@@ -1,0 +1,56 @@
+"""dr_tpu — a TPU-native distributed-ranges framework.
+
+A from-scratch re-design of Intel's *Distributed Ranges* capability set
+(reference: sudhirverma/distributed-ranges) for TPU: distributed containers
+whose ``segments()`` are shards of ``jax.Array``s on a device mesh,
+segment-preserving views, STL-style distributed algorithms lowered to fused
+XLA programs with mesh collectives, and halo (ghost-cell) exchange as
+``lax.ppermute`` neighbor shifts over ICI.
+
+Public surface (mirrors the reference's ``lib::`` / ``mhp::`` / ``shp::``
+namespaces through one TPU backend, called ``thp``):
+
+- runtime:   ``init / final / nprocs / devices / barrier / fence``
+- vocabulary: ``rank / segments / local`` CPOs + concept predicates
+- containers: ``distributed_vector``, ``distributed_span``, ``dense_matrix``,
+  ``sparse_matrix``
+- views:      ``views.take / drop / subrange / slice / zip / transform /
+  enumerate``
+- algorithms: ``fill / iota / copy / for_each / transform / reduce /
+  transform_reduce / inclusive_scan / exclusive_scan / dot / gemv``
+- halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
+"""
+
+from .parallel.runtime import (init, final, finalize, runtime, nprocs,
+                               devices, mesh, barrier, fence,
+                               get_duplicated_devices)
+from .parallel.halo import halo_bounds, span_halo, halo_ops
+from .core.vocabulary import (rank, segments, local, is_remote_range,
+                              is_distributed_range,
+                              is_remote_contiguous_range,
+                              is_distributed_contiguous_range)
+from .core.segment import Segment, ZipSegment
+from .containers.distributed_vector import distributed_vector, halo
+from .views import views
+from .views.views import aligned, local_segments
+from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
+                                     transform, to_numpy)
+from .algorithms.reduce import reduce, transform_reduce, dot
+from .algorithms.scan import inclusive_scan, exclusive_scan
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "final", "finalize", "runtime", "nprocs", "devices", "mesh",
+    "barrier", "fence", "get_duplicated_devices",
+    "halo_bounds", "span_halo", "halo_ops", "halo",
+    "rank", "segments", "local",
+    "is_remote_range", "is_distributed_range",
+    "is_remote_contiguous_range", "is_distributed_contiguous_range",
+    "Segment", "ZipSegment",
+    "distributed_vector",
+    "views", "aligned", "local_segments",
+    "fill", "iota", "copy", "copy_async", "for_each", "transform",
+    "to_numpy", "reduce", "transform_reduce", "dot",
+    "inclusive_scan", "exclusive_scan",
+]

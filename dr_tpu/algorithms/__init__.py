@@ -1,0 +1,4 @@
+from .elementwise import (fill, iota, copy, copy_async, for_each, transform,
+                          to_numpy)
+from .reduce import reduce, transform_reduce, dot
+from .scan import inclusive_scan, exclusive_scan

@@ -1,0 +1,38 @@
+"""Shared helpers for the algorithm layer: owned-cell masking and monoid
+combine tables (used by elementwise, reduce, and scan programs)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["owned_window_mask", "combine_for", "MONOID_COMBINE"]
+
+MONOID_COMBINE = {
+    "add": jnp.add,
+    "mul": jnp.multiply,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+def combine_for(kind, op):
+    """Elementwise combine fn for a classified monoid, else the user op."""
+    return MONOID_COMBINE[kind] if kind is not None else op
+
+
+def owned_window_mask(layout, off, n):
+    """(mask, gid) over the padded (nshards, width) cell grid.
+
+    ``gid`` is each cell's global logical index; ``mask`` selects owned
+    cells inside the logical window [off, off+n) and under the container's
+    logical size (pad/halo cells excluded).  This is the single source of
+    truth for the pad-and-mask rule (SURVEY.md §7 hard-part 3).
+    """
+    nshards, seg, prev, nxt, total_n = layout
+    width = prev + seg + nxt
+    col = jnp.arange(width)[None, :]
+    row = jnp.arange(nshards)[:, None]
+    owned = (col >= prev) & (col < prev + seg)
+    gid = row * seg + (col - prev)
+    mask = owned & (gid >= off) & (gid < off + n) & (gid < total_n)
+    return mask, gid

@@ -1,0 +1,349 @@
+"""Elementwise distributed algorithms: fill / iota / copy / for_each /
+transform.
+
+Reference behavior being matched (``include/dr/mhp/algorithms/
+cpu_algorithms.hpp:14-94,148-167`` and ``shp/algorithms/for_each.hpp``,
+``shp/copy.hpp``): every algorithm is collective and has two paths —
+
+* **aligned fast path**: all operands share a segment layout, so the whole
+  pipeline runs shard-local with zero communication.  Here that is ONE
+  cached jitted XLA program over the padded ``(nshards, width)`` arrays:
+  the view chain's ops, the user op, and the masked window write all fuse.
+* **fallback**: the reference falls back to rank-0 serial element RMA
+  (cpu_algorithms.hpp:44-54 — its known-slow path).  We instead evaluate
+  through logical arrays and let XLA/GSPMD insert the resharding
+  collectives — still compiled, still parallel, just with comm.
+
+Mutation contract (SURVEY.md §7 hard-part 1): algorithms REBIND the output
+container's array version; views write through to their base container.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ._common import owned_window_mask
+from ..containers.distributed_vector import distributed_vector
+from ..views import views as _v
+
+__all__ = ["fill", "iota", "copy", "copy_async", "for_each", "transform",
+           "to_numpy"]
+
+
+# ---------------------------------------------------------------------------
+# chain resolution: view pipeline -> (container, offset, length, ops)
+# ---------------------------------------------------------------------------
+
+class _Chain:
+    __slots__ = ("cont", "off", "n", "ops")
+
+    def __init__(self, cont, off, n, ops):
+        self.cont = cont
+        self.off = off
+        self.n = n
+        self.ops = tuple(ops)
+
+    @property
+    def key(self):
+        return (id(self.cont.runtime.mesh), self.cont.layout, self.off,
+                self.n, tuple(id(op) for op in self.ops))
+
+
+def _resolve(r) -> Optional[Tuple[_Chain, ...]]:
+    """Resolve ``r`` into per-leaf chains over containers, or None."""
+    if isinstance(r, distributed_vector):
+        return (_Chain(r, 0, len(r), ()),)
+    if isinstance(r, _v.subrange):
+        inner = _resolve(r.base)
+        if inner is None:
+            return None
+        return tuple(_Chain(c.cont, c.off + r.start, len(r), c.ops)
+                     for c in inner)
+    if isinstance(r, _v.transform):
+        inner = _resolve(r.base)
+        if inner is None:
+            return None
+        if len(inner) == 1:
+            c = inner[0]
+            return (_Chain(c.cont, c.off, c.n, c.ops + (r.op,)),)
+        return None  # transform-over-zip handled by the caller's op fusion
+    if isinstance(r, _v.zip_view):
+        chains = []
+        for comp in r.components:
+            inner = _resolve(comp)
+            if inner is None or len(inner) != 1:
+                return None
+            chains.append(inner[0])
+        n = len(r)
+        return tuple(_Chain(c.cont, c.off, n, c.ops) for c in chains)
+    return None
+
+
+def _fast_aligned(ins: Tuple[_Chain, ...], out: _Chain) -> bool:
+    """Aligned == same layout AND same window offset: segment (rank, size)
+    lists are then pairwise equal, the mhp::aligned condition."""
+    return all(c.cont.layout == out.cont.layout and c.off == out.off
+               for c in ins)
+
+
+# ---------------------------------------------------------------------------
+# fused elementwise programs
+# ---------------------------------------------------------------------------
+
+_prog_cache: dict = {}
+
+
+def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
+                    alias_mask=()):
+    """Cached program: out_data <- masked-window write of
+    op(chains(in_data...)) over padded shard arrays.  ``alias_mask[i]``
+    marks inputs that ARE the output container (in-place for_each): they
+    read the donated buffer instead of being passed twice."""
+    cont = out_chain.cont
+    nshards, seg, prev, nxt, _n = cont.layout
+    off, n = out_chain.off, out_chain.n
+    key = ("ew", cont.layout, off, n, in_keys,
+           tuple(tuple(id(o) for o in ops) for ops in in_ops),
+           id(op), with_index, alias_mask, str(cont.dtype))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    width = prev + seg + nxt
+
+    def body(out_data, *extra_datas):
+        it = iter(extra_datas)
+        in_datas = [out_data if aliased else next(it)
+                    for aliased in alias_mask] if alias_mask else []
+        vals_in = []
+        for data, ops in builtin_zip(in_datas, in_ops):
+            v = data
+            for o in ops:
+                v = o(v)
+            vals_in.append(v)
+        # global index of every padded cell (halo/pad cells -> out of window)
+        mask, gid = owned_window_mask(cont.layout, off, n)
+        if with_index:
+            vals = op(gid, *vals_in) if vals_in else op(gid)
+        else:
+            vals = op(*vals_in) if vals_in else op()
+        vals = jnp.broadcast_to(vals, out_data.shape).astype(out_data.dtype)
+        return jnp.where(mask, vals, out_data)
+
+    prog = jax.jit(body, donate_argnums=0)
+    _prog_cache[key] = prog
+    return prog
+
+
+builtin_zip = zip
+builtin_enumerate = enumerate
+
+
+def _run_fused(ins: Tuple[_Chain, ...], out_chain: _Chain, op,
+               with_index=False) -> None:
+    out_cont = out_chain.cont
+    alias_mask = tuple(c.cont is out_cont for c in ins)
+    prog = _window_program(
+        out_chain,
+        tuple(c.cont.layout for c in ins),
+        tuple(c.ops for c in ins),
+        op, with_index, alias_mask)
+    extra = [c.cont._data for c in ins if c.cont is not out_cont]
+    out_cont._data = prog(out_cont._data, *extra)
+
+
+def _write_window(out_chain: _Chain, values) -> None:
+    """Fallback write: splice values into the container's logical array."""
+    cont = out_chain.cont
+    arr = cont.to_array()
+    arr = arr.at[out_chain.off:out_chain.off + out_chain.n].set(
+        values.astype(cont.dtype))
+    cont.assign_array(arr)
+
+
+def _out_chain(out) -> _Chain:
+    res = _resolve(out)
+    if res is None or len(res) != 1 or res[0].ops:
+        raise TypeError(
+            "output must be a distributed_vector or a subrange view over one")
+    return res[0]
+
+
+# ---------------------------------------------------------------------------
+# public algorithms
+# ---------------------------------------------------------------------------
+
+def _generator_program(out_chain: _Chain, kind: str):
+    """Cached fill/iota program; the scalar is a traced argument so repeated
+    calls with different values reuse one compiled program."""
+    cont = out_chain.cont
+    key = ("gen", kind, cont.layout, out_chain.off, out_chain.n,
+           str(cont.dtype))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    layout, off, n = cont.layout, out_chain.off, out_chain.n
+
+    def body(out_data, scalar):
+        mask, gid = owned_window_mask(layout, off, n)
+        if kind == "fill":
+            vals = jnp.broadcast_to(scalar, out_data.shape)
+        else:
+            vals = gid + scalar
+        return jnp.where(mask, vals.astype(out_data.dtype), out_data)
+
+    prog = jax.jit(body, donate_argnums=0)
+    _prog_cache[key] = prog
+    return prog
+
+
+def fill(r, value) -> None:
+    """Collective fill (cpu_algorithms.hpp:14-28; shp/copy.hpp:147-174)."""
+    out = _out_chain(r)
+    prog = _generator_program(out, "fill")
+    out.cont._data = prog(out.cont._data, jnp.asarray(value, out.cont.dtype))
+
+
+def iota(r, start=0) -> None:
+    """Collective iota (cpu_algorithms.hpp:83-94).  The reference routes
+    every element through rank-0 RMA; here it is one sharded program."""
+    out = _out_chain(r)
+    prog = _generator_program(out, "iota")
+    out.cont._data = prog(out.cont._data,
+                          jnp.asarray(start - out.off))
+
+
+def transform(in_r, out, op: Callable) -> None:
+    """Collective transform (cpu_algorithms.hpp:148-167).  ``op`` is a
+    jax-traceable elementwise callable; over a zip input it receives one
+    argument per component."""
+    out_chain = _out_chain(out)
+    ins = _resolve(in_r)
+    n = len(in_r)
+    assert out_chain.n >= n, "output window too small"
+    out_chain.n = n if n < out_chain.n else out_chain.n
+    if ins is not None and _fast_aligned(ins, out_chain):
+        _run_fused(ins, out_chain, op)
+        return
+    # fallback: logical-array evaluation; XLA inserts the resharding
+    arr = in_r.to_array() if hasattr(in_r, "to_array") else jnp.asarray(in_r)
+    vals = op(*arr) if isinstance(arr, tuple) else op(arr)
+    _write_window(out_chain, vals[:out_chain.n] if vals.shape[0] != out_chain.n
+                  else vals)
+
+
+def copy(src, dst) -> None:
+    """Collective copy (cpu_algorithms.hpp:36-54; shp/copy.hpp:16-138).
+    Accepts host arrays on either side like the shp host<->device overloads."""
+    if isinstance(src, (np.ndarray, jax.Array, list, tuple)) and \
+            not hasattr(src, "__dr_segments__"):
+        out = _out_chain(dst)
+        _write_window(out, jnp.asarray(src, out.cont.dtype))
+        return
+    if isinstance(dst, np.ndarray):
+        vals = to_numpy(src)
+        dst[:len(vals)] = vals
+        return
+    transform(src, dst, _identity)
+
+
+def _identity(x):
+    return x
+
+
+def copy_async(src, dst):
+    """shp::copy_async parity: JAX dispatch is already asynchronous; the
+    returned handle's .wait() blocks (event-join, shp/copy.hpp:116-138)."""
+    copy(src, dst)
+
+    class _Event:
+        def __init__(self, cont):
+            self._cont = cont
+
+        def wait(self):
+            if hasattr(self._cont, "block_until_ready"):
+                self._cont.block_until_ready()
+    tgt = dst if hasattr(dst, "block_until_ready") else None
+    return _Event(tgt if tgt is not None else dst)
+
+
+def for_each(r, fn: Callable) -> None:
+    """Collective in-place for_each (cpu_algorithms.hpp:63-74;
+    shp/algorithms/for_each.hpp:16-92).
+
+    Semantic shift for immutable arrays: ``fn`` is PURE — it receives the
+    element value(s) and returns the new value(s); over a zip range it
+    returns a tuple (one entry per component) to write back."""
+    if isinstance(r, _v.zip_view):
+        outs = [_out_chain(c) for c in r.components]
+        ins = _resolve(r)
+        if ins is not None and all(_fast_aligned(ins, oc) for oc in outs):
+            conts = [oc.cont for oc in outs]
+            # inputs that are also outputs read the donated buffers
+            alias = tuple(
+                next((i for i, c in builtin_enumerate(conts)
+                      if c is ch.cont), -1) for ch in ins)
+            prog = _zip_foreach_program(ins, outs, fn, alias)
+            extra = [ch.cont._data for ch, a in builtin_zip(ins, alias)
+                     if a < 0]
+            datas = prog(*[c._data for c in conts], *extra)
+            for cont, nd in builtin_zip(conts, datas):
+                cont._data = nd
+            return
+        arrs = r.to_array()
+        vals = fn(*arrs)
+        if not isinstance(vals, tuple):
+            raise TypeError("for_each over zip: fn must return a tuple")
+        for oc, v in builtin_zip(outs, vals):
+            _write_window(oc, v)
+        return
+    transform(r, r, fn)
+
+
+def _zip_foreach_program(ins, outs, fn, alias):
+    key = ("zfe", tuple(c.key for c in ins), tuple(o.key for o in outs),
+           id(fn), alias)
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    k = len(outs)
+    cont = outs[0].cont
+    nshards, seg, prev, nxt, _n = cont.layout
+    off, n = outs[0].off, outs[0].n
+    width = prev + seg + nxt
+    in_ops = tuple(c.ops for c in ins)
+
+    def body(*datas):
+        out_datas, extra_datas = datas[:k], datas[k:]
+        it = iter(extra_datas)
+        in_datas = [out_datas[a] if a >= 0 else next(it) for a in alias]
+        vals_in = []
+        for data, ops in builtin_zip(in_datas, in_ops):
+            v = data
+            for o in ops:
+                v = o(v)
+            vals_in.append(v)
+        new_vals = fn(*vals_in)
+        mask, _gid = owned_window_mask(cont.layout, off, n)
+        return tuple(
+            jnp.where(mask, nv.astype(od.dtype), od)
+            for od, nv in builtin_zip(out_datas, new_vals))
+
+    prog = jax.jit(body, donate_argnums=tuple(range(k)))
+    _prog_cache[key] = prog
+    return prog
+
+
+def to_numpy(r) -> np.ndarray:
+    """Materialize a distributed range on the host (test-oracle path)."""
+    if hasattr(r, "to_array"):
+        arr = r.to_array()
+        if isinstance(arr, tuple):
+            return tuple(np.asarray(a) for a in arr)
+        return np.asarray(arr)
+    return np.asarray(r)

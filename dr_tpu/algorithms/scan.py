@@ -1,0 +1,154 @@
+"""Distributed prefix scan: ``inclusive_scan`` / ``exclusive_scan``.
+
+Reference: the 3-phase multi-GPU scan (``shp/algorithms/inclusive_scan.hpp:
+25-148``) — (1) per-segment scan, (2) scan of per-segment totals on the root
+device, (3) per-segment carry fixup — with host event.wait() barriers
+between phases.
+
+TPU re-design: ONE jitted ``shard_map`` program per layout — local
+``lax.associative_scan`` over the owned (masked) cells, ``all_gather`` of
+segment totals over the mesh axis, an exclusive fold of preceding totals
+(the carry), and the broadcast fixup — all fused by XLA, no host barriers
+(SURVEY.md §2.5 "Distributed prefix scan").
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._common import combine_for
+from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
+from .reduce import _classify_op, _identity_for
+
+__all__ = ["inclusive_scan", "exclusive_scan"]
+
+
+def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
+    key = ("scan", id(mesh), axis, layout, kind, id(op) if kind is None
+           else None, exclusive, str(dtype))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    nshards, seg, prev, nxt, n = layout
+    combine = combine_for(kind, op)
+
+    def body(blk):  # (1, width) one shard row
+        ident = _identity_for(kind, dtype) if kind is not None else None
+        x = blk[0, prev:prev + seg]
+        r = lax.axis_index(axis)
+        gid = r * seg + jnp.arange(seg)
+        if ident is not None:
+            x = jnp.where(gid < n, x, ident)
+        local = lax.associative_scan(combine, x)
+        totals = lax.all_gather(local[-1], axis)          # (nshards,)
+        # exclusive fold of totals from ranks < r  ->  my carry
+        if ident is not None:
+            masked = jnp.where(jnp.arange(nshards) < r, totals, ident)
+            carry = lax.associative_scan(combine, masked)[-1]
+            scanned = jnp.where(r > 0, combine(carry, local), local)
+        else:
+            # no identity: fold sequentially with lax.fori_loop
+            def fold(i, acc):
+                return jnp.where(i < r, combine(acc, totals[i]), acc)
+            carry = lax.fori_loop(1, nshards, fold, totals[0])
+            scanned = jnp.where(r > 0, combine(carry, local), local)
+        if exclusive:
+            shifted = jnp.roll(scanned, 1)
+            prev_rank_last = lax.ppermute(
+                scanned[-1], axis,
+                [(i, i + 1) for i in range(nshards - 1)])
+            first = prev_rank_last if ident is None else \
+                jnp.where(r > 0, prev_rank_last, ident)
+            scanned = shifted.at[0].set(first)
+        out = jnp.zeros((1, prev + seg + nxt), dtype)
+        return out.at[0, prev:prev + seg].set(scanned.astype(dtype))
+
+    shmapped = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                             out_specs=P(axis, None))
+    prog = jax.jit(shmapped)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _scan(in_r, out, op, init, exclusive):
+    if op is None:
+        op = operator.add
+    kind = _classify_op(op)
+    out_chain = _out_chain(out)
+    ins = _resolve(in_r)
+    full = (
+        ins is not None and len(ins) == 1 and not ins[0].ops
+        and ins[0].off == 0 and out_chain.off == 0
+        and ins[0].cont.layout == out_chain.cont.layout
+        and ins[0].n == len(ins[0].cont)
+        # the fast program rebuilds the whole output array, so the output
+        # window must cover the whole container too
+        and out_chain.n == len(out_chain.cont)
+    )
+    if full:
+        c = ins[0]
+        mesh = c.cont.runtime.mesh
+        prog = _scan_program(mesh, c.cont.runtime.axis, c.cont.layout,
+                             kind, op, exclusive, out_chain.cont.dtype)
+        out_chain.cont._data = prog(c.cont._data)
+        scanned = None
+    else:
+        arr = in_r.to_array() if hasattr(in_r, "to_array") \
+            else jnp.asarray(in_r)
+        combine = combine_for(kind, op)
+        scanned = lax.associative_scan(combine, arr)
+        if exclusive:
+            ident = (_identity_for(kind, arr.dtype) if kind is not None
+                     else arr[0] * 0)
+            scanned = jnp.concatenate(
+                [ident[None].astype(arr.dtype), scanned[:-1]])
+        _write_window(out_chain, scanned[:out_chain.n])
+    if init is not None:
+        # std::inclusive_scan init semantics: init folds into every prefix
+        cont = out_chain.cont
+        combine = combine_for(kind, op)
+        arr = cont.to_array()
+        arr = arr.at[out_chain.off:out_chain.off + out_chain.n].set(
+            combine(jnp.asarray(init, cont.dtype),
+                    arr[out_chain.off:out_chain.off + out_chain.n]))
+        cont.assign_array(arr)
+    return out
+
+
+def inclusive_scan(in_r, out, op: Callable = None, init=None):
+    """Distributed inclusive prefix scan
+    (shp/algorithms/inclusive_scan.hpp:25-148)."""
+    return _scan(in_r, out, op, init, exclusive=False)
+
+
+def exclusive_scan(in_r, out, init=0, op: Callable = None):
+    """Exclusive variant (std::exclusive_scan surface; the reference spec
+    names it, doc/spec/source/algorithms/)."""
+    out = _scan(in_r, out, op, None, exclusive=True)
+    # exclusive scan seeds with init at position 0 and folds into the rest
+    if init is not None and init != 0:
+        _scan_apply_init(out, init, op)
+    else:
+        pass
+    return out
+
+
+def _scan_apply_init(out, init, op):
+    if op is None:
+        op = operator.add
+    kind = _classify_op(op)
+    combine = combine_for(kind, op)
+    chain = _out_chain(out)
+    cont = chain.cont
+    arr = cont.to_array()
+    seg = arr[chain.off:chain.off + chain.n]
+    seg = combine(jnp.asarray(init, cont.dtype), seg)
+    arr = arr.at[chain.off:chain.off + chain.n].set(seg)
+    cont.assign_array(arr)

@@ -1,0 +1,1 @@
+from .distributed_vector import distributed_vector, halo

@@ -1,0 +1,298 @@
+"""``distributed_vector``: 1-D block-distributed vector on the TPU mesh.
+
+TPU-native re-design of both reference implementations:
+
+* ``mhp::distributed_vector`` — per-rank block + halo padding + RMA window
+  (``include/dr/mhp/containers/distributed_vector.hpp:176-238``),
+* ``shp::distributed_vector`` — one device segment per GPU
+  (``include/dr/shp/distributed_vector.hpp:138-182``).
+
+Design: the vector owns ONE ``jax.Array`` of shape ``(nshards, prev + seg +
+next)`` sharded over the mesh axis — shard row r is rank r's local block
+``[ghost_prev | owned | ghost_next]``, exactly the reference's local
+allocation (dv.hpp:190-194: ``segment_size = max(ceil(n/p), prev, next)``,
+alloc ``segment_size + prev + next``).  The last shard is padded; logical
+size ``n`` is metadata and every collective masks the tail (SURVEY.md §7
+hard-part 3).
+
+Mutation model (hard-part 1): JAX arrays are immutable values, so the
+container holds the *current version* and every algorithm rebinds it.
+Element/batched access replaces the reference's per-element MPI RMA
+(dv.hpp:109-122 — its known-slow path) with explicit batched gather/scatter
+through ``get()``/``put()`` — host-mediated, one fused XLA program per call.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.segment import Segment
+from ..parallel import runtime as _rt
+from ..parallel.halo import halo_bounds, span_halo
+
+__all__ = ["distributed_vector", "halo"]
+
+
+def _normalize_dtype(dtype):
+    if dtype is None:
+        return jnp.float32
+    if dtype is float:
+        return jnp.float32
+    if dtype is int:
+        return jnp.int32
+    return jnp.dtype(dtype)
+
+
+class distributed_vector:
+    """1-D block-distributed vector with optional halo regions."""
+
+    def __init__(self, size: int, dtype=None, halo: Optional[halo_bounds] = None,
+                 *, runtime=None, _data=None):
+        self._rt = runtime or _rt.runtime()
+        self._n = int(size)
+        self._dtype = _normalize_dtype(dtype)
+        self._hb = halo or halo_bounds()
+        P = self._rt.nprocs
+        # segment_size = max(ceil(n/p), prev, next)   (dv.hpp:190-193)
+        self._seg = max(-(-self._n // P) if self._n else 1,
+                        self._hb.prev, self._hb.next, 1)
+        self._nshards = P
+        if _data is not None:
+            self._data = _data
+        else:
+            self._data = _zeros(self._rt.mesh, self._rt.axis, P,
+                                self.block_width, self._dtype)
+        self._halo = span_halo(self) if self._hb.width else None
+        self._rt.register(self)
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def runtime(self):
+        return self._rt
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def halo_bounds(self) -> halo_bounds:
+        return self._hb
+
+    @property
+    def segment_size(self) -> int:
+        return self._seg
+
+    @property
+    def nshards(self) -> int:
+        return self._nshards
+
+    @property
+    def block_width(self) -> int:
+        """Per-shard row width: prev + seg + next."""
+        return self._hb.prev + self._seg + self._hb.next
+
+    @property
+    def layout(self):
+        """Alignment key: equal layouts => segment lists pairwise equal
+        (the ``mhp::aligned`` condition, mhp/alignment.hpp:13-28)."""
+        return (self._nshards, self._seg, self._hb.prev, self._hb.next,
+                self._n)
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def size(self) -> int:
+        return self._n
+
+    # ----------------------------------------------------------- vocabulary
+    def __dr_segments__(self):
+        segs = []
+        for r in range(self._nshards):
+            begin = r * self._seg
+            end = min(self._n, begin + self._seg)
+            if begin < end:
+                segs.append(Segment(self, r, begin, end))
+        return segs
+
+    # ------------------------------------------------------------- halo API
+    def halo(self) -> span_halo:
+        if self._halo is None:
+            raise ValueError("distributed_vector built without halo_bounds")
+        return self._halo
+
+    # ----------------------------------------------------------- value APIs
+    def to_array(self) -> jax.Array:
+        """Current logical value as a 1-D jax array of length n."""
+        return _extract(self._rt.mesh, self._rt.axis, self._nshards,
+                        self._seg, self._hb.prev, self._hb.next, self._n,
+                        self._dtype)(self._data)
+
+    def assign_array(self, values) -> None:
+        """Rebind the whole logical value (ghost cells reset to zero)."""
+        values = jnp.asarray(values, self._dtype)
+        assert values.shape == (self._n,)
+        self._data = _pack(self._rt.mesh, self._rt.axis, self._nshards,
+                           self._seg, self._hb.prev, self._hb.next, self._n,
+                           self._dtype)(values)
+
+    @classmethod
+    def from_array(cls, values, halo: Optional[halo_bounds] = None, *,
+                   runtime=None) -> "distributed_vector":
+        values = jnp.asarray(values)
+        dv = cls(values.shape[0], values.dtype, halo, runtime=runtime)
+        dv.assign_array(values)
+        return dv
+
+    # -- segment plumbing used by Segment ----------------------------------
+    def _host_values(self, begin: int, end: int) -> np.ndarray:
+        return np.asarray(self.to_array()[begin:end])
+
+    def _local_values(self, rank: int, begin: int, end: int):
+        lo = rank * self._seg
+        prev = self._hb.prev
+        for sh in self._data.addressable_shards:
+            idx = sh.index[0]
+            start = 0 if idx.start is None else idx.start
+            if start == rank and (idx.stop is None or idx.stop == rank + 1):
+                row = sh.data.reshape(-1)
+                return row[prev + (begin - lo): prev + (end - lo)]
+        # shard not addressable from this host (multi-host): global read
+        return self.to_array()[begin:end]
+
+    # ------------------------------------------------ element/batched access
+    def _locate(self, i):
+        i = jnp.asarray(i)
+        r = i // self._seg
+        c = self._hb.prev + i % self._seg
+        return r, c
+
+    def get(self, indices):
+        """Batched remote read (replaces per-element MPI_Rget,
+        dv.hpp:109-116)."""
+        r, c = self._locate(jnp.asarray(indices) % self._n)
+        return self._data[r, c]
+
+    def put(self, indices, values) -> None:
+        """Batched remote write (replaces per-element MPI_Put,
+        dv.hpp:118-122)."""
+        r, c = self._locate(jnp.asarray(indices) % self._n)
+        self._data = self._data.at[r, c].set(
+            jnp.asarray(values, self._dtype))
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            from ..views import subrange
+            start, stop, step = key.indices(self._n)
+            assert step == 1, "stride-1 subranges only"
+            return subrange(self, start, stop)
+        i = int(key)
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        return self._data[i // self._seg,
+                          self._hb.prev + i % self._seg].item()
+
+    def __setitem__(self, key, value) -> None:
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._n)
+            assert step == 1
+            idx = jnp.arange(start, stop)
+            self.put(idx, value)
+            return
+        i = int(key)
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        self.put(jnp.asarray([i]), jnp.asarray([value], self._dtype))
+
+    def __iter__(self):
+        return iter(np.asarray(self.to_array()))
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self.to_array())
+
+    def block_until_ready(self) -> "distributed_vector":
+        jax.block_until_ready(self._data)
+        return self
+
+    def __repr__(self):
+        return (f"distributed_vector(n={self._n}, dtype={self._dtype}, "
+                f"shards={self._nshards}x{self.block_width}, hb={self._hb})")
+
+
+# ---------------------------------------------------------------------------
+# cached jitted layout programs
+# ---------------------------------------------------------------------------
+
+_jit_cache: dict = {}
+
+
+def _cached(key, builder):
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = builder()
+        _jit_cache[key] = fn
+    return fn
+
+
+def _zeros(mesh, axis, nshards, width, dtype):
+    key = ("zeros", id(mesh), axis, nshards, width, str(dtype))
+
+    def build():
+        sh = NamedSharding(mesh, PartitionSpec(axis, None))
+        return jax.jit(lambda: jnp.zeros((nshards, width), dtype),
+                       out_shardings=sh)
+    return _cached(key, build)()
+
+
+def _extract(mesh, axis, nshards, seg, prev, nxt, n, dtype):
+    key = ("extract", id(mesh), axis, nshards, seg, prev, nxt, n, str(dtype))
+
+    def build():
+        def fn(data):
+            owned = data[:, prev:prev + seg] if (prev or nxt) else data
+            return owned.reshape(nshards * seg)[:n]
+        return jax.jit(fn)
+    return _cached(key, build)
+
+
+def _pack(mesh, axis, nshards, seg, prev, nxt, n, dtype):
+    key = ("pack", id(mesh), axis, nshards, seg, prev, nxt, n, str(dtype))
+
+    def build():
+        sh = NamedSharding(mesh, PartitionSpec(axis, None))
+
+        def fn(values):
+            flat = jnp.zeros((nshards * seg,), dtype).at[:n].set(values)
+            body = flat.reshape(nshards, seg)
+            if prev or nxt:
+                data = jnp.zeros((nshards, prev + seg + nxt), dtype)
+                data = data.at[:, prev:prev + seg].set(body)
+            else:
+                data = body
+            return data
+        return jax.jit(fn, out_shardings=sh)
+    return _cached(key, build)
+
+
+def halo(dr) -> span_halo:
+    """Fetch the halo of the distributed_vector underlying any view over it
+    (reference mhp dv.hpp:240-248)."""
+    obj = dr
+    seen = set()
+    while obj is not None and id(obj) not in seen:
+        seen.add(id(obj))
+        if isinstance(obj, distributed_vector):
+            return obj.halo()
+        obj = getattr(obj, "base", None)
+    raise TypeError("halo(): no underlying distributed_vector")

@@ -1,0 +1,3 @@
+from .vocabulary import (rank, segments, local, is_remote_range,
+                         is_distributed_range)
+from .segment import Segment, ZipSegment

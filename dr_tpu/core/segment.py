@@ -1,0 +1,130 @@
+"""Segment types: the value vocabulary returned by ``segments()``.
+
+A ``Segment`` is the TPU analog of the reference's ``lib::remote_subrange``
+(``include/dr/details/remote_subrange.hpp:13-37``) and of the per-rank
+segment types ``dv_segment`` (``mhp/containers/distributed_vector.hpp:137-162``)
+and ``device_span`` (``shp/device_span.hpp:43-84``): a contiguous slice of a
+distributed container's logical index space owned by one mesh rank.
+
+Design shift for TPU: a segment does not hold a pointer — it holds
+``(base, rank, begin, end)`` metadata plus a lazy elementwise op chain (how
+``transform_view`` segments stay distributed, reference
+``views/transform.hpp:9-43``).  ``local()`` reads the current shard *value*;
+mutation happens through the owning container's batched update API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+__all__ = ["Segment", "ZipSegment"]
+
+
+class Segment:
+    """Contiguous slice [begin, end) of ``base``'s logical space on ``rank``."""
+
+    __slots__ = ("base", "_rank", "begin", "end", "ops")
+
+    def __init__(self, base: Any, rank: int, begin: int, end: int,
+                 ops: Tuple[Callable, ...] = ()):
+        assert end >= begin
+        self.base = base
+        self._rank = rank
+        self.begin = begin
+        self.end = end
+        self.ops = tuple(ops)
+
+    # -- vocabulary protocol ------------------------------------------------
+    def __dr_rank__(self) -> int:
+        return self._rank
+
+    def __dr_local__(self):
+        """Device-resident values of this slice (no cross-device traffic)."""
+        vals = self.base._local_values(self._rank, self.begin, self.end)
+        for op in self.ops:
+            vals = op(vals)
+        return vals
+
+    # -- sequence-ish surface ----------------------------------------------
+    def __len__(self) -> int:
+        return self.end - self.begin
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, step = key.indices(len(self))
+            assert step == 1, "segments are contiguous"
+            return Segment(self.base, self._rank, self.begin + start,
+                           self.begin + stop, self.ops)
+        return self.materialize()[key]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def first(self, k: int) -> "Segment":
+        return self[:k]
+
+    def last(self, k: int) -> "Segment":
+        return self[len(self) - k:]
+
+    def subspan(self, offset: int, count: int) -> "Segment":
+        return self[offset:offset + count]
+
+    def with_op(self, op: Callable) -> "Segment":
+        return Segment(self.base, self._rank, self.begin, self.end,
+                       self.ops + (op,))
+
+    def materialize(self) -> np.ndarray:
+        """Host copy of this segment's values (the test-oracle path)."""
+        vals = self.base._host_values(self.begin, self.end)
+        for op in self.ops:
+            vals = op(vals)
+        return np.asarray(vals)
+
+    def __repr__(self):
+        return (f"Segment(rank={self._rank}, [{self.begin},{self.end})"
+                f"{', ops' if self.ops else ''})")
+
+
+class ZipSegment:
+    """A rank-aligned tuple of equally-sized segments (one per zipped range).
+
+    Analog of the reference's zipped segments (``shp/zip_view.hpp:149-206``):
+    all parts share a rank and length, so elementwise work on the tuple stays
+    on one device.
+    """
+
+    __slots__ = ("parts",)
+
+    def __init__(self, *parts):
+        assert parts
+        n = len(parts[0])
+        assert all(len(p) == n for p in parts), "zip segments must align"
+        self.parts = tuple(parts)
+
+    def __dr_rank__(self) -> int:
+        from .vocabulary import rank
+        return rank(self.parts[0])
+
+    def __dr_local__(self):
+        from .vocabulary import local
+        return tuple(local(p) for p in self.parts)
+
+    def __len__(self) -> int:
+        return len(self.parts[0])
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return ZipSegment(*(p[key] for p in self.parts))
+        return tuple(p.materialize()[key] for p in self.parts)
+
+    def __iter__(self):
+        mats = [p.materialize() for p in self.parts]
+        return iter(zip(*mats))
+
+    def materialize(self):
+        return tuple(p.materialize() for p in self.parts)
+
+    def __repr__(self):
+        return f"ZipSegment(rank={self.__dr_rank__()}, n={len(self)})"

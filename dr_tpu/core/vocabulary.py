@@ -1,0 +1,180 @@
+"""Core vocabulary: the ``rank`` / ``segments`` / ``local`` customization
+points and the remote/distributed range concepts.
+
+TPU-native re-design of the reference's L0 layer:
+
+* CPOs ``lib::ranges::rank/segments/local`` with method -> ADL -> fallback
+  resolution (reference ``include/dr/details/ranges.hpp:38-161``),
+* concepts ``remote_range`` / ``distributed_range`` etc.
+  (``include/dr/concepts/concepts.hpp:11-53``).
+
+Resolution order here mirrors the reference: a ``__dr_rank__``-style method
+on the object ("member function"), then a ``singledispatch`` registration
+("ADL overload") so foreign types can participate, then a documented
+fallback.  ``disable_rank`` (``ranges.hpp:15``) maps to the ``disable_rank``
+class attribute.
+
+On TPU, "rank" identifies the mesh position (device slot) owning a shard of
+a ``jax.Array``; ``local()`` yields the device-resident shard values instead
+of a raw pointer — arrays are immutable values, so local access is a read
+of the current version, and writes go through the container's batched
+update API (see SURVEY.md §7 hard-part 1).
+"""
+
+from __future__ import annotations
+
+from functools import singledispatch
+from typing import Any, Iterable
+
+__all__ = [
+    "rank",
+    "segments",
+    "local",
+    "rank_dispatch",
+    "segments_dispatch",
+    "local_dispatch",
+    "is_remote_range",
+    "is_distributed_range",
+    "is_remote_contiguous_range",
+    "is_distributed_contiguous_range",
+    "has_rank",
+    "has_segments",
+]
+
+
+# ---------------------------------------------------------------------------
+# "ADL" dispatch tables: foreign types register here, like the reference's
+# DR_RANGES_NAMESPACE ADL hooks (details/segments_tools.hpp:149-223).
+# ---------------------------------------------------------------------------
+
+@singledispatch
+def rank_dispatch(obj: Any):
+    raise TypeError(f"rank() is not available for {type(obj).__name__}")
+
+
+@singledispatch
+def segments_dispatch(obj: Any):
+    raise TypeError(f"segments() is not available for {type(obj).__name__}")
+
+
+@singledispatch
+def local_dispatch(obj: Any):
+    raise TypeError(f"local() is not available for {type(obj).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# CPOs
+# ---------------------------------------------------------------------------
+
+def rank(obj: Any) -> int:
+    """Owning mesh rank of a remote range / segment / iterator.
+
+    Mirrors ``rank_fn_`` (ranges.hpp:38-68): member -> ADL -> iterator
+    fallback (an object exposing a single segment delegates to it).
+    """
+    if getattr(type(obj), "disable_rank", False):
+        raise TypeError(f"rank() disabled for {type(obj).__name__}")
+    fn = getattr(obj, "__dr_rank__", None)
+    if fn is not None:
+        return fn() if callable(fn) else fn
+    try:
+        return rank_dispatch(obj)
+    except TypeError:
+        pass
+    raise TypeError(f"rank() is not available for {type(obj).__name__}")
+
+
+def segments(obj: Any):
+    """Sequence of remote sub-ranges making up a distributed range.
+
+    Mirrors ``segments_fn_`` (ranges.hpp:94-114).  Always returns a
+    (possibly empty) list; an *empty* list is the misalignment signal
+    (zip of misaligned ranges — segments_tools.hpp:117-121).
+    """
+    fn = getattr(obj, "__dr_segments__", None)
+    if fn is not None:
+        return list(fn())
+    try:
+        return list(segments_dispatch(obj))
+    except TypeError:
+        pass
+    raise TypeError(f"segments() is not available for {type(obj).__name__}")
+
+
+def local(obj: Any):
+    """Device-local values of a remote range/segment.
+
+    Mirrors ``local_fn_`` (ranges.hpp:133-161).  For a segment of a
+    sharded ``jax.Array`` this returns the addressable shard slice (a jax
+    array on the owning device) — the functional analog of the raw local
+    pointer.  For host objects (numpy/lists) it is the identity, matching
+    the reference fallback for non-remote iterators.
+    """
+    fn = getattr(obj, "__dr_local__", None)
+    if fn is not None:
+        return fn()
+    try:
+        return local_dispatch(obj)
+    except TypeError:
+        pass
+    return obj  # identity fallback (ranges.hpp:150-155)
+
+
+# ---------------------------------------------------------------------------
+# Concepts (concepts/concepts.hpp:11-53) as runtime predicates.
+# ---------------------------------------------------------------------------
+
+def has_rank(obj: Any) -> bool:
+    try:
+        rank(obj)
+        return True
+    except TypeError:
+        return False
+
+
+def has_segments(obj: Any) -> bool:
+    return getattr(obj, "__dr_segments__", None) is not None or _has_dispatch(
+        segments_dispatch, obj
+    )
+
+
+def _has_dispatch(table, obj) -> bool:
+    return table.dispatch(type(obj)) is not table.dispatch(object)
+
+
+def is_remote_range(obj: Any) -> bool:
+    """remote_range: a sized range with a rank (concepts.hpp:15-17)."""
+    return _is_sized(obj) and has_rank(obj)
+
+
+def is_distributed_range(obj: Any) -> bool:
+    """distributed_range: sized range whose segments() are remote ranges
+    (concepts.hpp:19-21)."""
+    if not _is_sized(obj) or not has_segments(obj):
+        return False
+    segs = segments(obj)
+    return all(is_remote_range(s) for s in segs)
+
+
+def is_remote_contiguous_range(obj: Any) -> bool:
+    """remote_contiguous_range (concepts.hpp:37-43): remote and backed by a
+    contiguous local shard — here: ``local()`` yields an array."""
+    if not is_remote_range(obj):
+        return False
+    loc = local(obj)
+    return hasattr(loc, "shape") or hasattr(loc, "__array__")
+
+
+def is_distributed_contiguous_range(obj: Any) -> bool:
+    """distributed_contiguous_range (concepts.hpp:45-52)."""
+    return is_distributed_range(obj) and all(
+        is_remote_contiguous_range(s) for s in segments(obj)
+    )
+
+
+def _is_sized(obj: Any) -> bool:
+    try:
+        len(obj)
+        return True
+    except TypeError:
+        return False

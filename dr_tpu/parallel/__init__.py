@@ -1,0 +1,2 @@
+from . import runtime
+from .halo import halo_bounds, span_halo, halo_ops

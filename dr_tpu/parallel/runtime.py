@@ -1,0 +1,193 @@
+"""TPU runtime: device mesh lifecycle for the ``thp`` execution backend.
+
+This is the TPU-native analog of the reference's two runtimes:
+
+* ``mhp::init()`` — MPI SPMD context (reference
+  ``include/dr/mhp/global.hpp:24-47``), and
+* ``shp::init(devices)`` — one process driving multiple SYCL GPUs through a
+  shared context (reference ``include/dr/shp/init.hpp:40-50``).
+
+On TPU both collapse into one model: a single controller owning a
+``jax.sharding.Mesh`` of devices.  Intra-host device-to-device traffic rides
+ICI via XLA collectives; the multi-host (MHP) dimension rides DCN via
+``jax.distributed`` with the *same* mesh abstraction.  Where the reference
+tracks per-container MPI RMA windows and fences them globally
+(``mhp/global.hpp:41-47``), JAX arrays are values: ``fence()`` maps to
+``jax.block_until_ready`` on outstanding container state.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import weakref
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "init",
+    "final",
+    "finalize",
+    "runtime",
+    "is_initialized",
+    "nprocs",
+    "devices",
+    "mesh",
+    "barrier",
+    "fence",
+    "Runtime",
+    "get_duplicated_devices",
+]
+
+
+@dataclass
+class Runtime:
+    """Global execution context: the device mesh and its shardings.
+
+    ``axis`` is the 1-D vector-distribution axis (the analog of MPI rank
+    space / the SHP device list); matrices tile over a 2-D view of the same
+    devices (see ``dr_tpu.containers.partition``).
+    """
+
+    mesh: Mesh
+    axis: str = "x"
+    #: containers register here so ``fence()`` can sync them, mirroring the
+    #: reference's active-window set (mhp/global.hpp:26).  Weak references:
+    #: dropped containers (and their device arrays) stay collectable.
+    _live: "weakref.WeakSet" = field(default_factory=weakref.WeakSet)
+
+    @property
+    def nprocs(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def devices(self):
+        return list(self.mesh.devices.reshape(-1))
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def block_sharding(self) -> NamedSharding:
+        """Sharding for the canonical (nprocs, segment) container layout."""
+        return NamedSharding(self.mesh, P(self.axis))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def register(self, container) -> None:
+        self._live.add(container)
+
+    def fence(self) -> None:
+        """Block until every registered container's current value is ready.
+
+        The reference fences all active RMA windows (mhp/global.hpp:41-47);
+        here array versions are values, so a fence is a readiness barrier.
+        """
+        for c in list(self._live):
+            data = getattr(c, "_data", None)
+            if data is not None:
+                jax.block_until_ready(data)
+
+    def barrier(self) -> None:
+        # Single-controller: program order is the barrier.  Multi-host JAX
+        # processes synchronize through the collectives themselves; an
+        # explicit barrier only needs to drain dispatched work.
+        self.fence()
+
+
+_runtime: Optional[Runtime] = None
+
+
+def get_duplicated_devices(n: int, devices: Optional[Sequence] = None):
+    """Pad the device list by repetition to reach ``n`` entries.
+
+    Port of the reference's multi-device faking used to test an N-GPU node
+    on fewer GPUs (``shp/util.hpp:119-136``).  On TPU the preferred fake is
+    ``--xla_force_host_platform_device_count`` (see tests/conftest.py), but
+    duplication is kept for API parity and for oversubscribing one real chip.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise RuntimeError("no JAX devices visible")
+    return [devices[i % len(devices)] for i in range(n)]
+
+
+def init(
+    devices: Optional[Sequence] = None,
+    *,
+    nprocs: Optional[int] = None,
+    axis: str = "x",
+) -> Runtime:
+    """Initialize the global runtime over a 1-D device mesh.
+
+    Analog of ``mhp::init()`` / ``shp::init(devices)``.  A jax Mesh cannot
+    repeat a physical device, so ``nprocs`` must be <= the device count;
+    to fake a larger mesh use ``--xla_force_host_platform_device_count``
+    (the TPU analog of the reference's device duplication,
+    shp/util.hpp:119-136 — see tests/conftest.py).
+    """
+    global _runtime
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if nprocs is not None:
+        if nprocs > len(devices):
+            raise ValueError(
+                f"nprocs={nprocs} exceeds the {len(devices)} visible "
+                "devices; a TPU mesh cannot repeat a device — fake a "
+                "larger mesh with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N")
+        devices = devices[:nprocs]
+    if len({d.id for d in devices}) != len(devices):
+        raise ValueError("device list contains duplicates; a mesh needs "
+                         "distinct devices")
+    mesh = Mesh(np.asarray(devices), (axis,))
+    _runtime = Runtime(mesh=mesh, axis=axis)
+    return _runtime
+
+
+def runtime() -> Runtime:
+    if _runtime is None:
+        init()
+    return _runtime  # type: ignore[return-value]
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def final() -> None:
+    """Tear down the global context (``mhp::final``, mhp/global.hpp:30-33)."""
+    global _runtime
+    if _runtime is not None:
+        _runtime.fence()
+    _runtime = None
+
+
+finalize = final
+
+
+def nprocs() -> int:
+    return runtime().nprocs
+
+
+def devices():
+    return runtime().devices
+
+
+def mesh() -> Mesh:
+    return runtime().mesh
+
+
+def barrier() -> None:
+    runtime().barrier()
+
+
+def fence() -> None:
+    runtime().fence()

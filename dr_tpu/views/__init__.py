@@ -1,0 +1,4 @@
+from . import views
+from .views import (take, drop, subrange, slice_view, transform, zip_view,
+                    enumerate_view, iota_view, aligned, local_segments,
+                    take_segments, drop_segments, ranked_view)

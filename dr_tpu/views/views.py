@@ -1,0 +1,419 @@
+"""Segment-preserving views over distributed ranges.
+
+TPU re-design of the reference's view stack:
+
+* ``take_segments`` / ``drop_segments`` / subrange recomputation
+  (``include/dr/details/segments_tools.hpp:38-94,149-223``),
+* ``zip_view`` with aligned segmentation (``include/dr/shp/zip_view.hpp``;
+  misaligned zip yields EMPTY segments — segments_tools.hpp:117-121 — which
+  is exactly the ``aligned()`` signal, mhp/alignment.hpp:8-28),
+* segment-preserving ``transform_view`` (``include/dr/views/transform.hpp``),
+* ``views::slice`` / ``take`` / ``drop`` / ``enumerate`` adaptors
+  (``shp/views/standard_views.hpp``, ``shp/views/enumerate.hpp``),
+* ``local_segments`` (``mhp/views.hpp:9-21``) and the debug ``ranked_view``
+  (``views/views.hpp:7-11``).
+
+Views are lazy metadata: they recompute ``segments()`` and know how to
+produce their logical value as a jax expression (``to_array``), so whole
+view pipelines (zip | transform | reduce) can be fused into one XLA program
+by the algorithm layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.segment import Segment, ZipSegment
+from ..core.vocabulary import local, rank, segments
+
+__all__ = [
+    "take", "drop", "subrange", "slice_view", "transform", "zip_view",
+    "zip", "enumerate_view", "enumerate", "iota_view", "counted",
+    "take_segments", "drop_segments", "aligned", "local_segments",
+    "ranked_view",
+]
+
+
+# ---------------------------------------------------------------------------
+# segment recomputation tools (segments_tools.hpp:38-94)
+# ---------------------------------------------------------------------------
+
+def take_segments(segs: Sequence, n: int):
+    """First ``n`` elements of a segment list, trimming the cut segment."""
+    out, remaining = [], n
+    for s in segs:
+        if remaining <= 0:
+            break
+        k = min(len(s), remaining)
+        out.append(s[:k] if k != len(s) else s)
+        remaining -= k
+    return out
+
+def drop_segments(segs: Sequence, n: int):
+    """Drop the first ``n`` elements of a segment list."""
+    out, todrop = [], n
+    for s in segs:
+        if todrop >= len(s):
+            todrop -= len(s)
+            continue
+        out.append(s[todrop:] if todrop else s)
+        todrop = 0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# view classes
+# ---------------------------------------------------------------------------
+
+class _ViewBase:
+    base: Any
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __dr_segments__(self):
+        raise NotImplementedError
+
+    def to_array(self):
+        raise NotImplementedError
+
+    def materialize(self):
+        arr = self.to_array()
+        if isinstance(arr, tuple):
+            return tuple(np.asarray(a) for a in arr)
+        return np.asarray(arr)
+
+    def __iter__(self):
+        m = self.materialize()
+        if isinstance(m, tuple):
+            return iter(builtin_zip(*m))
+        return iter(m)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, step = key.indices(len(self))
+            assert step == 1
+            return subrange(self, start, stop)
+        m = self.to_array()
+        if isinstance(m, tuple):
+            return tuple(a[key].item() for a in m)
+        return m[key].item()
+
+
+builtin_zip = zip
+builtin_enumerate = enumerate
+
+
+class subrange(_ViewBase):
+    """Window [start, stop) over a distributed range (take/drop/subrange)."""
+
+    def __init__(self, base: Any, start: int, stop: int):
+        n = len(base)
+        start = max(0, min(start, n))
+        stop = max(start, min(stop, n))
+        # collapse nested windows so ``base`` stays close to the container
+        if isinstance(base, subrange):
+            start += base.start
+            stop += base.start
+            base = base.base
+        self.base = base
+        self.start = start
+        self.stop = stop
+
+    def __len__(self):
+        return self.stop - self.start
+
+    def __dr_segments__(self):
+        segs = segments(self.base)
+        return take_segments(drop_segments(segs, self.start), len(self))
+
+    def to_array(self):
+        arr = self.base.to_array()
+        if isinstance(arr, tuple):
+            return tuple(a[self.start:self.stop] for a in arr)
+        return arr[self.start:self.stop]
+
+
+def take(r, n=None):
+    if n is None:
+        return _Pipe(lambda rr: subrange(rr, 0, r))
+    return subrange(r, 0, n)
+
+
+def drop(r, n=None):
+    if n is None:
+        return _Pipe(lambda rr: subrange(rr, r, len(rr)))
+    return subrange(r, n, len(r))
+
+
+def slice_view(r, bounds=None):
+    """``views::slice(r, (a, b))`` (shp/views/standard_views.hpp:19-44)."""
+    if bounds is None:
+        a, b = r
+        return _Pipe(lambda rr: subrange(rr, a, b))
+    a, b = bounds
+    return subrange(r, a, b)
+
+
+def counted(it_range, n):
+    """rng::views::counted analog over our ranges."""
+    return subrange(it_range, 0, n)
+
+
+class transform(_ViewBase):
+    """Lazy elementwise transform that stays distributed
+    (views/transform.hpp:9-43).  ``op`` must be jax-traceable; over a zip
+    base it receives one argument per component."""
+
+    def __init__(self, base: Any, op: Callable = None):
+        if op is None:
+            # the adaptor form transform(op) is handled in __new__; reaching
+            # here means a single non-callable argument
+            raise TypeError("transform(range, op) or transform(op) | range")
+        self.base = base
+        self.op = op
+
+    def __new__(cls, base=None, op=None):
+        if op is None and callable(base) and not hasattr(base, "__dr_segments__") \
+                and not hasattr(base, "to_array"):
+            return _Pipe(lambda rr: cls(rr, base))
+        return super().__new__(cls)
+
+    def __len__(self):
+        return len(self.base)
+
+    def __dr_segments__(self):
+        out = []
+        for s in segments(self.base):
+            if isinstance(s, Segment):
+                out.append(s.with_op(self.op))
+            elif isinstance(s, ZipSegment):
+                out.append(_MappedZipSegment(s, self.op))
+            else:
+                out.append(_MappedZipSegment(s, self.op))
+        return out
+
+    def to_array(self):
+        arr = self.base.to_array()
+        if isinstance(arr, tuple):
+            return self.op(*arr)
+        return self.op(arr)
+
+
+class _MappedZipSegment:
+    """ZipSegment with an elementwise op over the component tuple."""
+
+    __slots__ = ("inner", "op")
+
+    def __init__(self, inner, op):
+        self.inner = inner
+        self.op = op
+
+    def __dr_rank__(self):
+        return rank(self.inner)
+
+    def __dr_local__(self):
+        vals = local(self.inner)
+        return self.op(*vals) if isinstance(vals, tuple) else self.op(vals)
+
+    def __len__(self):
+        return len(self.inner)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return _MappedZipSegment(self.inner[key], self.op)
+        return self.materialize()[key]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def materialize(self):
+        vals = self.inner.materialize()
+        if isinstance(vals, tuple):
+            return np.asarray(self.op(*[jnp.asarray(v) for v in vals]))
+        return np.asarray(self.op(jnp.asarray(vals)))
+
+
+class zip_view(_ViewBase):
+    """Rank-aware zip (shp/zip_view.hpp).  Misaligned inputs yield empty
+    ``segments()`` — the ``aligned()`` signal — while ``to_array`` still
+    works (the slow path resharding is XLA's job, not serial RMA)."""
+
+    def __init__(self, *ranges):
+        assert ranges
+        self.components = tuple(ranges)
+        self.base = ranges[0]
+
+    def __len__(self):
+        return min(len(r) for r in self.components)
+
+    def __dr_segments__(self):
+        n = len(self)
+        seg_lists = []
+        for r in self.components:
+            try:
+                segs = segments(r)
+            except TypeError:
+                return []  # zipping with a non-distributed range
+            seg_lists.append(take_segments(segs, n))
+        first = seg_lists[0]
+        shape = [(rank(s), len(s)) for s in first]
+        for other in seg_lists[1:]:
+            if [(rank(s), len(s)) for s in other] != shape:
+                return []  # misaligned (segments_tools.hpp:117-121)
+        return [ZipSegment(*parts) for parts in builtin_zip(*seg_lists)]
+
+    def zipped_segments(self):
+        return self.__dr_segments__()
+
+    def to_array(self):
+        n = len(self)
+        arrs = []
+        for r in self.components:
+            a = r.to_array()
+            assert not isinstance(a, tuple), "nested zip: flatten first"
+            arrs.append(a[:n])
+        return tuple(arrs)
+
+
+zip = zip_view
+
+
+class iota_view(_ViewBase):
+    """Counting range whose segmentation mirrors ``like`` — the building
+    block of ``enumerate`` (details/enumerate.hpp:27-58)."""
+
+    def __init__(self, start: int, n: int, like: Any = None, dtype=jnp.int32):
+        self.start = start
+        self._n = n
+        self.like = like
+        self.dtype = dtype
+        self.base = None
+
+    def __len__(self):
+        return self._n
+
+    def __dr_segments__(self):
+        if self.like is None:
+            return [Segment(self, 0, 0, self._n)]
+        out = []
+        for s in take_segments(segments(self.like), self._n):
+            out.append(Segment(self, rank(s), s.begin, s.end))
+        return out
+
+    # acts as its own "container" for Segment plumbing
+    def _host_values(self, begin, end):
+        return np.arange(self.start + begin, self.start + end,
+                         dtype=np.dtype(self.dtype))
+
+    def _local_values(self, rank_, begin, end):
+        return jnp.arange(self.start + begin, self.start + end,
+                          dtype=self.dtype)
+
+    def to_array(self):
+        return jnp.arange(self.start, self.start + self._n, dtype=self.dtype)
+
+
+class enumerate_view(zip_view):
+    """zip(iota, r) (shp/views/enumerate.hpp:27-52)."""
+
+    def __init__(self, r):
+        super().__init__(iota_view(0, len(r), like=r), r)
+
+
+def enumerate(r=None):
+    if r is None:
+        return _Pipe(enumerate_view)
+    return enumerate_view(r)
+
+
+class ranked_view(zip_view):
+    """(owning-rank, value) pairs for debugging (views/views.hpp:7-11)."""
+
+    def __init__(self, r):
+        ranks = _rank_of_view(r)
+        super().__init__(ranks, r)
+
+
+class _rank_of_view(_ViewBase):
+    """Per-element owning rank of ``like``; positions derive from segment
+    ORDER (cumulative lengths), so any segment type works (zips included)."""
+
+    def __init__(self, like):
+        self.like = like
+        self.base = None
+        segs = segments(like)
+        if not segs:
+            raise ValueError("ranked_view: range has no segments "
+                             "(misaligned zip?)")
+        self._bounds = []
+        pos = 0
+        for s in segs:
+            self._bounds.append((pos, pos + len(s), rank(s)))
+            pos += len(s)
+
+    def __len__(self):
+        return len(self.like)
+
+    def __dr_segments__(self):
+        return [Segment(self, r, lo, hi) for lo, hi, r in self._bounds]
+
+    def _host_values(self, begin, end):
+        vals = np.empty(end - begin, dtype=np.int32)
+        for lo, hi, r in self._bounds:
+            a, b = max(lo, begin), min(hi, end)
+            if a < b:
+                vals[a - begin:b - begin] = r
+        return vals
+
+    def _local_values(self, rank_, begin, end):
+        return jnp.full((end - begin,), rank_, dtype=jnp.int32)
+
+    def to_array(self):
+        return jnp.asarray(self._host_values(0, len(self)))
+
+
+class _Pipe:
+    """Pipeable view adaptor: ``dv | views.take(3) | views.transform(f)``."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __ror__(self, r):
+        return self.fn(r)
+
+    def __call__(self, r):
+        return self.fn(r)
+
+
+# ---------------------------------------------------------------------------
+# alignment + local segments
+# ---------------------------------------------------------------------------
+
+def aligned(*ranges) -> bool:
+    """True iff all ranges have pairwise rank/size-equal segment lists
+    (mhp/alignment.hpp:13-28).  An empty segment list (misaligned zip)
+    is not aligned (mhp/alignment.hpp:8-10)."""
+    shapes = []
+    for r in ranges:
+        if hasattr(r, "__iter__") and not hasattr(r, "__dr_segments__") \
+                and not hasattr(r, "to_array"):
+            continue  # plain local iterables are skipped (alignment.hpp:20)
+        try:
+            segs = segments(r)
+        except TypeError:
+            return False
+        if not segs:
+            return False
+        shapes.append([(rank(s), len(s)) for s in segs])
+    return all(s == shapes[0] for s in shapes[1:]) if shapes else True
+
+
+def local_segments(r):
+    """Device-local values of each segment (mhp/views.hpp:9-21).  On the
+    single-controller TPU runtime every shard is addressable, so this yields
+    one jax array (or tuple for zips) per segment."""
+    return [local(s) for s in segments(r)]

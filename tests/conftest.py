@@ -1,0 +1,79 @@
+"""Test harness: fake an 8-device TPU mesh on CPU.
+
+The reference tests multi-device behavior without a cluster by duplicating
+real devices (``test/gtest/shp/shp-tests.cpp:34-39``) and by running the
+same gtest binary under mpiexec at 1-4 ranks (``test/gtest/mhp/
+CMakeLists.txt:27-33``).  The JAX analog is
+``--xla_force_host_platform_device_count``: one process, N virtual CPU
+devices, identical SPMD semantics.  Parametrized fixtures re-run suites at
+several mesh sizes, mirroring the reference's rank sweep.
+"""
+
+import os
+
+# XLA flags are read at (lazy) backend init, so setting them here is early
+# enough even if jax was already imported by site customization.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The environment may have imported jax already (e.g. a TPU plugin's
+# sitecustomize), freezing JAX_PLATFORMS from env — override via config.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import dr_tpu  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    """Every test starts with a full 8-device mesh runtime."""
+    dr_tpu.init()
+    yield
+    dr_tpu.final()
+
+
+@pytest.fixture(params=[1, 2, 3, 4, 8])
+def mesh_size(request):
+    """Rank sweep, mirroring the reference's mpiexec -n {1,2,3,4} runs."""
+    n = request.param
+    dr_tpu.init(jax.devices()[:n])
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Oracle helpers (reference test/gtest/include/common-tests.hpp)
+# ---------------------------------------------------------------------------
+
+def check_segments(r):
+    """join(segments(r)) == r elementwise (common-tests.hpp:31-50)."""
+    segs = dr_tpu.segments(r)
+    joined = np.concatenate([np.asarray(s.materialize()) for s in segs]) \
+        if segs else np.array([])
+    ref = np.asarray(dr_tpu.to_numpy(r))
+    np.testing.assert_allclose(joined, ref, rtol=1e-6)
+    # segments tile the range in order without gaps or overlap
+    assert sum(len(s) for s in segs) == len(r)
+    for a, b in zip(segs, segs[1:]):
+        if hasattr(a, "end") and hasattr(b, "begin"):
+            assert a.end == b.begin
+
+
+def equal(r, expected):
+    """Distributed result vs serial reference (common-tests.hpp:52-81)."""
+    np.testing.assert_allclose(np.asarray(dr_tpu.to_numpy(r)),
+                               np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+@pytest.fixture
+def oracle():
+    class _O:
+        check_segments = staticmethod(check_segments)
+        equal = staticmethod(equal)
+    return _O

@@ -1,0 +1,148 @@
+"""Distributed algorithm tests vs serial oracle
+(reference test/gtest/mhp/algorithms.cpp, test/gtest/shp/algorithms.cpp)."""
+
+import operator
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import views
+
+
+def test_fill(mesh_size, oracle):
+    dv = dr_tpu.distributed_vector(25)
+    dr_tpu.fill(dv, 3.5)
+    oracle.equal(dv, np.full(25, 3.5))
+
+
+def test_fill_subrange(oracle):
+    dv = dr_tpu.distributed_vector(20)
+    dr_tpu.fill(dv[4:9], 2.0)
+    ref = np.zeros(20)
+    ref[4:9] = 2.0
+    oracle.equal(dv, ref)
+
+
+def test_iota(mesh_size, oracle):
+    dv = dr_tpu.distributed_vector(23, dtype=np.int32)
+    dr_tpu.iota(dv, 10)
+    oracle.equal(dv, np.arange(10, 33))
+
+
+def test_iota_subrange(oracle):
+    dv = dr_tpu.distributed_vector(12, dtype=np.int32)
+    dr_tpu.iota(dv[3:7], 100)
+    ref = np.zeros(12, dtype=np.int32)
+    ref[3:7] = np.arange(100, 104)
+    oracle.equal(dv, ref)
+
+
+def test_copy_aligned(mesh_size, oracle):
+    a = dr_tpu.distributed_vector(31)
+    b = dr_tpu.distributed_vector(31)
+    dr_tpu.iota(a, 0)
+    dr_tpu.copy(a, b)
+    oracle.equal(b, np.arange(31, dtype=np.float32))
+
+
+def test_copy_host_to_distributed(oracle):
+    ref = np.random.default_rng(0).standard_normal(40).astype(np.float32)
+    dv = dr_tpu.distributed_vector(40)
+    dr_tpu.copy(ref, dv)
+    oracle.equal(dv, ref)
+
+
+def test_copy_misaligned_windows(oracle):
+    # shifted windows are misaligned -> XLA-reshard fallback
+    a = dr_tpu.distributed_vector(20)
+    b = dr_tpu.distributed_vector(20)
+    dr_tpu.iota(a, 0)
+    assert not dr_tpu.aligned(a[1:11], b[5:15])
+    dr_tpu.copy(a[1:11], b[5:15])
+    ref = np.zeros(20, dtype=np.float32)
+    ref[5:15] = np.arange(1, 11)
+    oracle.equal(b, ref)
+
+
+def test_transform(mesh_size, oracle):
+    a = dr_tpu.distributed_vector(27)
+    b = dr_tpu.distributed_vector(27)
+    dr_tpu.iota(a, 0)
+    dr_tpu.transform(a, b, lambda x: 2 * x + 1)
+    oracle.equal(b, 2 * np.arange(27, dtype=np.float32) + 1)
+
+
+def test_transform_zip(oracle):
+    n = 24
+    a = dr_tpu.distributed_vector.from_array(np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector.from_array(np.ones(n, dtype=np.float32))
+    c = dr_tpu.distributed_vector(n)
+    z = views.zip_view(a, b)
+    dr_tpu.transform(z, c, lambda x, y: x + y)
+    oracle.equal(c, np.arange(n) + 1.0)
+
+
+def test_for_each(mesh_size, oracle):
+    dv = dr_tpu.distributed_vector(18)
+    dr_tpu.iota(dv, 0)
+    dr_tpu.for_each(dv, lambda x: x * x)
+    oracle.equal(dv, np.arange(18, dtype=np.float32) ** 2)
+
+
+def test_for_each_zip_writeback(oracle):
+    n = 16
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector.from_array(
+        np.full(n, 10, dtype=np.float32))
+    z = views.zip_view(a, b)
+    dr_tpu.for_each(z, lambda x, y: (x + y, y - x))
+    oracle.equal(a, np.arange(n) + 10.0)
+    oracle.equal(b, 10.0 - np.arange(n))
+
+
+def test_reduce_sum(mesh_size):
+    dv = dr_tpu.distributed_vector(100)
+    dr_tpu.iota(dv, 1)
+    assert dr_tpu.reduce(dv) == pytest.approx(5050.0)
+
+
+def test_reduce_with_init_and_ops():
+    dv = dr_tpu.distributed_vector(10)
+    dr_tpu.iota(dv, 1)
+    assert dr_tpu.reduce(dv, init=100.0) == pytest.approx(155.0)
+    assert dr_tpu.reduce(dv, op=jnp.maximum) == pytest.approx(10.0)
+    assert dr_tpu.reduce(dv, op=jnp.minimum) == pytest.approx(1.0)
+
+
+def test_reduce_generic_op():
+    dv = dr_tpu.distributed_vector(8)
+    dr_tpu.fill(dv, 2.0)
+    got = dr_tpu.reduce(dv, op=lambda a, b: a * b)
+    assert got == pytest.approx(256.0)
+
+
+def test_reduce_subrange():
+    dv = dr_tpu.distributed_vector(50)
+    dr_tpu.iota(dv, 0)
+    assert dr_tpu.reduce(dv[10:20]) == pytest.approx(sum(range(10, 20)))
+
+
+def test_transform_reduce_dot(mesh_size):
+    n = 1000
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal(n).astype(np.float32)
+    y = rng.standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(x)
+    b = dr_tpu.distributed_vector.from_array(y)
+    got = dr_tpu.dot(a, b)
+    assert got == pytest.approx(float(np.dot(x, y)), rel=1e-4)
+
+
+def test_transform_reduce_explicit():
+    dv = dr_tpu.distributed_vector(9)
+    dr_tpu.iota(dv, 1)
+    got = dr_tpu.transform_reduce(dv, transform_op=lambda x: x * x)
+    assert got == pytest.approx(float((np.arange(1, 10) ** 2).sum()))

@@ -1,0 +1,89 @@
+"""distributed_vector tests (reference test/gtest/mhp/distributed_vector.cpp,
+test/gtest/shp/containers.cpp)."""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+
+
+def test_zero_initialized(mesh_size):
+    dv = dr_tpu.distributed_vector(17)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(dv), np.zeros(17))
+
+
+def test_segment_sizing_matches_reference_rule(mesh_size):
+    # segment_size = max(ceil(n/p), prev, next)  (mhp dv.hpp:190-193)
+    n = 23
+    hb = dr_tpu.halo_bounds(2, 3)
+    dv = dr_tpu.distributed_vector(n, halo=hb)
+    assert dv.segment_size == max(-(-n // mesh_size), 2, 3)
+    assert dv.block_width == dv.segment_size + 5
+
+
+def test_element_read_write(mesh_size):
+    dv = dr_tpu.distributed_vector(13)
+    dv[3] = 42.0
+    dv[12] = -1.0
+    assert dv[3] == 42.0
+    assert dv[12] == -1.0
+    assert dv[-1] == -1.0
+    with pytest.raises(IndexError):
+        dv[13]
+
+
+def test_batched_get_put(mesh_size):
+    dv = dr_tpu.distributed_vector(20, dtype=np.int32)
+    idx = np.array([0, 5, 7, 19, 11])
+    vals = np.array([1, 2, 3, 4, 5], dtype=np.int32)
+    dv.put(idx, vals)
+    got = np.asarray(dv.get(idx))
+    np.testing.assert_array_equal(got, vals)
+    # untouched elements remain zero
+    assert dv[1] == 0
+
+
+def test_from_array_roundtrip(mesh_size, oracle):
+    ref = np.arange(29, dtype=np.float32) * 1.5
+    dv = dr_tpu.distributed_vector.from_array(ref)
+    oracle.equal(dv, ref)
+    oracle.check_segments(dv)
+
+
+def test_from_array_with_halo(oracle):
+    ref = np.arange(50, dtype=np.float32)
+    dv = dr_tpu.distributed_vector.from_array(
+        ref, halo=dr_tpu.halo_bounds(1, 1))
+    oracle.equal(dv, ref)
+
+
+def test_slice_returns_view(oracle):
+    dv = dr_tpu.distributed_vector(30)
+    dr_tpu.iota(dv, 0)
+    v = dv[5:15]
+    assert len(v) == 10
+    oracle.equal(v, np.arange(5, 15, dtype=np.float32))
+
+
+def test_slice_assignment():
+    dv = dr_tpu.distributed_vector(10)
+    dv[2:5] = np.array([7.0, 8.0, 9.0])
+    np.testing.assert_array_equal(
+        dr_tpu.to_numpy(dv),
+        [0, 0, 7, 8, 9, 0, 0, 0, 0, 0])
+
+
+def test_small_vector_many_shards():
+    # n < nprocs: trailing shards hold no logical elements
+    dv = dr_tpu.distributed_vector(3)
+    segs = dr_tpu.segments(dv)
+    assert sum(len(s) for s in segs) == 3
+    dr_tpu.iota(dv, 1)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(dv), [1, 2, 3])
+
+
+def test_int_dtype(oracle):
+    dv = dr_tpu.distributed_vector(12, dtype=int)
+    dr_tpu.iota(dv, 0)
+    assert dr_tpu.to_numpy(dv).dtype == np.int32
+    oracle.check_segments(dv)

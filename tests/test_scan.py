@@ -1,0 +1,88 @@
+"""inclusive/exclusive scan tests (reference test/gtest/shp/algorithms.cpp
+:61-149, examples/shp/inclusive_scan_example.cpp)."""
+
+import operator
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dr_tpu
+
+
+def test_inclusive_scan_sum(mesh_size, oracle):
+    n = 57
+    src = np.random.default_rng(1).integers(0, 10, n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(n)
+    dr_tpu.inclusive_scan(a, out)
+    oracle.equal(out, np.cumsum(src))
+
+
+def test_inclusive_scan_mul():
+    src = np.full(16, 1.1, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(16)
+    dr_tpu.inclusive_scan(a, out, op=jnp.multiply)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), np.cumprod(src),
+                               rtol=1e-5)
+
+
+def test_inclusive_scan_max():
+    src = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5], dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(len(src))
+    dr_tpu.inclusive_scan(a, out, op=jnp.maximum)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(out),
+                                  np.maximum.accumulate(src))
+
+
+def test_inclusive_scan_init():
+    src = np.arange(1, 9, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(8)
+    dr_tpu.inclusive_scan(a, out, op=operator.add, init=100.0)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), np.cumsum(src) + 100)
+
+
+def test_inclusive_scan_in_place():
+    src = np.arange(20, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.inclusive_scan(a, a)
+    np.testing.assert_allclose(dr_tpu.to_numpy(a), np.cumsum(src))
+
+
+def test_exclusive_scan():
+    src = np.arange(1, 13, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(12)
+    dr_tpu.exclusive_scan(a, out, init=0.0)
+    ref = np.concatenate([[0], np.cumsum(src)[:-1]])
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref)
+
+
+def test_scan_into_subrange_preserves_rest():
+    """Regression: the fast path must not clobber output cells outside the
+    requested window."""
+    src = np.arange(1, 5, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(10)
+    dr_tpu.fill(out, 7.0)
+    dr_tpu.inclusive_scan(a, out[0:4])
+    got = dr_tpu.to_numpy(out)
+    np.testing.assert_allclose(got[:4], np.cumsum(src))
+    np.testing.assert_allclose(got[4:], np.full(6, 7.0))
+
+
+def test_scan_generic_op():
+    src = np.arange(1, 9, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(8)
+    dr_tpu.inclusive_scan(a, out, op=lambda x, y: x + y + 1)
+    ref = np.empty(8, dtype=np.float32)
+    acc = src[0]
+    ref[0] = acc
+    for i in range(1, 8):
+        acc = acc + src[i] + 1
+        ref[i] = acc
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref)

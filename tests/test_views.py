@@ -1,0 +1,150 @@
+"""View tests: segment recomputation, zip alignment, transform laziness
+(reference test/gtest/mhp/views.cpp, test/gtest/shp/views.cpp,
+test/gtest/mhp/alignment.cpp)."""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import views
+
+
+@pytest.fixture
+def dv():
+    v = dr_tpu.distributed_vector(24, dtype=np.int32)
+    dr_tpu.iota(v, 0)
+    return v
+
+
+def test_take(dv, oracle):
+    t = views.take(dv, 10)
+    assert len(t) == 10
+    oracle.equal(t, np.arange(10))
+    oracle.check_segments(t)
+
+
+def test_drop(dv, oracle):
+    d = views.drop(dv, 15)
+    assert len(d) == 9
+    oracle.equal(d, np.arange(15, 24))
+    oracle.check_segments(d)
+
+
+def test_subrange_collapses(dv, oracle):
+    s = views.subrange(views.subrange(dv, 4, 20), 2, 10)
+    assert s.base is dv
+    assert (s.start, s.stop) == (6, 14)
+    oracle.equal(s, np.arange(6, 14))
+    oracle.check_segments(s)
+
+
+def test_pipe_syntax(dv, oracle):
+    r = dv | views.take(20) | views.drop(5)
+    oracle.equal(r, np.arange(5, 20))
+    r2 = dv | views.slice_view((3, 9))
+    oracle.equal(r2, np.arange(3, 9))
+
+
+def test_take_segments_trim(dv):
+    segs = dr_tpu.segments(views.take(dv, 7))
+    assert sum(len(s) for s in segs) == 7
+    # ranks preserved from the base
+    base_segs = dr_tpu.segments(dv)
+    assert dr_tpu.rank(segs[0]) == dr_tpu.rank(base_segs[0])
+
+
+def test_transform_lazy(dv, oracle):
+    t = views.transform(dv, lambda x: x * 3)
+    assert len(t) == len(dv)
+    oracle.equal(t, np.arange(24) * 3)
+    oracle.check_segments(t)
+    # segments keep rank
+    for s, b in zip(dr_tpu.segments(t), dr_tpu.segments(dv)):
+        assert dr_tpu.rank(s) == dr_tpu.rank(b)
+
+
+def test_transform_pipe(dv, oracle):
+    t = dv | views.transform(lambda x: x + 100)
+    oracle.equal(t, np.arange(24) + 100)
+
+
+def test_zip_aligned(dv, oracle):
+    other = dr_tpu.distributed_vector(24, dtype=np.int32)
+    dr_tpu.iota(other, 100)
+    z = views.zip_view(dv, other)
+    assert dr_tpu.aligned(dv, other)
+    segs = dr_tpu.segments(z)
+    assert segs, "aligned zip must produce segments"
+    assert sum(len(s) for s in segs) == 24
+    a, b = z.to_array()
+    np.testing.assert_array_equal(np.asarray(a), np.arange(24))
+    np.testing.assert_array_equal(np.asarray(b), np.arange(100, 124))
+
+
+def test_zip_misaligned_empty_segments(dv):
+    # different segment sizes -> misaligned -> empty segment list
+    # (segments_tools.hpp:117-121)
+    other = dr_tpu.distributed_vector(100, dtype=np.int32)
+    z = views.zip_view(dv, other)
+    assert dr_tpu.segments(z) == []
+    assert not dr_tpu.aligned(dv, other)
+
+
+def test_zip_common_prefix_aligns(dv):
+    # same segment size, shorter vector: zip trims both lists to the common
+    # prefix and stays aligned (an improvement over the reference, which
+    # only compares full segment lists)
+    other = dr_tpu.distributed_vector(17, dtype=np.int32)
+    dr_tpu.iota(other, 0)
+    z = views.zip_view(dv, other)
+    segs = dr_tpu.segments(z)
+    assert segs and sum(len(s) for s in segs) == 17
+
+
+def test_zip_shifted_misaligned(dv):
+    assert not dr_tpu.aligned(dv[1:], dv[:-1])
+
+
+def test_enumerate(dv):
+    e = views.enumerate_view(dv)
+    segs = dr_tpu.segments(e)
+    assert segs
+    pairs = list(e)
+    assert pairs[:3] == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_zip_segment_iteration(dv):
+    other = dr_tpu.distributed_vector(24, dtype=np.int32)
+    dr_tpu.iota(other, 50)
+    z = views.zip_view(dv, other)
+    seg0 = dr_tpu.segments(z)[0]
+    vals = list(seg0)
+    assert vals[0] == (0, 50)
+
+
+def test_ranked_view(dv):
+    rv = views.ranked_view(dv)
+    pairs = list(rv)
+    # rank of the first element is 0
+    assert pairs[0][0] == 0
+    # ranks match the segment owner for every element
+    for s in dr_tpu.segments(dv):
+        for i in range(s.begin, s.end):
+            assert pairs[i][0] == dr_tpu.rank(s)
+
+
+def test_local_segments(dv):
+    locs = dr_tpu.local_segments(dv)
+    flat = np.concatenate([np.asarray(l) for l in locs])
+    np.testing.assert_array_equal(flat, np.arange(24))
+
+
+def test_transform_over_subrange(dv, oracle):
+    t = views.transform(views.subrange(dv, 5, 15), lambda x: -x)
+    oracle.equal(t, -np.arange(5, 15))
+    oracle.check_segments(t)
+
+
+def test_iota_view_standalone(oracle):
+    iv = views.iota_view(5, 10)
+    oracle.equal(iv, np.arange(5, 15))

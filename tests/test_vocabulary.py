@@ -1,0 +1,67 @@
+"""L0 vocabulary tests: CPOs, concepts, segment invariants.
+
+Mirrors the static_assert-style concept checks in the reference tests
+(``test/gtest/mhp/distributed_vector.cpp:12-24``, ``views.cpp:20-29``).
+"""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import views
+
+
+def test_distributed_vector_is_distributed_range():
+    dv = dr_tpu.distributed_vector(10)
+    assert dr_tpu.is_distributed_range(dv)
+    assert dr_tpu.is_distributed_contiguous_range(dv)
+
+
+def test_segments_cover_and_ranks(mesh_size):
+    n = 23
+    dv = dr_tpu.distributed_vector(n)
+    segs = dr_tpu.segments(dv)
+    assert sum(len(s) for s in segs) == n
+    ranks = [dr_tpu.rank(s) for s in segs]
+    assert ranks == sorted(ranks)
+    assert all(0 <= r < mesh_size for r in ranks)
+    # each segment is a remote contiguous range
+    for s in segs:
+        assert dr_tpu.is_remote_range(s)
+        assert dr_tpu.is_remote_contiguous_range(s)
+
+
+def test_local_returns_shard_values():
+    dv = dr_tpu.distributed_vector(16)
+    dr_tpu.iota(dv, 0)
+    for s in dr_tpu.segments(dv):
+        loc = dr_tpu.local(s)
+        np.testing.assert_array_equal(
+            np.asarray(loc), np.arange(s.begin, s.end, dtype=np.float32))
+
+
+def test_local_identity_fallback_for_host_objects():
+    x = [1, 2, 3]
+    assert dr_tpu.local(x) is x
+
+
+def test_rank_raises_for_plain_objects():
+    with pytest.raises(TypeError):
+        dr_tpu.rank([1, 2, 3])
+
+
+def test_segment_slicing_keeps_rank():
+    dv = dr_tpu.distributed_vector(32)
+    dr_tpu.iota(dv, 0)
+    s = dr_tpu.segments(dv)[0]
+    sub = s[1:3]
+    assert dr_tpu.rank(sub) == dr_tpu.rank(s)
+    assert len(sub) == 2
+    np.testing.assert_array_equal(sub.materialize(),
+                                  s.materialize()[1:3])
+
+
+def test_check_segments_invariant(oracle):
+    dv = dr_tpu.distributed_vector(41)
+    dr_tpu.iota(dv, 7)
+    oracle.check_segments(dv)
