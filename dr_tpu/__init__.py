@@ -37,6 +37,7 @@ from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
                                      transform, to_numpy)
 from .algorithms.reduce import reduce, transform_reduce, dot
 from .algorithms.scan import inclusive_scan, exclusive_scan
+from .algorithms.stencil import stencil_transform, stencil_iterate
 
 __version__ = "0.1.0"
 
@@ -53,4 +54,5 @@ __all__ = [
     "fill", "iota", "copy", "copy_async", "for_each", "transform",
     "to_numpy", "reduce", "transform_reduce", "dot",
     "inclusive_scan", "exclusive_scan",
+    "stencil_transform", "stencil_iterate",
 ]

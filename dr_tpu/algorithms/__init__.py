@@ -2,3 +2,4 @@ from .elementwise import (fill, iota, copy, copy_async, for_each, transform,
                           to_numpy)
 from .reduce import reduce, transform_reduce, dot
 from .scan import inclusive_scan, exclusive_scan
+from .stencil import stencil_transform, stencil_iterate
