@@ -31,6 +31,10 @@ from .core.vocabulary import (rank, segments, local, is_remote_range,
                               is_distributed_contiguous_range)
 from .core.segment import Segment, ZipSegment
 from .containers.distributed_vector import distributed_vector, halo
+from .containers.partition import (tile, matrix_partition, block_cyclic,
+                                   row_tiles, factor)
+from .containers.dense_matrix import dense_matrix, matrix_entry, Index2D
+from .containers.sparse_matrix import sparse_matrix, random_sparse_matrix
 from .views import views
 from .views.views import aligned, local_segments
 from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
@@ -38,6 +42,9 @@ from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
 from .algorithms.reduce import reduce, transform_reduce, dot
 from .algorithms.scan import inclusive_scan, exclusive_scan
 from .algorithms.stencil import stencil_transform, stencil_iterate
+from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
+                                   heat_step_weights)
+from .algorithms.gemv import gemv, flat_gemv, gemm
 
 __version__ = "0.1.0"
 
@@ -55,4 +62,9 @@ __all__ = [
     "to_numpy", "reduce", "transform_reduce", "dot",
     "inclusive_scan", "exclusive_scan",
     "stencil_transform", "stencil_iterate",
+    "stencil2d_transform", "stencil2d_iterate", "heat_step_weights",
+    "gemv", "flat_gemv", "gemm",
+    "tile", "matrix_partition", "block_cyclic", "row_tiles", "factor",
+    "dense_matrix", "matrix_entry", "Index2D",
+    "sparse_matrix", "random_sparse_matrix",
 ]

@@ -3,3 +3,6 @@ from .elementwise import (fill, iota, copy, copy_async, for_each, transform,
 from .reduce import reduce, transform_reduce, dot
 from .scan import inclusive_scan, exclusive_scan
 from .stencil import stencil_transform, stencil_iterate
+from .stencil2d import stencil2d_transform, stencil2d_iterate, \
+    heat_step_weights
+from .gemv import gemv, flat_gemv, gemm

@@ -1,0 +1,99 @@
+"""2-D stencil (heat equation) over a tiled dense_matrix.
+
+The BASELINE.json config-4 workload: "2D mdspan heat-equation stencil,
+tiled segments on a 2D TPU mesh".  The reference only documents the
+mdspan surface (SURVEY.md §2.6; the not-built example
+``examples/mhp/transpose-cpu.cpp``); on TPU the idiomatic form is shifted
+slices of ONE 2-D sharded array under jit — GSPMD materializes the
+inter-tile halo exchanges along both mesh axes automatically, so the
+"ghost cell" machinery is the compiler's job, not the container's.
+
+``stencil2d_iterate`` runs all steps device-side via lax.fori_loop with
+double buffering, like its 1-D sibling.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .elementwise import _prog_cache
+from ..containers.dense_matrix import dense_matrix
+
+__all__ = ["stencil2d_transform", "stencil2d_iterate", "heat_step_weights"]
+
+
+def heat_step_weights(alpha: float = 0.25):
+    """Classic 5-point heat kernel: u += alpha * laplacian(u)."""
+    return [[0.0, alpha, 0.0],
+            [alpha, 1.0 - 4.0 * alpha, alpha],
+            [0.0, alpha, 0.0]]
+
+
+def _build_step(m, n, mm, nn, weights, dtype):
+    w = np.asarray(weights, dtype=np.float64)
+    kh, kw = w.shape
+    assert kh % 2 == 1 and kw % 2 == 1
+    rh, rw = kh // 2, kw // 2
+
+    def step(cur, out):
+        u = cur[:m, :n]
+        acc = jnp.zeros((m - 2 * rh, n - 2 * rw), dtype)
+        for di in range(kh):
+            for dj in range(kw):
+                wij = float(w[di, dj])
+                if wij == 0.0:
+                    continue
+                acc = acc + wij * u[di:m - 2 * rh + di, dj:n - 2 * rw + dj]
+        return out.at[rh:m - rh, rw:n - rw].set(acc)
+
+    return step
+
+
+def stencil2d_transform(in_mat: dense_matrix, out_mat: dense_matrix,
+                        weights: Sequence[Sequence[float]]) -> None:
+    """One interior stencil step: out[i,j] = sum w[di,dj]*in[i+di,j+dj].
+
+    Edges (positions without a full neighborhood) keep out_mat's values,
+    matching the 1-D interior contract."""
+    assert in_mat.shape == out_mat.shape
+    m, n = in_mat.shape
+    mm, nn = in_mat._data.shape
+    key = ("st2", id(in_mat.runtime.mesh), in_mat.layout,
+           tuple(map(tuple, np.asarray(weights))), str(in_mat.dtype))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        step = _build_step(m, n, mm, nn, weights, in_mat.dtype)
+        prog = jax.jit(step, donate_argnums=1)
+        _prog_cache[key] = prog
+    out_mat._data = prog(in_mat._data, out_mat._data)
+
+
+def stencil2d_iterate(a: dense_matrix, b: dense_matrix,
+                      weights, steps: int) -> dense_matrix:
+    """``steps`` fused 2-D stencil steps, double-buffered in one program."""
+    assert a.shape == b.shape and a.layout == b.layout
+    m, n = a.shape
+    mm, nn = a._data.shape
+    key = ("st2it", id(a.runtime.mesh), a.layout,
+           tuple(map(tuple, np.asarray(weights))), steps, str(a.dtype))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        step = _build_step(m, n, mm, nn, weights, a.dtype)
+
+        def loop(x, y):
+            def one(i, xy):
+                u, v = xy
+                v = step(u, v)
+                return (v, u)
+            return lax.fori_loop(0, steps, one, (x, y))
+
+        prog = jax.jit(loop, donate_argnums=(0, 1))
+        _prog_cache[key] = prog
+    fin, other = prog(a._data, b._data)
+    a._data, b._data = fin, other
+    return a

@@ -1,0 +1,302 @@
+"""``dense_matrix``: 2-D tiled dense matrix on a 2-D TPU mesh.
+
+TPU re-design of ``shp::dense_matrix`` (``shp/containers/dense_matrix.hpp``)
+and — because N-D arrays are natural on TPU — of the documented-but-
+unimplemented ``distributed_mdarray``/``distributed_mdspan`` surface
+(``doc/spec/source/containers/distributed_mdarray.rst``, SURVEY.md §2.6).
+
+Design: ONE ``jax.Array`` of padded shape ``(gp*th, gq*tw)`` sharded over a
+2-D mesh view ("mr", "mc") of the runtime devices; tile (i, j) is the shard
+on device ``partition.tile_rank(i, j)``.  The logical shape (m, n) is
+metadata; every algorithm masks the pad (same pad-and-mask rule as the
+1-D vector).  Where the reference walks tiles through per-GPU queues, here
+whole-matrix expressions run under jit and GSPMD inserts any cross-tile
+traffic (e.g. the shifted-slice halos of the 2-D heat stencil).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .partition import block_cyclic, matrix_partition
+from ..parallel import runtime as _rt
+
+__all__ = ["dense_matrix", "matrix_entry", "Index2D"]
+
+
+class Index2D(tuple):
+    """2-D index with tuple protocol (shp/containers/index.hpp:38-112)."""
+
+    def __new__(cls, i, j=None):
+        if j is None:
+            i, j = i
+        return super().__new__(cls, (int(i), int(j)))
+
+    @property
+    def i(self):
+        return self[0]
+
+    @property
+    def j(self):
+        return self[1]
+
+
+class matrix_entry:
+    """(index, value) pair (shp/containers/matrix_entry.hpp:14-229)."""
+
+    __slots__ = ("index", "value")
+
+    def __init__(self, index, value):
+        self.index = Index2D(index)
+        self.value = value
+
+    def __iter__(self):  # structured bindings: (index, value)
+        return iter((self.index, self.value))
+
+    def __repr__(self):
+        return f"matrix_entry({self.index}, {self.value})"
+
+
+class MatrixTileSegment:
+    """One tile: rows [rb, re) x cols [cb, ce) owned by ``rank`` — the
+    dense_matrix_view-as-segment of the reference
+    (dense_matrix.hpp:198-242)."""
+
+    __slots__ = ("base", "_rank", "rb", "re", "cb", "ce")
+
+    def __init__(self, base, rank, rb, re, cb, ce):
+        self.base = base
+        self._rank = rank
+        self.rb, self.re, self.cb, self.ce = rb, re, cb, ce
+
+    def __dr_rank__(self):
+        return self._rank
+
+    def __dr_local__(self):
+        return self.base._local_tile(self._rank, self.rb, self.re,
+                                     self.cb, self.ce)
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.re - self.rb, self.ce - self.cb)
+
+    @property
+    def origin(self) -> Index2D:
+        return Index2D(self.rb, self.cb)
+
+    def __len__(self):
+        return (self.re - self.rb) * (self.ce - self.cb)
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(
+            self.base.to_array()[self.rb:self.re, self.cb:self.ce])
+
+    def __iter__(self):
+        vals = self.materialize()
+        for i in range(vals.shape[0]):
+            for j in range(vals.shape[1]):
+                yield matrix_entry((self.rb + i, self.cb + j), vals[i, j])
+
+    def __repr__(self):
+        return (f"MatrixTileSegment(rank={self._rank}, "
+                f"rows=[{self.rb},{self.re}), cols=[{self.cb},{self.ce}))")
+
+
+class dense_matrix:
+    """Block-tiled dense matrix (one shard per grid cell)."""
+
+    def __init__(self, shape: Tuple[int, int], dtype=None,
+                 partition: Optional[matrix_partition] = None, *,
+                 runtime=None, _data=None):
+        self._rt = runtime or _rt.runtime()
+        m, n = shape
+        self._m, self._n = int(m), int(n)
+        self._dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
+        part = partition or block_cyclic()
+        if isinstance(part, block_cyclic) and part.grid is None:
+            part = block_cyclic(part.tile, part.grid_for(self._rt.nprocs))
+        assert isinstance(part, block_cyclic) and part.is_block(), (
+            "v1 supports block placement (tile.div); cyclic tile shapes "
+            "land with the multi-tile storage mode")
+        self._part = part
+        gp, gq = part.grid_shape()
+        th, tw = part.tile_shape((self._m, self._n))
+        self._grid = (gp, gq)
+        self._tshape = (th, tw)
+        self._mesh = self._rt.mesh2d((gp, gq))
+        self._sharding = NamedSharding(self._mesh, PartitionSpec("mr", "mc"))
+        if _data is not None:
+            self._data = _data
+        else:
+            self._data = _zeros2d(self._mesh, gp * th, gq * tw, self._dtype,
+                                  self._sharding)
+        self._rt.register(self)
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self._m, self._n)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def grid_shape(self) -> Tuple[int, int]:
+        return self._grid
+
+    @property
+    def tile_shape(self) -> Tuple[int, int]:
+        return self._tshape
+
+    @property
+    def partition(self) -> matrix_partition:
+        return self._part
+
+    @property
+    def runtime(self):
+        return self._rt
+
+    def __len__(self):
+        return self._m * self._n
+
+    @property
+    def layout(self):
+        return ("dense2d", self._grid, self._tshape, self._m, self._n)
+
+    # ----------------------------------------------------------- vocabulary
+    def __dr_segments__(self):
+        segs = []
+        gp, gq = self._grid
+        th, tw = self._tshape
+        for i in range(gp):
+            rb, re = i * th, min((i + 1) * th, self._m)
+            if rb >= re:
+                continue
+            for j in range(gq):
+                cb, ce = j * tw, min((j + 1) * tw, self._n)
+                if cb >= ce:
+                    continue
+                segs.append(MatrixTileSegment(
+                    self, self._part.tile_rank(i, j), rb, re, cb, ce))
+        return segs
+
+    def tiles(self):
+        return self.__dr_segments__()
+
+    def tile(self, ij) -> MatrixTileSegment:
+        i, j = ij
+        gp, gq = self._grid
+        th, tw = self._tshape
+        assert 0 <= i < gp and 0 <= j < gq
+        return MatrixTileSegment(
+            self, self._part.tile_rank(i, j),
+            i * th, min((i + 1) * th, self._m),
+            j * tw, min((j + 1) * tw, self._n))
+
+    # ----------------------------------------------------------- value APIs
+    def to_array(self) -> jax.Array:
+        return self._data[:self._m, :self._n]
+
+    def assign_array(self, values) -> None:
+        values = jnp.asarray(values, self._dtype)
+        assert values.shape == (self._m, self._n)
+        gp, gq = self._grid
+        th, tw = self._tshape
+        self._data = _pack2d(self._mesh, gp * th, gq * tw, self._m, self._n,
+                             self._dtype, self._sharding)(values)
+
+    @classmethod
+    def from_array(cls, values, partition=None, *, runtime=None):
+        values = jnp.asarray(values)
+        mat = cls(values.shape, values.dtype, partition, runtime=runtime)
+        mat.assign_array(values)
+        return mat
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self.to_array())
+
+    def _local_tile(self, rank, rb, re, cb, ce):
+        # block mode: each device owns exactly one shard
+        target = self._mesh.devices.reshape(-1)[rank]
+        for sh in self._data.addressable_shards:
+            if sh.device.id == target.id:
+                ri, ci = sh.index
+                r0 = 0 if ri.start is None else ri.start
+                c0 = 0 if ci.start is None else ci.start
+                return sh.data[rb - r0:re - r0, cb - c0:ce - c0]
+        return self.to_array()[rb:re, cb:ce]  # multi-host fallback
+
+    # ------------------------------------------------ element/batched access
+    def __getitem__(self, ij):
+        i, j = ij
+        if isinstance(i, slice) or isinstance(j, slice):
+            from ..views.matrix_views import dense_matrix_view
+            ri = range(*i.indices(self._m)) if isinstance(i, slice) \
+                else range(i, i + 1)
+            rj = range(*j.indices(self._n)) if isinstance(j, slice) \
+                else range(j, j + 1)
+            return dense_matrix_view(self, ri.start, ri.stop,
+                                     rj.start, rj.stop)
+        i, j = int(i), int(j)
+        if i < 0:
+            i += self._m
+        if j < 0:
+            j += self._n
+        if not (0 <= i < self._m and 0 <= j < self._n):
+            raise IndexError((i, j))
+        return self._data[i, j].item()
+
+    def __setitem__(self, ij, value) -> None:
+        i, j = int(ij[0]), int(ij[1])
+        if not (0 <= i < self._m and 0 <= j < self._n):
+            raise IndexError((i, j))
+        self._data = self._data.at[i, j].set(
+            jnp.asarray(value, self._dtype))
+
+    def get(self, rows, cols):
+        """Batched element gather."""
+        return self._data[jnp.asarray(rows), jnp.asarray(cols)]
+
+    def put(self, rows, cols, values) -> None:
+        self._data = self._data.at[
+            jnp.asarray(rows), jnp.asarray(cols)].set(
+            jnp.asarray(values, self._dtype))
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def __repr__(self):
+        return (f"dense_matrix(shape={self.shape}, grid={self._grid}, "
+                f"tile={self._tshape}, dtype={self._dtype})")
+
+
+_cache: dict = {}
+
+
+def _zeros2d(mesh, mm, nn, dtype, sharding):
+    key = ("z2", id(mesh), mm, nn, str(dtype))
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda: jnp.zeros((mm, nn), dtype),
+                     out_shardings=sharding)
+        _cache[key] = fn
+    return fn()
+
+
+def _pack2d(mesh, mm, nn, m, n, dtype, sharding):
+    key = ("p2", id(mesh), mm, nn, m, n, str(dtype))
+    fn = _cache.get(key)
+    if fn is None:
+        def pack(values):
+            out = jnp.zeros((mm, nn), dtype)
+            return out.at[:m, :n].set(values)
+        fn = jax.jit(pack, out_shardings=sharding)
+        _cache[key] = fn
+    return fn

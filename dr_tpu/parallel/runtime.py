@@ -71,6 +71,24 @@ class Runtime:
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 
+    def mesh2d(self, grid, names=("mr", "mc")) -> Mesh:
+        """2-D mesh view over (a prefix of) the same devices — the
+        substrate for tiled matrices (tp-style 2-D sharding).  Cached per
+        grid shape."""
+        gp, gq = grid
+        if gp * gq > len(self.devices):
+            raise ValueError(
+                f"grid {grid} needs {gp*gq} devices, mesh has "
+                f"{len(self.devices)}")
+        cache = self.__dict__.setdefault("_mesh2d_cache", {})
+        key = (gp, gq, names)
+        m = cache.get(key)
+        if m is None:
+            devs = np.asarray(self.devices[:gp * gq]).reshape(gp, gq)
+            m = Mesh(devs, names)
+            cache[key] = m
+        return m
+
     @property
     def block_sharding(self) -> NamedSharding:
         """Sharding for the canonical (nprocs, segment) container layout."""

@@ -1,0 +1,100 @@
+"""dense_matrix + partition tests (reference test/gtest/shp/containers.cpp
+matrix sections, shp/containers/matrix_partition.hpp)."""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+
+
+def test_factor():
+    assert dr_tpu.factor(8) == (2, 4)
+    assert dr_tpu.factor(4) == (2, 2)
+    assert dr_tpu.factor(7) == (1, 7)
+    assert dr_tpu.factor(1) == (1, 1)
+
+
+def test_block_cyclic_tile_rank():
+    part = dr_tpu.block_cyclic(grid=(2, 4))
+    assert part.tile_rank(0, 0) == 0
+    assert part.tile_rank(0, 3) == 3
+    assert part.tile_rank(1, 0) == 4
+    assert part.tile_rank(1, 3) == 7
+
+
+def test_dense_matrix_roundtrip(oracle):
+    src = np.arange(7 * 9, dtype=np.float32).reshape(7, 9)
+    mat = dr_tpu.dense_matrix.from_array(src)
+    np.testing.assert_array_equal(mat.materialize(), src)
+
+
+def test_dense_matrix_segments_cover():
+    m, n = 10, 12
+    mat = dr_tpu.dense_matrix((m, n))
+    segs = dr_tpu.segments(mat)
+    total = sum((s.re - s.rb) * (s.ce - s.cb) for s in segs)
+    assert total == m * n
+    ranks = {dr_tpu.rank(s) for s in segs}
+    assert ranks <= set(range(dr_tpu.nprocs()))
+
+
+def test_dense_matrix_tile_materialize():
+    src = np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32)
+    mat = dr_tpu.dense_matrix.from_array(src)
+    for t in mat.tiles():
+        np.testing.assert_array_equal(t.materialize(),
+                                      src[t.rb:t.re, t.cb:t.ce])
+
+
+def test_dense_matrix_local_tile():
+    src = np.arange(64, dtype=np.float32).reshape(8, 8)
+    mat = dr_tpu.dense_matrix.from_array(src)
+    for t in mat.tiles():
+        loc = dr_tpu.local(t)
+        np.testing.assert_array_equal(np.asarray(loc),
+                                      src[t.rb:t.re, t.cb:t.ce])
+
+
+def test_dense_matrix_element_access():
+    mat = dr_tpu.dense_matrix((5, 5))
+    mat[2, 3] = 7.0
+    assert mat[2, 3] == 7.0
+    with pytest.raises(IndexError):
+        mat[5, 0]
+
+
+def test_dense_matrix_row_tiles_partition():
+    part = dr_tpu.row_tiles()
+    mat = dr_tpu.dense_matrix((16, 4), partition=part)
+    assert mat.grid_shape == (dr_tpu.nprocs(), 1)
+
+
+def test_dense_matrix_view_and_rows():
+    src = np.arange(36, dtype=np.float32).reshape(6, 6)
+    mat = dr_tpu.dense_matrix.from_array(src)
+    v = mat[1:4, 2:5]
+    np.testing.assert_array_equal(v.materialize(), src[1:4, 2:5])
+    segs = dr_tpu.segments(v)
+    assert sum((s.re - s.rb) * (s.ce - s.cb) for s in segs) == 9
+    np.testing.assert_array_equal(v.row(0).materialize(), src[1, 2:5])
+    np.testing.assert_array_equal(v.column(1).materialize(), src[1:4, 3])
+
+
+def test_matrix_entry_iteration():
+    src = np.arange(4, dtype=np.float32).reshape(2, 2)
+    mat = dr_tpu.dense_matrix.from_array(
+        src, partition=dr_tpu.block_cyclic(grid=(1, 1)))
+    entries = list(mat.tiles()[0])
+    assert [(e.index.i, e.index.j, float(e.value)) for e in entries] == \
+        [(0, 0, 0.0), (0, 1, 1.0), (1, 0, 2.0), (1, 1, 3.0)]
+
+
+def test_gemm():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((16, 12)).astype(np.float32)
+    b = rng.standard_normal((12, 8)).astype(np.float32)
+    A = dr_tpu.dense_matrix.from_array(a)
+    B = dr_tpu.dense_matrix.from_array(b)
+    C = dr_tpu.gemm(A, B)
+    np.testing.assert_allclose(C.materialize(), a @ b, rtol=1e-4,
+                               atol=1e-5)

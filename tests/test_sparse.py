@@ -1,0 +1,114 @@
+"""sparse_matrix + gemv tests (reference test/gtest/shp containers/gemv,
+examples/shp/gemv_example.cpp:18-41)."""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+
+
+def _random_dense(m, n, density, seed=0):
+    rng = np.random.default_rng(seed)
+    d = rng.standard_normal((m, n)).astype(np.float32)
+    mask = rng.random((m, n)) < density
+    return np.where(mask, d, 0.0).astype(np.float32)
+
+
+def test_from_dense_roundtrip():
+    d = _random_dense(20, 16, 0.2)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    assert sp.nnz == int(np.count_nonzero(d))
+    np.testing.assert_allclose(sp.to_dense(), d)
+
+
+def test_from_csr():
+    d = _random_dense(10, 10, 0.3, seed=1)
+    rowptr = np.zeros(11, dtype=np.int64)
+    rows, cols = np.nonzero(d)
+    np.add.at(rowptr[1:], rows, 1)
+    rowptr = np.cumsum(rowptr)
+    sp = dr_tpu.sparse_matrix.from_csr((10, 10), rowptr, cols, d[rows, cols])
+    np.testing.assert_allclose(sp.to_dense(), d)
+
+
+def test_segments_ranks_and_rows():
+    d = _random_dense(24, 8, 0.4, seed=2)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    segs = dr_tpu.segments(sp)
+    assert segs
+    covered = np.zeros((24, 8), dtype=np.float32)
+    for s in segs:
+        r, c, v = s.triples()
+        assert (r >= s.rb).all() and (r < s.re).all()
+        np.add.at(covered, (r, c), v)
+    np.testing.assert_allclose(covered, d)
+
+
+def test_tile_csr_view():
+    d = _random_dense(16, 6, 0.5, seed=3)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    t = sp.tile((0, 0))
+    rowptr, cols, vals = t.csr()
+    assert rowptr[-1] == t.nnz
+    # rebuild the tile densely from CSR
+    m = t.re - t.rb
+    dd = np.zeros((m, 6), dtype=np.float32)
+    for i in range(m):
+        for k in range(rowptr[i], rowptr[i + 1]):
+            dd[i, cols[k]] += vals[k]
+    np.testing.assert_allclose(dd, d[t.rb:t.re])
+
+
+def test_gemv_fast_path(mesh_size):
+    m, n = 8 * mesh_size, 24
+    d = _random_dense(m, n, 0.3, seed=4)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    b = np.random.default_rng(5).standard_normal(n).astype(np.float32)
+    bv = dr_tpu.distributed_vector.from_array(b)
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.gemv(c, sp, bv)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), d @ b, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gemv_accumulates():
+    m, n = 16, 8
+    d = _random_dense(m, n, 0.4, seed=6)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    b = np.ones(n, dtype=np.float32)
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 1.0)
+    dr_tpu.gemv(c, sp, b)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), 1.0 + d @ b,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gemv_host_b():
+    m, n = 12, 5
+    d = _random_dense(m, n, 0.6, seed=7)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    b = np.arange(n, dtype=np.float32)
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.gemv(c, sp, b)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), d @ b, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_random_sparse_matrix():
+    sp = dr_tpu.random_sparse_matrix((32, 32), density=0.1, seed=8)
+    assert sp.nnz == int(0.1 * 32 * 32)
+    assert sp.shape == (32, 32)
+    b = np.ones(32, dtype=np.float32)
+    y = np.asarray(dr_tpu.flat_gemv(sp, b))
+    np.testing.assert_allclose(y, sp.to_dense() @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_empty_rows_tile():
+    # matrix with an entirely empty row stripe still works
+    d = np.zeros((16, 4), dtype=np.float32)
+    d[0, 1] = 3.0
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    c = dr_tpu.distributed_vector(16)
+    dr_tpu.gemv(c, sp, np.ones(4, dtype=np.float32))
+    ref = d @ np.ones(4, dtype=np.float32)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), ref)
