@@ -1,0 +1,209 @@
+// thp_bridge implementation: CPython embedding of the dr_tpu runtime.
+#include "thp_bridge.hpp"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdlib>
+#include <stdexcept>
+
+namespace thp {
+
+namespace {
+
+[[noreturn]] void fail(const char* what) {
+  if (PyErr_Occurred()) PyErr_Print();
+  throw std::runtime_error(std::string("thp_bridge: ") + what);
+}
+
+PyObject* must(PyObject* p, const char* what) {
+  if (!p) fail(what);
+  return p;
+}
+
+}  // namespace
+
+struct session::impl {
+  PyObject* dr = nullptr;        // module dr_tpu
+  PyObject* stencil_mod = nullptr;
+  bool owns_interpreter = false;
+};
+
+session::session(int ncpu_devices) : impl_(new impl) {
+  if (!Py_IsInitialized()) {
+    if (ncpu_devices > 0) {
+      std::string flags = "--xla_force_host_platform_device_count=" +
+                          std::to_string(ncpu_devices);
+      setenv("XLA_FLAGS", flags.c_str(), 1);
+    }
+    Py_InitializeEx(0);
+    impl_->owns_interpreter = true;
+  }
+  if (ncpu_devices > 0) {
+    // env alone is not enough if site customization imported jax already
+    if (PyRun_SimpleString(
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"))
+      fail("forcing cpu platform");
+  }
+  impl_->dr = must(PyImport_ImportModule("dr_tpu"), "import dr_tpu");
+  must(PyObject_CallMethod(impl_->dr, "init", nullptr), "dr_tpu.init()");
+  impl_->stencil_mod = must(
+      PyImport_ImportModule("dr_tpu.algorithms.stencil"),
+      "import dr_tpu.algorithms.stencil");
+  // XLA device-count flags are frozen at first interpreter/backend init,
+  // so a later session cannot change the mesh size — fail loudly instead
+  // of computing over the wrong partitioning
+  if (ncpu_devices > 0 && nprocs() != (std::size_t)ncpu_devices)
+    fail("requested virtual mesh size differs from the initialized "
+         "backend; device-count flags are fixed at first init");
+}
+
+session::~session() {
+  Py_XDECREF(impl_->stencil_mod);
+  Py_XDECREF(impl_->dr);
+  // keep the interpreter alive: other sessions/objects may still use it
+}
+
+std::size_t session::nprocs() const {
+  PyObject* r = must(PyObject_CallMethod(impl_->dr, "nprocs", nullptr),
+                     "nprocs()");
+  std::size_t n = PyLong_AsSize_t(r);
+  Py_DECREF(r);
+  return n;
+}
+
+void session::exec(const std::string& code) {
+  if (PyRun_SimpleString(code.c_str())) fail("exec");
+}
+
+vector session::make_vector(std::size_t n, std::size_t prev,
+                            std::size_t next, bool periodic) {
+  PyObject* hb = nullptr;
+  if (prev || next) {
+    PyObject* hb_cls = must(
+        PyObject_GetAttrString(impl_->dr, "halo_bounds"), "halo_bounds");
+    hb = must(PyObject_CallFunction(hb_cls, "nnO", (Py_ssize_t)prev,
+                                    (Py_ssize_t)next,
+                                    periodic ? Py_True : Py_False),
+              "halo_bounds(...)");
+    Py_DECREF(hb_cls);
+  }
+  PyObject* cls = must(
+      PyObject_GetAttrString(impl_->dr, "distributed_vector"),
+      "distributed_vector");
+  PyObject* obj;
+  if (hb) {
+    PyObject* args = Py_BuildValue("(n)", (Py_ssize_t)n);
+    PyObject* kwargs = Py_BuildValue("{s:O}", "halo", hb);
+    obj = must(PyObject_Call(cls, args, kwargs), "distributed_vector(...)");
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    Py_DECREF(hb);
+  } else {
+    obj = must(PyObject_CallFunction(cls, "n", (Py_ssize_t)n),
+               "distributed_vector(n)");
+  }
+  Py_DECREF(cls);
+  return vector(this, obj, n);
+}
+
+double session::dot(const vector& a, const vector& b) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "dot", "OO",
+                          (PyObject*)a.obj_, (PyObject*)b.obj_),
+      "dot(a, b)");
+  double v = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return v;
+}
+
+void session::stencil_iterate(vector& a, vector& b,
+                              const std::vector<double>& weights,
+                              int steps) {
+  PyObject* w = PyList_New((Py_ssize_t)weights.size());
+  for (Py_ssize_t i = 0; i < (Py_ssize_t)weights.size(); ++i)
+    PyList_SetItem(w, i, PyFloat_FromDouble(weights[i]));
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->stencil_mod, "stencil_iterate", "OOOi",
+                          (PyObject*)a.obj_, (PyObject*)b.obj_, w, steps),
+      "stencil_iterate");
+  // stencil_iterate returns the buffer holding the final state; callers
+  // keep using `a` as "current" — swap handles if needed
+  if (r == (PyObject*)b.obj_) std::swap(a.obj_, b.obj_);
+  Py_DECREF(r);
+  Py_DECREF(w);
+}
+
+vector::~vector() { Py_XDECREF((PyObject*)obj_); }
+
+vector::vector(vector&& o) noexcept
+    : sess_(o.sess_), obj_(o.obj_), n_(o.n_) {
+  o.obj_ = nullptr;
+}
+
+vector& vector::operator=(vector&& o) noexcept {
+  if (this != &o) {
+    Py_XDECREF((PyObject*)obj_);
+    sess_ = o.sess_;
+    obj_ = o.obj_;
+    n_ = o.n_;
+    o.obj_ = nullptr;
+  }
+  return *this;
+}
+
+void vector::iota(double start) {
+  PyObject* r = must(
+      PyObject_CallMethod(sess_->impl_->dr, "iota", "Od",
+                          (PyObject*)obj_, start),
+      "iota");
+  Py_DECREF(r);
+}
+
+void vector::fill(double value) {
+  PyObject* r = must(
+      PyObject_CallMethod(sess_->impl_->dr, "fill", "Od",
+                          (PyObject*)obj_, value),
+      "fill");
+  Py_DECREF(r);
+}
+
+double vector::reduce() const {
+  PyObject* r = must(
+      PyObject_CallMethod(sess_->impl_->dr, "reduce", "O",
+                          (PyObject*)obj_),
+      "reduce");
+  double v = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return v;
+}
+
+void vector::halo_exchange() {
+  PyObject* h = must(
+      PyObject_CallMethod(sess_->impl_->dr, "halo", "O", (PyObject*)obj_),
+      "halo(v)");
+  PyObject* r = must(PyObject_CallMethod(h, "exchange", nullptr),
+                     "exchange()");
+  Py_DECREF(r);
+  Py_DECREF(h);
+}
+
+std::vector<double> vector::to_host() const {
+  PyObject* arr = must(
+      PyObject_CallMethod(sess_->impl_->dr, "to_numpy", "O",
+                          (PyObject*)obj_),
+      "to_numpy");
+  PyObject* lst = must(PyObject_CallMethod(arr, "tolist", nullptr),
+                       "tolist");
+  std::vector<double> out;
+  Py_ssize_t n = PyList_Size(lst);
+  out.reserve((std::size_t)n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out.push_back(PyFloat_AsDouble(PyList_GetItem(lst, i)));
+  Py_DECREF(lst);
+  Py_DECREF(arr);
+  return out;
+}
+
+}  // namespace thp

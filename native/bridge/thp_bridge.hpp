@@ -1,0 +1,82 @@
+// thp_bridge: C++ driver for the TPU execution backend.
+//
+// The reference's backends bind C++ to MPI (mhp) or SYCL (shp); the TPU
+// equivalent binds C++ to the embedded JAX/XLA runtime (the BASELINE.json
+// north-star "thin bridge": a C++ thp:: surface whose containers live as
+// shards of jax.Arrays on the device mesh).  The bridge uses the CPython
+// C API directly (no pybind11 in this image): one interpreter, GIL held by
+// the calling thread, jax programs dispatched asynchronously by the
+// runtime underneath.
+//
+// Surface (mirrors the Python dr_tpu API; extend as needed):
+//   thp::session s(ncpu_devices /*0 = real TPU*/);
+//   thp::vector v = s.vector(n, halo_prev, halo_next, periodic);
+//   v.iota(0); v.fill(1.0);
+//   double r = v.reduce();  double d = s.dot(a, b);
+//   s.stencil_iterate(a, b, {w...}, steps);
+//   std::vector<double> host = v.to_host();
+#pragma once
+
+#include <cstddef>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace thp {
+
+class session;
+
+class vector {
+ public:
+  vector() = default;
+  ~vector();
+  vector(vector&&) noexcept;
+  vector& operator=(vector&&) noexcept;
+  vector(const vector&) = delete;
+  vector& operator=(const vector&) = delete;
+
+  std::size_t size() const { return n_; }
+
+  void iota(double start);
+  void fill(double value);
+  double reduce() const;
+  void halo_exchange();
+  std::vector<double> to_host() const;
+
+ private:
+  friend class session;
+  vector(session* s, void* obj, std::size_t n)
+      : sess_(s), obj_(obj), n_(n) {}
+  session* sess_ = nullptr;
+  void* obj_ = nullptr;  // PyObject* of the dr_tpu.distributed_vector
+  std::size_t n_ = 0;
+};
+
+class session {
+ public:
+  // ncpu_devices > 0: force a virtual CPU mesh of that size (testing);
+  // ncpu_devices == 0: use the real device platform (TPU under the driver).
+  explicit session(int ncpu_devices = 0);
+  ~session();
+  session(const session&) = delete;
+  session& operator=(const session&) = delete;
+
+  std::size_t nprocs() const;
+
+  vector make_vector(std::size_t n, std::size_t halo_prev = 0,
+                     std::size_t halo_next = 0, bool periodic = false);
+  double dot(const vector& a, const vector& b);
+  // weights.size() must be halo_prev + halo_next + 1
+  void stencil_iterate(vector& a, vector& b,
+                       const std::vector<double>& weights, int steps);
+
+  // escape hatch: run a statement in the embedded interpreter
+  void exec(const std::string& code);
+
+ private:
+  friend class vector;
+  struct impl;
+  std::unique_ptr<impl> impl_;
+};
+
+}  // namespace thp

@@ -1,0 +1,136 @@
+// Native distributed algorithms over the vocabulary: fill / iota / copy /
+// for_each / transform / reduce / transform_reduce / inclusive_scan —
+// segment-wise execution with the aligned fast path / element fallback
+// split of the reference (mhp/algorithms/cpu_algorithms.hpp:14-167,
+// shp/algorithms/*).  On this host executor "element fallback" is plain
+// indexing (no RMA needed); on the TPU executor the same surface lowers to
+// fused XLA programs (dr_tpu/algorithms/*).
+#pragma once
+
+#include <algorithm>
+#include <functional>
+#include <numeric>
+
+#include "distributed_vector.hpp"
+#include "segment_tools.hpp"
+#include "vocabulary.hpp"
+
+namespace drtpu {
+
+template <distributed_range R, class T>
+void fill(R&& r, T value) {
+  for (auto&& s : drtpu::segments(r))
+    for (auto& x : drtpu::local(s)) x = value;
+}
+
+template <class T>
+void iota(distributed_vector<T>& dv, T start) {
+  for (auto&& s : drtpu::segments(dv)) {
+    T v = start + static_cast<T>(s.origin());
+    for (auto& x : drtpu::local(s)) x = v++;
+  }
+}
+
+template <distributed_range In, distributed_range Out, class Op>
+void transform(In&& in, Out&& out, Op op) {
+  if (drtpu::aligned(in, out)) {
+    auto is = drtpu::local_segments(in);
+    auto os = drtpu::local_segments(out);
+    for (std::size_t k = 0; k < is.size(); ++k)
+      for (std::size_t i = 0; i < is[k].size(); ++i)
+        os[k][i] = op(is[k][i]);
+    return;
+  }
+  // misaligned fallback: element-wise up to the shorter range
+  std::size_t n = std::min<std::size_t>(std::ranges::size(in),
+                                        std::ranges::size(out));
+  auto ib = std::ranges::begin(in);
+  auto ob = std::ranges::begin(out);
+  for (std::size_t i = 0; i < n; ++i, ++ib, ++ob) *ob = op(*ib);
+}
+
+template <distributed_range In, distributed_range Out>
+void copy(In&& in, Out&& out) {
+  transform(in, out, [](auto x) { return x; });
+}
+
+template <distributed_range R, class Fn>
+void for_each(R&& r, Fn fn) {
+  for (auto&& s : drtpu::segments(r))
+    for (auto& x : drtpu::local(s)) fn(x);
+}
+
+template <distributed_range R, class T, class Op = std::plus<>>
+T reduce(R&& r, T init = T{}, Op op = {}) {
+  T acc = init;
+  for (auto&& s : drtpu::segments(r)) {
+    auto loc = drtpu::local(s);
+    acc = std::reduce(loc.begin(), loc.end(), acc, op);
+  }
+  return acc;  // valid on every rank (single controller)
+}
+
+template <distributed_range R, class T, class ROp = std::plus<>,
+          class TOp = std::identity>
+T transform_reduce(R&& r, T init = T{}, ROp rop = {}, TOp top = {}) {
+  T acc = init;
+  for (auto&& s : drtpu::segments(r)) {
+    auto loc = drtpu::local(s);
+    acc = std::transform_reduce(loc.begin(), loc.end(), acc, rop, top);
+  }
+  return acc;
+}
+
+// dot = zip | transform | reduce (examples/shp/dot_product.cpp:11-18)
+template <distributed_range A, distributed_range B, class T>
+T dot(A&& a, B&& b, T init = T{}) {
+  T acc = init;
+  if (drtpu::aligned(a, b)) {
+    auto as = drtpu::local_segments(a);
+    auto bs = drtpu::local_segments(b);
+    for (std::size_t k = 0; k < as.size(); ++k)
+      for (std::size_t i = 0; i < as[k].size(); ++i)
+        acc += as[k][i] * bs[k][i];
+    return acc;
+  }
+  // misaligned fallback over the common prefix
+  std::size_t n = std::min<std::size_t>(std::ranges::size(a),
+                                        std::ranges::size(b));
+  auto ai = std::ranges::begin(a);
+  auto bi = std::ranges::begin(b);
+  for (std::size_t i = 0; i < n; ++i, ++ai, ++bi) acc += (*ai) * (*bi);
+  return acc;
+}
+
+// per-segment scan + carried prefix (the reference's 3-phase scan,
+// shp/algorithms/inclusive_scan.hpp:25-148, serialized on host)
+template <distributed_range In, distributed_range Out,
+          class Op = std::plus<>>
+void inclusive_scan(In&& in, Out&& out, Op op = {}) {
+  bool have_carry = false;
+  std::ranges::range_value_t<std::remove_cvref_t<In>> carry{};
+  if (drtpu::aligned(in, out)) {
+    auto is = drtpu::local_segments(in);
+    auto os = drtpu::local_segments(out);
+    for (std::size_t k = 0; k < is.size(); ++k) {
+      for (std::size_t i = 0; i < is[k].size(); ++i) {
+        carry = have_carry ? op(carry, is[k][i]) : is[k][i];
+        have_carry = true;
+        os[k][i] = carry;
+      }
+    }
+    return;
+  }
+  // misaligned fallback over the common prefix
+  std::size_t n = std::min<std::size_t>(std::ranges::size(in),
+                                        std::ranges::size(out));
+  auto ib = std::ranges::begin(in);
+  auto ob = std::ranges::begin(out);
+  for (std::size_t i = 0; i < n; ++i, ++ib, ++ob) {
+    carry = have_carry ? op(carry, *ib) : *ib;
+    have_carry = true;
+    *ob = carry;
+  }
+}
+
+}  // namespace drtpu

@@ -1,0 +1,235 @@
+// Native distributed_vector: 1-D block-distributed vector over a logical
+// mesh of P ranks, with halo padding — the host-side model of the TPU
+// layout (one padded row per shard; see dr_tpu/containers/
+// distributed_vector.py, mirroring mhp dv.hpp:176-238).
+//
+// This is the native CPU executor of the vocabulary: segments are
+// remote_span descriptors into per-rank buffers, halo exchange is
+// neighbor copies over the same [ghost_prev | owned | ghost_next] layout
+// the TPU backend uses (ppermute there, memcpy here), so a program written
+// against the vocabulary runs identically on either executor.
+#pragma once
+
+#include <algorithm>
+#include <cassert>
+#include <cstddef>
+#include <stdexcept>
+#include <vector>
+
+#include "iterator_adaptor.hpp"
+#include "remote_span.hpp"
+#include "segment_tools.hpp"
+#include "vocabulary.hpp"
+
+namespace drtpu {
+
+struct halo_bounds {
+  std::size_t prev = 0;
+  std::size_t next = 0;
+  bool periodic = false;
+};
+
+enum class halo_op { second, plus, max, min, multiplies };
+
+template <class T>
+class distributed_vector;
+
+// Ghost-cell controller (reference span_halo / halo_impl,
+// details/halo.hpp:55-110,273-387).
+template <class T>
+class span_halo {
+ public:
+  explicit span_halo(distributed_vector<T>* dv) : dv_(dv) {}
+
+  void exchange();
+  void exchange_begin() { exchange(); }
+  void exchange_finalize() {}
+  void reduce(halo_op op);
+  void reduce_plus() { reduce(halo_op::plus); }
+  void reduce_max() { reduce(halo_op::max); }
+  void reduce_min() { reduce(halo_op::min); }
+  void reduce_multiplies() { reduce(halo_op::multiplies); }
+
+ private:
+  distributed_vector<T>* dv_;
+};
+
+// Accessor for the global iterator: (container, logical index) — the
+// normal_distributed_iterator analog (details/
+// normal_distributed_iterator.hpp:13-115) with O(1) indexing thanks to the
+// uniform padded layout.
+template <class T>
+struct dv_accessor {
+  using value_type = T;
+  using difference_type = std::ptrdiff_t;
+
+  distributed_vector<T>* dv = nullptr;
+  std::size_t idx = 0;
+
+  T& dereference() const { return (*dv)[idx]; }
+  void operator+=(difference_type n) { idx += n; }
+  bool operator==(const dv_accessor& o) const {
+    return dv == o.dv && idx == o.idx;
+  }
+  auto operator<=>(const dv_accessor& o) const { return idx <=> o.idx; }
+  difference_type distance_to(const dv_accessor& o) const {
+    return static_cast<difference_type>(o.idx) -
+           static_cast<difference_type>(idx);
+  }
+};
+
+template <class T>
+class distributed_vector {
+ public:
+  using value_type = T;
+  using iterator = iterator_adaptor<dv_accessor<T>>;
+
+  distributed_vector(std::size_t n, std::size_t nprocs,
+                     halo_bounds hb = {})
+      : n_(n), nprocs_(nprocs), hb_(hb), halo_(this) {
+    assert(nprocs >= 1);
+    // segment_size = max(ceil(n/p), prev, next)  (dv.hpp:190-193)
+    seg_ = std::max({n ? (n + nprocs - 1) / nprocs : std::size_t{1},
+                     hb.prev, hb.next, std::size_t{1}});
+    width_ = hb.prev + seg_ + hb.next;
+    data_.assign(nprocs_, {});
+    for (auto& row : data_) row.assign(width_, T{});
+    if ((hb.prev || hb.next) && nprocs_ > 1) {
+      std::size_t tail = n_ - (nprocs_ - 1) * seg_;
+      if (n_ <= (nprocs_ - 1) * seg_)
+        throw std::invalid_argument("halo requires nonempty shards");
+      if (hb.periodic && tail < std::max(hb.prev, hb.next))
+        throw std::invalid_argument("periodic halo: tail below radius");
+    }
+  }
+
+  // value semantics must re-seat the halo controller's back-pointer
+  distributed_vector(const distributed_vector& o)
+      : n_(o.n_), nprocs_(o.nprocs_), seg_(o.seg_), width_(o.width_),
+        hb_(o.hb_), data_(o.data_), halo_(this) {}
+  distributed_vector(distributed_vector&& o) noexcept
+      : n_(o.n_), nprocs_(o.nprocs_), seg_(o.seg_), width_(o.width_),
+        hb_(o.hb_), data_(std::move(o.data_)), halo_(this) {}
+  distributed_vector& operator=(const distributed_vector& o) {
+    n_ = o.n_; nprocs_ = o.nprocs_; seg_ = o.seg_; width_ = o.width_;
+    hb_ = o.hb_; data_ = o.data_;
+    return *this;  // halo_ keeps pointing at *this
+  }
+  distributed_vector& operator=(distributed_vector&& o) noexcept {
+    n_ = o.n_; nprocs_ = o.nprocs_; seg_ = o.seg_; width_ = o.width_;
+    hb_ = o.hb_; data_ = std::move(o.data_);
+    return *this;
+  }
+
+  std::size_t size() const { return n_; }
+  iterator begin() { return iterator(dv_accessor<T>{this, 0}); }
+  iterator end() { return iterator(dv_accessor<T>{this, n_}); }
+  std::size_t nprocs() const { return nprocs_; }
+  std::size_t segment_size() const { return seg_; }
+  halo_bounds bounds() const { return hb_; }
+  span_halo<T>& halo() { return halo_; }
+
+  // element access through the padded layout
+  T& operator[](std::size_t i) {
+    return data_[i / seg_][hb_.prev + i % seg_];
+  }
+  const T& operator[](std::size_t i) const {
+    return data_[i / seg_][hb_.prev + i % seg_];
+  }
+
+  // padded row of one shard (the TPU (nshards, width) row analog)
+  std::span<T> shard_row(std::size_t r) {
+    return {data_[r].data(), width_};
+  }
+
+  std::vector<remote_span<T>> dr_segments() {
+    std::vector<remote_span<T>> segs;
+    for (std::size_t r = 0; r < nprocs_; ++r) {
+      std::size_t begin = r * seg_;
+      std::size_t end = std::min(n_, begin + seg_);
+      if (begin >= end) break;
+      segs.push_back(remote_span<T>(
+          r, begin,
+          std::span<T>(data_[r].data() + hb_.prev, end - begin)));
+    }
+    return segs;
+  }
+
+  std::size_t valid_of(std::size_t r) const {
+    std::size_t begin = r * seg_;
+    std::size_t end = std::min(n_, begin + seg_);
+    return end > begin ? end - begin : 0;
+  }
+
+ private:
+  friend class span_halo<T>;
+  std::size_t n_, nprocs_, seg_, width_;
+  halo_bounds hb_;
+  std::vector<std::vector<T>> data_;
+  span_halo<T> halo_;
+};
+
+template <class T>
+void span_halo<T>::exchange() {
+  auto& dv = *dv_;
+  auto [prev, next, periodic] = dv.hb_;
+  std::size_t P = dv.nprocs_;
+  if ((!prev && !next) || (P == 1 && !periodic)) return;
+  for (std::size_t r = 0; r < P; ++r) {
+    std::size_t valid = dv.valid_of(r);
+    if (!valid) continue;
+    // ghost_prev of r  <-  last `prev` valid cells of r-1 (fwd shift)
+    if (prev && (r > 0 || periodic)) {
+      std::size_t src = (r + P - 1) % P;
+      std::size_t sv = dv.valid_of(src);
+      std::copy_n(dv.data_[src].data() + prev + sv - prev, prev,
+                  dv.data_[r].data());
+    }
+    // ghost_next of r (right after valid tail) <- first `next` of r+1
+    if (next && (r + 1 < P || periodic)) {
+      std::size_t src = (r + 1) % P;
+      std::copy_n(dv.data_[src].data() + prev, next,
+                  dv.data_[r].data() + prev + valid);
+    }
+  }
+}
+
+template <class T>
+void span_halo<T>::reduce(halo_op op) {
+  auto& dv = *dv_;
+  auto [prev, next, periodic] = dv.hb_;
+  std::size_t P = dv.nprocs_;
+  if ((!prev && !next) || (P == 1 && !periodic)) return;
+  auto fold = [op](T a, T b) -> T {
+    switch (op) {
+      case halo_op::second: return b;
+      case halo_op::plus: return a + b;
+      case halo_op::max: return a > b ? a : b;
+      case halo_op::min: return a < b ? a : b;
+      case halo_op::multiplies: return a * b;
+    }
+    return b;
+  };
+  // ghosts fold back into their owners (halo.hpp:73-110)
+  for (std::size_t r = 0; r < P; ++r) {
+    std::size_t valid = dv.valid_of(r);
+    if (!valid) continue;
+    if (prev && (r > 0 || periodic)) {
+      std::size_t owner = (r + P - 1) % P;
+      std::size_t ov = dv.valid_of(owner);
+      T* dst = dv.data_[owner].data() + prev + ov - prev;
+      const T* src = dv.data_[r].data();
+      for (std::size_t k = 0; k < prev; ++k) dst[k] = fold(dst[k], src[k]);
+    }
+    if (next && (r + 1 < P || periodic)) {
+      std::size_t owner = (r + 1) % P;
+      T* dst = dv.data_[owner].data() + prev;
+      const T* src = dv.data_[r].data() + prev + valid;
+      for (std::size_t k = 0; k < next; ++k) dst[k] = fold(dst[k], src[k]);
+    }
+  }
+}
+
+static_assert(distributed_range<distributed_vector<double>&>);
+
+}  // namespace drtpu

@@ -1,0 +1,86 @@
+// iterator_adaptor: build a full random-access iterator from a small
+// "accessor" (state + advance/compare/dereference) — native equivalent of
+// the reference's lib::iterator_adaptor (details/iterator_adaptor.hpp:
+// 18-193), which every custom iterator there is built on.  The accessor
+// contract here: value_type, difference_type, operator+=(difference),
+// operator==(const A&), operator<=>(const A&), dereference() -> reference.
+#pragma once
+
+#include <compare>
+#include <cstddef>
+#include <iterator>
+
+namespace drtpu {
+
+template <class Accessor>
+class iterator_adaptor {
+ public:
+  using accessor_type = Accessor;
+  using value_type = typename Accessor::value_type;
+  using difference_type = typename Accessor::difference_type;
+  using reference = decltype(std::declval<const Accessor&>().dereference());
+  using iterator_category = std::random_access_iterator_tag;
+
+  iterator_adaptor() = default;
+  explicit iterator_adaptor(Accessor acc) : acc_(acc) {}
+  template <class... Args>
+    requires std::constructible_from<Accessor, Args...> &&
+             (sizeof...(Args) > 0)
+  explicit iterator_adaptor(Args&&... args)
+      : acc_(std::forward<Args>(args)...) {}
+
+  reference operator*() const { return acc_.dereference(); }
+  reference operator[](difference_type n) const {
+    auto t = acc_;
+    t += n;
+    return t.dereference();
+  }
+
+  iterator_adaptor& operator+=(difference_type n) {
+    acc_ += n;
+    return *this;
+  }
+  iterator_adaptor& operator-=(difference_type n) { return *this += -n; }
+  iterator_adaptor& operator++() { return *this += 1; }
+  iterator_adaptor operator++(int) {
+    auto t = *this;
+    ++*this;
+    return t;
+  }
+  iterator_adaptor& operator--() { return *this += -1; }
+  iterator_adaptor operator--(int) {
+    auto t = *this;
+    --*this;
+    return t;
+  }
+
+  friend iterator_adaptor operator+(iterator_adaptor it, difference_type n) {
+    return it += n;
+  }
+  friend iterator_adaptor operator+(difference_type n, iterator_adaptor it) {
+    return it += n;
+  }
+  friend iterator_adaptor operator-(iterator_adaptor it, difference_type n) {
+    return it += -n;
+  }
+  friend difference_type operator-(const iterator_adaptor& a,
+                                   const iterator_adaptor& b) {
+    return a.acc_.distance_to(b.acc_) * -1;
+  }
+
+  friend bool operator==(const iterator_adaptor& a,
+                         const iterator_adaptor& b) {
+    return a.acc_ == b.acc_;
+  }
+  friend auto operator<=>(const iterator_adaptor& a,
+                          const iterator_adaptor& b) {
+    return a.acc_ <=> b.acc_;
+  }
+
+  const Accessor& accessor() const { return acc_; }
+
+ private:
+  Accessor acc_;
+};
+
+}  // namespace drtpu

@@ -1,0 +1,58 @@
+// remote_span: a contiguous slice of a distributed container's logical
+// index space owned by one mesh rank — the native analog of the reference's
+// lib::remote_subrange (details/remote_subrange.hpp:13-37) and
+// shp::device_span (shp/device_span.hpp:43-84), redesigned as a descriptor:
+// (rank, global origin, host-visible span).  Rank-preserving first/last/
+// subspan mirror device_span's slicing surface.
+#pragma once
+
+#include <cstddef>
+#include <span>
+
+#include "vocabulary.hpp"
+
+namespace drtpu {
+
+template <class T>
+class remote_span {
+ public:
+  using element_type = T;
+  using value_type = std::remove_cv_t<T>;
+  using iterator = T*;
+
+  constexpr remote_span() = default;
+  constexpr remote_span(std::size_t rank, std::size_t origin,
+                        std::span<T> data)
+      : rank_(rank), origin_(origin), data_(data) {}
+
+  constexpr std::size_t dr_rank() const { return rank_; }
+  constexpr std::span<T> dr_local() const { return data_; }
+
+  constexpr std::size_t origin() const { return origin_; }
+  constexpr std::size_t size() const { return data_.size(); }
+  constexpr bool empty() const { return data_.empty(); }
+
+  constexpr T* begin() const { return data_.data(); }
+  constexpr T* end() const { return data_.data() + data_.size(); }
+  constexpr T& operator[](std::size_t i) const { return data_[i]; }
+
+  constexpr remote_span first(std::size_t n) const {
+    return {rank_, origin_, data_.first(n)};
+  }
+  constexpr remote_span last(std::size_t n) const {
+    return {rank_, origin_ + size() - n, data_.last(n)};
+  }
+  constexpr remote_span subspan(std::size_t off, std::size_t n) const {
+    return {rank_, origin_ + off, data_.subspan(off, n)};
+  }
+
+ private:
+  std::size_t rank_ = 0;
+  std::size_t origin_ = 0;
+  std::span<T> data_{};
+};
+
+static_assert(remote_range<remote_span<int>>);
+static_assert(remote_contiguous_range<remote_span<int>>);
+
+}  // namespace drtpu

@@ -1,0 +1,106 @@
+// Segment recomputation tools: take/drop windows over segment lists, the
+// zip alignment rule, and local_segments — native equivalents of the
+// reference's segments_tools.hpp:38-122 and mhp/alignment.hpp:13-28.
+//
+// Segments here are value descriptors (remote_span or anything with
+// size()/subspan()/dr_rank()), so recomputation is plain slicing — no
+// recursive view wrappers needed.
+#pragma once
+
+#include <cstddef>
+#include <vector>
+
+#include "remote_span.hpp"
+#include "vocabulary.hpp"
+
+namespace drtpu {
+
+template <class Seg>
+concept sliceable_segment = requires(const Seg& s, std::size_t k) {
+  { s.size() } -> std::convertible_to<std::size_t>;
+  { s.subspan(k, k) } -> std::convertible_to<Seg>;
+  { drtpu::rank(s) } -> std::convertible_to<std::size_t>;
+};
+
+// First n elements of a segment list, trimming the cut segment.
+template <sliceable_segment Seg>
+std::vector<Seg> take_segments(const std::vector<Seg>& segs, std::size_t n) {
+  std::vector<Seg> out;
+  std::size_t remaining = n;
+  for (const auto& s : segs) {
+    if (remaining == 0) break;
+    std::size_t k = s.size() < remaining ? s.size() : remaining;
+    out.push_back(s.subspan(0, k));
+    remaining -= k;
+  }
+  return out;
+}
+
+// Drop the first n elements of a segment list.
+template <sliceable_segment Seg>
+std::vector<Seg> drop_segments(const std::vector<Seg>& segs, std::size_t n) {
+  std::vector<Seg> out;
+  std::size_t todrop = n;
+  for (const auto& s : segs) {
+    if (todrop >= s.size()) {
+      todrop -= s.size();
+      continue;
+    }
+    out.push_back(s.subspan(todrop, s.size() - todrop));
+    todrop = 0;
+  }
+  return out;
+}
+
+template <sliceable_segment Seg>
+std::vector<Seg> subrange_segments(const std::vector<Seg>& segs,
+                                   std::size_t first, std::size_t last) {
+  return take_segments(drop_segments(segs, first), last - first);
+}
+
+// Pairwise (rank, size) equality of segment lists — the aligned() rule.
+// Misalignment is the empty-zip signal (segments_tools.hpp:117-121).
+template <sliceable_segment Seg>
+bool aligned_segments(const std::vector<std::vector<Seg>>& lists) {
+  if (lists.empty()) return true;
+  const auto& first = lists.front();
+  for (std::size_t li = 1; li < lists.size(); ++li) {
+    const auto& other = lists[li];
+    if (other.size() != first.size()) return false;
+    for (std::size_t i = 0; i < first.size(); ++i) {
+      if (drtpu::rank(first[i]) != drtpu::rank(other[i]) ||
+          first[i].size() != other[i].size())
+        return false;
+    }
+  }
+  return true;
+}
+
+template <distributed_range R1, distributed_range... Rs>
+bool aligned(R1&& r1, Rs&&... rs) {
+  using Seg = std::ranges::range_value_t<decltype(drtpu::segments(r1))>;
+  std::vector<std::vector<Seg>> lists;
+  auto collect = [&](auto&& r) {
+    std::vector<Seg> v;
+    for (auto&& s : drtpu::segments(r)) v.push_back(s);
+    lists.push_back(std::move(v));
+  };
+  collect(r1);
+  (collect(rs), ...);
+  for (const auto& l : lists)
+    if (l.empty()) return false;
+  return aligned_segments(lists);
+}
+
+// Device-local pieces of every segment (mhp/views.hpp:9-21): on the
+// single-controller runtime every shard is addressable.
+template <distributed_range R>
+auto local_segments(R&& r) {
+  auto segs = drtpu::segments(r);
+  using Local = decltype(drtpu::local(*segs.begin()));
+  std::vector<Local> out;
+  for (auto&& s : segs) out.push_back(drtpu::local(s));
+  return out;
+}
+
+}  // namespace drtpu

@@ -1,0 +1,144 @@
+// drtpu native vocabulary: rank / segments / local customization points and
+// the remote/distributed range concepts.
+//
+// C++20 re-design of the reference's L0 layer (include/dr/details/
+// ranges.hpp:38-161, include/dr/concepts/concepts.hpp:11-53) for the TPU
+// execution model: a "rank" is a mesh slot (device position), segments()
+// yields per-shard descriptors, and local() yields the host-visible span of
+// a shard's staged buffer.  Resolution order mirrors the reference: member
+// function, then ADL hook (dr_rank/dr_segments/dr_local), then fallback.
+#pragma once
+
+#include <concepts>
+#include <cstddef>
+#include <iterator>
+#include <ranges>
+#include <type_traits>
+#include <utility>
+
+namespace drtpu {
+
+// --------------------------------------------------------------------------
+// rank
+// --------------------------------------------------------------------------
+namespace cpo_detail {
+
+template <class T>
+concept member_rank = requires(T&& t) {
+  { std::forward<T>(t).dr_rank() } -> std::convertible_to<std::size_t>;
+};
+
+template <class T>
+concept adl_rank = requires(T&& t) {
+  { dr_rank(std::forward<T>(t)) } -> std::convertible_to<std::size_t>;
+};
+
+struct rank_fn {
+  template <class T>
+    requires member_rank<T> || adl_rank<T>
+  constexpr std::size_t operator()(T&& t) const {
+    if constexpr (member_rank<T>)
+      return std::forward<T>(t).dr_rank();
+    else
+      return dr_rank(std::forward<T>(t));
+  }
+};
+
+// --------------------------------------------------------------------------
+// segments
+// --------------------------------------------------------------------------
+template <class T>
+concept member_segments = requires(T&& t) {
+  { std::forward<T>(t).dr_segments() } -> std::ranges::forward_range;
+};
+
+template <class T>
+concept adl_segments = requires(T&& t) {
+  { dr_segments(std::forward<T>(t)) } -> std::ranges::forward_range;
+};
+
+struct segments_fn {
+  template <class T>
+    requires member_segments<T> || adl_segments<T>
+  constexpr decltype(auto) operator()(T&& t) const {
+    if constexpr (member_segments<T>)
+      return std::forward<T>(t).dr_segments();
+    else
+      return dr_segments(std::forward<T>(t));
+  }
+};
+
+// --------------------------------------------------------------------------
+// local
+// --------------------------------------------------------------------------
+template <class T>
+concept member_local = requires(T&& t) {
+  std::forward<T>(t).dr_local();
+};
+
+template <class T>
+concept adl_local = requires(T&& t) {
+  dr_local(std::forward<T>(t));
+};
+
+struct local_fn {
+  template <class T>
+    requires member_local<T> || adl_local<T> || std::contiguous_iterator<std::remove_cvref_t<T>>
+  constexpr auto operator()(T&& t) const {
+    if constexpr (member_local<T>)
+      return std::forward<T>(t).dr_local();
+    else if constexpr (adl_local<T>)
+      return dr_local(std::forward<T>(t));
+    else
+      // contiguous iterators are already local (ranges.hpp:150-155)
+      return std::remove_cvref_t<T>(std::forward<T>(t));
+  }
+};
+
+}  // namespace cpo_detail
+
+inline constexpr cpo_detail::rank_fn rank{};
+inline constexpr cpo_detail::segments_fn segments{};
+inline constexpr cpo_detail::local_fn local{};
+
+// --------------------------------------------------------------------------
+// concepts (concepts.hpp:11-53 equivalents)
+// --------------------------------------------------------------------------
+
+template <class I>
+concept remote_iterator =
+    std::forward_iterator<I> && requires(I i) { drtpu::rank(i); };
+
+template <class R>
+concept remote_range =
+    std::ranges::sized_range<R> && requires(R&& r) { drtpu::rank(r); };
+
+template <class R>
+concept distributed_range =
+    std::ranges::sized_range<R> && requires(R&& r) { drtpu::segments(r); };
+
+template <class I>
+concept remote_contiguous_iterator =
+    remote_iterator<I> && requires(I i) {
+      { drtpu::local(i) } -> std::contiguous_iterator;
+    };
+
+template <class R>
+concept remote_contiguous_range =
+    remote_range<R> && requires(R&& r) {
+      { drtpu::local(std::ranges::begin(r)) } -> std::contiguous_iterator;
+    };
+
+template <class I>
+concept distributed_iterator =
+    std::forward_iterator<I> && requires(I i) { drtpu::segments(i); };
+
+template <class R>
+concept distributed_contiguous_range =
+    distributed_range<R> &&
+    requires(R&& r) {
+      requires remote_contiguous_range<
+          std::ranges::range_value_t<decltype(drtpu::segments(r))>>;
+    };
+
+}  // namespace drtpu

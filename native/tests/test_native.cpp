@@ -1,0 +1,194 @@
+// Native layer tests: vocabulary CPOs, concepts, segment tools, the host
+// distributed_vector with halo, and the algorithm set — assert-based, run
+// at several logical mesh sizes (the native analog of the reference's
+// mpiexec -n {1,2,3,4} sweep, test/gtest/mhp/CMakeLists.txt:27-33).
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <numeric>
+#include <vector>
+
+#include <drtpu/algorithms.hpp>
+#include <drtpu/distributed_vector.hpp>
+#include <drtpu/iterator_adaptor.hpp>
+#include <drtpu/remote_span.hpp>
+#include <drtpu/segment_tools.hpp>
+#include <drtpu/vocabulary.hpp>
+
+using drtpu::distributed_vector;
+using drtpu::halo_bounds;
+using drtpu::remote_span;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                                \
+      return 1;                                                           \
+    }                                                                     \
+  } while (0)
+
+static int test_concepts() {
+  static_assert(drtpu::remote_range<remote_span<int>>);
+  static_assert(drtpu::remote_contiguous_range<remote_span<int>>);
+  static_assert(drtpu::distributed_range<distributed_vector<double>&>);
+  static_assert(!drtpu::remote_range<std::vector<int>>);
+  return 0;
+}
+
+static int test_vocabulary(std::size_t P) {
+  distributed_vector<double> dv(23, P);
+  auto segs = drtpu::segments(dv);
+  std::size_t total = 0, prev_rank = 0;
+  bool first = true;
+  for (auto& s : segs) {
+    total += s.size();
+    CHECK(drtpu::rank(s) < P);
+    if (!first) CHECK(drtpu::rank(s) > prev_rank);
+    prev_rank = drtpu::rank(s);
+    first = false;
+  }
+  CHECK(total == 23);
+  // local() yields writable host spans
+  drtpu::iota(dv, 0.0);
+  for (auto& s : segs) {
+    auto loc = drtpu::local(s);
+    CHECK(loc.size() == s.size());
+    CHECK(loc[0] == static_cast<double>(s.origin()));
+  }
+  return 0;
+}
+
+static int test_segment_tools(std::size_t P) {
+  distributed_vector<int> dv(24, P);
+  drtpu::iota(dv, 0);
+  auto segs = dv.dr_segments();
+  auto taken = drtpu::take_segments(segs, 7);
+  std::size_t tn = 0;
+  for (auto& s : taken) tn += s.size();
+  CHECK(tn == 7);
+  auto dropped = drtpu::drop_segments(segs, 5);
+  std::size_t dn = 0;
+  for (auto& s : dropped) dn += s.size();
+  CHECK(dn == 19);
+  CHECK(dropped[0][0] == 5);
+  auto sub = drtpu::subrange_segments(segs, 3, 11);
+  int expect = 3;
+  for (auto& s : sub)
+    for (int v : s) CHECK(v == expect++);
+  CHECK(expect == 11);
+
+  distributed_vector<int> other(24, P);
+  CHECK(drtpu::aligned(dv, other));
+  distributed_vector<int> longer(100, P);
+  if (P > 1) CHECK(!drtpu::aligned(dv, longer));
+  return 0;
+}
+
+static int test_algorithms(std::size_t P) {
+  distributed_vector<double> a(50, P), b(50, P);
+  drtpu::iota(a, 1.0);
+  drtpu::transform(a, b, [](double x) { return 2 * x; });
+  CHECK(b[49] == 100.0);
+  double sum = drtpu::reduce(a, 0.0);
+  CHECK(sum == 50.0 * 51.0 / 2.0);
+  double sq = drtpu::transform_reduce(a, 0.0, std::plus<>{},
+                                      [](double x) { return x * x; });
+  CHECK(sq == 42925.0);
+  double d = drtpu::dot(a, a, 0.0);
+  CHECK(d == sq);
+  distributed_vector<double> s(50, P);
+  drtpu::inclusive_scan(a, s);
+  CHECK(s[49] == sum);
+  drtpu::fill(b, 7.0);
+  CHECK(drtpu::reduce(b, 0.0) == 350.0);
+  // iterator + misaligned fallback path
+  drtpu::for_each(a, [](double& x) { x += 1.0; });
+  CHECK(a[0] == 2.0);
+  CHECK(*a.begin() == 2.0);
+  CHECK(*(a.begin() + 49) == 51.0);
+  CHECK(a.end() - a.begin() == 50);
+  return 0;
+}
+
+static int test_halo(std::size_t P) {
+  std::size_t n = 8 * P;
+  distributed_vector<double> dv(n, P, halo_bounds{1, 1, false});
+  drtpu::iota(dv, 0.0);
+  dv.halo().exchange();
+  if (P > 1) {
+    // ghost_prev of rank 1 holds rank 0's last owned value
+    auto row1 = dv.shard_row(1);
+    CHECK(row1[0] == static_cast<double>(dv.segment_size() - 1));
+  }
+  // periodic ring with a short last shard ships the logical tail
+  std::size_t n2 = 8 * P - (P > 1 ? 3 : 0);
+  distributed_vector<double> ring(n2, P, halo_bounds{1, 1, true});
+  drtpu::iota(ring, 0.0);
+  ring.halo().exchange();
+  auto row0 = ring.shard_row(0);
+  CHECK(row0[0] == static_cast<double>(n2 - 1));
+  // ghost->owner reduction
+  distributed_vector<double> r2(8 * P, P, halo_bounds{1, 1, false});
+  drtpu::fill(r2, 1.0);
+  r2.halo().exchange();
+  r2.halo().reduce_plus();
+  if (P > 1) {
+    CHECK(r2[dv.segment_size() - 1] == 2.0);
+    CHECK(r2[0] == 1.0);
+  }
+  // stencil through the padded rows (the hot-loop shape)
+  distributed_vector<double> in(8 * P, P, halo_bounds{1, 1, false});
+  distributed_vector<double> out(8 * P, P, halo_bounds{1, 1, false});
+  drtpu::iota(in, 0.0);
+  in.halo().exchange();
+  for (std::size_t r = 0; r < P; ++r) {
+    auto row = in.shard_row(r);
+    auto orow = out.shard_row(r);
+    for (std::size_t j = 0; j < in.valid_of(r); ++j)
+      orow[1 + j] = (row[j] + row[j + 1] + row[j + 2]) / 3.0;
+  }
+  for (std::size_t i = 1; i + 1 < in.size(); ++i)
+    CHECK(std::abs(out[i] - static_cast<double>(i)) < 1e-9);
+  return 0;
+}
+
+static int test_regressions(std::size_t P) {
+  // moved/copied vector's halo controller must act on the new object
+  distributed_vector<double> a(16 * P, P, halo_bounds{1, 1, false});
+  drtpu::iota(a, 0.0);
+  auto b = std::move(a);
+  b.halo().exchange();
+  if (P > 1) CHECK(b.shard_row(1)[0] == double(b.segment_size() - 1));
+  distributed_vector<double> c = b;
+  drtpu::fill(c, 5.0);
+  c.halo().exchange();
+  CHECK(c[0] == 5.0);
+  CHECK(b[0] == 0.0);  // source untouched by the copy's halo
+
+  // misaligned dot/scan/transform fall back over the common prefix
+  distributed_vector<double> x(100, P), y(3, P);
+  drtpu::fill(x, 2.0);
+  drtpu::fill(y, 3.0);
+  CHECK(drtpu::dot(x, y, 0.0) == 18.0);
+  distributed_vector<double> in(60, P), out(50, P);
+  drtpu::fill(in, 1.0);
+  drtpu::inclusive_scan(in, out);
+  CHECK(out[49] == 50.0);
+  drtpu::transform(in, out, [](double v) { return v * 4; });
+  CHECK(out[49] == 4.0);
+  return 0;
+}
+
+int main() {
+  if (test_concepts()) return 1;
+  for (std::size_t P : {1, 2, 3, 4, 8}) {
+    if (test_vocabulary(P)) return 1;
+    if (test_segment_tools(P)) return 1;
+    if (test_algorithms(P)) return 1;
+    if (test_halo(P)) return 1;
+    if (test_regressions(P)) return 1;
+  }
+  std::printf("native tests PASSED\n");
+  return 0;
+}

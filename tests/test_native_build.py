@@ -1,0 +1,45 @@
+"""Build and run the native C++ layer (vocabulary, host executor, bridge).
+
+The reference is a C++20 library; this keeps our native surface compiled
+and tested alongside the Python suite.
+"""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+
+
+requires_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                                  reason="g++ not available")
+
+
+@requires_gxx
+def test_native_vocabulary_and_executor():
+    subprocess.run(["make", "build/test_native"], cwd=NATIVE, check=True,
+                   capture_output=True)
+    out = subprocess.run([str(NATIVE / "build" / "test_native")],
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "PASSED" in out.stdout
+
+
+@requires_gxx
+def test_native_bridge_drives_backend():
+    r = subprocess.run(["make", "build/bridge_demo"], cwd=NATIVE,
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"bridge build unavailable: {r.stderr[-200:]}")
+    import os
+    env = dict(os.environ)
+    repo = str(NATIVE.parent)
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    out = subprocess.run([str(NATIVE / "build" / "bridge_demo"), "4"],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "PASSED" in out.stdout
