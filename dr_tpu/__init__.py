@@ -25,6 +25,9 @@ from .parallel.runtime import (init, final, finalize, runtime, nprocs,
                                devices, mesh, barrier, fence,
                                get_duplicated_devices)
 from .parallel.halo import halo_bounds, span_halo, halo_ops
+from .parallel.unstructured_halo import unstructured_halo
+from .parallel.collectives import (communicator, rma_window, default_comm,
+                                   init_distributed)
 from .core.vocabulary import (rank, segments, local, is_remote_range,
                               is_distributed_range,
                               is_remote_contiguous_range,
@@ -35,6 +38,11 @@ from .containers.partition import (tile, matrix_partition, block_cyclic,
                                    row_tiles, factor)
 from .containers.dense_matrix import dense_matrix, matrix_entry, Index2D
 from .containers.sparse_matrix import sparse_matrix, random_sparse_matrix
+from .containers.distributed_span import distributed_span
+from .containers.mdarray import (distributed_mdarray, distributed_mdspan,
+                                 transpose)
+from .utils.logging import drlog
+from .utils.debug import print_range, print_matrix, range_details
 from .views import views
 from .views.views import aligned, local_segments
 from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
@@ -67,4 +75,8 @@ __all__ = [
     "tile", "matrix_partition", "block_cyclic", "row_tiles", "factor",
     "dense_matrix", "matrix_entry", "Index2D",
     "sparse_matrix", "random_sparse_matrix",
+    "unstructured_halo", "communicator", "rma_window", "default_comm",
+    "init_distributed", "distributed_span",
+    "drlog", "print_range", "print_matrix", "range_details",
+    "distributed_mdarray", "distributed_mdspan", "transpose",
 ]

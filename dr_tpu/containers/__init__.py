@@ -2,3 +2,5 @@ from .distributed_vector import distributed_vector, halo
 from .partition import tile, matrix_partition, block_cyclic, row_tiles, factor
 from .dense_matrix import dense_matrix, matrix_entry, Index2D
 from .sparse_matrix import sparse_matrix, random_sparse_matrix
+from .distributed_span import distributed_span
+from .mdarray import distributed_mdarray, distributed_mdspan, transpose

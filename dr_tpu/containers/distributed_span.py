@@ -1,0 +1,69 @@
+"""``distributed_span``: non-owning distributed range over a segment list.
+
+TPU re-design of ``shp::distributed_span``
+(``shp/distributed_span.hpp:191-225``): wraps ANY list of segments and
+provides rank-preserving ``subspan/first/last`` that re-slice across
+segment boundaries.  Segments keep referencing their original containers;
+the span itself owns nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..core.vocabulary import rank, segments as _segments
+from ..views.views import drop_segments, take_segments
+
+__all__ = ["distributed_span"]
+
+
+class distributed_span:
+    def __init__(self, segs: Sequence):
+        self._segs = list(segs)
+
+    @classmethod
+    def of(cls, r) -> "distributed_span":
+        return cls(_segments(r))
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._segs)
+
+    def __dr_segments__(self):
+        return list(self._segs)
+
+    # -- rank-preserving re-slicing (distributed_span.hpp:191-225) ---------
+    def subspan(self, offset: int, count: int) -> "distributed_span":
+        return distributed_span(
+            take_segments(drop_segments(self._segs, offset), count))
+
+    def first(self, count: int) -> "distributed_span":
+        return self.subspan(0, count)
+
+    def last(self, count: int) -> "distributed_span":
+        return self.subspan(len(self) - count, count)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start, stop, step = key.indices(len(self))
+            assert step == 1
+            return self.subspan(start, stop - start)
+        return self.materialize()[key]
+
+    def materialize(self) -> np.ndarray:
+        if not self._segs:
+            return np.array([])
+        return np.concatenate([np.asarray(s.materialize())
+                               for s in self._segs])
+
+    def to_array(self):
+        import jax.numpy as jnp
+        return jnp.asarray(self.materialize())
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __repr__(self):
+        return (f"distributed_span(n={len(self)}, "
+                f"segments={len(self._segs)})")

@@ -1,0 +1,313 @@
+"""``distributed_mdarray`` / ``distributed_mdspan``: N-D distributed arrays.
+
+The reference SPECIFIES these but never implemented them
+(``doc/spec/source/containers/distributed_mdarray.rst:12-23``,
+``views/distributed_mdspan.rst:12-23``; the not-built example
+``examples/mhp/transpose-cpu.cpp:27-54``; mdspan dependency fetched but
+unused — SURVEY.md §2.6).  N-D sharded arrays are native on TPU, so they
+ship here as first-class:
+
+* ``distributed_mdarray(shape)`` — an N-D ``jax.Array`` sharded over its
+  leading one or two axes (1-D mesh axis or a 2-D grid), padded to the
+  shard grid with logical-shape masking, exposing ``segments()`` tiles;
+* ``distributed_mdspan`` — a non-owning N-D window (``submdspan``)
+  that re-slices tiles and still evaluates lazily.
+
+``transpose(out, in)`` covers the reference's planned transpose example —
+under jit the transpose of a sharded array lowers to an XLA all-to-all
+over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .partition import factor
+from ..parallel import runtime as _rt
+
+__all__ = ["distributed_mdarray", "distributed_mdspan", "transpose"]
+
+
+class MdTileSegment:
+    """One tile: an N-D box owned by one rank."""
+
+    __slots__ = ("base", "_rank", "box")
+
+    def __init__(self, base, rank, box: Tuple[Tuple[int, int], ...]):
+        self.base = base
+        self._rank = rank
+        self.box = box  # per-dim (begin, end)
+
+    def __dr_rank__(self):
+        return self._rank
+
+    def __dr_local__(self):
+        return self.base._local_box(self._rank, self.box)
+
+    @property
+    def shape(self):
+        return tuple(e - b for b, e in self.box)
+
+    def __len__(self):
+        n = 1
+        for b, e in self.box:
+            n *= e - b
+        return n
+
+    def materialize(self) -> np.ndarray:
+        sl = tuple(slice(b, e) for b, e in self.box)
+        return np.asarray(self.base.to_array()[sl])
+
+    def __repr__(self):
+        return f"MdTileSegment(rank={self._rank}, box={self.box})"
+
+
+class distributed_mdarray:
+    """N-D block-distributed array over the mesh's leading axes."""
+
+    def __init__(self, shape: Sequence[int], dtype=None, *,
+                 grid: Optional[Tuple[int, int]] = None, runtime=None,
+                 _data=None):
+        self._rt = runtime or _rt.runtime()
+        self._shape = tuple(int(s) for s in shape)
+        assert len(self._shape) >= 1
+        self._dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
+        P = self._rt.nprocs
+        ndim = len(self._shape)
+        if ndim == 1:
+            grid = (P,)
+        elif grid is None:
+            grid = factor(P)
+        self._grid = tuple(grid)
+        # tile sizes along the distributed leading axes
+        self._tsizes = tuple(-(-self._shape[d] // self._grid[d])
+                             if self._shape[d] else 1
+                             for d in range(len(self._grid)))
+        padded = list(self._shape)
+        for d in range(len(self._grid)):
+            padded[d] = self._grid[d] * self._tsizes[d]
+        self._padded = tuple(padded)
+        if len(self._grid) == 1:
+            mesh = self._rt.mesh
+            spec = PartitionSpec(self._rt.axis,
+                                 *([None] * (ndim - 1)))
+        else:
+            mesh = self._rt.mesh2d(self._grid)
+            spec = PartitionSpec("mr", "mc", *([None] * (ndim - 2)))
+        self._mesh = mesh
+        self._sharding = NamedSharding(mesh, spec)
+        if _data is not None:
+            self._data = _data
+        else:
+            key = ("mdz", id(mesh), self._padded, str(self._dtype))
+            fn = _md_cache.get(key)
+            if fn is None:
+                pd, dt, sh = self._padded, self._dtype, self._sharding
+                fn = jax.jit(lambda: jnp.zeros(pd, dt), out_shardings=sh)
+                _md_cache[key] = fn
+            self._data = fn()
+        self._rt.register(self)
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def grid(self):
+        return self._grid
+
+    @property
+    def runtime(self):
+        return self._rt
+
+    def __len__(self):
+        n = 1
+        for s in self._shape:
+            n *= s
+        return n
+
+    # ----------------------------------------------------------- vocabulary
+    def __dr_segments__(self):
+        segs = []
+        import itertools
+        ranges = [range(g) for g in self._grid]
+        for cell in itertools.product(*ranges):
+            box = []
+            ok = True
+            for d, i in enumerate(cell):
+                b = i * self._tsizes[d]
+                e = min(self._shape[d], b + self._tsizes[d])
+                if b >= e:
+                    ok = False
+                    break
+                box.append((b, e))
+            if not ok:
+                continue
+            for d in range(len(self._grid), len(self._shape)):
+                box.append((0, self._shape[d]))
+            rank = 0
+            for d, i in enumerate(cell):
+                rank = rank * self._grid[d] + i
+            segs.append(MdTileSegment(self, rank, tuple(box)))
+        return segs
+
+    def _local_box(self, rank, box):
+        devs = self._mesh.devices.reshape(-1)
+        target = devs[rank]
+        for sh in self._data.addressable_shards:
+            if sh.device.id == target.id:
+                sl = []
+                for d, (b, e) in enumerate(box):
+                    idx = sh.index[d] if d < len(sh.index) else slice(None)
+                    start = idx.start or 0
+                    sl.append(slice(b - start, e - start))
+                return sh.data[tuple(sl)]
+        sl = tuple(slice(b, e) for b, e in box)
+        return self.to_array()[sl]
+
+    # ----------------------------------------------------------- value APIs
+    def to_array(self) -> jax.Array:
+        sl = tuple(slice(0, s) for s in self._shape)
+        return self._data[sl]
+
+    def assign_array(self, values) -> None:
+        values = jnp.asarray(values, self._dtype)
+        assert values.shape == self._shape
+        key = ("mdp", id(self._mesh), self._padded, self._shape,
+               str(self._dtype))
+        fn = _md_cache.get(key)
+        if fn is None:
+            pd, dt, sh = self._padded, self._dtype, self._sharding
+            shp = self._shape
+
+            def pack(v):
+                out = jnp.zeros(pd, dt)
+                return out.at[tuple(slice(0, s) for s in shp)].set(v)
+            fn = jax.jit(pack, out_shardings=sh)
+            _md_cache[key] = fn
+        self._data = fn(values)
+
+    @classmethod
+    def from_array(cls, values, *, grid=None, runtime=None):
+        values = jnp.asarray(values)
+        md = cls(values.shape, values.dtype, grid=grid, runtime=runtime)
+        md.assign_array(values)
+        return md
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self.to_array())
+
+    def mdspan(self) -> "distributed_mdspan":
+        return distributed_mdspan(
+            self, tuple((0, s) for s in self._shape))
+
+    def submdspan(self, *slices) -> "distributed_mdspan":
+        return self.mdspan().submdspan(*slices)
+
+    def __getitem__(self, key):
+        if isinstance(key, tuple) and any(isinstance(k, slice) for k in key):
+            return self.submdspan(*key)
+        idx = tuple(int(k) for k in (key if isinstance(key, tuple)
+                                     else (key,)))
+        for d, i in enumerate(idx):
+            if not 0 <= i < self._shape[d]:
+                raise IndexError(idx)
+        return self._data[idx].item()
+
+    def __setitem__(self, key, value) -> None:
+        idx = tuple(int(k) for k in (key if isinstance(key, tuple)
+                                     else (key,)))
+        self._data = self._data.at[idx].set(jnp.asarray(value, self._dtype))
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def __repr__(self):
+        return (f"distributed_mdarray(shape={self._shape}, "
+                f"grid={self._grid}, dtype={self._dtype})")
+
+
+class distributed_mdspan:
+    """Non-owning N-D window over a distributed_mdarray
+    (spec: views/distributed_mdspan.rst)."""
+
+    def __init__(self, base: distributed_mdarray,
+                 box: Tuple[Tuple[int, int], ...]):
+        self.base = base
+        self.box = box
+
+    @property
+    def shape(self):
+        return tuple(e - b for b, e in self.box)
+
+    def __len__(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def submdspan(self, *slices) -> "distributed_mdspan":
+        box = list(self.box)
+        for d, sl in enumerate(slices):
+            b, e = self.box[d]
+            if isinstance(sl, slice):
+                s0, s1, step = sl.indices(e - b)
+                assert step == 1
+                box[d] = (b + s0, b + s1)
+            else:
+                box[d] = (b + int(sl), b + int(sl) + 1)
+        return distributed_mdspan(self.base, tuple(box))
+
+    def __dr_segments__(self):
+        out = []
+        from ..core.vocabulary import rank as _rank
+        for t in self.base.__dr_segments__():
+            clipped = []
+            ok = True
+            for (tb, te), (b, e) in zip(t.box, self.box):
+                lo, hi = max(tb, b), min(te, e)
+                if lo >= hi:
+                    ok = False
+                    break
+                clipped.append((lo, hi))
+            if ok:
+                out.append(MdTileSegment(self.base, _rank(t),
+                                         tuple(clipped)))
+        return out
+
+    def to_array(self):
+        sl = tuple(slice(b, e) for b, e in self.box)
+        return self.base.to_array()[sl]
+
+    def materialize(self) -> np.ndarray:
+        return np.asarray(self.to_array())
+
+    def __repr__(self):
+        return f"distributed_mdspan(box={self.box})"
+
+
+def transpose(out: distributed_mdarray, inp: distributed_mdarray) -> None:
+    """out = inp.T — the reference's planned-but-unbuilt transpose example
+    (examples/mhp/transpose-cpu.cpp:27-54).  Under jit the sharded
+    transpose lowers to an XLA all-to-all over the mesh."""
+    assert len(inp.shape) == 2 and out.shape == inp.shape[::-1]
+    key = ("mdT", id(inp._mesh), inp.shape, str(inp.dtype))
+    fn = _md_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda x: x.T)
+        _md_cache[key] = fn
+    out.assign_array(fn(inp.to_array()))
+
+
+_md_cache: dict = {}

@@ -1,0 +1,189 @@
+"""Communicator surface: collectives + point-to-point + RMA-window analog.
+
+TPU re-design of ``lib::communicator`` / ``lib::rma_window``
+(``include/dr/details/communicator.hpp``).  The reference wraps MPI:
+byte-oriented nonblocking p2p with halo tags, bcast/scatter(v)/gather(v),
+barrier, and one-sided windows (per-element Rget/Put + fence).
+
+On a single-controller TPU mesh these become:
+
+* ``bcast``      -> replicate an array across the mesh (device_put with a
+                    replicated sharding; XLA broadcast over ICI),
+* ``scatter``    -> shard a host/global array over the mesh axis,
+* ``gather``     -> fetch a sharded array to a host value (valid
+                    everywhere — improving the reference's root-only
+                    results),
+* ``send/recv``  -> ring shifts: ``shift_forward/backward`` wrap
+                    ``lax.ppermute`` (the halo tags' data plane),
+* ``alltoall``   -> ``lax.all_to_all`` over the mesh axis,
+* ``rma_window`` -> batched get/put against a distributed_vector
+                    (explicit-batch replacement for per-element RMA,
+                    SURVEY.md §2.5), with fence/flush as readiness
+                    barriers (arrays are values; ordering is program
+                    order).
+
+Multi-host (the MHP/DCN dimension) enters through ``init_distributed``:
+the same mesh abstraction spans hosts via ``jax.distributed``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import runtime as _rt
+
+__all__ = ["communicator", "rma_window", "default_comm", "init_distributed"]
+
+
+class communicator:
+    """Typed mesh communicator (communicator.hpp:7-95 analog)."""
+
+    def __init__(self, runtime=None):
+        self._rt = runtime or _rt.runtime()
+
+    # -- topology (communicator.hpp:21-26) ---------------------------------
+    @property
+    def size(self) -> int:
+        return self._rt.nprocs
+
+    def first(self) -> int:
+        return 0
+
+    def last(self) -> int:
+        return self.size - 1
+
+    def prev(self, rank: int) -> int:
+        return (rank - 1) % self.size
+
+    def next(self, rank: int) -> int:
+        return (rank + 1) % self.size
+
+    # -- collectives -------------------------------------------------------
+    def barrier(self) -> None:
+        self._rt.barrier()
+
+    def bcast(self, values) -> jax.Array:
+        """Replicate values on every device (communicator.hpp:32)."""
+        sh = NamedSharding(self._rt.mesh, P())
+        return jax.device_put(jnp.asarray(values), sh)
+
+    def scatter(self, values) -> jax.Array:
+        """Shard axis 0 of ``values`` over the mesh (communicator.hpp:36-45).
+        Length must divide the mesh; pad-and-mask is the container layer's
+        job (distributed_vector)."""
+        values = jnp.asarray(values)
+        assert values.shape[0] % self.size == 0, \
+            "scatter: first dim must divide the mesh (use a container for " \
+            "uneven sizes)"
+        sh = NamedSharding(self._rt.mesh, P(self._rt.axis))
+        return jax.device_put(values, sh)
+
+    def gather(self, arr) -> np.ndarray:
+        """Collect a (sharded) array to the host (communicator.hpp:47-62).
+        Result is valid on every rank (single controller)."""
+        return np.asarray(arr)
+
+    def allgather(self, arr) -> np.ndarray:
+        return self.gather(arr)
+
+    # -- ring p2p: the halo tag data plane (communicator.hpp:64-85) --------
+    def shift_forward(self, arr, periodic: bool = False) -> jax.Array:
+        """Every shard's slice moves to the next rank (rank r -> r+1)."""
+        return self._shift(arr, +1, periodic)
+
+    def shift_backward(self, arr, periodic: bool = False) -> jax.Array:
+        return self._shift(arr, -1, periodic)
+
+    def _shift(self, arr, direction: int, periodic: bool) -> jax.Array:
+        rt = self._rt
+        n = self.size
+        if direction > 0:
+            perm = [(i, i + 1) for i in range(n - 1)]
+            if periodic:
+                perm.append((n - 1, 0))
+        else:
+            perm = [(i + 1, i) for i in range(n - 1)]
+            if periodic:
+                perm.append((0, n - 1))
+        key = ("shift", id(rt.mesh), direction, periodic, arr.shape[1:],
+               str(arr.dtype))
+        prog = _shift_cache.get(key)
+        if prog is None:
+            body = jax.shard_map(
+                lambda x: jax.lax.ppermute(x, rt.axis, perm),
+                mesh=rt.mesh, in_specs=P(rt.axis),
+                out_specs=P(rt.axis))
+            prog = jax.jit(body)
+            _shift_cache[key] = prog
+        return prog(arr)
+
+    def alltoall(self, arr) -> jax.Array:
+        """lax.all_to_all over the mesh axis: arr (nshards, nshards, ...)
+        sharded on axis 0; block (i, j) moves to shard j."""
+        rt = self._rt
+        key = ("a2a", id(rt.mesh), arr.shape[1:], str(arr.dtype))
+        prog = _shift_cache.get(key)
+        if prog is None:
+            def body(x):  # x: (1, nshards, ...)
+                return jax.lax.all_to_all(x, rt.axis, split_axis=1,
+                                          concat_axis=0, tiled=False)
+            shm = jax.shard_map(body, mesh=rt.mesh, in_specs=P(rt.axis),
+                                out_specs=P(rt.axis))
+            prog = jax.jit(shm)
+            _shift_cache[key] = prog
+        return prog(arr)
+
+
+_shift_cache: dict = {}
+
+
+def default_comm() -> communicator:
+    """mhp::default_comm() analog (mhp/global.hpp:35)."""
+    return communicator()
+
+
+class rma_window:
+    """One-sided access surface over a distributed_vector
+    (communicator.hpp:97-149 analog).
+
+    The reference's per-element MPI_Rget/MPI_Put is its documented slow
+    path; here get/put are EXPLICITLY batched gathers/scatters compiled to
+    one program per call.  fence/flush are readiness barriers: arrays are
+    values, ordering is program order (SURVEY.md §5 "windows -> values").
+    """
+
+    def __init__(self, dv):
+        self._dv = dv
+
+    def get(self, indices):
+        return self._dv.get(indices)
+
+    def put(self, indices, values) -> None:
+        self._dv.put(indices, values)
+
+    def fence(self) -> None:
+        jax.block_until_ready(self._dv._data)
+
+    def flush(self, rank: Optional[int] = None) -> None:
+        jax.block_until_ready(self._dv._data)
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None, **kw):
+    """Multi-host (DCN) enablement — the MHP dimension.
+
+    Wraps ``jax.distributed.initialize``: after it, ``jax.devices()`` spans
+    every host and ``dr_tpu.init()`` builds a global mesh whose collectives
+    ride ICI within a slice and DCN across hosts.  All hosts must run the
+    same program in the same order — the SPMD discipline the reference gets
+    from MPI (SURVEY.md §7 hard-part 6).
+    """
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kw)
+    return _rt.init()

@@ -1,0 +1,63 @@
+"""Debug printers: ``print_range`` / ``print_matrix`` / ``range_details``.
+
+TPU re-design of the reference's debug helpers (``shp/util.hpp:138-222``):
+human-readable dumps of a distributed range's values and per-segment
+placement (rank, origin, size, device), for interactive inspection.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..core.vocabulary import local, rank, segments
+
+__all__ = ["print_range", "print_matrix", "range_details"]
+
+
+def range_details(r, name: str = "range", file=None) -> str:
+    """Per-segment placement summary (shp/util.hpp:186-205)."""
+    out = [f"{name}: n={len(r)}"]
+    try:
+        segs = segments(r)
+    except TypeError:
+        segs = []
+    for i, s in enumerate(segs):
+        origin = getattr(s, "begin", None)
+        origin = "" if origin is None else f" origin={origin}"
+        dev = ""
+        loc = local(s)
+        devs = getattr(loc, "devices", None)
+        if callable(devs):
+            try:
+                dev = f" device={list(devs())[0]}"
+            except Exception:
+                pass
+        out.append(f"  segment {i}: rank={rank(s)} size={len(s)}"
+                   f"{origin}{dev}")
+    text = "\n".join(out)
+    print(text, file=file or sys.stdout)
+    return text
+
+
+def print_range(r, name: str = "range", limit: int = 64, file=None) -> str:
+    """Values + segmentation (shp/util.hpp:138-160)."""
+    vals = np.asarray(r.materialize() if hasattr(r, "materialize")
+                      else np.asarray(r))
+    shown = np.array2string(vals[:limit], threshold=limit)
+    suffix = " ..." if vals.size > limit else ""
+    text = f"{name}: {shown}{suffix}"
+    print(text, file=file or sys.stdout)
+    range_details(r, name, file=file)
+    return text
+
+
+def print_matrix(m, name: str = "matrix", limit: int = 8, file=None) -> str:
+    """2-D dump with tile grid info (shp/util.hpp:162-184)."""
+    vals = np.asarray(m.materialize())
+    shown = np.array2string(vals[:limit, :limit], threshold=limit * limit)
+    text = (f"{name}: shape={m.shape} grid={getattr(m, 'grid_shape', '?')}"
+            f"\n{shown}")
+    print(text, file=file or sys.stdout)
+    return text

@@ -1,0 +1,62 @@
+"""``drlog``: the framework logger.
+
+TPU re-design of ``lib::drlog`` (``include/dr/details/logger.hpp:7-49``):
+a global logger with a per-process file sink (the reference writes
+``dr.{rank}.log`` per MPI rank; a single-controller TPU process writes one
+file, multi-host writes one per process index), ``debug(fmt, ...)`` with
+call-site prefixes, and a zero-cost disabled mode (the reference compiles
+the subsystem away without DR_FORMAT; here the module no-ops unless
+enabled, and the comm layers guard calls on ``enabled()``).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Optional, TextIO
+
+__all__ = ["drlog", "Logger"]
+
+
+class Logger:
+    def __init__(self):
+        self._sink: Optional[TextIO] = None
+        self._enabled = bool(os.environ.get("DR_TPU_LOG"))
+
+    def set_file(self, path: str) -> None:
+        """Open the per-process sink (README.rst:101-107 usage shape);
+        multi-host appends the process index like the reference's rank."""
+        import jax
+        if jax.process_count() > 1:
+            root, ext = os.path.splitext(path)
+            path = f"{root}.{jax.process_index()}{ext}"
+        self._sink = open(path, "a")
+        self._enabled = True
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def debug(self, fmt: str, *args, **kw) -> None:
+        """debug(fmt, ...) with source-location prefix
+        (logger.hpp:13-28)."""
+        if not self._enabled:
+            return
+        frame = inspect.stack()[1]
+        loc = f"{os.path.basename(frame.filename)}:{frame.lineno}"
+        msg = fmt.format(*args, **kw) if (args or kw) else fmt
+        line = f"[{loc}] {msg}\n"
+        if self._sink is not None:
+            self._sink.write(line)
+            self._sink.flush()
+        else:
+            import sys
+            sys.stderr.write("drlog " + line)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+#: global logger instance (the reference's ``lib::drlog`` global)
+drlog = Logger()

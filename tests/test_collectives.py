@@ -1,0 +1,129 @@
+"""communicator / rma_window / unstructured_halo / distributed_span tests
+(reference details/communicator.hpp, details/halo.hpp:148-271,
+shp/distributed_span.hpp)."""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+
+
+def test_communicator_topology():
+    comm = dr_tpu.default_comm()
+    assert comm.size == dr_tpu.nprocs()
+    assert comm.first() == 0 and comm.last() == comm.size - 1
+    assert comm.next(comm.last()) == 0
+    assert comm.prev(0) == comm.last()
+
+
+def test_bcast_scatter_gather():
+    comm = dr_tpu.default_comm()
+    v = np.arange(comm.size * 4, dtype=np.float32)
+    sharded = comm.scatter(v)
+    np.testing.assert_array_equal(comm.gather(sharded), v)
+    rep = comm.bcast(np.array([1.0, 2.0]))
+    np.testing.assert_array_equal(np.asarray(rep), [1.0, 2.0])
+
+
+def test_ring_shift():
+    comm = dr_tpu.default_comm()
+    P = comm.size
+    arr = comm.scatter(np.arange(P, dtype=np.float32).reshape(P, 1)
+                       .repeat(2, 1).reshape(P, 2)[:, :1])
+    fwd = comm.shift_forward(arr, periodic=True)
+    got = np.asarray(fwd).ravel()
+    expect = np.roll(np.arange(P), 1)
+    np.testing.assert_array_equal(got, expect)
+    bwd = comm.shift_backward(arr, periodic=False)
+    got = np.asarray(bwd).ravel()
+    # non-periodic: last shard receives zeros
+    expect = np.concatenate([np.arange(1, P), [0]])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_alltoall():
+    comm = dr_tpu.default_comm()
+    P = comm.size
+    if P == 1:
+        pytest.skip("needs >1 rank")
+    mat = np.arange(P * P, dtype=np.float32).reshape(P, P, 1)
+    sharded = comm.scatter(mat)
+    out = np.asarray(comm.alltoall(sharded)).reshape(P, P)
+    np.testing.assert_array_equal(out, mat.reshape(P, P).T)
+
+
+def test_rma_window():
+    dv = dr_tpu.distributed_vector(32, dtype=np.float32)
+    win = dr_tpu.rma_window(dv)
+    win.put(np.array([1, 17, 31]), np.array([5.0, 6.0, 7.0]))
+    win.fence()
+    got = np.asarray(win.get(np.array([1, 17, 31])))
+    np.testing.assert_array_equal(got, [5.0, 6.0, 7.0])
+    win.flush()
+
+
+def test_unstructured_halo_exchange():
+    n = 32
+    dv = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    # rank 1 mirrors elements {0, 5}; rank 2 mirrors {31}
+    uh = dr_tpu.unstructured_halo(dv, {1: [0, 5], 2: [31]})
+    uh.exchange()
+    np.testing.assert_array_equal(np.asarray(uh.ghost_values(1)), [0., 5.])
+    np.testing.assert_array_equal(np.asarray(uh.ghost_values(2)), [31.])
+
+
+def test_unstructured_halo_reduce():
+    n = 16
+    dv = dr_tpu.distributed_vector.from_array(np.zeros(n, np.float32))
+    uh = dr_tpu.unstructured_halo(dv, {0: [3, 7], 1: [7]})
+    uh.set_ghost_values(0, np.array([1.0, 2.0]))
+    uh.set_ghost_values(1, np.array([10.0]))
+    uh.reduce("plus")
+    got = dr_tpu.to_numpy(dv)
+    assert got[3] == 1.0
+    assert got[7] == 12.0  # contributions from both ghost groups combine
+    uh2 = dr_tpu.unstructured_halo(dv, {0: [3]})
+    uh2.set_ghost_values(0, np.array([100.0]))
+    uh2.reduce("max")
+    assert dr_tpu.to_numpy(dv)[3] == 100.0
+
+
+def test_distributed_span_reslicing():
+    dv = dr_tpu.distributed_vector.from_array(
+        np.arange(40, dtype=np.float32))
+    sp = dr_tpu.distributed_span.of(dv)
+    assert len(sp) == 40
+    sub = sp.subspan(7, 20)
+    np.testing.assert_array_equal(sub.materialize(),
+                                  np.arange(7, 27, dtype=np.float32))
+    np.testing.assert_array_equal(sub.first(5).materialize(),
+                                  np.arange(7, 12, dtype=np.float32))
+    np.testing.assert_array_equal(sub.last(3).materialize(),
+                                  np.arange(24, 27, dtype=np.float32))
+    # ranks preserved through re-slicing
+    for s in dr_tpu.segments(sub):
+        assert 0 <= dr_tpu.rank(s) < dr_tpu.nprocs()
+
+
+def test_logger(tmp_path):
+    log = dr_tpu.drlog
+    path = tmp_path / "dr.log"
+    log.set_file(str(path))
+    log.debug("hello {}", 42)
+    log.close()
+    text = path.read_text()
+    assert "hello 42" in text
+    assert "test_collectives.py" in text
+
+
+def test_debug_printers(capsys):
+    dv = dr_tpu.distributed_vector.from_array(
+        np.arange(10, dtype=np.float32))
+    dr_tpu.print_range(dv, "v")
+    out = capsys.readouterr().out
+    assert "v:" in out and "rank=" in out
+    mat = dr_tpu.dense_matrix.from_array(np.eye(4, dtype=np.float32))
+    dr_tpu.print_matrix(mat, "m")
+    out = capsys.readouterr().out
+    assert "shape=(4, 4)" in out

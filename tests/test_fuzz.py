@@ -1,0 +1,138 @@
+"""Randomized property harness: random sizes / windows / ops vs numpy.
+
+TPU analog of the reference's MPI-aware libFuzzer harness
+(``test/fuzz/cpu/cpu-fuzz.cpp:50-64`` + ``algorithms.cpp:10-57``): a spec
+(algorithm, n, b, e) drives copy/transform/reduce/scan over random
+subranges, asserting against the serial result.  Seeded and bounded so it
+runs deterministically in CI; crank DR_TPU_FUZZ_ITERS for longer runs.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import views
+
+ITERS = int(os.environ.get("DR_TPU_FUZZ_ITERS", "40"))
+
+
+def _mk(rng, n):
+    src = rng.standard_normal(n).astype(np.float32)
+    return src, dr_tpu.distributed_vector.from_array(src)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_subrange_ops(seed):
+    rng = np.random.default_rng(seed)
+    for it in range(ITERS):
+        n = int(rng.integers(1, 200))
+        b = int(rng.integers(0, n))
+        e = int(rng.integers(b, n))
+        alg = rng.choice(["copy", "transform", "reduce", "scan", "fill",
+                          "iota"])
+        src, dv = _mk(rng, n)
+        if alg == "copy":
+            dst_src, dst = _mk(rng, n)
+            dr_tpu.copy(dv[b:e], dst[b:e])
+            ref = dst_src.copy()
+            ref[b:e] = src[b:e]
+            np.testing.assert_allclose(dr_tpu.to_numpy(dst), ref,
+                                       rtol=1e-5, atol=1e-6)
+        elif alg == "transform":
+            dst_src, dst = _mk(rng, n)
+            dr_tpu.transform(dv[b:e], dst[b:e], lambda x: x * 2 + 1)
+            ref = dst_src.copy()
+            ref[b:e] = src[b:e] * 2 + 1
+            np.testing.assert_allclose(dr_tpu.to_numpy(dst), ref,
+                                       rtol=1e-5, atol=1e-6)
+        elif alg == "reduce":
+            got = dr_tpu.reduce(dv[b:e])
+            np.testing.assert_allclose(
+                got, float(src[b:e].astype(np.float64).sum()),
+                rtol=1e-3, atol=1e-4)
+        elif alg == "scan":
+            out = dr_tpu.distributed_vector(n)
+            dr_tpu.inclusive_scan(dv, out)
+            np.testing.assert_allclose(dr_tpu.to_numpy(out),
+                                       np.cumsum(src, dtype=np.float32),
+                                       rtol=1e-3, atol=1e-4)
+        elif alg == "fill":
+            dr_tpu.fill(dv[b:e], 3.25)
+            ref = src.copy()
+            ref[b:e] = 3.25
+            np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref)
+        elif alg == "iota":
+            iv = dr_tpu.distributed_vector(n, dtype=np.int32)
+            dr_tpu.iota(iv[b:e], 5)
+            ref = np.zeros(n, np.int32)
+            ref[b:e] = np.arange(5, 5 + (e - b))
+            np.testing.assert_array_equal(dr_tpu.to_numpy(iv), ref)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_zip_pipelines(seed):
+    rng = np.random.default_rng(100 + seed)
+    for it in range(ITERS // 2):
+        n = int(rng.integers(2, 150))
+        a_src, a = _mk(rng, n)
+        b_src, b = _mk(rng, n)
+        mode = rng.choice(["dot", "for_each", "tr"])
+        if mode == "dot":
+            got = dr_tpu.dot(a, b)
+            ref = float(np.dot(a_src.astype(np.float64),
+                               b_src.astype(np.float64)))
+            assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)
+        elif mode == "for_each":
+            z = views.zip_view(a, b)
+            dr_tpu.for_each(z, lambda x, y: (x + y, x - y))
+            np.testing.assert_allclose(dr_tpu.to_numpy(a), a_src + b_src,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(dr_tpu.to_numpy(b), a_src - b_src,
+                                       rtol=1e-5, atol=1e-6)
+        else:
+            got = dr_tpu.transform_reduce(
+                views.transform(views.zip_view(a, b),
+                                lambda x, y: jnp.abs(x - y)))
+            ref = float(np.abs(a_src - b_src).astype(np.float64).sum())
+            assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)
+
+
+def test_fuzz_halo_stencil():
+    rng = np.random.default_rng(7)
+    for it in range(8):
+        P = dr_tpu.nprocs()
+        n = int(rng.integers(4 * P, 12 * P))
+        r = int(rng.integers(1, 3))
+        periodic = bool(rng.integers(0, 2))
+        tail = n - (P - 1) * max(-(-n // P), r)
+        if tail < max(r, 1):
+            continue
+        src = rng.standard_normal(n).astype(np.float32)
+        hb = dr_tpu.halo_bounds(r, r, periodic)
+        try:
+            a = dr_tpu.distributed_vector.from_array(src, halo=hb)
+            b = dr_tpu.distributed_vector.from_array(src, halo=hb)
+        except ValueError:
+            continue
+        w = rng.random(2 * r + 1).astype(np.float64)
+        w /= w.sum()
+        out = dr_tpu.stencil_iterate(a, b, list(w), steps=2)
+        ref = src.astype(np.float64)
+        for _ in range(2):
+            if periodic:
+                acc = np.zeros_like(ref)
+                for d in range(-r, r + 1):
+                    acc += np.roll(ref, -d) * w[d + r]
+                ref = acc
+            else:
+                y = ref.copy()
+                acc = np.zeros(n - 2 * r)
+                for d in range(-r, r + 1):
+                    acc += ref[r + d:n - r + d] * w[d + r]
+                y[r:n - r] = acc
+                ref = y
+        np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=1e-3,
+                                   atol=1e-4)
