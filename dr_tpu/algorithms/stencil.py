@@ -29,7 +29,8 @@ from jax.sharding import PartitionSpec as P
 from .elementwise import _out_chain, _prog_cache, _resolve
 from ..parallel.halo import _ring_perms
 
-__all__ = ["stencil_transform", "stencil_iterate", "build_stencil_step"]
+__all__ = ["stencil_transform", "stencil_iterate", "build_stencil_step",
+           "stencil_iterate_blocked"]
 
 
 def _shift_window(row, d, prev, seg):
@@ -175,3 +176,73 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
     fin, other = prog(a_dv._data, b_dv._data)
     a_dv._data, b_dv._data = fin, other
     return a_dv
+
+
+def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
+                            chunk: int = 8192, interpret=None):
+    """Temporally-blocked stencil: T steps fused per HBM pass via the
+    Pallas kernel (ops/stencil_pallas.py), with ONE ppermute halo exchange
+    per T-step block instead of per step — both the HBM traffic and the
+    ICI message count drop ~T-fold versus stencil_iterate.
+
+    Requirements: periodic ring (every cell computed — the context-
+    parallel shape), halo width >= time_block * radius, and equal full
+    shards (n divisible by nshards * segment alignment).  Returns ``dv``
+    stepped ``steps`` times.
+    """
+    from ..ops import stencil_pallas
+
+    cont = dv
+    hb = cont.halo_bounds
+    r = (len(weights) - 1) // 2
+    nshards, seg, prev, nxt, n = cont.layout
+    assert hb.periodic, "blocked stencil runs on the periodic ring"
+    assert prev == nxt and prev >= time_block * r, \
+        "halo width must cover time_block * radius"
+    assert n == nshards * seg, "blocked stencil needs equal full shards"
+    if interpret is None:
+        interpret = cont.runtime.devices[0].platform != "tpu"
+
+    w = tuple(float(x) for x in weights)
+    key = ("stencil_blk", id(cont.runtime.mesh), cont.layout, w,
+           time_block, chunk, bool(interpret), str(cont.dtype))
+    progs = _prog_cache.setdefault(key, {})
+    nfull, rest = divmod(steps, time_block)
+    if nfull and time_block not in progs:
+        progs[time_block] = _make_blocked_prog(cont, w, time_block, chunk,
+                                               interpret)
+    if rest and rest not in progs:
+        progs[rest] = _make_blocked_prog(cont, w, rest, chunk, interpret)
+    data = cont._data
+    for _ in range(nfull):
+        data = progs[time_block](data)
+    if rest:
+        data = progs[rest](data)
+    cont._data = data
+    return cont
+
+
+def _make_blocked_prog(cont, weights, tsteps, chunk, interpret):
+    from ..ops import stencil_pallas
+    nshards, seg, prev, nxt, n = cont.layout
+    halo_w = prev
+    axis = cont.runtime.axis
+    w = tuple(float(x) for x in weights)
+    fwd, bwd = _ring_perms(nshards, True)
+    width = 2 * halo_w + seg
+
+    def body(blk):
+        send_f = blk[:, halo_w + seg - halo_w: halo_w + seg]
+        blk = blk.at[:, :halo_w].set(lax.ppermute(send_f, axis, fwd))
+        send_b = blk[:, halo_w: 2 * halo_w]
+        blk = blk.at[:, width - halo_w:].set(
+            lax.ppermute(send_b, axis, bwd))
+        return stencil_pallas.blocked_stencil_row(
+            blk, seg, halo_w, w, tsteps, chunk=chunk, interpret=interpret)
+
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axis
+    # annotation, which the default shard_map checker rejects
+    shm = jax.shard_map(body, mesh=cont.runtime.mesh,
+                        in_specs=P(axis, None), out_specs=P(axis, None),
+                        check_vma=False)
+    return jax.jit(shm)

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel: temporally-blocked 1-D stencil.
+
+The XLA path (algorithms/stencil.py) is HBM-bound: every step reads and
+writes the whole vector (2 x 4 bytes per element per step).  This kernel
+fuses ``T`` time steps per HBM pass: each grid chunk DMAs a window of
+``C + 2*T*r`` elements HBM->VMEM, applies the weighted stencil T times in
+VMEM (trapezoid scheme: the valid region shrinks by r per step, so the
+window overlap pays for the fusion), and writes back C elements — HBM
+traffic drops to ~(2 x 4 bytes) per element per T steps, an ~T-fold cut
+in the bandwidth bill.
+
+Cross-shard: the container's halo width must be >= T*r; one ppermute
+exchange per T-step block keeps ghosts fresh (algorithms/stencil.py
+handles the exchange; this kernel is the per-shard compute).
+
+Kernel shape notes (see /opt/skills/guides/pallas_guide.md): rows are
+(1, W) so the vector unit works along lanes; inputs stay in HBM/ANY and
+chunks are DMA'd manually (overlapping windows can't be expressed with
+disjoint BlockSpecs); weights are baked as Python floats (VPU immediate
+operands).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific namespace; absent on pure-CPU installs
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["blocked_stencil_row", "supported"]
+
+
+def supported() -> bool:
+    return _HAS_PLTPU
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@functools.lru_cache(maxsize=64)
+def _build(width: int, seg: int, halo: int, weights: tuple, tsteps: int,
+           chunk: int, dtype_name: str, interpret: bool):
+    """pallas_call computing ``tsteps`` stencil steps over one (1, width)
+    padded row; ghost cells must hold >= tsteps*r valid neighbor values."""
+    r = (len(weights) - 1) // 2
+    w = tuple(float(x) for x in weights)
+    dtype = jnp.dtype(dtype_name)
+    win = chunk + 2 * halo  # DMA window per chunk
+    nchunks = seg // chunk
+    assert seg % chunk == 0
+
+    def kernel(in_hbm, out_hbm, vin, vout, sem_in, sem_out):
+        i = pl.program_id(0)
+        start = i * chunk  # row coordinate of the window start
+        cp_in = pltpu.make_async_copy(
+            in_hbm.at[:, pl.ds(start, win)], vin, sem_in)
+        cp_in.start()
+        cp_in.wait()
+        x = vin[:, :]
+        # trapezoid: after step t, cells [r*(t+1), win - r*(t+1)) are valid
+        for t in range(tsteps):
+            core = x[:, 2 * r:] * w[2 * r]
+            for d in range(2 * r):
+                core = core + x[:, d:win - 2 * r + d] * w[d]
+            x = jnp.concatenate(
+                [x[:, :r], core, x[:, win - r:]], axis=1)
+        vout[:, :] = x[:, halo:halo + chunk]
+        cp_out = pltpu.make_async_copy(
+            vout, out_hbm.at[:, pl.ds(start + halo, chunk)], sem_out)
+        cp_out.start()
+        cp_out.wait()
+
+    grid = (nchunks,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((1, width), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, win), dtype),
+            pltpu.VMEM((1, chunk), dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        input_output_aliases={},
+        interpret=interpret,
+    )
+
+
+def blocked_stencil_row(row, seg: int, halo: int,
+                        weights: Sequence[float], tsteps: int,
+                        chunk: int = 8192, interpret: bool = False):
+    """Apply ``tsteps`` fused stencil steps to one padded (1, W) row.
+
+    ``row``: (1, halo + seg + halo) array; ghosts must be pre-exchanged
+    with width >= tsteps * r.  Returns the new row: owned cells hold the
+    stepped values, ghost cells are passed through stale (re-exchange
+    before the next block).  ``seg`` must be a multiple of ``chunk``
+    (callers pad; see algorithms/stencil.py fused path).
+    """
+    if not _HAS_PLTPU:
+        raise RuntimeError("pallas TPU namespace unavailable")
+    r = (len(weights) - 1) // 2
+    assert halo >= tsteps * r, "halo narrower than the fused time block"
+    width = row.shape[-1]
+    assert width == 2 * halo + seg
+    if seg % chunk:
+        chunk = int(np.gcd(seg, chunk)) or seg
+    fn = _build(width, seg, halo, tuple(float(x) for x in weights),
+                tsteps, chunk, str(row.dtype), interpret)
+    out = fn(row.reshape(1, width))
+    # ghost regions: carry the input's values through
+    out = out.at[:, :halo].set(row.reshape(1, width)[:, :halo])
+    out = out.at[:, width - halo:].set(
+        row.reshape(1, width)[:, width - halo:])
+    return out.reshape(row.shape)
