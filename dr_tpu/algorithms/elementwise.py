@@ -340,10 +340,12 @@ def _zip_foreach_program(ins, outs, fn, alias):
 
 
 def to_numpy(r) -> np.ndarray:
-    """Materialize a distributed range on the host (test-oracle path)."""
+    """Materialize a distributed range on the host (test-oracle path).
+    Valid on every process in multi-host runs (utils/host.py)."""
+    from ..utils.host import to_host
     if hasattr(r, "to_array"):
         arr = r.to_array()
         if isinstance(arr, tuple):
-            return tuple(np.asarray(a) for a in arr)
-        return np.asarray(arr)
+            return tuple(to_host(a) for a in arr)
+        return to_host(arr)
     return np.asarray(r)

@@ -153,7 +153,8 @@ class distributed_vector:
 
     # -- segment plumbing used by Segment ----------------------------------
     def _host_values(self, begin: int, end: int) -> np.ndarray:
-        return np.asarray(self.to_array()[begin:end])
+        from ..utils.host import to_host
+        return to_host(self.to_array()[begin:end])
 
     def _local_values(self, rank: int, begin: int, end: int):
         lo = rank * self._seg
@@ -219,7 +220,8 @@ class distributed_vector:
         return iter(np.asarray(self.to_array()))
 
     def materialize(self) -> np.ndarray:
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def block_until_ready(self) -> "distributed_vector":
         jax.block_until_ready(self._data)

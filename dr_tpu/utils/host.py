@@ -1,0 +1,23 @@
+"""Host materialization that works in multi-process (multi-host) runs.
+
+Single-controller: shards are all addressable and ``np.asarray`` works.
+Under ``jax.distributed`` each process holds only its shards, so global
+reads go through ``process_allgather`` — the analog of the reference's
+gather-to-root, except the result is valid on every process.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_host"]
+
+
+def to_host(arr) -> np.ndarray:
+    import jax
+    if not hasattr(arr, "is_fully_addressable"):
+        return np.asarray(arr)
+    if jax.process_count() == 1 or arr.is_fully_addressable:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

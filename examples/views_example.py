@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Views tour: take/drop/slice/zip/transform/enumerate over distributed
+vectors (reference examples/shp/{zip,take}*.cpp, examples/mhp/views).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import dr_tpu
+    from dr_tpu import views
+
+    dr_tpu.init()
+    n = 1 << 10
+    a = dr_tpu.distributed_vector(n)
+    b = dr_tpu.distributed_vector(n)
+    dr_tpu.iota(a, 0)
+    dr_tpu.fill(b, 1.0)
+
+    taken = a | views.take(100)
+    assert len(taken) == 100
+
+    sl = a | views.slice_view((10, 20))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(sl),
+                                  np.arange(10, 20, dtype=np.float32))
+
+    doubled = a | views.transform(lambda x: 2 * x)
+    assert dr_tpu.reduce(doubled) == float(np.arange(n, dtype=np.float64)
+                                           .sum() * 2)
+
+    z = views.zip_view(a, b)
+    assert dr_tpu.aligned(a, b)
+    c = dr_tpu.distributed_vector(n)
+    dr_tpu.transform(z, c, lambda x, y: x + y)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(c),
+                                  np.arange(n, dtype=np.float32) + 1)
+
+    first = list(views.enumerate_view(a | views.take(3)))
+    assert first == [(0, 0.0), (1, 1.0), (2, 2.0)]
+
+    dr_tpu.print_range(a | views.take(8), "a[:8]")
+    print("views example: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Worker for the multi-process (MHP-dimension) smoke test.
+
+Each process initializes jax.distributed, joins the global mesh, and runs
+the same collective program — the SPMD discipline of the reference's
+MPI backend (every rank calls every collective in the same order).
+Usage: python multihost_worker.py <pid> <nproc> <port>
+"""
+
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import dr_tpu  # noqa: E402
+import numpy as np  # noqa: E402
+
+dr_tpu.init_distributed(f"localhost:{port}", nproc, pid)
+assert dr_tpu.nprocs() == nproc
+
+n = 4 * nproc
+dv = dr_tpu.distributed_vector(n, dtype=np.float32)
+dr_tpu.iota(dv, 1)
+
+total = dr_tpu.reduce(dv)
+assert total == n * (n + 1) / 2, total
+
+out = dr_tpu.distributed_vector(n)
+dr_tpu.inclusive_scan(dv, out)
+got = dr_tpu.to_numpy(out)
+np.testing.assert_allclose(got, np.cumsum(np.arange(1, n + 1)), rtol=1e-5)
+
+hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+sv = dr_tpu.distributed_vector(n, dtype=np.float32, halo=hb)
+w = dr_tpu.distributed_vector(n, dtype=np.float32, halo=hb)
+src = np.arange(n, dtype=np.float32)
+sv.assign_array(src)
+w.assign_array(src)
+res = dr_tpu.stencil_iterate(sv, w, [0.25, 0.5, 0.25], steps=2)
+vals = dr_tpu.to_numpy(res)
+assert np.isfinite(vals).all()
+
+print(f"MULTIHOST-OK pid={pid} reduce={total} scan_last={got[-1]}",
+      flush=True)

@@ -1,0 +1,51 @@
+"""Multi-process SPMD execution — the MHP/DCN dimension.
+
+The reference tests its MPI backend under mpiexec at several rank counts
+(test/gtest/mhp/CMakeLists.txt:27-33); here two OS processes join a
+jax.distributed coordinator and run the same collective program over the
+global mesh.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = Path(__file__).resolve().parent / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_two_process_spmd(nproc):
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""  # one local device per process
+    repo = str(WORKER.parent.parent)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(pid), str(nproc), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=WORKER.parent.parent)
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-2000:]}"
+        assert "MULTIHOST-OK" in out
