@@ -93,7 +93,8 @@ class MatrixTileSegment:
         return (self.re - self.rb) * (self.ce - self.cb)
 
     def materialize(self) -> np.ndarray:
-        return np.asarray(
+        from ..utils.host import to_host
+        return to_host(
             self.base.to_array()[self.rb:self.re, self.cb:self.ce])
 
     def __iter__(self):
@@ -219,7 +220,8 @@ class dense_matrix:
         return mat
 
     def materialize(self) -> np.ndarray:
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def _local_tile(self, rank, rb, re, cb, ce):
         # block mode: each device owns exactly one shard

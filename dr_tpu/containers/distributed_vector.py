@@ -217,7 +217,7 @@ class distributed_vector:
         self.put(jnp.asarray([i]), jnp.asarray([value], self._dtype))
 
     def __iter__(self):
-        return iter(np.asarray(self.to_array()))
+        return iter(self.materialize())
 
     def materialize(self) -> np.ndarray:
         from ..utils.host import to_host

@@ -60,8 +60,9 @@ class MdTileSegment:
         return n
 
     def materialize(self) -> np.ndarray:
+        from ..utils.host import to_host
         sl = tuple(slice(b, e) for b, e in self.box)
-        return np.asarray(self.base.to_array()[sl])
+        return to_host(self.base.to_array()[sl])
 
     def __repr__(self):
         return f"MdTileSegment(rank={self._rank}, box={self.box})"
@@ -205,7 +206,8 @@ class distributed_mdarray:
         return md
 
     def materialize(self) -> np.ndarray:
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def mdspan(self) -> "distributed_mdspan":
         return distributed_mdspan(
@@ -291,7 +293,8 @@ class distributed_mdspan:
         return self.base.to_array()[sl]
 
     def materialize(self) -> np.ndarray:
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def __repr__(self):
         return f"distributed_mdspan(box={self.box})"

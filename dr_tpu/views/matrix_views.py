@@ -50,7 +50,8 @@ class dense_matrix_view:
         return self.base.to_array()[self.rb:self.re, self.cb:self.ce]
 
     def materialize(self) -> np.ndarray:
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def row(self, i: int) -> "matrix_row_view":
         return matrix_row_view(self.base, self.rb + i, self.cb, self.ce)
@@ -79,7 +80,8 @@ class matrix_row_view:
         return self.base.to_array()[self.i, self.cb:self.ce]
 
     def materialize(self):
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def __iter__(self):
         return iter(self.materialize())
@@ -104,7 +106,8 @@ class matrix_column_view:
         return self.base.to_array()[self.rb:self.re, self.j]
 
     def materialize(self):
-        return np.asarray(self.to_array())
+        from ..utils.host import to_host
+        return to_host(self.to_array())
 
     def __iter__(self):
         return iter(self.materialize())

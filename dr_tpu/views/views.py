@@ -81,10 +81,11 @@ class _ViewBase:
         raise NotImplementedError
 
     def materialize(self):
+        from ..utils.host import to_host
         arr = self.to_array()
         if isinstance(arr, tuple):
-            return tuple(np.asarray(a) for a in arr)
-        return np.asarray(arr)
+            return tuple(to_host(a) for a in arr)
+        return to_host(arr)
 
     def __iter__(self):
         m = self.materialize()

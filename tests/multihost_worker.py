@@ -42,5 +42,12 @@ res = dr_tpu.stencil_iterate(sv, w, [0.25, 0.5, 0.25], steps=2)
 vals = dr_tpu.to_numpy(res)
 assert np.isfinite(vals).all()
 
+# iteration and matrix materialization must also be valid on every process
+assert list(dv)[0] == 1.0
+mat = dr_tpu.dense_matrix((2 * nproc, 3), dtype=np.float32,
+                          partition=dr_tpu.row_tiles())
+m_host = mat.materialize()
+assert m_host.shape == (2 * nproc, 3)
+
 print(f"MULTIHOST-OK pid={pid} reduce={total} scan_last={got[-1]}",
       flush=True)

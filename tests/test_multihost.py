@@ -37,15 +37,28 @@ def test_two_process_spmd(nproc):
             env=env, cwd=WORKER.parent.parent)
         for pid in range(nproc)
     ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=300)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    import threading
+
+    outs = [None] * nproc
+
+    def drain(i, p):
+        outs[i], _ = p.communicate()
+
+    threads = [threading.Thread(target=drain, args=(i, p))
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+    deadline = 300
+    for t in threads:
+        t.join(timeout=deadline)
+    # a dead worker leaves its peer blocked in a collective: kill
+    # stragglers so every worker's own output is still reported
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for t in threads:
+        t.join(timeout=30)
     for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-2000:]}"
-        assert "MULTIHOST-OK" in out
+        assert p.returncode == 0, \
+            f"proc {pid} failed:\n{(out or '')[-2000:]}"
+        assert "MULTIHOST-OK" in (out or "")
