@@ -1,0 +1,120 @@
+"""Ring attention: sequence-parallel attention over the mesh ring.
+
+The reference's halo subsystem is the structural substrate of
+context/sequence parallelism (SURVEY.md §5 "Long-context"): 1-D
+partitioned data with ring-shaped neighbor exchange.  This op makes the
+long-context capability first-class: Q/K/V are sharded over the sequence
+axis of the mesh, each shard computes blockwise attention against the K/V
+block it currently holds, and K/V blocks rotate around the ring with
+``lax.ppermute`` (ICI neighbor traffic) — compute on block i overlaps the
+transfer of block i+1, the classic ring-attention schedule (Liu et al.;
+the same shift pattern as parallel/halo.py).
+
+Numerically-stable online softmax (flash-style running max/denominator)
+keeps memory at O(block) regardless of total sequence length; the causal
+variant masks by GLOBAL positions so results match single-device
+attention exactly.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import runtime as _rt
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+_cache: dict = {}
+
+
+def _build(mesh, axis, nshards, shape, causal, dtype):
+    B, s, h, d = shape  # local block: (batch, seq_shard, heads, head_dim)
+    scale = 1.0 / math.sqrt(d)
+    ring = [(i, (i + 1) % nshards) for i in range(nshards)]
+
+    def body(q, k, v):
+        my = lax.axis_index(axis)
+        q_pos = my * s + jnp.arange(s)
+        m = jnp.full((B, h, s), -jnp.inf, jnp.float32)
+        l = jnp.zeros((B, h, s), jnp.float32)
+        acc = jnp.zeros((B, h, s, d), jnp.float32)
+
+        def step(t, carry):
+            m, l, acc, k_blk, v_blk = carry
+            src = (my - t) % nshards  # whose block we hold this round
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                                preferred_element_type=jnp.float32) * scale
+            if causal:
+                k_pos = src * s + jnp.arange(s)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                logits = jnp.where(mask[None, None], logits, -jnp.inf)
+            blk_max = jnp.max(logits, axis=-1)
+            new_m = jnp.maximum(m, blk_max)
+            # guard fully-masked rows (new_m == -inf)
+            safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+            p = jnp.exp(logits - safe_m[..., None])
+            p = jnp.where(jnp.isfinite(logits), p, 0.0)
+            correction = jnp.where(jnp.isfinite(m),
+                                   jnp.exp(m - safe_m), 0.0)
+            l = l * correction + jnp.sum(p, axis=-1)
+            acc = acc * correction[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v_blk,
+                preferred_element_type=jnp.float32)
+            # rotate K/V around the ring for the next round
+            k_blk = lax.ppermute(k_blk, axis, ring)
+            v_blk = lax.ppermute(v_blk, axis, ring)
+            return new_m, l, acc, k_blk, v_blk
+
+        carry = (m, l, acc, k, v)
+        for t in range(nshards):  # static unroll: overlaps compute + ICI
+            carry = step(t, carry)
+        m, l, acc, _, _ = carry
+        safe_l = jnp.where(l > 0, l, 1.0)
+        out = (acc / safe_l[..., None]).astype(dtype)
+        return jnp.einsum("bhqd->bqhd", out)
+
+    shm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis))
+    return jax.jit(shm)
+
+
+def ring_attention(q, k, v, *, causal: bool = False, runtime=None):
+    """Sequence-parallel attention.
+
+    q/k/v: (batch, seq, heads, head_dim) jax arrays; ``seq`` is sharded
+    over the mesh axis (the function shards unsharded inputs).  Returns
+    the attention output with the same sharding.
+    """
+    rt = runtime or _rt.runtime()
+    B, S, h, d = q.shape
+    nshards = rt.nprocs
+    assert S % nshards == 0, "seq length must divide the mesh"
+    sharding = NamedSharding(rt.mesh, P(None, rt.axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    key = ("ringattn", id(rt.mesh), (B, S // nshards, h, d), causal,
+           str(q.dtype))
+    prog = _cache.get(key)
+    if prog is None:
+        prog = _build(rt.mesh, rt.axis, nshards,
+                      (B, S // nshards, h, d), causal, q.dtype)
+        _cache[key] = prog
+    return prog(q, k, v)
+
+
+def ring_self_attention(x, wq, wk, wv, *, causal: bool = False,
+                        runtime=None):
+    """Convenience: project + ring-attend. x: (B, S, h*d) sharded on S."""
+    B, S, hd = x.shape
+    h, d = wq.shape[1], wq.shape[2]
+    proj = lambda w: jnp.einsum("bse,ehd->bshd", x, w)
+    return ring_attention(proj(wq), proj(wk), proj(wv), causal=causal,
+                          runtime=runtime)

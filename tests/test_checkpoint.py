@@ -1,0 +1,49 @@
+"""Checkpoint round-trip tests (capability beyond the reference, which
+has no serialization — SURVEY.md §5)."""
+
+import numpy as np
+
+import dr_tpu
+from dr_tpu.utils import checkpoint
+
+
+def test_vector_roundtrip(tmp_path):
+    src = np.random.default_rng(0).standard_normal(37).astype(np.float32)
+    dv = dr_tpu.distributed_vector.from_array(
+        src, halo=dr_tpu.halo_bounds(1, 1))
+    p = tmp_path / "vec.npz"
+    checkpoint.save(str(p), dv)
+    back = checkpoint.load(str(p))
+    np.testing.assert_allclose(back.materialize(), src)
+    assert back.halo_bounds == dv.halo_bounds
+
+
+def test_dense_matrix_roundtrip(tmp_path):
+    src = np.random.default_rng(1).standard_normal((9, 7))\
+        .astype(np.float32)
+    mat = dr_tpu.dense_matrix.from_array(src)
+    p = tmp_path / "mat.npz"
+    checkpoint.save(str(p), mat)
+    back = checkpoint.load(str(p))
+    np.testing.assert_allclose(back.materialize(), src)
+
+
+def test_sparse_roundtrip(tmp_path):
+    d = np.zeros((16, 8), np.float32)
+    d[3, 2] = 1.5
+    d[15, 7] = -2.0
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    p = tmp_path / "sp.npz"
+    checkpoint.save(str(p), sp)
+    back = checkpoint.load(str(p))
+    np.testing.assert_allclose(back.to_dense(), d)
+
+
+def test_mdarray_roundtrip(tmp_path):
+    src = np.random.default_rng(2).standard_normal((4, 5, 3))\
+        .astype(np.float32)
+    md = dr_tpu.distributed_mdarray.from_array(src)
+    p = tmp_path / "md.npz"
+    checkpoint.save(str(p), md)
+    back = checkpoint.load(str(p))
+    np.testing.assert_allclose(back.materialize(), src)
