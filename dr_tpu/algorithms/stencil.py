@@ -161,11 +161,19 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
                                   hb.prev, hb.next, cont.runtime.axis)
 
         def loop(a, b):
-            def one(i, ab):
+            # Two steps per iteration keep the carry order (a, b) stable:
+            # a swapped carry forces XLA to copy both arrays every
+            # iteration (2x HBM traffic and 2x peak memory).
+            def two(i, ab):
                 x, y = ab
                 y = step(x, y)
-                return (y, x)
-            return lax.fori_loop(0, steps, one, (a, b))
+                x = step(y, x)
+                return (x, y)
+            a, b = lax.fori_loop(0, steps // 2, two, (a, b))
+            if steps % 2:
+                b = step(a, b)
+                a, b = b, a
+            return a, b
 
         shmapped = jax.shard_map(
             loop, mesh=cont.runtime.mesh,
@@ -179,7 +187,7 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
 
 
 def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
-                            chunk: int = 8192, interpret=None):
+                            chunk: int = 2 ** 17, interpret=None):
     """Temporally-blocked stencil: T steps fused per HBM pass via the
     Pallas kernel (ops/stencil_pallas.py), with ONE ppermute halo exchange
     per T-step block instead of per step — both the HBM traffic and the
@@ -190,8 +198,6 @@ def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
     shards (n divisible by nshards * segment alignment).  Returns ``dv``
     stepped ``steps`` times.
     """
-    from ..ops import stencil_pallas
-
     cont = dv
     hb = cont.halo_bounds
     r = (len(weights) - 1) // 2
@@ -200,6 +206,10 @@ def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
     assert prev == nxt and prev >= time_block * r, \
         "halo width must cover time_block * radius"
     assert n == nshards * seg, "blocked stencil needs equal full shards"
+    # one ppermute hop supplies at most seg fresh neighbor elements; a
+    # deeper time block would read the sender's own stale ghosts
+    assert time_block * r <= seg, \
+        "time_block * radius exceeds the per-shard segment"
     if interpret is None:
         interpret = cont.runtime.devices[0].platform != "tpu"
 
@@ -245,4 +255,6 @@ def _make_blocked_prog(cont, weights, tsteps, chunk, interpret):
     shm = jax.shard_map(body, mesh=cont.runtime.mesh,
                         in_specs=P(axis, None), out_specs=P(axis, None),
                         check_vma=False)
-    return jax.jit(shm)
+    # donation lets the ghost-column updates write in place instead of
+    # copying the whole padded row per T-step block
+    return jax.jit(shm, donate_argnums=0)

@@ -1,23 +1,29 @@
 """Pallas TPU kernel: temporally-blocked 1-D stencil.
 
 The XLA path (algorithms/stencil.py) is HBM-bound: every step reads and
-writes the whole vector (2 x 4 bytes per element per step).  This kernel
-fuses ``T`` time steps per HBM pass: each grid chunk DMAs a window of
-``C + 2*T*r`` elements HBM->VMEM, applies the weighted stencil T times in
-VMEM (trapezoid scheme: the valid region shrinks by r per step, so the
-window overlap pays for the fusion), and writes back C elements — HBM
-traffic drops to ~(2 x 4 bytes) per element per T steps, an ~T-fold cut
-in the bandwidth bill.
+writes the whole vector (2 x 4 bytes per element per step) and the
+overlapping shifted-slice reads are not deduplicated.  This kernel fuses
+``T`` time steps per HBM pass: each chunk is DMA'd HBM->VMEM once
+(double-buffered, overlapping DMA with compute), stepped T times in VMEM,
+and written back once — HBM traffic drops to ~(2 x 4 bytes) per element
+per T steps.
+
+TPU-native layout: the padded shard row (1, width) is viewed as
+(width/128, 128) so every vreg is a full (8, 128) f32 tile (a (1, W) row
+wastes 7/8 of each vreg's sublanes).  The flat 1-D shift x[i+s] becomes a
+lane roll plus a sublane roll patching the wrapped lanes:
+
+    B[r, l] = x[r, l+s]            l <  128-s   (lane roll)
+    B[r, l] = x[r+1, l+s-128]      l >= 128-s   (row roll of the above)
 
 Cross-shard: the container's halo width must be >= T*r; one ppermute
 exchange per T-step block keeps ghosts fresh (algorithms/stencil.py
 handles the exchange; this kernel is the per-shard compute).
 
-Kernel shape notes (see /opt/skills/guides/pallas_guide.md): rows are
-(1, W) so the vector unit works along lanes; inputs stay in HBM/ANY and
-chunks are DMA'd manually (overlapping windows can't be expressed with
-disjoint BlockSpecs); weights are baked as Python floats (VPU immediate
-operands).
+Geometry (Mosaic tiling: f32 tiles are (8, 128), DMA slices must be
+tile-aligned): halo % 1024 == 0 and seg % 1024 == 0 so windows start and
+end on whole (8, 128) tiles.  Reference workload this accelerates:
+``examples/mhp/stencil-1d.cpp:47-66``.
 """
 
 from __future__ import annotations
@@ -27,7 +33,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+from jax import lax
 
 from jax.experimental import pallas as pl
 
@@ -38,92 +44,161 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-__all__ = ["blocked_stencil_row", "supported"]
+__all__ = ["blocked_stencil_row", "supported", "LANES", "ROW_ALIGN"]
+
+LANES = 128
+SUBLANES = 8
+ROW_ALIGN = LANES * SUBLANES  # 1024: whole (8, 128) f32 tiles
 
 
 def supported() -> bool:
     return _HAS_PLTPU
 
 
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+def _flat_shift(x, s: int, interpret: bool):
+    """B[f] = x_flat[f + s] over the row-major flattening of (R, 128).
+
+    The wrapped tail/head rows hold garbage — callers keep a trapezoid
+    margin (the halo rows) around the trusted core.
+    """
+    if s == 0:
+        return x
+    if interpret:
+        roll = jnp.roll
+    else:
+        # pltpu.roll wants non-negative shifts; roll(x, -k) == roll(x, d-k)
+        def roll(u, k, axis):
+            return pltpu.roll(u, k % u.shape[axis], axis=axis)
+    lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    if s > 0:
+        a = roll(x, -s, axis=1)
+        b = roll(a, -1, axis=0)
+        return jnp.where(lane < LANES - s, a, b)
+    a = roll(x, -s, axis=1)
+    b = roll(a, 1, axis=0)
+    return jnp.where(lane >= -s, a, b)
 
 
 @functools.lru_cache(maxsize=64)
 def _build(width: int, seg: int, halo: int, weights: tuple, tsteps: int,
            chunk: int, dtype_name: str, interpret: bool):
-    """pallas_call computing ``tsteps`` stencil steps over one (1, width)
-    padded row; ghost cells must hold >= tsteps*r valid neighbor values."""
+    """pallas_call stepping one (width/128, 128) padded row ``tsteps``
+    times; ghost cells must hold >= tsteps*r valid neighbor values."""
     r = (len(weights) - 1) // 2
     w = tuple(float(x) for x in weights)
     dtype = jnp.dtype(dtype_name)
-    win = chunk + 2 * halo  # DMA window per chunk
-    nchunks = seg // chunk
-    assert seg % chunk == 0
+    assert halo % ROW_ALIGN == 0 and seg % ROW_ALIGN == 0, (
+        f"blocked stencil needs seg ({seg}) and halo ({halo}) aligned "
+        f"to {ROW_ALIGN} (whole (8,128) f32 tiles)")
+    assert halo >= tsteps * r, "halo narrower than the fused time block"
+    rows_total = width // LANES
+    seg_rows = seg // LANES
+    hr = halo // LANES
+    # chunk rows: largest tile-aligned divisor of seg_rows <= chunk/128
+    crows = min(max(chunk // LANES, SUBLANES), seg_rows)
+    crows -= crows % SUBLANES
+    while seg_rows % crows:
+        crows -= SUBLANES
+    nchunks = seg_rows // crows
+    wrows = crows + 2 * hr
 
-    def kernel(in_hbm, out_hbm, vin, vout, sem_in, sem_out):
+    def weighted(u):
+        acc = _flat_shift(u, -r, interpret) * w[0]
+        for d in range(1, 2 * r + 1):
+            acc = acc + _flat_shift(u, d - r, interpret) * w[d]
+        return acc.astype(dtype)
+
+    def kernel(in_hbm, out_hbm, vin, vout, in_sem, out_sem, gsem):
         i = pl.program_id(0)
-        start = i * chunk  # row coordinate of the window start
-        cp_in = pltpu.make_async_copy(
-            in_hbm.at[:, pl.ds(start, win)], vin, sem_in)
-        cp_in.start()
-        cp_in.wait()
-        x = vin[:, :]
-        # trapezoid: after step t, cells [r*(t+1), win - r*(t+1)) are valid
-        for t in range(tsteps):
-            core = x[:, 2 * r:] * w[2 * r]
-            for d in range(2 * r):
-                core = core + x[:, d:win - 2 * r + d] * w[d]
-            x = jnp.concatenate(
-                [x[:, :r], core, x[:, win - r:]], axis=1)
-        vout[:, :] = x[:, halo:halo + chunk]
-        cp_out = pltpu.make_async_copy(
-            vout, out_hbm.at[:, pl.ds(start + halo, chunk)], sem_out)
-        cp_out.start()
-        cp_out.wait()
+        slot = lax.rem(i, 2)
 
-    grid = (nchunks,)
+        def in_dma(c, s):
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(c * crows, wrows), :],
+                vin.at[s], in_sem.at[s])
+
+        def out_dma(c, s):
+            return pltpu.make_async_copy(
+                vout.at[s],
+                out_hbm.at[pl.ds(hr + c * crows, crows), :],
+                out_sem.at[s])
+
+        @pl.when(i == 0)
+        def _():
+            in_dma(0, 0).start()
+
+        @pl.when(i + 1 < nchunks)
+        def _():
+            in_dma(i + 1, 1 - slot).start()
+
+        in_dma(i, slot).wait()
+
+        # the out-DMA that used this vout slot two chunks ago must be done
+        @pl.when(i >= 2)
+        def _():
+            out_dma(i - 2, slot).wait()
+
+        x = vin[slot]
+        x = lax.fori_loop(0, tsteps, lambda t, u: weighted(u), x)
+        vout[slot] = x[hr:hr + crows, :]
+        out_dma(i, slot).start()
+
+        # ghost rows pass through unchanged (stale until next exchange)
+        @pl.when(i == 0)
+        def _():
+            g = pltpu.make_async_copy(
+                vin.at[0, pl.ds(0, hr), :],
+                out_hbm.at[pl.ds(0, hr), :], gsem)
+            g.start()
+            g.wait()
+
+        @pl.when(i == nchunks - 1)
+        def _():
+            g = pltpu.make_async_copy(
+                vin.at[slot, pl.ds(wrows - hr, hr), :],
+                out_hbm.at[pl.ds(rows_total - hr, hr), :], gsem)
+            g.start()
+            g.wait()
+            out_dma(i, slot).wait()
+
+        if nchunks > 1:
+            @pl.when(i == nchunks - 1)
+            def _():
+                out_dma(i - 1, 1 - slot).wait()
+
     return pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(nchunks,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        out_shape=jax.ShapeDtypeStruct((1, width), dtype),
+        out_shape=jax.ShapeDtypeStruct((rows_total, LANES), dtype),
         scratch_shapes=[
-            pltpu.VMEM((1, win), dtype),
-            pltpu.VMEM((1, chunk), dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, wrows, LANES), dtype),
+            pltpu.VMEM((2, crows, LANES), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
         ],
-        input_output_aliases={},
         interpret=interpret,
     )
 
 
 def blocked_stencil_row(row, seg: int, halo: int,
                         weights: Sequence[float], tsteps: int,
-                        chunk: int = 8192, interpret: bool = False):
+                        chunk: int = 2 ** 17, interpret: bool = False):
     """Apply ``tsteps`` fused stencil steps to one padded (1, W) row.
 
     ``row``: (1, halo + seg + halo) array; ghosts must be pre-exchanged
     with width >= tsteps * r.  Returns the new row: owned cells hold the
     stepped values, ghost cells are passed through stale (re-exchange
-    before the next block).  ``seg`` must be a multiple of ``chunk``
-    (callers pad; see algorithms/stencil.py fused path).
+    before the next block).  Geometry: seg and halo must be multiples of
+    ``ROW_ALIGN`` (1024) — whole (8, 128) f32 tiles.
     """
     if not _HAS_PLTPU:
         raise RuntimeError("pallas TPU namespace unavailable")
-    r = (len(weights) - 1) // 2
-    assert halo >= tsteps * r, "halo narrower than the fused time block"
     width = row.shape[-1]
     assert width == 2 * halo + seg
-    if seg % chunk:
-        chunk = int(np.gcd(seg, chunk)) or seg
     fn = _build(width, seg, halo, tuple(float(x) for x in weights),
                 tsteps, chunk, str(row.dtype), interpret)
-    out = fn(row.reshape(1, width))
-    # ghost regions: carry the input's values through
-    out = out.at[:, :halo].set(row.reshape(1, width)[:, :halo])
-    out = out.at[:, width - halo:].set(
-        row.reshape(1, width)[:, width - halo:])
+    out = fn(row.reshape(width // LANES, LANES))
     return out.reshape(row.shape)

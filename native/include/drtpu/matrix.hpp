@@ -1,0 +1,310 @@
+// Tiled distributed matrices on the host executor: block-cyclic partitions,
+// dense matrices, CSR sparse matrices, and gemv/gemm.
+//
+// Native equivalents of the reference's SHP matrix stack —
+// `matrix_partition`/`block_cyclic` with near-square grid factorization
+// (shp/containers/matrix_partition.hpp:23-86, detail.hpp:15-24),
+// `dense_matrix` (one tile per grid cell placed by tile_rank,
+// dense_matrix.hpp:245-263), `sparse_matrix` (per-tile CSR triples,
+// sparse_matrix.hpp:344-349), and `gemv` (row-tiled SpMV with replicated b,
+// gemv.hpp:45-66).  Re-designed for value-descriptor segments: a tile is a
+// `matrix_tile` descriptor (rank, global offsets, shape, leading dimension,
+// host span) — the same tiled layout the TPU path shards over a 2-D mesh
+// view (dr_tpu/containers/dense_matrix.py).
+#pragma once
+
+#include <cassert>
+#include <cstddef>
+#include <memory>
+#include <span>
+#include <vector>
+
+#include "vocabulary.hpp"
+
+namespace drtpu {
+
+struct index2d {
+  std::size_t i = 0, j = 0;
+  bool operator==(const index2d&) const = default;
+};
+
+// Near-square factorization of p (shp/containers/detail.hpp:15-24).
+inline index2d factor_grid(std::size_t p) {
+  std::size_t a = 1;
+  for (std::size_t d = 1; d * d <= p; ++d)
+    if (p % d == 0) a = d;
+  return {p / a, a};
+}
+
+// Block-cyclic placement: tile (ti, tj) lives on rank
+// grid[(ti % gi) * gj + (tj % gj)]  (matrix_partition.hpp:34-63).
+class block_cyclic {
+ public:
+  explicit block_cyclic(index2d grid) : grid_(grid) {}
+  explicit block_cyclic(std::size_t nprocs) : grid_(factor_grid(nprocs)) {}
+
+  index2d grid_shape() const { return grid_; }
+  std::size_t tile_rank(index2d tile) const {
+    return (tile.i % grid_.i) * grid_.j + (tile.j % grid_.j);
+  }
+
+ private:
+  index2d grid_;
+};
+
+// row_tiles: 1-D row-stripe partition (grid (p, 1)) — the layout the
+// reference's gemv asserts (gemv.hpp:21 grid_shape[1]==1).
+inline block_cyclic row_tiles(std::size_t nprocs) {
+  return block_cyclic(index2d{nprocs, 1});
+}
+
+// One dense tile: (rank, global row/col origin, shape, leading dim, data).
+template <class T>
+class matrix_tile {
+ public:
+  matrix_tile() = default;
+  matrix_tile(std::size_t rank, index2d origin, index2d shape,
+              std::size_t ld, T* data)
+      : rank_(rank), origin_(origin), shape_(shape), ld_(ld), data_(data) {}
+
+  std::size_t dr_rank() const { return rank_; }
+  std::span<T> dr_local() const {
+    return {data_, (shape_.i - 1) * ld_ + shape_.j};
+  }
+  index2d origin() const { return origin_; }
+  index2d shape() const { return shape_; }
+  std::size_t ld() const { return ld_; }
+  std::size_t size() const { return shape_.i * shape_.j; }
+  bool empty() const { return size() == 0; }
+
+  T& operator()(std::size_t i, std::size_t j) const {
+    return data_[i * ld_ + j];
+  }
+  // row-slice of the tile (dense_matrix_view row slicing surface)
+  std::span<T> row(std::size_t i) const { return {data_ + i * ld_, shape_.j}; }
+
+ private:
+  std::size_t rank_ = 0;
+  index2d origin_{}, shape_{};
+  std::size_t ld_ = 0;
+  T* data_ = nullptr;
+};
+
+template <class T>
+class dense_matrix {
+ public:
+  using value_type = T;
+
+  dense_matrix(index2d shape, index2d tile_shape, block_cyclic part)
+      : shape_(shape), tshape_(tile_shape), part_(part) {
+    assert(tshape_.i && tshape_.j);
+    grid_ = {ceil_div(shape_.i, tshape_.i), ceil_div(shape_.j, tshape_.j)};
+    tiles_.resize(grid_.i * grid_.j);
+    for (std::size_t ti = 0; ti < grid_.i; ++ti)
+      for (std::size_t tj = 0; tj < grid_.j; ++tj)
+        tiles_[ti * grid_.j + tj].assign(
+            tile_rows(ti) * tile_cols(tj), T{});
+  }
+
+  // default: near-square grid over nprocs, one tile per grid cell
+  // (`tile::div` auto-tiling, matrix_partition.hpp:64-86)
+  dense_matrix(index2d shape, std::size_t nprocs)
+      : dense_matrix(shape,
+                     index2d{ceil_div(shape.i, factor_grid(nprocs).i),
+                             ceil_div(shape.j, factor_grid(nprocs).j)},
+                     block_cyclic(nprocs)) {}
+
+  index2d shape() const { return shape_; }
+  index2d grid_shape() const { return grid_; }
+  index2d tile_shape() const { return tshape_; }
+  std::size_t size() const { return shape_.i * shape_.j; }
+
+  std::size_t tile_rows(std::size_t ti) const {
+    return std::min(tshape_.i, shape_.i - ti * tshape_.i);
+  }
+  std::size_t tile_cols(std::size_t tj) const {
+    return std::min(tshape_.j, shape_.j - tj * tshape_.j);
+  }
+
+  matrix_tile<T> tile(index2d t) {
+    auto& buf = tiles_[t.i * grid_.j + t.j];
+    return {part_.tile_rank(t),
+            {t.i * tshape_.i, t.j * tshape_.j},
+            {tile_rows(t.i), tile_cols(t.j)},
+            tile_cols(t.j),
+            buf.data()};
+  }
+
+  std::vector<matrix_tile<T>> dr_segments() {
+    std::vector<matrix_tile<T>> out;
+    out.reserve(tiles_.size());
+    for (std::size_t ti = 0; ti < grid_.i; ++ti)
+      for (std::size_t tj = 0; tj < grid_.j; ++tj)
+        out.push_back(tile({ti, tj}));
+    return out;
+  }
+
+  T& operator()(std::size_t i, std::size_t j) {
+    index2d t{i / tshape_.i, j / tshape_.j};
+    return tile(t)(i % tshape_.i, j % tshape_.j);
+  }
+
+ private:
+  static std::size_t ceil_div(std::size_t a, std::size_t b) {
+    return (a + b - 1) / b;
+  }
+
+  index2d shape_, tshape_, grid_{};
+  block_cyclic part_;
+  std::vector<std::vector<T>> tiles_;
+};
+
+// --------------------------------------------------------------------------
+// CSR sparse matrix, row-striped (one CSR triple per row tile)
+// --------------------------------------------------------------------------
+
+template <class T, class I = std::size_t>
+struct csr_tile {
+  std::size_t rank = 0;
+  std::size_t row_origin = 0;
+  index2d shape{};
+  std::vector<T> values;
+  std::vector<I> rowptr;  // shape.i + 1 entries
+  std::vector<I> colind;
+
+  std::size_t dr_rank() const { return rank; }
+  std::size_t nnz() const { return values.size(); }
+};
+
+template <class T, class I = std::size_t>
+class sparse_matrix {
+ public:
+  using value_type = T;
+
+  // Build from COO triplets (row-major sorted not required).
+  sparse_matrix(index2d shape, std::size_t nprocs,
+                const std::vector<std::tuple<std::size_t, std::size_t, T>>&
+                    entries)
+      : shape_(shape), nprocs_(nprocs) {
+    std::size_t stripe = (shape.i + nprocs - 1) / nprocs;
+    stripe_ = stripe ? stripe : 1;
+    tiles_.resize(nprocs);
+    for (std::size_t r = 0; r < nprocs; ++r) {
+      auto& t = tiles_[r];
+      t.rank = r;
+      t.row_origin = r * stripe_;
+      std::size_t rows = t.row_origin < shape.i
+                             ? std::min(stripe_, shape.i - t.row_origin)
+                             : 0;
+      t.shape = {rows, shape.j};
+      t.rowptr.assign(rows + 1, 0);
+    }
+    // counting sort by (tile, local row)
+    for (auto& [i, j, v] : entries) {
+      auto& t = tiles_[i / stripe_];
+      ++t.rowptr[i - t.row_origin + 1];
+    }
+    for (auto& t : tiles_) {
+      for (std::size_t k = 1; k < t.rowptr.size(); ++k)
+        t.rowptr[k] += t.rowptr[k - 1];
+      t.values.resize(t.rowptr.back());
+      t.colind.resize(t.rowptr.back());
+    }
+    std::vector<std::vector<I>> cursor(nprocs);
+    for (std::size_t r = 0; r < nprocs; ++r)
+      cursor[r].assign(tiles_[r].rowptr.begin(), tiles_[r].rowptr.end());
+    for (auto& [i, j, v] : entries) {
+      auto& t = tiles_[i / stripe_];
+      I& c = cursor[i / stripe_][i - t.row_origin];
+      t.values[c] = v;
+      t.colind[c] = static_cast<I>(j);
+      ++c;
+    }
+  }
+
+  index2d shape() const { return shape_; }
+  std::size_t nnz() const {
+    std::size_t s = 0;
+    for (auto& t : tiles_) s += t.nnz();
+    return s;
+  }
+  std::size_t stripe() const { return stripe_; }
+  const std::vector<csr_tile<T, I>>& tiles() const { return tiles_; }
+  const csr_tile<T, I>& tile(std::size_t r) const { return tiles_[r]; }
+
+ private:
+  index2d shape_;
+  std::size_t nprocs_, stripe_ = 1;
+  std::vector<csr_tile<T, I>> tiles_;
+};
+
+// --------------------------------------------------------------------------
+// gemv / gemm
+// --------------------------------------------------------------------------
+
+// SpMV c += A * b, row-striped A; b replicated to every tile's executor
+// (the reference's replicated-b design, gemv.hpp:39-66) — on the host
+// executor replication is free, the accumulation contract is identical.
+template <class T, class I, class VecC, class VecB>
+void gemv(VecC&& c, const sparse_matrix<T, I>& a, const VecB& b) {
+  assert(std::ranges::size(b) >= a.shape().j);
+  for (auto& t : a.tiles()) {
+    for (std::size_t li = 0; li < t.shape.i; ++li) {
+      T acc{};
+      for (I k = t.rowptr[li]; k < t.rowptr[li + 1]; ++k)
+        acc += t.values[k] * b[t.colind[k]];
+      c[t.row_origin + li] += acc;
+    }
+  }
+}
+
+// Dense gemv over tiled A.
+template <class T, class VecC, class VecB>
+void gemv(VecC&& c, dense_matrix<T>& a, const VecB& b) {
+  for (auto& t : a.dr_segments()) {
+    for (std::size_t li = 0; li < t.shape().i; ++li) {
+      T acc{};
+      for (std::size_t lj = 0; lj < t.shape().j; ++lj)
+        acc += t(li, lj) * b[t.origin().j + lj];
+      c[t.origin().i + li] += acc;
+    }
+  }
+}
+
+// Dense C += A * B over tiles (the SUMMA traversal: every (Ci, k, Bj)
+// tile triple with a non-empty global-range intersection contributes; on
+// the TPU path this is the 2-D mesh matmul).  All element access goes
+// through tile-local spans — tilings of A, B, C need not match.
+template <class T>
+void gemm(dense_matrix<T>& c, dense_matrix<T>& a, dense_matrix<T>& b) {
+  assert(a.shape().j == b.shape().i);
+  assert(c.shape().i == a.shape().i && c.shape().j == b.shape().j);
+  auto a_tiles = a.dr_segments();
+  auto b_tiles = b.dr_segments();
+  for (auto& ct : c.dr_segments()) {
+    std::size_t ci0 = ct.origin().i, ci1 = ci0 + ct.shape().i;
+    std::size_t cj0 = ct.origin().j, cj1 = cj0 + ct.shape().j;
+    for (auto& at : a_tiles) {
+      std::size_t i0 = std::max(ci0, at.origin().i);
+      std::size_t i1 = std::min(ci1, at.origin().i + at.shape().i);
+      if (i0 >= i1) continue;
+      for (auto& bt : b_tiles) {
+        std::size_t j0 = std::max(cj0, bt.origin().j);
+        std::size_t j1 = std::min(cj1, bt.origin().j + bt.shape().j);
+        std::size_t k0 = std::max(at.origin().j, bt.origin().i);
+        std::size_t k1 = std::min(at.origin().j + at.shape().j,
+                                  bt.origin().i + bt.shape().i);
+        if (j0 >= j1 || k0 >= k1) continue;
+        for (std::size_t i = i0; i < i1; ++i)
+          for (std::size_t k = k0; k < k1; ++k) {
+            T av = at(i - at.origin().i, k - at.origin().j);
+            for (std::size_t j = j0; j < j1; ++j)
+              ct(i - ci0, j - cj0) +=
+                  av * bt(k - bt.origin().i, j - bt.origin().j);
+          }
+      }
+    }
+  }
+}
+
+}  // namespace drtpu

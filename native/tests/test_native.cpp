@@ -11,8 +11,10 @@
 #include <drtpu/algorithms.hpp>
 #include <drtpu/distributed_vector.hpp>
 #include <drtpu/iterator_adaptor.hpp>
+#include <drtpu/matrix.hpp>
 #include <drtpu/remote_span.hpp>
 #include <drtpu/segment_tools.hpp>
+#include <drtpu/views.hpp>
 #include <drtpu/vocabulary.hpp>
 
 using drtpu::distributed_vector;
@@ -180,6 +182,135 @@ static int test_regressions(std::size_t P) {
   return 0;
 }
 
+static int test_views(std::size_t P) {
+  namespace vw = drtpu::views;
+  distributed_vector<double> dv(40, P);
+  drtpu::iota(dv, 0.0);
+
+  // take/drop/subrange pipelines recompute segments
+  auto t = dv | vw::take(13);
+  static_assert(drtpu::distributed_range<decltype(t)>);
+  CHECK(t.size() == 13);
+  CHECK(drtpu::reduce(t, 0.0) == 12.0 * 13.0 / 2.0);
+  auto d = dv | vw::drop(35);
+  CHECK(d.size() == 5);
+  CHECK(*d.begin() == 35.0);
+  auto sub = dv | vw::subrange(10, 20);
+  CHECK(drtpu::reduce(sub, 0.0) == (10.0 + 19.0) * 10.0 / 2.0);
+  // segments join back to the view (check_segments invariant)
+  double joined = 0;
+  std::size_t count = 0;
+  for (auto& s : drtpu::segments(sub))
+    for (auto&& v : drtpu::local(s)) { joined += v; ++count; }
+  CHECK(count == 10 && joined == drtpu::reduce(sub, 0.0));
+
+  // transform stays distributed; transform | reduce == transform_reduce
+  auto sq = dv | vw::transform([](double x) { return x * x; });
+  CHECK(drtpu::segments(sq).size() == drtpu::segments(dv).size());
+  double ssq = drtpu::reduce(sq, 0.0);
+  CHECK(ssq == drtpu::transform_reduce(dv, 0.0, std::plus<>{},
+                                       [](double x) { return x * x; }));
+
+  // zip: aligned views zip segment-wise; elementwise iteration works
+  distributed_vector<double> other(40, P);
+  drtpu::fill(other, 2.0);
+  auto z = vw::zip(dv, other);
+  CHECK(z.size() == 40);
+  CHECK(!drtpu::segments(z).empty());
+  double dotv = 0;
+  for (auto& s : drtpu::segments(z))
+    for (auto&& [a, b] : drtpu::local(s)) dotv += a * b;
+  CHECK(dotv == drtpu::dot(dv, other, 0.0));
+  {
+    auto [a0, b0] = *z.begin();
+    CHECK(a0 == 0.0 && b0 == 2.0);
+  }
+  // zip of dv with a shifted self: misaligned => empty segments signal
+  if (P > 1) {
+    auto zm = vw::zip(dv, dv | vw::drop(1));
+    CHECK(drtpu::segments(zm).empty());
+    // nested zip over a misaligned zip propagates the empty signal
+    // instead of indexing the inner empty segment list
+    auto zz = vw::zip(zm, dv);
+    CHECK(drtpu::segments(zz).empty());
+  }
+  // zip | transform | reduce — the dot-product pipeline
+  // (examples/shp/dot_product.cpp:11-18 shape)
+  auto prod = vw::zip(dv, other) |
+              vw::transform([](auto t) {
+                auto [a, b] = t;
+                return a * b;
+              });
+  CHECK(drtpu::reduce(prod, 0.0) == dotv);
+
+  // enumerate carries global indices through segments
+  auto en = vw::enumerate(dv);
+  for (auto& s : drtpu::segments(en))
+    for (auto&& [i, v] : drtpu::local(s))
+      CHECK(static_cast<double>(i) == v);
+
+  // ranked view reports owning ranks
+  auto pairs = vw::ranked(dv).pairs();
+  CHECK(pairs.size() == 40);
+  CHECK(pairs.front().first == 0);
+  CHECK(pairs.back().first == drtpu::rank(
+      drtpu::segments(dv).back()));
+  return 0;
+}
+
+static int test_matrix(std::size_t P) {
+  using drtpu::index2d;
+  // block-cyclic placement covers all ranks; grid is near-square
+  auto grid = drtpu::factor_grid(P);
+  CHECK(grid.i * grid.j == P);
+  CHECK(grid.i >= grid.j);
+
+  // dense matrix: tiles join back to the logical matrix
+  drtpu::dense_matrix<double> A(index2d{10, 7}, P);
+  for (std::size_t i = 0; i < 10; ++i)
+    for (std::size_t j = 0; j < 7; ++j) A(i, j) = 10.0 * i + j;
+  std::size_t covered = 0;
+  for (auto& t : A.dr_segments()) {
+    CHECK(t.dr_rank() < P);
+    covered += t.size();
+    for (std::size_t i = 0; i < t.shape().i; ++i)
+      for (std::size_t j = 0; j < t.shape().j; ++j)
+        CHECK(t(i, j) == 10.0 * (t.origin().i + i) + (t.origin().j + j));
+  }
+  CHECK(covered == 70);
+
+  // dense gemv vs serial oracle
+  std::vector<double> b(7), c(10, 0.0), ref(10, 0.0);
+  for (std::size_t j = 0; j < 7; ++j) b[j] = 1.0 + j;
+  drtpu::gemv(c, A, b);
+  for (std::size_t i = 0; i < 10; ++i)
+    for (std::size_t j = 0; j < 7; ++j) ref[i] += A(i, j) * b[j];
+  for (std::size_t i = 0; i < 10; ++i)
+    CHECK(std::abs(c[i] - ref[i]) < 1e-9);
+
+  // gemm vs serial oracle
+  drtpu::dense_matrix<double> B(index2d{7, 6}, P), C(index2d{10, 6}, P);
+  for (std::size_t i = 0; i < 7; ++i)
+    for (std::size_t j = 0; j < 6; ++j) B(i, j) = (i == j) ? 2.0 : 0.0;
+  drtpu::gemm(C, A, B);
+  for (std::size_t i = 0; i < 10; ++i)
+    for (std::size_t j = 0; j < 6; ++j) CHECK(C(i, j) == 2.0 * A(i, j));
+
+  // sparse CSR from COO + SpMV vs dense oracle
+  std::vector<std::tuple<std::size_t, std::size_t, double>> coo;
+  for (std::size_t i = 0; i < 10; ++i)
+    for (std::size_t j = 0; j < 7; ++j)
+      if ((i + j) % 3 == 0) coo.emplace_back(i, j, 1.0 + double(i * 7 + j));
+  drtpu::sparse_matrix<double> S(index2d{10, 7}, P, coo);
+  CHECK(S.nnz() == coo.size());
+  std::vector<double> sc(10, 0.0), sref(10, 0.0);
+  drtpu::gemv(sc, S, b);
+  for (auto& [i, j, v] : coo) sref[i] += v * b[j];
+  for (std::size_t i = 0; i < 10; ++i)
+    CHECK(std::abs(sc[i] - sref[i]) < 1e-9);
+  return 0;
+}
+
 int main() {
   if (test_concepts()) return 1;
   for (std::size_t P : {1, 2, 3, 4, 8}) {
@@ -188,6 +319,8 @@ int main() {
     if (test_algorithms(P)) return 1;
     if (test_halo(P)) return 1;
     if (test_regressions(P)) return 1;
+    if (test_views(P)) return 1;
+    if (test_matrix(P)) return 1;
   }
   std::printf("native tests PASSED\n");
   return 0;

@@ -24,16 +24,20 @@ def _serial_periodic(x, w, steps):
     return x
 
 
+# kernel geometry: seg and halo are whole (8, 128) f32 tiles
+ALIGN = stencil_pallas.ROW_ALIGN
+
+
 @pytest.mark.parametrize("steps", [4, 8, 11])
 def test_blocked_matches_oracle(steps):
     P = dr_tpu.nprocs()
-    seg = 64
+    seg = ALIGN
     n = P * seg
     w = [0.25, 0.5, 0.25]
     src = np.random.default_rng(0).standard_normal(n).astype(np.float32)
-    hb = dr_tpu.halo_bounds(4, 4, periodic=True)  # covers time_block*r
+    hb = dr_tpu.halo_bounds(ALIGN, ALIGN, periodic=True)
     dv = dr_tpu.distributed_vector.from_array(src, halo=hb)
-    stencil_iterate_blocked(dv, w, steps, time_block=4, chunk=32)
+    stencil_iterate_blocked(dv, w, steps, time_block=4)
     ref = _serial_periodic(src, w, steps)
     np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref, rtol=1e-4,
                                atol=1e-5)
@@ -41,21 +45,36 @@ def test_blocked_matches_oracle(steps):
 
 def test_blocked_5pt():
     P = dr_tpu.nprocs()
-    seg = 64
+    seg = ALIGN
     n = P * seg
     w = [0.05, 0.25, 0.4, 0.25, 0.05]
     src = np.random.default_rng(1).standard_normal(n).astype(np.float32)
-    hb = dr_tpu.halo_bounds(8, 8, periodic=True)
+    hb = dr_tpu.halo_bounds(ALIGN, ALIGN, periodic=True)
     dv = dr_tpu.distributed_vector.from_array(src, halo=hb)
-    stencil_iterate_blocked(dv, w, 8, time_block=4, chunk=64)
+    stencil_iterate_blocked(dv, w, 8, time_block=4)
     ref = _serial_periodic(src, w, 8)
+    np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_blocked_multichunk():
+    """seg spanning several DMA chunks exercises the double-buffer loop."""
+    P = dr_tpu.nprocs()
+    seg = 4 * ALIGN
+    n = P * seg
+    w = [0.25, 0.5, 0.25]
+    src = np.random.default_rng(3).standard_normal(n).astype(np.float32)
+    hb = dr_tpu.halo_bounds(ALIGN, ALIGN, periodic=True)
+    dv = dr_tpu.distributed_vector.from_array(src, halo=hb)
+    stencil_iterate_blocked(dv, w, 6, time_block=6, chunk=ALIGN)
+    ref = _serial_periodic(src, w, 6)
     np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref, rtol=1e-4,
                                atol=1e-5)
 
 
 def test_blocked_matches_unblocked():
     P = dr_tpu.nprocs()
-    seg = 32
+    seg = ALIGN
     n = P * seg
     w = [1 / 3, 1 / 3, 1 / 3]
     src = np.random.default_rng(2).standard_normal(n).astype(np.float32)
@@ -63,9 +82,9 @@ def test_blocked_matches_unblocked():
     a = dr_tpu.distributed_vector.from_array(src, halo=hb1)
     b = dr_tpu.distributed_vector.from_array(src, halo=hb1)
     ref_dv = dr_tpu.stencil_iterate(a, b, w, steps=6)
-    hb2 = dr_tpu.halo_bounds(3, 3, periodic=True)
+    hb2 = dr_tpu.halo_bounds(ALIGN, ALIGN, periodic=True)
     blk = dr_tpu.distributed_vector.from_array(src, halo=hb2)
-    stencil_iterate_blocked(blk, w, 6, time_block=3, chunk=32)
+    stencil_iterate_blocked(blk, w, 6, time_block=3)
     np.testing.assert_allclose(dr_tpu.to_numpy(blk),
                                dr_tpu.to_numpy(ref_dv), rtol=1e-4,
                                atol=1e-5)
