@@ -16,6 +16,8 @@ bandwidth per chip.  Two implementations:
   (2 x 4 bytes x n x steps / time) can exceed physical peak by up to
   ``tblock``-fold: that headroom over the bandwidth bound is the point of
   the kernel.  ``detail.phys_gbps`` estimates the physical traffic rate.
+  If the pallas path fails (e.g. a Mosaic lowering regression), the
+  benchmark falls back to the xla path instead of dying.
 
 vs_baseline: achieved effective GB/s divided by the north-star target
 (0.7 x the chip's peak HBM bandwidth).  The reference publishes no
@@ -48,13 +50,94 @@ def _peak_for(device) -> float:
     return 819.0
 
 
+def _sync(cont):
+    # block_until_ready can be a no-op on tunneled backends (axon); a host
+    # read of one element is a hard completion barrier.  Slice device-side
+    # so only a scalar crosses the wire, and read a local shard so
+    # multi-process SPMD runs stay legal.
+    shard = cont._data.addressable_shards[0].data
+    return float(shard.reshape(-1)[0])
+
+
+def _measure(impl: str, n: int, steps: int, tblock: int):
+    """Allocate, warm up, and time one implementation; returns a result
+    dict.  Raises on any non-OOM failure (caller decides the fallback)."""
+    import dr_tpu
+    from dr_tpu.algorithms.stencil import (stencil_iterate,
+                                           stencil_iterate_blocked)
+    from dr_tpu.ops import stencil_pallas
+
+    pallas = impl == "pallas"
+    w = [0.05, 0.25, 0.4, 0.25, 0.05]
+    radius = 2
+    if pallas:
+        # Mosaic tile alignment: halo is whole (8, 128) f32 tiles
+        ra = stencil_pallas.ROW_ALIGN
+        halo_w = max(ra, -(-tblock * radius // ra) * ra)
+    else:
+        halo_w = radius
+    # periodic ring: every element computed every step on both paths
+    hb = dr_tpu.halo_bounds(halo_w, halo_w, periodic=True)
+    nshards = dr_tpu.nprocs()
+    # pallas path: shards must be whole DMA chunks; never round below one
+    align = nshards * 2 ** 17 if pallas else nshards
+    n = max(align, n - n % align)
+
+    dtype = np.float32
+    a = b = None
+
+    def run(nsteps):
+        if pallas:
+            return stencil_iterate_blocked(a, w, nsteps,
+                                           time_block=tblock,
+                                           chunk=2 ** 17)
+        return stencil_iterate(a, b, w, steps=nsteps)
+
+    for attempt in range(3):
+        try:
+            a = dr_tpu.distributed_vector(n, dtype, halo=hb)
+            dr_tpu.fill(a, 1.0)
+            if not pallas:  # pallas path steps in place, no 2nd buffer
+                b = dr_tpu.distributed_vector(n, dtype, halo=hb)
+                dr_tpu.fill(b, 1.0)
+            # warmup / compile; also surfaces OOM for backoff.  XLA path:
+            # same step count as the timed run (steps is in the jit key).
+            # Pallas path: one full block + the remainder block compiles
+            # both cached programs without paying the full timed run.
+            nfull, rest = divmod(steps, tblock)
+            warm = steps if not pallas else \
+                min(steps, tblock * min(nfull, 1) + rest)
+            _sync(run(warm))
+            break
+        except Exception as e:
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "emory" in str(e)
+            if attempt == 2 or not oom:
+                raise
+            a = b = None  # release this attempt's buffers before retrying
+            n //= 4  # back off on OOM
+            n = max(align, n - n % align)
+
+    t0 = time.perf_counter()
+    out = run(steps)
+    _sync(out)
+    dt = time.perf_counter() - t0
+
+    # effective traffic: the per-step XLA path would read n + write n
+    bytes_eff = 2.0 * n * np.dtype(dtype).itemsize * steps
+    gbps = bytes_eff / dt / 1e9
+    # physical traffic: the pallas path touches HBM once per tblock steps
+    nfull, rest = divmod(steps, tblock)
+    passes = steps if not pallas else nfull + (1 if rest else 0)
+    phys_gbps = 2.0 * n * np.dtype(dtype).itemsize * passes / dt / 1e9
+    return {"n": n, "steps": steps, "seconds": round(dt, 4), "impl": impl,
+            "gbps": gbps, "phys_gbps": phys_gbps}
+
+
 def main():
     n = int(os.environ.get("DR_TPU_BENCH_N", str(2 ** 30)))
 
     import jax
     import dr_tpu
-    from dr_tpu.algorithms.stencil import (stencil_iterate,
-                                           stencil_iterate_blocked)
     from dr_tpu.ops import stencil_pallas
 
     dev = jax.devices()[0]
@@ -72,89 +155,39 @@ def main():
     if on_cpu and "DR_TPU_BENCH_N" not in os.environ:
         n = 2 ** 24  # keep CPU smoke runs fast
 
+    xla_steps = int(os.environ.get("DR_TPU_BENCH_STEPS", "16"))
+
     dr_tpu.init(jax.devices())
-    w = [0.05, 0.25, 0.4, 0.25, 0.05]
-    radius = 2
-    if pallas:
-        # Mosaic tile alignment: halo is whole (8, 128) f32 tiles
-        ra = stencil_pallas.ROW_ALIGN
-        halo_w = max(ra, -(-tblock * radius // ra) * ra)
-    else:
-        halo_w = radius
-    # periodic ring: every element computed every step on both paths
-    hb = dr_tpu.halo_bounds(halo_w, halo_w, periodic=True)
-    nshards = dr_tpu.nprocs()
-    # pallas path: shards must be whole DMA chunks; never round below one
-    align = nshards * 2 ** 17 if pallas else nshards
-    n = max(align, n - n % align)
+    res = None
+    try:
+        res = _measure(impl, n, steps, tblock)
+    except Exception:
+        if not pallas or "DR_TPU_BENCH_IMPL" in os.environ:
+            raise
+        # the blocked kernel failed outright — report it and fall back to
+        # the XLA path so the driver still records a number for the round
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print("pallas path failed; falling back to xla", file=sys.stderr)
+    if res is None:
+        # retried outside the except block: a live exception traceback
+        # would pin the failed attempt's device buffers during the retry
+        res = _measure("xla", n, xla_steps, tblock)
 
-    dtype = np.float32
-
-    def run(nsteps):
-        if pallas:
-            return stencil_iterate_blocked(a, w, nsteps,
-                                           time_block=tblock,
-                                           chunk=2 ** 17)
-        return stencil_iterate(a, b, w, steps=nsteps)
-
-    def sync(cont):
-        # block_until_ready can be a no-op on tunneled backends (axon);
-        # a host read of one element is a hard completion barrier.  Slice
-        # device-side so only a scalar crosses the wire, and read a local
-        # shard so multi-process SPMD runs stay legal.
-        shard = cont._data.addressable_shards[0].data
-        return float(shard.reshape(-1)[0])
-
-    b = None
-    for attempt in range(3):
-        try:
-            a = dr_tpu.distributed_vector(n, dtype, halo=hb)
-            dr_tpu.fill(a, 1.0)
-            if not pallas:  # pallas path steps in place, no 2nd buffer
-                b = dr_tpu.distributed_vector(n, dtype, halo=hb)
-                dr_tpu.fill(b, 1.0)
-            # warmup / compile; also surfaces OOM for backoff.  XLA path:
-            # same step count as the timed run (steps is in the jit key).
-            # Pallas path: one full block + the remainder block compiles
-            # both cached programs without paying the full timed run.
-            nfull, rest = divmod(steps, tblock)
-            warm = steps if not pallas else \
-                min(steps, tblock * min(nfull, 1) + rest)
-            sync(run(warm))
-            break
-        except Exception as e:
-            oom = "RESOURCE_EXHAUSTED" in str(e) or "emory" in str(e)
-            if attempt == 2 or not oom:
-                raise
-            a = b = None  # release this attempt's buffers before retrying
-            n //= 4  # back off on OOM
-            n = max(align, n - n % align)
-
-    t0 = time.perf_counter()
-    out = run(steps)
-    sync(out)
-    dt = time.perf_counter() - t0
-
-    # effective traffic: the per-step XLA path would read n + write n
-    bytes_eff = 2.0 * n * np.dtype(dtype).itemsize * steps
-    gbps = bytes_eff / dt / 1e9
-    # physical traffic: the pallas path touches HBM once per tblock steps
-    nfull, rest = divmod(steps, tblock)
-    passes = steps if not pallas else nfull + (1 if rest else 0)
-    phys_gbps = 2.0 * n * np.dtype(dtype).itemsize * passes / dt / 1e9
     nchips = 1  # single-controller measurement is per chip
     peak = _peak_for(dev)
     target = 0.7 * peak
 
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
-        "value": round(gbps / nchips, 2),
+        "value": round(res["gbps"] / nchips, 2),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / nchips / target, 4),
+        "vs_baseline": round(res["gbps"] / nchips / target, 4),
         "detail": {
-            "n": n, "steps": steps, "seconds": round(dt, 4),
-            "impl": impl, "device": str(dev), "peak_hbm_gbps": peak,
-            "phys_gbps": round(phys_gbps / nchips, 2),
+            "n": res["n"], "steps": res["steps"],
+            "seconds": res["seconds"], "impl": res["impl"],
+            "device": str(dev), "peak_hbm_gbps": peak,
+            "phys_gbps": round(res["phys_gbps"] / nchips, 2),
             "target_gbps": round(target, 1),
         },
     }))
