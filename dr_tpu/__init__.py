@@ -34,6 +34,7 @@ from .core.vocabulary import (rank, segments, local, is_remote_range,
                               is_distributed_contiguous_range)
 from .core.segment import Segment, ZipSegment
 from .containers.distributed_vector import distributed_vector, halo
+from .containers.distribution import block_distribution, even_sizes
 from .containers.partition import (tile, matrix_partition, block_cyclic,
                                    row_tiles, factor)
 from .containers.dense_matrix import dense_matrix, matrix_entry, Index2D
@@ -66,7 +67,7 @@ __all__ = [
     "is_remote_range", "is_distributed_range",
     "is_remote_contiguous_range", "is_distributed_contiguous_range",
     "Segment", "ZipSegment",
-    "distributed_vector",
+    "distributed_vector", "block_distribution", "even_sizes",
     "views", "aligned", "local_segments",
     "fill", "iota", "copy", "copy_async", "for_each", "transform",
     "to_numpy", "reduce", "transform_reduce", "dot",

@@ -1,11 +1,15 @@
-"""Shared helpers for the algorithm layer: owned-cell masking and monoid
-combine tables (used by elementwise, reduce, and scan programs)."""
+"""Shared helpers for the algorithm layer: layout geometry, owned-cell
+masking, and monoid combine tables (used by elementwise, reduce, and scan
+programs)."""
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
-__all__ = ["owned_window_mask", "combine_for", "MONOID_COMBINE"]
+__all__ = ["layout_geometry", "owned_window_mask", "uniform_layout",
+           "combine_for", "MONOID_COMBINE"]
 
 MONOID_COMBINE = {
     "add": jnp.add,
@@ -20,19 +24,47 @@ def combine_for(kind, op):
     return MONOID_COMBINE[kind] if kind is not None else op
 
 
+def uniform_layout(layout) -> bool:
+    """True when the layout is the default ceil-division block layout
+    (layout[1] is the int segment size).  Uneven ``block_distribution``
+    layouts carry a tagged size tuple instead."""
+    return isinstance(layout[1], int)
+
+
+def layout_geometry(layout):
+    """(nshards, capacity, prev, nxt, n, starts, sizes) for any layout.
+
+    ``capacity`` is the physical owned width of every padded shard row;
+    ``starts[r]``/``sizes[r]`` give rank r's logical window.  For uniform
+    layouts sizes is seg everywhere (the tail masking happens via
+    ``gid < n``); for uneven layouts they come from the distribution.
+    """
+    nshards, seg, prev, nxt, n = layout
+    if isinstance(seg, tuple):  # ("b", s0, s1, ...) — block_distribution
+        sizes = np.asarray(seg[1:], dtype=np.int64)
+        cap = max(int(sizes.max(initial=0)), prev, nxt, 1)
+        starts = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+    else:
+        sizes = np.full(nshards, seg, dtype=np.int64)
+        cap = seg
+        starts = np.arange(nshards, dtype=np.int64) * seg
+    return nshards, cap, prev, nxt, n, starts, sizes
+
+
 def owned_window_mask(layout, off, n):
     """(mask, gid) over the padded (nshards, width) cell grid.
 
     ``gid`` is each cell's global logical index; ``mask`` selects owned
     cells inside the logical window [off, off+n) and under the container's
     logical size (pad/halo cells excluded).  This is the single source of
-    truth for the pad-and-mask rule (SURVEY.md §7 hard-part 3).
+    truth for the pad-and-mask rule (SURVEY.md §7 hard-part 3), for both
+    uniform and uneven block distributions.
     """
-    nshards, seg, prev, nxt, total_n = layout
-    width = prev + seg + nxt
+    nshards, cap, prev, nxt, total_n, starts, sizes = layout_geometry(layout)
+    width = prev + cap + nxt
     col = jnp.arange(width)[None, :]
-    row = jnp.arange(nshards)[:, None]
-    owned = (col >= prev) & (col < prev + seg)
-    gid = row * seg + (col - prev)
+    local = col - prev
+    owned = (local >= 0) & (local < jnp.asarray(sizes)[:, None])
+    gid = jnp.asarray(starts)[:, None] + local
     mask = owned & (gid >= off) & (gid < off + n) & (gid < total_n)
     return mask, gid

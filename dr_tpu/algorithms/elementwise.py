@@ -105,7 +105,6 @@ def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
     marks inputs that ARE the output container (in-place for_each): they
     read the donated buffer instead of being passed twice."""
     cont = out_chain.cont
-    nshards, seg, prev, nxt, _n = cont.layout
     off, n = out_chain.off, out_chain.n
     key = ("ew", cont.layout, off, n, in_keys,
            tuple(tuple(id(o) for o in ops) for ops in in_ops),
@@ -113,8 +112,6 @@ def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
-
-    width = prev + seg + nxt
 
     def body(out_data, *extra_datas):
         it = iter(extra_datas)
@@ -313,9 +310,7 @@ def _zip_foreach_program(ins, outs, fn, alias):
         return prog
     k = len(outs)
     cont = outs[0].cont
-    nshards, seg, prev, nxt, _n = cont.layout
     off, n = outs[0].off, outs[0].n
-    width = prev + seg + nxt
     in_ops = tuple(c.ops for c in ins)
 
     def body(*datas):

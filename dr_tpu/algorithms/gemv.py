@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ._common import owned_window_mask
+from ._common import owned_window_mask, uniform_layout
 from .elementwise import _prog_cache
 from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix
@@ -69,8 +69,11 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     if a._vals is None:
         return c  # empty matrix: nothing to add
     rt = a.runtime
-    # shard r of c must hold exactly tile r's rows
+    # shard r of c must hold exactly tile r's rows — which also requires
+    # the uniform ceil layout (an uneven distribution can match nshards
+    # and capacity while owning different row ranges)
     fast = (isinstance(c, distributed_vector)
+            and uniform_layout(c.layout)
             and c.nshards == a.nshards and c.segment_size == a.tile_rows
             and c.runtime is rt)
     if fast:

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import combine_for
+from ._common import combine_for, uniform_layout
 from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from .reduce import _classify_op, _identity_for
 
@@ -87,6 +87,9 @@ def _scan(in_r, out, op, init, exclusive):
         ins is not None and len(ins) == 1 and not ins[0].ops
         and ins[0].off == 0 and out_chain.off == 0
         and ins[0].cont.layout == out_chain.cont.layout
+        # the shard_map program assumes the uniform ceil layout; uneven
+        # block distributions take the logical-array fallback below
+        and uniform_layout(ins[0].cont.layout)
         and ins[0].n == len(ins[0].cont)
         # the fast program rebuilds the whole output array, so the output
         # window must cover the whole container too

@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ._common import uniform_layout
 from .elementwise import _out_chain, _prog_cache, _resolve
 from ..parallel.halo import _ring_perms
 
@@ -112,6 +113,8 @@ def stencil_transform(in_dv, out_dv, op: Union[Callable, Sequence[float]],
     assert oc.off == 0 and oc.n == len(oc.cont) and \
         oc.cont.layout == cont.layout, \
         "stencil output must be a whole aligned distributed_vector"
+    assert uniform_layout(cont.layout), \
+        "stencils require the uniform block distribution"
     hb = cont.halo_bounds
     prev = nxt = radius if radius is not None else None
     if prev is None:
@@ -147,6 +150,8 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
     """
     cont = a_dv
     assert b_dv.layout == cont.layout
+    assert uniform_layout(cont.layout), \
+        "stencils require the uniform block distribution"
     hb = cont.halo_bounds
     if callable(op):
         key_op = id(op)

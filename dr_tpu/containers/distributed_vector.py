@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..core.segment import Segment
 from ..parallel import runtime as _rt
 from ..parallel.halo import halo_bounds, span_halo
+from .distribution import block_distribution
 
 __all__ = ["distributed_vector", "halo"]
 
@@ -54,16 +55,45 @@ class distributed_vector:
     """1-D block-distributed vector with optional halo regions."""
 
     def __init__(self, size: int, dtype=None, halo: Optional[halo_bounds] = None,
-                 *, runtime=None, _data=None):
+                 *, distribution=None, runtime=None, _data=None):
         self._rt = runtime or _rt.runtime()
         self._n = int(size)
         self._dtype = _normalize_dtype(dtype)
         self._hb = halo or halo_bounds()
         P = self._rt.nprocs
-        # segment_size = max(ceil(n/p), prev, next)   (dv.hpp:190-193)
-        self._seg = max(-(-self._n // P) if self._n else 1,
-                        self._hb.prev, self._hb.next, 1)
         self._nshards = P
+        if distribution is not None and not isinstance(distribution,
+                                                       block_distribution):
+            distribution = block_distribution(distribution)
+        if distribution is not None:
+            if len(distribution.sizes) != P:
+                raise ValueError(
+                    f"distribution has {len(distribution.sizes)} blocks "
+                    f"for a {P}-shard mesh")
+            if distribution.n != self._n:
+                raise ValueError(
+                    f"distribution sizes sum to {distribution.n}, "
+                    f"vector size is {self._n}")
+        self._dist_entry = (distribution.layout_entry()
+                            if distribution is not None else None)
+        if isinstance(self._dist_entry, int):
+            self._dist_entry = None  # even sizes == default layout
+        if self._dist_entry is not None and self._hb.width:
+            raise ValueError("halo_bounds require the uniform block "
+                             "distribution (the halo exchange ring assumes "
+                             "equal shards)")
+        if self._dist_entry is not None:
+            sizes = np.asarray(self._dist_entry[1:], dtype=np.int64)
+            self._seg = max(int(sizes.max(initial=0)), self._hb.prev,
+                            self._hb.next, 1)
+            self._sizes = sizes
+            self._starts = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+        else:
+            # segment_size = max(ceil(n/p), prev, next)   (dv.hpp:190-193)
+            self._seg = max(-(-self._n // P) if self._n else 1,
+                            self._hb.prev, self._hb.next, 1)
+            self._sizes = None
+            self._starts = None
         if _data is not None:
             self._data = _data
         else:
@@ -101,9 +131,27 @@ class distributed_vector:
     @property
     def layout(self):
         """Alignment key: equal layouts => segment lists pairwise equal
-        (the ``mhp::aligned`` condition, mhp/alignment.hpp:13-28)."""
-        return (self._nshards, self._seg, self._hb.prev, self._hb.next,
-                self._n)
+        (the ``mhp::aligned`` condition, mhp/alignment.hpp:13-28).
+        ``layout[1]`` is the int segment size for the default uniform
+        layout, or the distribution's tagged size tuple."""
+        return (self._nshards, self._dist_entry or self._seg,
+                self._hb.prev, self._hb.next, self._n)
+
+    @property
+    def distribution(self):
+        """The explicit block_distribution, or None for the default
+        ceil-division layout."""
+        if self._dist_entry is None:
+            return None
+        return block_distribution(self._dist_entry[1:])
+
+    def _rank_window(self, r: int):
+        """Rank r's logical [begin, end) window."""
+        if self._starts is not None:
+            b = int(self._starts[r])
+            return b, b + int(self._sizes[r])
+        b = r * self._seg
+        return b, min(self._n, b + self._seg)
 
     def __len__(self) -> int:
         return self._n
@@ -116,8 +164,7 @@ class distributed_vector:
     def __dr_segments__(self):
         segs = []
         for r in range(self._nshards):
-            begin = r * self._seg
-            end = min(self._n, begin + self._seg)
+            begin, end = self._rank_window(r)
             if begin < end:
                 segs.append(Segment(self, r, begin, end))
         return segs
@@ -131,6 +178,9 @@ class distributed_vector:
     # ----------------------------------------------------------- value APIs
     def to_array(self) -> jax.Array:
         """Current logical value as a 1-D jax array of length n."""
+        if self._dist_entry is not None:
+            return _extract_uneven(self._rt.mesh, self.layout,
+                                   self._dtype)(self._data)
         return _extract(self._rt.mesh, self._rt.axis, self._nshards,
                         self._seg, self._hb.prev, self._hb.next, self._n,
                         self._dtype)(self._data)
@@ -139,15 +189,20 @@ class distributed_vector:
         """Rebind the whole logical value (ghost cells reset to zero)."""
         values = jnp.asarray(values, self._dtype)
         assert values.shape == (self._n,)
+        if self._dist_entry is not None:
+            self._data = _pack_uneven(self._rt.mesh, self._rt.axis,
+                                      self.layout, self._dtype)(values)
+            return
         self._data = _pack(self._rt.mesh, self._rt.axis, self._nshards,
                            self._seg, self._hb.prev, self._hb.next, self._n,
                            self._dtype)(values)
 
     @classmethod
     def from_array(cls, values, halo: Optional[halo_bounds] = None, *,
-                   runtime=None) -> "distributed_vector":
+                   distribution=None, runtime=None) -> "distributed_vector":
         values = jnp.asarray(values)
-        dv = cls(values.shape[0], values.dtype, halo, runtime=runtime)
+        dv = cls(values.shape[0], values.dtype, halo,
+                 distribution=distribution, runtime=runtime)
         dv.assign_array(values)
         return dv
 
@@ -157,7 +212,7 @@ class distributed_vector:
         return to_host(self.to_array()[begin:end])
 
     def _local_values(self, rank: int, begin: int, end: int):
-        lo = rank * self._seg
+        lo = self._rank_window(rank)[0]
         prev = self._hb.prev
         for sh in self._data.addressable_shards:
             idx = sh.index[0]
@@ -171,6 +226,11 @@ class distributed_vector:
     # ------------------------------------------------ element/batched access
     def _locate(self, i):
         i = jnp.asarray(i)
+        if self._starts is not None:
+            starts = jnp.asarray(self._starts)
+            r = jnp.searchsorted(starts, i, side="right") - 1
+            c = self._hb.prev + i - starts[r]
+            return r, c
         r = i // self._seg
         c = self._hb.prev + i % self._seg
         return r, c
@@ -199,6 +259,10 @@ class distributed_vector:
             i += self._n
         if not 0 <= i < self._n:
             raise IndexError(i)
+        if self._starts is not None:
+            r = int(np.searchsorted(self._starts, i, side="right")) - 1
+            return self._data[r,
+                              self._hb.prev + i - int(self._starts[r])].item()
         return self._data[i // self._seg,
                           self._hb.prev + i % self._seg].item()
 
@@ -283,6 +347,40 @@ def _pack(mesh, axis, nshards, seg, prev, nxt, n, dtype):
             else:
                 data = body
             return data
+        return jax.jit(fn, out_shardings=sh)
+    return _cached(key, build)
+
+
+def _uneven_phys_index(layout):
+    """Static flat physical index of every logical element for an uneven
+    block layout (computed once per layout with numpy)."""
+    from ..algorithms._common import layout_geometry
+    nshards, cap, prev, nxt, n, starts, sizes = layout_geometry(layout)
+    width = prev + cap + nxt
+    k = np.arange(n)
+    r = np.searchsorted(starts, k, side="right") - 1
+    return nshards, width, jnp.asarray(r * width + prev + (k - starts[r]))
+
+
+def _extract_uneven(mesh, layout, dtype):
+    key = ("extract_u", id(mesh), layout, str(dtype))
+
+    def build():
+        _nshards, _width, idx = _uneven_phys_index(layout)
+        return jax.jit(lambda data: data.reshape(-1)[idx])
+    return _cached(key, build)
+
+
+def _pack_uneven(mesh, axis, layout, dtype):
+    key = ("pack_u", id(mesh), axis, layout, str(dtype))
+
+    def build():
+        nshards, width, idx = _uneven_phys_index(layout)
+        sh = NamedSharding(mesh, PartitionSpec(axis, None))
+
+        def fn(values):
+            flat = jnp.zeros((nshards * width,), dtype).at[idx].set(values)
+            return flat.reshape(nshards, width)
         return jax.jit(fn, out_shardings=sh)
     return _cached(key, build)
 

@@ -1,0 +1,71 @@
+"""Distribution policies for ``distributed_vector``.
+
+The reference leaves this as declared future work: ``// TODO: support
+teams, distributions`` (``include/dr/shp/distributed_vector.hpp:113``) and
+a disabled allocator/distribution test
+(``test/gtest/mhp/distributed_vector.cpp:121-131``).  Here it is
+first-class: a ``block_distribution`` gives every shard an explicit owned
+size (zeros allowed — a shard with size 0 simply owns nothing, which is
+the "team" case: restrict the data to a subset of ranks).
+
+TPU realization: the physical layout stays ONE uniform padded
+``(nshards, capacity)`` sharded array (pjit's equal-shard world); the
+distribution only changes the *logical* metadata — per-shard owned sizes
+and start offsets — which every algorithm reads through
+``algorithms._common.layout_geometry``.  Uneven sizes therefore cost
+padding, never resharding.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+__all__ = ["block_distribution", "even_sizes"]
+
+
+def even_sizes(n: int, nshards: int) -> Tuple[int, ...]:
+    """The default ceil-division block sizes: seg = ceil(n/p), short tail.
+    (reference rule, mhp dv.hpp:190-193 / shp distributed_vector.hpp:151)."""
+    seg = -(-n // nshards) if n else 1
+    sizes = []
+    left = n
+    for _ in range(nshards):
+        take = min(seg, left)
+        sizes.append(take)
+        left -= take
+    return tuple(sizes)
+
+
+class block_distribution:
+    """Explicit per-shard owned sizes.  ``sizes[r]`` elements live on rank
+    r, contiguously: rank r owns logical ``[starts[r], starts[r]+sizes[r])``.
+    """
+
+    def __init__(self, sizes: Sequence[int]):
+        self.sizes = tuple(int(s) for s in sizes)
+        if any(s < 0 for s in self.sizes):
+            raise ValueError("block sizes must be >= 0")
+
+    @property
+    def n(self) -> int:
+        return sum(self.sizes)
+
+    def layout_entry(self):
+        """The value stored in ``layout[1]``: an int for the uniform
+        ceil-division layout (back-compat fast paths), else the tagged
+        size tuple."""
+        nshards = len(self.sizes)
+        if self.sizes == even_sizes(self.n, nshards):
+            seg = -(-self.n // nshards) if self.n else 1
+            return seg
+        return ("b",) + self.sizes
+
+    def __repr__(self):
+        return f"block_distribution({list(self.sizes)})"
+
+    def __eq__(self, other):
+        return (isinstance(other, block_distribution)
+                and self.sizes == other.sizes)
+
+    def __hash__(self):
+        return hash(self.sizes)

@@ -27,6 +27,9 @@ def save(path: str, container) -> None:
     if isinstance(container, distributed_vector):
         hb = container.halo_bounds
         meta = {"kind": "vector", "halo": [hb.prev, hb.next, hb.periodic]}
+        dist = container.distribution
+        if dist is not None:
+            meta["sizes"] = list(dist.sizes)
         arrays = {"data": container.materialize()}
     elif isinstance(container, dense_matrix):
         meta = {"kind": "dense_matrix",
@@ -70,7 +73,18 @@ def load(path: str, *, runtime=None):
             prev, nxt, periodic = meta["halo"]
             hb = halo_bounds(int(prev), int(nxt), bool(periodic)) \
                 if (prev or nxt) else None
+            sizes = meta.get("sizes")
+            if sizes is not None:
+                from ..parallel import runtime as _rt
+                P = (runtime or _rt.runtime()).nprocs
+                if len(sizes) != P:
+                    raise ValueError(
+                        f"checkpointed block_distribution has {len(sizes)} "
+                        f"blocks but the current mesh has {P} shards; "
+                        "re-save without an explicit distribution to "
+                        "re-block on load")
             return distributed_vector.from_array(f["data"], halo=hb,
+                                                 distribution=sizes,
                                                  runtime=runtime)
         if kind == "dense_matrix":
             return dense_matrix.from_array(f["data"], runtime=runtime)

@@ -1,0 +1,211 @@
+"""Distribution policies for distributed_vector.
+
+The reference declares but never ships this: ``// TODO: support teams,
+distributions`` (shp/distributed_vector.hpp:113) and the disabled
+allocator/distribution test (test/gtest/mhp/distributed_vector.cpp:121-131).
+Here uneven block sizes (and zero-size "team" blocks) are first-class.
+"""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from conftest import check_segments, equal
+
+
+def test_even_sizes_helper():
+    assert dr_tpu.even_sizes(10, 4) == (3, 3, 3, 1)
+    assert dr_tpu.even_sizes(8, 4) == (2, 2, 2, 2)
+    assert dr_tpu.even_sizes(2, 4) == (1, 1, 0, 0)
+
+
+def test_even_distribution_is_default_layout():
+    """An explicitly-even distribution must alias the default layout so
+    the two are aligned() and share compiled programs."""
+    a = dr_tpu.distributed_vector(100)
+    b = dr_tpu.distributed_vector(
+        100, distribution=dr_tpu.even_sizes(100, dr_tpu.nprocs()))
+    assert a.layout == b.layout
+    assert b.distribution is None
+
+
+def test_uneven_sizes_validation():
+    P = dr_tpu.nprocs()
+    with pytest.raises(ValueError):
+        dr_tpu.distributed_vector(10, distribution=[10] * (P + 1))
+    with pytest.raises(ValueError):
+        dr_tpu.distributed_vector(10, distribution=[1] * P)  # sums to P
+    with pytest.raises(ValueError):
+        dr_tpu.block_distribution([3, -1])
+
+
+def test_halo_requires_uniform():
+    P = dr_tpu.nprocs()
+    sizes = [2] * P
+    sizes[0] = 2 + P  # uneven but sums correctly with n below
+    with pytest.raises(ValueError):
+        dr_tpu.distributed_vector(sum(sizes), halo=dr_tpu.halo_bounds(1, 1),
+                                  distribution=sizes)
+
+
+def _uneven_sizes(n, P, seed=0):
+    rng = np.random.default_rng(seed)
+    cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+    bounds = np.concatenate(([0], cuts, [n]))
+    return tuple(int(b - a) for a, b in zip(bounds[:-1], bounds[1:]))
+
+
+def test_segments_respect_distribution(oracle):
+    P = dr_tpu.nprocs()
+    n = 37
+    sizes = _uneven_sizes(n, P, seed=1)
+    src = np.arange(n, dtype=np.float32)
+    dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
+    segs = dr_tpu.segments(dv)
+    # nonzero blocks appear in order with the declared sizes
+    declared = [s for s in sizes if s]
+    assert [len(s) for s in segs] == declared
+    starts = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+    ranks = [r for r, s in enumerate(sizes) if s]
+    assert [dr_tpu.rank(s) for s in segs] == ranks
+    for s, r in zip(segs, ranks):
+        assert s.begin == int(starts[r])
+    oracle.check_segments(dv)
+    oracle.equal(dv, src)
+
+
+def test_team_zero_blocks(oracle):
+    """Zero-size blocks = 'teams': data restricted to a rank subset."""
+    P = dr_tpu.nprocs()
+    n = 12
+    sizes = [0] * P
+    sizes[0] = n  # everything on rank 0
+    dv = dr_tpu.distributed_vector(n, np.int32, distribution=sizes)
+    dr_tpu.iota(dv, 5)
+    segs = dr_tpu.segments(dv)
+    assert len(segs) == 1 and dr_tpu.rank(segs[0]) == 0
+    oracle.equal(dv, np.arange(5, 5 + n))
+
+
+def test_elementwise_on_uneven(oracle):
+    P = dr_tpu.nprocs()
+    n = 29
+    sizes = _uneven_sizes(n, P, seed=2)
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    b = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.iota(a, 0)
+    dr_tpu.fill(b, 10.0)
+    assert dr_tpu.aligned(a, b)
+    out = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.transform(dr_tpu.views.zip(a, b), out, lambda x, y: x + y)
+    oracle.equal(out, np.arange(n) + 10.0)
+    dr_tpu.for_each(out, lambda x: x * 2)
+    oracle.equal(out, 2 * (np.arange(n) + 10.0))
+
+
+def test_uneven_vs_uniform_misaligned():
+    P = dr_tpu.nprocs()
+    if P == 1:
+        pytest.skip("one shard: every distribution is the same")
+    n = 24
+    sizes = list(dr_tpu.even_sizes(n, P))
+    sizes[0] += 1
+    sizes[-1] -= 1
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    assert not dr_tpu.aligned(a, b)
+    # fallback path still computes the right answer
+    dr_tpu.iota(a, 0)
+    dr_tpu.transform(a, b, lambda x: x + 1)
+    np.testing.assert_allclose(dr_tpu.to_numpy(b), np.arange(n) + 1)
+
+
+def test_reduce_scan_on_uneven(oracle):
+    P = dr_tpu.nprocs()
+    n = 41
+    sizes = _uneven_sizes(n, P, seed=3)
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.iota(a, 1)
+    assert dr_tpu.reduce(a) == pytest.approx(n * (n + 1) / 2)
+    s = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.inclusive_scan(a, s)
+    oracle.equal(s, np.cumsum(np.arange(1, n + 1)))
+
+
+def test_get_put_on_uneven():
+    P = dr_tpu.nprocs()
+    n = 19
+    sizes = _uneven_sizes(n, P, seed=4)
+    dv = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.fill(dv, 0.0)
+    idx = np.array([0, n // 2, n - 1])
+    dv.put(idx, np.array([1.0, 2.0, 3.0]))
+    got = np.asarray(dv.get(idx))
+    np.testing.assert_allclose(got, [1.0, 2.0, 3.0])
+    assert dv[n - 1] == 3.0
+    dv[0] = 7.0
+    assert dv[0] == 7.0
+    # untouched cells stayed zero
+    np.testing.assert_allclose(
+        np.delete(dr_tpu.to_numpy(dv), idx), 0.0)
+
+
+def test_views_over_uneven(oracle):
+    P = dr_tpu.nprocs()
+    n = 33
+    sizes = _uneven_sizes(n, P, seed=5)
+    src = np.arange(n, dtype=np.float32)
+    dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
+    v = dv[5:20]
+    oracle.equal(v, src[5:20])
+    check = dr_tpu.views.transform(dv, lambda x: x * x)
+    assert dr_tpu.reduce(check) == pytest.approx(float((src ** 2).sum()))
+    oracle.check_segments(v)
+
+
+def test_stencil_rejects_uneven():
+    P = dr_tpu.nprocs()
+    if P == 1:
+        pytest.skip("one shard: every distribution is uniform")
+    n = 16 * P
+    sizes = list(dr_tpu.even_sizes(n, P))
+    sizes[0] += 1
+    sizes[-1] -= 1
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    b = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    with pytest.raises(AssertionError):
+        dr_tpu.stencil_transform(a, b, [0.25, 0.5, 0.25], radius=0)
+
+
+def test_gemv_rejects_uneven_fast_path(oracle):
+    """Uneven c whose capacity happens to equal tile_rows must NOT take
+    the rank-r-owns-rows-[r*th, r*th+th) fast path."""
+    P = dr_tpu.nprocs()
+    if P == 1:
+        pytest.skip("one shard: every distribution is uniform")
+    m = 2 * P - 1  # tile_rows = 2, last tile short
+    d = np.random.default_rng(0).random((m, m)).astype(np.float32)
+    d[d < 0.5] = 0.0
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    sizes = [2] * P
+    sizes[-2] = 1  # uneven, but max(sizes) == tile_rows == 2
+    sizes[-1] = m - sum(sizes[:-1])
+    assert sum(sizes) == m and max(sizes) == 2
+    c = dr_tpu.distributed_vector(m, np.float32, distribution=sizes)
+    dr_tpu.fill(c, 0.0)
+    bv = np.ones(m, np.float32)
+    dr_tpu.gemv(c, sp, bv)
+    oracle.equal(c, d @ bv)
+
+
+def test_checkpoint_roundtrips_distribution(tmp_path):
+    P = dr_tpu.nprocs()
+    n = 23
+    sizes = _uneven_sizes(n, P, seed=6)
+    src = np.arange(n, dtype=np.float32)
+    dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
+    path = str(tmp_path / "dv_dist")
+    dr_tpu.checkpoint.save(path, dv)
+    back = dr_tpu.checkpoint.load(path)
+    assert back.layout == dv.layout  # placement survives, not just values
+    np.testing.assert_allclose(dr_tpu.to_numpy(back), src)
