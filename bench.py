@@ -133,6 +133,133 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
             "gbps": gbps, "phys_gbps": phys_gbps}
 
 
+def _time_best(fn, iters=3):
+    """Best-of-N wall time of fn(); fn must block until complete."""
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _secondary_metrics(on_cpu: bool) -> dict:
+    """The remaining BASELINE.json configs, each as one number in detail:
+    transform_reduce dot (GB/s), inclusive_scan (GB/s), halo-exchange
+    p50 latency (us), 2-D heat stencil (GB/s), CSR SpMV (GFLOP/s).
+    Every config is independently guarded — a failure records an error
+    string instead of killing the headline metric."""
+    import dr_tpu
+    out = {}
+    P = dr_tpu.nprocs()
+    itemsize = 4
+
+    # config 1: transform_reduce dot-product (dot_product.cpp:11-18)
+    try:
+        n = (2 ** 22 if on_cpu else 2 ** 27) // P * P
+        a = dr_tpu.distributed_vector(n, np.float32)
+        b = dr_tpu.distributed_vector(n, np.float32)
+        dr_tpu.fill(a, 1.5)
+        dr_tpu.fill(b, 2.0)
+        dr_tpu.dot(a, b)  # warm/compile; returns a host scalar (synced)
+        dt = _time_best(lambda: dr_tpu.dot(a, b))
+        out["dot_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
+    except Exception as e:  # pragma: no cover - defensive
+        out["dot_error"] = repr(e)[:160]
+    finally:
+        a = b = None  # free the buffers even when a step raised
+
+    # config 3: inclusive_scan prefix sum (inclusive_scan.hpp:25-148)
+    try:
+        n = (2 ** 22 if on_cpu else 2 ** 27) // P * P
+        a = dr_tpu.distributed_vector(n, np.float32)
+        s = dr_tpu.distributed_vector(n, np.float32)
+        dr_tpu.iota(a, 0)
+        dr_tpu.inclusive_scan(a, s)  # warm
+
+        def run_scan():
+            dr_tpu.inclusive_scan(a, s)
+            _sync(s)
+        dt = _time_best(run_scan)
+        out["scan_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
+    except Exception as e:  # pragma: no cover - defensive
+        out["scan_error"] = repr(e)[:160]
+    finally:
+        a = s = None
+
+    # halo-exchange p50 latency (the BASELINE.json metric's third term;
+    # halo.hpp:273-387 exchange over the ppermute ring)
+    try:
+        hw = 1024
+        n = P * (2 ** 18 if on_cpu else 2 ** 22)
+        hb = dr_tpu.halo_bounds(hw, hw, periodic=True)
+        v = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+        dr_tpu.fill(v, 1.0)
+        h = v.halo()
+        h.exchange()  # warm/compile
+        _sync(v)
+        times = []
+        for _ in range(51):
+            t0 = time.perf_counter()
+            h.exchange()
+            _sync(v)
+            times.append(time.perf_counter() - t0)
+        out["halo_exchange_p50_us"] = round(
+            float(np.median(times)) * 1e6, 1)
+    except Exception as e:  # pragma: no cover - defensive
+        out["halo_error"] = repr(e)[:160]
+    finally:
+        v = h = None  # span_halo holds the vector; clear both
+
+    # config 4: 2-D heat stencil on the tiled dense matrix
+    try:
+        m = 1024 if on_cpu else 8192
+        steps = 10
+        src = np.zeros((m, m), dtype=np.float32)
+        src[m // 2, m // 2] = 1000.0
+        w = dr_tpu.heat_step_weights(0.25)
+        A = dr_tpu.dense_matrix.from_array(src)
+        B = dr_tpu.dense_matrix.from_array(src)
+        dr_tpu.stencil2d_iterate(A, B, w, steps=steps)  # warm
+
+        def run_heat():
+            out_m = dr_tpu.stencil2d_iterate(A, B, w, steps=steps)
+            _sync(out_m)
+        dt = _time_best(run_heat)
+        out["heat2d_gbps"] = round(
+            2.0 * m * m * itemsize * steps / dt / 1e9, 2)
+    except Exception as e:  # pragma: no cover - defensive
+        out["heat2d_error"] = repr(e)[:160]
+    finally:
+        A = B = None
+
+    # config 5: CSR SpMV (gemv_example.cpp:18-41)
+    try:
+        m = 2 ** 14 if on_cpu else 2 ** 17
+        k = 32  # nnz per row
+        rng = np.random.default_rng(0)
+        rows = np.repeat(np.arange(m), k)
+        cols = rng.integers(0, m, size=m * k)
+        vals = rng.standard_normal(m * k).astype(np.float32)
+        A = dr_tpu.sparse_matrix.from_coo((m, m), rows, cols, vals)
+        c = dr_tpu.distributed_vector(m, np.float32)
+        bv = dr_tpu.distributed_vector(m, np.float32)
+        dr_tpu.fill(bv, 1.0)
+        dr_tpu.fill(c, 0.0)
+        dr_tpu.gemv(c, A, bv)  # warm
+
+        def run_spmv():
+            dr_tpu.gemv(c, A, bv)
+            _sync(c)
+        dt = _time_best(run_spmv)
+        out["spmv_gflops"] = round(2.0 * m * k / dt / 1e9, 2)
+    except Exception as e:  # pragma: no cover - defensive
+        out["spmv_error"] = repr(e)[:160]
+    finally:
+        A = c = bv = None
+    return out
+
+
 def main():
     n = int(os.environ.get("DR_TPU_BENCH_N", str(2 ** 30)))
 
@@ -178,6 +305,10 @@ def main():
     peak = _peak_for(dev)
     target = 0.7 * peak
 
+    secondary = {}
+    if os.environ.get("DR_TPU_BENCH_SECONDARY", "1") != "0":
+        secondary = _secondary_metrics(on_cpu)
+
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
         "value": round(res["gbps"] / nchips, 2),
@@ -189,6 +320,7 @@ def main():
             "device": str(dev), "peak_hbm_gbps": peak,
             "phys_gbps": round(res["phys_gbps"] / nchips, 2),
             "target_gbps": round(target, 1),
+            **secondary,
         },
     }))
 
