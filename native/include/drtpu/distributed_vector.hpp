@@ -29,6 +29,16 @@ struct halo_bounds {
   bool periodic = false;
 };
 
+// Explicit per-rank block sizes (the reference's declared-but-unbuilt
+// "// TODO: support teams, distributions", shp/distributed_vector.hpp:113;
+// zero-size blocks = the "teams" case).  Mirrors the Python
+// block_distribution (dr_tpu/containers/distribution.py).
+struct block_distribution {
+  std::vector<std::size_t> sizes;
+  explicit block_distribution(std::vector<std::size_t> s)
+      : sizes(std::move(s)) {}
+};
+
 enum class halo_op { second, plus, max, min, multiplies };
 
 template <class T>
@@ -91,9 +101,8 @@ class distributed_vector {
     // segment_size = max(ceil(n/p), prev, next)  (dv.hpp:190-193)
     seg_ = std::max({n ? (n + nprocs - 1) / nprocs : std::size_t{1},
                      hb.prev, hb.next, std::size_t{1}});
-    width_ = hb.prev + seg_ + hb.next;
-    data_.assign(nprocs_, {});
-    for (auto& row : data_) row.assign(width_, T{});
+    init_uniform_windows();
+    alloc_rows();
     if ((hb.prev || hb.next) && nprocs_ > 1) {
       std::size_t tail = n_ - (nprocs_ - 1) * seg_;
       if (n_ <= (nprocs_ - 1) * seg_)
@@ -103,21 +112,63 @@ class distributed_vector {
     }
   }
 
+  // Explicit distribution: rank r owns sizes[r] contiguous elements.
+  // Halo padding requires the uniform layout (the exchange ring assumes
+  // equal shards), matching the Python container's rule.
+  distributed_vector(std::size_t n, std::size_t nprocs,
+                     const block_distribution& dist, halo_bounds hb = {})
+      : n_(n), nprocs_(nprocs), hb_(hb), halo_(this) {
+    assert(nprocs >= 1);
+    if (dist.sizes.size() != nprocs_)
+      throw std::invalid_argument("distribution block count != nprocs");
+    std::size_t total = 0;
+    for (auto s : dist.sizes) total += s;
+    if (total != n_)
+      throw std::invalid_argument("distribution sizes do not sum to n");
+    sizes_ = dist.sizes;
+    starts_.resize(nprocs_);
+    std::size_t acc = 0;
+    std::size_t mx = 0;
+    for (std::size_t r = 0; r < nprocs_; ++r) {
+      starts_[r] = acc;
+      acc += sizes_[r];
+      mx = std::max(mx, sizes_[r]);
+    }
+    seg_ = std::max({mx, hb.prev, hb.next, std::size_t{1}});
+    uniform_ = is_even_layout();
+    if (!uniform_ && (hb.prev || hb.next))
+      throw std::invalid_argument(
+          "halo_bounds require the uniform block distribution");
+    if ((hb.prev || hb.next) && nprocs_ > 1) {
+      if (sizes_.back() == 0)
+        throw std::invalid_argument("halo requires nonempty shards");
+      if (hb.periodic && sizes_.back() < std::max(hb.prev, hb.next))
+        throw std::invalid_argument("periodic halo: tail below radius");
+    }
+    alloc_rows();
+  }
+
   // value semantics must re-seat the halo controller's back-pointer
   distributed_vector(const distributed_vector& o)
       : n_(o.n_), nprocs_(o.nprocs_), seg_(o.seg_), width_(o.width_),
-        hb_(o.hb_), data_(o.data_), halo_(this) {}
+        uniform_(o.uniform_), hb_(o.hb_), starts_(o.starts_),
+        sizes_(o.sizes_), data_(o.data_), halo_(this) {}
   distributed_vector(distributed_vector&& o) noexcept
       : n_(o.n_), nprocs_(o.nprocs_), seg_(o.seg_), width_(o.width_),
-        hb_(o.hb_), data_(std::move(o.data_)), halo_(this) {}
+        uniform_(o.uniform_), hb_(o.hb_), starts_(std::move(o.starts_)),
+        sizes_(std::move(o.sizes_)), data_(std::move(o.data_)),
+        halo_(this) {}
   distributed_vector& operator=(const distributed_vector& o) {
     n_ = o.n_; nprocs_ = o.nprocs_; seg_ = o.seg_; width_ = o.width_;
-    hb_ = o.hb_; data_ = o.data_;
+    uniform_ = o.uniform_; hb_ = o.hb_;
+    starts_ = o.starts_; sizes_ = o.sizes_; data_ = o.data_;
     return *this;  // halo_ keeps pointing at *this
   }
   distributed_vector& operator=(distributed_vector&& o) noexcept {
     n_ = o.n_; nprocs_ = o.nprocs_; seg_ = o.seg_; width_ = o.width_;
-    hb_ = o.hb_; data_ = std::move(o.data_);
+    uniform_ = o.uniform_; hb_ = o.hb_;
+    starts_ = std::move(o.starts_); sizes_ = std::move(o.sizes_);
+    data_ = std::move(o.data_);
     return *this;
   }
 
@@ -126,15 +177,28 @@ class distributed_vector {
   iterator end() { return iterator(dv_accessor<T>{this, n_}); }
   std::size_t nprocs() const { return nprocs_; }
   std::size_t segment_size() const { return seg_; }
+  bool uniform() const { return uniform_; }
+  const std::vector<std::size_t>& block_sizes() const { return sizes_; }
   halo_bounds bounds() const { return hb_; }
   span_halo<T>& halo() { return halo_; }
 
+  // rank owning logical index i
+  std::size_t rank_of(std::size_t i) const {
+    if (uniform_) return i / seg_;
+    // last start <= i (upper_bound handles zero-size blocks: repeated
+    // starts resolve to the last — owning — rank)
+    auto it = std::upper_bound(starts_.begin(), starts_.end(), i);
+    return static_cast<std::size_t>(it - starts_.begin()) - 1;
+  }
+
   // element access through the padded layout
   T& operator[](std::size_t i) {
-    return data_[i / seg_][hb_.prev + i % seg_];
+    std::size_t r = rank_of(i);
+    return data_[r][hb_.prev + i - starts_[r]];
   }
   const T& operator[](std::size_t i) const {
-    return data_[i / seg_][hb_.prev + i % seg_];
+    std::size_t r = rank_of(i);
+    return data_[r][hb_.prev + i - starts_[r]];
   }
 
   // padded row of one shard (the TPU (nshards, width) row analog)
@@ -145,26 +209,58 @@ class distributed_vector {
   std::vector<remote_span<T>> dr_segments() {
     std::vector<remote_span<T>> segs;
     for (std::size_t r = 0; r < nprocs_; ++r) {
-      std::size_t begin = r * seg_;
-      std::size_t end = std::min(n_, begin + seg_);
-      if (begin >= end) break;
+      if (!sizes_[r]) continue;
       segs.push_back(remote_span<T>(
-          r, begin,
-          std::span<T>(data_[r].data() + hb_.prev, end - begin)));
+          r, starts_[r],
+          std::span<T>(data_[r].data() + hb_.prev, sizes_[r])));
     }
     return segs;
   }
 
-  std::size_t valid_of(std::size_t r) const {
-    std::size_t begin = r * seg_;
-    std::size_t end = std::min(n_, begin + seg_);
-    return end > begin ? end - begin : 0;
-  }
+  std::size_t valid_of(std::size_t r) const { return sizes_[r]; }
 
  private:
   friend class span_halo<T>;
-  std::size_t n_, nprocs_, seg_, width_;
+
+  void init_uniform_windows() {
+    starts_.resize(nprocs_);
+    sizes_.resize(nprocs_);
+    for (std::size_t r = 0; r < nprocs_; ++r) {
+      starts_[r] = r * seg_;
+      std::size_t end = std::min(n_, starts_[r] + seg_);
+      sizes_[r] = end > starts_[r] ? end - starts_[r] : 0;
+    }
+    uniform_ = true;
+  }
+
+  bool is_even_layout() const {
+    // explicit sizes matching what the DEFAULT ctor would build — i.e.
+    // ceil-division windows under the halo-bumped segment size
+    // (max(ceil(n/p), prev, next)) — so the fast div/mod indexing applies
+    // and segments align with default-constructed peers
+    std::size_t seg =
+        std::max({n_ ? (n_ + nprocs_ - 1) / nprocs_ : std::size_t{1},
+                  hb_.prev, hb_.next, std::size_t{1}});
+    if (seg_ != seg) return false;  // rank_of divides by seg_; must agree
+    for (std::size_t r = 0; r < nprocs_; ++r) {
+      std::size_t begin = std::min(n_, r * seg);
+      std::size_t end = std::min(n_, begin + seg);
+      if (starts_[r] != r * seg && sizes_[r] != 0) return false;
+      if (sizes_[r] != end - begin) return false;
+    }
+    return true;
+  }
+
+  void alloc_rows() {
+    width_ = hb_.prev + seg_ + hb_.next;
+    data_.assign(nprocs_, {});
+    for (auto& row : data_) row.assign(width_, T{});
+  }
+
+  std::size_t n_, nprocs_, seg_ = 1, width_ = 1;
+  bool uniform_ = false;
   halo_bounds hb_;
+  std::vector<std::size_t> starts_, sizes_;
   std::vector<std::vector<T>> data_;
   span_halo<T> halo_;
 };

@@ -311,6 +311,123 @@ static int test_matrix(std::size_t P) {
   return 0;
 }
 
+static int test_distribution(std::size_t P) {
+  using drtpu::block_distribution;
+  using drtpu::distributed_vector;
+
+  // uneven blocks: rank r owns sizes[r] contiguous elements
+  std::size_t n = 4 * P + 3;
+  std::vector<std::size_t> sizes(P, 4);
+  sizes[0] += 3;  // lopsided first block
+  distributed_vector<double> dv(n, P, block_distribution(sizes));
+  CHECK(!dv.uniform() || P == 1);
+  drtpu::iota(dv, 0.0);
+  for (std::size_t i = 0; i < n; ++i) CHECK(dv[i] == double(i));
+
+  // segments carry the declared sizes, in order, ranks increasing
+  auto segs = dv.dr_segments();
+  CHECK(segs.size() == P);
+  std::size_t at = 0;
+  for (std::size_t r = 0; r < P; ++r) {
+    CHECK(drtpu::rank(segs[r]) == r);
+    CHECK(segs[r].size() == sizes[r]);
+    CHECK(segs[r].origin() == at);
+    at += sizes[r];
+  }
+
+  // algorithms run segment-wise over the uneven layout
+  CHECK(drtpu::reduce(dv, 0.0) == double(n) * double(n - 1) / 2.0);
+  distributed_vector<double> out(n, P, block_distribution(sizes));
+  drtpu::transform(dv, out, [](double x) { return 2.0 * x; });
+  for (std::size_t i = 0; i < n; ++i) CHECK(out[i] == 2.0 * double(i));
+  drtpu::inclusive_scan(dv, out);
+  double acc = 0;
+  for (std::size_t i = 0; i < n; ++i) {
+    acc += double(i);
+    CHECK(out[i] == acc);
+  }
+
+  // zero-size blocks = teams: everything on the last rank
+  std::vector<std::size_t> team(P, 0);
+  team[P - 1] = 6;
+  distributed_vector<int> tv(6, P, block_distribution(team));
+  drtpu::fill(tv, 9);
+  auto tsegs = tv.dr_segments();
+  CHECK(tsegs.size() == 1 && drtpu::rank(tsegs[0]) == P - 1);
+  CHECK(tv[5] == 9);
+
+  // validation: wrong sum / wrong count / halo-with-uneven all throw
+  bool threw = false;
+  try {
+    distributed_vector<double> bad(n + 1, P, block_distribution(sizes));
+    (void)bad;
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  threw = false;
+  try {
+    std::vector<std::size_t> wrong(P + 1, 1);
+    distributed_vector<double> bad2(P + 1, P, block_distribution(wrong));
+    (void)bad2;
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  if (P > 1) {
+    threw = false;
+    try {
+      distributed_vector<double> bad3(
+          n, P, block_distribution(sizes), drtpu::halo_bounds{1, 1});
+      (void)bad3;
+    } catch (const std::invalid_argument&) {
+      threw = true;
+    }
+    CHECK(threw);
+  }
+
+  // explicitly-even sizes behave as the default layout (uniform fast path)
+  std::size_t m = 8 * P;
+  std::vector<std::size_t> even(P, 8);
+  distributed_vector<double> ev(m, P, block_distribution(even));
+  CHECK(ev.uniform());
+  drtpu::iota(ev, 1.0);
+  CHECK(drtpu::reduce(ev, 0.0) == double(m) * double(m + 1) / 2.0);
+
+  // halo-bumped segment size: even-under-ceil sizes are NOT the default
+  // layout when the halo radius exceeds the block size — must be rejected
+  // (the default ctor rejects the same config), never silently misindexed
+  if (P == 4) {
+    threw = false;
+    try {
+      distributed_vector<double> hb_bad(
+          8, 4, block_distribution({2, 2, 2, 2}), drtpu::halo_bounds{3, 0});
+      (void)hb_bad;
+    } catch (const std::invalid_argument&) {
+      threw = true;
+    }
+    CHECK(threw);
+  }
+  // ...while explicit sizes matching the halo-bumped default layout ARE
+  // uniform and index identically to a default-constructed peer
+  if (P == 2) {
+    distributed_vector<double> hv(8, 2, block_distribution({6, 2}),
+                                  drtpu::halo_bounds{6, 0});
+    CHECK(hv.uniform());
+    drtpu::iota(hv, 0.0);
+    distributed_vector<double> hd(8, 2, drtpu::halo_bounds{6, 0});
+    drtpu::iota(hd, 0.0);
+    for (std::size_t i = 0; i < 8; ++i) CHECK(hv[i] == hd[i]);
+    auto hs = hv.dr_segments();
+    auto ds = hd.dr_segments();
+    CHECK(hs.size() == ds.size());
+    for (std::size_t k = 0; k < hs.size(); ++k)
+      CHECK(hs[k].size() == ds[k].size() &&
+            hs[k].origin() == ds[k].origin());
+  }
+  return 0;
+}
+
 int main() {
   if (test_concepts()) return 1;
   for (std::size_t P : {1, 2, 3, 4, 8}) {
@@ -321,6 +438,7 @@ int main() {
     if (test_regressions(P)) return 1;
     if (test_views(P)) return 1;
     if (test_matrix(P)) return 1;
+    if (test_distribution(P)) return 1;
   }
   std::printf("native tests PASSED\n");
   return 0;
