@@ -100,6 +100,59 @@ def test_fuzz_zip_pipelines(seed):
             assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)
 
 
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_distributions(seed):
+    """Random block distributions (incl. zero-size team blocks): the
+    elementwise/reduce/scan surface must match numpy regardless of where
+    the blocks fall."""
+    rng = np.random.default_rng(300 + seed)
+    P = dr_tpu.nprocs()
+    for it in range(ITERS // 2):
+        n = int(rng.integers(1, 160))
+        cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+        bounds = np.concatenate(([0], cuts, [n]))
+        sizes = tuple(int(b - a) for a, b in zip(bounds[:-1], bounds[1:]))
+        src = rng.standard_normal(n).astype(np.float32)
+        dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
+        alg = rng.choice(["roundtrip", "transform", "reduce", "scan",
+                          "putget"])
+        if alg == "roundtrip":
+            np.testing.assert_allclose(dr_tpu.to_numpy(dv), src,
+                                       rtol=1e-6)
+            segs = dr_tpu.segments(dv)
+            assert [len(s) for s in segs] == [s for s in sizes if s]
+        elif alg == "transform":
+            out = dr_tpu.distributed_vector(n, np.float32,
+                                            distribution=sizes)
+            dr_tpu.transform(dv, out, lambda x: x * 0.5 - 2)
+            np.testing.assert_allclose(dr_tpu.to_numpy(out),
+                                       src * 0.5 - 2, rtol=1e-5,
+                                       atol=1e-6)
+        elif alg == "reduce":
+            got = dr_tpu.reduce(dv)
+            np.testing.assert_allclose(
+                got, float(src.astype(np.float64).sum()),
+                rtol=1e-3, atol=1e-4)
+        elif alg == "scan":
+            out = dr_tpu.distributed_vector(n, np.float32,
+                                            distribution=sizes)
+            dr_tpu.inclusive_scan(dv, out)
+            np.testing.assert_allclose(dr_tpu.to_numpy(out),
+                                       np.cumsum(src, dtype=np.float32),
+                                       rtol=1e-3, atol=1e-4)
+        else:
+            k = int(rng.integers(1, min(8, n) + 1))
+            idx = rng.choice(n, size=k, replace=False)
+            vals = rng.standard_normal(k).astype(np.float32)
+            dv.put(idx, vals)
+            np.testing.assert_allclose(np.asarray(dv.get(idx)), vals,
+                                       rtol=1e-6)
+            ref = src.copy()
+            ref[idx] = vals
+            np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref,
+                                       rtol=1e-6)
+
+
 def test_fuzz_halo_stencil():
     rng = np.random.default_rng(7)
     for it in range(8):
