@@ -1,0 +1,169 @@
+// Native communicator + logger for the host executor.
+//
+// The reference wraps an MPI communicator (`lib::communicator`,
+// include/dr/details/communicator.hpp:7-95: rank topology, barrier,
+// bcast/scatter(v)/gather(v), nonblocking p2p with the halo tag enum) and
+// a global per-rank file logger (`lib::drlog`, details/logger.hpp:7-49).
+// The host executor models P ranks inside one process, so the same
+// surface operates on per-rank value slots: collectives are memcpys, the
+// barrier is a no-op, and the ring shifts are the p2p plane the halo
+// engine uses (tag {halo_forward, halo_reverse} equivalents).  The TPU
+// executor's counterpart is dr_tpu/parallel/collectives.py (ppermute /
+// psum / all_gather over the mesh axis).
+#pragma once
+
+#include <cstdarg>
+#include <cstddef>
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace drtpu {
+
+// ---------------------------------------------------------------------------
+// communicator
+// ---------------------------------------------------------------------------
+
+class communicator {
+ public:
+  explicit communicator(std::size_t nprocs) : nprocs_(nprocs) {
+    if (!nprocs) throw std::invalid_argument("communicator: nprocs == 0");
+  }
+
+  std::size_t size() const { return nprocs_; }
+  std::size_t first() const { return 0; }
+  std::size_t last() const { return nprocs_ - 1; }
+  std::size_t prev(std::size_t rank) const {
+    return (rank + nprocs_ - 1) % nprocs_;
+  }
+  std::size_t next(std::size_t rank) const { return (rank + 1) % nprocs_; }
+
+  // All P ranks live in this process: the barrier is trivially satisfied.
+  void barrier() const {}
+
+  // slots[r] is rank r's value; bcast copies root's slot everywhere
+  // (communicator.hpp:32).
+  template <class T>
+  void bcast(std::vector<T>& slots, std::size_t root) const {
+    check_slots(slots.size());
+    if (root >= nprocs_)
+      throw std::invalid_argument("bcast: root out of range");
+    for (std::size_t r = 0; r < nprocs_; ++r)
+      if (r != root) slots[r] = slots[root];
+  }
+
+  // scatter(v): root's vector of P values lands one per rank
+  // (communicator.hpp:36-45).
+  template <class T>
+  void scatter(const std::vector<T>& values, std::vector<T>& slots) const {
+    check_slots(values.size());
+    check_slots(slots.size());
+    for (std::size_t r = 0; r < nprocs_; ++r) slots[r] = values[r];
+  }
+
+  // gather(v): every rank's value lands in root's vector, rank order
+  // (communicator.hpp:47-62).  Shared memory: every caller sees it.
+  template <class T>
+  void gather(const std::vector<T>& slots, std::vector<T>& out) const {
+    check_slots(slots.size());
+    out = slots;
+  }
+
+  // Ring shifts — the halo p2p plane (tag halo_forward / halo_reverse).
+  // Non-periodic edges keep their old value, matching the span_halo rule.
+  template <class T>
+  void shift_forward(std::vector<T>& slots, bool periodic = false) const {
+    check_slots(slots.size());
+    if (nprocs_ == 1) return;
+    T edge = slots[nprocs_ - 1];
+    for (std::size_t r = nprocs_ - 1; r > 0; --r)
+      slots[r] = slots[r - 1];
+    if (periodic) slots[0] = edge;
+  }
+
+  template <class T>
+  void shift_backward(std::vector<T>& slots, bool periodic = false) const {
+    check_slots(slots.size());
+    if (nprocs_ == 1) return;
+    T edge = slots[0];
+    for (std::size_t r = 0; r + 1 < nprocs_; ++r) slots[r] = slots[r + 1];
+    if (periodic) slots[nprocs_ - 1] = edge;
+  }
+
+  // alltoall: slots[r][c] -> out[c][r] (the transpose of the mailbox
+  // grid).  Alias-safe: builds into a temporary so alltoall(g, g) works.
+  template <class T>
+  void alltoall(const std::vector<std::vector<T>>& slots,
+                std::vector<std::vector<T>>& out) const {
+    check_slots(slots.size());
+    std::vector<std::vector<T>> t(nprocs_, std::vector<T>(nprocs_));
+    for (std::size_t r = 0; r < nprocs_; ++r) {
+      if (slots[r].size() != nprocs_)
+        throw std::invalid_argument("alltoall: ragged slot row");
+      for (std::size_t c = 0; c < nprocs_; ++c) t[c][r] = slots[r][c];
+    }
+    out = std::move(t);
+  }
+
+ private:
+  void check_slots(std::size_t got) const {
+    if (got != nprocs_)
+      throw std::invalid_argument("communicator: slot count != nprocs");
+  }
+
+  std::size_t nprocs_;
+};
+
+// ---------------------------------------------------------------------------
+// logger (lib::drlog, details/logger.hpp:7-49)
+// ---------------------------------------------------------------------------
+
+// Global logger with an optional file sink; a no-op until set_file() is
+// called (the reference compiles to nothing without DR_FORMAT — here the
+// gate is runtime instead of compile-time).  printf-style because the
+// toolchain (g++ 12) lacks <format>.
+class logger {
+ public:
+  ~logger() { close(); }
+
+  void set_file(const std::string& path) {
+    close();
+    sink_ = std::fopen(path.c_str(), "w");
+    if (!sink_) throw std::runtime_error("drlog: cannot open " + path);
+  }
+
+  void close() {
+    if (sink_) {
+      std::fclose(sink_);
+      sink_ = nullptr;
+    }
+  }
+
+  bool active() const { return sink_ != nullptr; }
+
+#if defined(__GNUC__)
+  __attribute__((format(printf, 4, 5)))
+#endif
+  void debug(const char* file, int line, const char* fmt, ...) {
+    if (!sink_) return;
+    std::fprintf(sink_, "%s:%d: ", file, line);
+    std::va_list ap;
+    va_start(ap, fmt);
+    std::vfprintf(sink_, fmt, ap);
+    va_end(ap);
+    std::fputc('\n', sink_);
+    std::fflush(sink_);
+  }
+
+ private:
+  std::FILE* sink_ = nullptr;
+};
+
+inline logger drlog;  // the global instance (lib::drlog analog)
+
+// Call-site capture like the reference's source_location prefix
+// (logger.hpp:13-28).
+#define DRTPU_LOG(...) ::drtpu::drlog.debug(__FILE__, __LINE__, __VA_ARGS__)
+
+}  // namespace drtpu

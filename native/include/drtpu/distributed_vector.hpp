@@ -41,6 +41,20 @@ struct block_distribution {
 
 enum class halo_op { second, plus, max, min, multiplies };
 
+// The ghost->owner combine rule shared by span_halo and unstructured_halo
+// (reference fold table, details/halo.hpp:92-110).
+template <class T>
+inline T halo_fold(halo_op op, T a, T b) {
+  switch (op) {
+    case halo_op::second: return b;
+    case halo_op::plus: return a + b;
+    case halo_op::max: return a > b ? a : b;
+    case halo_op::min: return a < b ? a : b;
+    case halo_op::multiplies: return a * b;
+  }
+  return b;
+}
+
 template <class T>
 class distributed_vector;
 
@@ -296,16 +310,7 @@ void span_halo<T>::reduce(halo_op op) {
   auto [prev, next, periodic] = dv.hb_;
   std::size_t P = dv.nprocs_;
   if ((!prev && !next) || (P == 1 && !periodic)) return;
-  auto fold = [op](T a, T b) -> T {
-    switch (op) {
-      case halo_op::second: return b;
-      case halo_op::plus: return a + b;
-      case halo_op::max: return a > b ? a : b;
-      case halo_op::min: return a < b ? a : b;
-      case halo_op::multiplies: return a * b;
-    }
-    return b;
-  };
+  auto fold = [op](T a, T b) -> T { return halo_fold(op, a, b); };
   // ghosts fold back into their owners (halo.hpp:73-110)
   for (std::size_t r = 0; r < P; ++r) {
     std::size_t valid = dv.valid_of(r);

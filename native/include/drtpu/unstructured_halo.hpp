@@ -1,0 +1,100 @@
+// Native unstructured (index-list) halo over the host executor.
+//
+// Reference: `index_group` / `unstructured_halo`
+// (include/dr/details/halo.hpp:148-271): per neighbor rank, an index
+// list into the local data; exchange packs owned values through the
+// index arrays into messages and unpacks into ghosts; reduce reverses
+// direction and folds with an op.  The contiguity optimization
+// (halo.hpp:161-166: unbuffered send straight from &data[indices[0]])
+// becomes irrelevant in shared memory — every transfer is a direct
+// indexed copy.
+//
+// Surface mirrors the TPU-side dr_tpu/parallel/unstructured_halo.py:
+// construct from a distributed_vector plus {rank: [global indices]}
+// ghost maps; exchange() refreshes ghosts from owners (one gather);
+// reduce(op) folds ghost contributions back into owners.
+#pragma once
+
+#include <cstddef>
+#include <map>
+#include <span>
+#include <stdexcept>
+#include <vector>
+
+#include "distributed_vector.hpp"
+
+namespace drtpu {
+
+template <class T>
+class unstructured_halo {
+ public:
+  // ghost_indices[r] = the GLOBAL element indices rank r mirrors.
+  unstructured_halo(distributed_vector<T>& dv,
+                    const std::map<std::size_t, std::vector<std::size_t>>&
+                        ghost_indices)
+      : dv_(&dv) {
+    // one flat ghost buffer carved per rank (halo.hpp:27-51)
+    for (auto& [rank, indices] : ghost_indices) {
+      if (rank >= dv.nprocs())
+        throw std::invalid_argument("unstructured_halo: rank out of range");
+      if (indices.empty()) continue;
+      for (auto i : indices)
+        if (i >= dv.size())
+          throw std::invalid_argument(
+              "unstructured_halo: index out of range");
+      offsets_[rank] = {flat_.size(), flat_.size() + indices.size()};
+      flat_.insert(flat_.end(), indices.begin(), indices.end());
+    }
+    ghost_.assign(flat_.size(), T{});
+  }
+
+  // owner -> ghost: refresh every mirrored value (halo.hpp:55-70).
+  void exchange() {
+    auto& dv = *dv_;
+    for (std::size_t k = 0; k < flat_.size(); ++k) ghost_[k] = dv[flat_[k]];
+  }
+  void exchange_begin() { exchange(); }
+  void exchange_finalize() {}
+
+  std::span<T> ghost_values(std::size_t rank) {
+    auto it = offsets_.find(rank);
+    if (it == offsets_.end()) return {};
+    auto [a, b] = it->second;
+    return {ghost_.data() + a, b - a};
+  }
+
+  void set_ghost_values(std::size_t rank, std::span<const T> values) {
+    auto it = offsets_.find(rank);
+    if (it == offsets_.end() || values.size() != it->second.second -
+                                                     it->second.first)
+      throw std::invalid_argument("set_ghost_values: bad rank or size");
+    std::copy(values.begin(), values.end(),
+              ghost_.begin() +
+                  static_cast<std::ptrdiff_t>(it->second.first));
+  }
+
+  // ghost -> owner: fold contributions back (halo.hpp:73-110).  Unlike
+  // exchange, duplicates fold sequentially in flat order (the reference's
+  // unpack loop semantics).
+  void reduce(halo_op op) {
+    auto& dv = *dv_;
+    for (std::size_t k = 0; k < flat_.size(); ++k) {
+      T& dst = dv[flat_[k]];
+      dst = halo_fold(op, dst, ghost_[k]);
+    }
+  }
+  void reduce_begin(halo_op op) { reduce(op); }
+  void reduce_finalize() {}
+  void reduce_plus() { reduce(halo_op::plus); }
+  void reduce_max() { reduce(halo_op::max); }
+  void reduce_min() { reduce(halo_op::min); }
+  void reduce_multiplies() { reduce(halo_op::multiplies); }
+
+ private:
+  distributed_vector<T>* dv_;
+  std::vector<std::size_t> flat_;
+  std::vector<T> ghost_;
+  std::map<std::size_t, std::pair<std::size_t, std::size_t>> offsets_;
+};
+
+}  // namespace drtpu

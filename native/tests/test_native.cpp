@@ -9,11 +9,13 @@
 #include <vector>
 
 #include <drtpu/algorithms.hpp>
+#include <drtpu/communicator.hpp>
 #include <drtpu/distributed_vector.hpp>
 #include <drtpu/iterator_adaptor.hpp>
 #include <drtpu/matrix.hpp>
 #include <drtpu/remote_span.hpp>
 #include <drtpu/segment_tools.hpp>
+#include <drtpu/unstructured_halo.hpp>
 #include <drtpu/views.hpp>
 #include <drtpu/vocabulary.hpp>
 
@@ -428,6 +430,117 @@ static int test_distribution(std::size_t P) {
   return 0;
 }
 
+static int test_communicator(std::size_t P) {
+  drtpu::communicator comm(P);
+  CHECK(comm.size() == P && comm.first() == 0 && comm.last() == P - 1);
+  CHECK(comm.next(P - 1) == 0 && comm.prev(0) == P - 1);
+  comm.barrier();
+
+  // bcast: root's slot lands everywhere
+  std::vector<double> slots(P);
+  for (std::size_t r = 0; r < P; ++r) slots[r] = double(r);
+  comm.bcast(slots, P - 1);
+  for (auto v : slots) CHECK(v == double(P - 1));
+
+  // scatter / gather round-trip in rank order
+  std::vector<double> vals(P), got;
+  for (std::size_t r = 0; r < P; ++r) vals[r] = 10.0 + double(r);
+  comm.scatter(vals, slots);
+  comm.gather(slots, got);
+  CHECK(got == vals);
+
+  // ring shifts: non-periodic keeps the edge, periodic wraps
+  comm.scatter(vals, slots);
+  comm.shift_forward(slots, /*periodic=*/false);
+  CHECK(slots[0] == vals[0]);  // edge kept
+  if (P > 1) CHECK(slots[1] == vals[0] && slots[P - 1] == vals[P - 2]);
+  comm.scatter(vals, slots);
+  comm.shift_backward(slots, /*periodic=*/true);
+  CHECK(slots[P - 1] == vals[0]);
+  if (P > 1) CHECK(slots[0] == vals[1]);
+
+  // alltoall transposes the mailbox grid; in-place aliasing is safe
+  std::vector<std::vector<double>> grid(P, std::vector<double>(P)), tg;
+  for (std::size_t r = 0; r < P; ++r)
+    for (std::size_t c = 0; c < P; ++c) grid[r][c] = double(r * P + c);
+  comm.alltoall(grid, tg);
+  for (std::size_t r = 0; r < P; ++r)
+    for (std::size_t c = 0; c < P; ++c) CHECK(tg[c][r] == grid[r][c]);
+  comm.alltoall(tg, tg);  // transpose back in place
+  CHECK(tg == grid);
+
+  // out-of-range bcast root throws instead of reading past the slots
+  bool threw = false;
+  try {
+    comm.bcast(slots, P);
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  return 0;
+}
+
+static int test_unstructured_halo(std::size_t P) {
+  using drtpu::unstructured_halo;
+  std::size_t n = 6 * P;
+  distributed_vector<double> dv(n, P);
+  drtpu::iota(dv, 0.0);
+
+  // every rank mirrors the first and last global element plus a middle one
+  std::map<std::size_t, std::vector<std::size_t>> ghosts;
+  for (std::size_t r = 0; r < P; ++r)
+    ghosts[r] = {0, n / 2, n - 1};
+  unstructured_halo<double> uh(dv, ghosts);
+
+  uh.exchange();
+  for (std::size_t r = 0; r < P; ++r) {
+    auto g = uh.ghost_values(r);
+    CHECK(g.size() == 3);
+    CHECK(g[0] == 0.0 && g[1] == double(n / 2) && g[2] == double(n - 1));
+  }
+
+  // contributions fold back into owners (plus), duplicates accumulate:
+  // every rank contributes 1.0 to each mirrored element
+  for (std::size_t r = 0; r < P; ++r) {
+    std::vector<double> ones(3, 1.0);
+    uh.set_ghost_values(r, std::span<const double>(ones));
+  }
+  uh.reduce(drtpu::halo_op::plus);
+  CHECK(dv[0] == 0.0 + double(P));
+  CHECK(dv[n / 2] == double(n / 2) + double(P));
+  CHECK(dv[n - 1] == double(n - 1) + double(P));
+
+  // op=second overwrites (last contribution wins over duplicates)
+  for (std::size_t r = 0; r < P; ++r) {
+    std::vector<double> v = {5.0, 6.0, 7.0};
+    uh.set_ghost_values(r, std::span<const double>(v));
+  }
+  uh.reduce(drtpu::halo_op::second);
+  CHECK(dv[0] == 5.0 && dv[n / 2] == 6.0 && dv[n - 1] == 7.0);
+
+  // validation: out-of-range index / rank throw
+  bool threw = false;
+  try {
+    std::map<std::size_t, std::vector<std::size_t>> bad{{0, {n}}};
+    unstructured_halo<double> b(dv, bad);
+    (void)b;
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  // out-of-range rank throws even with an empty index list
+  threw = false;
+  try {
+    std::map<std::size_t, std::vector<std::size_t>> bad{{P + 7, {}}};
+    unstructured_halo<double> b(dv, bad);
+    (void)b;
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  return 0;
+}
+
 int main() {
   if (test_concepts()) return 1;
   for (std::size_t P : {1, 2, 3, 4, 8}) {
@@ -439,6 +552,26 @@ int main() {
     if (test_views(P)) return 1;
     if (test_matrix(P)) return 1;
     if (test_distribution(P)) return 1;
+    if (test_communicator(P)) return 1;
+    if (test_unstructured_halo(P)) return 1;
+  }
+  {
+    // logger: no-op until a sink is set; writes call-site-prefixed lines
+    char path[] = "/tmp/drtpu_log_test.txt";
+    DRTPU_LOG("dropped (no sink yet), value=%d", 1);
+    drtpu::drlog.set_file(path);
+    DRTPU_LOG("halo exchange rank=%d n=%zu", 3, std::size_t{42});
+    drtpu::drlog.close();
+    std::FILE* f = std::fopen(path, "r");
+    CHECK(f != nullptr);
+    char buf[256] = {0};
+    CHECK(std::fgets(buf, sizeof buf, f) != nullptr);
+    std::fclose(f);
+    std::remove(path);
+    std::string line(buf);
+    CHECK(line.find("test_native.cpp") != std::string::npos);
+    CHECK(line.find("halo exchange rank=3 n=42") != std::string::npos);
+    CHECK(line.find("dropped") == std::string::npos);
   }
   std::printf("native tests PASSED\n");
   return 0;
