@@ -7,9 +7,31 @@ from __future__ import annotations
 import numpy as np
 
 import jax.numpy as jnp
+from jax import lax
 
 __all__ = ["layout_geometry", "owned_window_mask", "uniform_layout",
-           "combine_for", "MONOID_COMBINE"]
+           "double_buffered_loop", "combine_for", "MONOID_COMBINE"]
+
+
+def double_buffered_loop(step, steps, x, y):
+    """Run ``steps`` applications of ``y' = step(x, y)`` with buffer
+    swapping, returning (final, other).
+
+    Two steps per fori_loop iteration keep the carry order (x, y) stable —
+    a swapped carry forces XLA to copy both arrays every iteration
+    (2x HBM traffic and 2x peak memory).  The odd remainder runs outside
+    the loop with a trace-level swap.
+    """
+    def two(i, xy):
+        u, v = xy
+        v = step(u, v)
+        u = step(v, u)
+        return (u, v)
+    x, y = lax.fori_loop(0, steps // 2, two, (x, y))
+    if steps % 2:
+        y = step(x, y)
+        x, y = y, x
+    return x, y
 
 MONOID_COMBINE = {
     "add": jnp.add,

@@ -26,7 +26,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import uniform_layout
+from ._common import double_buffered_loop, uniform_layout
 from .elementwise import _out_chain, _prog_cache, _resolve
 from ..parallel.halo import _ring_perms
 
@@ -166,19 +166,7 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
                                   hb.prev, hb.next, cont.runtime.axis)
 
         def loop(a, b):
-            # Two steps per iteration keep the carry order (a, b) stable:
-            # a swapped carry forces XLA to copy both arrays every
-            # iteration (2x HBM traffic and 2x peak memory).
-            def two(i, ab):
-                x, y = ab
-                y = step(x, y)
-                x = step(y, x)
-                return (x, y)
-            a, b = lax.fori_loop(0, steps // 2, two, (a, b))
-            if steps % 2:
-                b = step(a, b)
-                a, b = b, a
-            return a, b
+            return double_buffered_loop(step, steps, a, b)
 
         shmapped = jax.shard_map(
             loop, mesh=cont.runtime.mesh,

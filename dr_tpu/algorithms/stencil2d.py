@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ._common import double_buffered_loop
 from .elementwise import _prog_cache
 from ..containers.dense_matrix import dense_matrix
 
@@ -86,11 +87,7 @@ def stencil2d_iterate(a: dense_matrix, b: dense_matrix,
         step = _build_step(m, n, mm, nn, weights, a.dtype)
 
         def loop(x, y):
-            def one(i, xy):
-                u, v = xy
-                v = step(u, v)
-                return (v, u)
-            return lax.fori_loop(0, steps, one, (x, y))
+            return double_buffered_loop(step, steps, x, y)
 
         prog = jax.jit(loop, donate_argnums=(0, 1))
         _prog_cache[key] = prog

@@ -46,6 +46,24 @@ def test_heat_iterated():
                                atol=1e-5)
 
 
+def test_heat_iterated_odd_steps():
+    """Odd step counts exercise the two-per-iteration loop's remainder
+    path (and steps=1 the degenerate zero-iteration case)."""
+    m, n = 19, 21
+    src = np.random.default_rng(2).standard_normal((m, n))\
+        .astype(np.float32)
+    w = dr_tpu.heat_step_weights(0.25)
+    for steps in (1, 3, 5):
+        A = dr_tpu.dense_matrix.from_array(src)
+        B = dr_tpu.dense_matrix.from_array(src)
+        out = dr_tpu.stencil2d_iterate(A, B, w, steps=steps)
+        ref = src.astype(np.float64)
+        for _ in range(steps):
+            ref = _serial_step(ref, w)
+        np.testing.assert_allclose(out.materialize(), ref, rtol=1e-3,
+                                   atol=1e-5)
+
+
 def test_heat_converges_to_mean():
     # physical sanity: with fixed zero boundary, interior decays
     m = n = 16
