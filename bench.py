@@ -6,18 +6,22 @@ Prints ONE JSON line:
 
 Workload (BASELINE.json north star): iterated 1-D 5-point stencil (radius
 2) with halo exchange over a ~1B-element vector, target >= 70% of HBM
-bandwidth per chip.  Two implementations:
+bandwidth per chip.  Three implementations (TPU tries matmul -> pallas ->
+xla, falling back on failure so the driver always records a number):
 
 - ``xla`` — one jitted program per run (fused ppermute halo exchange +
   shifted weighted sum + lax.fori_loop double buffering); each step reads
   and writes the whole vector, so the rate is physical HBM traffic.
-- ``pallas`` (TPU default) — the temporally-blocked kernel fuses
-  ``tblock`` steps per HBM pass, so the reported *effective* bandwidth
-  (2 x 4 bytes x n x steps / time) can exceed physical peak by up to
-  ``tblock``-fold: that headroom over the bandwidth bound is the point of
-  the kernel.  ``detail.phys_gbps`` estimates the physical traffic rate.
-  If the pallas path fails (e.g. a Mosaic lowering regression), the
-  benchmark falls back to the xla path instead of dying.
+- ``pallas`` — the temporally-blocked VMEM kernel fuses ``tblock`` steps
+  per HBM pass; VPU compute-bound near 0.9 TB/s effective on v5e.
+- ``matmul`` (TPU default) — composes ``tblock`` steps into one banded
+  Toeplitz operator applied as lane-column matmuls on the MXU
+  (ops/stencil_matmul.py); ~5x the pallas path's effective rate.
+
+For the blocked paths the reported *effective* bandwidth (2 x 4 bytes x
+n x steps / time) can exceed physical peak by up to ``tblock``-fold:
+that headroom over the bandwidth bound is the point of temporal
+blocking.  ``detail.phys_gbps`` estimates the physical traffic rate.
 
 vs_baseline: achieved effective GB/s divided by the north-star target
 (0.7 x the chip's peak HBM bandwidth).  The reference publishes no
@@ -64,13 +68,25 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     dict.  Raises on any non-OOM failure (caller decides the fallback)."""
     import dr_tpu
     from dr_tpu.algorithms.stencil import (stencil_iterate,
-                                           stencil_iterate_blocked)
+                                           stencil_iterate_blocked,
+                                           stencil_iterate_matmul)
     from dr_tpu.ops import stencil_pallas
 
     pallas = impl == "pallas"
+    matmul = impl == "matmul"
+    blocked = pallas or matmul
     w = [0.05, 0.25, 0.4, 0.25, 0.05]
     radius = 2
-    if pallas:
+    if matmul:
+        from dr_tpu.ops import stencil_matmul
+        # composed band must fit one lane column
+        la = stencil_matmul.LANES
+        tblock = min(tblock, stencil_matmul.max_ksteps(radius))
+        halo_w = max(la, -(-tblock * radius // la) * la)
+        # the chunked apply peaks near 3x the row (input copy + stacked
+        # chunk outputs + output); cap so it fits 16 GB HBM with margin
+        n = min(n, 2 ** 29)
+    elif pallas:
         # Mosaic tile alignment: halo is whole (8, 128) f32 tiles
         ra = stencil_pallas.ROW_ALIGN
         halo_w = max(ra, -(-tblock * radius // ra) * ra)
@@ -79,14 +95,16 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     # periodic ring: every element computed every step on both paths
     hb = dr_tpu.halo_bounds(halo_w, halo_w, periodic=True)
     nshards = dr_tpu.nprocs()
-    # pallas path: shards must be whole DMA chunks; never round below one
-    align = nshards * 2 ** 17 if pallas else nshards
+    # blocked paths: shards must be whole aligned chunks; never below one
+    align = nshards * 2 ** 17 if blocked else nshards
     n = max(align, n - n % align)
 
     dtype = np.float32
     a = b = None
 
     def run(nsteps):
+        if matmul:
+            return stencil_iterate_matmul(a, w, nsteps, k_block=tblock)
         if pallas:
             return stencil_iterate_blocked(a, w, nsteps,
                                            time_block=tblock,
@@ -97,7 +115,7 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
         try:
             a = dr_tpu.distributed_vector(n, dtype, halo=hb)
             dr_tpu.fill(a, 1.0)
-            if not pallas:  # pallas path steps in place, no 2nd buffer
+            if not blocked:  # blocked paths step in place, no 2nd buffer
                 b = dr_tpu.distributed_vector(n, dtype, halo=hb)
                 dr_tpu.fill(b, 1.0)
             # warmup / compile; also surfaces OOM for backoff.  XLA path:
@@ -105,7 +123,7 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
             # Pallas path: one full block + the remainder block compiles
             # both cached programs without paying the full timed run.
             nfull, rest = divmod(steps, tblock)
-            warm = steps if not pallas else \
+            warm = steps if not blocked else \
                 min(steps, tblock * min(nfull, 1) + rest)
             _sync(run(warm))
             break
@@ -114,8 +132,12 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
             if attempt == 2 or not oom:
                 raise
             a = b = None  # release this attempt's buffers before retrying
-            n //= 4  # back off on OOM
-            n = max(align, n - n % align)
+        # backoff OUTSIDE the except block: while it is live, the
+        # exception's traceback pins callee frames (and their buffers),
+        # so collecting/sleeping inside would wait for nothing
+        _settle(2.0)
+        n //= 4  # back off on OOM
+        n = max(align, n - n % align)
 
     t0 = time.perf_counter()
     out = run(steps)
@@ -127,10 +149,19 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     gbps = bytes_eff / dt / 1e9
     # physical traffic: the pallas path touches HBM once per tblock steps
     nfull, rest = divmod(steps, tblock)
-    passes = steps if not pallas else nfull + (1 if rest else 0)
+    passes = steps if not blocked else nfull + (1 if rest else 0)
     phys_gbps = 2.0 * n * np.dtype(dtype).itemsize * passes / dt / 1e9
     return {"n": n, "steps": steps, "seconds": round(dt, 4), "impl": impl,
             "gbps": gbps, "phys_gbps": phys_gbps}
+
+
+def _settle(seconds):
+    """gc + pause so asynchronous (tunneled) device deallocs land before
+    the next allocation.  Call with no exception in flight: a live
+    traceback pins the failed frames' buffers and defeats the wait."""
+    import gc
+    gc.collect()
+    time.sleep(seconds)
 
 
 def _time_best(fn, iters=3):
@@ -141,6 +172,24 @@ def _time_best(fn, iters=3):
         fn()
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def _time_amortized(dispatch, sync, calls=16, batches=3):
+    """Median per-call time of ``calls`` async dispatches + ONE sync.
+
+    The host<->device control link (a tunneled RPC under axon) costs tens
+    of milliseconds per round trip; syncing every call would measure the
+    link, not the device.  Dispatches queue on the device, so batch-time /
+    calls is the genuine per-op device time once calls >> 1."""
+    times = []
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(calls):
+            last = dispatch()
+        sync(last)
+        times.append((time.perf_counter() - t0) / calls)
+    return float(np.median(times))
 
 
 def _secondary_metrics(on_cpu: bool) -> dict:
@@ -161,8 +210,9 @@ def _secondary_metrics(on_cpu: bool) -> dict:
         b = dr_tpu.distributed_vector(n, np.float32)
         dr_tpu.fill(a, 1.5)
         dr_tpu.fill(b, 2.0)
-        dr_tpu.dot(a, b)  # warm/compile; returns a host scalar (synced)
-        dt = _time_best(lambda: dr_tpu.dot(a, b))
+        dr_tpu.dot(a, b)  # warm/compile (synced once)
+        dt = _time_amortized(lambda: dr_tpu.dot_async(a, b),
+                             lambda v: float(v))
         out["dot_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["dot_error"] = repr(e)[:160]
@@ -176,11 +226,8 @@ def _secondary_metrics(on_cpu: bool) -> dict:
         s = dr_tpu.distributed_vector(n, np.float32)
         dr_tpu.iota(a, 0)
         dr_tpu.inclusive_scan(a, s)  # warm
-
-        def run_scan():
-            dr_tpu.inclusive_scan(a, s)
-            _sync(s)
-        dt = _time_best(run_scan)
+        dt = _time_amortized(lambda: dr_tpu.inclusive_scan(a, s),
+                             lambda _: _sync(s), calls=8)
         out["scan_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["scan_error"] = repr(e)[:160]
@@ -198,14 +245,12 @@ def _secondary_metrics(on_cpu: bool) -> dict:
         h = v.halo()
         h.exchange()  # warm/compile
         _sync(v)
-        times = []
-        for _ in range(51):
-            t0 = time.perf_counter()
-            h.exchange()
-            _sync(v)
-            times.append(time.perf_counter() - t0)
-        out["halo_exchange_p50_us"] = round(
-            float(np.median(times)) * 1e6, 1)
+        dt = _time_amortized(h.exchange, lambda _: _sync(v),
+                             calls=64, batches=5)
+        # amortized: median over batches of (64 queued exchanges /
+        # one sync); an individually-synced p50 would measure the
+        # tunneled control link, not the device
+        out["halo_exchange_amortized_p50_us"] = round(dt * 1e6, 1)
     except Exception as e:  # pragma: no cover - defensive
         out["halo_error"] = repr(e)[:160]
     finally:
@@ -221,11 +266,9 @@ def _secondary_metrics(on_cpu: bool) -> dict:
         A = dr_tpu.dense_matrix.from_array(src)
         B = dr_tpu.dense_matrix.from_array(src)
         dr_tpu.stencil2d_iterate(A, B, w, steps=steps)  # warm
-
-        def run_heat():
-            out_m = dr_tpu.stencil2d_iterate(A, B, w, steps=steps)
-            _sync(out_m)
-        dt = _time_best(run_heat)
+        dt = _time_amortized(
+            lambda: dr_tpu.stencil2d_iterate(A, B, w, steps=steps),
+            _sync, calls=8)
         out["heat2d_gbps"] = round(
             2.0 * m * m * itemsize * steps / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
@@ -247,11 +290,8 @@ def _secondary_metrics(on_cpu: bool) -> dict:
         dr_tpu.fill(bv, 1.0)
         dr_tpu.fill(c, 0.0)
         dr_tpu.gemv(c, A, bv)  # warm
-
-        def run_spmv():
-            dr_tpu.gemv(c, A, bv)
-            _sync(c)
-        dt = _time_best(run_spmv)
+        dt = _time_amortized(lambda: dr_tpu.gemv(c, A, bv),
+                             lambda _: _sync(c), calls=16)
         out["spmv_gflops"] = round(2.0 * m * k / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["spmv_error"] = repr(e)[:160]
@@ -269,37 +309,42 @@ def main():
 
     dev = jax.devices()[0]
     on_cpu = dev.platform == "cpu"
-    # default: temporally-blocked Pallas kernel on TPU, XLA path elsewhere
-    # (interpret-mode pallas is far too slow for a benchmark)
-    impl = os.environ.get(
-        "DR_TPU_BENCH_IMPL",
-        "pallas" if dev.platform == "tpu" and stencil_pallas.supported()
-        else "xla").strip().lower()
-    pallas = impl == "pallas"
-    steps = int(os.environ.get("DR_TPU_BENCH_STEPS",
-                               "256" if pallas else "16"))
+    on_tpu = dev.platform == "tpu"
+    # default chain on TPU: MXU composed-operator matmul path, then the
+    # Pallas VMEM kernel, then plain XLA; elsewhere XLA only (interpret-
+    # mode pallas is far too slow for a benchmark)
+    if "DR_TPU_BENCH_IMPL" in os.environ:
+        chain = [os.environ["DR_TPU_BENCH_IMPL"].strip().lower()]
+    elif on_tpu:
+        chain = ["matmul"] + (["pallas"] if stencil_pallas.supported()
+                              else []) + ["xla"]
+    else:
+        chain = ["xla"]
     tblock = int(os.environ.get("DR_TPU_BENCH_TBLOCK", "64"))
     if on_cpu and "DR_TPU_BENCH_N" not in os.environ:
         n = 2 ** 24  # keep CPU smoke runs fast
 
-    xla_steps = int(os.environ.get("DR_TPU_BENCH_STEPS", "16"))
-
     dr_tpu.init(jax.devices())
     res = None
-    try:
-        res = _measure(impl, n, steps, tblock)
-    except Exception:
-        if not pallas or "DR_TPU_BENCH_IMPL" in os.environ:
-            raise
-        # the blocked kernel failed outright — report it and fall back to
-        # the XLA path so the driver still records a number for the round
-        import traceback
-        traceback.print_exc(file=sys.stderr)
-        print("pallas path failed; falling back to xla", file=sys.stderr)
-    if res is None:
-        # retried outside the except block: a live exception traceback
-        # would pin the failed attempt's device buffers during the retry
-        res = _measure("xla", n, xla_steps, tblock)
+    for i, impl in enumerate(chain):
+        blocked = impl in ("pallas", "matmul")
+        steps = int(os.environ.get("DR_TPU_BENCH_STEPS",
+                                   "512" if blocked else "16"))
+        try:
+            res = _measure(impl, n, steps, tblock)
+            break
+        except Exception:
+            if i + 1 == len(chain):
+                raise
+            # report the failure and fall back so the driver still
+            # records a number for the round
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            print(f"{impl} path failed; falling back to {chain[i + 1]}",
+                  file=sys.stderr)
+        # settle OUTSIDE the except block (the live traceback pins the
+        # failed attempt's device buffers) before the next impl allocates
+        _settle(3.0)
 
     nchips = 1  # single-controller measurement is per chip
     peak = _peak_for(dev)

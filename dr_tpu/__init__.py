@@ -50,7 +50,9 @@ from .views import views
 from .views.views import aligned, local_segments
 from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
                                      transform, to_numpy)
-from .algorithms.reduce import reduce, transform_reduce, dot
+from .algorithms.reduce import (reduce, transform_reduce, dot,
+                                reduce_async, transform_reduce_async,
+                                dot_async)
 from .algorithms.scan import inclusive_scan, exclusive_scan
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
@@ -71,6 +73,7 @@ __all__ = [
     "views", "aligned", "local_segments",
     "fill", "iota", "copy", "copy_async", "for_each", "transform",
     "to_numpy", "reduce", "transform_reduce", "dot",
+    "reduce_async", "transform_reduce_async", "dot_async",
     "inclusive_scan", "exclusive_scan",
     "stencil_transform", "stencil_iterate",
     "stencil2d_transform", "stencil2d_iterate", "heat_step_weights",

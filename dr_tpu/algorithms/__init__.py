@@ -1,9 +1,11 @@
 from .elementwise import (fill, iota, copy, copy_async, for_each, transform,
                           to_numpy)
-from .reduce import reduce, transform_reduce, dot
+from .reduce import (reduce, transform_reduce, dot, reduce_async,
+                     transform_reduce_async, dot_async)
 from .scan import inclusive_scan, exclusive_scan
 from .stencil import (stencil_transform, stencil_iterate,
-                      stencil_iterate_blocked)
+                      stencil_iterate_blocked,
+                      stencil_iterate_matmul)
 from .stencil2d import stencil2d_transform, stencil2d_iterate, \
     heat_step_weights
 from .gemv import gemv, flat_gemv, gemm

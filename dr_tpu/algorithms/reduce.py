@@ -27,7 +27,8 @@ from ._common import owned_window_mask
 from .elementwise import _Chain, _prog_cache, _resolve
 from ..views import views as _v
 
-__all__ = ["reduce", "transform_reduce", "dot"]
+__all__ = ["reduce", "transform_reduce", "dot",
+           "reduce_async", "transform_reduce_async", "dot_async"]
 
 
 # known monoids: (jnp vector-reduce, identity)
@@ -65,10 +66,13 @@ def _identity_for(kind: str, dtype):
     raise ValueError(kind)
 
 
-def _fused_reduce_program(chains, kind):
+def _fused_reduce_program(chains, kind, zip_op=None):
     """Masked fused reduce over padded shard arrays — zero reshaping,
-    zero gather: XLA lowers the cross-shard combine to an all-reduce."""
-    key = ("red", tuple(c.key for c in chains), kind)
+    zero gather: XLA lowers the cross-shard combine to an all-reduce.
+    Multi-chain (zip) inputs are combined elementwise by ``zip_op`` before
+    the reduction, so ``dot`` reads each input exactly once."""
+    key = ("red", tuple(c.key for c in chains), kind,
+           id(zip_op) if zip_op is not None else None)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -84,9 +88,7 @@ def _fused_reduce_program(chains, kind):
             for o in ops:
                 v = o(v)
             vals.append(v)
-        v = vals[0]
-        for extra in vals[1:]:  # zipped chains already combined by ops
-            v = v * extra  # pragma: no cover - only dot uses multi-chain
+        v = zip_op(*vals) if zip_op is not None else vals[0]
         mask, _gid = owned_window_mask(layout, off, n)
         ident = _identity_for(kind, v.dtype)
         return vec_reduce(jnp.where(mask, v, ident))
@@ -96,15 +98,43 @@ def _fused_reduce_program(chains, kind):
     return prog
 
 
-def reduce(r, init=None, op: Callable = None):
-    """Collective reduction; returns a host scalar (valid on all ranks)."""
+def _zip_reduce_chains(r):
+    """(chains, zip_op) when ``r`` is a transform over a zip of aligned
+    same-window container chains — the dot-product pipeline shape
+    (``examples/shp/dot_product.cpp:11-18``) — else None."""
+    if not (isinstance(r, _v.transform) and isinstance(r.base, _v.zip_view)):
+        return None
+    chains = _resolve(r.base)
+    if not chains:
+        return None
+    c0 = chains[0]
+    if not all(c.cont.layout == c0.cont.layout and c.off == c0.off
+               and c.n == c0.n for c in chains[1:]):
+        return None
+    return chains, r.op
+
+
+def reduce_async(r, op: Callable = None):
+    """Like :func:`reduce` but returns the DEVICE scalar without waiting —
+    the analog of the reference's oneDPL ``reduce_async`` path
+    (``shp/algorithms/reduce.hpp:42-88``): the reduction is enqueued and
+    the caller folds/syncs when ready (``jax.block_until_ready`` or any
+    host conversion acts as the future's ``.get()``)."""
     kind = _classify_op(op)
-    chains = None
+    chains = zip_op = None
     if kind is not None:
-        # fuse transform-over-zip pipelines where the zip multiplies out
         chains = _resolve(r) if not isinstance(r, _v.zip_view) else None
-    if chains is not None and len(chains) == 1:
-        val = _fused_reduce_program(chains, kind)(chains[0].cont._data)
+        if chains is not None and len(chains) != 1:
+            chains = None
+        if chains is None:
+            # transform-over-zip (the dot pipeline): fuse the zip combine
+            # into the same single-pass program
+            zipped = _zip_reduce_chains(r)
+            if zipped is not None:
+                chains, zip_op = zipped
+    if chains is not None:
+        val = _fused_reduce_program(chains, kind, zip_op)(
+            *[c.cont._data for c in chains])
     else:
         arr = r.to_array() if hasattr(r, "to_array") else jnp.asarray(r)
         assert not isinstance(arr, tuple), \
@@ -113,6 +143,12 @@ def reduce(r, init=None, op: Callable = None):
             val = _MONOIDS[kind][0](arr)
         else:
             val = _generic_reduce(arr, op)
+    return val
+
+
+def reduce(r, init=None, op: Callable = None):
+    """Collective reduction; returns a host scalar (valid on all ranks)."""
+    val = reduce_async(r, op)
     if init is not None:
         pyop = op if op is not None else operator.add
         return pyop(init, val.item())
@@ -148,8 +184,21 @@ def transform_reduce(r, init=None, reduce_op=None, transform_op=None):
     return reduce(_v.transform(r, transform_op), init, reduce_op)
 
 
+def transform_reduce_async(r, reduce_op=None, transform_op=None):
+    """Async :func:`transform_reduce`: returns the device scalar."""
+    if transform_op is None:
+        transform_op = _identity
+    return reduce_async(_v.transform(r, transform_op), reduce_op)
+
+
 def dot(a, b, init=None):
     """Dot product — the reference's headline SHP example
     (``examples/shp/dot_product.cpp:11-18``): zip | transform(*) | reduce."""
     z = _v.zip_view(a, b)
     return reduce(_v.transform(z, _multiply2), init, operator.add)
+
+
+def dot_async(a, b):
+    """Async dot product: the fused program's device scalar, no host sync."""
+    z = _v.zip_view(a, b)
+    return reduce_async(_v.transform(z, _multiply2), operator.add)

@@ -29,6 +29,33 @@ from .reduce import _classify_op, _identity_for
 __all__ = ["inclusive_scan", "exclusive_scan"]
 
 
+_BLOCK = 1024  # whole f32 vreg rows (8 sublanes x 128 lanes)
+
+
+def _blocked_scan(combine, x, ident):
+    """Inclusive scan of a 1-D array via (rows, 1024) blocking.
+
+    ``lax.associative_scan`` over a flat 2^27-element axis emits ~27
+    levels of full-size slice/concat intermediates, which can exhaust the
+    TPU compiler; scanning lane-blocked rows needs only 10 shallow levels
+    on tile-aligned 2-D arrays plus a recursive scan of the per-row
+    totals.  Requires an identity element; callers without one fall back
+    to the flat scan.
+    """
+    n = x.shape[0]
+    if ident is None or n <= 2 * _BLOCK:
+        return lax.associative_scan(combine, x)
+    pad = (-n) % _BLOCK
+    if pad:
+        x = jnp.concatenate([x, jnp.full((pad,), ident, x.dtype)])
+    rows = x.reshape(-1, _BLOCK)
+    rs = lax.associative_scan(combine, rows, axis=1)
+    carry = _blocked_scan(combine, rs[:, -1], ident)
+    carry = jnp.concatenate(
+        [jnp.full((1,), ident, x.dtype), carry[:-1]])
+    return combine(carry[:, None], rs).reshape(-1)[:n]
+
+
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
     key = ("scan", id(mesh), axis, layout, kind, id(op) if kind is None
            else None, exclusive, str(dtype))
@@ -46,7 +73,8 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
         gid = r * seg + jnp.arange(seg)
         if ident is not None:
             x = jnp.where(gid < n, x, ident)
-        local = lax.associative_scan(combine, x)
+        local = _blocked_scan(combine, x,
+                              ident if kind is not None else None)
         totals = lax.all_gather(local[-1], axis)          # (nshards,)
         # exclusive fold of totals from ranks < r  ->  my carry
         if ident is not None:
@@ -106,7 +134,9 @@ def _scan(in_r, out, op, init, exclusive):
         arr = in_r.to_array() if hasattr(in_r, "to_array") \
             else jnp.asarray(in_r)
         combine = combine_for(kind, op)
-        scanned = lax.associative_scan(combine, arr)
+        scanned = _blocked_scan(
+            combine, arr,
+            _identity_for(kind, arr.dtype) if kind is not None else None)
         if exclusive:
             ident = (_identity_for(kind, arr.dtype) if kind is not None
                      else arr[0] * 0)

@@ -31,7 +31,7 @@ from .elementwise import _out_chain, _prog_cache, _resolve
 from ..parallel.halo import _ring_perms
 
 __all__ = ["stencil_transform", "stencil_iterate", "build_stencil_step",
-           "stencil_iterate_blocked"]
+           "stencil_iterate_blocked", "stencil_iterate_matmul"]
 
 
 def _shift_window(row, d, prev, seg):
@@ -117,13 +117,18 @@ def stencil_transform(in_dv, out_dv, op: Union[Callable, Sequence[float]],
         "stencils require the uniform block distribution"
     hb = cont.halo_bounds
     prev = nxt = radius if radius is not None else None
-    if prev is None:
-        prev, nxt = hb.prev, hb.next
     if callable(op):
         key_op = id(op)
         body_op = op
+        if prev is None:
+            prev, nxt = hb.prev, hb.next
     else:
         body_op, key_op = _weights_op(op, cont.dtype)
+        if prev is None:
+            # weight vectors fix the radius themselves; the halo may be wider
+            prev = nxt = (len(key_op) - 1) // 2
+        assert hb.prev >= prev and hb.next >= nxt, \
+            "halo narrower than the weight-stencil radius"
     key = ("stencil", id(cont.runtime.mesh), cont.layout, hb.periodic,
            prev, nxt, key_op, str(cont.dtype))
     prog = _prog_cache.get(key)
@@ -156,14 +161,21 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
     if callable(op):
         key_op = id(op)
         body_op = op
+        prev, nxt = hb.prev, hb.next
     else:
         body_op, key_op = _weights_op(op, cont.dtype)
+        # the stencil radius is set by the weight vector, which may be
+        # narrower than the container's halo (e.g. wide blocked-path halos)
+        rad = (len(key_op) - 1) // 2
+        prev = nxt = rad
+        assert hb.prev >= rad and hb.next >= rad, \
+            "halo narrower than the weight-stencil radius"
     key = ("stencil_it", id(cont.runtime.mesh), cont.layout, hb.periodic,
            key_op, steps, str(cont.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
         step = build_stencil_step(cont.layout, hb.periodic, body_op,
-                                  hb.prev, hb.next, cont.runtime.axis)
+                                  prev, nxt, cont.runtime.axis)
 
         def loop(a, b):
             return double_buffered_loop(step, steps, a, b)
@@ -209,20 +221,85 @@ def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
     w = tuple(float(x) for x in weights)
     key = ("stencil_blk", id(cont.runtime.mesh), cont.layout, w,
            time_block, chunk, bool(interpret), str(cont.dtype))
+    return _blocked_drive(
+        cont, key, steps, time_block,
+        lambda nst: _make_blocked_prog(cont, w, nst, chunk, interpret))
+
+
+def _blocked_drive(cont, key, steps, block, make_prog):
+    """Shared drive loop for the temporally-blocked paths: cache one
+    program per fused step count (full block + remainder) and apply."""
     progs = _prog_cache.setdefault(key, {})
-    nfull, rest = divmod(steps, time_block)
-    if nfull and time_block not in progs:
-        progs[time_block] = _make_blocked_prog(cont, w, time_block, chunk,
-                                               interpret)
+    nfull, rest = divmod(steps, block)
+    if nfull and block not in progs:
+        progs[block] = make_prog(block)
     if rest and rest not in progs:
-        progs[rest] = _make_blocked_prog(cont, w, rest, chunk, interpret)
+        progs[rest] = make_prog(rest)
     data = cont._data
     for _ in range(nfull):
-        data = progs[time_block](data)
+        data = progs[block](data)
     if rest:
         data = progs[rest](data)
     cont._data = data
     return cont
+
+
+def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
+    """Temporally-blocked stencil on the MXU (ops/stencil_matmul.py):
+    ``k_block`` steps composed into one banded-Toeplitz operator applied
+    as lane-column matmuls, with ONE ppermute halo exchange per block.
+
+    Same contract as :func:`stencil_iterate_blocked` (periodic ring,
+    equal full shards, halo width >= k_block * radius); additionally
+    k_block * radius <= 128 so the composed band spans at most adjacent
+    lane columns.  Returns ``dv`` stepped ``steps`` times.
+    """
+    from ..ops import stencil_matmul
+    cont = dv
+    hb = cont.halo_bounds
+    r = (len(weights) - 1) // 2
+    nshards, seg, prev, nxt, n = cont.layout
+    assert hb.periodic, "blocked stencil runs on the periodic ring"
+    assert prev == nxt and prev >= k_block * r, \
+        "halo width must cover k_block * radius"
+    assert n == nshards * seg, "blocked stencil needs equal full shards"
+    assert k_block * r <= stencil_matmul.LANES
+    assert k_block * r <= seg, \
+        "k_block * radius exceeds the per-shard segment"
+
+    w = tuple(float(x) for x in weights)
+    key = ("stencil_mm", id(cont.runtime.mesh), cont.layout, w, k_block,
+           str(cont.dtype))
+    return _blocked_drive(cont, key, steps, k_block,
+                          lambda nst: _make_matmul_prog(cont, w, nst))
+
+
+def _ring_exchange_full(blk, seg, halo_w, axis, nshards):
+    """Periodic full-width ghost refresh for the blocked paths: both edge
+    slices of the owned block move one hop around the ring."""
+    fwd, bwd = _ring_perms(nshards, True)
+    width = 2 * halo_w + seg
+    send_f = blk[:, halo_w + seg - halo_w: halo_w + seg]
+    blk = blk.at[:, :halo_w].set(lax.ppermute(send_f, axis, fwd))
+    send_b = blk[:, halo_w: 2 * halo_w]
+    blk = blk.at[:, width - halo_w:].set(lax.ppermute(send_b, axis, bwd))
+    return blk
+
+
+def _make_matmul_prog(cont, weights, ksteps):
+    from ..ops import stencil_matmul
+    nshards, seg, prev, nxt, n = cont.layout
+    halo_w = prev
+    axis = cont.runtime.axis
+
+    def body(blk):
+        blk = _ring_exchange_full(blk, seg, halo_w, axis, nshards)
+        return stencil_matmul.matmul_stencil_row(
+            blk, seg, halo_w, weights, ksteps)
+
+    shm = jax.shard_map(body, mesh=cont.runtime.mesh,
+                        in_specs=P(axis, None), out_specs=P(axis, None))
+    return jax.jit(shm, donate_argnums=0)
 
 
 def _make_blocked_prog(cont, weights, tsteps, chunk, interpret):
@@ -231,15 +308,9 @@ def _make_blocked_prog(cont, weights, tsteps, chunk, interpret):
     halo_w = prev
     axis = cont.runtime.axis
     w = tuple(float(x) for x in weights)
-    fwd, bwd = _ring_perms(nshards, True)
-    width = 2 * halo_w + seg
 
     def body(blk):
-        send_f = blk[:, halo_w + seg - halo_w: halo_w + seg]
-        blk = blk.at[:, :halo_w].set(lax.ppermute(send_f, axis, fwd))
-        send_b = blk[:, halo_w: 2 * halo_w]
-        blk = blk.at[:, width - halo_w:].set(
-            lax.ppermute(send_b, axis, bwd))
+        blk = _ring_exchange_full(blk, seg, halo_w, axis, nshards)
         return stencil_pallas.blocked_stencil_row(
             blk, seg, halo_w, w, tsteps, chunk=chunk, interpret=interpret)
 

@@ -1,0 +1,153 @@
+"""MXU-path temporally-blocked 1-D stencil: composed-operator matmuls.
+
+The Pallas VMEM kernel (ops/stencil_pallas.py) is VPU compute-bound:
+every fused step costs ~20 vector ops per element (lane/sublane rolls +
+selects + the weighted sum), so its effective bandwidth plateaus near
+8 bytes x VPU-throughput / ops-per-step — around 0.9 TB/s on v5e.
+
+The MXU has ~2 orders of magnitude more FLOPs than the VPU.  To use it,
+compose ``k`` stencil steps into ONE linear operator: the k-fold
+convolution of the weight taps is again a Toeplitz band (half-width
+``k*r``), and on the lane-blocked view ``X[:, j] = x[128j : 128j+128]``
+the composed step touches only adjacent 128-columns when ``k*r <= 128``:
+
+    out_col_j = A_[-1] @ X_col_{j-1}  +  A_0 @ X_col_j  +  A_[+1] @ X_col_{j+1}
+    A_d[a, b] = c[(b + 128*d) - a],   c = taps(weights) ** (*k)
+
+which is one (ncols, 128) x (128, 384) matmul plus three shifted adds.
+Per element-step the MXU cost is 3*2*128/k FLOPs (24 at k=32) versus
+the VPU path's ~20 vector ops per element-step — the arithmetic moves
+to the unit with the FLOPs, and HBM still sees one read + one write per
+``k`` steps.  Numerically the composed taps are computed in float64 on
+the host, so one composed application is *more* accurate than ``k``
+sequential float32 steps.
+
+Same contract as ``blocked_stencil_row``: the padded shard row arrives
+with ghosts pre-exchanged to width >= k*r; owned cells are stepped ``k``
+times, ghost cells pass through stale (re-exchange before the next
+block).  Reference workload: ``examples/mhp/stencil-1d.cpp:47-66``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["composed_taps", "matmul_stencil_row", "max_ksteps"]
+
+LANES = 128
+
+
+def composed_taps(weights: Sequence[float], k: int) -> np.ndarray:
+    """k-fold convolution of the stencil taps (float64, length 2*k*r+1)."""
+    c = np.array([1.0], dtype=np.float64)
+    w = np.asarray(weights, dtype=np.float64)
+    for _ in range(k):
+        c = np.convolve(c, w)
+    return c
+
+
+def max_ksteps(radius: int) -> int:
+    """Largest composable block: the band must fit one lane column."""
+    return LANES // radius
+
+
+@functools.lru_cache(maxsize=64)
+def _operator(weights: tuple, k: int, dtype_name: str):
+    """(128, 384) stacked [A_-1 | A_0 | A_+1] transposed for R @ W."""
+    c = composed_taps(weights, k)
+    R = (len(c) - 1) // 2  # k * radius
+    assert R <= LANES, f"k*radius ({R}) exceeds one lane column ({LANES})"
+    blocks = []
+    for d in (-1, 0, 1):
+        A = np.zeros((LANES, LANES), dtype=np.float64)
+        a = np.arange(LANES)[:, None]
+        b = np.arange(LANES)[None, :]
+        s = b + LANES * d - a
+        inband = np.abs(s) <= R
+        A[inband] = c[(s + R)[inband]]
+        blocks.append(A)
+    W = np.concatenate(blocks, axis=0)  # (384, 128): [A_-1; A_0; A_+1]
+    # cache a NUMPY array: a jnp conversion here would run inside the
+    # caller's trace and leak a tracer through the lru_cache
+    return np.ascontiguousarray(W.T).astype(dtype_name)  # (128, 384)
+
+
+import os
+
+# matmul precision for the composed-operator apply.  HIGH (bf16x3 passes,
+# f32 accumulate) measures within noise of DEFAULT and ~12% faster than
+# HIGHEST, with composed-apply error ~1e-5 absolute over 128 steps
+# (composing taps in float64 on the host already beats k sequential f32
+# steps).  Overridable for experimentation.
+_PRECISION = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}[os.environ.get("DR_TPU_MM_PRECISION", "high").strip().lower()]
+
+# rows per matmul chunk: bounds the (chunk, 384) product intermediate so
+# billion-element rows don't triple HBM residency
+_CHUNK_ROWS = int(os.environ.get("DR_TPU_MM_CHUNK_ROWS", str(2 ** 15)))
+
+
+def _apply(src, W, segc):
+    """P-form composed apply on ``src`` = owned columns +1 ghost column
+    each side: one (segc+2, 128) x (128, 384) matmul + shifted adds."""
+    P = jax.lax.dot_general(
+        src, W, (((1,), (0,)), ((), ())),
+        precision=_PRECISION,
+        preferred_element_type=jnp.promote_types(src.dtype, jnp.float32))
+    return (P[0:segc, 0:LANES]                    # A_-1 @ X_{j-1}
+            + P[1:segc + 1, LANES:2 * LANES]      # A_0  @ X_j
+            + P[2:segc + 2, 2 * LANES:])          # A_+1 @ X_{j+1}
+
+
+def matmul_stencil_row(row, seg: int, halo: int, weights: Sequence[float],
+                       ksteps: int):
+    """Apply ``ksteps`` composed stencil steps to one padded (1, W) row.
+
+    ``row``: (1, halo + seg + halo); ghosts pre-exchanged with width
+    >= ksteps * r.  seg and halo must be multiples of 128 (whole lane
+    columns).  Returns the new row (owned stepped, ghosts stale).
+    """
+    r = (len(weights) - 1) // 2
+    width = row.shape[-1]
+    assert width == 2 * halo + seg
+    assert seg % LANES == 0 and halo % LANES == 0, \
+        "matmul stencil needs seg and halo aligned to 128 lanes"
+    assert halo >= ksteps * r, "halo narrower than the composed block"
+    assert ksteps * r <= LANES, "composed band exceeds one lane column"
+    dtype = row.dtype
+    W = jnp.asarray(
+        _operator(tuple(float(x) for x in weights), ksteps, str(dtype)))
+    hc = halo // LANES
+    segc = seg // LANES
+    R = row.reshape(width // LANES, LANES)
+    cr = _CHUNK_ROWS
+    if segc <= cr:
+        out = _apply(R[hc - 1: hc + segc + 1], W, segc)
+        R = R.at[hc:hc + segc].set(out.astype(dtype))
+    else:
+        # chunked: keeps the (cr, 384) intermediate VMEM/HBM-bounded and
+        # lets XLA pipeline fetch/matmul/writeback down the row
+        nch, rem = divmod(segc, cr)
+        R0 = R  # all chunks read the pre-step row, never partial updates
+
+        def chunk(i):
+            src = jax.lax.dynamic_slice(
+                R0, (hc - 1 + i * cr, 0), (cr + 2, LANES))
+            return _apply(src, W, cr)
+        outs = jax.lax.map(chunk, jnp.arange(nch))
+        if rem:  # remainder chunk stays bounded too
+            start = hc + nch * cr
+            tail = _apply(R0[start - 1: start + rem + 1], W, rem)
+        R = R.at[hc:hc + nch * cr].set(
+            outs.reshape(nch * cr, LANES).astype(dtype))
+        if rem:
+            R = R.at[start:start + rem].set(tail.astype(dtype))
+    return R.reshape(row.shape)

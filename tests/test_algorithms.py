@@ -146,3 +146,17 @@ def test_transform_reduce_explicit():
     dr_tpu.iota(dv, 1)
     got = dr_tpu.transform_reduce(dv, transform_op=lambda x: x * x)
     assert got == pytest.approx(float((np.arange(1, 10) ** 2).sum()))
+
+
+def test_async_reductions():
+    # reduce_async/dot_async return device scalars (reference SHP's
+    # oneDPL reduce_async surface, shp/algorithms/reduce.hpp:42-88)
+    src = np.arange(33, dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    b = dr_tpu.distributed_vector.from_array(src * 0 + 2)
+    v = dr_tpu.reduce_async(a)
+    assert float(v) == pytest.approx(src.sum())
+    d = dr_tpu.dot_async(a, b)
+    assert float(d) == pytest.approx(2 * src.sum())
+    t = dr_tpu.transform_reduce_async(a, transform_op=lambda x: x * x)
+    assert float(t) == pytest.approx((src * src).sum())
