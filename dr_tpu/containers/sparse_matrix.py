@@ -99,6 +99,9 @@ class sparse_matrix:
         self._vals = None
         self._rows = None
         self._cols = None
+        self._ell_vals = None
+        self._ell_cols = None
+        self._ell_width = 0
         self._tile_nnz = np.zeros(P, dtype=np.int64)
         self._nnz = 0
 
@@ -136,6 +139,64 @@ class sparse_matrix:
         self._nnz = int(len(rows))
         self._rt.register(self)
         return self
+
+    # padding blowup bound for the ELL layout: rows*kmax <= factor * K
+    _ELL_FACTOR = 4
+
+    def ensure_ell(self) -> bool:
+        """Build the row-grouped padded (ELL) device layout lazily:
+        (P, th, kmax) arrays, created on the first SpMV that can use them
+        (not at construction — matrices used only for iteration/views
+        shouldn't pay a second device copy).
+
+        TPU scatter-adds (segment_sum over a flat nnz stream) serialize;
+        grouping each row's entries along a fixed-width axis turns SpMV
+        into a dense gather + row-sum (algorithms/gemv.py).  Skipped when
+        a skewed row would pad beyond _ELL_FACTOR x the COO footprint.
+        Returns True when the layout is available.
+        """
+        if self._ell_vals is not None:
+            return True
+        if self._ell_width < 0 or self._vals is None:  # known-skewed / empty
+            return False
+        if not self._vals.is_fully_addressable:
+            # multi-process SPMD: the host-side regroup would need remote
+            # shards; the segment_sum path stays correct there
+            return False
+        counts = self._tile_nnz
+        K = self._vals.shape[1]
+        rows_h = np.asarray(self._rows)
+        P, th = self._nshards, self._th
+        kmax = 1
+        for t in range(P):
+            c = int(counts[t])
+            if c:
+                kmax = max(kmax, int(np.bincount(
+                    rows_h[t, :c], minlength=th).max()))
+        if th * kmax > self._ELL_FACTOR * max(K, 1):
+            self._ell_width = -1  # remember the skew; don't retry
+            return False
+        self._ell_width = kmax
+        vals_h = np.asarray(self._vals)
+        cols_h = np.asarray(self._cols)
+        ell_vals = np.zeros((P, th, kmax), dtype=self._dtype)
+        ell_cols = np.zeros((P, th, kmax), dtype=np.int32)
+        for t in range(P):
+            c = int(counts[t])
+            if not c:
+                continue
+            lr = rows_h[t, :c]
+            idx = np.argsort(lr, kind="stable")
+            lr_s = lr[idx]
+            # rank of each entry within its row (first occurrence offset)
+            pos = np.arange(c) - np.searchsorted(lr_s, lr_s)
+            ell_vals[t, lr_s, pos] = vals_h[t, :c][idx]
+            ell_cols[t, lr_s, pos] = cols_h[t, :c][idx]
+        sh = NamedSharding(self._rt.mesh,
+                           PartitionSpec(self._rt.axis, None, None))
+        self._ell_vals = jax.device_put(jnp.asarray(ell_vals), sh)
+        self._ell_cols = jax.device_put(jnp.asarray(ell_cols), sh)
+        return True
 
     @classmethod
     def from_csr(cls, shape, rowptr, cols, values, *, runtime=None):

@@ -93,8 +93,8 @@ def _ring_perms(nshards: int, periodic: bool):
     return fwd, bwd
 
 
-def _exchange_program(mesh, axis, nshards, seg, prev, nxt, periodic, n):
-    """Build the jitted halo-exchange shard_map program for one layout.
+def _exchange_body(axis, nshards, seg, prev, nxt, periodic, n):
+    """Shard-local exchange body (one padded row in, one out).
 
     The last shard may be logically short (pad-and-mask layout); its valid
     tail is ``n - (nshards-1)*seg``, so edge sends slice at a per-shard
@@ -134,8 +134,29 @@ def _exchange_program(mesh, axis, nshards, seg, prev, nxt, periodic, n):
                 new, jnp.where(got, recv, old), prev + valid, axis=1)
         return new
 
+    return body
+
+
+def _exchange_program(mesh, axis, nshards, seg, prev, nxt, periodic, n):
+    """One jitted halo-exchange shard_map program for one layout."""
+    body = _exchange_body(axis, nshards, seg, prev, nxt, periodic, n)
     shmapped = jax.shard_map(
         body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
+    return jax.jit(shmapped, donate_argnums=0)
+
+
+def _exchange_n_program(mesh, axis, nshards, seg, prev, nxt, periodic, n,
+                        iters):
+    """``iters`` exchanges fused into ONE program (lax.fori_loop): no host
+    dispatch between rounds — the device-side latency of a single ring
+    exchange is this program's time / iters."""
+    body = _exchange_body(axis, nshards, seg, prev, nxt, periodic, n)
+
+    def loop(blk):
+        return lax.fori_loop(0, iters, lambda i, x: body(x), blk)
+
+    shmapped = jax.shard_map(
+        loop, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
     return jax.jit(shmapped, donate_argnums=0)
 
 
@@ -184,13 +205,18 @@ def _reduce_program(mesh, axis, nshards, seg, prev, nxt, periodic, op, n):
 _program_cache: dict = {}
 
 
-def _cached(kind, mesh, axis, nshards, seg, prev, nxt, periodic, n, op=None):
-    key = (kind, id(mesh), axis, nshards, seg, prev, nxt, periodic, n, op)
+def _cached(kind, mesh, axis, nshards, seg, prev, nxt, periodic, n, op=None,
+            iters=1):
+    key = (kind, id(mesh), axis, nshards, seg, prev, nxt, periodic, n, op,
+           iters)
     prog = _program_cache.get(key)
     if prog is None:
         if kind == "exchange":
             prog = _exchange_program(mesh, axis, nshards, seg, prev, nxt,
                                      periodic, n)
+        elif kind == "exchange_n":
+            prog = _exchange_n_program(mesh, axis, nshards, seg, prev, nxt,
+                                       periodic, n, iters)
         else:
             prog = _reduce_program(mesh, axis, nshards, seg, prev, nxt,
                                    periodic, op, n)
@@ -252,6 +278,19 @@ class span_halo:
     # -- exchange: owner edges -> neighbor ghosts ---------------------------
     def exchange(self) -> None:
         self._run("exchange")
+
+    def exchange_n(self, iters: int) -> None:
+        """``iters`` back-to-back exchanges fused in one device program —
+        for multi-round patterns (and for measuring per-exchange device
+        latency without per-dispatch overhead)."""
+        dv = self._dv
+        hb = dv.halo_bounds
+        if hb.width == 0 or dv.nshards == 0 or iters <= 0:
+            return
+        prog = _cached("exchange_n", dv.runtime.mesh, dv.runtime.axis,
+                       dv.nshards, dv.segment_size, hb.prev, hb.next,
+                       hb.periodic, len(dv), None, iters)
+        dv._data = prog(dv._data)
 
     def exchange_begin(self) -> None:
         # JAX dispatch is asynchronous; begin == enqueue the program.

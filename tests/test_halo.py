@@ -140,3 +140,16 @@ def test_exchange_begin_finalize():
     h.exchange_finalize()
     rows = _shard_rows(dv)
     assert rows[1, 0] == dv.segment_size - 1
+
+
+def test_exchange_n_matches_repeated_exchange():
+    import numpy as np
+    n = 64
+    src = np.arange(n, dtype=np.float32)
+    hb = dr_tpu.halo_bounds(2, 2, periodic=True)
+    a = dr_tpu.distributed_vector.from_array(src, halo=hb)
+    b = dr_tpu.distributed_vector.from_array(src, halo=hb)
+    for _ in range(3):
+        a.halo().exchange()
+    b.halo().exchange_n(3)
+    np.testing.assert_array_equal(np.asarray(a._data), np.asarray(b._data))

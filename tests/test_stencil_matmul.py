@@ -53,3 +53,18 @@ def test_matmul_matches_xla_path():
     mm = stencil_iterate_matmul(m, w, 6, k_block=3)
     np.testing.assert_allclose(dr_tpu.to_numpy(mm), dr_tpu.to_numpy(xla),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_matmul_stencil_asymmetric_weights():
+    # asymmetric taps catch a flipped band orientation or swapped
+    # ppermute direction that symmetric weights cannot see
+    n = dr_tpu.nprocs() * 1024
+    rng = np.random.default_rng(11)
+    src = rng.standard_normal(n).astype(np.float32)
+    w = [0.1, 0.2, 0.7]
+    hb = dr_tpu.halo_bounds(128, 128, periodic=True)
+    a = dr_tpu.distributed_vector.from_array(src, halo=hb)
+    out = stencil_iterate_matmul(a, w, 6, k_block=4)
+    ref = _serial_stencil(src, w, 6)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref,
+                               rtol=2e-4, atol=2e-5)
