@@ -133,4 +133,33 @@ void inclusive_scan(In&& in, Out&& out, Op op = {}) {
   }
 }
 
+// exclusive variant (std::exclusive_scan surface; the reference spec
+// names it, doc/spec/source/algorithms/)
+template <distributed_range In, distributed_range Out, class T,
+          class Op = std::plus<>>
+void exclusive_scan(In&& in, Out&& out, T init, Op op = {}) {
+  T carry = init;
+  if (drtpu::aligned(in, out)) {
+    auto is = drtpu::local_segments(in);
+    auto os = drtpu::local_segments(out);
+    for (std::size_t k = 0; k < is.size(); ++k) {
+      for (std::size_t i = 0; i < is[k].size(); ++i) {
+        T next = op(carry, is[k][i]);
+        os[k][i] = carry;
+        carry = next;
+      }
+    }
+    return;
+  }
+  std::size_t n = std::min<std::size_t>(std::ranges::size(in),
+                                        std::ranges::size(out));
+  auto ib = std::ranges::begin(in);
+  auto ob = std::ranges::begin(out);
+  for (std::size_t i = 0; i < n; ++i, ++ib, ++ob) {
+    T next = op(carry, *ib);
+    *ob = carry;
+    carry = next;
+  }
+}
+
 }  // namespace drtpu

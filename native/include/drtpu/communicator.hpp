@@ -12,6 +12,7 @@
 // psum / all_gather over the mesh axis).
 #pragma once
 
+#include <algorithm>
 #include <cstdarg>
 #include <cstddef>
 #include <cstdio>
@@ -113,6 +114,70 @@ class communicator {
   }
 
   std::size_t nprocs_;
+};
+
+// ---------------------------------------------------------------------------
+// rma_window (lib::rma_window, details/communicator.hpp:97-149)
+// ---------------------------------------------------------------------------
+
+// One-sided window: each rank registers its local block; get/put address
+// (rank, offset) pairs.  The reference backs this with MPI_Rget/MPI_Put +
+// fence/flush; in the shared-memory host executor the window is a table
+// of spans and the sync calls are ordering no-ops.  The TPU executor's
+// counterpart is the batched collectives.rma_window (the explicit-batch
+// redesign of per-element RMA, SURVEY §2.5).
+template <class T>
+class rma_window {
+ public:
+  rma_window() = default;
+  explicit rma_window(std::size_t nprocs)
+      : data_(nprocs, nullptr), count_(nprocs, 0) {}
+
+  void create(std::size_t rank, T* block, std::size_t count) {
+    check_rank(rank);
+    data_[rank] = block;
+    count_[rank] = count;
+  }
+
+  void free_window() {
+    std::fill(data_.begin(), data_.end(), nullptr);
+    std::fill(count_.begin(), count_.end(), std::size_t{0});
+  }
+
+  T get(std::size_t rank, std::size_t idx) const {
+    check_elem(rank, idx);
+    return data_[rank][idx];
+  }
+
+  void put(std::size_t rank, std::size_t idx, const T& value) {
+    check_elem(rank, idx);
+    data_[rank][idx] = value;
+  }
+
+  // Single process: all puts are visible at return; these order only.
+  void fence() const {}
+  void flush(std::size_t rank) const { check_rank(rank); }
+
+  std::size_t size(std::size_t rank) const {
+    check_rank(rank);
+    return count_[rank];
+  }
+
+ private:
+  void check_rank(std::size_t rank) const {
+    if (rank >= data_.size())
+      throw std::invalid_argument("rma_window: rank out of range");
+  }
+  void check_elem(std::size_t rank, std::size_t idx) const {
+    check_rank(rank);
+    if (!data_[rank])
+      throw std::logic_error("rma_window: rank has no attached block");
+    if (idx >= count_[rank])
+      throw std::out_of_range("rma_window: index outside window");
+  }
+
+  std::vector<T*> data_;
+  std::vector<std::size_t> count_;
 };
 
 // ---------------------------------------------------------------------------

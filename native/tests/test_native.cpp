@@ -541,6 +541,52 @@ static int test_unstructured_halo(std::size_t P) {
   return 0;
 }
 
+
+static int test_rma_window(std::size_t P) {
+  // lib::rma_window analog: per-rank blocks, one-sided get/put
+  std::vector<std::vector<double>> blocks(P, std::vector<double>(4, 0.0));
+  drtpu::rma_window<double> win(P);
+  for (std::size_t r = 0; r < P; ++r)
+    win.create(r, blocks[r].data(), blocks[r].size());
+  for (std::size_t r = 0; r < P; ++r) win.put(r, 1, 10.0 * r);
+  win.fence();
+  for (std::size_t r = 0; r < P; ++r) {
+    CHECK(win.get(r, 1) == 10.0 * r);
+    CHECK(blocks[r][1] == 10.0 * r);
+    win.flush(r);
+    CHECK(win.size(r) == 4);
+  }
+  bool threw = false;
+  try {
+    win.get(0, 99);
+  } catch (const std::out_of_range&) {
+    threw = true;
+  }
+  CHECK(threw);
+  win.free_window();
+  threw = false;
+  try {
+    win.get(0, 0);
+  } catch (const std::logic_error&) {
+    threw = true;
+  }
+  CHECK(threw);
+  return 0;
+}
+
+static int test_exclusive_scan(std::size_t P) {
+  std::size_t n = 4 * P + 3;
+  distributed_vector<double> in(n, P), out(n, P);
+  drtpu::iota(in, 1.0);
+  drtpu::exclusive_scan(in, out, 100.0);
+  double carry = 100.0;
+  for (std::size_t i = 0; i < n; ++i) {
+    CHECK(out[i] == carry);
+    carry += in[i];
+  }
+  return 0;
+}
+
 int main() {
   if (test_concepts()) return 1;
   for (std::size_t P : {1, 2, 3, 4, 8}) {
@@ -554,6 +600,8 @@ int main() {
     if (test_distribution(P)) return 1;
     if (test_communicator(P)) return 1;
     if (test_unstructured_halo(P)) return 1;
+    if (test_rma_window(P)) return 1;
+    if (test_exclusive_scan(P)) return 1;
   }
   {
     // logger: no-op until a sink is set; writes call-site-prefixed lines
