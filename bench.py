@@ -277,6 +277,33 @@ def _secondary_metrics(on_cpu: bool) -> dict:
     finally:
         A = B = None
 
+    # long-context: causal ring attention (sequence-parallel over the
+    # same ppermute ring as the halo subsystem; SURVEY §5)
+    try:
+        B, S, h, hd = 1, (1024 if on_cpu else 8192), (2 if on_cpu else 8), \
+            (64 if on_cpu else 128)
+        S = S // P * P
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        # stage on device once: numpy operands would re-cross the host
+        # link every call and the transfer would dominate the timing
+        q, kk, vv = (jnp.asarray(
+            rng.standard_normal((B, S, h, hd)).astype(np.float32))
+            for _ in range(3))
+        res = dr_tpu.ring_attention(q, kk, vv, causal=True)  # warm
+        float(res[0, 0, 0, 0])  # scalar sync: slice device-side
+
+        def run_attn():
+            return dr_tpu.ring_attention(q, kk, vv, causal=True)
+        dt = _time_amortized(run_attn, lambda r: float(r[0, 0, 0, 0]),
+                             calls=4)
+        flops = 2.0 * B * h * S * S * hd  # causal: half of 4*B*h*S^2*d
+        out["ring_attn_tflops"] = round(flops / dt / 1e12, 3)
+    except Exception as e:  # pragma: no cover - defensive
+        out["ring_attn_error"] = repr(e)[:160]
+    finally:
+        q = kk = vv = res = None
+
     # config 5: CSR SpMV (gemv_example.cpp:18-41)
     try:
         m = 2 ** 14 if on_cpu else 2 ** 17

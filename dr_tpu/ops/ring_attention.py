@@ -34,50 +34,84 @@ __all__ = ["ring_attention", "ring_self_attention"]
 _cache: dict = {}
 
 
-def _build(mesh, axis, nshards, shape, causal, dtype):
+def _pick_q_chunk(B, s, h, budget_bytes=128 * 2 ** 20):
+    """Largest q-chunk whose (B, h, qc, s) f32 logits fit the budget."""
+    qc = s
+    while qc > 128 and B * h * qc * s * 4 > budget_bytes and qc % 2 == 0:
+        qc //= 2
+    return qc
+
+
+def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None):
     B, s, h, d = shape  # local block: (batch, seq_shard, heads, head_dim)
     scale = 1.0 / math.sqrt(d)
     ring = [(i, (i + 1) % nshards) for i in range(nshards)]
+    qc = min(q_chunk or _pick_q_chunk(B, s, h), s)
+    while s % qc:
+        qc -= 1  # honor the bound: largest divisor of s <= requested
+    nqc = s // qc
 
     def body(q, k, v):
         my = lax.axis_index(axis)
-        q_pos = my * s + jnp.arange(s)
-        m = jnp.full((B, h, s), -jnp.inf, jnp.float32)
-        l = jnp.zeros((B, h, s), jnp.float32)
-        acc = jnp.zeros((B, h, s, d), jnp.float32)
+        m = jnp.full((nqc, B, h, qc), -jnp.inf, jnp.float32)
+        l = jnp.zeros((nqc, B, h, qc), jnp.float32)
+        acc = jnp.zeros((nqc, B, h, qc, d), jnp.float32)
+        # q chunked along seq: (nqc, B, qc, h, d); positions per chunk
+        q_ch = jnp.moveaxis(q.reshape(B, nqc, qc, h, d), 1, 0)
+        q_pos = (my * s + jnp.arange(s)).reshape(nqc, qc)
 
-        def step(t, carry):
-            m, l, acc, k_blk, v_blk = carry
-            src = (my - t) % nshards  # whose block we hold this round
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+        def one_chunk(args, k_blk, v_blk, k_pos):
+            """Online-softmax update of one q chunk against the held
+            K/V block (flash-style running max/denominator)."""
+            q_c, qp, m_c, l_c, acc_c = args
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q_c, k_blk,
+                                precision=lax.Precision.HIGH,
                                 preferred_element_type=jnp.float32) * scale
             if causal:
-                k_pos = src * s + jnp.arange(s)
-                mask = q_pos[:, None] >= k_pos[None, :]
+                mask = qp[:, None] >= k_pos[None, :]
                 logits = jnp.where(mask[None, None], logits, -jnp.inf)
             blk_max = jnp.max(logits, axis=-1)
-            new_m = jnp.maximum(m, blk_max)
+            new_m = jnp.maximum(m_c, blk_max)
             # guard fully-masked rows (new_m == -inf)
             safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
             p = jnp.exp(logits - safe_m[..., None])
             p = jnp.where(jnp.isfinite(logits), p, 0.0)
-            correction = jnp.where(jnp.isfinite(m),
-                                   jnp.exp(m - safe_m), 0.0)
-            l = l * correction + jnp.sum(p, axis=-1)
-            acc = acc * correction[..., None] + jnp.einsum(
+            correction = jnp.where(jnp.isfinite(m_c),
+                                   jnp.exp(m_c - safe_m), 0.0)
+            l_c = l_c * correction + jnp.sum(p, axis=-1)
+            acc_c = acc_c * correction[..., None] + jnp.einsum(
                 "bhqk,bkhd->bhqd", p, v_blk,
+                precision=lax.Precision.HIGH,
                 preferred_element_type=jnp.float32)
+            return new_m, l_c, acc_c
+
+        def step(t, carry):
+            m, l, acc, k_blk, v_blk = carry
+            src = (my - t) % nshards  # whose block we hold this round
+            k_pos = src * s + jnp.arange(s)
+            if nqc == 1:
+                m, l, acc = one_chunk(
+                    (q_ch[0], q_pos[0], m[0], l[0], acc[0]),
+                    k_blk, v_blk, k_pos)
+                m, l, acc = m[None], l[None], acc[None]
+            else:
+                # chunked q bounds the (B, h, qc, s) logits regardless of
+                # the local sequence length (long-context single chip)
+                m, l, acc = lax.map(
+                    lambda a: one_chunk(a, k_blk, v_blk, k_pos),
+                    (q_ch, q_pos, m, l, acc))
             # rotate K/V around the ring for the next round
             k_blk = lax.ppermute(k_blk, axis, ring)
             v_blk = lax.ppermute(v_blk, axis, ring)
-            return new_m, l, acc, k_blk, v_blk
+            return m, l, acc, k_blk, v_blk
 
         carry = (m, l, acc, k, v)
         for t in range(nshards):  # static unroll: overlaps compute + ICI
             carry = step(t, carry)
         m, l, acc, _, _ = carry
         safe_l = jnp.where(l > 0, l, 1.0)
-        out = (acc / safe_l[..., None]).astype(dtype)
+        out = (acc / safe_l[..., None]).astype(dtype)   # (nqc, B, h, qc, d)
+        out = jnp.moveaxis(out, 0, 2).reshape(B, h, s, d)
         return jnp.einsum("bhqd->bqhd", out)
 
     shm = jax.shard_map(
@@ -87,12 +121,15 @@ def _build(mesh, axis, nshards, shape, causal, dtype):
     return jax.jit(shm)
 
 
-def ring_attention(q, k, v, *, causal: bool = False, runtime=None):
+def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
+                   q_chunk: int = None):
     """Sequence-parallel attention.
 
     q/k/v: (batch, seq, heads, head_dim) jax arrays; ``seq`` is sharded
     over the mesh axis (the function shards unsharded inputs).  Returns
-    the attention output with the same sharding.
+    the attention output with the same sharding.  ``q_chunk`` bounds the
+    per-round logits to (batch, heads, q_chunk, block) — default picks
+    the largest chunk under a fixed memory budget.
     """
     rt = runtime or _rt.runtime()
     B, S, h, d = q.shape
@@ -101,11 +138,11 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None):
     sharding = NamedSharding(rt.mesh, P(None, rt.axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     key = ("ringattn", id(rt.mesh), (B, S // nshards, h, d), causal,
-           str(q.dtype))
+           str(q.dtype), q_chunk)
     prog = _cache.get(key)
     if prog is None:
         prog = _build(rt.mesh, rt.axis, nshards,
-                      (B, S // nshards, h, d), causal, q.dtype)
+                      (B, S // nshards, h, d), causal, q.dtype, q_chunk)
         _cache[key] = prog
     return prog(q, k, v)
 

@@ -41,3 +41,24 @@ def test_ring_attention_long_sequence_constant_local_memory():
     got = np.asarray(ring_attention(q, q, q, causal=True))
     ref = _dense_attention(q, q, q, causal=True)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_attention_q_chunked_matches_unchunked():
+    rng = np.random.default_rng(9)
+    B, S, h, d = 2, 8 * dr_tpu.nprocs(), 2, 16
+    q, k, v = (rng.standard_normal((B, S, h, d)).astype(np.float32)
+               for _ in range(3))
+    full = np.asarray(dr_tpu.ring_attention(q, k, v, causal=True))
+    chunked = np.asarray(dr_tpu.ring_attention(q, k, v, causal=True,
+                                               q_chunk=4))
+    np.testing.assert_allclose(chunked, full, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_q_chunked_non_causal():
+    rng = np.random.default_rng(10)
+    B, S, h, d = 1, 16 * dr_tpu.nprocs(), 2, 8
+    q, k, v = (rng.standard_normal((B, S, h, d)).astype(np.float32)
+               for _ in range(3))
+    full = np.asarray(dr_tpu.ring_attention(q, k, v))
+    chunked = np.asarray(dr_tpu.ring_attention(q, k, v, q_chunk=8))
+    np.testing.assert_allclose(chunked, full, rtol=2e-4, atol=2e-5)
