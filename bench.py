@@ -192,7 +192,7 @@ def _time_amortized(dispatch, sync, calls=16, batches=3):
     return float(np.median(times))
 
 
-def _secondary_metrics(on_cpu: bool) -> dict:
+def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
     """The remaining BASELINE.json configs, each as one number in detail:
     transform_reduce dot (GB/s), inclusive_scan (GB/s), halo-exchange
     p50 latency (us), 2-D heat stencil (GB/s), CSR SpMV (GFLOP/s).
@@ -257,25 +257,49 @@ def _secondary_metrics(on_cpu: bool) -> dict:
     finally:
         v = h = None  # span_halo holds the vector; clear both
 
-    # config 4: 2-D heat stencil on the tiled dense matrix
+    # config 4: 2-D heat stencil on the tiled dense matrix.  On TPU the
+    # temporally-blocked Pallas kernel (VMEM row bands, T steps per HBM
+    # pass) runs first; any failure falls back to the XLA path.
+    A = B = M = None
     try:
         m = 1024 if on_cpu else 8192
-        steps = 10
+        w = dr_tpu.heat_step_weights(0.25)
         src = np.zeros((m, m), dtype=np.float32)
         src[m // 2, m // 2] = 1000.0
-        w = dr_tpu.heat_step_weights(0.25)
-        A = dr_tpu.dense_matrix.from_array(src)
-        B = dr_tpu.dense_matrix.from_array(src)
-        dr_tpu.stencil2d_iterate(A, B, w, steps=steps)  # warm
-        dt = _time_amortized(
-            lambda: dr_tpu.stencil2d_iterate(A, B, w, steps=steps),
-            _sync, calls=8)
+        dt = steps = None
+        if on_tpu:  # the blocked kernel compiles on TPU only
+            try:
+                from dr_tpu.algorithms.stencil2d import \
+                    stencil2d_iterate_blocked
+                steps = 64
+                M = dr_tpu.dense_matrix.from_array(src)
+                stencil2d_iterate_blocked(M, w, steps, time_block=16)
+                _sync(M)
+                dt = _time_amortized(
+                    lambda: stencil2d_iterate_blocked(M, w, steps,
+                                                      time_block=16),
+                    _sync, calls=4)
+                out["heat2d_impl"] = "pallas2d"
+            except Exception as e:
+                out["heat2d_blocked_error"] = repr(e)[:120]
+                dt = None
+            finally:
+                M = None
+        if dt is None:
+            steps = 10
+            A = dr_tpu.dense_matrix.from_array(src)
+            B = dr_tpu.dense_matrix.from_array(src)
+            dr_tpu.stencil2d_iterate(A, B, w, steps=steps)  # warm
+            dt = _time_amortized(
+                lambda: dr_tpu.stencil2d_iterate(A, B, w, steps=steps),
+                _sync, calls=8)
+            out["heat2d_impl"] = "xla"
         out["heat2d_gbps"] = round(
             2.0 * m * m * itemsize * steps / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["heat2d_error"] = repr(e)[:160]
     finally:
-        A = B = None
+        A = B = M = None
 
     # long-context: causal ring attention (sequence-parallel over the
     # same ppermute ring as the halo subsystem; SURVEY §5)
@@ -380,7 +404,7 @@ def main():
 
     secondary = {}
     if os.environ.get("DR_TPU_BENCH_SECONDARY", "1") != "0":
-        secondary = _secondary_metrics(on_cpu)
+        secondary = _secondary_metrics(on_cpu, on_tpu)
 
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",

@@ -25,7 +25,8 @@ from ._common import double_buffered_loop
 from .elementwise import _prog_cache
 from ..containers.dense_matrix import dense_matrix
 
-__all__ = ["stencil2d_transform", "stencil2d_iterate", "heat_step_weights"]
+__all__ = ["stencil2d_transform", "stencil2d_iterate",
+           "stencil2d_iterate_blocked", "heat_step_weights"]
 
 
 def heat_step_weights(alpha: float = 0.25):
@@ -72,6 +73,58 @@ def stencil2d_transform(in_mat: dense_matrix, out_mat: dense_matrix,
         prog = jax.jit(step, donate_argnums=1)
         _prog_cache[key] = prog
     out_mat._data = prog(in_mat._data, out_mat._data)
+
+
+def stencil2d_iterate_blocked(a: dense_matrix, weights, steps: int, *,
+                              time_block: int = 16, band: int = None,
+                              interpret=None) -> dense_matrix:
+    """Temporally-blocked 2-D stencil (ops/stencil2d_pallas.py): T steps
+    fused per HBM pass over VMEM-resident row bands.
+
+    Contract: 3x3 weights, frozen (Dirichlet) edges — equivalent to
+    ``stencil2d_iterate`` when both its buffers share edge values (the
+    usual both-from-src setup).  Requires the matrix on a single device
+    (the bench shape); multi-tile grids use the XLA path.
+    """
+    from ..ops import stencil2d_pallas
+    assert np.asarray(weights).shape == (3, 3), "blocked path is 3x3"
+    m, n = a.shape
+    assert a.grid_shape == (1, 1), \
+        "blocked 2-D stencil runs on a single-tile matrix"
+    if interpret is None:
+        interpret = a.runtime.devices[0].platform != "tpu"
+    pad = time_block  # covers the remainder block too (rest < time_block)
+    key = ("st2blk", id(a.runtime.mesh), a.layout, m, n,
+           tuple(map(tuple, np.asarray(weights))), time_block, band,
+           bool(interpret), str(a.dtype))
+    progs = _prog_cache.setdefault(key, {})
+
+    def make(tsteps):
+        def run(xp):
+            return stencil2d_pallas.blocked_stencil2d_padded(
+                xp, m, weights, tsteps, pad, band=band,
+                interpret=interpret)
+        return jax.jit(run)
+
+    if "pad" not in progs:
+        progs["pad"] = jax.jit(
+            lambda x: jnp.pad(x, ((pad, pad), (0, 0))))
+        progs["unpad"] = jax.jit(lambda xp: xp[pad:pad + m, :])
+    nfull, rest = divmod(steps, time_block)
+    if nfull and time_block not in progs:
+        progs[time_block] = make(time_block)
+    if rest and rest not in progs:
+        progs[rest] = make(rest)
+    # pad ONCE and keep the padded layout across blocks: pad-row contents
+    # are irrelevant (frozen edges stop the dependency cone), so chained
+    # passes pay no re-pad traffic
+    data = progs["pad"](a._data)
+    for _ in range(nfull):
+        data = progs[time_block](data)
+    if rest:
+        data = progs[rest](data)
+    a._data = progs["unpad"](data)
+    return a
 
 
 def stencil2d_iterate(a: dense_matrix, b: dense_matrix,

@@ -1,0 +1,190 @@
+"""Pallas TPU kernel: temporally-blocked 2-D (3x3) stencil.
+
+The XLA 2-D heat loop (algorithms/stencil2d.py) pays full HBM traffic
+plus shifted-slice relayouts every step (~100 GB/s logical on v5e, vs a
+~310 GB/s elementwise floor).  This kernel processes full-width row
+bands resident in VMEM and fuses ``T`` time steps per HBM pass: each
+band is DMA'd in once with ``T`` halo rows above and below
+(double-buffered, overlapping DMA with compute), stepped T times on the
+VPU, and written back once.
+
+Boundary contract (matches ``stencil2d_transform``'s interior-only
+writes when both buffers share edge values, i.e. the usual
+both-initialized-from-src setup): edge rows/columns are FROZEN — every
+step rewrites them with their pre-step value (Dirichlet), interior
+cells get the 3x3 weighted sum.
+
+Row-padded layout: the kernel reads AND writes arrays with ``pad``
+extra rows above and below, so a multi-block drive pads once and keeps
+the layout across blocks — no per-pass re-pad traffic.  Pad-row
+contents are irrelevant: the frozen edge rows stop the dependency cone
+at the boundary, so pad garbage only ever feeds the trapezoid margin.
+
+Geometry: band height H divides m; n is a multiple of 128 lanes; rows
+per band DMA = H + 2T.  Reference workload: the 2-D mdspan heat
+equation (BASELINE.json config 4; SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from .stencil_pallas import (LANES, SUBLANES, _HAS_PLTPU, pltpu, supported,
+                             tpu_roll)
+
+__all__ = ["blocked_stencil2d", "blocked_stencil2d_padded", "pick_band",
+           "supported"]
+
+
+@functools.lru_cache(maxsize=32)
+def _build(m: int, n: int, H: int, T: int, pad: int, weights: tuple,
+           dtype_name: str, interpret: bool):
+    """pallas_call: (m + 2*pad, n) padded array -> same-shape padded
+    array with the owned rows stepped T times (pad >= T)."""
+    dtype = jnp.dtype(dtype_name)
+    w = np.asarray(weights, dtype=np.float64)
+    assert w.shape == (3, 3)
+    assert m % H == 0 and n % LANES == 0 and pad >= T
+    nbands = m // H
+    wrows = H + 2 * T
+
+    def step_tile(u, interior):
+        """One masked stencil step on a (wrows, n) VMEM tile; ``interior``
+        is the precomputed keep-edges mask for this band."""
+        acc = jnp.zeros_like(u, dtype=jnp.float32)
+        for di in range(3):
+            # row shift: tile rows are haloed, rolls are cheap sublane
+            # rotates; wrapped rows are in the trapezoid margin
+            ur = u if di == 1 else tpu_roll(u, 1 - di, 0, interpret)
+            for dj in range(3):
+                wij = float(w[di, dj])
+                if wij == 0.0:
+                    continue
+                sh = ur if dj == 1 else tpu_roll(ur, 1 - dj, 1, interpret)
+                acc = acc + wij * sh
+        return jnp.where(interior, acc.astype(dtype), u)
+
+    def kernel(in_hbm, out_hbm, vin, vout, in_sem, out_sem):
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+        off = pad - T  # first padded row of band 0's DMA window
+
+        def in_dma(b, s):
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(off + b * H, wrows), :], vin.at[s],
+                in_sem.at[s])
+
+        def out_dma(b, s):
+            return pltpu.make_async_copy(
+                vout.at[s], out_hbm.at[pl.ds(pad + b * H, H), :],
+                out_sem.at[s])
+
+        @pl.when(i == 0)
+        def _():
+            in_dma(0, 0).start()
+
+        @pl.when(i + 1 < nbands)
+        def _():
+            in_dma(i + 1, 1 - slot).start()
+
+        in_dma(i, slot).wait()
+
+        @pl.when(i >= 2)
+        def _():
+            out_dma(i - 2, slot).wait()
+
+        u = vin[slot]
+        # freeze global edges: first/last original row, first/last
+        # column (original row of tile row r is i*H + r - T).  Computed
+        # once per band, reused every step.
+        orig_row = (i * H - T) + lax.broadcasted_iota(jnp.int32, u.shape, 0)
+        col = lax.broadcasted_iota(jnp.int32, u.shape, 1)
+        interior = ((orig_row > 0) & (orig_row < m - 1)
+                    & (col > 0) & (col < n - 1))
+        u = lax.fori_loop(0, T, lambda t, x: step_tile(x, interior), u)
+        vout[slot] = u[T:T + H, :]
+        out_dma(i, slot).start()
+
+        @pl.when(i == nbands - 1)
+        def _():
+            out_dma(i, slot).wait()
+
+        if nbands > 1:
+            @pl.when(i == nbands - 1)
+            def _():
+                out_dma(i - 1, 1 - slot).wait()
+
+    params = {}
+    if not interpret:
+        # the per-step temporaries (rolled copies, f32 acc, masks) exceed
+        # the default 16 MiB scoped-vmem limit at useful band sizes
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20)
+    return pl.pallas_call(
+        kernel,
+        grid=(nbands,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((m + 2 * pad, n), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, wrows, n), dtype),
+            pltpu.VMEM((2, H, n), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+        **params,
+    )
+
+
+def pick_band(m: int, n: int, T: int,
+              vmem_budget: int = 88 * 2 ** 20) -> int:
+    """Largest band height H (a multiple of SUBLANES dividing m) whose
+    double-buffered in/out tiles plus ~5 working copies of the haloed
+    tile fit the VMEM budget.  Raises when no such H exists — pass an
+    explicit ``band`` (or reshape) in that case."""
+    for H in range(m, SUBLANES - 1, -SUBLANES):
+        if m % H:
+            continue
+        if (7 * (H + 2 * T) + 2 * H) * n * 4 <= vmem_budget:
+            return H
+    raise ValueError(
+        f"no band height divides m={m} within the VMEM budget "
+        f"(n={n}, T={T}); pass band= explicitly or pad the rows")
+
+
+def blocked_stencil2d_padded(xp, m: int, weights, tsteps: int, pad: int,
+                             *, band: int = None,
+                             interpret: bool = False):
+    """One T-step pass over a row-padded (m + 2*pad, n) array; returns
+    the same padded layout (chain passes without re-padding)."""
+    if not _HAS_PLTPU:
+        raise RuntimeError("pallas TPU namespace unavailable")
+    n = xp.shape[1]
+    T = tsteps
+    H = band or pick_band(m, n, T)
+    assert m % H == 0, "band height must divide the row count"
+    fn = _build(m, n, H, T, pad,
+                tuple(map(tuple, np.asarray(weights, float))),
+                str(xp.dtype), interpret)
+    return fn(xp)
+
+
+def blocked_stencil2d(x, weights: Sequence[Sequence[float]], tsteps: int,
+                      *, band: int = None, interpret: bool = False):
+    """Apply ``tsteps`` fused 3x3 stencil steps to a 2-D array with
+    frozen (Dirichlet) edges.  Returns the stepped array.  One-shot
+    convenience over :func:`blocked_stencil2d_padded`."""
+    m, n = x.shape
+    xp = jnp.pad(x, ((tsteps, tsteps), (0, 0)))
+    out = blocked_stencil2d_padded(xp, m, weights, tsteps, tsteps,
+                                   band=band, interpret=interpret)
+    return out[tsteps:tsteps + m, :]

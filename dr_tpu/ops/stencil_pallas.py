@@ -55,6 +55,15 @@ def supported() -> bool:
     return _HAS_PLTPU
 
 
+def tpu_roll(u, k: int, axis: int, interpret: bool):
+    """jnp.roll(u, k, axis) that lowers through pltpu.roll on TPU
+    (which requires a non-negative shift).  Shared by the 1-D and 2-D
+    blocked kernels."""
+    if interpret:
+        return jnp.roll(u, k, axis=axis)
+    return pltpu.roll(u, k % u.shape[axis], axis=axis)
+
+
 def _flat_shift(x, s: int, interpret: bool):
     """B[f] = x_flat[f + s] over the row-major flattening of (R, 128).
 
@@ -63,12 +72,9 @@ def _flat_shift(x, s: int, interpret: bool):
     """
     if s == 0:
         return x
-    if interpret:
-        roll = jnp.roll
-    else:
-        # pltpu.roll wants non-negative shifts; roll(x, -k) == roll(x, d-k)
-        def roll(u, k, axis):
-            return pltpu.roll(u, k % u.shape[axis], axis=axis)
+
+    def roll(u, k, axis):
+        return tpu_roll(u, k, axis, interpret)
     lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
     if s > 0:
         a = roll(x, -s, axis=1)
