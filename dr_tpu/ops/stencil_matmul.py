@@ -92,7 +92,7 @@ _PRECISION = {
 
 # rows per matmul chunk: bounds the (chunk, 384) product intermediate so
 # billion-element rows don't triple HBM residency
-_CHUNK_ROWS = int(os.environ.get("DR_TPU_MM_CHUNK_ROWS", str(2 ** 15)))
+_CHUNK_ROWS = int(os.environ.get("DR_TPU_MM_CHUNK_ROWS", str(2 ** 16)))
 
 
 def _apply(src, W, segc):
