@@ -49,5 +49,32 @@ mat = dr_tpu.dense_matrix((2 * nproc, 3), dtype=np.float32,
 m_host = mat.materialize()
 assert m_host.shape == (2 * nproc, 3)
 
+# fused zip|transform|reduce dot (single-pass multi-chain program)
+other = dr_tpu.distributed_vector(n, dtype=np.float32)
+dr_tpu.fill(other, 2.0)
+d = dr_tpu.dot(dv, other)
+assert d == 2.0 * total, d
+
+# halo exchange + ghost->owner reduction across process boundaries
+sv.halo().exchange()
+sv.halo().reduce_plus()
+sv.block_until_ready()
+
+# SpMV: multi-process runs must stay on the sharded segment_sum path
+# (the ELL regroup needs fully-addressable shards)
+m = 2 * nproc
+rows = np.arange(m, dtype=np.int64)
+cols = np.zeros(m, dtype=np.int64)
+vals = np.ones(m, dtype=np.float32)
+A = dr_tpu.sparse_matrix.from_coo((m, m), rows, cols, vals)
+if nproc > 1:  # single-process shards are addressable; ELL is fine there
+    assert not A.ensure_ell()
+c = dr_tpu.distributed_vector(m, dtype=np.float32)
+bv = dr_tpu.distributed_vector(m, dtype=np.float32)
+dr_tpu.fill(bv, 3.0)
+dr_tpu.fill(c, 0.0)
+dr_tpu.gemv(c, A, bv)
+np.testing.assert_allclose(dr_tpu.to_numpy(c), np.full(m, 3.0), rtol=1e-6)
+
 print(f"MULTIHOST-OK pid={pid} reduce={total} scan_last={got[-1]}",
       flush=True)

@@ -189,3 +189,65 @@ def test_fuzz_halo_stencil():
                 ref = y
         np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=1e-3,
                                    atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_gemv(seed):
+    """Random sparsity patterns through both SpMV paths (ELL and
+    segment_sum), accumulate semantics, vs a numpy scatter oracle."""
+    rng = np.random.default_rng(100 + seed)
+    for _ in range(6):
+        m = int(rng.integers(4, 60))
+        ncols = int(rng.integers(3, 40))
+        nnz = int(rng.integers(0, 4 * m + 1))
+        rows = rng.integers(0, m, size=nnz)
+        cols = rng.integers(0, ncols, size=nnz)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        A = dr_tpu.sparse_matrix.from_coo((m, ncols), rows, cols, vals)
+        bsrc = rng.standard_normal(ncols).astype(np.float32)
+        csrc = rng.standard_normal(m).astype(np.float32)
+        c = dr_tpu.distributed_vector.from_array(csrc)
+        b = dr_tpu.distributed_vector.from_array(bsrc)
+        dr_tpu.gemv(c, A, b)
+        ref = csrc.astype(np.float64)
+        np.add.at(ref, rows, vals.astype(np.float64) * bsrc[cols])
+        np.testing.assert_allclose(dr_tpu.to_numpy(c), ref, rtol=1e-3,
+                                   atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_scans(seed):
+    """Random lengths/ops through inclusive/exclusive scan vs numpy
+    accumulate.  One case per seed is large enough that every shard's
+    local scan takes the blocked / MXU-cumsum formulation (> 2*1024
+    elements per shard on the 8-device mesh)."""
+    rng = np.random.default_rng(200 + seed)
+    cases = [
+        (None, np.add.accumulate),
+        (jnp.maximum, np.maximum.accumulate),
+        (jnp.multiply, np.multiply.accumulate),
+    ]
+    sizes = [int(rng.integers(3, 5000)) for _ in range(3)]
+    sizes.append(8 * 2 ** 11 * 2 + int(rng.integers(1, 99)))  # blocked
+    for n in sizes:
+        op, acc = cases[int(rng.integers(0, len(cases)))]
+        if op is jnp.multiply:
+            # keep magnitudes near 1 so the oracle tail stays far above
+            # atol (otherwise the comparison is vacuous)
+            n = min(n, 500)
+            src = rng.uniform(0.9, 1.1, n).astype(np.float32)
+        else:
+            src = rng.uniform(0.5, 1.5, n).astype(np.float32)
+        a = dr_tpu.distributed_vector.from_array(src)
+        out = dr_tpu.distributed_vector(n)
+        dr_tpu.inclusive_scan(a, out, op=op)
+        np.testing.assert_allclose(dr_tpu.to_numpy(out),
+                                   acc(src.astype(np.float64)),
+                                   rtol=2e-3, atol=1e-3)
+        if op is None:
+            ex = dr_tpu.distributed_vector(n)
+            dr_tpu.exclusive_scan(a, ex)
+            ref = np.concatenate(
+                [[0.0], np.cumsum(src.astype(np.float64))[:-1]])
+            np.testing.assert_allclose(dr_tpu.to_numpy(ex), ref,
+                                       rtol=2e-3, atol=1e-3)
