@@ -212,7 +212,7 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         dr_tpu.fill(b, 2.0)
         dr_tpu.dot(a, b)  # warm/compile (synced once)
         dt = _time_amortized(lambda: dr_tpu.dot_async(a, b),
-                             lambda v: float(v), calls=64)
+                             lambda v: float(v), calls=128)
         out["dot_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["dot_error"] = repr(e)[:160]
@@ -227,7 +227,7 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         dr_tpu.iota(a, 0)
         dr_tpu.inclusive_scan(a, s)  # warm
         dt = _time_amortized(lambda: dr_tpu.inclusive_scan(a, s),
-                             lambda _: _sync(s), calls=8)
+                             lambda _: _sync(s), calls=32)
         out["scan_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["scan_error"] = repr(e)[:160]
@@ -343,7 +343,7 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         dr_tpu.fill(c, 0.0)
         dr_tpu.gemv(c, A, bv)  # warm
         dt = _time_amortized(lambda: dr_tpu.gemv(c, A, bv),
-                             lambda _: _sync(c), calls=16)
+                             lambda _: _sync(c), calls=64)
         out["spmv_gflops"] = round(2.0 * m * k / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["spmv_error"] = repr(e)[:160]

@@ -20,12 +20,11 @@ container's array version; views write through to their base container.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
 
 from ._common import owned_window_mask
 from ..containers.distributed_vector import distributed_vector

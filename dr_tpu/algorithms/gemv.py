@@ -22,9 +22,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from ._common import owned_window_mask, uniform_layout
+from ._common import uniform_layout
 from .elementwise import _prog_cache
 from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix

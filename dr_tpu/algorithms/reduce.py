@@ -17,14 +17,13 @@ driver metric workload — so it gets a first-class fused implementation.
 from __future__ import annotations
 
 import operator
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ._common import owned_window_mask
-from .elementwise import _Chain, _prog_cache, _resolve
+from .elementwise import _prog_cache, _resolve
 from ..views import views as _v
 
 __all__ = ["reduce", "transform_reduce", "dot",

@@ -15,7 +15,7 @@ segment totals over the mesh axis, an exclusive fold of preceding totals
 from __future__ import annotations
 
 import operator
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -24,9 +24,7 @@ through ``get()``/``put()`` — host-mediated, one fused XLA program per call.
 
 from __future__ import annotations
 
-import math
-from functools import lru_cache
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ import math
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-import numpy as np
 from jax.sharding import Mesh
 
 __all__ = ["tile", "matrix_partition", "block_cyclic", "row_tiles", "factor"]

@@ -18,7 +18,7 @@ init (sparse_matrix.hpp:286-336).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -24,7 +24,7 @@ update API (see SURVEY.md §7 hard-part 1).
 from __future__ import annotations
 
 from functools import singledispatch
-from typing import Any, Iterable
+from typing import Any
 
 __all__ = [
     "rank",

@@ -30,8 +30,6 @@ ghosts are left untouched, as in the reference.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,6 @@ tracks per-container MPI RMA windows and fences them globally
 
 from __future__ import annotations
 
-import math
 import os
 import weakref
 from dataclasses import dataclass, field
