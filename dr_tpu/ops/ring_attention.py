@@ -32,8 +32,10 @@ __all__ = ["ring_attention", "ring_self_attention"]
 _cache: dict = {}
 
 
-def _pick_q_chunk(B, s, h, budget_bytes=128 * 2 ** 20):
-    """Largest q-chunk whose (B, h, qc, s) f32 logits fit the budget."""
+def _pick_q_chunk(B, s, h, budget_bytes=512 * 2 ** 20):
+    """Largest q-chunk whose (B, h, qc, s) f32 logits fit the budget.
+    The floor stays at 128 so high batch*heads configs keep an
+    enforceable memory bound."""
     qc = s
     while qc > 128 and B * h * qc * s * 4 > budget_bytes and qc % 2 == 0:
         qc //= 2
@@ -54,15 +56,18 @@ def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None):
         m = jnp.full((nqc, B, h, qc), -jnp.inf, jnp.float32)
         l = jnp.zeros((nqc, B, h, qc), jnp.float32)
         acc = jnp.zeros((nqc, B, h, qc, d), jnp.float32)
-        # q chunked along seq: (nqc, B, qc, h, d); positions per chunk
-        q_ch = jnp.moveaxis(q.reshape(B, nqc, qc, h, d), 1, 0)
+        # q chunked along seq, head-major: (nqc, B, h, qc, d)
+        q_ch = jnp.einsum("bnqhd->nbhqd", q.reshape(B, nqc, qc, h, d))
         q_pos = (my * s + jnp.arange(s)).reshape(nqc, qc)
 
-        def one_chunk(args, k_blk, v_blk, k_pos):
+        def one_chunk(args, kT, vT, k_pos):
             """Online-softmax update of one q chunk against the held
-            K/V block (flash-style running max/denominator)."""
+            K/V block (flash-style running max/denominator).  kT/vT are
+            head-major (B, h, s, d): transposed ONCE per ring step —
+            letting the einsum re-transpose per chunk costs more HBM
+            traffic than the attention itself."""
             q_c, qp, m_c, l_c, acc_c = args
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q_c, k_blk,
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q_c, kT,
                                 precision=lax.Precision.HIGH,
                                 preferred_element_type=jnp.float32) * scale
             if causal:
@@ -78,32 +83,35 @@ def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None):
                                    jnp.exp(m_c - safe_m), 0.0)
             l_c = l_c * correction + jnp.sum(p, axis=-1)
             acc_c = acc_c * correction[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, v_blk,
+                "bhqk,bhkd->bhqd", p, vT,
                 precision=lax.Precision.HIGH,
                 preferred_element_type=jnp.float32)
             return new_m, l_c, acc_c
 
         def step(t, carry):
-            m, l, acc, k_blk, v_blk = carry
+            m, l, acc, kT, vT = carry
             src = (my - t) % nshards  # whose block we hold this round
             k_pos = src * s + jnp.arange(s)
             if nqc == 1:
                 m, l, acc = one_chunk(
                     (q_ch[0], q_pos[0], m[0], l[0], acc[0]),
-                    k_blk, v_blk, k_pos)
+                    kT, vT, k_pos)
                 m, l, acc = m[None], l[None], acc[None]
             else:
                 # chunked q bounds the (B, h, qc, s) logits regardless of
                 # the local sequence length (long-context single chip)
                 m, l, acc = lax.map(
-                    lambda a: one_chunk(a, k_blk, v_blk, k_pos),
+                    lambda a: one_chunk(a, kT, vT, k_pos),
                     (q_ch, q_pos, m, l, acc))
-            # rotate K/V around the ring for the next round
-            k_blk = lax.ppermute(k_blk, axis, ring)
-            v_blk = lax.ppermute(v_blk, axis, ring)
-            return m, l, acc, k_blk, v_blk
+            # rotate K/V around the ring for the next round (ppermute is
+            # layout-agnostic: the head-major blocks travel directly)
+            kT = lax.ppermute(kT, axis, ring)
+            vT = lax.ppermute(vT, axis, ring)
+            return m, l, acc, kT, vT
 
-        carry = (m, l, acc, k, v)
+        # head-major ONCE; the ring carries the transposed blocks
+        carry = (m, l, acc, jnp.einsum("bkhd->bhkd", k),
+                 jnp.einsum("bkhd->bhkd", v))
         for t in range(nshards):  # static unroll: overlaps compute + ICI
             carry = step(t, carry)
         m, l, acc, _, _ = carry
