@@ -38,6 +38,22 @@ __all__ = ["fill", "iota", "copy", "copy_async", "for_each", "transform",
 # chain resolution: view pipeline -> (container, offset, length, ops)
 # ---------------------------------------------------------------------------
 
+# Callables keyed into _prog_cache are pinned here so their id() can never
+# be recycled by a later allocation.  Today the cached jitted programs also
+# close over these callables, which pins them implicitly — the explicit pin
+# makes key stability independent of that detail (e.g. AOT-compiled cache
+# entries would not retain Python closures).
+_op_pins: dict = {}
+
+
+def _op_key(op):
+    """Stable cache key for a user callable (None passes through)."""
+    if op is None:
+        return None
+    _op_pins.setdefault(id(op), op)
+    return id(op)
+
+
 class _Chain:
     __slots__ = ("cont", "off", "n", "ops")
 
@@ -50,7 +66,7 @@ class _Chain:
     @property
     def key(self):
         return (id(self.cont.runtime.mesh), self.cont.layout, self.off,
-                self.n, tuple(id(op) for op in self.ops))
+                self.n, tuple(_op_key(op) for op in self.ops))
 
 
 def _resolve(r) -> Optional[Tuple[_Chain, ...]]:
@@ -106,8 +122,8 @@ def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
     cont = out_chain.cont
     off, n = out_chain.off, out_chain.n
     key = ("ew", cont.layout, off, n, in_keys,
-           tuple(tuple(id(o) for o in ops) for ops in in_ops),
-           id(op), with_index, alias_mask, str(cont.dtype))
+           tuple(tuple(_op_key(o) for o in ops) for ops in in_ops),
+           _op_key(op), with_index, alias_mask, str(cont.dtype))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -303,7 +319,7 @@ def for_each(r, fn: Callable) -> None:
 
 def _zip_foreach_program(ins, outs, fn, alias):
     key = ("zfe", tuple(c.key for c in ins), tuple(o.key for o in outs),
-           id(fn), alias)
+           _op_key(fn), alias)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ._common import owned_window_mask
-from .elementwise import _prog_cache, _resolve
+from .elementwise import _op_key, _prog_cache, _resolve
 from ..views import views as _v
 
 __all__ = ["reduce", "transform_reduce", "dot",
@@ -70,8 +70,7 @@ def _fused_reduce_program(chains, kind, zip_op=None):
     zero gather: XLA lowers the cross-shard combine to an all-reduce.
     Multi-chain (zip) inputs are combined elementwise by ``zip_op`` before
     the reduction, so ``dot`` reads each input exactly once."""
-    key = ("red", tuple(c.key for c in chains), kind,
-           id(zip_op) if zip_op is not None else None)
+    key = ("red", tuple(c.key for c in chains), kind, _op_key(zip_op))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -155,7 +154,7 @@ def reduce(r, init=None, op: Callable = None):
 
 
 def _generic_reduce(arr, op):
-    key = ("gred", arr.shape, str(arr.dtype), id(op))
+    key = ("gred", arr.shape, str(arr.dtype), _op_key(op))
     prog = _prog_cache.get(key)
     if prog is None:
         def body(x):

@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ._common import double_buffered_loop, uniform_layout
-from .elementwise import _out_chain, _prog_cache, _resolve
+from .elementwise import _op_key, _out_chain, _prog_cache, _resolve
 from ..parallel.halo import _ring_perms
 
 __all__ = ["stencil_transform", "stencil_iterate", "build_stencil_step",
@@ -118,7 +118,7 @@ def stencil_transform(in_dv, out_dv, op: Union[Callable, Sequence[float]],
     hb = cont.halo_bounds
     prev = nxt = radius if radius is not None else None
     if callable(op):
-        key_op = id(op)
+        key_op = _op_key(op)
         body_op = op
         if prev is None:
             prev, nxt = hb.prev, hb.next
@@ -159,7 +159,7 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
         "stencils require the uniform block distribution"
     hb = cont.halo_bounds
     if callable(op):
-        key_op = id(op)
+        key_op = _op_key(op)
         body_op = op
         prev, nxt = hb.prev, hb.next
     else:
@@ -266,6 +266,15 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
     assert k_block * r <= stencil_matmul.LANES
     assert k_block * r <= seg, \
         "k_block * radius exceeds the per-shard segment"
+    # surface the matmul path's lane-alignment preconditions here, at the
+    # API level, instead of as an assertion inside the shard_map trace
+    la = stencil_matmul.LANES
+    assert seg % la == 0, (
+        f"stencil_iterate_matmul requires the per-shard segment "
+        f"({seg}) to be a multiple of {la} lanes")
+    assert prev % la == 0, (
+        f"stencil_iterate_matmul requires the halo width ({prev}) "
+        f"to be a multiple of {la} lanes")
 
     w = tuple(float(x) for x in weights)
     key = ("stencil_mm", id(cont.runtime.mesh), cont.layout, w, k_block,

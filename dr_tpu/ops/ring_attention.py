@@ -37,7 +37,9 @@ def _pick_q_chunk(B, s, h, budget_bytes=512 * 2 ** 20):
     The floor stays at 128 so high batch*heads configs keep an
     enforceable memory bound."""
     qc = s
-    while qc > 128 and B * h * qc * s * 4 > budget_bytes and qc % 2 == 0:
+    # halve only while the RESULT stays >= 128, so the floor holds even
+    # when s is not a power of two (e.g. s=384 -> 192, not 96)
+    while qc % 2 == 0 and qc >= 256 and B * h * qc * s * 4 > budget_bytes:
         qc //= 2
     return qc
 

@@ -147,15 +147,20 @@ def _build(m: int, n: int, H: int, T: int, pad: int, weights: tuple,
 
 def pick_band(m: int, n: int, T: int,
               vmem_budget: int = 88 * 2 ** 20) -> int:
-    """Largest band height H (a multiple of SUBLANES dividing m) whose
-    double-buffered in/out tiles plus ~5 working copies of the haloed
-    tile fit the VMEM budget.  Raises when no such H exists — pass an
-    explicit ``band`` (or reshape) in that case."""
-    for H in range(m, SUBLANES - 1, -SUBLANES):
-        if m % H:
-            continue
-        if (7 * (H + 2 * T) + 2 * H) * n * 4 <= vmem_budget:
-            return H
+    """Largest band height H dividing m whose double-buffered in/out
+    tiles plus ~5 working copies of the haloed tile fit the VMEM budget.
+    H must divide m.  Sublane-aligned divisors (H % 8 == 0) are preferred
+    outright — an aligned band DMAs whole (8, 128) tiles — and unaligned
+    divisors are used only when no aligned one fits.  Raises when no
+    divisor fits — pass an explicit ``band`` (or reshape) in that case."""
+    def fits(H):
+        return (7 * (H + 2 * T) + 2 * H) * n * 4 <= vmem_budget
+    divisors = [h for h in range(1, m + 1) if m % h == 0 and fits(h)]
+    aligned = [h for h in divisors if h % SUBLANES == 0]
+    if aligned:
+        return max(aligned)
+    if divisors:
+        return max(divisors)
     raise ValueError(
         f"no band height divides m={m} within the VMEM budget "
         f"(n={n}, T={T}); pass band= explicitly or pad the rows")

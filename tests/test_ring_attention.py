@@ -62,3 +62,13 @@ def test_ring_attention_q_chunked_non_causal():
     full = np.asarray(dr_tpu.ring_attention(q, k, v))
     chunked = np.asarray(dr_tpu.ring_attention(q, k, v, q_chunk=8))
     np.testing.assert_allclose(chunked, full, rtol=2e-4, atol=2e-5)
+
+
+def test_pick_q_chunk_floor_holds_for_non_power_of_two():
+    from dr_tpu.ops.ring_attention import _pick_q_chunk
+    # tiny budget forces maximal halving; the floor must still hold
+    for s in (192, 384, 8192, 131072):
+        qc = _pick_q_chunk(B=8, s=s, h=32, budget_bytes=1)
+        assert qc >= 128, (s, qc)
+        # and the caller's divisor walk starts from a sane value
+        assert qc <= s

@@ -43,3 +43,32 @@ def test_blocked_full_3x3_weights():
     got = stencil2d_iterate_blocked(M, w, 4, time_block=4, band=8)
     np.testing.assert_allclose(got.materialize(), ref.materialize(),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_pick_band_accepts_unaligned_divisors():
+    from dr_tpu.ops.stencil2d_pallas import SUBLANES, pick_band
+    # m with no multiple-of-8 divisor besides none: 12 = 2*2*3
+    H = pick_band(12, 128, T=1)
+    assert 12 % H == 0
+    # aligned divisors are preferred when they exist
+    H = pick_band(64, 128, T=1)
+    assert H % SUBLANES == 0 and 64 % H == 0
+    import pytest
+    with pytest.raises(ValueError):
+        pick_band(3, 1 << 22, T=1)  # nothing fits a tiny budget
+
+
+def test_blocked_kernel_consumes_unaligned_band():
+    # the kernel itself (not just pick_band) must accept a sublane-
+    # unaligned band height: m=24 stepped with band=12
+    m = 24
+    src = np.random.default_rng(7).standard_normal(
+        (m, 128)).astype(np.float32)
+    w = dr_tpu.heat_step_weights(0.2)
+    A = _single_tile(src)
+    B = _single_tile(src)
+    ref = stencil2d_iterate(A, B, w, steps=4)
+    M = _single_tile(src)
+    got = stencil2d_iterate_blocked(M, w, 4, time_block=2, band=12)
+    np.testing.assert_allclose(got.materialize(), ref.materialize(),
+                               rtol=2e-4, atol=2e-5)
