@@ -28,6 +28,7 @@ import numpy as np
 
 from ._common import owned_window_mask
 from ..containers.distributed_vector import distributed_vector
+from ..core.pinning import pinned_id
 from ..views import views as _v
 
 __all__ = ["fill", "iota", "copy", "copy_async", "for_each", "transform",
@@ -38,20 +39,8 @@ __all__ = ["fill", "iota", "copy", "copy_async", "for_each", "transform",
 # chain resolution: view pipeline -> (container, offset, length, ops)
 # ---------------------------------------------------------------------------
 
-# Callables keyed into _prog_cache are pinned here so their id() can never
-# be recycled by a later allocation.  Today the cached jitted programs also
-# close over these callables, which pins them implicitly — the explicit pin
-# makes key stability independent of that detail (e.g. AOT-compiled cache
-# entries would not retain Python closures).
-_op_pins: dict = {}
-
-
-def _op_key(op):
-    """Stable cache key for a user callable (None passes through)."""
-    if op is None:
-        return None
-    _op_pins.setdefault(id(op), op)
-    return id(op)
+# Stable cache key for user callables and meshes (see core/pinning.py).
+_op_key = pinned_id
 
 
 class _Chain:
@@ -65,8 +54,8 @@ class _Chain:
 
     @property
     def key(self):
-        return (id(self.cont.runtime.mesh), self.cont.layout, self.off,
-                self.n, tuple(_op_key(op) for op in self.ops))
+        return (pinned_id(self.cont.runtime.mesh), self.cont.layout,
+                self.off, self.n, tuple(_op_key(op) for op in self.ops))
 
 
 def _resolve(r) -> Optional[Tuple[_Chain, ...]]:

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._common import uniform_layout
 from .elementwise import _prog_cache
+from ..core.pinning import pinned_id
 from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix
 from ..containers.sparse_matrix import sparse_matrix
@@ -34,7 +35,7 @@ __all__ = ["gemv", "flat_gemv", "gemm"]
 
 
 def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
-    key = ("gemv", id(mesh), axis, nshards, th, K, m, seg_out, width_out,
+    key = ("gemv", pinned_id(mesh), axis, nshards, th, K, m, seg_out, width_out,
            prev_out)
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -70,7 +71,7 @@ def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
     with a one-hot compare amortizes the per-gather cost ~2.5x, and the
     fixed (th, kmax) ELL shape makes the multiply + row-sum dense VPU
     work.  b is padded to a multiple of W so every slice is in range."""
-    key = ("gemv_ell", id(mesh), axis, nshards, th, kmax, seg_out, prev_out)
+    key = ("gemv_ell", pinned_id(mesh), axis, nshards, th, kmax, seg_out, prev_out)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -174,7 +175,7 @@ def gemm(a: dense_matrix, b: dense_matrix,
     assert k == k2
     if out is None:
         out = dense_matrix((m, n), a.dtype, runtime=a.runtime)
-    key = ("gemm", id(a.runtime.mesh), a.shape, b.shape, str(a.dtype))
+    key = ("gemm", pinned_id(a.runtime.mesh), a.shape, b.shape, str(a.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
         prog = jax.jit(lambda x, y: jnp.matmul(

@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 from ._common import combine_for, uniform_layout
 from .elementwise import _op_key, _out_chain, _prog_cache, _resolve, _write_window
 from .reduce import _classify_op, _identity_for
+from ..core.pinning import pinned_id
 
 __all__ = ["inclusive_scan", "exclusive_scan"]
 
@@ -94,7 +95,7 @@ def _blocked_scan(combine, x, ident, kind=None):
 
 
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
-    key = ("scan", id(mesh), axis, layout, kind, _op_key(op) if kind is None
+    key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
            else None, exclusive, str(dtype))
     prog = _prog_cache.get(key)
     if prog is not None:

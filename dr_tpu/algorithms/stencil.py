@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._common import double_buffered_loop, uniform_layout
 from .elementwise import _op_key, _out_chain, _prog_cache, _resolve
+from ..core.pinning import pinned_id
 from ..parallel.halo import _ring_perms
 
 __all__ = ["stencil_transform", "stencil_iterate", "build_stencil_step",
@@ -129,7 +130,7 @@ def stencil_transform(in_dv, out_dv, op: Union[Callable, Sequence[float]],
             prev = nxt = (len(key_op) - 1) // 2
         assert hb.prev >= prev and hb.next >= nxt, \
             "halo narrower than the weight-stencil radius"
-    key = ("stencil", id(cont.runtime.mesh), cont.layout, hb.periodic,
+    key = ("stencil", pinned_id(cont.runtime.mesh), cont.layout, hb.periodic,
            prev, nxt, key_op, str(cont.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
@@ -170,7 +171,7 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
         prev = nxt = rad
         assert hb.prev >= rad and hb.next >= rad, \
             "halo narrower than the weight-stencil radius"
-    key = ("stencil_it", id(cont.runtime.mesh), cont.layout, hb.periodic,
+    key = ("stencil_it", pinned_id(cont.runtime.mesh), cont.layout, hb.periodic,
            key_op, steps, str(cont.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
@@ -219,7 +220,7 @@ def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
         interpret = cont.runtime.devices[0].platform != "tpu"
 
     w = tuple(float(x) for x in weights)
-    key = ("stencil_blk", id(cont.runtime.mesh), cont.layout, w,
+    key = ("stencil_blk", pinned_id(cont.runtime.mesh), cont.layout, w,
            time_block, chunk, bool(interpret), str(cont.dtype))
     return _blocked_drive(
         cont, key, steps, time_block,
@@ -277,7 +278,7 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
         f"to be a multiple of {la} lanes")
 
     w = tuple(float(x) for x in weights)
-    key = ("stencil_mm", id(cont.runtime.mesh), cont.layout, w, k_block,
+    key = ("stencil_mm", pinned_id(cont.runtime.mesh), cont.layout, w, k_block,
            str(cont.dtype))
     return _blocked_drive(cont, key, steps, k_block,
                           lambda nst: _make_matmul_prog(cont, w, nst))

@@ -23,6 +23,7 @@ from jax import lax
 
 from ._common import double_buffered_loop
 from .elementwise import _prog_cache
+from ..core.pinning import pinned_id
 from ..containers.dense_matrix import dense_matrix
 
 __all__ = ["stencil2d_transform", "stencil2d_iterate",
@@ -65,7 +66,7 @@ def stencil2d_transform(in_mat: dense_matrix, out_mat: dense_matrix,
     assert in_mat.shape == out_mat.shape
     m, n = in_mat.shape
     mm, nn = in_mat._data.shape
-    key = ("st2", id(in_mat.runtime.mesh), in_mat.layout,
+    key = ("st2", pinned_id(in_mat.runtime.mesh), in_mat.layout,
            tuple(map(tuple, np.asarray(weights))), str(in_mat.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
@@ -94,7 +95,7 @@ def stencil2d_iterate_blocked(a: dense_matrix, weights, steps: int, *,
     if interpret is None:
         interpret = a.runtime.devices[0].platform != "tpu"
     pad = time_block  # covers the remainder block too (rest < time_block)
-    key = ("st2blk", id(a.runtime.mesh), a.layout, m, n,
+    key = ("st2blk", pinned_id(a.runtime.mesh), a.layout, m, n,
            tuple(map(tuple, np.asarray(weights))), time_block, band,
            bool(interpret), str(a.dtype))
     progs = _prog_cache.setdefault(key, {})
@@ -133,7 +134,7 @@ def stencil2d_iterate(a: dense_matrix, b: dense_matrix,
     assert a.shape == b.shape and a.layout == b.layout
     m, n = a.shape
     mm, nn = a._data.shape
-    key = ("st2it", id(a.runtime.mesh), a.layout,
+    key = ("st2it", pinned_id(a.runtime.mesh), a.layout,
            tuple(map(tuple, np.asarray(weights))), steps, str(a.dtype))
     prog = _prog_cache.get(key)
     if prog is None:

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .partition import block_cyclic, matrix_partition
+from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
 
 __all__ = ["dense_matrix", "matrix_entry", "Index2D"]
@@ -283,7 +284,7 @@ _cache: dict = {}
 
 
 def _zeros2d(mesh, mm, nn, dtype, sharding):
-    key = ("z2", id(mesh), mm, nn, str(dtype))
+    key = ("z2", pinned_id(mesh), mm, nn, str(dtype))
     fn = _cache.get(key)
     if fn is None:
         fn = jax.jit(lambda: jnp.zeros((mm, nn), dtype),
@@ -293,7 +294,7 @@ def _zeros2d(mesh, mm, nn, dtype, sharding):
 
 
 def _pack2d(mesh, mm, nn, m, n, dtype, sharding):
-    key = ("p2", id(mesh), mm, nn, m, n, str(dtype))
+    key = ("p2", pinned_id(mesh), mm, nn, m, n, str(dtype))
     fn = _cache.get(key)
     if fn is None:
         def pack(values):

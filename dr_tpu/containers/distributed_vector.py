@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..core.pinning import pinned_id
 from ..core.segment import Segment
 from ..parallel import runtime as _rt
 from ..parallel.halo import halo_bounds, span_halo
@@ -233,16 +234,29 @@ class distributed_vector:
         c = self._hb.prev + i % self._seg
         return r, c
 
+    def _check_indices(self, indices):
+        """Bounds-check a host-side index batch.  Negative indices follow
+        the numpy convention; anything out of range raises IndexError (the
+        reference's RMA would fault, not wrap)."""
+        orig = np.asarray(indices)
+        idx = np.where(orig < 0, orig + self._n, orig)
+        bad = (idx < 0) | (idx >= self._n)
+        if bad.any():
+            raise IndexError(
+                f"index {int(orig[bad].reshape(-1)[0])} out of range "
+                f"for distributed_vector of size {self._n}")
+        return jnp.asarray(idx)
+
     def get(self, indices):
         """Batched remote read (replaces per-element MPI_Rget,
         dv.hpp:109-116)."""
-        r, c = self._locate(jnp.asarray(indices) % self._n)
+        r, c = self._locate(self._check_indices(indices))
         return self._data[r, c]
 
     def put(self, indices, values) -> None:
         """Batched remote write (replaces per-element MPI_Put,
         dv.hpp:118-122)."""
-        r, c = self._locate(jnp.asarray(indices) % self._n)
+        r, c = self._locate(self._check_indices(indices))
         self._data = self._data.at[r, c].set(
             jnp.asarray(values, self._dtype))
 
@@ -310,7 +324,7 @@ def _cached(key, builder):
 
 
 def _zeros(mesh, axis, nshards, width, dtype):
-    key = ("zeros", id(mesh), axis, nshards, width, str(dtype))
+    key = ("zeros", pinned_id(mesh), axis, nshards, width, str(dtype))
 
     def build():
         sh = NamedSharding(mesh, PartitionSpec(axis, None))
@@ -320,7 +334,7 @@ def _zeros(mesh, axis, nshards, width, dtype):
 
 
 def _extract(mesh, axis, nshards, seg, prev, nxt, n, dtype):
-    key = ("extract", id(mesh), axis, nshards, seg, prev, nxt, n, str(dtype))
+    key = ("extract", pinned_id(mesh), axis, nshards, seg, prev, nxt, n, str(dtype))
 
     def build():
         def fn(data):
@@ -331,7 +345,7 @@ def _extract(mesh, axis, nshards, seg, prev, nxt, n, dtype):
 
 
 def _pack(mesh, axis, nshards, seg, prev, nxt, n, dtype):
-    key = ("pack", id(mesh), axis, nshards, seg, prev, nxt, n, str(dtype))
+    key = ("pack", pinned_id(mesh), axis, nshards, seg, prev, nxt, n, str(dtype))
 
     def build():
         sh = NamedSharding(mesh, PartitionSpec(axis, None))
@@ -361,7 +375,7 @@ def _uneven_phys_index(layout):
 
 
 def _extract_uneven(mesh, layout, dtype):
-    key = ("extract_u", id(mesh), layout, str(dtype))
+    key = ("extract_u", pinned_id(mesh), layout, str(dtype))
 
     def build():
         _nshards, _width, idx = _uneven_phys_index(layout)
@@ -370,7 +384,7 @@ def _extract_uneven(mesh, layout, dtype):
 
 
 def _pack_uneven(mesh, axis, layout, dtype):
-    key = ("pack_u", id(mesh), axis, layout, str(dtype))
+    key = ("pack_u", pinned_id(mesh), axis, layout, str(dtype))
 
     def build():
         nshards, width, idx = _uneven_phys_index(layout)

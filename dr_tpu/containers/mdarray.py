@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .partition import factor
+from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
 
 __all__ = ["distributed_mdarray", "distributed_mdspan", "transpose"]
@@ -105,7 +106,7 @@ class distributed_mdarray:
         if _data is not None:
             self._data = _data
         else:
-            key = ("mdz", id(mesh), self._padded, str(self._dtype))
+            key = ("mdz", pinned_id(mesh), self._padded, str(self._dtype))
             fn = _md_cache.get(key)
             if fn is None:
                 pd, dt, sh = self._padded, self._dtype, self._sharding
@@ -184,7 +185,7 @@ class distributed_mdarray:
     def assign_array(self, values) -> None:
         values = jnp.asarray(values, self._dtype)
         assert values.shape == self._shape
-        key = ("mdp", id(self._mesh), self._padded, self._shape,
+        key = ("mdp", pinned_id(self._mesh), self._padded, self._shape,
                str(self._dtype))
         fn = _md_cache.get(key)
         if fn is None:
@@ -305,7 +306,7 @@ def transpose(out: distributed_mdarray, inp: distributed_mdarray) -> None:
     (examples/mhp/transpose-cpu.cpp:27-54).  Under jit the sharded
     transpose lowers to an XLA all-to-all over the mesh."""
     assert len(inp.shape) == 2 and out.shape == inp.shape[::-1]
-    key = ("mdT", id(inp._mesh), inp.shape, str(inp.dtype))
+    key = ("mdT", pinned_id(inp._mesh), inp.shape, str(inp.dtype))
     fn = _md_cache.get(key)
     if fn is None:
         fn = jax.jit(lambda x: x.T)

@@ -1,0 +1,24 @@
+"""Identity-stable cache keys.
+
+Compiled-program caches throughout the package key on the identity of
+Python objects (user callables, the runtime mesh).  A raw ``id()`` is only
+stable while the object lives: once collected, the id can be recycled by a
+later allocation, silently aliasing a different object's cache entry.
+``pinned_id`` returns the id AND pins the object for the process lifetime,
+so a key can never be recycled — independent of whether the cached
+artifact happens to retain the object (jitted closures do today;
+AOT-compiled entries would not).
+
+Growth is bounded by the number of distinct pinned objects, the same
+envelope as the program caches themselves (which never evict).
+"""
+
+_pins: dict = {}
+
+
+def pinned_id(obj):
+    """Stable identity key for ``obj`` (None passes through)."""
+    if obj is None:
+        return None
+    _pins.setdefault(id(obj), obj)
+    return id(obj)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
 
 __all__ = ["ring_attention", "ring_self_attention"]
@@ -145,7 +146,7 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
     assert S % nshards == 0, "seq length must divide the mesh"
     sharding = NamedSharding(rt.mesh, P(None, rt.axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    key = ("ringattn", id(rt.mesh), (B, S // nshards, h, d), causal,
+    key = ("ringattn", pinned_id(rt.mesh), (B, S // nshards, h, d), causal,
            str(q.dtype), q_chunk)
     prog = _cache.get(key)
     if prog is None:

@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import runtime as _rt
+from ..core.pinning import pinned_id
 
 __all__ = ["communicator", "rma_window", "default_comm", "init_distributed"]
 
@@ -110,7 +111,7 @@ class communicator:
             perm = [(i + 1, i) for i in range(n - 1)]
             if periodic:
                 perm.append((0, n - 1))
-        key = ("shift", id(rt.mesh), direction, periodic, arr.shape[1:],
+        key = ("shift", pinned_id(rt.mesh), direction, periodic, arr.shape[1:],
                str(arr.dtype))
         prog = _shift_cache.get(key)
         if prog is None:
@@ -126,7 +127,7 @@ class communicator:
         """lax.all_to_all over the mesh axis: arr (nshards, nshards, ...)
         sharded on axis 0; block (i, j) moves to shard j."""
         rt = self._rt
-        key = ("a2a", id(rt.mesh), arr.shape[1:], str(arr.dtype))
+        key = ("a2a", pinned_id(rt.mesh), arr.shape[1:], str(arr.dtype))
         prog = _shift_cache.get(key)
         if prog is None:
             def body(x):  # x: (1, nshards, ...)

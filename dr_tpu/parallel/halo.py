@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..core.pinning import pinned_id
 
 __all__ = ["halo_bounds", "span_halo", "halo_ops"]
 
@@ -205,7 +206,7 @@ _program_cache: dict = {}
 
 def _cached(kind, mesh, axis, nshards, seg, prev, nxt, periodic, n, op=None,
             iters=1):
-    key = (kind, id(mesh), axis, nshards, seg, prev, nxt, periodic, n, op,
+    key = (kind, pinned_id(mesh), axis, nshards, seg, prev, nxt, periodic, n, op,
            iters)
     prog = _program_cache.get(key)
     if prog is None:

@@ -53,14 +53,21 @@ class unstructured_halo:
             flat.append(ix)
             pos += len(ix)
         self._flat = np.concatenate(flat) if flat else np.zeros(0, np.int64)
+        # validate ONCE at construction (the analog of the reference's
+        # buffer carving: numpy-convention negatives, out-of-range raises)
+        # and bake the (shard, column) gather coordinates on device —
+        # exchange() then never re-checks or re-uploads
+        self._rc = dv._locate(dv._check_indices(self._flat)) \
+            if len(self._flat) else None
         self._ghost = jnp.zeros((len(self._flat),), dv.dtype)
 
     # -- owner -> ghost (exchange, halo.hpp:55-70) -------------------------
     def exchange(self) -> None:
         """Refresh every ghost from its owner: one fused gather."""
-        if not len(self._flat):
+        if self._rc is None:
             return
-        self._ghost = self._dv.get(jnp.asarray(self._flat))
+        r, c = self._rc
+        self._ghost = self._dv._data[r, c]
 
     exchange_begin = exchange
 
@@ -82,11 +89,10 @@ class unstructured_halo:
         """Fold ghost contributions back into owners: one fused
         scatter-reduce (duplicate indices combine, unlike the reference's
         sequential unpack loop)."""
-        if not len(self._flat):
+        if self._rc is None:
             return
         dv = self._dv
-        idx = jnp.asarray(self._flat)
-        r, c = dv._locate(idx)
+        r, c = self._rc
         at = dv._data.at[r, c]
         if op == "plus":
             dv._data = at.add(self._ghost)

@@ -1,4 +1,5 @@
 from . import views
 from .views import (take, drop, subrange, slice_view, transform, zip_view,
                     enumerate_view, iota_view, aligned, local_segments,
-                    take_segments, drop_segments, ranked_view)
+                    take_segments, drop_segments, ranked_view,
+                    segment_id, segment_range, segment_ranges)

@@ -318,6 +318,75 @@ class iota_view(_ViewBase):
         return jnp.arange(self.start, self.start + self._n, dtype=self.dtype)
 
 
+class segment_id:
+    """A position inside one segment: (segment, local_id, global id) —
+    ``shp::id<1>`` (shp/range.hpp:12-33).  Converts to the global index."""
+
+    __slots__ = ("segment", "local_id", "global_id")
+
+    def __init__(self, segment: int, local_id: int, global_id: int):
+        self.segment = segment
+        self.local_id = local_id
+        self.global_id = global_id
+
+    def __index__(self):
+        return self.global_id
+
+    def __int__(self):
+        return self.global_id
+
+    def __eq__(self, other):
+        if isinstance(other, segment_id):
+            return (self.segment, self.local_id, self.global_id) == \
+                (other.segment, other.local_id, other.global_id)
+        return self.global_id == other
+
+    def __hash__(self):
+        # consistent with the int-comparison branch of __eq__
+        return hash(self.global_id)
+
+    def __repr__(self):
+        return (f"segment_id(segment={self.segment}, "
+                f"local={self.local_id}, global={self.global_id})")
+
+
+class segment_range:
+    """Range of :class:`segment_id` values for one segment
+    (shp/range.hpp:97-130): ``segment_range(seg_id, size, global_offset)``
+    yields ids (seg_id, 0..size-1, global_offset + local)."""
+
+    def __init__(self, seg_id: int, segment_size: int, global_offset: int):
+        self.segment_id = seg_id
+        self.segment_size = segment_size
+        self.global_offset = global_offset
+
+    def __len__(self):
+        return self.segment_size
+
+    def __getitem__(self, idx: int):
+        if idx < 0:
+            idx += self.segment_size
+        if not 0 <= idx < self.segment_size:
+            raise IndexError(idx)
+        return segment_id(self.segment_id, idx, self.global_offset + idx)
+
+    def __iter__(self):
+        return (self[i] for i in range(self.segment_size))
+
+    def rank(self):  # reference: always 0 (shp/range.hpp:124)
+        return 0
+
+
+def segment_ranges(r):
+    """One :class:`segment_range` per segment of ``r`` — the natural use
+    of the reference's utility: segment-local ids with global offsets."""
+    out, pos = [], 0
+    for i, s in builtin_enumerate(segments(r)):
+        out.append(segment_range(i, len(s), pos))
+        pos += len(s)
+    return out
+
+
 class enumerate_view(zip_view):
     """zip(iota, r) (shp/views/enumerate.hpp:27-52)."""
 

@@ -429,6 +429,73 @@ class enumerate_view : public view_base {
   V base_;
 };
 
+// segment_range: iota-like range of per-segment position ids — the
+// reference's shp::id<1> + shp::segment_range (shp/range.hpp:12-130).
+// Each element carries (segment, local index, global index) and converts
+// to the global index.
+class seg_id {
+ public:
+  seg_id() = default;
+  seg_id(std::size_t segment, std::size_t local, std::size_t global)
+      : segment_(segment), local_(local), global_(global) {}
+
+  operator std::size_t() const { return global_; }
+  std::size_t segment() const { return segment_; }
+  std::size_t local_id() const { return local_; }
+  std::size_t global_id() const { return global_; }
+
+ private:
+  std::size_t segment_ = 0;
+  std::size_t local_ = 0;
+  std::size_t global_ = 0;
+};
+
+struct segment_range_accessor {
+  using value_type = seg_id;
+  using difference_type = std::ptrdiff_t;
+
+  std::size_t segment = 0;
+  std::size_t idx = 0;
+  std::size_t offset = 0;
+
+  seg_id dereference() const { return {segment, idx, offset + idx}; }
+  void operator+=(difference_type n) { idx += n; }
+  bool operator==(const segment_range_accessor& o) const {
+    return segment == o.segment && idx == o.idx;
+  }
+  auto operator<=>(const segment_range_accessor& o) const {
+    return idx <=> o.idx;
+  }
+  difference_type distance_to(const segment_range_accessor& o) const {
+    return difference_type(o.idx) - difference_type(idx);
+  }
+};
+
+class segment_range {
+ public:
+  using value_type = seg_id;
+  using iterator = iterator_adaptor<segment_range_accessor>;
+
+  segment_range(std::size_t segment_id, std::size_t segment_size,
+                std::size_t global_offset)
+      : segment_id_(segment_id), size_(segment_size),
+        offset_(global_offset) {}
+
+  iterator begin() const {
+    return iterator(segment_range_accessor{segment_id_, 0, offset_});
+  }
+  iterator end() const { return begin() + size_; }
+  std::size_t size() const { return size_; }
+  seg_id operator[](std::size_t i) const { return *(begin() + i); }
+  // the reference returns rank 0 unconditionally (shp/range.hpp:124)
+  std::size_t dr_rank() const { return 0; }
+
+ private:
+  std::size_t segment_id_;
+  std::size_t size_;
+  std::size_t offset_;
+};
+
 // ranked_view: debug view of (owning rank, value) pairs (views/views.hpp:7-11)
 template <dr_view V>
 class ranked_view : public view_base {

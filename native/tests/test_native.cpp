@@ -587,8 +587,30 @@ static int test_exclusive_scan(std::size_t P) {
   return 0;
 }
 
+int test_segment_range() {
+  // shp/range.hpp:97-130: per-segment id range with global offsets
+  drtpu::segment_range sr(3, 4, 100);
+  CHECK(sr.size() == 4);
+  CHECK(sr.dr_rank() == 0);
+  std::size_t i = 0;
+  for (auto id : sr) {
+    CHECK(id.segment() == 3);
+    CHECK(id.local_id() == i);
+    CHECK(id.global_id() == 100 + i);
+    CHECK(std::size_t(id) == 100 + i);  // converts to the global index
+    ++i;
+  }
+  CHECK(i == 4);
+  CHECK(sr[2].global_id() == 102);
+  CHECK(sr.end() - sr.begin() == 4);
+  static_assert(std::random_access_iterator<
+                decltype(drtpu::segment_range(0, 0, 0).begin())>);
+  return 0;
+}
+
 int main() {
   if (test_concepts()) return 1;
+  if (test_segment_range()) return 1;
   for (std::size_t P : {1, 2, 3, 4, 8}) {
     if (test_vocabulary(P)) return 1;
     if (test_segment_tools(P)) return 1;

@@ -87,3 +87,20 @@ def test_int_dtype(oracle):
     dr_tpu.iota(dv, 0)
     assert dr_tpu.to_numpy(dv).dtype == np.int32
     oracle.check_segments(dv)
+
+
+def test_get_put_reject_out_of_range(mesh_size):
+    import pytest
+    v = dr_tpu.distributed_vector(10, np.float32)
+    dr_tpu.iota(v, 0)
+    # numpy-convention negatives are fine
+    np.testing.assert_allclose(np.asarray(v.get([-1, -10])), [9.0, 0.0])
+    # out-of-range must raise, not wrap (round-1 wrapped % n silently)
+    with pytest.raises(IndexError):
+        v.get([10])
+    with pytest.raises(IndexError):
+        v.get([0, 5, -11])
+    with pytest.raises(IndexError):
+        v.put([12], [1.0])
+    # state unchanged after the failed put
+    np.testing.assert_allclose(dr_tpu.to_numpy(v), np.arange(10.0))

@@ -148,3 +148,33 @@ def test_transform_over_subrange(dv, oracle):
 def test_iota_view_standalone(oracle):
     iv = views.iota_view(5, 10)
     oracle.equal(iv, np.arange(5, 15))
+
+
+def test_segment_range(dv, mesh_size):
+    # shp/range.hpp:97-130: per-segment id ranges with global offsets
+    srs = views.segment_ranges(dv)
+    segs = dr_tpu.segments(dv)
+    assert len(srs) == len(segs)
+    pos = 0
+    for i, (sr, s) in enumerate(zip(srs, segs)):
+        assert len(sr) == len(s)
+        assert sr.rank() == 0  # reference contract
+        first, last = sr[0], sr[-1]
+        assert first.segment == i and first.local_id == 0
+        assert int(first) == pos
+        assert last.global_id == pos + len(s) - 1
+        pos += len(s)
+    # iteration yields every global index exactly once, in order
+    flat = [int(x) for sr in srs for x in sr]
+    assert flat == list(range(len(dv)))
+    # id protocol: usable anywhere an index is (e.g. container indexing)
+    assert dv[srs[0][1]] == dr_tpu.to_numpy(dv)[1]
+
+
+def test_segment_range_standalone():
+    sr = views.segment_range(3, 4, 100)
+    assert [x.global_id for x in sr] == [100, 101, 102, 103]
+    assert sr[2] == 102 and sr[2].segment == 3 and sr[2].local_id == 2
+    import pytest
+    with pytest.raises(IndexError):
+        sr[4]
