@@ -174,6 +174,27 @@ def _time_best(fn, iters=3):
     return best
 
 
+def _marginal(run_sync, r1=4, r2=36, samples=5):
+    """Device-side per-op seconds by the MARGINAL method: time a fused
+    loop of r1 ops and one of r2 ops (each dispatched once and synced
+    once), interleaved, and divide the median difference by r2 - r1.
+    The tunneled per-dispatch constant — large and drifting (tens of
+    ms) — cancels in the difference; fused loops come from the *_n
+    program family (dot_n, inclusive_scan_n, ring_attention_n,
+    exchange_n)."""
+    for r in (r1, r2):
+        run_sync(r)  # compile + warm
+    t1s, t2s = [], []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        run_sync(r1)
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_sync(r2)
+        t2s.append(time.perf_counter() - t0)
+    return (float(np.median(t2s)) - float(np.median(t1s))) / (r2 - r1)
+
+
 def _time_amortized(dispatch, sync, calls=16, batches=3):
     """Median per-call time of ``calls`` async dispatches + ONE sync.
 
@@ -203,31 +224,36 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
     P = dr_tpu.nprocs()
     itemsize = 4
 
-    # config 1: transform_reduce dot-product (dot_product.cpp:11-18)
+    # config 1: transform_reduce dot-product (dot_product.cpp:11-18).
+    # dot_n fuses the reductions device-side (VERDICT r1 item 4): the
+    # metric no longer pays the tunneled dispatch overhead.
     try:
         n = (2 ** 22 if on_cpu else 2 ** 27) // P * P
         a = dr_tpu.distributed_vector(n, np.float32)
         b = dr_tpu.distributed_vector(n, np.float32)
         dr_tpu.fill(a, 1.5)
         dr_tpu.fill(b, 2.0)
-        dr_tpu.dot(a, b)  # warm/compile (synced once)
-        dt = _time_amortized(lambda: dr_tpu.dot_async(a, b),
-                             lambda v: float(v), calls=128)
+        from dr_tpu.algorithms.reduce import dot_n
+        dt = _marginal(lambda r: float(dot_n(a, b, r)))
         out["dot_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["dot_error"] = repr(e)[:160]
     finally:
         a = b = None  # free the buffers even when a step raised
 
-    # config 3: inclusive_scan prefix sum (inclusive_scan.hpp:25-148)
+    # config 3: inclusive_scan prefix sum (inclusive_scan.hpp:25-148),
+    # fused-loop measurement (inclusive_scan_n)
     try:
         n = (2 ** 22 if on_cpu else 2 ** 27) // P * P
         a = dr_tpu.distributed_vector(n, np.float32)
         s = dr_tpu.distributed_vector(n, np.float32)
         dr_tpu.iota(a, 0)
-        dr_tpu.inclusive_scan(a, s)  # warm
-        dt = _time_amortized(lambda: dr_tpu.inclusive_scan(a, s),
-                             lambda _: _sync(s), calls=32)
+        from dr_tpu.algorithms.scan import inclusive_scan_n
+
+        def run_scan(r):
+            inclusive_scan_n(a, s, r)
+            _sync(s)
+        dt = _marginal(run_scan)
         out["scan_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["scan_error"] = repr(e)[:160]
@@ -302,7 +328,9 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         A = B = M = None
 
     # long-context: causal ring attention (sequence-parallel over the
-    # same ppermute ring as the halo subsystem; SURVEY §5)
+    # same ppermute ring as the halo subsystem; SURVEY §5).  bf16
+    # inputs take the fused Pallas flash kernel (f32 accumulation);
+    # ring_attention_n chains steps device-side for the measurement.
     try:
         B, S, h, hd = 1, (1024 if on_cpu else 8192), (2 if on_cpu else 8), \
             (64 if on_cpu else 128)
@@ -312,24 +340,19 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         # stage on device once: numpy operands would re-cross the host
         # link every call and the transfer would dominate the timing
         q, kk, vv = (jnp.asarray(
-            rng.standard_normal((B, S, h, hd)).astype(np.float32))
-            for _ in range(3))
-        # warm several times: the first executions of a fresh program
-        # carry residual one-time cost on the tunneled backend
-        for _ in range(3):
-            res = dr_tpu.ring_attention(q, kk, vv, causal=True)
-        float(res[0, 0, 0, 0])  # scalar sync: slice device-side
+            rng.standard_normal((B, S, h, hd)).astype(np.float32),
+            dtype=jnp.bfloat16) for _ in range(3))
 
-        def run_attn():
-            return dr_tpu.ring_attention(q, kk, vv, causal=True)
-        dt = _time_amortized(run_attn, lambda r: float(r[0, 0, 0, 0]),
-                             calls=4)
+        def run_attn(r):
+            res = dr_tpu.ring_attention_n(q, kk, vv, r, causal=True)
+            float(res[0, 0, 0, 0].astype(jnp.float32))
+        dt = _marginal(run_attn, r1=2, r2=18, samples=5)
         flops = 2.0 * B * h * S * S * hd  # causal: half of 4*B*h*S^2*d
         out["ring_attn_tflops"] = round(flops / dt / 1e12, 3)
     except Exception as e:  # pragma: no cover - defensive
         out["ring_attn_error"] = repr(e)[:160]
     finally:
-        q = kk = vv = res = None
+        q = kk = vv = None
 
     # config 5: CSR SpMV (gemv_example.cpp:18-41)
     try:

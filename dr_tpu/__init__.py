@@ -45,7 +45,7 @@ from .containers.mdarray import (distributed_mdarray, distributed_mdspan,
 from .utils.logging import drlog
 from .utils.debug import print_range, print_matrix, range_details
 from .utils import checkpoint
-from .ops.ring_attention import ring_attention
+from .ops.ring_attention import ring_attention, ring_attention_n
 from .views import views
 from .views.views import aligned, local_segments
 from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
@@ -85,5 +85,5 @@ __all__ = [
     "init_distributed", "distributed_span",
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
-    "checkpoint", "ring_attention",
+    "checkpoint", "ring_attention", "ring_attention_n",
 ]

@@ -27,7 +27,7 @@ from .elementwise import _op_key, _prog_cache, _resolve
 from ..views import views as _v
 
 __all__ = ["reduce", "transform_reduce", "dot",
-           "reduce_async", "transform_reduce_async", "dot_async"]
+           "reduce_async", "transform_reduce_async", "dot_async", "dot_n"]
 
 
 # known monoids: (jnp vector-reduce, identity)
@@ -200,3 +200,42 @@ def dot_async(a, b):
     """Async dot product: the fused program's device scalar, no host sync."""
     z = _v.zip_view(a, b)
     return reduce_async(_v.transform(z, _multiply2), operator.add)
+
+
+def dot_n(a, b, iters: int):
+    """``iters`` chained dot products in ONE jitted program — the
+    measurement analog of ``span_halo.exchange_n`` (parallel/halo.py):
+    per-op device time excludes the tunneled per-dispatch overhead.
+
+    Each round perturbs one operand by ``carry * 1e-38`` so the WHOLE
+    fused multiply+reduce depends on the loop carry — XLA can neither
+    hoist the multiply out of the loop nor skip re-reading the inputs,
+    keeping per-iteration HBM traffic exactly a dot's (one pass over
+    both arrays, no intermediates).  The returned value differs from
+    ``dot(a, b)`` by O(1e-38 * |dot| * sum(a)) — negligible.  Returns
+    the final device scalar."""
+    chains = _resolve(_v.zip_view(a, b))
+    assert chains is not None and len(chains) == 2, \
+        "dot_n needs two aligned container chains"
+    c0, c1 = chains
+    assert c0.cont.layout == c1.cont.layout and c0.off == c1.off \
+        and c0.n == c1.n
+    assert not c0.ops and not c1.ops, "dot_n takes plain containers"
+    key = ("dot_n", c0.key, c1.key, int(iters))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        layout, off, n = c0.cont.layout, c0.off, c0.n
+
+        def many(d0, d1):
+            mask, _gid = owned_window_mask(layout, off, n)
+
+            def it(_, s):
+                prod = d0 * (d1 + s * jnp.asarray(1e-38, d1.dtype))
+                return jnp.sum(jnp.where(mask, prod, 0))
+
+            return jax.lax.fori_loop(0, iters, it,
+                                     jnp.zeros((), d0.dtype))
+
+        prog = jax.jit(many)
+        _prog_cache[key] = prog
+    return prog(c0.cont._data, c1.cont._data)

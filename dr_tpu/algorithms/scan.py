@@ -27,7 +27,7 @@ from .elementwise import _op_key, _out_chain, _prog_cache, _resolve, _write_wind
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
 
-__all__ = ["inclusive_scan", "exclusive_scan"]
+__all__ = ["inclusive_scan", "exclusive_scan", "inclusive_scan_n"]
 
 
 _BLOCK = 1024  # whole f32 vreg rows (8 sublanes x 128 lanes)
@@ -198,6 +198,42 @@ def inclusive_scan(in_r, out, op: Callable = None, init=None):
     """Distributed inclusive prefix scan
     (shp/algorithms/inclusive_scan.hpp:25-148)."""
     return _scan(in_r, out, op, init, exclusive=False)
+
+
+def inclusive_scan_n(in_v, out, iters: int):
+    """``iters`` chained add-scans in ONE jitted program (the
+    ``span_halo.exchange_n`` measurement analog): each round scans the
+    previous round's output, so per-op device time excludes the
+    tunneled per-dispatch overhead and no extra elementwise pass skews
+    the per-op traffic.  Values grow without bound (inf arithmetic
+    runs at full speed on TPU): ``out`` is a timing aid, NOT
+    cumsum(in)."""
+    ins = _resolve(in_v)
+    out_chain = _out_chain(out)
+    assert (ins is not None and len(ins) == 1 and not ins[0].ops
+            and ins[0].off == 0 and out_chain.off == 0
+            and ins[0].cont.layout == out_chain.cont.layout
+            and uniform_layout(ins[0].cont.layout)
+            and ins[0].n == len(ins[0].cont)
+            and out_chain.n == len(out_chain.cont)), \
+        "inclusive_scan_n takes two whole uniform-layout containers"
+    c = ins[0]
+    mesh = c.cont.runtime.mesh
+    dtype = out_chain.cont.dtype
+    key = ("scan_n", pinned_id(mesh), c.cont.layout, str(dtype),
+           int(iters))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        one = _scan_program(mesh, c.cont.runtime.axis, c.cont.layout,
+                            "add", None, False, dtype)
+
+        def many(d):
+            return lax.fori_loop(0, iters, lambda _, x: one(x), d)
+
+        prog = jax.jit(many)
+        _prog_cache[key] = prog
+    out_chain.cont._data = prog(c.cont._data)
+    return out
 
 
 def exclusive_scan(in_r, out, init=0, op: Callable = None):

@@ -1,0 +1,192 @@
+"""Pallas TPU kernel: fused flash-attention block update for ring attention.
+
+The XLA blockwise path (ops/ring_attention.py) materializes the
+(q_chunk, block) logits in HBM between the two einsums and re-reads the
+running (m, l, acc) state per chunk — at S=8k that caps the MXU at a few
+percent utilization.  This kernel fuses one full flash-attention update
+(logits -> online softmax -> weighted V accumulation) over the K/V block
+a ring step holds, entirely in VMEM:
+
+- grid (B*h, S_local / bq): one q tile per cell, K/V of the whole held
+  block resident in VMEM across the cell's inner loop;
+- matmuls run on the MXU in bf16 with f32 accumulation
+  (``preferred_element_type``), exp/normalization stays f32 on the VPU;
+- the causal variant bounds the inner k loop by the q tile's GLOBAL
+  position (ring offsets arrive via scalar prefetch), so future blocks
+  cost nothing — the ~2x causal saving the XLA path only gets from
+  masking FLOPs it already paid for;
+- the running (m, l, acc) state is a kernel carry: ring step t feeds
+  step t+1, and the final normalization (acc / l) happens once in XLA.
+
+Reference lineage: the ring substrate of
+``include/dr/details/halo.hpp:273-387`` (periodic neighbor exchange)
+carried to its long-context conclusion (SURVEY.md §5); the blockwise
+online softmax follows the flash/ring-attention literature (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from .stencil_pallas import _HAS_PLTPU, pltpu
+
+__all__ = ["flash_update", "supported", "pick_blocks"]
+
+_NEG_INF = float("-inf")
+
+
+def supported() -> bool:
+    return _HAS_PLTPU
+
+
+def pick_blocks(s: int, skv: int, d: int):
+    """(bq, bk) for local seq length ``s`` against a ``skv``-long K/V
+    block: the largest power-of-two tiles (bq <= 2048, bk <= 1024 —
+    measured optimum on v5e) dividing the sequence lengths.  Returns
+    None when no MXU-friendly tiling exists or the resident K/V block
+    would overflow VMEM (callers fall back to the XLA path)."""
+    def pick(n, cap, floor):
+        b = cap
+        while b >= floor:
+            if n % b == 0:
+                return b
+            b //= 2
+        return None
+    if d % 128 or skv % 128:
+        return None
+    # the whole held K/V block stays VMEM-resident (double-buffered)
+    if 2 * 2 * skv * d * 2 > 64 * 2 ** 20:
+        return None
+    bq = pick(s, 2048, 16)   # sublane-aligned q tile (bf16 tile: (16, 128))
+    bk = pick(skv, 1024, 128)  # lane-aligned k tile (logits last dim)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
+
+
+@functools.lru_cache(maxsize=32)
+def _build(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
+           causal: bool, interpret: bool):
+    """pallas_call: one flash update of (m, l, acc) against a K/V block.
+
+    Inputs: info=[q_off, k_off] (scalar prefetch), q (BH, s, d) bf16,
+    k/v (BH, skv, d) bf16, carries m/l (BH, s, 1) f32 (the trailing
+    length-1 lane dim satisfies Mosaic block tiling AND is the compute
+    layout of row stats), acc (BH, s, d) f32.  Outputs: updated m, l,
+    acc.
+    """
+    nk = skv // bk
+    scale = 1.0 / (d ** 0.5)
+
+    def kernel(info, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+               mo_ref, lo_ref, acco_ref):
+        iq = pl.program_id(1)
+        q_off = info[0]
+        k_off = info[1]
+
+        qv = q_ref[0]                                   # (bq, d) bf16
+        m = m_ref[0]                                    # (bq, 1) f32
+        l = l_ref[0]
+        acc = acc_ref[0]                                # (bq, d) f32
+        q_lo = q_off + iq * bq                          # global q position
+
+        if causal:
+            # only k blocks whose first position is <= the tile's last q
+            # position can contribute; later blocks are skipped outright
+            hi = jnp.clip((q_lo + bq - 1 - k_off) // bk + 1, 0, nk)
+        else:
+            hi = nk
+
+        def body(ik, carry):
+            m, l, acc = carry
+            kblk = k_ref[0, pl.ds(ik * bk, bk), :]      # (bk, d) bf16
+            vblk = v_ref[0, pl.ds(ik * bk, bk), :]
+            logits = lax.dot_general(
+                qv, kblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                qp = q_lo + lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+                kp = (k_off + ik * bk
+                      + lax.broadcasted_iota(jnp.int32, logits.shape, 1))
+                logits = jnp.where(qp >= kp, logits, _NEG_INF)
+            blk_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
+            new_m = jnp.maximum(m, blk_max)
+            # new_m = -inf only when every k so far is masked; exp(x -
+            # safe_m) then sees x = -inf and yields 0 rows on its own
+            safe_m = jnp.where(new_m > _NEG_INF, new_m, 0.0)
+            p = jnp.exp(logits - safe_m)                # masked -> exp(-inf)=0
+            corr = jnp.exp(m - safe_m)                  # m=-inf -> 0
+            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            pv = lax.dot_general(
+                p.astype(jnp.bfloat16), vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc = acc * corr + pv
+            return new_m, l, acc
+
+        m, l, acc = lax.fori_loop(0, hi, body, (m, l, acc))
+        mo_ref[0] = m
+        lo_ref[0] = l
+        acco_ref[0] = acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, info: (b, i, 0)),
+            pl.BlockSpec((1, skv, d), lambda b, i, info: (b, 0, 0)),
+            pl.BlockSpec((1, skv, d), lambda b, i, info: (b, 0, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, info: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, 1), lambda b, i, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, info: (b, i, 0)),
+        ],
+    )
+    flops_per_cell = 2 * 2 * bq * skv * d  # two matmuls per k block
+    if causal:
+        flops_per_cell //= 2
+    params = {}
+    if not interpret:
+        # resident K/V blocks + f32 logits exceed the default 16 MiB
+        # scoped-vmem limit at useful tile sizes
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        **params,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, s, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_cell * BH * (s // bq),
+            bytes_accessed=(BH * s * d * 2 * 2 + BH * skv * d * 2 * 2
+                            + BH * s * d * 4 * 2),
+            transcendentals=BH * s * skv),
+        interpret=interpret,
+    )
+
+
+def flash_update(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
+                 bq: int, bk: int, interpret: bool = False):
+    """One ring step's flash update.  q (BH, s, d) and k/v (BH, skv, d)
+    are bf16 (callers cast); m/l (BH, s, 1) and acc (BH, s, d) are the f32
+    running state; q_off/k_off are the GLOBAL sequence offsets of the q
+    shard and the held K/V block (traced scalars under shard_map)."""
+    BH, s, d = q.shape
+    skv = k.shape[1]
+    fn = _build(BH, s, skv, d, bq, bk, causal, interpret)
+    info = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    return fn(info, q, k, v, m, l, acc)

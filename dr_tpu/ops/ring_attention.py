@@ -19,6 +19,7 @@ attention exactly.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -27,10 +28,79 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
+from . import flash_attention as _fa
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_attention_n", "ring_self_attention"]
 
 _cache: dict = {}
+
+
+def _flash_viable(shape, dtype, rt) -> bool:
+    """Flash kernel path: TPU backend, MXU-friendly tiling, bf16 q/k/v.
+
+    float32 inputs keep the Precision.HIGH XLA path by default — the
+    fused kernel computes in bf16 (f32 accumulation), and silently
+    trading the input precision away would break the module's
+    exact-match contract.  ``DR_TPU_RING_IMPL=flash`` opts f32 inputs
+    into the kernel; ``DR_TPU_RING_IMPL=xla`` forces the XLA path."""
+    impl = os.environ.get("DR_TPU_RING_IMPL", "").strip().lower()
+    if impl == "xla":
+        return False
+    if not _fa.supported():
+        return False
+    if jnp.dtype(dtype) == jnp.dtype(jnp.float32):
+        if impl != "flash":
+            return False
+    elif jnp.dtype(dtype) != jnp.dtype(jnp.bfloat16):
+        return False
+    B, s, h, d = shape
+    if _fa.pick_blocks(s, s, d) is None:
+        return False
+    # gate on the RUNTIME's devices, not the process default backend
+    # (a CPU-mesh runtime on a TPU-default host must take the XLA path)
+    return rt.devices[0].platform == "tpu"
+
+
+def _build_flash(mesh, axis, nshards, shape, causal, dtype):
+    """Ring schedule with the fused Pallas block kernel as the per-step
+    compute: K/V blocks rotate via ppermute, the (m, l, acc) online-
+    softmax state is the carry, normalization happens once at the end."""
+    B, s, h, d = shape
+    BH = B * h
+    bq, bk = _fa.pick_blocks(s, s, d)
+    ring = [(i, (i + 1) % nshards) for i in range(nshards)]
+
+    def body(q, k, v):
+        my = lax.axis_index(axis)
+        # head-major (BH, s, d) once; bf16 feeds the MXU, f32 state
+        qh = jnp.einsum("bshd->bhsd", q).reshape(BH, s, d)
+        kh = jnp.einsum("bshd->bhsd", k).reshape(BH, s, d)
+        vh = jnp.einsum("bshd->bhsd", v).reshape(BH, s, d)
+        qh, kh, vh = (x.astype(jnp.bfloat16) for x in (qh, kh, vh))
+        m = jnp.full((BH, s, 1), -jnp.inf, jnp.float32)
+        l = jnp.zeros((BH, s, 1), jnp.float32)
+        acc = jnp.zeros((BH, s, d), jnp.float32)
+        q_off = my * s
+        for t in range(nshards):  # static unroll: overlaps compute + ICI
+            src = (my - t) % nshards
+            m, l, acc = _fa.flash_update(
+                qh, kh, vh, m, l, acc, q_off, src * s,
+                causal=causal, bq=bq, bk=bk)
+            if t + 1 < nshards:
+                kh = lax.ppermute(kh, axis, ring)
+                vh = lax.ppermute(vh, axis, ring)
+        safe_l = jnp.where(l > 0, l, 1.0)
+        out = (acc / safe_l).astype(dtype)
+        return jnp.einsum("bhsd->bshd",
+                          out.reshape(B, h, s, d))
+
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axis
+    # metadata, so shard_map's vma check cannot type them
+    shm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    return jax.jit(shm)
 
 
 def _pick_q_chunk(B, s, h, budget_bytes=512 * 2 ** 20):
@@ -146,12 +216,49 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
     assert S % nshards == 0, "seq length must divide the mesh"
     sharding = NamedSharding(rt.mesh, P(None, rt.axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    key = ("ringattn", pinned_id(rt.mesh), (B, S // nshards, h, d), causal,
-           str(q.dtype), q_chunk)
+    shape = (B, S // nshards, h, d)
+    flash = q_chunk is None and _flash_viable(shape, q.dtype, rt)
+    key = ("ringattn", pinned_id(rt.mesh), shape, causal,
+           str(q.dtype), q_chunk, flash)
     prog = _cache.get(key)
     if prog is None:
-        prog = _build(rt.mesh, rt.axis, nshards,
-                      (B, S // nshards, h, d), causal, q.dtype, q_chunk)
+        if flash:
+            prog = _build_flash(rt.mesh, rt.axis, nshards, shape, causal,
+                                q.dtype)
+        else:
+            prog = _build(rt.mesh, rt.axis, nshards, shape, causal,
+                          q.dtype, q_chunk)
+        _cache[key] = prog
+    return prog(q, k, v)
+
+
+def ring_attention_n(q, k, v, iters: int, *, causal: bool = False,
+                     runtime=None):
+    """``iters`` chained ring-attention steps in ONE jitted program
+    (v := attn(q, k, v) each round) — the measurement analog of
+    ``span_halo.exchange_n`` (parallel/halo.py): per-step device time
+    excludes the tunneled per-dispatch overhead entirely.  Returns the
+    final output."""
+    rt = runtime or _rt.runtime()
+    B, S, h, d = q.shape
+    nshards = rt.nprocs
+    assert S % nshards == 0, "seq length must divide the mesh"
+    shape = (B, S // nshards, h, d)
+    flash = _flash_viable(shape, q.dtype, rt)
+    sharding = NamedSharding(rt.mesh, P(None, rt.axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    key = ("ringattn_n", pinned_id(rt.mesh), shape, causal,
+           str(q.dtype), flash, int(iters))
+    prog = _cache.get(key)
+    if prog is None:
+        build = _build_flash if flash else _build
+        one = build(rt.mesh, rt.axis, nshards, shape, causal, q.dtype)
+
+        def many(q, k, v):
+            return lax.fori_loop(
+                0, iters, lambda _, vv: one(q, k, vv), v)
+
+        prog = jax.jit(many)
         _cache[key] = prog
     return prog(q, k, v)
 

@@ -160,3 +160,30 @@ def test_async_reductions():
     assert float(d) == pytest.approx(2 * src.sum())
     t = dr_tpu.transform_reduce_async(a, transform_op=lambda x: x * x)
     assert float(t) == pytest.approx((src * src).sum())
+
+
+def test_dot_n_matches_dot():
+    from dr_tpu.algorithms.reduce import dot_n
+    n = 64 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(n)
+    b = dr_tpu.distributed_vector(n)
+    dr_tpu.iota(a, 1)
+    dr_tpu.fill(b, 0.5)
+    want = dr_tpu.dot(a, b)
+    got = float(dot_n(a, b, 3))
+    assert abs(got - want) < 1e-3 * abs(want)
+
+
+def test_inclusive_scan_n_runs_chained():
+    from dr_tpu.algorithms.scan import inclusive_scan_n
+    n = 32 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(n)
+    s = dr_tpu.distributed_vector(n)
+    dr_tpu.fill(a, 1.0)
+    inclusive_scan_n(a, s, 1)
+    # one round == a plain inclusive scan
+    np.testing.assert_allclose(dr_tpu.to_numpy(s),
+                               np.arange(1, n + 1, dtype=np.float32))
+    inclusive_scan_n(a, s, 2)  # chained round compiles and runs
+    got = dr_tpu.to_numpy(s)
+    np.testing.assert_allclose(got, np.cumsum(np.arange(1, n + 1)))

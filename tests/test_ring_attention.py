@@ -72,3 +72,53 @@ def test_pick_q_chunk_floor_holds_for_non_power_of_two():
         assert qc >= 128, (s, qc)
         # and the caller's divisor walk starts from a sane value
         assert qc <= s
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_interpret_matches_dense(causal):
+    """The Pallas flash block kernel (interpret mode) against the dense
+    oracle — the TPU path's math, validated on CPU."""
+    import jax.numpy as jnp
+    from dr_tpu.ops import flash_attention as fa
+
+    rng = np.random.default_rng(4)
+    BH, s, d = 2, 256, 128
+    q, k, v = (rng.standard_normal((BH, s, d)).astype(np.float32)
+               for _ in range(3))
+    blocks = fa.pick_blocks(s, s, d)
+    assert blocks is not None
+    bq, bk = blocks
+    qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+    m = jnp.full((BH, s, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((BH, s, 1), jnp.float32)
+    acc = jnp.zeros((BH, s, d), jnp.float32)
+    # two chained updates against the two halves emulate two ring steps
+    half = s // 2
+    m, l, acc = fa.flash_update(qb, kb[:, :half], vb[:, :half], m, l, acc,
+                                0, 0, causal=causal, bq=bq,
+                                bk=min(bk, half), interpret=True)
+    m, l, acc = fa.flash_update(qb, kb[:, half:], vb[:, half:], m, l, acc,
+                                0, half, causal=causal, bq=bq,
+                                bk=min(bk, half), interpret=True)
+    out = np.asarray(acc / np.where(np.asarray(l) > 0, np.asarray(l),
+                                    1.0))
+    qf, kf, vf = (np.asarray(np.asarray(x, np.float32), np.float64)
+                  for x in (qb, kb, vb))
+    logits = np.einsum("bqd,bkd->bqk", qf, kf) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((s, s), bool))
+        logits = np.where(mask[None], logits, -np.inf)
+    logits -= logits.max(-1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bqk,bkd->bqd", p, vf)
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-3)
+
+
+def test_pick_blocks_gates():
+    from dr_tpu.ops import flash_attention as fa
+    assert fa.pick_blocks(8192, 8192, 128) == (2048, 1024)
+    assert fa.pick_blocks(8192, 8192, 100) is None   # lane-unaligned d
+    assert fa.pick_blocks(100, 8192, 128) is None    # no q tile divisor
+    # K/V block too large for resident VMEM -> fallback
+    assert fa.pick_blocks(1 << 20, 1 << 20, 128) is None
