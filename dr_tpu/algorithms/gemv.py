@@ -119,6 +119,43 @@ def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
     return prog
 
 
+def _gemv2d_ell_program(rt, grid, th, tw, kmax, m, n):
+    """SpMV on a 2-D tile grid: per-tile dense ELL contraction against
+    the tile's LOCAL b slice, then a ``psum`` of partials over the mesh
+    columns — the collective the reference's ``grid_shape[1]==1`` assert
+    avoids (gemv.hpp:21)."""
+    gp, gq = grid
+    mesh2 = rt.mesh2d(grid)
+    key = ("gemv2d", pinned_id(mesh2), grid, th, tw, kmax, m, n)
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    def body(vals, cols, b2):
+        # per device: vals/cols (1, 1, th, kmax), b2 (1, tw)
+        bloc = b2[0]
+        contrib = vals[0, 0] * bloc[cols[0, 0]]      # (th, kmax)
+        y = jax.lax.psum(contrib.sum(-1), "mc")
+        return y[None]                               # (1, th)
+
+    shm = jax.shard_map(
+        body, mesh=mesh2,
+        in_specs=(P("mr", "mc", None, None), P("mr", "mc", None, None),
+                  P("mc", None)),
+        out_specs=P("mr", None))
+
+    def run(ell_vals, ell_cols, b):
+        v4 = ell_vals.reshape(gp, gq, th, kmax)
+        c4 = ell_cols.reshape(gp, gq, th, kmax)
+        pad = gq * tw - b.shape[0]
+        bp = jnp.pad(b, (0, pad)) if pad else b
+        return shm(v4, c4, bp.reshape(gq, tw)).reshape(-1)[:m]
+
+    prog = jax.jit(run)
+    _prog_cache[key] = prog
+    return prog
+
+
 def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     """c += A·b (reference gemv semantics: accumulate into c,
     gemv.hpp:45-66)."""
@@ -130,6 +167,16 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     if a._vals is None:
         return c  # empty matrix: nothing to add
     rt = a.runtime
+    if a.grid_shape[1] > 1:
+        # 2-D tile grid: partial SpMV per tile + psum over mesh columns
+        if a.ensure_ell():
+            prog = _gemv2d_ell_program(rt, a.grid_shape, a.tile_rows,
+                                       a.tile_cols, a._ell_width, m, n)
+            y = prog(a._ell_vals, a._ell_cols, b_arr)
+        else:
+            y = flat_gemv(a, b_arr)
+        c.assign_array(c.to_array() + y.astype(c.dtype))
+        return c
     # shard r of c must hold exactly tile r's rows — which also requires
     # the uniform ceil layout (an uneven distribution can match nshards
     # and capacity while owning different row ranges)
@@ -157,13 +204,20 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
 
 
 def flat_gemv(a: sparse_matrix, b_arr) -> jax.Array:
-    """A·b as a logical (m,) array (no output container needed)."""
+    """A·b as a logical (m,) array (no output container needed).
+
+    Handles any tile grid: per-tile local indices get their tile's
+    row/col offsets back; pad entries carry value 0 so clamped
+    out-of-range gathers/scatters contribute nothing."""
     if a._vals is None:
         return jnp.zeros((a.shape[0],), a.dtype)
-    th = a.tile_rows
-    offs = jnp.arange(a.nshards, dtype=jnp.int32)[:, None] * th
-    rows_g = (a._rows + offs).reshape(-1)
-    contrib = (a._vals * b_arr[a._cols]).reshape(-1)
+    gp, gq = a.grid_shape
+    th, tw = a.tile_rows, a.tile_cols
+    t = jnp.arange(a.nshards, dtype=jnp.int32)[:, None]
+    rows_g = (a._rows + (t // gq) * th).reshape(-1)
+    cols_g = (a._cols + (t % gq) * tw).reshape(-1)
+    contrib = (a._vals.reshape(-1)
+               * jnp.take(jnp.asarray(b_arr), cols_g, mode="clip"))
     return jnp.zeros((a.shape[0],), a.dtype).at[rows_g].add(contrib)
 
 

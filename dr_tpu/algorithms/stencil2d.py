@@ -57,20 +57,42 @@ def _build_step(m, n, mm, nn, weights, dtype):
     return step
 
 
+def _fold_ops(mat: dense_matrix):
+    """The container's folding permutation (dense_matrix.fold_ops), with
+    the matrix's sharding constrained on the fold result so the stored
+    layout stays 2-D block-sharded inside the program."""
+    from ..containers.dense_matrix import fold_ops
+    unfold, fold = fold_ops(mat._grid, mat._slots, mat._tshape, *mat.shape)
+
+    def fold_sharded(lg):
+        return lax.with_sharding_constraint(fold(lg), mat._sharding)
+
+    return unfold, fold_sharded
+
+
 def stencil2d_transform(in_mat: dense_matrix, out_mat: dense_matrix,
                         weights: Sequence[Sequence[float]]) -> None:
     """One interior stencil step: out[i,j] = sum w[di,dj]*in[i+di,j+dj].
 
     Edges (positions without a full neighborhood) keep out_mat's values,
     matching the 1-D interior contract."""
-    assert in_mat.shape == out_mat.shape
+    assert in_mat.shape == out_mat.shape and in_mat.layout == out_mat.layout
     m, n = in_mat.shape
     mm, nn = in_mat._data.shape
     key = ("st2", pinned_id(in_mat.runtime.mesh), in_mat.layout,
            tuple(map(tuple, np.asarray(weights))), str(in_mat.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
-        step = _build_step(m, n, mm, nn, weights, in_mat.dtype)
+        if in_mat.is_block:
+            step = _build_step(m, n, mm, nn, weights, in_mat.dtype)
+        else:
+            # cyclic storage: compute on the logical array, re-fold the
+            # result — one unfold/fold pair per program, not per step
+            lstep = _build_step(m, n, m, n, weights, in_mat.dtype)
+            unfold, fold = _fold_ops(in_mat)
+
+            def step(din, dout):
+                return fold(lstep(unfold(din), unfold(dout)))
         prog = jax.jit(step, donate_argnums=1)
         _prog_cache[key] = prog
     out_mat._data = prog(in_mat._data, out_mat._data)
@@ -90,7 +112,7 @@ def stencil2d_iterate_blocked(a: dense_matrix, weights, steps: int, *,
     from ..ops import stencil2d_pallas
     assert np.asarray(weights).shape == (3, 3), "blocked path is 3x3"
     m, n = a.shape
-    assert a.grid_shape == (1, 1), \
+    assert a.grid_shape == (1, 1) and a.is_block, \
         "blocked 2-D stencil runs on a single-tile matrix"
     if interpret is None:
         interpret = a.runtime.devices[0].platform != "tpu"
@@ -138,10 +160,21 @@ def stencil2d_iterate(a: dense_matrix, b: dense_matrix,
            tuple(map(tuple, np.asarray(weights))), steps, str(a.dtype))
     prog = _prog_cache.get(key)
     if prog is None:
-        step = _build_step(m, n, mm, nn, weights, a.dtype)
+        if a.is_block:
+            step = _build_step(m, n, mm, nn, weights, a.dtype)
 
-        def loop(x, y):
-            return double_buffered_loop(step, steps, x, y)
+            def loop(x, y):
+                return double_buffered_loop(step, steps, x, y)
+        else:
+            # cyclic storage: unfold once, iterate on the logical
+            # array, fold both buffers back at the end
+            lstep = _build_step(m, n, m, n, weights, a.dtype)
+            unfold, fold = _fold_ops(a)
+
+            def loop(x, y):
+                fin, oth = double_buffered_loop(
+                    lstep, steps, unfold(x), unfold(y))
+                return fold(fin), fold(oth)
 
         prog = jax.jit(loop, donate_argnums=(0, 1))
         _prog_cache[key] = prog

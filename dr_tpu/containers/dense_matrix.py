@@ -122,21 +122,31 @@ class dense_matrix:
         part = partition or block_cyclic()
         if isinstance(part, block_cyclic) and part.grid is None:
             part = block_cyclic(part.tile, part.grid_for(self._rt.nprocs))
-        assert isinstance(part, block_cyclic) and part.is_block(), (
-            "v1 supports block placement (tile.div); cyclic tile shapes "
-            "land with the multi-tile storage mode")
+        assert isinstance(part, block_cyclic), \
+            "dense_matrix distributions are block_cyclic instances"
         self._part = part
         gp, gq = part.grid_shape()
         th, tw = part.tile_shape((self._m, self._n))
         self._grid = (gp, gq)
         self._tshape = (th, tw)
+        # cyclic multi-tile storage (matrix_partition.hpp:34-86): tile
+        # (i, j) lives on device (i % gp, j % gq) at slot (i//gp, j//gq).
+        # The shard array stores tile-rows DEVICE-major, slot-minor
+        # ("folded" order), so round-robin placement is recovered by a
+        # plain 2-D block sharding; block mode is slots == (1, 1), where
+        # folded and logical layouts coincide.
+        nti = max(1, -(-self._m // th))
+        ntj = max(1, -(-self._n // tw))
+        self._ntiles = (nti, ntj)
+        self._slots = (-(-nti // gp), -(-ntj // gq))
+        si, sj = self._slots
         self._mesh = self._rt.mesh2d((gp, gq))
         self._sharding = NamedSharding(self._mesh, PartitionSpec("mr", "mc"))
         if _data is not None:
             self._data = _data
         else:
-            self._data = _zeros2d(self._mesh, gp * th, gq * tw, self._dtype,
-                                  self._sharding)
+            self._data = _zeros2d(self._mesh, gp * si * th, gq * sj * tw,
+                                  self._dtype, self._sharding)
         self._rt.register(self)
 
     # ------------------------------------------------------------------ meta
@@ -168,19 +178,30 @@ class dense_matrix:
         return self._m * self._n
 
     @property
+    def is_block(self) -> bool:
+        """One tile per device (folded == logical layout)."""
+        return self._slots == (1, 1)
+
+    @property
+    def grid_tiles(self) -> Tuple[int, int]:
+        """Tile-grid dimensions (# tiles per axis)."""
+        return self._ntiles
+
+    @property
     def layout(self):
-        return ("dense2d", self._grid, self._tshape, self._m, self._n)
+        return ("dense2d", self._grid, self._tshape, self._slots,
+                self._m, self._n)
 
     # ----------------------------------------------------------- vocabulary
     def __dr_segments__(self):
         segs = []
-        gp, gq = self._grid
+        nti, ntj = self._ntiles
         th, tw = self._tshape
-        for i in range(gp):
+        for i in range(nti):
             rb, re = i * th, min((i + 1) * th, self._m)
             if rb >= re:
                 continue
-            for j in range(gq):
+            for j in range(ntj):
                 cb, ce = j * tw, min((j + 1) * tw, self._n)
                 if cb >= ce:
                     continue
@@ -193,9 +214,9 @@ class dense_matrix:
 
     def tile(self, ij) -> MatrixTileSegment:
         i, j = ij
-        gp, gq = self._grid
+        nti, ntj = self._ntiles
         th, tw = self._tshape
-        assert 0 <= i < gp and 0 <= j < gq
+        assert 0 <= i < nti and 0 <= j < ntj
         return MatrixTileSegment(
             self, self._part.tile_rank(i, j),
             i * th, min((i + 1) * th, self._m),
@@ -203,14 +224,16 @@ class dense_matrix:
 
     # ----------------------------------------------------------- value APIs
     def to_array(self) -> jax.Array:
-        return self._data[:self._m, :self._n]
+        if self.is_block:
+            return self._data[:self._m, :self._n]
+        return _unfold2d(self._mesh, self._grid, self._slots, self._tshape,
+                         self._m, self._n, self._dtype)(self._data)
 
     def assign_array(self, values) -> None:
         values = jnp.asarray(values, self._dtype)
         assert values.shape == (self._m, self._n)
-        gp, gq = self._grid
-        th, tw = self._tshape
-        self._data = _pack2d(self._mesh, gp * th, gq * tw, self._m, self._n,
+        self._data = _pack2d(self._mesh, self._grid, self._slots,
+                             self._tshape, self._m, self._n,
                              self._dtype, self._sharding)(values)
 
     @classmethod
@@ -224,15 +247,28 @@ class dense_matrix:
         from ..utils.host import to_host
         return to_host(self.to_array())
 
+    def _stored_rc(self, r, c):
+        """Logical (row, col) -> stored (folded) coordinates.  Works on
+        scalars and jnp arrays alike."""
+        gp, gq = self._grid
+        si, sj = self._slots
+        th, tw = self._tshape
+        i, wr = r // th, r % th
+        j, wc = c // tw, c % tw
+        return (((i % gp) * si + i // gp) * th + wr,
+                ((j % gq) * sj + j // gq) * tw + wc)
+
     def _local_tile(self, rank, rb, re, cb, ce):
-        # block mode: each device owns exactly one shard
+        # each device owns one shard holding all its (slot-ordered) tiles
+        th, tw = self._tshape
+        si, sj = self._slots
+        i, j = rb // th, cb // tw
+        lr = (i // self._grid[0]) * th   # within-shard row of this tile
+        lc = (j // self._grid[1]) * tw
         target = self._mesh.devices.reshape(-1)[rank]
         for sh in self._data.addressable_shards:
             if sh.device.id == target.id:
-                ri, ci = sh.index
-                r0 = 0 if ri.start is None else ri.start
-                c0 = 0 if ci.start is None else ci.start
-                return sh.data[rb - r0:re - r0, cb - c0:ce - c0]
+                return sh.data[lr:lr + (re - rb), lc:lc + (ce - cb)]
         return self.to_array()[rb:re, cb:ce]  # multi-host fallback
 
     # ------------------------------------------------ element/batched access
@@ -253,22 +289,41 @@ class dense_matrix:
             j += self._n
         if not (0 <= i < self._m and 0 <= j < self._n):
             raise IndexError((i, j))
-        return self._data[i, j].item()
+        si, sj = self._stored_rc(i, j)
+        return self._data[si, sj].item()
 
     def __setitem__(self, ij, value) -> None:
         i, j = int(ij[0]), int(ij[1])
         if not (0 <= i < self._m and 0 <= j < self._n):
             raise IndexError((i, j))
-        self._data = self._data.at[i, j].set(
+        si, sj = self._stored_rc(i, j)
+        self._data = self._data.at[si, sj].set(
             jnp.asarray(value, self._dtype))
+
+    def _check_rc(self, rows, cols):
+        """Numpy-convention negatives + strict bounds (same contract as
+        distributed_vector.get/put: no silent wrapping — folded storage
+        would alias out-of-range indices onto OTHER valid elements)."""
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        rows = np.where(rows < 0, rows + self._m, rows)
+        cols = np.where(cols < 0, cols + self._n, cols)
+        if ((rows < 0) | (rows >= self._m)).any() or \
+                ((cols < 0) | (cols >= self._n)).any():
+            raise IndexError(
+                f"index out of range for shape {(self._m, self._n)}")
+        return rows, cols
 
     def get(self, rows, cols):
         """Batched element gather."""
-        return self._data[jnp.asarray(rows), jnp.asarray(cols)]
+        rows, cols = self._check_rc(rows, cols)
+        sr, sc = self._stored_rc(jnp.asarray(rows), jnp.asarray(cols))
+        return self._data[sr, sc]
 
     def put(self, rows, cols, values) -> None:
-        self._data = self._data.at[
-            jnp.asarray(rows), jnp.asarray(cols)].set(
+        rows, cols = self._check_rc(rows, cols)
+        sr, sc = self._stored_rc(jnp.asarray(rows), jnp.asarray(cols))
+        self._data = self._data.at[sr, sc].set(
             jnp.asarray(values, self._dtype))
 
     def block_until_ready(self):
@@ -293,13 +348,56 @@ def _zeros2d(mesh, mm, nn, dtype, sharding):
     return fn()
 
 
-def _pack2d(mesh, mm, nn, m, n, dtype, sharding):
-    key = ("p2", pinned_id(mesh), mm, nn, m, n, str(dtype))
+def fold_ops(grid, slots, tshape, m, n):
+    """(unfold, fold) PURE fns between the FOLDED stored layout and the
+    logical (m, n) array — the single home of the folding permutation
+    (also used inside algorithm programs, e.g. algorithms/stencil2d.py).
+
+    Folding permutes tile-rows/cols from logical (slot-major, device-
+    minor: tile i lives at (i // gp, i % gp)) to stored (device-major,
+    slot-minor) order so the cyclic placement becomes a plain 2-D block
+    sharding.  With slots == (1, 1) the permutation is the identity."""
+    gp, gq = grid
+    si, sj = slots
+    th, tw = tshape
+    mm, nn = gp * si * th, gq * sj * tw
+
+    def unfold(data):
+        lg = data
+        if slots != (1, 1):
+            lg = (lg.reshape(gp, si, th, gq, sj, tw)
+                  .transpose(1, 0, 2, 4, 3, 5).reshape(mm, nn))
+        return lg[:m, :n]
+
+    def fold(logical):
+        out = jnp.zeros((mm, nn), logical.dtype).at[:m, :n].set(logical)
+        if slots != (1, 1):
+            out = (out.reshape(si, gp, th, sj, gq, tw)
+                   .transpose(1, 0, 2, 4, 3, 5).reshape(mm, nn))
+        return out
+
+    return unfold, fold
+
+
+def _pack2d(mesh, grid, slots, tshape, m, n, dtype, sharding):
+    """Logical (m, n) -> padded FOLDED stored array (jitted, sharded)."""
+    key = ("p2", pinned_id(mesh), grid, slots, tshape, m, n, str(dtype))
     fn = _cache.get(key)
     if fn is None:
-        def pack(values):
-            out = jnp.zeros((mm, nn), dtype)
-            return out.at[:m, :n].set(values)
-        fn = jax.jit(pack, out_shardings=sharding)
+        _, fold = fold_ops(grid, slots, tshape, m, n)
+        fn = jax.jit(lambda values: fold(values.astype(dtype)),
+                     out_shardings=sharding)
+        _cache[key] = fn
+    return fn
+
+
+def _unfold2d(mesh, grid, slots, tshape, m, n, dtype):
+    """Stored FOLDED array -> logical (m, n) view (jitted; inverse of
+    :func:`_pack2d`'s permutation)."""
+    key = ("u2", pinned_id(mesh), grid, slots, tshape, m, n, str(dtype))
+    fn = _cache.get(key)
+    if fn is None:
+        unfold, _ = fold_ops(grid, slots, tshape, m, n)
+        fn = jax.jit(unfold)
         _cache[key] = fn
     return fn

@@ -1,4 +1,4 @@
-"""``sparse_matrix``: distributed sparse matrix, row-tiled on the mesh.
+"""``sparse_matrix``: distributed sparse matrix, tiled on the mesh.
 
 TPU re-design of ``shp::sparse_matrix`` (``shp/containers/
 sparse_matrix.hpp``): the reference keeps one CSR triple
@@ -31,22 +31,27 @@ __all__ = ["sparse_matrix", "random_sparse_matrix", "CsrTileSegment"]
 
 
 class CsrTileSegment:
-    """One row tile's sparse triple, with rank — the ``csr_matrix_view``
-    analog (shp/views/csr_matrix_view.hpp)."""
+    """One tile's sparse triple, with rank — the ``csr_matrix_view``
+    analog (shp/views/csr_matrix_view.hpp).  Row-tiled matrices have
+    ``cb = 0``; 2-D partitions carry the tile's column window too
+    (sparse_matrix.hpp:344-349: tiles come from the same
+    matrix_partition machinery as dense)."""
 
-    __slots__ = ("base", "_rank", "rb", "re")
+    __slots__ = ("base", "_rank", "rb", "re", "cb", "ce")
 
-    def __init__(self, base, rank, rb, re):
+    def __init__(self, base, rank, rb, re, cb=0, ce=None):
         self.base = base
         self._rank = rank
         self.rb, self.re = rb, re
+        self.cb = cb
+        self.ce = base.shape[1] if ce is None else ce
 
     def __dr_rank__(self):
         return self._rank
 
     @property
     def shape(self):
-        return (self.re - self.rb, self.base.shape[1])
+        return (self.re - self.rb, self.ce - self.cb)
 
     def __len__(self):
         return int(self.nnz)
@@ -56,10 +61,10 @@ class CsrTileSegment:
         return self.base._tile_nnz[self._rank]
 
     def triples(self):
-        """(rows, cols, values) with GLOBAL row ids, host numpy."""
+        """(rows, cols, values) with GLOBAL ids, host numpy."""
         k = int(self.base._tile_nnz[self._rank])
         rows = np.asarray(self.base._rows[self._rank][:k]) + self.rb
-        cols = np.asarray(self.base._cols[self._rank][:k])
+        cols = np.asarray(self.base._cols[self._rank][:k]) + self.cb
         vals = np.asarray(self.base._vals[self._rank][:k])
         return rows, cols, vals
 
@@ -82,20 +87,39 @@ class CsrTileSegment:
 
     def __repr__(self):
         return (f"CsrTileSegment(rank={self._rank}, rows=[{self.rb},"
-                f"{self.re}), nnz={int(self.nnz)})")
+                f"{self.re}), cols=[{self.cb},{self.ce}), "
+                f"nnz={int(self.nnz)})")
 
 
 class sparse_matrix:
-    """Row-tiled distributed sparse matrix (CSR surface, padded-COO device
-    layout)."""
+    """Distributed sparse matrix (CSR surface, padded-COO device layout).
 
-    def __init__(self, shape: Tuple[int, int], dtype=None, *, runtime=None):
+    Default partition is row tiles (grid (P, 1), the reference gemv's
+    required shape); any ``block_cyclic`` grid with ``gp*gq == nprocs``
+    and ``tile.div`` tiles gives a 2-D tiling whose SpMV reduces
+    partials over mesh columns (exceeding the reference's
+    ``grid_shape[1]==1`` assert, gemv.hpp:21)."""
+
+    def __init__(self, shape: Tuple[int, int], dtype=None, *,
+                 partition=None, runtime=None):
         self._rt = runtime or _rt.runtime()
         self._m, self._n = int(shape[0]), int(shape[1])
         self._dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
         P = self._rt.nprocs
+        if partition is None:
+            gp, gq = P, 1
+        else:
+            from .partition import block_cyclic, tile as _tile
+            assert isinstance(partition, block_cyclic)
+            gp, gq = partition.grid_for(P)
+            assert gp * gq == P, \
+                "sparse grids place one tile per device (gp*gq == nprocs)"
+            assert partition.tile == (_tile.div, _tile.div), \
+                "sparse tiles are tile.div (one block per device)"
+        self._grid = (gp, gq)
         self._nshards = P
-        self._th = -(-self._m // P)  # rows per tile
+        self._th = -(-self._m // gp)  # rows per tile
+        self._tw = -(-self._n // gq)  # cols per tile
         self._vals = None
         self._rows = None
         self._cols = None
@@ -107,14 +131,17 @@ class sparse_matrix:
 
     # ------------------------------------------------------------- builders
     @classmethod
-    def from_coo(cls, shape, rows, cols, values, *, runtime=None):
+    def from_coo(cls, shape, rows, cols, values, *, partition=None,
+                 runtime=None):
         """Build from global COO triples (any order)."""
-        self = cls(shape, np.asarray(values).dtype, runtime=runtime)
+        self = cls(shape, np.asarray(values).dtype, partition=partition,
+                   runtime=runtime)
         rows = np.asarray(rows, np.int64)
         cols = np.asarray(cols, np.int64)
         values = np.asarray(values)
-        P, th = self._nshards, self._th
-        tile_of = rows // th
+        P, th, tw = self._nshards, self._th, self._tw
+        gp, gq = self._grid
+        tile_of = (rows // th) * gq + cols // tw
         order = np.argsort(tile_of, kind="stable")
         rows, cols, values, tile_of = (rows[order], cols[order],
                                        values[order], tile_of[order])
@@ -128,8 +155,8 @@ class sparse_matrix:
             c = int(counts[t])
             sl = slice(start, start + c)
             vals_h[t, :c] = values[sl]
-            rows_h[t, :c] = rows[sl] - t * th  # tile-local rows
-            cols_h[t, :c] = cols[sl]
+            rows_h[t, :c] = rows[sl] - (t // gq) * th  # tile-local rows
+            cols_h[t, :c] = cols[sl] - (t % gq) * tw   # tile-local cols
             start += c
         sh = NamedSharding(self._rt.mesh, PartitionSpec(self._rt.axis, None))
         self._vals = jax.device_put(jnp.asarray(vals_h), sh)
@@ -199,20 +226,22 @@ class sparse_matrix:
         return True
 
     @classmethod
-    def from_csr(cls, shape, rowptr, cols, values, *, runtime=None):
+    def from_csr(cls, shape, rowptr, cols, values, *, partition=None,
+                 runtime=None):
         """Build from a global CSR triple (the reference's construction
         path, sparse_matrix.hpp:286-336)."""
         rowptr = np.asarray(rowptr, np.int64)
         rows = np.repeat(np.arange(shape[0], dtype=np.int64),
                          np.diff(rowptr))
-        return cls.from_coo(shape, rows, cols, values, runtime=runtime)
+        return cls.from_coo(shape, rows, cols, values,
+                            partition=partition, runtime=runtime)
 
     @classmethod
-    def from_dense(cls, dense, *, runtime=None):
+    def from_dense(cls, dense, *, partition=None, runtime=None):
         dense = np.asarray(dense)
         rows, cols = np.nonzero(dense)
         return cls.from_coo(dense.shape, rows, cols, dense[rows, cols],
-                            runtime=runtime)
+                            partition=partition, runtime=runtime)
 
     # ------------------------------------------------------------------ meta
     @property
@@ -236,8 +265,12 @@ class sparse_matrix:
         return self._th
 
     @property
+    def tile_cols(self) -> int:
+        return self._tw
+
+    @property
     def grid_shape(self):
-        return (self._nshards, 1)
+        return self._grid
 
     @property
     def runtime(self):
@@ -249,11 +282,15 @@ class sparse_matrix:
     # ----------------------------------------------------------- vocabulary
     def __dr_segments__(self):
         segs = []
-        for r in range(self._nshards):
-            rb = r * self._th
+        gp, gq = self._grid
+        for t in range(self._nshards):
+            i, j = t // gq, t % gq
+            rb = i * self._th
             re = min(self._m, rb + self._th)
-            if rb < re and self._tile_nnz[r] > 0:
-                segs.append(CsrTileSegment(self, r, rb, re))
+            cb = j * self._tw
+            ce = min(self._n, cb + self._tw)
+            if rb < re and cb < ce and self._tile_nnz[t] > 0:
+                segs.append(CsrTileSegment(self, t, rb, re, cb, ce))
         return segs
 
     def tiles(self):
@@ -261,9 +298,12 @@ class sparse_matrix:
 
     def tile(self, ij) -> CsrTileSegment:
         i, j = (ij if isinstance(ij, tuple) else (ij, 0))
-        assert j == 0, "row-tiled: one column of tiles"
-        rb = i * self._th
-        return CsrTileSegment(self, i, rb, min(self._m, rb + self._th))
+        gp, gq = self._grid
+        assert 0 <= i < gp and 0 <= j < gq
+        rb, cb = i * self._th, j * self._tw
+        return CsrTileSegment(self, i * gq + j,
+                              rb, min(self._m, rb + self._th),
+                              cb, min(self._n, cb + self._tw))
 
     # ----------------------------------------------------------- value APIs
     def to_dense(self) -> np.ndarray:
@@ -282,12 +322,13 @@ class sparse_matrix:
         return self
 
     def __repr__(self):
+        gp, gq = self._grid
         return (f"sparse_matrix(shape={self.shape}, nnz={self._nnz}, "
-                f"tiles={self._nshards}x1, dtype={self._dtype})")
+                f"tiles={gp}x{gq}, dtype={self._dtype})")
 
 
-def random_sparse_matrix(shape, density=0.01, *, seed=0, runtime=None,
-                         dtype=np.float32):
+def random_sparse_matrix(shape, density=0.01, *, seed=0, partition=None,
+                         runtime=None, dtype=np.float32):
     """Random sparse matrix (reference generate_random_csr,
     sparse_matrix.hpp:299-336)."""
     m, n = shape
@@ -296,4 +337,5 @@ def random_sparse_matrix(shape, density=0.01, *, seed=0, runtime=None,
     flat = rng.choice(m * n, size=nnz, replace=False)
     rows, cols = flat // n, flat % n
     vals = rng.standard_normal(nnz).astype(dtype)
-    return sparse_matrix.from_coo(shape, rows, cols, vals, runtime=runtime)
+    return sparse_matrix.from_coo(shape, rows, cols, vals,
+                                  partition=partition, runtime=runtime)

@@ -33,7 +33,8 @@ def save(path: str, container) -> None:
         arrays = {"data": container.materialize()}
     elif isinstance(container, dense_matrix):
         meta = {"kind": "dense_matrix",
-                "grid": list(container.grid_shape)}
+                "grid": list(container.grid_shape),
+                "tile": list(container.partition.tile)}
         arrays = {"data": container.materialize()}
     elif isinstance(container, distributed_mdarray):
         meta = {"kind": "mdarray", "grid": list(container.grid)}
@@ -45,7 +46,8 @@ def save(path: str, container) -> None:
             rows.append(r)
             cols.append(c)
             vals.append(v)
-        meta = {"kind": "sparse_matrix", "shape": list(container.shape)}
+        meta = {"kind": "sparse_matrix", "shape": list(container.shape),
+                "grid": list(container.grid_shape)}
         arrays = {
             "rows": np.concatenate(rows) if rows else np.zeros(0, np.int64),
             "cols": np.concatenate(cols) if cols else np.zeros(0, np.int64),
@@ -87,12 +89,42 @@ def load(path: str, *, runtime=None):
                                                  distribution=sizes,
                                                  runtime=runtime)
         if kind == "dense_matrix":
-            return dense_matrix.from_array(f["data"], runtime=runtime)
+            part = _matrix_partition(meta, runtime, cyclic_ok=True)
+            return dense_matrix.from_array(f["data"], part,
+                                           runtime=runtime)
         if kind == "mdarray":
             return distributed_mdarray.from_array(f["data"],
                                                   runtime=runtime)
         if kind == "sparse_matrix":
+            part = _matrix_partition(meta, runtime, cyclic_ok=False)
             return sparse_matrix.from_coo(tuple(meta["shape"]), f["rows"],
                                           f["cols"], f["vals"],
-                                          runtime=runtime)
+                                          partition=part, runtime=runtime)
     raise ValueError(f"unknown checkpoint kind: {kind}")
+
+
+def _matrix_partition(meta, runtime, *, cyclic_ok):
+    """Rebuild the checkpointed partition: exact when the saved grid fits
+    the current mesh; re-blocked (default grid) when a plain block layout
+    moved to a different mesh size; error when a non-default layout
+    cannot be represented there."""
+    from ..containers.partition import block_cyclic, tile as _tile
+    from ..parallel import runtime as _rt
+
+    grid = meta.get("grid")
+    tile = meta.get("tile", [_tile.div, _tile.div])
+    if grid is None:
+        return None
+    P = (runtime or _rt.runtime()).nprocs
+    gp, gq = int(grid[0]), int(grid[1])
+    is_div = tuple(tile) == (_tile.div, _tile.div)
+    if gp * gq == P:
+        if is_div and not cyclic_ok and gq == 1:
+            return None  # default row tiling: let the container choose
+        return block_cyclic(tile=tuple(tile), grid=(gp, gq))
+    if is_div:
+        return None  # plain block layout: re-block on the current mesh
+    raise ValueError(
+        f"checkpointed cyclic partition (grid {gp}x{gq}, tile {tile}) "
+        f"does not fit the current {P}-device mesh; re-save with a "
+        "block (tile.div) layout to re-block on load")

@@ -47,3 +47,30 @@ def test_mdarray_roundtrip(tmp_path):
     checkpoint.save(str(p), md)
     back = checkpoint.load(str(p))
     np.testing.assert_allclose(back.materialize(), src)
+
+
+def test_cyclic_dense_partition_roundtrip(tmp_path):
+    part = dr_tpu.block_cyclic(tile=(4, 4), grid=dr_tpu.factor(
+        dr_tpu.nprocs()))
+    src = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+    mat = dr_tpu.dense_matrix.from_array(src, part)
+    p = str(tmp_path / "cyc")
+    checkpoint.save(p, mat)
+    back = checkpoint.load(p)
+    assert not back.is_block
+    assert back.partition.tile == (4, 4)
+    assert back.grid_shape == part.grid
+    np.testing.assert_array_equal(back.materialize(), src)
+
+
+def test_sparse_2d_partition_roundtrip(tmp_path):
+    part = dr_tpu.block_cyclic(grid=dr_tpu.factor(dr_tpu.nprocs()))
+    d = np.zeros((12, 12), dtype=np.float32)
+    d[3, 4] = 2.0
+    d[11, 1] = -1.0
+    sp = dr_tpu.sparse_matrix.from_dense(d, partition=part)
+    p = str(tmp_path / "sp2d")
+    checkpoint.save(p, sp)
+    back = checkpoint.load(p)
+    assert back.grid_shape == part.grid_for(dr_tpu.nprocs())
+    np.testing.assert_array_equal(back.to_dense(), d)

@@ -98,3 +98,108 @@ def test_gemm():
     C = dr_tpu.gemm(A, B)
     np.testing.assert_allclose(C.materialize(), a @ b, rtol=1e-4,
                                atol=1e-5)
+
+
+# ---------------------------------------------------------------- cyclic
+
+def _cyclic_part(th, tw, grid=None):
+    if grid is None:
+        grid = dr_tpu.factor(dr_tpu.nprocs())
+    return dr_tpu.block_cyclic(tile=(th, tw), grid=grid)
+
+
+def test_cyclic_roundtrip():
+    src = np.arange(24 * 20, dtype=np.float32).reshape(24, 20)
+    mat = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    assert not mat.is_block
+    np.testing.assert_array_equal(mat.materialize(), src)
+
+
+def test_cyclic_tile_rank_round_robin():
+    # round-robin parity with the reference's tile_rank
+    # (matrix_partition.hpp:34-86)
+    part = _cyclic_part(4, 4, grid=(2, 2))
+    src = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+    mat = dr_tpu.dense_matrix.from_array(src, part)
+    nti, ntj = mat.grid_tiles
+    assert (nti, ntj) == (4, 4)
+    for t in mat.tiles():
+        i, j = t.rb // 4, t.cb // 4
+        assert dr_tpu.rank(t) == (i % 2) * 2 + (j % 2)
+
+
+def test_cyclic_segments_cover_and_materialize():
+    src = np.random.default_rng(3).standard_normal((24, 16)) \
+        .astype(np.float32)
+    mat = dr_tpu.dense_matrix.from_array(src, _cyclic_part(8, 4))
+    segs = dr_tpu.segments(mat)
+    total = sum((s.re - s.rb) * (s.ce - s.cb) for s in segs)
+    assert total == 24 * 16
+    for t in segs:
+        np.testing.assert_array_equal(t.materialize(),
+                                      src[t.rb:t.re, t.cb:t.ce])
+
+
+def test_cyclic_local_tile():
+    src = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+    mat = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    for t in mat.tiles():
+        loc = dr_tpu.local(t)
+        np.testing.assert_array_equal(np.asarray(loc),
+                                      src[t.rb:t.re, t.cb:t.ce])
+
+
+def test_cyclic_uneven_trim():
+    # tiles that do not divide the shape: last row/col tiles are trimmed
+    src = np.arange(10 * 7, dtype=np.float32).reshape(10, 7)
+    mat = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    np.testing.assert_array_equal(mat.materialize(), src)
+    total = sum(len(t) for t in mat.tiles())
+    assert total == 70
+
+
+def test_cyclic_element_and_batched_access():
+    src = np.zeros((12, 12), dtype=np.float32)
+    mat = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    mat[5, 7] = 3.0
+    assert mat[5, 7] == 3.0
+    mat.put([1, 9], [2, 11], [4.0, 5.0])
+    got = np.asarray(mat.get([1, 9, 5], [2, 11, 7]))
+    np.testing.assert_array_equal(got, [4.0, 5.0, 3.0])
+    # the logical view agrees
+    arr = mat.materialize()
+    assert arr[1, 2] == 4.0 and arr[9, 11] == 5.0 and arr[5, 7] == 3.0
+
+
+def test_cyclic_gemm_matches_block():
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((16, 12)).astype(np.float32)
+    b = rng.standard_normal((12, 8)).astype(np.float32)
+    A = dr_tpu.dense_matrix.from_array(a, _cyclic_part(4, 4))
+    B = dr_tpu.dense_matrix.from_array(b, _cyclic_part(4, 4))
+    C = dr_tpu.gemm(A, B)
+    np.testing.assert_allclose(C.materialize(), a @ b, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_cyclic_stencil2d_matches_block():
+    rng = np.random.default_rng(8)
+    src = rng.standard_normal((16, 16)).astype(np.float32)
+    w = dr_tpu.heat_step_weights(0.25)
+    Ac = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    Bc = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    Ab = dr_tpu.dense_matrix.from_array(src)
+    Bb = dr_tpu.dense_matrix.from_array(src)
+    out_c = dr_tpu.stencil2d_iterate(Ac, Bc, w, steps=3)
+    out_b = dr_tpu.stencil2d_iterate(Ab, Bb, w, steps=3)
+    np.testing.assert_allclose(out_c.materialize(), out_b.materialize(),
+                               rtol=1e-5, atol=1e-6)
+    # single-step transform parity too
+    Ac2 = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    Bc2 = dr_tpu.dense_matrix.from_array(src, _cyclic_part(4, 4))
+    Ab2 = dr_tpu.dense_matrix.from_array(src)
+    Bb2 = dr_tpu.dense_matrix.from_array(src)
+    dr_tpu.stencil2d_transform(Ac2, Bc2, w)
+    dr_tpu.stencil2d_transform(Ab2, Bb2, w)
+    np.testing.assert_allclose(Bc2.materialize(), Bb2.materialize(),
+                               rtol=1e-5, atol=1e-6)
