@@ -31,7 +31,7 @@ from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix
 from ..containers.sparse_matrix import sparse_matrix
 
-__all__ = ["gemv", "flat_gemv", "gemm"]
+__all__ = ["gemv", "gemv_n", "flat_gemv", "gemm"]
 
 
 def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
@@ -63,49 +63,56 @@ _GATHER_W = 16     # b-slice width per gather (measured TPU sweet spot)
 _ELL_CHUNK = 2 ** 13  # tile rows per lax.map chunk (bounds intermediates)
 
 
-def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
-    """Scatter-free SpMV over the row-grouped (ELL) layout.
+def _ell_local(vals0, cols0, b, th, kmax):
+    """One shard's ELL contraction: (th,) row sums of vals * b[cols].
 
     TPU scatter-adds (segment_sum) and per-element gathers both serialize
     (~4 ns/element); gathering W-wide slices of b and selecting the lane
     with a one-hot compare amortizes the per-gather cost ~2.5x, and the
     fixed (th, kmax) ELL shape makes the multiply + row-sum dense VPU
     work.  b is padded to a multiple of W so every slice is in range."""
+    W = _GATHER_W
+    pad = (-b.shape[0]) % W
+    bp = jnp.concatenate([b, jnp.zeros((pad,), b.dtype)]) if pad else b
+    B2 = bp.reshape(-1, W)
+    q, r = cols0 // W, cols0 % W
+
+    def block(args):
+        v, qs, rs = args
+        gathered = B2[qs]                       # (ch, kmax, W)
+        oh = rs[..., None] == jax.lax.broadcasted_iota(
+            jnp.int32, rs.shape + (W,), rs.ndim)
+        return (v * (gathered * oh).sum(-1)).sum(-1)
+
+    ch = _ELL_CHUNK
+    if th > ch:
+        nch, rem = divmod(th, ch)
+        body_rows = nch * ch
+        local = jax.lax.map(
+            block, (vals0[:body_rows].reshape(nch, ch, kmax),
+                    q[:body_rows].reshape(nch, ch, kmax),
+                    r[:body_rows].reshape(nch, ch, kmax))).reshape(
+                        body_rows)
+        if rem:  # remainder rows in one bounded tail block
+            tail = block((vals0[body_rows:], q[body_rows:],
+                          r[body_rows:]))
+            local = jnp.concatenate([local, tail])
+    else:
+        local = block((vals0, q, r))
+    return local
+
+
+def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
+    """Scatter-free SpMV over the row-grouped (ELL) layout
+    (see :func:`_ell_local`)."""
     key = ("gemv_ell", pinned_id(mesh), axis, nshards, th, kmax, seg_out, prev_out)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
-    W = _GATHER_W
 
     def body(c_blk, vals, cols, b):
         # one shard: vals/cols (1, th, kmax), b (n,) replicated
-        pad = (-b.shape[0]) % W
-        bp = jnp.concatenate([b, jnp.zeros((pad,), b.dtype)]) if pad else b
-        B2 = bp.reshape(-1, W)
-        q, r = cols[0] // W, cols[0] % W
-
-        def block(args):
-            v, qs, rs = args
-            gathered = B2[qs]                       # (ch, kmax, W)
-            oh = rs[..., None] == jax.lax.broadcasted_iota(
-                jnp.int32, rs.shape + (W,), rs.ndim)
-            return (v * (gathered * oh).sum(-1)).sum(-1)
-
-        ch = _ELL_CHUNK
-        if th > ch:
-            nch, rem = divmod(th, ch)
-            body_rows = nch * ch
-            local = jax.lax.map(
-                block, (vals[0][:body_rows].reshape(nch, ch, kmax),
-                        q[:body_rows].reshape(nch, ch, kmax),
-                        r[:body_rows].reshape(nch, ch, kmax))).reshape(
-                            body_rows)
-            if rem:  # remainder rows in one bounded tail block
-                tail = block((vals[0][body_rows:], q[body_rows:],
-                              r[body_rows:]))
-                local = jnp.concatenate([local, tail])
-        else:
-            local = block((vals[0], q, r))
+        local = _ell_local(vals[0], cols[0], b, th, kmax)
         upd = c_blk[0, prev_out:prev_out + seg_out] + local.astype(c_blk.dtype)
         return c_blk.at[0, prev_out:prev_out + seg_out].set(upd)
 
@@ -117,6 +124,49 @@ def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
     prog = jax.jit(shmapped, donate_argnums=0)
     _prog_cache[key] = prog
     return prog
+
+
+def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
+    """``iters`` chained SpMVs in ONE jitted program (the exchange_n /
+    dot_n measurement analog): each round perturbs b by a scalar of the
+    running output (times 1e-38) so XLA can neither hoist the
+    contraction nor skip re-reading b.  Accumulates into ``c`` like
+    ``iters`` gemv calls (up to the negligible perturbation)."""
+    assert isinstance(a, sparse_matrix) and a.grid_shape[1] == 1
+    m, n = a.shape
+    b_arr = b.to_array() if hasattr(b, "to_array") else jnp.asarray(b)
+    assert b_arr.shape == (n,)
+    have_ell = a.ensure_ell()   # side effect must survive python -O
+    assert have_ell, "gemv_n needs the ELL fast path"
+    rt = a.runtime
+    assert (isinstance(c, distributed_vector)
+            and uniform_layout(c.layout)
+            and c.nshards == a.nshards and c.segment_size == a.tile_rows
+            and c.runtime is rt), "gemv_n needs the aligned fast path"
+    th, kmax = a.tile_rows, a._ell_width
+    seg_out, prev_out = c.segment_size, c.halo_bounds.prev
+    key = ("gemv_ell_n", pinned_id(rt.mesh), rt.axis, a.nshards, th,
+           kmax, seg_out, prev_out, int(iters))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        def body(c_blk, vals, cols, b):
+            def it(_, cb):
+                s = cb[0, prev_out] * jnp.asarray(1e-38, b.dtype)
+                local = _ell_local(vals[0], cols[0], b + s, th, kmax)
+                upd = (cb[0, prev_out:prev_out + seg_out]
+                       + local.astype(cb.dtype))
+                return cb.at[0, prev_out:prev_out + seg_out].set(upd)
+            return jax.lax.fori_loop(0, iters, it, c_blk)
+
+        shmapped = jax.shard_map(
+            body, mesh=rt.mesh,
+            in_specs=(P(rt.axis, None), P(rt.axis, None, None),
+                      P(rt.axis, None, None), P()),
+            out_specs=P(rt.axis, None))
+        prog = jax.jit(shmapped, donate_argnums=0)
+        _prog_cache[key] = prog
+    c._data = prog(c._data, a._ell_vals, a._ell_cols, b_arr)
+    return c
 
 
 def _gemv2d_ell_program(rt, grid, th, tw, kmax, m, n):

@@ -278,8 +278,10 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
         f"to be a multiple of {la} lanes")
 
     w = tuple(float(x) for x in weights)
+    # impl resolves from env at build time: key on it so flipping
+    # DR_TPU_MM_IMPL between calls rebuilds instead of silently reusing
     key = ("stencil_mm", pinned_id(cont.runtime.mesh), cont.layout, w, k_block,
-           str(cont.dtype))
+           str(cont.dtype), _matmul_impl(cont))
     return _blocked_drive(cont, key, steps, k_block,
                           lambda nst: _make_matmul_prog(cont, w, nst))
 
@@ -296,19 +298,38 @@ def _ring_exchange_full(blk, seg, halo_w, axis, nshards):
     return blk
 
 
+def _matmul_impl(cont) -> str:
+    """Composed-operator apply implementation: the fused VMEM Pallas
+    apply on TPU (one HBM read + write per composed block instead of
+    the P-form's ~4x), the XLA P-form elsewhere or on request
+    (DR_TPU_MM_IMPL=pallas|xla)."""
+    import os
+    from ..ops import stencil_pallas
+    impl = os.environ.get("DR_TPU_MM_IMPL", "").strip().lower()
+    if impl in ("pallas", "xla"):
+        return impl
+    return "pallas" if (
+        stencil_pallas.supported()
+        and cont.runtime.devices[0].platform == "tpu") else "xla"
+
+
 def _make_matmul_prog(cont, weights, ksteps):
     from ..ops import stencil_matmul
     nshards, seg, prev, nxt, n = cont.layout
     halo_w = prev
     axis = cont.runtime.axis
+    impl = _matmul_impl(cont)
 
     def body(blk):
         blk = _ring_exchange_full(blk, seg, halo_w, axis, nshards)
         return stencil_matmul.matmul_stencil_row(
-            blk, seg, halo_w, weights, ksteps)
+            blk, seg, halo_w, weights, ksteps, impl=impl)
 
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axis
+    # annotation, which the default shard_map checker rejects
     shm = jax.shard_map(body, mesh=cont.runtime.mesh,
-                        in_specs=P(axis, None), out_specs=P(axis, None))
+                        in_specs=P(axis, None), out_specs=P(axis, None),
+                        check_vma=(impl != "pallas"))
     return jax.jit(shm, donate_argnums=0)
 
 

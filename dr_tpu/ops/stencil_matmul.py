@@ -107,13 +107,127 @@ def _apply(src, W, segc):
             + P[2:segc + 2, 2 * LANES:])          # A_+1 @ X_{j+1}
 
 
+def _pick_chunk_rows(segc: int, cap: int = 4096):
+    """Largest power-of-two chunk <= cap dividing the owned columns
+    (always exists: 1 divides everything; large segments get large,
+    DMA-efficient chunks)."""
+    cr = cap
+    while cr > 1:
+        if segc % cr == 0:
+            return cr
+        cr //= 2
+    return 1
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
+                  dtype_name: str, interpret: bool = False):
+    """Fused Pallas apply: the XLA P-form writes the (rows, 384) product
+    through HBM (~3x the row) and re-reads it for the shifted adds; this
+    kernel keeps matmul + shifted add VMEM-resident so HBM sees exactly
+    one read and one write per element per composed block.
+
+    Operates on the (nrows, 128) lane-blocked view; owned columns
+    [hc, hc+segc) are stepped in ``cr``-column chunks (double-buffered
+    DMA).  Input and output are SEPARATE buffers — aliasing them would
+    race chunk i's output write against chunk i+1's ghost-row prefetch
+    at every chunk boundary — and the ghost columns pass through via
+    two explicit side DMAs.  (The kernel body never uses the stencil
+    weights; they arrive as the W operand, so geometry alone keys the
+    compile cache.)"""
+    from jax.experimental import pallas as pl
+    from .stencil_pallas import pltpu
+
+    dtype = jnp.dtype(dtype_name)
+    nch = segc // cr
+    wrows = cr + 2  # one ghost lane-column each side
+
+    def kernel(w_ref, row_hbm, out_hbm, vin, vout, in_sem, out_sem,
+               ghost_sem):
+        i = pl.program_id(0)
+        slot = jax.lax.rem(i, 2)
+
+        def in_dma(c, s):
+            return pltpu.make_async_copy(
+                row_hbm.at[pl.ds(hc - 1 + c * cr, wrows), :], vin.at[s],
+                in_sem.at[s])
+
+        def out_dma(c, s):
+            return pltpu.make_async_copy(
+                vout.at[s], out_hbm.at[pl.ds(hc + c * cr, cr), :],
+                out_sem.at[s])
+
+        def ghost_dma(g):  # stale pass-through of the halo columns
+            lo = (0, hc + segc)[g]
+            return pltpu.make_async_copy(
+                row_hbm.at[pl.ds(lo, hc), :],
+                out_hbm.at[pl.ds(lo, hc), :], ghost_sem.at[g])
+
+        @pl.when(i == 0)
+        def _():
+            in_dma(0, 0).start()
+            ghost_dma(0).start()
+            ghost_dma(1).start()
+
+        @pl.when(i + 1 < nch)
+        def _():
+            in_dma(i + 1, 1 - slot).start()
+
+        in_dma(i, slot).wait()
+
+        @pl.when(i >= 2)
+        def _():
+            out_dma(i - 2, slot).wait()
+
+        src = vin[slot]
+        P = jax.lax.dot_general(
+            src, w_ref[:], (((1,), (0,)), ((), ())),
+            precision=_PRECISION,
+            preferred_element_type=jnp.promote_types(dtype, jnp.float32))
+        out = (P[0:cr, 0:LANES] + P[1:cr + 1, LANES:2 * LANES]
+               + P[2:cr + 2, 2 * LANES:])
+        vout[slot] = out.astype(dtype)
+        out_dma(i, slot).start()
+
+        @pl.when(i == nch - 1)
+        def _():
+            out_dma(i, slot).wait()
+            ghost_dma(0).wait()
+            ghost_dma(1).wait()
+
+        if nch > 1:
+            @pl.when(i == nch - 1)
+            def _():
+                out_dma(i - 1, 1 - slot).wait()
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((nrows, LANES), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, wrows, LANES), dtype),
+            pltpu.VMEM((2, cr, LANES), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+        **({} if interpret else {"compiler_params": pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20)}),
+    )
+
+
 def matmul_stencil_row(row, seg: int, halo: int, weights: Sequence[float],
-                       ksteps: int):
+                       ksteps: int, impl: str = "xla"):
     """Apply ``ksteps`` composed stencil steps to one padded (1, W) row.
 
     ``row``: (1, halo + seg + halo); ghosts pre-exchanged with width
     >= ksteps * r.  seg and halo must be multiples of 128 (whole lane
     columns).  Returns the new row (owned stepped, ghosts stale).
+    ``impl="pallas"`` (TPU callers) takes the fused VMEM apply.
     """
     r = (len(weights) - 1) // 2
     width = row.shape[-1]
@@ -128,6 +242,11 @@ def matmul_stencil_row(row, seg: int, halo: int, weights: Sequence[float],
     hc = halo // LANES
     segc = seg // LANES
     R = row.reshape(width // LANES, LANES)
+    if impl.startswith("pallas"):
+        cr = _pick_chunk_rows(segc)
+        fn = _pallas_apply(width // LANES, hc, segc, cr, str(dtype),
+                           interpret=impl == "pallas_interpret")
+        return fn(W, R).reshape(row.shape)
     cr = _CHUNK_ROWS
     if segc <= cr:
         out = _apply(R[hc - 1: hc + segc + 1], W, segc)

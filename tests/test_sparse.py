@@ -174,3 +174,16 @@ def test_sparse_2d_random_and_repr():
     dr_tpu.gemv(c, sp, b)
     np.testing.assert_allclose(dr_tpu.to_numpy(c), sp.to_dense() @ b,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_gemv_n_matches_repeated_gemv():
+    from dr_tpu.algorithms.gemv import gemv_n
+    m = 16 * dr_tpu.nprocs()
+    d = _random_dense(m, 24, 0.5, seed=21)
+    sp = dr_tpu.sparse_matrix.from_dense(d)
+    b = np.linspace(0, 1, 24).astype(np.float32)
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 0.0)
+    gemv_n(c, sp, b, 3)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), 3 * (d @ b),
+                               rtol=1e-4, atol=1e-5)

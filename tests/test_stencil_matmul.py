@@ -68,3 +68,30 @@ def test_matmul_stencil_asymmetric_weights():
     ref = _serial_stencil(src, w, 6)
     np.testing.assert_allclose(dr_tpu.to_numpy(out), ref,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_apply_matches_xla_interpret():
+    """The fused VMEM apply (interpret mode) against the XLA P-form."""
+    import jax.numpy as jnp
+    from dr_tpu.ops import stencil_matmul as sm
+
+    rng = np.random.default_rng(5)
+    seg, halo = 512, 128
+    w = [0.05, 0.25, 0.4, 0.25, 0.05]
+    k = 16
+    row = jnp.asarray(rng.standard_normal(
+        (1, 2 * halo + seg)).astype(np.float32))
+    ref = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k))
+    got = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k,
+                                           impl="pallas_interpret"))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pick_chunk_rows():
+    from dr_tpu.ops import stencil_matmul as sm
+    assert sm._pick_chunk_rows(4096) == 4096
+    assert sm._pick_chunk_rows(4096 * 3) == 4096
+    assert sm._pick_chunk_rows(512) == 512
+    assert sm._pick_chunk_rows(384) == 128
+    assert sm._pick_chunk_rows(100) == 4
+    assert sm._pick_chunk_rows(7) == 1
