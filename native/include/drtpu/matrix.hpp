@@ -167,10 +167,11 @@ template <class T, class I = std::size_t>
 struct csr_tile {
   std::size_t rank = 0;
   std::size_t row_origin = 0;
+  std::size_t col_origin = 0;  // 2-D grids; 0 for row stripes
   index2d shape{};
   std::vector<T> values;
   std::vector<I> rowptr;  // shape.i + 1 entries
-  std::vector<I> colind;
+  std::vector<I> colind;  // tile-local when col_origin > 0
 
   std::size_t dr_rank() const { return rank; }
   std::size_t nnz() const { return values.size(); }
@@ -181,27 +182,46 @@ class sparse_matrix {
  public:
   using value_type = T;
 
-  // Build from COO triplets (row-major sorted not required).
+  // Row-striped build from COO triplets (grid {nprocs, 1}).
   sparse_matrix(index2d shape, std::size_t nprocs,
                 const std::vector<std::tuple<std::size_t, std::size_t, T>>&
                     entries)
-      : shape_(shape), nprocs_(nprocs) {
-    std::size_t stripe = (shape.i + nprocs - 1) / nprocs;
-    stripe_ = stripe ? stripe : 1;
-    tiles_.resize(nprocs);
-    for (std::size_t r = 0; r < nprocs; ++r) {
+      : sparse_matrix(shape, index2d{nprocs, 1}, entries) {}
+
+  // 2-D tile grid (sparse_matrix.hpp:344-349 partitions sparse through
+  // the same matrix_partition machinery as dense; the Python side's
+  // psum-over-mesh-columns SpMV mirrors this layout).  Tiles hold
+  // LOCAL column indices with a col_origin when the grid has columns.
+  sparse_matrix(index2d shape, index2d grid,
+                const std::vector<std::tuple<std::size_t, std::size_t, T>>&
+                    entries)
+      : shape_(shape), grid_(grid), nprocs_(grid.i * grid.j) {
+    assert(grid.i && grid.j);
+    stripe_ = std::max<std::size_t>((shape.i + grid.i - 1) / grid.i, 1);
+    cstripe_ = std::max<std::size_t>((shape.j + grid.j - 1) / grid.j, 1);
+    tiles_.resize(nprocs_);
+    for (std::size_t r = 0; r < nprocs_; ++r) {
       auto& t = tiles_[r];
       t.rank = r;
-      t.row_origin = r * stripe_;
+      t.row_origin = (r / grid.j) * stripe_;
+      t.col_origin = grid.j > 1 ? (r % grid.j) * cstripe_ : 0;
       std::size_t rows = t.row_origin < shape.i
                              ? std::min(stripe_, shape.i - t.row_origin)
                              : 0;
-      t.shape = {rows, shape.j};
+      std::size_t cols =
+          grid.j > 1 ? (t.col_origin < shape.j
+                            ? std::min(cstripe_, shape.j - t.col_origin)
+                            : 0)
+                     : shape.j;
+      t.shape = {rows, cols};
       t.rowptr.assign(rows + 1, 0);
     }
+    auto tile_of = [&](std::size_t i, std::size_t j) {
+      return (i / stripe_) * grid_.j + (grid_.j > 1 ? j / cstripe_ : 0);
+    };
     // counting sort by (tile, local row)
     for (auto& [i, j, v] : entries) {
-      auto& t = tiles_[i / stripe_];
+      auto& t = tiles_[tile_of(i, j)];
       ++t.rowptr[i - t.row_origin + 1];
     }
     for (auto& t : tiles_) {
@@ -210,31 +230,35 @@ class sparse_matrix {
       t.values.resize(t.rowptr.back());
       t.colind.resize(t.rowptr.back());
     }
-    std::vector<std::vector<I>> cursor(nprocs);
-    for (std::size_t r = 0; r < nprocs; ++r)
+    std::vector<std::vector<I>> cursor(nprocs_);
+    for (std::size_t r = 0; r < nprocs_; ++r)
       cursor[r].assign(tiles_[r].rowptr.begin(), tiles_[r].rowptr.end());
     for (auto& [i, j, v] : entries) {
-      auto& t = tiles_[i / stripe_];
-      I& c = cursor[i / stripe_][i - t.row_origin];
+      auto r = tile_of(i, j);
+      auto& t = tiles_[r];
+      I& c = cursor[r][i - t.row_origin];
       t.values[c] = v;
-      t.colind[c] = static_cast<I>(j);
+      t.colind[c] = static_cast<I>(j - t.col_origin);
       ++c;
     }
   }
 
   index2d shape() const { return shape_; }
+  index2d grid_shape() const { return grid_; }
   std::size_t nnz() const {
     std::size_t s = 0;
     for (auto& t : tiles_) s += t.nnz();
     return s;
   }
   std::size_t stripe() const { return stripe_; }
+  std::size_t col_stripe() const { return cstripe_; }
   const std::vector<csr_tile<T, I>>& tiles() const { return tiles_; }
   const csr_tile<T, I>& tile(std::size_t r) const { return tiles_[r]; }
 
  private:
   index2d shape_;
-  std::size_t nprocs_, stripe_ = 1;
+  index2d grid_{1, 1};
+  std::size_t nprocs_, stripe_ = 1, cstripe_ = 1;
   std::vector<csr_tile<T, I>> tiles_;
 };
 
@@ -252,8 +276,8 @@ void gemv(VecC&& c, const sparse_matrix<T, I>& a, const VecB& b) {
     for (std::size_t li = 0; li < t.shape.i; ++li) {
       T acc{};
       for (I k = t.rowptr[li]; k < t.rowptr[li + 1]; ++k)
-        acc += t.values[k] * b[t.colind[k]];
-      c[t.row_origin + li] += acc;
+        acc += t.values[k] * b[t.col_origin + t.colind[k]];
+      c[t.row_origin + li] += acc;  // per-tile partials accumulate
     }
   }
 }

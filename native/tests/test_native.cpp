@@ -310,6 +310,29 @@ static int test_matrix(std::size_t P) {
   for (auto& [i, j, v] : coo) sref[i] += v * b[j];
   for (std::size_t i = 0; i < 10; ++i)
     CHECK(std::abs(sc[i] - sref[i]) < 1e-9);
+
+  // 2-D sparse tile grid: tiles window both axes, SpMV accumulates
+  // per-tile partials (the reference asserts grid cols == 1 away;
+  // gemv.hpp:21)
+  {
+    index2d grid{P >= 2 ? P / 2 : std::size_t{1},
+                 P >= 2 ? std::size_t{2} : std::size_t{1}};
+    drtpu::sparse_matrix<double> S2(index2d{10, 7}, grid, coo);
+    CHECK(S2.nnz() == coo.size());
+    CHECK(S2.grid_shape().i == grid.i && S2.grid_shape().j == grid.j);
+    std::size_t nnz2 = 0;
+    for (auto& t : S2.tiles()) {
+      nnz2 += t.nnz();
+      for (std::size_t li = 0; li < t.shape.i; ++li)
+        for (auto k = t.rowptr[li]; k < t.rowptr[li + 1]; ++k)
+          CHECK(t.colind[k] < t.shape.j);  // tile-local columns
+    }
+    CHECK(nnz2 == coo.size());
+    std::vector<double> sc2(10, 0.0);
+    drtpu::gemv(sc2, S2, b);
+    for (std::size_t i = 0; i < 10; ++i)
+      CHECK(std::abs(sc2[i] - sref[i]) < 1e-9);
+  }
   return 0;
 }
 
