@@ -35,12 +35,7 @@ _MM_BLOCK = 128  # cumsum-as-matmul block width (measured TPU optimum:
 # narrower blocks cut the n*C MXU FLOPs; recursion depth stays trivial)
 
 
-@__import__("functools").lru_cache(maxsize=8)
-def _prefix_matrix(c: int):
-    # NUMPY on purpose: a jnp conversion here would run inside the
-    # caller's trace and leak a tracer through the lru_cache
-    import numpy as _np
-    return _np.triu(_np.ones((c, c), dtype=_np.float32))
+from ..ops.scan_pallas import prefix_matrix as _prefix_matrix
 
 
 def _matmul_cumsum(x, ident):
@@ -94,9 +89,27 @@ def _blocked_scan(combine, x, ident, kind=None):
     return combine(carry[:, None], rs).reshape(-1)[:n]
 
 
-def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
+def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
+    """The single-pass Pallas chunked cumsum serves the hot case: add-
+    scan over f32-accumulable INPUT data (f32/bf16/f16 — the kernel
+    accumulates in f32, so integer exactness and f64 precision must
+    take the XLA path), TPU backend, lane-chunkable segment."""
+    from ..ops import scan_pallas
+    nshards, seg, prev, nxt, n = layout
+    if jnp.dtype(in_dtype) not in (jnp.dtype(jnp.float32),
+                                   jnp.dtype(jnp.bfloat16),
+                                   jnp.dtype(jnp.float16)):
+        return False
+    return (kind == "add"
+            and scan_pallas.supported()
+            and runtime.devices[0].platform == "tpu"
+            and scan_pallas.pick_chunk(seg) is not None)
+
+
+def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
+                  use_kernel=False):
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
-           else None, exclusive, str(dtype))
+           else None, exclusive, str(dtype), use_kernel)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -111,8 +124,13 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
         gid = r * seg + jnp.arange(seg)
         if ident is not None:
             x = jnp.where(gid < n, x, ident)
-        local = _blocked_scan(combine, x,
-                              ident if kind is not None else None, kind)
+        if use_kernel:
+            from ..ops import scan_pallas
+            local = scan_pallas.chunked_cumsum(x)
+        else:
+            local = _blocked_scan(combine, x,
+                                  ident if kind is not None else None,
+                                  kind)
         totals = lax.all_gather(local[-1], axis)          # (nshards,)
         # exclusive fold of totals from ranks < r  ->  my carry
         if ident is not None:
@@ -136,8 +154,11 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype):
         out = jnp.zeros((1, prev + seg + nxt), dtype)
         return out.at[0, prev:prev + seg].set(scanned.astype(dtype))
 
+    # check_vma=False only for the kernel path: pallas outputs carry no
+    # varying-mesh-axis metadata
     shmapped = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
-                             out_specs=P(axis, None))
+                             out_specs=P(axis, None),
+                             check_vma=not use_kernel)
     prog = jax.jit(shmapped)
     _prog_cache[key] = prog
     return prog
@@ -164,8 +185,12 @@ def _scan(in_r, out, op, init, exclusive):
     if full:
         c = ins[0]
         mesh = c.cont.runtime.mesh
-        prog = _scan_program(mesh, c.cont.runtime.axis, c.cont.layout,
-                             kind, op, exclusive, out_chain.cont.dtype)
+        dt = out_chain.cont.dtype
+        prog = _scan_program(
+            mesh, c.cont.runtime.axis, c.cont.layout, kind, op,
+            exclusive, dt,
+            use_kernel=_use_scan_kernel(c.cont.layout, kind,
+                                        c.cont.dtype, c.cont.runtime))
         out_chain.cont._data = prog(c.cont._data)
         scanned = None
     else:
@@ -224,8 +249,11 @@ def inclusive_scan_n(in_v, out, iters: int):
            int(iters))
     prog = _prog_cache.get(key)
     if prog is None:
-        one = _scan_program(mesh, c.cont.runtime.axis, c.cont.layout,
-                            "add", None, False, dtype)
+        one = _scan_program(
+            mesh, c.cont.runtime.axis, c.cont.layout, "add", None,
+            False, dtype,
+            use_kernel=_use_scan_kernel(c.cont.layout, "add",
+                                        c.cont.dtype, c.cont.runtime))
 
         def many(d):
             return lax.fori_loop(0, iters, lambda _, x: one(x), d)

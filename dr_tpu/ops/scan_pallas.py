@@ -1,0 +1,174 @@
+"""Pallas TPU kernel: single-HBM-pass chunked prefix sum.
+
+The XLA matmul-cumsum (algorithms/scan.py `_matmul_cumsum`) needs two
+full passes over the data: one producing the per-row prefixes and one
+re-reading them for the carry fixup — ~16 B/element of HBM traffic
+where the operation's floor is 8 B (read + write once).  This kernel
+fuses everything into one pass: chunks stream through VMEM
+(double-buffered DMA), each chunk's local prefix runs on the MXU
+(multiply by an upper-triangular ones matrix), and the running carry
+lives in a VMEM scratch that persists across the SEQUENTIAL TPU grid —
+so the carry "fixup" is a free broadcast-add while the chunk is still
+resident.
+
+Layout: x viewed as (rows, 128) lane-blocked; flat order is row-major,
+so the prefix decomposes as
+  within-row lane prefix      (rows @ U128, MXU)
+  + exclusive row offset      (row totals scanned the same way, twice
+                               more at 1/128 and 1/16384 the size)
+  + chunk carry               (scalar scratch).
+
+Reference workload: ``shp/algorithms/inclusive_scan.hpp:25-148``
+(BASELINE.json config 3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from .stencil_pallas import _HAS_PLTPU, pltpu
+
+__all__ = ["chunked_cumsum", "pick_chunk", "prefix_matrix",
+           "supported"]
+
+LANES = 128
+_MAX_ROWS = 2048  # chunk rows: (R, 128) f32 = 1 MiB per buffer
+
+
+def supported() -> bool:
+    return _HAS_PLTPU
+
+
+def pick_chunk(n: int):
+    """Chunk rows R (power of two, R*128 divides n, R % 128 == 0 so the
+    row-total re-block stays tile-aligned) or None -> caller falls back
+    to the XLA path."""
+    if n % LANES:
+        return None
+    rows = n // LANES
+    R = _MAX_ROWS
+    while R >= LANES:
+        if rows % R == 0:
+            return R
+        R //= 2
+    return None
+
+
+@functools.lru_cache(maxsize=8)
+def prefix_matrix(k: int):
+    """Upper-triangular ones: (rows @ prefix_matrix)[i, j] =
+    sum_{b<=j} rows[i, b].  Shared by this kernel and the XLA
+    matmul-cumsum (algorithms/scan.py).  NUMPY on purpose (see
+    stencil_matmul._operator): jnp here would leak a tracer through
+    the cache."""
+    return np.triu(np.ones((k, k), dtype=np.float32))
+
+
+@functools.lru_cache(maxsize=16)
+def _build(rows: int, R: int, dtype_name: str, interpret: bool):
+    dtype = jnp.dtype(dtype_name)
+    nch = rows // R
+    S = R // LANES  # sub-rows of the row-total re-block (S <= 128)
+
+    def kernel(u_ref, x_hbm, out_hbm, vin, vout, carry, in_sem, out_sem):
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+
+        def in_dma(c, s):
+            return pltpu.make_async_copy(
+                x_hbm.at[pl.ds(c * R, R), :], vin.at[s], in_sem.at[s])
+
+        def out_dma(c, s):
+            return pltpu.make_async_copy(
+                vout.at[s], out_hbm.at[pl.ds(c * R, R), :], out_sem.at[s])
+
+        @pl.when(i == 0)
+        def _():
+            carry[0, 0] = jnp.zeros((), jnp.float32)
+            in_dma(0, 0).start()
+
+        @pl.when(i + 1 < nch)
+        def _():
+            in_dma(i + 1, 1 - slot).start()
+
+        in_dma(i, slot).wait()
+
+        @pl.when(i >= 2)
+        def _():
+            out_dma(i - 2, slot).wait()
+
+        U = u_ref[:]
+        x = vin[slot].astype(jnp.float32)
+        # lane prefix within each 128-wide row (MXU)
+        P1 = lax.dot_general(x, U, (((1,), (0,)), ((), ())),
+                             precision=lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+        row_tot = P1[:, LANES - 1:LANES]              # (R, 1)
+        t = row_tot.reshape(S, LANES)                 # sub-row blocks
+        ts = lax.dot_general(t, U, (((1,), (0,)), ((), ())),
+                             precision=lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+        sub_tot = ts[:, LANES - 1:LANES]              # (S, 1)
+        st = lax.dot_general(
+            sub_tot.reshape(1, S), U[:S, :S], (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)       # (1, S) inclusive
+        excl_sub = (st - sub_tot.reshape(1, S)).reshape(S, 1)
+        # exclusive offset of each row = inclusive-across-rows - own
+        excl_rows = (ts - t + excl_sub).reshape(R, 1)
+        out = P1 + excl_rows + carry[0, 0]
+        carry[0, 0] = carry[0, 0] + st[0, S - 1]
+        vout[slot] = out.astype(dtype)
+        out_dma(i, slot).start()
+
+        @pl.when(i == nch - 1)
+        def _():
+            out_dma(i, slot).wait()
+
+        if nch > 1:
+            @pl.when(i == nch - 1)
+            def _():
+                out_dma(i - 1, 1 - slot).wait()
+
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20)
+    return pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, R, LANES), dtype),
+            pltpu.VMEM((2, R, LANES), dtype),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+        **params,
+    )
+
+
+def chunked_cumsum(x, *, interpret: bool = False):
+    """Inclusive add-scan of a 1-D float array in ONE HBM pass.
+
+    Requires ``pick_chunk(len(x))`` to succeed (lane-blocked chunking);
+    callers fall back to the XLA matmul-cumsum otherwise."""
+    n = x.shape[0]
+    R = pick_chunk(n)
+    assert R is not None, "no lane-aligned chunking for this length"
+    rows = n // LANES
+    fn = _build(rows, R, str(x.dtype), interpret)
+    U = jnp.asarray(prefix_matrix(LANES), jnp.float32)
+    return fn(U, x.reshape(rows, LANES)).reshape(n)

@@ -114,3 +114,24 @@ def test_blocked_scan_helper_matches_flat():
                         jnp.array(-np.inf, jnp.float32))
     np.testing.assert_allclose(np.asarray(got),
                                np.maximum.accumulate(np.asarray(x)))
+
+
+def test_chunked_cumsum_kernel_interpret():
+    """Single-pass Pallas scan kernel (interpret mode) vs numpy."""
+    from dr_tpu.ops import scan_pallas
+    rng = np.random.default_rng(6)
+    for n in (128 * 128, 128 * 128 * 4 + 0):
+        R = scan_pallas.pick_chunk(n)
+        assert R is not None
+        x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        got = np.asarray(scan_pallas.chunked_cumsum(x, interpret=True))
+        ref = np.cumsum(np.asarray(x, np.float64))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-3)
+
+
+def test_scan_kernel_chunk_gates():
+    from dr_tpu.ops import scan_pallas
+    assert scan_pallas.pick_chunk(2 ** 27) == 2048
+    assert scan_pallas.pick_chunk(128 * 128) == 128
+    assert scan_pallas.pick_chunk(130) is None      # not lane-aligned
+    assert scan_pallas.pick_chunk(128 * 100) is None  # rows % 2^k != 0
