@@ -76,5 +76,41 @@ dr_tpu.fill(c, 0.0)
 dr_tpu.gemv(c, A, bv)
 np.testing.assert_allclose(dr_tpu.to_numpy(c), np.full(m, 3.0), rtol=1e-6)
 
+# fused measurement family must be SPMD-safe (every process runs the
+# same chained program; psum keeps results identical everywhere)
+from dr_tpu.algorithms.reduce import dot_n  # noqa: E402
+dn = float(dot_n(dv, other, 3))
+assert abs(dn - d) < 1e-3 * abs(d), (dn, d)
+
+from dr_tpu.algorithms.scan import inclusive_scan_n  # noqa: E402
+sn = dr_tpu.distributed_vector(n, dtype=np.float32)
+inclusive_scan_n(dv, sn, 1)
+np.testing.assert_allclose(dr_tpu.to_numpy(sn),
+                           np.cumsum(np.arange(1, n + 1)), rtol=1e-5)
+
+# ring attention over the two-process mesh (XLA path on CPU)
+rng = np.random.default_rng(3)
+S, h, hd = 4 * nproc, 2, 8
+q, k2, v2 = (rng.standard_normal((1, S, h, hd)).astype(np.float32)
+             for _ in range(3))
+att1 = dr_tpu.ring_attention(q, k2, v2, causal=True)
+attn = dr_tpu.ring_attention_n(q, k2, v2, 1, causal=True)
+# global arrays span both processes: compare the LOCAL shards
+np.testing.assert_allclose(
+    np.asarray(attn.addressable_shards[0].data),
+    np.asarray(att1.addressable_shards[0].data), rtol=1e-5, atol=1e-6)
+
+# 2-D-partitioned sparse gemv over a (nproc, 1)->factor grid
+gp, gq = dr_tpu.factor(nproc)
+if gq > 1:
+    d2 = np.zeros((2 * nproc, 2 * nproc), dtype=np.float32)
+    d2[0, -1] = 5.0
+    sp2 = dr_tpu.sparse_matrix.from_dense(
+        d2, partition=dr_tpu.block_cyclic(grid=(gp, gq)))
+    c2 = dr_tpu.distributed_vector(2 * nproc, dtype=np.float32)
+    dr_tpu.fill(c2, 0.0)
+    dr_tpu.gemv(c2, sp2, np.ones(2 * nproc, dtype=np.float32))
+    np.testing.assert_allclose(dr_tpu.to_numpy(c2), d2.sum(axis=1))
+
 print(f"MULTIHOST-OK pid={pid} reduce={total} scan_last={got[-1]}",
       flush=True)
