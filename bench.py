@@ -354,7 +354,7 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
     finally:
         q = kk = vv = None
 
-    # config 5: CSR SpMV (gemv_example.cpp:18-41)
+    # config 5: CSR SpMV (gemv_example.cpp:18-41), fused-loop (gemv_n)
     try:
         m = 2 ** 14 if on_cpu else 2 ** 17
         k = 32  # nnz per row
@@ -367,9 +367,12 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         bv = dr_tpu.distributed_vector(m, np.float32)
         dr_tpu.fill(bv, 1.0)
         dr_tpu.fill(c, 0.0)
-        dr_tpu.gemv(c, A, bv)  # warm
-        dt = _time_amortized(lambda: dr_tpu.gemv(c, A, bv),
-                             lambda _: _sync(c), calls=64)
+        from dr_tpu.algorithms.gemv import gemv_n
+
+        def run_spmv(r):
+            gemv_n(c, A, bv, r)
+            _sync(c)
+        dt = _marginal(run_spmv, r1=2, r2=18)
         out["spmv_gflops"] = round(2.0 * m * k / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["spmv_error"] = repr(e)[:160]

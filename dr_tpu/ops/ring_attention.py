@@ -61,10 +61,13 @@ def _flash_viable(shape, dtype, rt) -> bool:
     return rt.devices[0].platform == "tpu"
 
 
-def _build_flash(mesh, axis, nshards, shape, causal, dtype):
+def _build_flash(mesh, axis, nshards, shape, causal, dtype,
+                 interpret=False):
     """Ring schedule with the fused Pallas block kernel as the per-step
     compute: K/V blocks rotate via ppermute, the (m, l, acc) online-
-    softmax state is the carry, normalization happens once at the end."""
+    softmax state is the carry, normalization happens once at the end.
+    ``interpret`` runs the kernel interpreted (CPU-mesh validation of
+    the multi-shard ring carries)."""
     B, s, h, d = shape
     BH = B * h
     bq, bk = _fa.pick_blocks(s, s, d)
@@ -85,7 +88,7 @@ def _build_flash(mesh, axis, nshards, shape, causal, dtype):
             src = (my - t) % nshards
             m, l, acc = _fa.flash_update(
                 qh, kh, vh, m, l, acc, q_off, src * s,
-                causal=causal, bq=bq, bk=bk)
+                causal=causal, bq=bq, bk=bk, interpret=interpret)
             if t + 1 < nshards:
                 kh = lax.ppermute(kh, axis, ring)
                 vh = lax.ppermute(vh, axis, ring)
