@@ -36,6 +36,10 @@ import time
 import numpy as np
 
 
+# the user's apply-implementation override, captured before any
+# fallback step mutates the variable
+_USER_MM_IMPL = os.environ.get("DR_TPU_MM_IMPL")
+
 # per-chip peak HBM bandwidth, GB/s (public spec sheets)
 _PEAK_HBM = {
     "v2": 700.0, "v3": 900.0, "v4": 1228.0,
@@ -73,7 +77,17 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     from dr_tpu.ops import stencil_pallas
 
     pallas = impl == "pallas"
-    matmul = impl == "matmul"
+    matmul = impl in ("matmul", "matmul_xla")
+    # matmul_xla: the composed-operator path with the XLA P-form apply —
+    # the fallback when the fused Pallas apply fails on this backend.
+    # Other impls restore whatever the USER set (bench must not eat a
+    # DR_TPU_MM_IMPL override).
+    if impl == "matmul_xla":
+        os.environ["DR_TPU_MM_IMPL"] = "xla"
+    elif _USER_MM_IMPL is None:
+        os.environ.pop("DR_TPU_MM_IMPL", None)
+    else:
+        os.environ["DR_TPU_MM_IMPL"] = _USER_MM_IMPL
     blocked = pallas or matmul
     w = [0.05, 0.25, 0.4, 0.25, 0.05]
     radius = 2
@@ -397,8 +411,8 @@ def main():
     if "DR_TPU_BENCH_IMPL" in os.environ:
         chain = [os.environ["DR_TPU_BENCH_IMPL"].strip().lower()]
     elif on_tpu:
-        chain = ["matmul"] + (["pallas"] if stencil_pallas.supported()
-                              else []) + ["xla"]
+        chain = ["matmul", "matmul_xla"] + \
+            (["pallas"] if stencil_pallas.supported() else []) + ["xla"]
     else:
         chain = ["xla"]
     tblock = int(os.environ.get("DR_TPU_BENCH_TBLOCK", "64"))
@@ -408,7 +422,7 @@ def main():
     dr_tpu.init(jax.devices())
     res = None
     for i, impl in enumerate(chain):
-        blocked = impl in ("pallas", "matmul")
+        blocked = impl in ("pallas", "matmul", "matmul_xla")
         steps = int(os.environ.get("DR_TPU_BENCH_STEPS",
                                    "512" if blocked else "16"))
         try:

@@ -203,3 +203,49 @@ def test_cyclic_stencil2d_matches_block():
     dr_tpu.stencil2d_transform(Ab2, Bb2, w)
     np.testing.assert_allclose(Bc2.materialize(), Bb2.materialize(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_cyclic_mesh_sweep(mesh_size):
+    """Cyclic placement across the rank sweep (VERDICT r1 item 5:
+    mesh {1,2,3,4,8}): round-robin tile_rank parity, roundtrip, gemm,
+    and the 2-D stencil on a cyclic layout."""
+    rng = np.random.default_rng(30 + mesh_size)
+    gp, gq = dr_tpu.factor(mesh_size)
+    part = dr_tpu.block_cyclic(tile=(4, 4), grid=(gp, gq))
+    src = rng.standard_normal((16, 16)).astype(np.float32)
+    A = dr_tpu.dense_matrix.from_array(src, part)
+    np.testing.assert_array_equal(A.materialize(), src)
+    for t in A.tiles():
+        i, j = t.rb // 4, t.cb // 4
+        assert dr_tpu.rank(t) == (i % gp) * gq + (j % gq)
+    B = dr_tpu.dense_matrix.from_array(src, part)
+    C = dr_tpu.gemm(A, B)
+    np.testing.assert_allclose(C.materialize(), src @ src, rtol=1e-4,
+                               atol=1e-4)
+    A2 = dr_tpu.dense_matrix.from_array(src, part)
+    B2 = dr_tpu.dense_matrix.from_array(src, part)
+    out = dr_tpu.stencil2d_iterate(A2, B2,
+                                   dr_tpu.heat_step_weights(0.25),
+                                   steps=2)
+    Ab = dr_tpu.dense_matrix.from_array(src)
+    Bb = dr_tpu.dense_matrix.from_array(src)
+    ref = dr_tpu.stencil2d_iterate(Ab, Bb,
+                                   dr_tpu.heat_step_weights(0.25),
+                                   steps=2)
+    np.testing.assert_allclose(out.materialize(), ref.materialize(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_2d_mesh_sweep(mesh_size):
+    rng = np.random.default_rng(40 + mesh_size)
+    gp, gq = dr_tpu.factor(mesh_size)
+    d = np.where(rng.random((20, 18)) < 0.4,
+                 rng.standard_normal((20, 18)), 0).astype(np.float32)
+    sp = dr_tpu.sparse_matrix.from_dense(
+        d, partition=dr_tpu.block_cyclic(grid=(gp, gq)))
+    b = np.linspace(-1, 1, 18).astype(np.float32)
+    c = dr_tpu.distributed_vector(20)
+    dr_tpu.fill(c, 0.0)
+    dr_tpu.gemv(c, sp, b)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), d @ b, rtol=1e-4,
+                               atol=1e-5)
