@@ -126,6 +126,10 @@ class sparse_matrix:
         self._ell_vals = None
         self._ell_cols = None
         self._ell_width = 0
+        self._bcsr_vals = None
+        self._bcsr_cols = None
+        self._bcsr_kb = 0
+        self._bcsr_state = "maybe"
         self._tile_nnz = np.zeros(P, dtype=np.int64)
         self._nnz = 0
 
@@ -223,6 +227,99 @@ class sparse_matrix:
                            PartitionSpec(self._rt.axis, None, None))
         self._ell_vals = jax.device_put(jnp.asarray(ell_vals), sh)
         self._ell_cols = jax.device_put(jnp.asarray(ell_cols), sh)
+        return True
+
+    # BCSR blocks: MXU-friendly dense tiles (sublanes x lanes)
+    _BCSR_BH = 8
+    _BCSR_BW = 128
+    # build the dense-block layout only when the blocks it creates hold
+    # enough nnz that the 1024-element tiles pay for themselves
+    _BCSR_MIN_FILL = 1.0 / 16.0
+    # allocation skew bound: block-ELL tiles allocated <= factor x occupied
+    _BCSR_FACTOR = 2
+
+    def ensure_bcsr(self) -> bool:
+        """Build the block-ELL (BCSR) device layout lazily: nnz grouped
+        into dense (8, 128) tiles, tiles grouped by block-row with a
+        fixed width — SpMV becomes ONE 128-slice gather of b per tile
+        plus an MXU contraction (VERDICT r1 item 6; the reference's
+        gemv.hpp:45-66 nnz-parallel kernel re-imagined for the MXU).
+
+        Only viable when the sparsity is block-structured: returns False
+        (and remembers) when the average tile fill is below
+        ``_BCSR_MIN_FILL`` — unstructured patterns keep the ELL /
+        segment-sum paths."""
+        if self._bcsr_vals is not None:
+            return True
+        if self._bcsr_state == "no" or self._vals is None:
+            return False
+        if self.grid_shape[1] != 1 or not self._vals.is_fully_addressable:
+            return False
+        bh, bw = self._BCSR_BH, self._BCSR_BW
+        th = self._th
+        if th % bh:
+            return False
+        P = self._nshards
+        counts = self._tile_nnz
+        rows_h = np.asarray(self._rows)
+        cols_h = np.asarray(self._cols)
+        nbr = th // bh                      # block-rows per shard tile
+        # pass 1: per-shard block-row tile lists (block col ids); the
+        # values stay on device until the gates below admit the layout
+        per = []                            # (shard) -> {(br, cb)} maps
+        kb = 1
+        total_tiles = 0
+        for t in range(P):
+            c = int(counts[t])
+            br = rows_h[t, :c] // bh
+            cb = cols_h[t, :c] // bw
+            keys = np.unique(br.astype(np.int64) * (1 << 32)
+                             | cb.astype(np.int64))
+            per.append(keys)
+            total_tiles += len(keys)
+            if c:
+                kb = max(kb, int(np.bincount(
+                    (keys >> 32).astype(np.int64), minlength=nbr).max()))
+        fill = self._nnz / max(total_tiles * bh * bw, 1)
+        # skew gate: the block-ELL width kb applies to EVERY block-row,
+        # so one dense block-row must not balloon the allocation — bound
+        # kb by the average occupancy (the _ELL_FACTOR analog).  Mostly
+        # empty matrices are already rejected by the fill gate.
+        avg_kb = -(-total_tiles // max(P * nbr, 1))
+        if (fill < self._BCSR_MIN_FILL
+                or kb > self._BCSR_FACTOR * max(avg_kb, 1)):
+            self._bcsr_state = "no"
+            return False
+        vals_h = np.asarray(self._vals)
+        # pass 2: dense tiles in block-ELL form
+        bvals = np.zeros((P, nbr, kb, bh, bw), dtype=self._dtype)
+        bcols = np.zeros((P, nbr, kb), dtype=np.int32)
+        for t in range(P):
+            c = int(counts[t])
+            if not c:
+                continue
+            keys = per[t]
+            br = (keys >> 32).astype(np.int64)
+            cb = (keys & 0xFFFFFFFF).astype(np.int64)
+            # slot within each block-row: keys are sorted (br, cb), so
+            # slot = index - first index of the same block-row
+            slot = np.arange(len(keys)) - np.searchsorted(br, br, "left")
+            bcols[t, br, slot] = cb
+            r = rows_h[t, :c]
+            cc = cols_h[t, :c]
+            key_e = ((r // bh).astype(np.int64) * (1 << 32)
+                     | (cc // bw).astype(np.int64))
+            pos = np.searchsorted(keys, key_e)
+            np.add.at(bvals, (t, br[pos], slot[pos], r % bh, cc % bw),
+                      vals_h[t, :c])
+        sh = NamedSharding(self._rt.mesh,
+                           PartitionSpec(self._rt.axis, *([None] * 4)))
+        shc = NamedSharding(self._rt.mesh,
+                            PartitionSpec(self._rt.axis, None, None))
+        self._bcsr_vals = jax.device_put(jnp.asarray(bvals), sh)
+        self._bcsr_cols = jax.device_put(jnp.asarray(bcols), shc)
+        self._bcsr_kb = kb
+        self._bcsr_state = "yes"
         return True
 
     @classmethod
