@@ -19,6 +19,8 @@ it ships.)
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -59,7 +61,9 @@ def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
     return prog
 
 
-_GATHER_W = 16     # b-slice width per gather (measured TPU sweet spot)
+# b-slice width per gather (measured TPU sweet spot; env-overridable
+# for on-device tuning sweeps)
+_GATHER_W = int(os.environ.get("DR_TPU_GATHER_W", "16"))
 _ELL_CHUNK = 2 ** 13  # tile rows per lax.map chunk (bounds intermediates)
 
 
