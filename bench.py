@@ -209,6 +209,25 @@ def _marginal(run_sync, r1=4, r2=36, samples=5):
     return (float(np.median(t2s)) - float(np.median(t1s))) / (r2 - r1)
 
 
+def _marginal_with_fallback(run_sync, kernel_possible, env_var, err_key,
+                            out, **kw):
+    """_marginal, but when a TPU Pallas kernel path may have been taken
+    and fails, record the error and retry once with ``env_var=xla``
+    forcing the XLA path.  Off-TPU the kernel was never selected, so
+    failures propagate undisguised (no pointless identical retry)."""
+    try:
+        return _marginal(run_sync, **kw)
+    except Exception as e:
+        if not kernel_possible:
+            raise
+        out[err_key] = repr(e)[:120]
+        os.environ[env_var] = "xla"
+        try:
+            return _marginal(run_sync, **kw)
+        finally:
+            os.environ.pop(env_var, None)
+
+
 def _time_amortized(dispatch, sync, calls=16, batches=3):
     """Median per-call time of ``calls`` async dispatches + ONE sync.
 
@@ -267,7 +286,8 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         def run_scan(r):
             inclusive_scan_n(a, s, r)
             _sync(s)
-        dt = _marginal(run_scan)
+        dt = _marginal_with_fallback(run_scan, on_tpu, "DR_TPU_SCAN_IMPL",
+                                     "scan_kernel_error", out)
         out["scan_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
     except Exception as e:  # pragma: no cover - defensive
         out["scan_error"] = repr(e)[:160]
@@ -360,7 +380,10 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         def run_attn(r):
             res = dr_tpu.ring_attention_n(q, kk, vv, r, causal=True)
             float(res[0, 0, 0, 0].astype(jnp.float32))
-        dt = _marginal(run_attn, r1=2, r2=18, samples=5)
+        dt = _marginal_with_fallback(run_attn, on_tpu,
+                                     "DR_TPU_RING_IMPL",
+                                     "ring_attn_kernel_error", out,
+                                     r1=2, r2=18, samples=5)
         flops = 2.0 * B * h * S * S * hd  # causal: half of 4*B*h*S^2*d
         out["ring_attn_tflops"] = round(flops / dt / 1e12, 3)
     except Exception as e:  # pragma: no cover - defensive

@@ -61,9 +61,12 @@ def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
     return prog
 
 
-# b-slice width per gather (measured TPU sweet spot; env-overridable
-# for on-device tuning sweeps)
-_GATHER_W = int(os.environ.get("DR_TPU_GATHER_W", "16"))
+def _gather_w() -> int:
+    """b-slice width per gather (measured TPU sweet spot).  Read per
+    call so DR_TPU_GATHER_W sweeps work in-process — but note the ELL
+    program caches do NOT key on it; clear caches (fresh process) or
+    vary the layout between sweep points."""
+    return int(os.environ.get("DR_TPU_GATHER_W", "16"))
 _ELL_CHUNK = 2 ** 13  # tile rows per lax.map chunk (bounds intermediates)
 
 
@@ -75,7 +78,7 @@ def _ell_local(vals0, cols0, b, th, kmax):
     with a one-hot compare amortizes the per-gather cost ~2.5x, and the
     fixed (th, kmax) ELL shape makes the multiply + row-sum dense VPU
     work.  b is padded to a multiple of W so every slice is in range."""
-    W = _GATHER_W
+    W = _gather_w()
     pad = (-b.shape[0]) % W
     bp = jnp.concatenate([b, jnp.zeros((pad,), b.dtype)]) if pad else b
     B2 = bp.reshape(-1, W)

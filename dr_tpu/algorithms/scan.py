@@ -15,6 +15,7 @@ segment totals over the mesh axis, an exclusive fold of preceding totals
 from __future__ import annotations
 
 import operator
+import os
 from typing import Callable
 
 import jax
@@ -93,7 +94,10 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
     """The single-pass Pallas chunked cumsum serves the hot case: add-
     scan over f32-accumulable INPUT data (f32/bf16/f16 — the kernel
     accumulates in f32, so integer exactness and f64 precision must
-    take the XLA path), TPU backend, lane-chunkable segment."""
+    take the XLA path), TPU backend, lane-chunkable segment.
+    ``DR_TPU_SCAN_IMPL=xla`` forces the XLA matmul-cumsum."""
+    if os.environ.get("DR_TPU_SCAN_IMPL", "").strip().lower() == "xla":
+        return False
     from ..ops import scan_pallas
     nshards, seg, prev, nxt, n = layout
     if jnp.dtype(in_dtype) not in (jnp.dtype(jnp.float32),
@@ -245,15 +249,15 @@ def inclusive_scan_n(in_v, out, iters: int):
     c = ins[0]
     mesh = c.cont.runtime.mesh
     dtype = out_chain.cont.dtype
+    use_kernel = _use_scan_kernel(c.cont.layout, "add", c.cont.dtype,
+                                  c.cont.runtime)
     key = ("scan_n", pinned_id(mesh), c.cont.layout, str(dtype),
-           int(iters))
+           int(iters), use_kernel)
     prog = _prog_cache.get(key)
     if prog is None:
         one = _scan_program(
             mesh, c.cont.runtime.axis, c.cont.layout, "add", None,
-            False, dtype,
-            use_kernel=_use_scan_kernel(c.cont.layout, "add",
-                                        c.cont.dtype, c.cont.runtime))
+            False, dtype, use_kernel=use_kernel)
 
         def many(d):
             return lax.fori_loop(0, iters, lambda _, x: one(x), d)
