@@ -45,6 +45,7 @@ from .containers.mdarray import (distributed_mdarray, distributed_mdspan,
 from .utils.logging import drlog
 from .utils.debug import print_range, print_matrix, range_details
 from .utils import checkpoint
+from .utils import profiling
 from .ops.ring_attention import ring_attention, ring_attention_n
 from .views import views
 from .views.views import aligned, local_segments
@@ -85,5 +86,5 @@ __all__ = [
     "init_distributed", "distributed_span",
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
-    "checkpoint", "ring_attention", "ring_attention_n",
+    "checkpoint", "profiling", "ring_attention", "ring_attention_n",
 ]

@@ -1,0 +1,65 @@
+"""Profiling and timing helpers.
+
+The reference has NO tracing/profiling subsystem (SURVEY.md §5:
+"Tracing/profiling: none"); on TPU the right tool is the JAX/XLA
+profiler, so this module is a thin, dependency-light veneer over it
+plus a device-honest timer for the tunneled single-chip environment
+(see docs/PERF.md "measurement lesson"):
+
+- ``trace(logdir)``: context manager around ``jax.profiler.trace`` —
+  XLA op-level traces viewable in TensorBoard/Perfetto; annotations via
+  ``annotate``.
+- ``annotate(name)``: ``jax.profiler.TraceAnnotation`` passthrough.
+- ``device_timer(run_sync, r1, r2, samples)``: the marginal method as
+  a library utility — per-op device seconds for a fused ``*_n``-style
+  callable, with the per-dispatch constant cancelled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+__all__ = ["trace", "annotate", "device_timer"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a JAX profiler trace of the enclosed block into
+    ``logdir`` (inspect with TensorBoard's profile plugin)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a :func:`trace` capture."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_timer(run_sync, r1: int = 4, r2: int = 36,
+                 samples: int = 5) -> float:
+    """Per-op device seconds for a fused-loop callable by the marginal
+    method: ``run_sync(r)`` must execute ``r`` chained ops in ONE
+    dispatched program and hard-sync (read a device scalar).  The
+    host-dispatch constant — large and drifting on tunneled backends —
+    cancels in the r2-r1 difference.  See the ``*_n`` family
+    (``dot_n``, ``inclusive_scan_n``, ``ring_attention_n``, ``gemv_n``,
+    ``span_halo.exchange_n``) for ready-made fused loops."""
+    for r in (r1, r2):
+        run_sync(r)  # compile + warm
+    t1s, t2s = [], []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        run_sync(r1)
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_sync(r2)
+        t2s.append(time.perf_counter() - t0)
+    return (float(np.median(t2s)) - float(np.median(t1s))) / (r2 - r1)

@@ -187,3 +187,20 @@ def test_inclusive_scan_n_runs_chained():
     inclusive_scan_n(a, s, 2)  # chained round compiles and runs
     got = dr_tpu.to_numpy(s)
     np.testing.assert_allclose(got, np.cumsum(np.arange(1, n + 1)))
+
+
+def test_profiling_device_timer_and_annotate():
+    """utils.profiling: the marginal timer measures a fused loop and
+    annotate/trace wrap without error (CPU backend)."""
+    from dr_tpu.algorithms.reduce import dot_n
+    from dr_tpu.utils import profiling
+    n = 64 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(n)
+    b = dr_tpu.distributed_vector(n)
+    dr_tpu.fill(a, 1.0)
+    dr_tpu.fill(b, 2.0)
+    dt = profiling.device_timer(lambda r: float(dot_n(a, b, r)),
+                                r1=1, r2=5, samples=2)
+    assert np.isfinite(dt)
+    with profiling.annotate("dot"):
+        float(dot_n(a, b, 1))
