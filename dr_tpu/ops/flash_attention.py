@@ -72,14 +72,15 @@ def pick_blocks(s: int, skv: int, d: int):
 
 @functools.lru_cache(maxsize=32)
 def _build(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
-           causal: bool, interpret: bool):
+           causal: bool, interpret: bool, group: int = 1):
     """pallas_call: one flash update of (m, l, acc) against a K/V block.
 
     Inputs: info=[q_off, k_off] (scalar prefetch), q (BH, s, d) bf16,
-    k/v (BH, skv, d) bf16, carries m/l (BH, s, 1) f32 (the trailing
-    length-1 lane dim satisfies Mosaic block tiling AND is the compute
-    layout of row stats), acc (BH, s, d) f32.  Outputs: updated m, l,
-    acc.
+    k/v (BH // group, skv, d) bf16, carries m/l (BH, s, 1) f32 (the
+    trailing length-1 lane dim satisfies Mosaic block tiling AND is the
+    compute layout of row stats), acc (BH, s, d) f32.  Outputs: updated
+    m, l, acc.  ``group`` > 1 is grouped-query attention: q head b
+    reads K/V head b // group — just an index map, no replication.
     """
     nk = skv // bk
     scale = 1.0 / (d ** 0.5)
@@ -139,8 +140,10 @@ def _build(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
         grid=(BH, s // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, info: (b, i, 0)),
-            pl.BlockSpec((1, skv, d), lambda b, i, info: (b, 0, 0)),
-            pl.BlockSpec((1, skv, d), lambda b, i, info: (b, 0, 0)),
+            pl.BlockSpec((1, skv, d),
+                         lambda b, i, info: (b // group, 0, 0)),
+            pl.BlockSpec((1, skv, d),
+                         lambda b, i, info: (b // group, 0, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, info: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, info: (b, i, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, info: (b, i, 0)),
@@ -171,7 +174,8 @@ def _build(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
         ],
         cost_estimate=pl.CostEstimate(
             flops=flops_per_cell * BH * (s // bq),
-            bytes_accessed=(BH * s * d * 2 * 2 + BH * skv * d * 2 * 2
+            bytes_accessed=(BH * s * d * 2 * 2
+                            + (BH // group) * skv * d * 2 * 2
                             + BH * s * d * 4 * 2),
             transcendentals=BH * s * skv),
         interpret=interpret,
@@ -180,13 +184,18 @@ def _build(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
 
 def flash_update(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
                  bq: int, bk: int, interpret: bool = False):
-    """One ring step's flash update.  q (BH, s, d) and k/v (BH, skv, d)
-    are bf16 (callers cast); m/l (BH, s, 1) and acc (BH, s, d) are the f32
-    running state; q_off/k_off are the GLOBAL sequence offsets of the q
-    shard and the held K/V block (traced scalars under shard_map)."""
+    """One ring step's flash update.  q (BH, s, d) and k/v
+    (BHkv, skv, d) with BH % BHkv == 0 (grouped-query: q head b reads
+    K/V head b // group) are bf16 (callers cast); m/l (BH, s, 1) and
+    acc (BH, s, d) are the f32 running state; q_off/k_off are the
+    GLOBAL sequence offsets of the q shard and the held K/V block
+    (traced scalars under shard_map)."""
     BH, s, d = q.shape
     skv = k.shape[1]
-    fn = _build(BH, s, skv, d, bq, bk, causal, interpret)
+    assert v.shape == k.shape, "k and v must share (heads, skv, d)"
+    assert BH % k.shape[0] == 0, "q heads must be a multiple of kv heads"
+    group = BH // k.shape[0]
+    fn = _build(BH, s, skv, d, bq, bk, causal, interpret, group)
     info = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     return fn(info, q, k, v, m, l, acc)

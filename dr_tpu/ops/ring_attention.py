@@ -62,13 +62,16 @@ def _flash_viable(shape, dtype, rt) -> bool:
 
 
 def _build_flash(mesh, axis, nshards, shape, causal, dtype,
-                 interpret=False):
+                 interpret=False, hkv=None):
     """Ring schedule with the fused Pallas block kernel as the per-step
     compute: K/V blocks rotate via ppermute, the (m, l, acc) online-
     softmax state is the carry, normalization happens once at the end.
     ``interpret`` runs the kernel interpreted (CPU-mesh validation of
-    the multi-shard ring carries)."""
+    the multi-shard ring carries).  ``hkv`` < h is grouped-query
+    attention: the kernel indexes the shared K/V heads directly, so the
+    ring moves (and VMEM holds) only ``hkv`` heads."""
     B, s, h, d = shape
+    hkv = h if hkv is None else hkv
     BH = B * h
     bq, bk = _fa.pick_blocks(s, s, d)
     ring = [(i, (i + 1) % nshards) for i in range(nshards)]
@@ -77,8 +80,8 @@ def _build_flash(mesh, axis, nshards, shape, causal, dtype,
         my = lax.axis_index(axis)
         # head-major (BH, s, d) once; bf16 feeds the MXU, f32 state
         qh = jnp.einsum("bshd->bhsd", q).reshape(BH, s, d)
-        kh = jnp.einsum("bshd->bhsd", k).reshape(BH, s, d)
-        vh = jnp.einsum("bshd->bhsd", v).reshape(BH, s, d)
+        kh = jnp.einsum("bshd->bhsd", k).reshape(B * hkv, s, d)
+        vh = jnp.einsum("bshd->bhsd", v).reshape(B * hkv, s, d)
         qh, kh, vh = (x.astype(jnp.bfloat16) for x in (qh, kh, vh))
         m = jnp.full((BH, s, 1), -jnp.inf, jnp.float32)
         l = jnp.zeros((BH, s, 1), jnp.float32)
@@ -106,6 +109,12 @@ def _build_flash(mesh, axis, nshards, shape, causal, dtype,
     return jax.jit(shm)
 
 
+def _repeat_heads_hmajor(x, group):
+    """GQA on the XLA path: expand head-major (B, hkv, s, d) K/V blocks
+    to the q head count (repeat along axis 1)."""
+    return jnp.repeat(x, group, axis=1) if group > 1 else x
+
+
 def _pick_q_chunk(B, s, h, budget_bytes=512 * 2 ** 20):
     """Largest q-chunk whose (B, h, qc, s) f32 logits fit the budget.
     The floor stays at 128 so high batch*heads configs keep an
@@ -118,8 +127,10 @@ def _pick_q_chunk(B, s, h, budget_bytes=512 * 2 ** 20):
     return qc
 
 
-def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None):
+def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None,
+           hkv=None):
     B, s, h, d = shape  # local block: (batch, seq_shard, heads, head_dim)
+    group = 1 if hkv is None else h // hkv
     scale = 1.0 / math.sqrt(d)
     ring = [(i, (i + 1) % nshards) for i in range(nshards)]
     qc = min(q_chunk or _pick_q_chunk(B, s, h), s)
@@ -168,6 +179,10 @@ def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None):
             m, l, acc, kT, vT = carry
             src = (my - t) % nshards  # whose block we hold this round
             k_pos = src * s + jnp.arange(s)
+            # GQA: the ring carries only the hkv shared heads; expand to
+            # the q head count just-in-time for this step's einsums
+            kT = _repeat_heads_hmajor(kT, group)
+            vT = _repeat_heads_hmajor(vT, group)
             if nqc == 1:
                 m, l, acc = one_chunk(
                     (q_ch[0], q_pos[0], m[0], l[0], acc[0]),
@@ -180,7 +195,9 @@ def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None):
                     lambda a: one_chunk(a, kT, vT, k_pos),
                     (q_ch, q_pos, m, l, acc))
             # rotate K/V around the ring for the next round (ppermute is
-            # layout-agnostic: the head-major blocks travel directly)
+            # layout-agnostic: the head-major blocks travel directly).
+            # The UN-expanded blocks travel: GQA moves only hkv heads.
+            kT, vT = carry[3], carry[4]
             kT = lax.ppermute(kT, axis, ring)
             vT = lax.ppermute(vT, axis, ring)
             return m, l, acc, kT, vT
@@ -215,22 +232,25 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
     """
     rt = runtime or _rt.runtime()
     B, S, h, d = q.shape
+    hkv = k.shape[2]
+    assert h % hkv == 0 and v.shape[2] == hkv, \
+        "q heads must be a multiple of the (shared) kv heads"
     nshards = rt.nprocs
     assert S % nshards == 0, "seq length must divide the mesh"
     sharding = NamedSharding(rt.mesh, P(None, rt.axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     shape = (B, S // nshards, h, d)
     flash = q_chunk is None and _flash_viable(shape, q.dtype, rt)
-    key = ("ringattn", pinned_id(rt.mesh), shape, causal,
+    key = ("ringattn", pinned_id(rt.mesh), shape, hkv, causal,
            str(q.dtype), q_chunk, flash)
     prog = _cache.get(key)
     if prog is None:
         if flash:
             prog = _build_flash(rt.mesh, rt.axis, nshards, shape, causal,
-                                q.dtype)
+                                q.dtype, hkv=hkv)
         else:
             prog = _build(rt.mesh, rt.axis, nshards, shape, causal,
-                          q.dtype, q_chunk)
+                          q.dtype, q_chunk, hkv=hkv)
         _cache[key] = prog
     return prog(q, k, v)
 
@@ -244,6 +264,8 @@ def ring_attention_n(q, k, v, iters: int, *, causal: bool = False,
     final output."""
     rt = runtime or _rt.runtime()
     B, S, h, d = q.shape
+    assert k.shape[2] == h and v.shape[2] == h, \
+        "ring_attention_n chains v through the output: heads must match"
     nshards = rt.nprocs
     assert S % nshards == 0, "seq length must divide the mesh"
     shape = (B, S // nshards, h, d)

@@ -169,3 +169,16 @@ def test_distributed_scan_with_kernel_interpret(monkeypatch):
         [[0.0], np.cumsum(src.astype(np.float64))[:-1]])
     np.testing.assert_allclose(dr_tpu.to_numpy(ex), ref,
                                rtol=1e-4, atol=1e-3)
+
+
+def test_chunked_cumsum_kernel_bf16_interpret():
+    from dr_tpu.ops import scan_pallas
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    n = 128 * 128
+    x = jnp.asarray(rng.standard_normal(n), jnp.bfloat16)
+    got = np.asarray(scan_pallas.chunked_cumsum(x, interpret=True)
+                     .astype(jnp.float32))
+    ref = np.cumsum(np.asarray(x.astype(jnp.float32), np.float64))
+    # bf16 storage rounds each output; tolerance reflects that
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=1.0)
