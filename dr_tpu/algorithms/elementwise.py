@@ -227,7 +227,12 @@ def transform(in_r, out, op: Callable) -> None:
     ins = _resolve(in_r)
     n = len(in_r)
     assert out_chain.n >= n, "output window too small"
-    out_chain.n = n if n < out_chain.n else out_chain.n
+    if n < out_chain.n:
+        # narrow via a NEW chain: the key property must always reflect
+        # the window actually written (VERDICT r1 noted the in-place
+        # narrow as a future cache-key footgun)
+        out_chain = _Chain(out_chain.cont, out_chain.off, n,
+                           out_chain.ops)
     if ins is not None and _fast_aligned(ins, out_chain):
         _run_fused(ins, out_chain, op)
         return
