@@ -51,14 +51,15 @@ from .views import views
 from .views.views import aligned, local_segments
 from .algorithms.elementwise import (fill, iota, copy, copy_async, for_each,
                                      transform, to_numpy)
-from .algorithms.reduce import (reduce, transform_reduce, dot,
+from .algorithms.reduce import (reduce, transform_reduce, dot, dot_n,
                                 reduce_async, transform_reduce_async,
                                 dot_async)
-from .algorithms.scan import inclusive_scan, exclusive_scan
+from .algorithms.scan import (inclusive_scan, exclusive_scan,
+                              inclusive_scan_n)
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
                                    heat_step_weights)
-from .algorithms.gemv import gemv, flat_gemv, gemm
+from .algorithms.gemv import gemv, gemv_n, flat_gemv, gemm
 
 __version__ = "0.1.0"
 
@@ -87,4 +88,5 @@ __all__ = [
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
     "checkpoint", "profiling", "ring_attention", "ring_attention_n",
+    "dot_n", "inclusive_scan_n", "gemv_n",
 ]

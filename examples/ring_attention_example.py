@@ -53,6 +53,19 @@ def main():
     print(f"ring attention over {P} shard(s), seq={S}: "
           f"max |err| vs dense reference = {err:.2e}")
     assert err < 1e-3, "mismatch vs dense reference"
+
+    # grouped-query attention: fewer shared K/V heads (h % hkv == 0);
+    # the ring carries only the hkv heads
+    if args.heads % 2 == 0:
+        hkv = args.heads // 2
+        kg, vg = k[:, :, :hkv], v[:, :, :hkv]
+        gqa = np.asarray(dr_tpu.ring_attention(q, kg, vg,
+                                               causal=args.causal))
+        ref_g = dense_reference(q, np.repeat(kg, 2, axis=2),
+                                np.repeat(vg, 2, axis=2), args.causal)
+        err_g = np.abs(gqa - ref_g).max()
+        print(f"grouped-query (hkv={hkv}): max |err| = {err_g:.2e}")
+        assert err_g < 1e-3, "GQA mismatch vs dense reference"
     print("PASSED")
 
 
