@@ -103,20 +103,21 @@ def _exchange_body(axis, nshards, seg, prev, nxt, periodic, n):
     tail = n - (nshards - 1) * seg
 
     def body(blk):  # blk: (1, prev + seg + nxt) — one shard row
-        S = prev + seg + nxt
-        new = blk
         idx = lax.axis_index(axis)
         valid = jnp.where(idx == nshards - 1, tail, seg)
+        # ALL reads of the row happen before any write: with disjoint
+        # live ranges XLA can update the (fori_loop-carried) row in
+        # place instead of copying it per round — the copies, not the
+        # ghost traffic, dominated the measured exchange latency
+        new_p = new_n = None
         if prev:
             # last `prev` VALID owned cells -> next rank's ghost_prev
             send = lax.dynamic_slice_in_dim(blk, prev + valid - prev, prev,
                                             axis=1)
             recv = lax.ppermute(send, axis, fwd)
-            if periodic or nshards == 1:
-                got = jnp.bool_(periodic)
-            else:
-                got = idx > 0
-            new = new.at[:, :prev].set(jnp.where(got, recv, blk[:, :prev]))
+            got = jnp.bool_(periodic) if (periodic or nshards == 1) \
+                else idx > 0
+            new_p = jnp.where(got, recv, blk[:, :prev])
         if nxt:
             # first `nxt` owned cells -> prev rank's ghost_next, written
             # IMMEDIATELY after the receiver's valid tail so every local row
@@ -124,13 +125,16 @@ def _exchange_body(axis, nshards, seg, prev, nxt, periodic, n):
             # a short last shard
             send = blk[:, prev: prev + nxt]
             recv = lax.ppermute(send, axis, bwd)
-            if periodic or nshards == 1:
-                got = jnp.bool_(periodic)
-            else:
-                got = idx < nshards - 1
-            old = lax.dynamic_slice_in_dim(new, prev + valid, nxt, axis=1)
-            new = lax.dynamic_update_slice_in_dim(
-                new, jnp.where(got, recv, old), prev + valid, axis=1)
+            got = jnp.bool_(periodic) if (periodic or nshards == 1) \
+                else idx < nshards - 1
+            old = lax.dynamic_slice_in_dim(blk, prev + valid, nxt, axis=1)
+            new_n = jnp.where(got, recv, old)
+        new = blk
+        if new_p is not None:
+            new = new.at[:, :prev].set(new_p)
+        if new_n is not None:
+            new = lax.dynamic_update_slice_in_dim(new, new_n, prev + valid,
+                                                  axis=1)
         return new
 
     return body
