@@ -78,6 +78,7 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool):
     S = R // LANES  # sub-rows of the row-total re-block (S <= 128)
 
     def kernel(u_ref, x_hbm, out_hbm, vin, vout, carry, in_sem, out_sem):
+        # carry lives in SMEM: scalar state across the sequential grid
         i = pl.program_id(0)
         slot = lax.rem(i, 2)
 
@@ -151,7 +152,7 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool):
         scratch_shapes=[
             pltpu.VMEM((2, R, LANES), dtype),
             pltpu.VMEM((2, R, LANES), dtype),
-            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],

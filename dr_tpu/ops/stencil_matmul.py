@@ -142,8 +142,8 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
     nch = segc // cr
     wrows = cr + 2  # one ghost lane-column each side
 
-    def kernel(w_ref, row_hbm, out_hbm, vin, vout, in_sem, out_sem,
-               ghost_sem):
+    def kernel(w_ref, row_hbm, out_hbm, vin, vout, vghost, in_sem,
+               out_sem, ghost_sem):
         i = pl.program_id(0)
         slot = jax.lax.rem(i, 2)
 
@@ -157,17 +157,26 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
                 vout.at[s], out_hbm.at[pl.ds(hc + c * cr, cr), :],
                 out_sem.at[s])
 
-        def ghost_dma(g):  # stale pass-through of the halo columns
+        # stale pass-through of the halo columns, bounced through VMEM
+        # (two legs per side: HBM->VMEM on the first cell, VMEM->HBM on
+        # the last — direct HBM->HBM DMA is not a safe Mosaic bet)
+        def ghost_in(g):
             lo = (0, hc + segc)[g]
             return pltpu.make_async_copy(
-                row_hbm.at[pl.ds(lo, hc), :],
-                out_hbm.at[pl.ds(lo, hc), :], ghost_sem.at[g])
+                row_hbm.at[pl.ds(lo, hc), :], vghost.at[g],
+                ghost_sem.at[g])
+
+        def ghost_out(g):
+            lo = (0, hc + segc)[g]
+            return pltpu.make_async_copy(
+                vghost.at[g], out_hbm.at[pl.ds(lo, hc), :],
+                ghost_sem.at[g])
 
         @pl.when(i == 0)
         def _():
             in_dma(0, 0).start()
-            ghost_dma(0).start()
-            ghost_dma(1).start()
+            ghost_in(0).start()
+            ghost_in(1).start()
 
         @pl.when(i + 1 < nch)
         def _():
@@ -191,9 +200,13 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
 
         @pl.when(i == nch - 1)
         def _():
+            ghost_in(0).wait()
+            ghost_in(1).wait()
+            ghost_out(0).start()
+            ghost_out(1).start()
             out_dma(i, slot).wait()
-            ghost_dma(0).wait()
-            ghost_dma(1).wait()
+            ghost_out(0).wait()
+            ghost_out(1).wait()
 
         if nch > 1:
             @pl.when(i == nch - 1)
@@ -210,6 +223,7 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
         scratch_shapes=[
             pltpu.VMEM((2, wrows, LANES), dtype),
             pltpu.VMEM((2, cr, LANES), dtype),
+            pltpu.VMEM((2, hc, LANES), dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
