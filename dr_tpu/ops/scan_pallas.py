@@ -13,10 +13,11 @@ resident.
 
 Layout: x viewed as (rows, 128) lane-blocked; flat order is row-major,
 so the prefix decomposes as
-  within-row lane prefix      (rows @ U128, MXU)
-  + exclusive row offset      (row totals scanned the same way, twice
-                               more at 1/128 and 1/16384 the size)
-  + chunk carry               (scalar scratch).
+  within-row lane prefix      (rows @ U128, upper-triangular ones, MXU)
+  + exclusive row offset      (Lstrict @ row_totals: strictly-LOWER
+                               triangular ones on the sublane axis —
+                               no cross-layout reshapes, all MXU)
+  + chunk carry               (SMEM scalar across the sequential grid).
 
 Reference workload: ``shp/algorithms/inclusive_scan.hpp:25-148``
 (BASELINE.json config 3).
@@ -39,7 +40,7 @@ __all__ = ["chunked_cumsum", "pick_chunk", "prefix_matrix",
            "supported"]
 
 LANES = 128
-_MAX_ROWS = 2048  # chunk rows: (R, 128) f32 = 1 MiB per buffer
+_MAX_ROWS = 512  # chunk rows: bounds the (R, R) row-offset operator
 
 
 def supported() -> bool:
@@ -47,9 +48,8 @@ def supported() -> bool:
 
 
 def pick_chunk(n: int):
-    """Chunk rows R (power of two, R*128 divides n, R % 128 == 0 so the
-    row-total re-block stays tile-aligned) or None -> caller falls back
-    to the XLA path."""
+    """Chunk rows R (power of two, R*128 divides n) or None -> caller
+    falls back to the XLA path."""
     if n % LANES:
         return None
     rows = n // LANES
@@ -71,13 +71,20 @@ def prefix_matrix(k: int):
     return np.triu(np.ones((k, k), dtype=np.float32))
 
 
+@functools.lru_cache(maxsize=8)
+def _strict_lower(k: int):
+    """(Lstrict @ col)[i] = sum_{r<i} col[r]: the exclusive row-offset
+    operator (NUMPY, see prefix_matrix)."""
+    return np.tril(np.ones((k, k), dtype=np.float32), -1)
+
+
 @functools.lru_cache(maxsize=16)
 def _build(rows: int, R: int, dtype_name: str, interpret: bool):
     dtype = jnp.dtype(dtype_name)
     nch = rows // R
-    S = R // LANES  # sub-rows of the row-total re-block (S <= 128)
 
-    def kernel(u_ref, x_hbm, out_hbm, vin, vout, carry, in_sem, out_sem):
+    def kernel(u_ref, lo_ref, x_hbm, out_hbm, vin, vout, carry, in_sem,
+               out_sem):
         # carry lives in SMEM: scalar state across the sequential grid
         i = pl.program_id(0)
         slot = lax.rem(i, 2)
@@ -105,27 +112,21 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool):
         def _():
             out_dma(i - 2, slot).wait()
 
-        U = u_ref[:]
         x = vin[slot].astype(jnp.float32)
         # lane prefix within each 128-wide row (MXU)
-        P1 = lax.dot_general(x, U, (((1,), (0,)), ((), ())),
+        P1 = lax.dot_general(x, u_ref[:], (((1,), (0,)), ((), ())),
                              precision=lax.Precision.HIGHEST,
                              preferred_element_type=jnp.float32)
         row_tot = P1[:, LANES - 1:LANES]              # (R, 1)
-        t = row_tot.reshape(S, LANES)                 # sub-row blocks
-        ts = lax.dot_general(t, U, (((1,), (0,)), ((), ())),
-                             precision=lax.Precision.HIGHEST,
-                             preferred_element_type=jnp.float32)
-        sub_tot = ts[:, LANES - 1:LANES]              # (S, 1)
-        st = lax.dot_general(
-            sub_tot.reshape(1, S), U[:S, :S], (((1,), (0,)), ((), ())),
+        # exclusive row offsets on the SUBLANE axis: one (R, R)
+        # strictly-lower matmul — no cross-layout reshapes
+        excl_rows = lax.dot_general(
+            lo_ref[:], row_tot, (((1,), (0,)), ((), ())),
             precision=lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)       # (1, S) inclusive
-        excl_sub = (st - sub_tot.reshape(1, S)).reshape(S, 1)
-        # exclusive offset of each row = inclusive-across-rows - own
-        excl_rows = (ts - t + excl_sub).reshape(R, 1)
+            preferred_element_type=jnp.float32)       # (R, 1)
         out = P1 + excl_rows + carry[0, 0]
-        carry[0, 0] = carry[0, 0] + st[0, S - 1]
+        carry[0, 0] = (carry[0, 0] + excl_rows[R - 1, 0]
+                       + row_tot[R - 1, 0])
         vout[slot] = out.astype(dtype)
         out_dma(i, slot).start()
 
@@ -146,6 +147,7 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool):
         kernel,
         grid=(nch,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct((rows, LANES), dtype),
@@ -172,4 +174,5 @@ def chunked_cumsum(x, *, interpret: bool = False):
     rows = n // LANES
     fn = _build(rows, R, str(x.dtype), interpret)
     U = jnp.asarray(prefix_matrix(LANES), jnp.float32)
-    return fn(U, x.reshape(rows, LANES)).reshape(n)
+    L = jnp.asarray(_strict_lower(R), jnp.float32)
+    return fn(U, L, x.reshape(rows, LANES)).reshape(n)

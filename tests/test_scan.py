@@ -131,7 +131,7 @@ def test_chunked_cumsum_kernel_interpret():
 
 def test_scan_kernel_chunk_gates():
     from dr_tpu.ops import scan_pallas
-    assert scan_pallas.pick_chunk(2 ** 27) == 2048
+    assert scan_pallas.pick_chunk(2 ** 27) == scan_pallas._MAX_ROWS
     assert scan_pallas.pick_chunk(128 * 128) == 128
     assert scan_pallas.pick_chunk(130) is None      # not lane-aligned
     assert scan_pallas.pick_chunk(128 * 100) is None  # rows % 2^k != 0
