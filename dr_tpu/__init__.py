@@ -58,7 +58,7 @@ from .algorithms.scan import (inclusive_scan, exclusive_scan,
                               inclusive_scan_n)
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
-                                   heat_step_weights)
+                                   stencil2d_n, heat_step_weights)
 from .algorithms.gemv import gemv, gemv_n, flat_gemv, gemm
 
 __version__ = "0.1.0"
@@ -88,5 +88,5 @@ __all__ = [
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
     "checkpoint", "profiling", "ring_attention", "ring_attention_n",
-    "dot_n", "inclusive_scan_n", "gemv_n",
+    "dot_n", "inclusive_scan_n", "gemv_n", "stencil2d_n",
 ]

@@ -27,7 +27,8 @@ from ..core.pinning import pinned_id
 from ..containers.dense_matrix import dense_matrix
 
 __all__ = ["stencil2d_transform", "stencil2d_iterate",
-           "stencil2d_iterate_blocked", "heat_step_weights"]
+           "stencil2d_iterate_blocked", "stencil2d_n",
+           "heat_step_weights"]
 
 
 def heat_step_weights(alpha: float = 0.25):
@@ -147,6 +148,43 @@ def stencil2d_iterate_blocked(a: dense_matrix, weights, steps: int, *,
     if rest:
         data = progs[rest](data)
     a._data = progs["unpad"](data)
+    return a
+
+
+def stencil2d_n(a: dense_matrix, weights, iters: int, *,
+                time_block: int = 16) -> dense_matrix:
+    """``iters`` full time-blocks of the blocked 2-D stencil in ONE
+    jitted program (the 2-D member of the ``*_n`` measurement family,
+    docs/PERF.md "measurement lesson"): pad, ``lax.fori_loop`` over the
+    Pallas block kernel, unpad — so per-block device time excludes the
+    tunneled per-dispatch constant entirely.  Applies exactly
+    ``iters * time_block`` steps with the same frozen-edge contract as
+    :func:`stencil2d_iterate_blocked`."""
+    from ..ops import stencil2d_pallas
+    assert np.asarray(weights).shape == (3, 3), "blocked path is 3x3"
+    m, n = a.shape
+    assert a.grid_shape == (1, 1) and a.is_block, \
+        "blocked 2-D stencil runs on a single-tile matrix"
+    interpret = a.runtime.devices[0].platform != "tpu"
+    pad = time_block
+    key = ("st2n", pinned_id(a.runtime.mesh), a.layout, m, n,
+           tuple(map(tuple, np.asarray(weights))), time_block,
+           bool(interpret), str(a.dtype), int(iters))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        def run(x):
+            xp = jnp.pad(x, ((pad, pad), (0, 0)))
+
+            def body(_, d):
+                return stencil2d_pallas.blocked_stencil2d_padded(
+                    d, m, weights, time_block, pad, interpret=interpret)
+
+            xp = jax.lax.fori_loop(0, iters, body, xp)
+            return xp[pad:pad + m, :]
+
+        prog = jax.jit(run)
+        _prog_cache[key] = prog
+    a._data = prog(a._data)
     return a
 
 

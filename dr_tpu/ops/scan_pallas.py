@@ -7,7 +7,7 @@ where the operation's floor is 8 B (read + write once).  This kernel
 fuses everything into one pass: chunks stream through VMEM
 (double-buffered DMA), each chunk's local prefix runs on the MXU
 (multiply by an upper-triangular ones matrix), and the running carry
-lives in a VMEM scratch that persists across the SEQUENTIAL TPU grid —
+lives in an SMEM scratch that persists across the SEQUENTIAL TPU grid —
 so the carry "fixup" is a free broadcast-add while the chunk is still
 resident.
 

@@ -90,6 +90,13 @@ _PRECISION = {
     "highest": jax.lax.Precision.HIGHEST,
 }[os.environ.get("DR_TPU_MM_PRECISION", "high").strip().lower()]
 
+# Mosaic (the Pallas TPU compiler) accepts only DEFAULT and HIGHEST dot
+# precisions; HIGH exists only at the XLA level.  The fused kernel is
+# HBM-bound (that is its whole point), so promoting HIGH to HIGHEST
+# inside the kernel costs no wall-clock and only gains accuracy.
+_KERNEL_PRECISION = (jax.lax.Precision.HIGHEST
+                     if _PRECISION == jax.lax.Precision.HIGH else _PRECISION)
+
 # rows per matmul chunk: bounds the (chunk, 384) product intermediate so
 # billion-element rows don't triple HBM residency
 _CHUNK_ROWS = int(os.environ.get("DR_TPU_MM_CHUNK_ROWS", str(2 ** 16)))
@@ -191,7 +198,7 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
         src = vin[slot]
         P = jax.lax.dot_general(
             src, w_ref[:], (((1,), (0,)), ((), ())),
-            precision=_PRECISION,
+            precision=_KERNEL_PRECISION,
             preferred_element_type=jnp.promote_types(dtype, jnp.float32))
         out = (P[0:cr, 0:LANES] + P[1:cr + 1, LANES:2 * LANES]
                + P[2:cr + 2, 2 * LANES:])

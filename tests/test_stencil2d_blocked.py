@@ -72,3 +72,19 @@ def test_blocked_kernel_consumes_unaligned_band():
     got = stencil2d_iterate_blocked(M, w, 4, time_block=2, band=12)
     np.testing.assert_allclose(got.materialize(), ref.materialize(),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_stencil2d_n_matches_iterate_blocked():
+    # the fused measurement program applies exactly iters * tb steps
+    m, tb, iters = 32, 2, 3
+    src = np.random.default_rng(7).standard_normal(
+        (m, 128)).astype(np.float32)
+    w = dr_tpu.heat_step_weights(0.2)
+    from dr_tpu.algorithms.stencil2d import stencil2d_n
+    A = _single_tile(src)
+    B = _single_tile(src)
+    ref = stencil2d_iterate(A, B, w, steps=iters * tb)
+    M = _single_tile(src)
+    got = stencil2d_n(M, w, iters, time_block=tb)
+    np.testing.assert_allclose(got.materialize(), ref.materialize(),
+                               rtol=2e-4, atol=2e-5)
