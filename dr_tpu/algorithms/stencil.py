@@ -252,8 +252,9 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
 
     Same contract as :func:`stencil_iterate_blocked` (periodic ring,
     equal full shards, halo width >= k_block * radius); additionally
-    k_block * radius <= 128 so the composed band spans at most adjacent
-    lane columns.  Returns ``dv`` stepped ``steps`` times.
+    k_block <= max_ksteps(radius) — the composed band may span up to
+    two lane columns each side.  Returns ``dv`` stepped ``steps``
+    times.
     """
     from ..ops import stencil_matmul
     cont = dv
@@ -264,7 +265,8 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
     assert prev == nxt and prev >= k_block * r, \
         "halo width must cover k_block * radius"
     assert n == nshards * seg, "blocked stencil needs equal full shards"
-    assert k_block * r <= stencil_matmul.LANES
+    assert k_block <= stencil_matmul.max_ksteps(r), \
+        "composed band exceeds the supported lane-column reach"
     assert k_block * r <= seg, \
         "k_block * radius exceeds the per-shard segment"
     # surface the matmul path's lane-alignment preconditions here, at the

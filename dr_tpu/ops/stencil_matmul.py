@@ -9,18 +9,20 @@ The MXU has ~2 orders of magnitude more FLOPs than the VPU.  To use it,
 compose ``k`` stencil steps into ONE linear operator: the k-fold
 convolution of the weight taps is again a Toeplitz band (half-width
 ``k*r``), and on the lane-blocked view ``X[:, j] = x[128j : 128j+128]``
-the composed step touches only adjacent 128-columns when ``k*r <= 128``:
+the composed step touches the ``D = ceil(k*r / 128)`` nearest
+128-columns each side:
 
-    out_col_j = A_[-1] @ X_col_{j-1}  +  A_0 @ X_col_j  +  A_[+1] @ X_col_{j+1}
+    out_col_j = sum_{d=-D..D}  A_d @ X_col_{j+d}
     A_d[a, b] = c[(b + 128*d) - a],   c = taps(weights) ** (*k)
 
-which is one (ncols, 128) x (128, 384) matmul plus three shifted adds.
-Per element-step the MXU cost is 3*2*128/k FLOPs (24 at k=32) versus
-the VPU path's ~20 vector ops per element-step — the arithmetic moves
-to the unit with the FLOPs, and HBM still sees one read + one write per
-``k`` steps.  Numerically the composed taps are computed in float64 on
-the host, so one composed application is *more* accurate than ``k``
-sequential float32 steps.
+which is one (ncols, 128) x (128, (2D+1)*128) matmul plus 2D+1 shifted
+adds.  Per element-step the MXU cost is (2D+1)*2*128/k FLOPs (24 at
+k=32, D=1; 20 at k=128, D=2) versus the VPU path's ~20 vector ops per
+element-step — the arithmetic moves to the unit with the FLOPs, and HBM
+still sees one read + one write per ``k`` steps, so doubling D halves
+the physical passes again.  Numerically the composed taps are computed
+in float64 on the host, so one composed application is *more* accurate
+than ``k`` sequential float32 steps.
 
 Same contract as ``blocked_stencil_row``: the padded shard row arrives
 with ghosts pre-exchanged to width >= k*r; owned cells are stepped ``k``
@@ -51,19 +53,31 @@ def composed_taps(weights: Sequence[float], k: int) -> np.ndarray:
     return c
 
 
-def max_ksteps(radius: int) -> int:
-    """Largest composable block: the band must fit one lane column."""
-    return LANES // radius
+def max_ksteps(radius: int, ncols: int = 2) -> int:
+    """Largest supported composable block: the band half-width ``k*r``
+    may span up to ``ncols`` lane columns each side (D <= ncols)."""
+    return ncols * LANES // radius
+
+
+def _cols_for(half_width: int) -> int:
+    """Lane columns a band of the given half-width reaches each side."""
+    return -(-half_width // LANES)
+
+
+def band_cols(k: int, radius: int) -> int:
+    """D: lane columns the composed band reaches each side."""
+    return _cols_for(k * radius)
 
 
 @functools.lru_cache(maxsize=64)
 def _operator(weights: tuple, k: int, dtype_name: str):
-    """(128, 384) stacked [A_-1 | A_0 | A_+1] transposed for R @ W."""
+    """(128, (2D+1)*128) stacked [A_-D | ... | A_0 | ... | A_+D]
+    transposed for R @ W, where D = ceil(k*r / 128)."""
     c = composed_taps(weights, k)
     R = (len(c) - 1) // 2  # k * radius
-    assert R <= LANES, f"k*radius ({R}) exceeds one lane column ({LANES})"
+    D = _cols_for(R)
     blocks = []
-    for d in (-1, 0, 1):
+    for d in range(-D, D + 1):
         A = np.zeros((LANES, LANES), dtype=np.float64)
         a = np.arange(LANES)[:, None]
         b = np.arange(LANES)[None, :]
@@ -71,10 +85,10 @@ def _operator(weights: tuple, k: int, dtype_name: str):
         inband = np.abs(s) <= R
         A[inband] = c[(s + R)[inband]]
         blocks.append(A)
-    W = np.concatenate(blocks, axis=0)  # (384, 128): [A_-1; A_0; A_+1]
+    W = np.concatenate(blocks, axis=0)  # ((2D+1)*128, 128)
     # cache a NUMPY array: a jnp conversion here would run inside the
     # caller's trace and leak a tracer through the lru_cache
-    return np.ascontiguousarray(W.T).astype(dtype_name)  # (128, 384)
+    return np.ascontiguousarray(W.T).astype(dtype_name)  # (128, (2D+1)*128)
 
 
 import os
@@ -102,16 +116,20 @@ _KERNEL_PRECISION = (jax.lax.Precision.HIGHEST
 _CHUNK_ROWS = int(os.environ.get("DR_TPU_MM_CHUNK_ROWS", str(2 ** 16)))
 
 
-def _apply(src, W, segc):
-    """P-form composed apply on ``src`` = owned columns +1 ghost column
-    each side: one (segc+2, 128) x (128, 384) matmul + shifted adds."""
+def _apply(src, W, segc, D=1):
+    """P-form composed apply on ``src`` = owned columns + ``D`` ghost
+    columns each side: one (segc+2D, 128) x (128, (2D+1)*128) matmul
+    plus 2D+1 shifted adds."""
     P = jax.lax.dot_general(
         src, W, (((1,), (0,)), ((), ())),
         precision=_PRECISION,
         preferred_element_type=jnp.promote_types(src.dtype, jnp.float32))
-    return (P[0:segc, 0:LANES]                    # A_-1 @ X_{j-1}
-            + P[1:segc + 1, LANES:2 * LANES]      # A_0  @ X_j
-            + P[2:segc + 2, 2 * LANES:])          # A_+1 @ X_{j+1}
+    # block i holds A_{i-D}; its contribution to out row j comes from
+    # src row j + (i - D) + D = j + i
+    out = P[0:segc, 0:LANES]
+    for i in range(1, 2 * D + 1):
+        out = out + P[i:segc + i, i * LANES:(i + 1) * LANES]
+    return out
 
 
 def _pick_chunk_rows(segc: int, cap: int = 4096):
@@ -128,9 +146,9 @@ def _pick_chunk_rows(segc: int, cap: int = 4096):
 
 @functools.lru_cache(maxsize=32)
 def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
-                  dtype_name: str, interpret: bool = False):
-    """Fused Pallas apply: the XLA P-form writes the (rows, 384) product
-    through HBM (~3x the row) and re-reads it for the shifted adds; this
+                  dtype_name: str, D: int = 1, interpret: bool = False):
+    """Fused Pallas apply: the XLA P-form writes the (rows, (2D+1)*128)
+    product through HBM and re-reads it for the shifted adds; this
     kernel keeps matmul + shifted add VMEM-resident so HBM sees exactly
     one read and one write per element per composed block.
 
@@ -147,7 +165,7 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
 
     dtype = jnp.dtype(dtype_name)
     nch = segc // cr
-    wrows = cr + 2  # one ghost lane-column each side
+    wrows = cr + 2 * D  # D ghost lane-columns each side
 
     def kernel(w_ref, row_hbm, out_hbm, vin, vout, vghost, in_sem,
                out_sem, ghost_sem):
@@ -156,7 +174,7 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
 
         def in_dma(c, s):
             return pltpu.make_async_copy(
-                row_hbm.at[pl.ds(hc - 1 + c * cr, wrows), :], vin.at[s],
+                row_hbm.at[pl.ds(hc - D + c * cr, wrows), :], vin.at[s],
                 in_sem.at[s])
 
         def out_dma(c, s):
@@ -200,8 +218,9 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
             src, w_ref[:], (((1,), (0,)), ((), ())),
             precision=_KERNEL_PRECISION,
             preferred_element_type=jnp.promote_types(dtype, jnp.float32))
-        out = (P[0:cr, 0:LANES] + P[1:cr + 1, LANES:2 * LANES]
-               + P[2:cr + 2, 2 * LANES:])
+        out = P[0:cr, 0:LANES]
+        for b in range(1, 2 * D + 1):
+            out = out + P[b:cr + b, b * LANES:(b + 1) * LANES]
         vout[slot] = out.astype(dtype)
         out_dma(i, slot).start()
 
@@ -247,8 +266,10 @@ def matmul_stencil_row(row, seg: int, halo: int, weights: Sequence[float],
 
     ``row``: (1, halo + seg + halo); ghosts pre-exchanged with width
     >= ksteps * r.  seg and halo must be multiples of 128 (whole lane
-    columns).  Returns the new row (owned stepped, ghosts stale).
-    ``impl="pallas"`` (TPU callers) takes the fused VMEM apply.
+    columns); the composed band may reach D = ceil(ksteps*r/128) lane
+    columns each side.  Returns the new row (owned stepped, ghosts
+    stale).  ``impl="pallas"`` (TPU callers) takes the fused VMEM
+    apply.
     """
     r = (len(weights) - 1) // 2
     width = row.shape[-1]
@@ -256,36 +277,38 @@ def matmul_stencil_row(row, seg: int, halo: int, weights: Sequence[float],
     assert seg % LANES == 0 and halo % LANES == 0, \
         "matmul stencil needs seg and halo aligned to 128 lanes"
     assert halo >= ksteps * r, "halo narrower than the composed block"
-    assert ksteps * r <= LANES, "composed band exceeds one lane column"
+    D = band_cols(ksteps, r)
     dtype = row.dtype
     W = jnp.asarray(
         _operator(tuple(float(x) for x in weights), ksteps, str(dtype)))
     hc = halo // LANES
     segc = seg // LANES
+    assert hc >= D  # follows from halo >= k*r and 128-alignment
     R = row.reshape(width // LANES, LANES)
     if impl.startswith("pallas"):
         cr = _pick_chunk_rows(segc)
-        fn = _pallas_apply(width // LANES, hc, segc, cr, str(dtype),
+        fn = _pallas_apply(width // LANES, hc, segc, cr, str(dtype), D,
                            interpret=impl == "pallas_interpret")
         return fn(W, R).reshape(row.shape)
     cr = _CHUNK_ROWS
     if segc <= cr:
-        out = _apply(R[hc - 1: hc + segc + 1], W, segc)
+        out = _apply(R[hc - D: hc + segc + D], W, segc, D)
         R = R.at[hc:hc + segc].set(out.astype(dtype))
     else:
-        # chunked: keeps the (cr, 384) intermediate VMEM/HBM-bounded and
-        # lets XLA pipeline fetch/matmul/writeback down the row
+        # chunked: keeps the (cr, (2D+1)*128) intermediate VMEM/HBM-
+        # bounded and lets XLA pipeline fetch/matmul/writeback down the
+        # row
         nch, rem = divmod(segc, cr)
         R0 = R  # all chunks read the pre-step row, never partial updates
 
         def chunk(i):
             src = jax.lax.dynamic_slice(
-                R0, (hc - 1 + i * cr, 0), (cr + 2, LANES))
-            return _apply(src, W, cr)
+                R0, (hc - D + i * cr, 0), (cr + 2 * D, LANES))
+            return _apply(src, W, cr, D)
         outs = jax.lax.map(chunk, jnp.arange(nch))
         if rem:  # remainder chunk stays bounded too
             start = hc + nch * cr
-            tail = _apply(R0[start - 1: start + rem + 1], W, rem)
+            tail = _apply(R0[start - D: start + rem + D], W, rem, D)
         R = R.at[hc:hc + nch * cr].set(
             outs.reshape(nch * cr, LANES).astype(dtype))
         if rem:
