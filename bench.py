@@ -102,8 +102,10 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
         n = min(n, 2 ** 29)
     elif pallas:
         # VPU path: its per-step roll/select cost scales with tblock;
-        # 64 was the measured knee — don't inherit the matmul default
-        tblock = min(tblock, 64)
+        # 64 was the measured knee — don't inherit the matmul default,
+        # but honor an explicit user override
+        if "DR_TPU_BENCH_TBLOCK" not in os.environ:
+            tblock = min(tblock, 64)
         # Mosaic tile alignment: halo is whole (8, 128) f32 tiles
         ra = stencil_pallas.ROW_ALIGN
         halo_w = max(ra, -(-tblock * radius // ra) * ra)

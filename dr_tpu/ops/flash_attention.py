@@ -27,6 +27,7 @@ online softmax follows the flash/ring-attention literature (PAPERS.md).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,9 +49,11 @@ def supported() -> bool:
 def pick_blocks(s: int, skv: int, d: int):
     """(bq, bk) for local seq length ``s`` against a ``skv``-long K/V
     block: the largest power-of-two tiles (bq <= 2048, bk <= 1024 —
-    measured optimum on v5e) dividing the sequence lengths.  Returns
-    None when no MXU-friendly tiling exists or the resident K/V block
-    would overflow VMEM (callers fall back to the XLA path)."""
+    measured optimum on v5e; caps overridable via DR_TPU_FLASH_BQ /
+    DR_TPU_FLASH_BK for on-device tuning) dividing the sequence
+    lengths.  Returns None when no MXU-friendly tiling exists or the
+    resident K/V block would overflow VMEM (callers fall back to the
+    XLA path)."""
     def pick(n, cap, floor):
         b = cap
         while b >= floor:
@@ -63,8 +66,16 @@ def pick_blocks(s: int, skv: int, d: int):
     # the whole held K/V block stays VMEM-resident (double-buffered)
     if 2 * 2 * skv * d * 2 > 64 * 2 ** 20:
         return None
-    bq = pick(s, 2048, 16)   # sublane-aligned q tile (bf16 tile: (16, 128))
-    bk = pick(skv, 1024, 128)  # lane-aligned k tile (logits last dim)
+    def pow2_cap(env, default):
+        # round down to a power of two: pick() only guarantees the
+        # sublane/lane tile alignment promised below for 2^k tiles
+        v = max(1, int(os.environ.get(env, default)))
+        return 1 << (v.bit_length() - 1)
+
+    cap_q = pow2_cap("DR_TPU_FLASH_BQ", "2048")
+    cap_k = pow2_cap("DR_TPU_FLASH_BK", "1024")
+    bq = pick(s, cap_q, 16)  # sublane-aligned q tile (bf16 tile: (16, 128))
+    bk = pick(skv, cap_k, 128)  # lane-aligned k tile (logits last dim)
     if bq is None or bk is None:
         return None
     return bq, bk

@@ -132,11 +132,17 @@ def _apply(src, W, segc, D=1):
     return out
 
 
-def _pick_chunk_rows(segc: int, cap: int = 4096):
+# fused-apply chunk cap (lane columns per DMA chunk): overridable for
+# on-device tuning — the grid's per-step overhead amortizes with larger
+# chunks until VMEM pressure pushes back
+_CHUNK_CAP = int(os.environ.get("DR_TPU_MM_CHUNK_CAP", "4096"))
+
+
+def _pick_chunk_rows(segc: int, cap: int = None):
     """Largest power-of-two chunk <= cap dividing the owned columns
     (always exists: 1 divides everything; large segments get large,
     DMA-efficient chunks)."""
-    cr = cap
+    cr = _CHUNK_CAP if cap is None else cap
     while cr > 1:
         if segc % cr == 0:
             return cr

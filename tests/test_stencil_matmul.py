@@ -128,3 +128,38 @@ def test_pallas_apply_wide_band_interpret():
     got = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k,
                                            impl="pallas_interpret"))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_wide_band_chunked_paths():
+    """D=2 with nch > 1: the chunk-boundary offsets (hc - D + c*cr,
+    wrows = cr + 2D) in both the fused interpret kernel and the
+    lax.map-chunked XLA path."""
+    import jax.numpy as jnp
+    from dr_tpu.ops import stencil_matmul as sm
+
+    rng = np.random.default_rng(13)
+    seg, halo = 1024, 256   # segc = 8
+    w = [0.05, 0.25, 0.4, 0.25, 0.05]
+    k = 128  # D = 2
+    row = jnp.asarray(rng.standard_normal(
+        (1, 2 * halo + seg)).astype(np.float32))
+    ref = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k))
+
+    # pallas interpret with cr=2 -> nch=4
+    orig_pick = sm._pick_chunk_rows
+    sm._pick_chunk_rows = lambda segc, cap=None: 2
+    try:
+        got = np.asarray(sm.matmul_stencil_row(
+            row, seg, halo, w, k, impl="pallas_interpret"))
+    finally:
+        sm._pick_chunk_rows = orig_pick
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # XLA chunked path with a 3-row chunk -> nch=2 plus remainder 2
+    orig_rows = sm._CHUNK_ROWS
+    sm._CHUNK_ROWS = 3
+    try:
+        got = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k))
+    finally:
+        sm._CHUNK_ROWS = orig_rows
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""On-device tuning sweep for the hot kernels (run on the real TPU).
+
+Measures everything by the marginal method with a hard scalar-read sync
+(docs/PERF.md "measurement lesson"): block_until_ready can be a no-op
+on tunneled backends, so each timed call returns one device scalar.
+
+Usage:  python tools/tune_tpu.py [stencil|scan|dot|spmv|heat|attn|all]
+
+Prints one line per configuration; safe to re-run (all programs cached
+per process).  This is a developer tool, not part of the bench contract.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _marginal(run_sync, r1=2, r2=10, samples=5):
+    for r in (r1, r2):
+        run_sync(r)
+    t1s, t2s = [], []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        run_sync(r1)
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_sync(r2)
+        t2s.append(time.perf_counter() - t0)
+    return (float(np.median(t2s)) - float(np.median(t1s))) / (r2 - r1)
+
+
+def tune_stencil():
+    """Sweep the fused-apply chunk cap and band width on the headline
+    geometry (n = 2^29, f32)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from dr_tpu.ops import stencil_matmul as sm
+
+    n = 2 ** 29
+    w = (0.05, 0.25, 0.4, 0.25, 0.05)  # radius 2
+    for k, halo in ((64, 128), (128, 256)):
+        seg = n
+        row = jnp.zeros((1, 2 * halo + seg), jnp.float32) + 0.5
+        GB = seg * 4 * 2 / 1e9
+        for cap in (4096, 8192, 16384):
+            sm._pallas_apply.cache_clear()
+            orig = sm._pick_chunk_rows
+            sm._pick_chunk_rows = functools.partial(orig, cap=cap)
+            try:
+                @jax.jit
+                def run(row, r, salt):
+                    row = row.at[0, 0].add(salt * 1e-9)
+
+                    def body(i, acc):
+                        return sm.matmul_stencil_row(acc, seg, halo, w, k,
+                                                     impl="pallas")
+                    out = jax.lax.fori_loop(0, r, body, row)
+                    return out[0, seg // 2]
+
+                s = [0]
+
+                def sync(r):
+                    s[0] += 1
+                    return float(run(row, r, s[0]))
+                dt = _marginal(sync)
+                print(f"stencil k={k} cap={cap}: {dt * 1e3:.2f} ms/apply "
+                      f"phys {GB / dt:.1f} GB/s "
+                      f"eff {GB * k / dt / 2:.0f} GB/s", flush=True)
+            except Exception as e:
+                print(f"stencil k={k} cap={cap}: FAIL "
+                      f"{str(e).splitlines()[0][:90]}", flush=True)
+            finally:
+                sm._pick_chunk_rows = orig
+
+
+def tune_scan():
+    import jax
+    import jax.numpy as jnp
+
+    from dr_tpu.ops import scan_pallas
+
+    n = 2 ** 27
+    x = jnp.ones((n,), jnp.float32)
+    print("pick_chunk:", scan_pallas.pick_chunk(n), flush=True)
+
+    @jax.jit
+    def run(x, r, salt):
+        x = x.at[0].add(salt * 1e-9)
+
+        def body(i, acc):
+            return scan_pallas.chunked_cumsum(acc) * jnp.asarray(
+                1e-9, acc.dtype)
+        out = jax.lax.fori_loop(0, r, body, x)
+        return out[n // 2]
+
+    s = [0]
+
+    def sync(r):
+        s[0] += 1
+        return float(run(x, r, s[0]))
+    dt = _marginal(sync)
+    print(f"scan kernel: {dt * 1e3:.3f} ms -> {2 * n * 4 / dt / 1e9:.1f} "
+          f"GB/s", flush=True)
+
+
+def tune_container(name):
+    """dot / spmv / heat / attn through the public *_n programs."""
+    import jax.numpy as jnp
+
+    import dr_tpu
+
+    dr_tpu.init()
+    if name == "dot":
+        n = 2 ** 27
+        a = dr_tpu.distributed_vector(n, np.float32)
+        b = dr_tpu.distributed_vector(n, np.float32)
+        dr_tpu.fill(a, 1.5)
+        dr_tpu.fill(b, 2.0)
+        for r2 in (36, 150, 600):
+            dt = _marginal(lambda r: float(dr_tpu.dot_n(a, b, r)), 4, r2)
+            print(f"dot r2={r2}: {2.0 * n * 4 / dt / 1e9:.1f} GB/s",
+                  flush=True)
+    elif name == "heat":
+        m = 8192
+        w = dr_tpu.heat_step_weights(0.25)
+        src = np.zeros((m, m), dtype=np.float32)
+        src[m // 2, m // 2] = 1000.0
+        M = dr_tpu.dense_matrix.from_array(src)
+
+        def _sync(c):
+            return float(c._data.addressable_shards[0].data.reshape(-1)[0])
+
+        def run(r):
+            dr_tpu.stencil2d_n(M, w, r, time_block=16)
+            _sync(M)
+        dt = _marginal(run, 2, 10)
+        print(f"heat2d: {2.0 * m * m * 4 * 16 / dt / 1e9:.1f} GB/s eff",
+              flush=True)
+    elif name == "attn":
+        B, S, h, hd = 1, 8192, 8, 128
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(
+            rng.standard_normal((B, S, h, hd)).astype(np.float32),
+            dtype=jnp.bfloat16) for _ in range(3))
+
+        def run(r):
+            res = dr_tpu.ring_attention_n(q, k, v, r, causal=True)
+            float(res[0, 0, 0, 0].astype(jnp.float32))
+        dt = _marginal(run, 2, 18)
+        fl = 2.0 * B * h * S * S * hd
+        print(f"ring attn: {fl / dt / 1e12:.1f} TFLOP/s", flush=True)
+    elif name == "spmv":
+        m, half = 2 ** 15, 128
+        rng = np.random.default_rng(1)
+        ii = np.repeat(np.arange(m), 2 * half + 1)
+        jj = ii + np.tile(np.arange(-half, half + 1), m)
+        keep = (jj >= 0) & (jj < m)
+        ii, jj = ii[keep], jj[keep]
+        vv = rng.standard_normal(len(ii)).astype(np.float32)
+        A = dr_tpu.sparse_matrix.from_coo((m, m), ii, jj, vv)
+        assert A.ensure_bcsr()
+        c = dr_tpu.distributed_vector(m, np.float32)
+        bv = dr_tpu.distributed_vector(m, np.float32)
+        dr_tpu.fill(bv, 1.0)
+        dr_tpu.fill(c, 0.0)
+
+        def _sync(cc):
+            return float(cc._data.addressable_shards[0].data.reshape(-1)[0])
+
+        for r2 in (18, 600, 3000):
+            def run(r):
+                dr_tpu.gemv_n(c, A, bv, r)
+                _sync(c)
+            dt = _marginal(run, 2, r2)
+            print(f"bcsr spmv r2={r2}: {2.0 * len(ii) / dt / 1e9:.2f} "
+                  f"GFLOP/s", flush=True)
+
+
+if __name__ == "__main__":
+    what = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if what in ("stencil", "all"):
+        tune_stencil()
+    if what in ("scan", "all"):
+        tune_scan()
+    for nm in ("dot", "heat", "attn", "spmv"):
+        if what in (nm, "all"):
+            tune_container(nm)
