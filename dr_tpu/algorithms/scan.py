@@ -110,10 +110,18 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
             and scan_pallas.pick_chunk(seg) is not None)
 
 
+def _kernel_variant() -> str:
+    """Trace-time kernel-variant selector (DR_TPU_SCAN_KERNEL): part of
+    every program cache key so A/B sweeps rebuild instead of reusing
+    the other variant's cached program."""
+    return os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower()
+
+
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                   use_kernel=False):
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
-           else None, exclusive, str(dtype), use_kernel)
+           else None, exclusive, str(dtype), use_kernel,
+           _kernel_variant() if use_kernel else None)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -256,7 +264,8 @@ def inclusive_scan_n(in_v, out, iters: int):
     use_kernel = _use_scan_kernel(c.cont.layout, "add", c.cont.dtype,
                                   c.cont.runtime)
     key = ("scan_n", pinned_id(mesh), c.cont.layout, str(dtype),
-           int(iters), use_kernel)
+           int(iters), use_kernel,
+           _kernel_variant() if use_kernel else None)
     prog = _prog_cache.get(key)
     if prog is None:
         one = _scan_program(

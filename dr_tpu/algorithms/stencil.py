@@ -282,8 +282,11 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
     w = tuple(float(x) for x in weights)
     # impl resolves from env at build time: key on it so flipping
     # DR_TPU_MM_IMPL between calls rebuilds instead of silently reusing
+    # the chunk cap is a trace-time constant of the fused apply: key on
+    # it so DR_TPU_MM_CHUNK_CAP sweeps rebuild instead of reusing stale
+    # programs
     key = ("stencil_mm", pinned_id(cont.runtime.mesh), cont.layout, w, k_block,
-           str(cont.dtype), _matmul_impl(cont))
+           str(cont.dtype), _matmul_impl(cont), stencil_matmul._chunk_cap())
     return _blocked_drive(cont, key, steps, k_block,
                           lambda nst: _make_matmul_prog(cont, w, nst))
 

@@ -241,8 +241,11 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     shape = (B, S // nshards, h, d)
     flash = q_chunk is None and _flash_viable(shape, q.dtype, rt)
+    # the picked flash tiles key the program: the DR_TPU_FLASH_BQ/BK
+    # caps may change between calls (tools/tune_tpu.py sweeps them)
+    blocks = _fa.pick_blocks(shape[1], shape[1], d) if flash else None
     key = ("ringattn", pinned_id(rt.mesh), shape, hkv, causal,
-           str(q.dtype), q_chunk, flash)
+           str(q.dtype), q_chunk, flash, blocks)
     prog = _cache.get(key)
     if prog is None:
         if flash:
@@ -272,8 +275,9 @@ def ring_attention_n(q, k, v, iters: int, *, causal: bool = False,
     flash = _flash_viable(shape, q.dtype, rt)
     sharding = NamedSharding(rt.mesh, P(None, rt.axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    blocks = _fa.pick_blocks(shape[1], shape[1], d) if flash else None
     key = ("ringattn_n", pinned_id(rt.mesh), shape, causal,
-           str(q.dtype), flash, int(iters))
+           str(q.dtype), flash, blocks, int(iters))
     prog = _cache.get(key)
     if prog is None:
         build = _build_flash if flash else _build

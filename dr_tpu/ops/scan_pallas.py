@@ -79,7 +79,12 @@ def _strict_lower(k: int):
 
 
 @functools.lru_cache(maxsize=16)
-def _build(rows: int, R: int, dtype_name: str, interpret: bool):
+def _build(rows: int, R: int, dtype_name: str, interpret: bool,
+           vpu: bool = False):
+    """``vpu=True`` swaps the two MXU matmuls for log-step cumsums on
+    the vector unit — same math, different unit; which wins on a given
+    chip generation is an empirical question (DR_TPU_SCAN_KERNEL=vpu to
+    select, tools/tune_tpu.py to measure)."""
     dtype = jnp.dtype(dtype_name)
     nch = rows // R
 
@@ -113,17 +118,26 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool):
             out_dma(i - 2, slot).wait()
 
         x = vin[slot].astype(jnp.float32)
-        # lane prefix within each 128-wide row (MXU)
-        P1 = lax.dot_general(x, u_ref[:], (((1,), (0,)), ((), ())),
-                             precision=lax.Precision.HIGHEST,
-                             preferred_element_type=jnp.float32)
-        row_tot = P1[:, LANES - 1:LANES]              # (R, 1)
-        # exclusive row offsets on the SUBLANE axis: one (R, R)
-        # strictly-lower matmul — no cross-layout reshapes
-        excl_rows = lax.dot_general(
-            lo_ref[:], row_tot, (((1,), (0,)), ((), ())),
-            precision=lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)       # (R, 1)
+        if vpu:
+            # log-step shifted adds on the vector unit; the f32 HIGHEST
+            # matmuls cost 6 MXU passes each, which can exceed the DMA
+            # floor — the VPU does the same prefix in ~7+9 vector steps
+            P1 = jnp.cumsum(x, axis=1)
+            row_tot = P1[:, LANES - 1:LANES]          # (R, 1)
+            incl_rows = jnp.cumsum(row_tot, axis=0)   # (R, 1)
+            excl_rows = incl_rows - row_tot
+        else:
+            # lane prefix within each 128-wide row (MXU)
+            P1 = lax.dot_general(x, u_ref[:], (((1,), (0,)), ((), ())),
+                                 precision=lax.Precision.HIGHEST,
+                                 preferred_element_type=jnp.float32)
+            row_tot = P1[:, LANES - 1:LANES]          # (R, 1)
+            # exclusive row offsets on the SUBLANE axis: one (R, R)
+            # strictly-lower matmul — no cross-layout reshapes
+            excl_rows = lax.dot_general(
+                lo_ref[:], row_tot, (((1,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)   # (R, 1)
         out = P1 + excl_rows + carry[0, 0]
         carry[0, 0] = (carry[0, 0] + excl_rows[R - 1, 0]
                        + row_tot[R - 1, 0])
@@ -167,12 +181,22 @@ def chunked_cumsum(x, *, interpret: bool = False):
     """Inclusive add-scan of a 1-D float array in ONE HBM pass.
 
     Requires ``pick_chunk(len(x))`` to succeed (lane-blocked chunking);
-    callers fall back to the XLA matmul-cumsum otherwise."""
+    callers fall back to the XLA matmul-cumsum otherwise.
+    ``DR_TPU_SCAN_KERNEL=vpu`` selects the cumsum (vector-unit)
+    variant of the in-chunk prefix; default is the MXU matmul form."""
+    import os
     n = x.shape[0]
     R = pick_chunk(n)
     assert R is not None, "no lane-aligned chunking for this length"
     rows = n // LANES
-    fn = _build(rows, R, str(x.dtype), interpret)
-    U = jnp.asarray(prefix_matrix(LANES), jnp.float32)
-    L = jnp.asarray(_strict_lower(R), jnp.float32)
+    vpu = os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower() == "vpu"
+    fn = _build(rows, R, str(x.dtype), interpret, vpu)
+    if vpu:
+        # the vpu kernel never reads the matmul operands: ship 1x1
+        # dummies instead of the (128,128)+(R,R) matrices (the whole
+        # point of the variant is minimal VMEM/HBM traffic)
+        U = L = jnp.zeros((1, 1), jnp.float32)
+    else:
+        U = jnp.asarray(prefix_matrix(LANES), jnp.float32)
+        L = jnp.asarray(_strict_lower(R), jnp.float32)
     return fn(U, L, x.reshape(rows, LANES)).reshape(n)

@@ -132,17 +132,22 @@ def _apply(src, W, segc, D=1):
     return out
 
 
-# fused-apply chunk cap (lane columns per DMA chunk): overridable for
-# on-device tuning — the grid's per-step overhead amortizes with larger
-# chunks until VMEM pressure pushes back
-_CHUNK_CAP = int(os.environ.get("DR_TPU_MM_CHUNK_CAP", "4096"))
+def _chunk_cap() -> int:
+    """Fused-apply chunk cap (lane columns per DMA chunk): overridable
+    per call via DR_TPU_MM_CHUNK_CAP for on-device tuning — the grid's
+    per-step overhead amortizes with larger chunks until VMEM pressure
+    pushes back.  Rounded down to a power of two: _pick_chunk_rows
+    halves the cap looking for a divisor, so a non-2^k cap would
+    silently collapse the chunk size to ~1."""
+    v = max(1, int(os.environ.get("DR_TPU_MM_CHUNK_CAP", "4096")))
+    return 1 << (v.bit_length() - 1)
 
 
 def _pick_chunk_rows(segc: int, cap: int = None):
     """Largest power-of-two chunk <= cap dividing the owned columns
     (always exists: 1 divides everything; large segments get large,
     DMA-efficient chunks)."""
-    cr = _CHUNK_CAP if cap is None else cap
+    cr = _chunk_cap() if cap is None else cap
     while cr > 1:
         if segc % cr == 0:
             return cr

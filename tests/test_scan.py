@@ -182,3 +182,16 @@ def test_chunked_cumsum_kernel_bf16_interpret():
     ref = np.cumsum(np.asarray(x.astype(jnp.float32), np.float64))
     # bf16 storage rounds each output; tolerance reflects that
     np.testing.assert_allclose(got, ref, rtol=2e-2, atol=1.0)
+
+
+def test_chunked_cumsum_vpu_variant_interpret(monkeypatch):
+    """The vector-unit in-chunk prefix (DR_TPU_SCAN_KERNEL=vpu) matches
+    the MXU form and numpy."""
+    from dr_tpu.ops import scan_pallas
+    monkeypatch.setenv("DR_TPU_SCAN_KERNEL", "vpu")
+    rng = np.random.default_rng(8)
+    n = 128 * 128 * 4
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    got = np.asarray(scan_pallas.chunked_cumsum(x, interpret=True))
+    ref = np.cumsum(np.asarray(x, np.float64))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-3)

@@ -37,8 +37,6 @@ def _marginal(run_sync, r1=2, r2=10, samples=5):
 def tune_stencil():
     """Sweep the fused-apply chunk cap and band width on the headline
     geometry (n = 2^29, f32)."""
-    import functools
-
     import jax
     import jax.numpy as jnp
 
@@ -51,9 +49,7 @@ def tune_stencil():
         row = jnp.zeros((1, 2 * halo + seg), jnp.float32) + 0.5
         GB = seg * 4 * 2 / 1e9
         for cap in (4096, 8192, 16384):
-            sm._pallas_apply.cache_clear()
-            orig = sm._pick_chunk_rows
-            sm._pick_chunk_rows = functools.partial(orig, cap=cap)
+            os.environ["DR_TPU_MM_CHUNK_CAP"] = str(cap)
             try:
                 @jax.jit
                 def run(row, r, salt):
@@ -77,8 +73,7 @@ def tune_stencil():
             except Exception as e:
                 print(f"stencil k={k} cap={cap}: FAIL "
                       f"{str(e).splitlines()[0][:90]}", flush=True)
-            finally:
-                sm._pick_chunk_rows = orig
+    os.environ.pop("DR_TPU_MM_CHUNK_CAP", None)
 
 
 def tune_scan():
@@ -91,24 +86,35 @@ def tune_scan():
     x = jnp.ones((n,), jnp.float32)
     print("pick_chunk:", scan_pallas.pick_chunk(n), flush=True)
 
-    @jax.jit
-    def run(x, r, salt):
-        x = x.at[0].add(salt * 1e-9)
+    for variant in ("mxu", "vpu"):
+        if variant == "vpu":
+            os.environ["DR_TPU_SCAN_KERNEL"] = "vpu"
+        else:
+            os.environ.pop("DR_TPU_SCAN_KERNEL", None)
 
-        def body(i, acc):
-            return scan_pallas.chunked_cumsum(acc) * jnp.asarray(
-                1e-9, acc.dtype)
-        out = jax.lax.fori_loop(0, r, body, x)
-        return out[n // 2]
+        @jax.jit
+        def run(x, r, salt):
+            x = x.at[0].add(salt * 1e-9)
 
-    s = [0]
+            def body(i, acc):
+                return scan_pallas.chunked_cumsum(acc) * jnp.asarray(
+                    1e-9, acc.dtype)
+            out = jax.lax.fori_loop(0, r, body, x)
+            return out[n // 2]
 
-    def sync(r):
-        s[0] += 1
-        return float(run(x, r, s[0]))
-    dt = _marginal(sync)
-    print(f"scan kernel: {dt * 1e3:.3f} ms -> {2 * n * 4 / dt / 1e9:.1f} "
-          f"GB/s", flush=True)
+        s = [0]
+
+        def sync(r):
+            s[0] += 1
+            return float(run(x, r, s[0]))
+        try:
+            dt = _marginal(sync)
+            print(f"scan kernel [{variant}]: {dt * 1e3:.3f} ms -> "
+                  f"{2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
+        except Exception as e:
+            print(f"scan kernel [{variant}]: FAIL "
+                  f"{str(e).splitlines()[0][:90]}", flush=True)
+    os.environ.pop("DR_TPU_SCAN_KERNEL", None)
 
 
 def tune_container(name):
@@ -154,9 +160,20 @@ def tune_container(name):
         def run(r):
             res = dr_tpu.ring_attention_n(q, k, v, r, causal=True)
             float(res[0, 0, 0, 0].astype(jnp.float32))
-        dt = _marginal(run, 2, 18)
         fl = 2.0 * B * h * S * S * hd
-        print(f"ring attn: {fl / dt / 1e12:.1f} TFLOP/s", flush=True)
+        for bq, bk in ((2048, 1024), (1024, 1024), (2048, 512),
+                       (512, 512), (1024, 2048)):
+            os.environ["DR_TPU_FLASH_BQ"] = str(bq)
+            os.environ["DR_TPU_FLASH_BK"] = str(bk)
+            try:
+                dt = _marginal(run, 2, 18)
+                print(f"ring attn bq={bq} bk={bk}: "
+                      f"{fl / dt / 1e12:.1f} TFLOP/s", flush=True)
+            except Exception as e:
+                print(f"ring attn bq={bq} bk={bk}: FAIL "
+                      f"{str(e).splitlines()[0][:90]}", flush=True)
+        os.environ.pop("DR_TPU_FLASH_BQ", None)
+        os.environ.pop("DR_TPU_FLASH_BK", None)
     elif name == "spmv":
         m, half = 2 ** 15, 128
         rng = np.random.default_rng(1)
