@@ -495,9 +495,28 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
     return out
 
 
+def _devices_or_die(timeout_s: float):
+    """First backend touch via runtime.probe_devices: a recorded failure
+    line beats the eternal hang a wedged tunnel relay produces."""
+    from dr_tpu.parallel.runtime import probe_devices
+
+    devs, err = probe_devices(timeout_s)
+    if devs is not None:
+        return devs
+    print(json.dumps({
+        "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
+        "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
+        "detail": {"error": err},
+    }))
+    sys.stdout.flush()
+    os._exit(1)
+
+
 def main():
     n = int(os.environ.get("DR_TPU_BENCH_N", str(2 ** 30)))
 
+    _devices_or_die(float(os.environ.get("DR_TPU_BENCH_INIT_TIMEOUT",
+                                         "900")))
     import jax
     import dr_tpu
     from dr_tpu.ops import stencil_pallas

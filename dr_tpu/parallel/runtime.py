@@ -38,6 +38,7 @@ __all__ = [
     "mesh",
     "barrier",
     "fence",
+    "probe_devices",
     "Runtime",
     "get_duplicated_devices",
 ]
@@ -119,6 +120,35 @@ class Runtime:
 
 
 _runtime: Optional[Runtime] = None
+
+
+def probe_devices(timeout_s: float):
+    """First backend touch behind a watchdog thread: ``(devices, None)``
+    on success, ``(None, error_repr_or_timeout_message)`` otherwise.
+
+    A wedged tunnel relay makes ``jax.devices()`` block forever inside
+    the PJRT client (observed when an earlier client died mid-claim and
+    the chip's server-side grant had not expired).  Callers decide the
+    policy — fail fast, record an error artifact, or fall back to a
+    virtual mesh; this helper only guarantees the probe terminates."""
+    import threading
+
+    box = {}
+
+    def probe():
+        try:
+            box["devices"] = jax.devices()
+        except Exception as e:  # pragma: no cover - backend specific
+            box["error"] = repr(e)[:200]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in box:
+        return box["devices"], None
+    return None, box.get(
+        "error", f"device init exceeded {timeout_s:.0f}s "
+        "(wedged tunnel relay?)")
 
 
 def get_duplicated_devices(n: int, devices: Optional[Sequence] = None):
