@@ -33,6 +33,7 @@ block).  Reference workload: ``examples/mhp/stencil-1d.cpp:47-66``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Sequence
 
 import jax
@@ -53,9 +54,14 @@ def composed_taps(weights: Sequence[float], k: int) -> np.ndarray:
     return c
 
 
-def max_ksteps(radius: int, ncols: int = 2) -> int:
+def max_ksteps(radius: int, ncols: int | None = None) -> int:
     """Largest supported composable block: the band half-width ``k*r``
-    may span up to ``ncols`` lane columns each side (D <= ncols)."""
+    may span up to ``ncols`` lane columns each side (D <= ncols;
+    default 2, DR_TPU_MM_BAND_COLS overrides for on-device tuning —
+    with the 3-pass HIGH-emulated apply the MXU stays under the DMA
+    floor up to about 4 columns)."""
+    if ncols is None:
+        ncols = int(os.environ.get("DR_TPU_MM_BAND_COLS", "2"))
     return ncols * LANES // radius
 
 
@@ -91,8 +97,6 @@ def _operator(weights: tuple, k: int, dtype_name: str):
     return np.ascontiguousarray(W.T).astype(dtype_name)  # (128, (2D+1)*128)
 
 
-import os
-
 # matmul precision for the composed-operator apply.  HIGH (bf16x3 passes,
 # f32 accumulate) measures within noise of DEFAULT and ~12% faster than
 # HIGHEST, with composed-apply error ~1e-5 absolute over 128 steps
@@ -105,11 +109,46 @@ _PRECISION = {
 }[os.environ.get("DR_TPU_MM_PRECISION", "high").strip().lower()]
 
 # Mosaic (the Pallas TPU compiler) accepts only DEFAULT and HIGHEST dot
-# precisions; HIGH exists only at the XLA level.  The fused kernel is
-# HBM-bound (that is its whole point), so promoting HIGH to HIGHEST
-# inside the kernel costs no wall-clock and only gains accuracy.
+# precisions; HIGH exists only at the XLA level.  For f32 the kernel
+# emulates HIGH itself (_dot_high_f32: bf16 hi/lo split, three DEFAULT
+# dots with f32 accumulation — the same passes XLA's HIGH runs), which
+# costs 3 MXU passes instead of HIGHEST's 6 and keeps the fused apply
+# DMA-bound at wide bands.  Explicit DEFAULT/HIGHEST pass through.
 _KERNEL_PRECISION = (jax.lax.Precision.HIGHEST
                      if _PRECISION == jax.lax.Precision.HIGH else _PRECISION)
+
+
+def _bf16_split(x):
+    """(hi, lo) bf16 parts of an f32 array: hi + lo reconstructs x to
+    ~16 mantissa bits."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _dot_default(x, y):
+    return jax.lax.dot_general(
+        x, y, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.DEFAULT,
+        preferred_element_type=jnp.float32)
+
+
+def _dot_high_f32(a, b):
+    """bf16x3 emulation of Precision.HIGH for f32 operands: split each
+    into a bf16 hi part and a bf16 residual, accumulate the three
+    significant cross terms in f32 on the MXU (hi*hi + hi*lo + lo*hi;
+    lo*lo is below f32 rounding, exactly as XLA's HIGH drops it)."""
+    a_hi, a_lo = _bf16_split(a)
+    b_hi, b_lo = _bf16_split(b)
+    return (_dot_default(a_hi, b_hi) + _dot_default(a_hi, b_lo)
+            + _dot_default(a_lo, b_hi))
+
+
+def _emulate_high(dtype) -> bool:
+    """The fused kernel emulates HIGH itself for f32 data (3 DEFAULT
+    MXU passes vs HIGHEST's 6)."""
+    return (_PRECISION == jax.lax.Precision.HIGH
+            and jnp.dtype(dtype) == jnp.dtype(jnp.float32))
 
 # rows per matmul chunk: bounds the (chunk, 384) product intermediate so
 # billion-element rows don't triple HBM residency
@@ -143,7 +182,7 @@ def _chunk_cap() -> int:
     return 1 << (v.bit_length() - 1)
 
 
-def _pick_chunk_rows(segc: int, cap: int = None):
+def _pick_chunk_rows(segc: int, cap: int | None = None):
     """Largest power-of-two chunk <= cap dividing the owned columns
     (always exists: 1 divides everything; large segments get large,
     DMA-efficient chunks)."""
@@ -169,17 +208,19 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
     race chunk i's output write against chunk i+1's ghost-row prefetch
     at every chunk boundary — and the ghost columns pass through via
     two explicit side DMAs.  (The kernel body never uses the stencil
-    weights; they arrive as the W operand, so geometry alone keys the
-    compile cache.)"""
+    weights; they arrive as the two W operands — pre-split bf16 halves
+    under HIGH emulation, (W, dummy) otherwise — so geometry alone keys
+    the compile cache.)"""
     from jax.experimental import pallas as pl
     from .stencil_pallas import pltpu
 
     dtype = jnp.dtype(dtype_name)
+    emul = _emulate_high(dtype)
     nch = segc // cr
     wrows = cr + 2 * D  # D ghost lane-columns each side
 
-    def kernel(w_ref, row_hbm, out_hbm, vin, vout, vghost, in_sem,
-               out_sem, ghost_sem):
+    def kernel(w_ref, w2_ref, row_hbm, out_hbm, vin, vout, vghost,
+               in_sem, out_sem, ghost_sem):
         i = pl.program_id(0)
         slot = jax.lax.rem(i, 2)
 
@@ -225,10 +266,19 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
             out_dma(i - 2, slot).wait()
 
         src = vin[slot]
-        P = jax.lax.dot_general(
-            src, w_ref[:], (((1,), (0,)), ((), ())),
-            precision=_KERNEL_PRECISION,
-            preferred_element_type=jnp.promote_types(dtype, jnp.float32))
+        if emul:
+            # HIGH emulation: W arrives pre-split (hoisted out of the
+            # grid loop); only the streaming chunk is split per step
+            s_hi, s_lo = _bf16_split(src)
+            P = (_dot_default(s_hi, w_ref[:])
+                 + _dot_default(s_hi, w2_ref[:])
+                 + _dot_default(s_lo, w_ref[:]))
+        else:
+            P = jax.lax.dot_general(
+                src, w_ref[:], (((1,), (0,)), ((), ())),
+                precision=_KERNEL_PRECISION,
+                preferred_element_type=jnp.promote_types(
+                    dtype, jnp.float32))
         out = P[0:cr, 0:LANES]
         for b in range(1, 2 * D + 1):
             out = out + P[b:cr + b, b * LANES:(b + 1) * LANES]
@@ -254,6 +304,7 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
         kernel,
         grid=(nch,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct((nrows, LANES), dtype),
@@ -300,7 +351,11 @@ def matmul_stencil_row(row, seg: int, halo: int, weights: Sequence[float],
         cr = _pick_chunk_rows(segc)
         fn = _pallas_apply(width // LANES, hc, segc, cr, str(dtype), D,
                            interpret=impl == "pallas_interpret")
-        return fn(W, R).reshape(row.shape)
+        if _emulate_high(dtype):
+            W1, W2 = _bf16_split(W)  # hoisted: constant under the grid
+        else:
+            W1, W2 = W, jnp.zeros((1, 1), W.dtype)
+        return fn(W1, W2, R).reshape(row.shape)
     cr = _CHUNK_ROWS
     if segc <= cr:
         out = _apply(R[hc - D: hc + segc + D], W, segc, D)

@@ -84,7 +84,9 @@ def test_pallas_apply_matches_xla_interpret():
     ref = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k))
     got = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k,
                                            impl="pallas_interpret"))
-    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # the kernel emulates HIGH via bf16x3 (~5e-6 scaled error); the
+    # XLA reference on CPU computes full f32
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
 def test_pick_chunk_rows():
@@ -127,7 +129,7 @@ def test_pallas_apply_wide_band_interpret():
     ref = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k))
     got = np.asarray(sm.matmul_stencil_row(row, seg, halo, w, k,
                                            impl="pallas_interpret"))
-    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
 def test_wide_band_chunked_paths():
@@ -153,7 +155,7 @@ def test_wide_band_chunked_paths():
             row, seg, halo, w, k, impl="pallas_interpret"))
     finally:
         sm._pick_chunk_rows = orig_pick
-    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
     # XLA chunked path with a 3-row chunk -> nch=2 plus remainder 2
     orig_rows = sm._CHUNK_ROWS
@@ -163,3 +165,35 @@ def test_wide_band_chunked_paths():
     finally:
         sm._CHUNK_ROWS = orig_rows
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dot_high_f32_emulation_accuracy():
+    """The in-kernel bf16x3 HIGH emulation tracks the f64 product to
+    ~f32 precision (far beyond one bf16 pass)."""
+    import jax.numpy as jnp
+    from dr_tpu.ops.stencil_matmul import _dot_high_f32
+
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((64, 128)).astype(np.float32)
+    b = rng.standard_normal((128, 384)).astype(np.float32)
+    ref = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    got = np.asarray(_dot_high_f32(jnp.asarray(a), jnp.asarray(b)))
+    # scaled max error: one DEFAULT bf16 pass lands ~3e-3 on this
+    # shape; the 3-pass emulation must land ~5e-6 like true HIGH
+    err = np.abs(got - ref).max() / np.abs(ref).max()
+    assert err < 5e-5, err
+
+
+def test_matmul_stencil_band_cols_4(monkeypatch):
+    """D=4 (k*r spanning four lane columns) via DR_TPU_MM_BAND_COLS."""
+    monkeypatch.setenv("DR_TPU_MM_BAND_COLS", "4")
+    n = dr_tpu.nprocs() * 1024
+    rng = np.random.default_rng(17)
+    src = rng.standard_normal(n).astype(np.float32)
+    w = [0.05, 0.25, 0.4, 0.25, 0.05]  # radius 2, k=256 -> D=4
+    hb = dr_tpu.halo_bounds(512, 512, periodic=True)
+    a = dr_tpu.distributed_vector.from_array(src, halo=hb)
+    out = stencil_iterate_matmul(a, w, 256, k_block=256)
+    ref = _serial_stencil(src, w, 256)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref,
+                               rtol=2e-4, atol=2e-5)

@@ -44,7 +44,7 @@ def tune_stencil():
 
     n = 2 ** 29
     w = (0.05, 0.25, 0.4, 0.25, 0.05)  # radius 2
-    for k, halo in ((64, 128), (128, 256)):
+    for k, halo in ((64, 128), (128, 256), (256, 512)):
         seg = n
         row = jnp.zeros((1, 2 * halo + seg), jnp.float32) + 0.5
         GB = seg * 4 * 2 / 1e9
