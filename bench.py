@@ -242,13 +242,17 @@ def _marginal(run_sync, r1=4, r2=36, samples=5, min_spread=0.3, rmax=4096):
         if r2w > r2:
             run_sync(r2w)  # compile + warm the widened loop
             dt = once(r1, r2w)
-    if dt <= 0:
-        # even the widened spread was noise: report the failure (the
-        # caller's except records an error string) instead of printing a
-        # negative rate into the benchmark JSON.  _JitterError so the
-        # kernel-fallback wrapper does not misread it as a kernel bug.
+            r2 = r2w
+    if dt <= 0 or (r2 - r1) * dt < min_spread / 10.0:
+        # even the widened spread stayed an order of magnitude under the
+        # jitter-proof threshold: the number is noise (possibly negative
+        # or absurdly small-positive).  Report the failure (the caller's
+        # except records an error string) instead of printing it into
+        # the benchmark JSON.  _JitterError so the kernel-fallback
+        # wrapper does not misread it as a kernel bug.
         raise _JitterError("marginal measurement drowned in dispatch "
-                           f"jitter (dt={dt:.3e} s/op)")
+                           f"jitter (dt={dt:.3e} s/op over "
+                           f"{r2 - r1} ops)")
     return dt
 
 

@@ -61,7 +61,7 @@ def max_ksteps(radius: int, ncols: int | None = None) -> int:
     with the 3-pass HIGH-emulated apply the MXU stays under the DMA
     floor up to about 4 columns)."""
     if ncols is None:
-        ncols = int(os.environ.get("DR_TPU_MM_BAND_COLS", "2"))
+        ncols = max(1, int(os.environ.get("DR_TPU_MM_BAND_COLS", "2")))
     return ncols * LANES // radius
 
 
@@ -133,15 +133,22 @@ def _dot_default(x, y):
         preferred_element_type=jnp.float32)
 
 
-def _dot_high_f32(a, b):
-    """bf16x3 emulation of Precision.HIGH for f32 operands: split each
-    into a bf16 hi part and a bf16 residual, accumulate the three
-    significant cross terms in f32 on the MXU (hi*hi + hi*lo + lo*hi;
-    lo*lo is below f32 rounding, exactly as XLA's HIGH drops it)."""
-    a_hi, a_lo = _bf16_split(a)
-    b_hi, b_lo = _bf16_split(b)
+def _dot_high_split(a_hi, a_lo, b_hi, b_lo):
+    """The three significant bf16 cross terms accumulated in f32 on the
+    MXU (hi*hi + hi*lo + lo*hi; lo*lo is below f32 rounding, exactly as
+    XLA's HIGH drops it).  Shared by :func:`_dot_high_f32` and the
+    fused kernel, so the accuracy test covers the shipped math."""
     return (_dot_default(a_hi, b_hi) + _dot_default(a_hi, b_lo)
             + _dot_default(a_lo, b_hi))
+
+
+def _dot_high_f32(a, b):
+    """bf16x3 emulation of Precision.HIGH for f32 operands: split each
+    into a bf16 hi part and a bf16 residual, then
+    :func:`_dot_high_split`."""
+    a_hi, a_lo = _bf16_split(a)
+    b_hi, b_lo = _bf16_split(b)
+    return _dot_high_split(a_hi, a_lo, b_hi, b_lo)
 
 
 def _emulate_high(dtype) -> bool:
@@ -270,9 +277,7 @@ def _pallas_apply(nrows: int, hc: int, segc: int, cr: int,
             # HIGH emulation: W arrives pre-split (hoisted out of the
             # grid loop); only the streaming chunk is split per step
             s_hi, s_lo = _bf16_split(src)
-            P = (_dot_default(s_hi, w_ref[:])
-                 + _dot_default(s_hi, w2_ref[:])
-                 + _dot_default(s_lo, w_ref[:]))
+            P = _dot_high_split(s_hi, s_lo, w_ref[:], w2_ref[:])
         else:
             P = jax.lax.dot_general(
                 src, w_ref[:], (((1,), (0,)), ((), ())),

@@ -1,0 +1,94 @@
+"""Integration coverage through the examples' composition layer (the
+reference ships its examples as tests too: test/gtest reuses the same
+workloads its examples/ demonstrate)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import dr_tpu
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples"))
+
+
+def test_conjugate_gradient_converges():
+    """CG composes gemv + dot + fused zip|transform: the solution must
+    match the dense solve (SPD Laplacian system)."""
+    from conjugate_gradient import build_laplacian, cg
+
+    n = 256
+    ii, jj, vv = build_laplacian(n)
+    A = dr_tpu.sparse_matrix.from_coo((n, n), ii, jj, vv)
+    rng = np.random.default_rng(1)
+    b = rng.standard_normal(n).astype(np.float32)
+    x, resid, its = cg(A, b, iters=100)
+    assert resid < 1e-3 and its < 60
+    Ad = np.zeros((n, n), dtype=np.float64)
+    Ad[ii, jj] = vv
+    ref = np.linalg.solve(Ad, b.astype(np.float64))
+    np.testing.assert_allclose(dr_tpu.to_numpy(x), ref,
+                               rtol=1e-3, atol=1e-3)
+
+
+def _load_bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeOp:
+    """run_sync stub: deterministic per-op cost, records loop counts."""
+
+    def __init__(self, per_op, constant=0.0):
+        self.per_op = per_op
+        self.constant = constant
+        self.calls = []
+        self.clock = [0.0]
+
+    def __call__(self, r):
+        self.calls.append(r)
+        self.clock[0] += self.constant + self.per_op * r
+
+
+def test_marginal_widens_fast_ops(monkeypatch):
+    """An op far below the spread threshold must widen its loop count
+    instead of reporting noise."""
+    bench = _load_bench()
+    op = _FakeOp(per_op=1e-4)
+    monkeypatch.setattr(bench.time, "perf_counter",
+                        lambda: op.clock[0])
+    dt = bench._marginal(op, r1=4, r2=36, samples=3, min_spread=0.3,
+                         rmax=4096)
+    assert dt == pytest.approx(1e-4, rel=1e-6)
+    assert max(op.calls) > 36  # widened beyond the pilot loop count
+
+
+def test_marginal_raises_on_pure_noise(monkeypatch):
+    """Zero marginal cost (measurement drowned) raises the typed error
+    instead of returning a non-positive rate."""
+    bench = _load_bench()
+    op = _FakeOp(per_op=0.0, constant=0.01)
+    monkeypatch.setattr(bench.time, "perf_counter",
+                        lambda: op.clock[0])
+    with pytest.raises(bench._JitterError):
+        bench._marginal(op, r1=4, r2=36, samples=3, min_spread=0.3,
+                        rmax=4096)
+
+
+def test_marginal_fast_path_no_widening(monkeypatch):
+    """An op already above the spread threshold keeps the pilot count
+    (no extra compile)."""
+    bench = _load_bench()
+    op = _FakeOp(per_op=0.05)
+    monkeypatch.setattr(bench.time, "perf_counter",
+                        lambda: op.clock[0])
+    dt = bench._marginal(op, r1=4, r2=36, samples=3, min_spread=0.3,
+                         rmax=4096)
+    assert dt == pytest.approx(0.05, rel=1e-6)
+    assert max(op.calls) == 36

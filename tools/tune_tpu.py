@@ -72,7 +72,7 @@ def tune_stencil():
                       f"eff {GB * k / dt / 2:.0f} GB/s", flush=True)
             except Exception as e:
                 print(f"stencil k={k} cap={cap}: FAIL "
-                      f"{str(e).splitlines()[0][:90]}", flush=True)
+                      f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
     os.environ.pop("DR_TPU_MM_CHUNK_CAP", None)
 
 
@@ -113,7 +113,7 @@ def tune_scan():
                   f"{2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
         except Exception as e:
             print(f"scan kernel [{variant}]: FAIL "
-                  f"{str(e).splitlines()[0][:90]}", flush=True)
+                  f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
     os.environ.pop("DR_TPU_SCAN_KERNEL", None)
 
 
@@ -144,12 +144,18 @@ def tune_container(name):
         def _sync(c):
             return float(c._data.addressable_shards[0].data.reshape(-1)[0])
 
-        def run(r):
-            dr_tpu.stencil2d_n(M, w, r, time_block=16)
-            _sync(M)
-        dt = _marginal(run, 2, 10)
-        print(f"heat2d: {2.0 * m * m * 4 * 16 / dt / 1e9:.1f} GB/s eff",
-              flush=True)
+        for tb in (8, 16, 32, 64):
+            def run(r):
+                dr_tpu.stencil2d_n(M, w, r, time_block=tb)
+                _sync(M)
+            try:
+                dt = _marginal(run, 2, 10)
+                print(f"heat2d tb={tb}: "
+                      f"{2.0 * m * m * 4 * tb / dt / 1e9:.1f} GB/s eff",
+                      flush=True)
+            except Exception as e:
+                print(f"heat2d tb={tb}: FAIL "
+                      f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
     elif name == "attn":
         B, S, h, hd = 1, 8192, 8, 128
         rng = np.random.default_rng(0)
@@ -171,7 +177,7 @@ def tune_container(name):
                       f"{fl / dt / 1e12:.1f} TFLOP/s", flush=True)
             except Exception as e:
                 print(f"ring attn bq={bq} bk={bk}: FAIL "
-                      f"{str(e).splitlines()[0][:90]}", flush=True)
+                      f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
         os.environ.pop("DR_TPU_FLASH_BQ", None)
         os.environ.pop("DR_TPU_FLASH_BK", None)
     elif name == "spmv":
