@@ -500,17 +500,39 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
 
 
 def _devices_or_die(timeout_s: float):
-    """First backend touch via runtime.probe_devices: a recorded failure
-    line beats the eternal hang a wedged tunnel relay produces."""
+    """First backend touch via runtime.probe_devices: a recorded result
+    beats the eternal hang a wedged tunnel relay produces.
+
+    On probe failure, re-exec once with the CPU platform forced — an
+    honest smoke number with ``detail.device = cpu`` and
+    ``detail.degraded`` naming the cause still beats a zero.  The child
+    sets the platform before backend init, so its probe returns
+    immediately; if even that fails, record the error and exit."""
     from dr_tpu.parallel.runtime import probe_devices
 
+    if os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     devs, err = probe_devices(timeout_s)
     if devs is not None:
         return devs
+    if not os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
+        print(f"device init failed ({err}); re-running on CPU",
+              file=sys.stderr)
+        env = dict(os.environ)
+        env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
+        env["_DR_TPU_BENCH_DEGRADED"] = err
+        env["JAX_PLATFORMS"] = "cpu"
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
+    detail = {"error": err}
+    if os.environ.get("_DR_TPU_BENCH_DEGRADED"):
+        # keep the original TPU-side cause alongside the child's error
+        detail["degraded"] = os.environ["_DR_TPU_BENCH_DEGRADED"]
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
         "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
-        "detail": {"error": err},
+        "detail": detail,
     }))
     sys.stdout.flush()
     os._exit(1)
@@ -586,6 +608,8 @@ def main():
             "device": str(dev), "peak_hbm_gbps": peak,
             "phys_gbps": round(res["phys_gbps"] / nchips, 2),
             "target_gbps": round(target, 1),
+            **({"degraded": os.environ["_DR_TPU_BENCH_DEGRADED"]}
+               if os.environ.get("_DR_TPU_BENCH_DEGRADED") else {}),
             **secondary,
         },
     }))

@@ -103,21 +103,26 @@ _prog_cache: dict = {}
 
 
 def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
-                    alias_mask=()):
+                    alias_mask=(), nscalars=0):
     """Cached program: out_data <- masked-window write of
     op(chains(in_data...)) over padded shard arrays.  ``alias_mask[i]``
     marks inputs that ARE the output container (in-place for_each): they
-    read the donated buffer instead of being passed twice."""
+    read the donated buffer instead of being passed twice.  The last
+    ``nscalars`` program arguments are TRACED scalars appended to the
+    op's arguments — per-call values (a CG loop's alpha/beta) reuse one
+    compiled program instead of baking each closure into a new one."""
     cont = out_chain.cont
     off, n = out_chain.off, out_chain.n
     key = ("ew", cont.layout, off, n, in_keys,
            tuple(tuple(_op_key(o) for o in ops) for ops in in_ops),
-           _op_key(op), with_index, alias_mask, str(cont.dtype))
+           _op_key(op), with_index, alias_mask, nscalars, str(cont.dtype))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
 
-    def body(out_data, *extra_datas):
+    def body(out_data, *rest):
+        extra_datas = rest[:len(rest) - nscalars]
+        scalars = rest[len(rest) - nscalars:]
         it = iter(extra_datas)
         in_datas = [out_data if aliased else next(it)
                     for aliased in alias_mask] if alias_mask else []
@@ -129,10 +134,11 @@ def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
             vals_in.append(v)
         # global index of every padded cell (halo/pad cells -> out of window)
         mask, gid = owned_window_mask(cont.layout, off, n)
+        args = (list(vals_in) + list(scalars))
         if with_index:
-            vals = op(gid, *vals_in) if vals_in else op(gid)
+            vals = op(gid, *args) if args else op(gid)
         else:
-            vals = op(*vals_in) if vals_in else op()
+            vals = op(*args) if args else op()
         vals = jnp.broadcast_to(vals, out_data.shape).astype(out_data.dtype)
         return jnp.where(mask, vals, out_data)
 
@@ -146,16 +152,20 @@ builtin_enumerate = enumerate
 
 
 def _run_fused(ins: Tuple[_Chain, ...], out_chain: _Chain, op,
-               with_index=False) -> None:
+               with_index=False, scalars=()) -> None:
     out_cont = out_chain.cont
     alias_mask = tuple(c.cont is out_cont for c in ins)
     prog = _window_program(
         out_chain,
         tuple(c.cont.layout for c in ins),
         tuple(c.ops for c in ins),
-        op, with_index, alias_mask)
+        op, with_index, alias_mask, len(scalars))
     extra = [c.cont._data for c in ins if c.cont is not out_cont]
-    out_cont._data = prog(out_cont._data, *extra)
+    # scalars keep their own (weak) dtype so the op computes in the same
+    # promoted type as the fallback path; the window write casts to the
+    # container dtype either way
+    svals = [jnp.asarray(s) for s in scalars]
+    out_cont._data = prog(out_cont._data, *extra, *svals)
 
 
 def _write_window(out_chain: _Chain, values) -> None:
@@ -219,10 +229,14 @@ def iota(r, start=0) -> None:
                           jnp.asarray(start - out.off))
 
 
-def transform(in_r, out, op: Callable) -> None:
+def transform(in_r, out, op: Callable, *scalars) -> None:
     """Collective transform (cpu_algorithms.hpp:148-167).  ``op`` is a
     jax-traceable elementwise callable; over a zip input it receives one
-    argument per component."""
+    argument per component.  Trailing ``*scalars`` are appended to the
+    op's arguments as TRACED values: pass loop-varying coefficients
+    (a CG iteration's alpha/beta) here — with a module-level ``op`` the
+    compiled program is reused across calls, where a fresh closure per
+    value would compile (and pin) a new program every iteration."""
     out_chain = _out_chain(out)
     ins = _resolve(in_r)
     n = len(in_r)
@@ -234,11 +248,12 @@ def transform(in_r, out, op: Callable) -> None:
         out_chain = _Chain(out_chain.cont, out_chain.off, n,
                            out_chain.ops)
     if ins is not None and _fast_aligned(ins, out_chain):
-        _run_fused(ins, out_chain, op)
+        _run_fused(ins, out_chain, op, scalars=scalars)
         return
     # fallback: logical-array evaluation; XLA inserts the resharding
     arr = in_r.to_array() if hasattr(in_r, "to_array") else jnp.asarray(in_r)
-    vals = op(*arr) if isinstance(arr, tuple) else op(arr)
+    vals = op(*arr, *scalars) if isinstance(arr, tuple) \
+        else op(arr, *scalars)
     _write_window(out_chain, vals[:out_chain.n] if vals.shape[0] != out_chain.n
                   else vals)
 

@@ -30,6 +30,19 @@ def build_laplacian(n: int):
     return ii, jj, vv
 
 
+def _axpy(x, p, alpha):
+    return x + alpha * p
+
+
+def _axmy(r, ap, alpha):
+    return r - alpha * ap
+
+
+# Module-level ops + transform's trailing scalar arguments: the
+# coefficients are TRACED, so all iterations share ONE compiled program
+# per update.  (Closing over alpha/beta in per-iteration lambdas would
+# compile — and pin — a fresh program every iteration: the op identity
+# keys the program cache.)
 def cg(A, b, iters: int, tol: float = 1e-6):
     """Textbook CG over the distributed containers; returns (x, resid)."""
     import dr_tpu
@@ -48,16 +61,13 @@ def cg(A, b, iters: int, tol: float = 1e-6):
         dr_tpu.gemv(Ap, A, p)  # gemv ACCUMULATES (c += A·b), hence the fill
         alpha = rs / float(dr_tpu.dot(p, Ap))
         # x += alpha p ; r -= alpha Ap   (fused zip|transform programs)
-        dr_tpu.transform(dr_tpu.views.zip(x, p), x,
-                         lambda xi, pi: xi + alpha * pi)
-        dr_tpu.transform(dr_tpu.views.zip(r, Ap), r,
-                         lambda ri, ai: ri - alpha * ai)
+        dr_tpu.transform(dr_tpu.views.zip(x, p), x, _axpy, alpha)
+        dr_tpu.transform(dr_tpu.views.zip(r, Ap), r, _axmy, alpha)
         rs_new = float(dr_tpu.dot(r, r))
         if rs_new < tol * tol:
             return x, np.sqrt(rs_new), it + 1
         beta = rs_new / rs
-        dr_tpu.transform(dr_tpu.views.zip(r, p), p,
-                         lambda ri, pi: ri + beta * pi)
+        dr_tpu.transform(dr_tpu.views.zip(r, p), p, _axpy, beta)
         rs = rs_new
     return x, np.sqrt(rs), iters
 

@@ -204,3 +204,24 @@ def test_profiling_device_timer_and_annotate():
     assert np.isfinite(dt)
     with profiling.annotate("dot"):
         float(dot_n(a, b, 1))
+
+
+def test_transform_scalar_args_reuse_program():
+    """Trailing transform scalars are traced: two calls with different
+    values share ONE cached program (the CG-loop pattern)."""
+    from dr_tpu.algorithms.elementwise import _prog_cache
+
+    def axpy(x, p, alpha):
+        return x + alpha * p
+
+    n = 256
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    dr_tpu.iota(a, 0)
+    dr_tpu.fill(b, 1.0)
+    dr_tpu.transform(dr_tpu.views.zip(a, b), a, axpy, 2.0)
+    n_progs = len(_prog_cache)
+    dr_tpu.transform(dr_tpu.views.zip(a, b), a, axpy, 5.0)
+    assert len(_prog_cache) == n_progs  # same program, new scalar
+    ref = np.arange(n) + 2.0 + 5.0
+    np.testing.assert_allclose(dr_tpu.to_numpy(a), ref, rtol=1e-6)

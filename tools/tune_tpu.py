@@ -20,6 +20,10 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _errline(e):
+    return (str(e).splitlines() or [repr(e)])[0][:90]
+
+
 def _marginal(run_sync, r1=2, r2=10, samples=5):
     for r in (r1, r2):
         run_sync(r)
@@ -72,7 +76,7 @@ def tune_stencil():
                       f"eff {GB * k / dt / 2:.0f} GB/s", flush=True)
             except Exception as e:
                 print(f"stencil k={k} cap={cap}: FAIL "
-                      f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
+                      f"{_errline(e)}", flush=True)
     os.environ.pop("DR_TPU_MM_CHUNK_CAP", None)
 
 
@@ -113,7 +117,7 @@ def tune_scan():
                   f"{2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
         except Exception as e:
             print(f"scan kernel [{variant}]: FAIL "
-                  f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
+                  f"{_errline(e)}", flush=True)
     os.environ.pop("DR_TPU_SCAN_KERNEL", None)
 
 
@@ -155,7 +159,7 @@ def tune_container(name):
                       flush=True)
             except Exception as e:
                 print(f"heat2d tb={tb}: FAIL "
-                      f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
+                      f"{_errline(e)}", flush=True)
     elif name == "attn":
         B, S, h, hd = 1, 8192, 8, 128
         rng = np.random.default_rng(0)
@@ -177,7 +181,7 @@ def tune_container(name):
                       f"{fl / dt / 1e12:.1f} TFLOP/s", flush=True)
             except Exception as e:
                 print(f"ring attn bq={bq} bk={bk}: FAIL "
-                      f"{(str(e).splitlines() or [repr(e)])[0][:90]}", flush=True)
+                      f"{_errline(e)}", flush=True)
         os.environ.pop("DR_TPU_FLASH_BQ", None)
         os.environ.pop("DR_TPU_FLASH_BK", None)
     elif name == "spmv":
