@@ -298,3 +298,54 @@ def test_fuzz_sparse_2d_gemv():
         dr_tpu.gemv(c, sp, b)
         np.testing.assert_allclose(dr_tpu.to_numpy(c), d @ b,
                                    rtol=1e-4, atol=1e-4)
+
+
+def _fuzz_axpy(x, p, alpha):
+    return x + alpha * p
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_scalar_transforms(seed):
+    """Trailing traced scalars over random zip windows: one cached
+    program per op regardless of the coefficient stream."""
+    rng = np.random.default_rng(300 + seed)
+    for it in range(ITERS // 2):
+        n = int(rng.integers(2, 150))
+        b = int(rng.integers(0, n - 1))
+        e = int(rng.integers(b + 1, n))
+        a_src, a = _mk(rng, n)
+        p_src, p = _mk(rng, n)
+        alpha = float(rng.standard_normal())
+        dr_tpu.transform(views.zip(a[b:e], p[b:e]), a[b:e],
+                         _fuzz_axpy, alpha)
+        ref = a_src.copy()
+        ref[b:e] = a_src[b:e] + np.float32(alpha) * p_src[b:e]
+        np.testing.assert_allclose(dr_tpu.to_numpy(a), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_fuzz_matmul_stencil_band_widths(monkeypatch):
+    """Every composed-block size across band widths D=1..4 against the
+    serial oracle (the multi-column P-form's index arithmetic)."""
+    rng = np.random.default_rng(77)
+    w = [0.05, 0.25, 0.4, 0.25, 0.05]  # radius 2
+    r = 2
+    P = dr_tpu.nprocs()
+    for k in (8, 32, 64, 96, 128, 192, 256):  # D = 1, 1, 1, 2, 2, 3, 4
+        halo = max(128, -(-k * r // 128) * 128)
+        n = P * 1024
+        src = rng.standard_normal(n).astype(np.float32)
+        hb = dr_tpu.halo_bounds(halo, halo, periodic=True)
+        dv = dr_tpu.distributed_vector.from_array(src, halo=hb)
+        steps = int(rng.integers(1, 3)) * k  # whole blocks
+        from dr_tpu.algorithms.stencil import stencil_iterate_matmul
+        import dr_tpu.ops.stencil_matmul as sm
+        if k > sm.max_ksteps(r):
+            monkeypatch.setenv("DR_TPU_MM_BAND_COLS", "4")
+        out = stencil_iterate_matmul(dv, w, steps, k_block=k)
+        x = src.astype(np.float64)
+        for _ in range(steps):
+            x = sum(wi * np.roll(x, s)
+                    for wi, s in zip(w, (2, 1, 0, -1, -2)))
+        np.testing.assert_allclose(dr_tpu.to_numpy(out), x,
+                                   rtol=2e-4, atol=2e-5)
