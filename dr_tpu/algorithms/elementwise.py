@@ -293,13 +293,15 @@ def copy_async(src, dst):
     return _Event(tgt if tgt is not None else dst)
 
 
-def for_each(r, fn: Callable) -> None:
+def for_each(r, fn: Callable, *scalars) -> None:
     """Collective in-place for_each (cpu_algorithms.hpp:63-74;
     shp/algorithms/for_each.hpp:16-92).
 
     Semantic shift for immutable arrays: ``fn`` is PURE — it receives the
     element value(s) and returns the new value(s); over a zip range it
-    returns a tuple (one entry per component) to write back."""
+    returns a tuple (one entry per component) to write back.  Trailing
+    ``*scalars`` are TRACED arguments appended to ``fn``'s, exactly as
+    in :func:`transform`."""
     if isinstance(r, _v.zip_view):
         outs = [_out_chain(c) for c in r.components]
         ins = _resolve(r)
@@ -309,26 +311,28 @@ def for_each(r, fn: Callable) -> None:
             alias = tuple(
                 next((i for i, c in builtin_enumerate(conts)
                       if c is ch.cont), -1) for ch in ins)
-            prog = _zip_foreach_program(ins, outs, fn, alias)
+            prog = _zip_foreach_program(ins, outs, fn, alias,
+                                        len(scalars))
             extra = [ch.cont._data for ch, a in builtin_zip(ins, alias)
                      if a < 0]
-            datas = prog(*[c._data for c in conts], *extra)
+            svals = [jnp.asarray(sv) for sv in scalars]
+            datas = prog(*[c._data for c in conts], *extra, *svals)
             for cont, nd in builtin_zip(conts, datas):
                 cont._data = nd
             return
         arrs = r.to_array()
-        vals = fn(*arrs)
+        vals = fn(*arrs, *scalars)
         if not isinstance(vals, tuple):
             raise TypeError("for_each over zip: fn must return a tuple")
         for oc, v in builtin_zip(outs, vals):
             _write_window(oc, v)
         return
-    transform(r, r, fn)
+    transform(r, r, fn, *scalars)
 
 
-def _zip_foreach_program(ins, outs, fn, alias):
+def _zip_foreach_program(ins, outs, fn, alias, nscalars=0):
     key = ("zfe", tuple(c.key for c in ins), tuple(o.key for o in outs),
-           _op_key(fn), alias)
+           _op_key(fn), alias, nscalars)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -338,7 +342,9 @@ def _zip_foreach_program(ins, outs, fn, alias):
     in_ops = tuple(c.ops for c in ins)
 
     def body(*datas):
-        out_datas, extra_datas = datas[:k], datas[k:]
+        out_datas = datas[:k]
+        extra_datas = datas[k:len(datas) - nscalars]
+        scalars = datas[len(datas) - nscalars:]
         it = iter(extra_datas)
         in_datas = [out_datas[a] if a >= 0 else next(it) for a in alias]
         vals_in = []
@@ -347,7 +353,7 @@ def _zip_foreach_program(ins, outs, fn, alias):
             for o in ops:
                 v = o(v)
             vals_in.append(v)
-        new_vals = fn(*vals_in)
+        new_vals = fn(*vals_in, *scalars)
         mask, _gid = owned_window_mask(cont.layout, off, n)
         return tuple(
             jnp.where(mask, nv.astype(od.dtype), od)

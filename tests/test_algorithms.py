@@ -225,3 +225,32 @@ def test_transform_scalar_args_reuse_program():
     assert len(_prog_cache) == n_progs  # same program, new scalar
     ref = np.arange(n) + 2.0 + 5.0
     np.testing.assert_allclose(dr_tpu.to_numpy(a), ref, rtol=1e-6)
+
+
+def test_for_each_scalar_args():
+    """for_each mirrors transform's trailing traced scalars, including
+    over zips (tuple write-back)."""
+
+    def scale2(x, y, c):
+        return x * c, y + c
+
+    n = 128
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    dr_tpu.iota(a, 0)
+    dr_tpu.fill(b, 1.0)
+    from dr_tpu.algorithms.elementwise import _prog_cache
+
+    dr_tpu.for_each(dr_tpu.views.zip(a, b), scale2, 3.0)
+    np.testing.assert_allclose(dr_tpu.to_numpy(a), np.arange(n) * 3.0)
+    np.testing.assert_allclose(dr_tpu.to_numpy(b), np.full(n, 4.0))
+    n_progs = len(_prog_cache)
+    dr_tpu.for_each(dr_tpu.views.zip(a, b), scale2, 0.5)
+    assert len(_prog_cache) == n_progs  # scalar traced, program reused
+    np.testing.assert_allclose(dr_tpu.to_numpy(a), np.arange(n) * 1.5)
+
+    def shift(x, c):
+        return x + c
+
+    dr_tpu.for_each(a, shift, 2.0)
+    np.testing.assert_allclose(dr_tpu.to_numpy(a), np.arange(n) * 1.5 + 2.0)
