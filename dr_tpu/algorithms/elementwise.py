@@ -43,6 +43,40 @@ __all__ = ["fill", "iota", "copy", "copy_async", "for_each", "transform",
 _op_key = pinned_id
 
 
+def _traced_op_key(op):
+    """Cache key for a chain op in the SPECIALIZED program paths (the
+    ones that feed BoundOp scalars as traced operands): a BoundOp keys
+    on its underlying op + scalar COUNT, so streaming values reuse the
+    program.  Paths that CALL ops directly (materialization, generic
+    reduce) must keep ``_op_key`` — identity keying bakes the values,
+    which is correct there."""
+    if isinstance(op, _v.BoundOp):
+        return ("bnd", pinned_id(op.op), len(op.scalars))
+    return pinned_id(op)
+
+
+def _chain_scalars(chains):
+    """BoundOp scalar values across all chain ops, in the deterministic
+    (chain-major, op-order) sequence the program bodies consume."""
+    out = []
+    for c in chains:
+        for o in c.ops:
+            if isinstance(o, _v.BoundOp):
+                out.extend(o.scalars)
+    return out
+
+
+def _apply_chain_ops(v, ops, sc_iter):
+    """Apply a chain's ops; BoundOp ops draw their scalars (traced) from
+    ``sc_iter`` in the :func:`_chain_scalars` order."""
+    for o in ops:
+        if isinstance(o, _v.BoundOp):
+            v = o.op(v, *[next(sc_iter) for _ in o.scalars])
+        else:
+            v = o(v)
+    return v
+
+
 class _Chain:
     __slots__ = ("cont", "off", "n", "ops")
 
@@ -55,7 +89,7 @@ class _Chain:
     @property
     def key(self):
         return (pinned_id(self.cont.runtime.mesh), self.cont.layout,
-                self.off, self.n, tuple(_op_key(op) for op in self.ops))
+                self.off, self.n, tuple(_traced_op_key(op) for op in self.ops))
 
 
 def _resolve(r) -> Optional[Tuple[_Chain, ...]]:
@@ -113,8 +147,12 @@ def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
     compiled program instead of baking each closure into a new one."""
     cont = out_chain.cont
     off, n = out_chain.off, out_chain.n
+    # chain-op BoundOp scalars arrive FIRST in the scalar tail, then the
+    # public transform scalars; nscalars counts both
+    nchain = sum(len(o.scalars) for ops in in_ops for o in ops
+                 if isinstance(o, _v.BoundOp))
     key = ("ew", cont.layout, off, n, in_keys,
-           tuple(tuple(_op_key(o) for o in ops) for ops in in_ops),
+           tuple(tuple(_traced_op_key(o) for o in ops) for ops in in_ops),
            _op_key(op), with_index, alias_mask, nscalars, str(cont.dtype))
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -123,18 +161,16 @@ def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,
     def body(out_data, *rest):
         extra_datas = rest[:len(rest) - nscalars]
         scalars = rest[len(rest) - nscalars:]
+        sc_iter = iter(scalars[:nchain])
+        op_scalars = scalars[nchain:]
         it = iter(extra_datas)
         in_datas = [out_data if aliased else next(it)
                     for aliased in alias_mask] if alias_mask else []
-        vals_in = []
-        for data, ops in builtin_zip(in_datas, in_ops):
-            v = data
-            for o in ops:
-                v = o(v)
-            vals_in.append(v)
+        vals_in = [_apply_chain_ops(d, ops, sc_iter)
+                   for d, ops in builtin_zip(in_datas, in_ops)]
         # global index of every padded cell (halo/pad cells -> out of window)
         mask, gid = owned_window_mask(cont.layout, off, n)
-        args = (list(vals_in) + list(scalars))
+        args = (list(vals_in) + list(op_scalars))
         if with_index:
             vals = op(gid, *args) if args else op(gid)
         else:
@@ -155,16 +191,17 @@ def _run_fused(ins: Tuple[_Chain, ...], out_chain: _Chain, op,
                with_index=False, scalars=()) -> None:
     out_cont = out_chain.cont
     alias_mask = tuple(c.cont is out_cont for c in ins)
+    all_scalars = _chain_scalars(ins) + list(scalars)
     prog = _window_program(
         out_chain,
         tuple(c.cont.layout for c in ins),
         tuple(c.ops for c in ins),
-        op, with_index, alias_mask, len(scalars))
+        op, with_index, alias_mask, len(all_scalars))
     extra = [c.cont._data for c in ins if c.cont is not out_cont]
     # scalars keep their own (weak) dtype so the op computes in the same
     # promoted type as the fallback path; the window write casts to the
     # container dtype either way
-    svals = [jnp.asarray(s) for s in scalars]
+    svals = [jnp.asarray(s) for s in all_scalars]
     out_cont._data = prog(out_cont._data, *extra, *svals)
 
 
@@ -311,6 +348,9 @@ def for_each(r, fn: Callable, *scalars) -> None:
             alias = tuple(
                 next((i for i, c in builtin_enumerate(conts)
                       if c is ch.cont), -1) for ch in ins)
+            # zip components are all OUTPUTS (_out_chain rejects ops),
+            # so these chains can never carry BoundOps — only the public
+            # fn scalars flow through
             prog = _zip_foreach_program(ins, outs, fn, alias,
                                         len(scalars))
             extra = [ch.cont._data for ch, a in builtin_zip(ins, alias)
@@ -344,7 +384,7 @@ def _zip_foreach_program(ins, outs, fn, alias, nscalars=0):
     def body(*datas):
         out_datas = datas[:k]
         extra_datas = datas[k:len(datas) - nscalars]
-        scalars = datas[len(datas) - nscalars:]
+        fn_scalars = datas[len(datas) - nscalars:]
         it = iter(extra_datas)
         in_datas = [out_datas[a] if a >= 0 else next(it) for a in alias]
         vals_in = []
@@ -353,7 +393,7 @@ def _zip_foreach_program(ins, outs, fn, alias, nscalars=0):
             for o in ops:
                 v = o(v)
             vals_in.append(v)
-        new_vals = fn(*vals_in, *scalars)
+        new_vals = fn(*vals_in, *fn_scalars)
         mask, _gid = owned_window_mask(cont.layout, off, n)
         return tuple(
             jnp.where(mask, nv.astype(od.dtype), od)

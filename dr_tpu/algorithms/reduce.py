@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 
 from ._common import owned_window_mask
-from .elementwise import _op_key, _prog_cache, _resolve
+from .elementwise import (_apply_chain_ops, _chain_scalars, _op_key,
+                          _prog_cache, _resolve, _traced_op_key)
 from ..views import views as _v
 
 __all__ = ["reduce", "transform_reduce", "dot",
@@ -69,8 +70,13 @@ def _fused_reduce_program(chains, kind, zip_op=None):
     """Masked fused reduce over padded shard arrays — zero reshaping,
     zero gather: XLA lowers the cross-shard combine to an all-reduce.
     Multi-chain (zip) inputs are combined elementwise by ``zip_op`` before
-    the reduction, so ``dot`` reads each input exactly once."""
-    key = ("red", tuple(c.key for c in chains), kind, _op_key(zip_op))
+    the reduction, so ``dot`` reads each input exactly once.
+
+    BoundOp chain/zip ops feed their scalars as TRACED trailing operands
+    (call through :func:`_call_fused_reduce`), so a coefficient stream
+    through a view pipeline reuses one compiled program."""
+    key = ("red", tuple(c.key for c in chains), kind,
+           _traced_op_key(zip_op) if zip_op is not None else None)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -78,15 +84,22 @@ def _fused_reduce_program(chains, kind, zip_op=None):
     layout, off, n = c0.cont.layout, c0.off, c0.n
     vec_reduce, _ = _MONOIDS[kind]
     all_ops = tuple(c.ops for c in chains)
+    nchain = sum(len(o.scalars) for ops in all_ops for o in ops
+                 if isinstance(o, _v.BoundOp))
+    nds = len(chains)
 
-    def body(*datas):
-        vals = []
-        for d, ops in zip(datas, all_ops):
-            v = d
-            for o in ops:
-                v = o(v)
-            vals.append(v)
-        v = zip_op(*vals) if zip_op is not None else vals[0]
+    def body(*args):
+        datas = args[:nds]
+        sc_iter = iter(args[nds:nds + nchain])
+        zip_scalars = args[nds + nchain:]
+        vals = [_apply_chain_ops(d, ops, sc_iter)
+                for d, ops in zip(datas, all_ops)]
+        if zip_op is None:
+            v = vals[0]
+        elif isinstance(zip_op, _v.BoundOp):
+            v = zip_op.op(*vals, *zip_scalars)
+        else:
+            v = zip_op(*vals)
         mask, _gid = owned_window_mask(layout, off, n)
         ident = _identity_for(kind, v.dtype)
         return vec_reduce(jnp.where(mask, v, ident))
@@ -94,6 +107,16 @@ def _fused_reduce_program(chains, kind, zip_op=None):
     prog = jax.jit(body)
     _prog_cache[key] = prog
     return prog
+
+
+def _call_fused_reduce(chains, kind, zip_op=None):
+    """Build + invoke the fused reduce with the BoundOp scalar tail."""
+    scal = _chain_scalars(chains)
+    if isinstance(zip_op, _v.BoundOp):
+        scal = scal + list(zip_op.scalars)
+    svals = [jnp.asarray(s) for s in scal]
+    return _fused_reduce_program(chains, kind, zip_op)(
+        *[c.cont._data for c in chains], *svals)
 
 
 def _zip_reduce_chains(r):
@@ -131,8 +154,7 @@ def reduce_async(r, op: Callable = None):
             if zipped is not None:
                 chains, zip_op = zipped
     if chains is not None:
-        val = _fused_reduce_program(chains, kind, zip_op)(
-            *[c.cont._data for c in chains])
+        val = _call_fused_reduce(chains, kind, zip_op)
     else:
         arr = r.to_array() if hasattr(r, "to_array") else jnp.asarray(r)
         assert not isinstance(arr, tuple), \
@@ -174,19 +196,26 @@ def _multiply2(x, y):
     return x * y
 
 
-def transform_reduce(r, init=None, reduce_op=None, transform_op=None):
+def transform_reduce(r, init=None, reduce_op=None, transform_op=None,
+                     transform_args=()):
     """Spec'd transform_reduce: reduce(transform(r)).  Fuses into the same
-    single program as reduce()."""
+    single program as reduce().  ``transform_args`` bind trailing TRACED
+    scalars to ``transform_op`` (views.BoundOp): a per-step coefficient
+    (e.g. sum((x - mu)**2) with a streaming mu) reuses one compiled
+    program."""
     if transform_op is None:
         transform_op = _identity
-    return reduce(_v.transform(r, transform_op), init, reduce_op)
+    return reduce(_v.transform(r, transform_op, *transform_args),
+                  init, reduce_op)
 
 
-def transform_reduce_async(r, reduce_op=None, transform_op=None):
+def transform_reduce_async(r, reduce_op=None, transform_op=None,
+                           transform_args=()):
     """Async :func:`transform_reduce`: returns the device scalar."""
     if transform_op is None:
         transform_op = _identity
-    return reduce_async(_v.transform(r, transform_op), reduce_op)
+    return reduce_async(_v.transform(r, transform_op, *transform_args),
+                        reduce_op)
 
 
 def dot(a, b, init=None):

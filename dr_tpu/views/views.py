@@ -33,8 +33,27 @@ __all__ = [
     "take", "drop", "subrange", "slice_view", "transform", "zip_view",
     "zip", "enumerate_view", "enumerate", "iota_view", "counted",
     "take_segments", "drop_segments", "aligned", "local_segments",
-    "ranked_view",
+    "ranked_view", "BoundOp",
 ]
+
+
+class BoundOp:
+    """``op`` with trailing scalar arguments bound: calling it behaves
+    exactly like ``lambda *a: op(*a, *scalars)``, but it keeps the op
+    and the scalars inspectable — the algorithm layer's program caches
+    key on the OP identity plus the scalar COUNT and feed the values as
+    traced operands, so a loop streaming coefficients through a view
+    pipeline (``reduce(views.transform(r, f, mu))`` per step) reuses
+    one compiled program instead of recompiling per value."""
+
+    __slots__ = ("op", "scalars")
+
+    def __init__(self, op: Callable, scalars: Sequence):
+        self.op = op
+        self.scalars = tuple(scalars)
+
+    def __call__(self, *args):
+        return self.op(*args, *self.scalars)
 
 
 # ---------------------------------------------------------------------------
@@ -167,17 +186,26 @@ def counted(it_range, n):
 class transform(_ViewBase):
     """Lazy elementwise transform that stays distributed
     (views/transform.hpp:9-43).  ``op`` must be jax-traceable; over a zip
-    base it receives one argument per component."""
+    base it receives one argument per component.  Trailing ``*scalars``
+    bind extra arguments (:class:`BoundOp`): the fused algorithm
+    programs receive them TRACED, so per-call coefficient streams reuse
+    one compiled program."""
 
-    def __init__(self, base: Any, op: Callable = None):
+    def __init__(self, base: Any, op: Callable = None, *scalars):
         if op is None:
             # the adaptor form transform(op) is handled in __new__; reaching
             # here means a single non-callable argument
             raise TypeError("transform(range, op) or transform(op) | range")
+        if not callable(op):
+            # fail at the misuse site: the adaptor form takes NO scalars
+            # (transform(op, 0.5) | r would land here with op=0.5)
+            raise TypeError(
+                "transform op must be callable; the pipe-adaptor form "
+                "does not take scalars — use transform(range, op, *scalars)")
         self.base = base
-        self.op = op
+        self.op = BoundOp(op, scalars) if scalars else op
 
-    def __new__(cls, base=None, op=None):
+    def __new__(cls, base=None, op=None, *scalars):
         if op is None and callable(base) and not hasattr(base, "__dr_segments__") \
                 and not hasattr(base, "to_array"):
             return _Pipe(lambda rr: cls(rr, base))

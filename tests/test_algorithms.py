@@ -254,3 +254,39 @@ def test_for_each_scalar_args():
 
     dr_tpu.for_each(a, shift, 2.0)
     np.testing.assert_allclose(dr_tpu.to_numpy(a), np.arange(n) * 1.5 + 2.0)
+
+
+def test_transform_reduce_streamed_coefficient():
+    """transform_args bind TRACED scalars into the fused reduce pipeline:
+    a streaming coefficient reuses one compiled program."""
+    from dr_tpu.algorithms.elementwise import _prog_cache
+
+    def sqdiff(x, mu):
+        return (x - mu) ** 2
+
+    n = 500
+    rng = np.random.default_rng(3)
+    src = rng.standard_normal(n).astype(np.float32)
+    dv = dr_tpu.distributed_vector.from_array(src)
+    got = dr_tpu.transform_reduce(dv, transform_op=sqdiff,
+                                  transform_args=(0.5,))
+    ref = float(((src.astype(np.float64) - 0.5) ** 2).sum())
+    assert got == pytest.approx(ref, rel=1e-4)
+    n_progs = len(_prog_cache)
+    got2 = dr_tpu.transform_reduce(dv, transform_op=sqdiff,
+                                   transform_args=(-1.25,))
+    assert len(_prog_cache) == n_progs  # scalar traced, program reused
+    ref2 = float(((src.astype(np.float64) + 1.25) ** 2).sum())
+    assert got2 == pytest.approx(ref2, rel=1e-4)
+
+    # the same through an explicit views.transform pipeline over a zip
+    def wdot(x, y, w):
+        return w * x * y
+
+    b = dr_tpu.distributed_vector.from_array(2.0 - src)
+    z = dr_tpu.views.zip(dv, b)
+    r1 = dr_tpu.reduce(dr_tpu.views.transform(z, wdot, 2.0))
+    r2 = dr_tpu.reduce(dr_tpu.views.transform(z, wdot, -3.0))
+    ref1 = float((2.0 * src.astype(np.float64) * (2.0 - src)).sum())
+    assert r1 == pytest.approx(ref1, rel=1e-4)
+    assert r2 == pytest.approx(-1.5 * r1, rel=1e-4)
