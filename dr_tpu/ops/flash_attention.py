@@ -37,7 +37,8 @@ from jax.experimental import pallas as pl
 
 from .stencil_pallas import _HAS_PLTPU, pltpu
 
-__all__ = ["flash_update", "supported", "pick_blocks"]
+__all__ = ["flash_update", "pick_blocks", "resident_fits",
+           "supported", "use_streaming"]
 
 _NEG_INF = float("-inf")
 
@@ -63,8 +64,10 @@ def pick_blocks(s: int, skv: int, d: int):
         return None
     if d % 128 or skv % 128:
         return None
-    # the whole held K/V block stays VMEM-resident (double-buffered)
-    if 2 * 2 * skv * d * 2 > 64 * 2 ** 20:
+    # beyond the resident-K/V VMEM budget the STREAMING variant takes
+    # over (k-block grid dimension, Mosaic pipelines the tile DMAs), so
+    # a large skv only gates when streaming is explicitly disabled
+    if not use_streaming(skv, d) and not resident_fits(skv, d):
         return None
     def pow2_cap(env, default):
         # round down to a power of two: pick() only guarantees the
@@ -79,6 +82,140 @@ def pick_blocks(s: int, skv: int, d: int):
     if bq is None or bk is None:
         return None
     return bq, bk
+
+
+def _block_update(qv, kblk, vblk, m, l, acc, scale, causal, q_lo, k_lo):
+    """One online-softmax update of (m, l, acc) against a K/V tile —
+    the shared core of the resident and streaming kernels (a numerical
+    fix here reaches both)."""
+    logits = lax.dot_general(
+        qv, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qp = q_lo + lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        kp = k_lo + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(qp >= kp, logits, _NEG_INF)
+    blk_max = jnp.max(logits, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    # new_m = -inf only when every k so far is masked; exp(x - safe_m)
+    # then sees x = -inf and yields 0 rows on its own
+    safe_m = jnp.where(new_m > _NEG_INF, new_m, 0.0)
+    p = jnp.exp(logits - safe_m)                # masked -> exp(-inf)=0
+    corr = jnp.exp(m - safe_m)                  # m=-inf -> 0
+    pv = lax.dot_general(
+        p.astype(jnp.bfloat16), vblk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (new_m, l * corr + jnp.sum(p, axis=-1, keepdims=True),
+            acc * corr + pv)
+
+
+def resident_fits(skv: int, d: int) -> bool:
+    """Whole held K/V block (double-buffered bf16) within the VMEM
+    budget — the resident kernel's eligibility bound (~64k tokens at
+    d=128)."""
+    return 2 * 2 * skv * d * 2 <= 64 * 2 ** 20
+
+
+def use_streaming(skv: int, d: int) -> bool:
+    """Kernel-variant selector (trace-time): streaming beyond the
+    resident VMEM budget; DR_TPU_FLASH_STREAM=1/0 forces/forbids.
+    Callers caching programs must key on this."""
+    env = os.environ.get("DR_TPU_FLASH_STREAM", "").strip()
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return not resident_fits(skv, d)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_streaming(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
+                     causal: bool, interpret: bool, group: int = 1):
+    """Long-context variant: the K-block index is a GRID dimension, so
+    Mosaic's pipeliner streams (bk, d) K/V tiles from HBM instead of
+    holding the whole block in VMEM — sequence length is then bounded
+    by HBM, not VMEM (the resident kernel's ~64k ceiling at d=128).
+
+    The (m, l, acc) online-softmax state lives in the OUTPUT blocks,
+    which map to the same (b, iq) slot for every ik — Mosaic keeps a
+    revisited block VMEM-resident across the innermost steps, so the
+    state never round-trips HBM within one q tile.  Causal q tiles
+    skip the compute (not the tile fetch) of strictly-future K blocks.
+    """
+    nk = skv // bk
+    scale = 1.0 / (d ** 0.5)
+
+    def kernel(info, q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
+               mo_ref, lo_ref, acco_ref):
+        iq = pl.program_id(1)
+        ik = pl.program_id(2)
+        q_off = info[0]
+        k_off = info[1]
+        q_lo = q_off + iq * bq
+
+        @pl.when(ik == 0)
+        def _():
+            # seed the revisited output state from the ring carries
+            mo_ref[0] = mi_ref[0]
+            lo_ref[0] = li_ref[0]
+            acco_ref[0] = acci_ref[0]
+
+        # strictly-future K block for every q row in this tile?
+        contributes = (k_off + ik * bk <= q_lo + bq - 1) if causal \
+            else (ik >= 0)
+
+        @pl.when(contributes)
+        def _():
+            new_m, new_l, new_acc = _block_update(
+                q_ref[0], k_ref[0], v_ref[0], mo_ref[0], lo_ref[0],
+                acco_ref[0], scale, causal, q_lo, k_off + ik * bk)
+            mo_ref[0] = new_m
+            lo_ref[0] = new_l
+            acco_ref[0] = new_acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(BH, s // bq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, ik, info: (b, i, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, ik, info: (b // group, ik, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, ik, info: (b // group, ik, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, ik, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, ik, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, ik, info: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, 1), lambda b, i, ik, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, ik, info: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, ik, info: (b, i, 0)),
+        ],
+    )
+    flops_per_cell = 2 * 2 * bq * bk * d
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20,
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        **params,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, s, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_cell * BH * (s // bq) * nk
+            // (2 if causal else 1),
+            bytes_accessed=(BH * s * d * 2 * 2
+                            + BH * (s // bq) * skv * d * 2 * 2
+                            + BH * s * d * 4 * 2),
+            transcendentals=BH * s * skv),
+        interpret=interpret,
+    )
 
 
 @functools.lru_cache(maxsize=32)
@@ -119,27 +256,8 @@ def _build(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
             m, l, acc = carry
             kblk = k_ref[0, pl.ds(ik * bk, bk), :]      # (bk, d) bf16
             vblk = v_ref[0, pl.ds(ik * bk, bk), :]
-            logits = lax.dot_general(
-                qv, kblk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            if causal:
-                qp = q_lo + lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-                kp = (k_off + ik * bk
-                      + lax.broadcasted_iota(jnp.int32, logits.shape, 1))
-                logits = jnp.where(qp >= kp, logits, _NEG_INF)
-            blk_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
-            new_m = jnp.maximum(m, blk_max)
-            # new_m = -inf only when every k so far is masked; exp(x -
-            # safe_m) then sees x = -inf and yields 0 rows on its own
-            safe_m = jnp.where(new_m > _NEG_INF, new_m, 0.0)
-            p = jnp.exp(logits - safe_m)                # masked -> exp(-inf)=0
-            corr = jnp.exp(m - safe_m)                  # m=-inf -> 0
-            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-            pv = lax.dot_general(
-                p.astype(jnp.bfloat16), vblk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            acc = acc * corr + pv
-            return new_m, l, acc
+            return _block_update(qv, kblk, vblk, m, l, acc, scale,
+                                 causal, q_lo, k_off + ik * bk)
 
         m, l, acc = lax.fori_loop(0, hi, body, (m, l, acc))
         mo_ref[0] = m
@@ -206,7 +324,8 @@ def flash_update(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
     assert v.shape == k.shape, "k and v must share (heads, skv, d)"
     assert BH % k.shape[0] == 0, "q heads must be a multiple of kv heads"
     group = BH // k.shape[0]
-    fn = _build(BH, s, skv, d, bq, bk, causal, interpret, group)
+    build = _build_streaming if use_streaming(skv, d) else _build
+    fn = build(BH, s, skv, d, bq, bk, causal, interpret, group)
     info = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     return fn(info, q, k, v, m, l, acc)

@@ -244,8 +244,9 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
     # the picked flash tiles key the program: the DR_TPU_FLASH_BQ/BK
     # caps may change between calls (tools/tune_tpu.py sweeps them)
     blocks = _fa.pick_blocks(shape[1], shape[1], d) if flash else None
+    stream = _fa.use_streaming(shape[1], d) if flash else None
     key = ("ringattn", pinned_id(rt.mesh), shape, hkv, causal,
-           str(q.dtype), q_chunk, flash, blocks)
+           str(q.dtype), q_chunk, flash, blocks, stream)
     prog = _cache.get(key)
     if prog is None:
         if flash:
@@ -276,8 +277,9 @@ def ring_attention_n(q, k, v, iters: int, *, causal: bool = False,
     sharding = NamedSharding(rt.mesh, P(None, rt.axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     blocks = _fa.pick_blocks(shape[1], shape[1], d) if flash else None
+    stream = _fa.use_streaming(shape[1], d) if flash else None
     key = ("ringattn_n", pinned_id(rt.mesh), shape, causal,
-           str(q.dtype), flash, blocks, int(iters))
+           str(q.dtype), flash, blocks, stream, int(iters))
     prog = _cache.get(key)
     if prog is None:
         build = _build_flash if flash else _build

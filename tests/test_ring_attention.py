@@ -115,13 +115,56 @@ def test_flash_kernel_interpret_matches_dense(causal):
     np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-3)
 
 
-def test_pick_blocks_gates():
+def test_pick_blocks_gates(monkeypatch):
     from dr_tpu.ops import flash_attention as fa
     assert fa.pick_blocks(8192, 8192, 128) == (2048, 1024)
     assert fa.pick_blocks(8192, 8192, 100) is None   # lane-unaligned d
     assert fa.pick_blocks(100, 8192, 128) is None    # no q tile divisor
-    # K/V block too large for resident VMEM -> fallback
+    # beyond the resident VMEM budget the STREAMING kernel takes over
+    assert not fa.resident_fits(1 << 20, 128)
+    assert fa.pick_blocks(1 << 20, 1 << 20, 128) == (2048, 1024)
+    assert fa.use_streaming(1 << 20, 128)
+    assert not fa.use_streaming(8192, 128)
+    # explicit opt-out restores the hard gate
+    monkeypatch.setenv("DR_TPU_FLASH_STREAM", "0")
     assert fa.pick_blocks(1 << 20, 1 << 20, 128) is None
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_streaming_matches_resident_interpret(causal, monkeypatch):
+    """The streaming kernel (K-block grid dimension, state in revisited
+    output blocks) must match the resident kernel exactly on the same
+    inputs (interpret mode)."""
+    import jax.numpy as jnp
+
+    from dr_tpu.ops import flash_attention as fa
+    rng = np.random.default_rng(21)
+    BH, s, d = 4, 256, 128
+    bq, bk = 64, 128
+    q, k, v = (jnp.asarray(rng.standard_normal((BH, s, d)),
+                           jnp.bfloat16) for _ in range(3))
+    m = jnp.full((BH, s, 1), -np.inf, jnp.float32)
+    l = jnp.zeros((BH, s, 1), jnp.float32)
+    acc = jnp.zeros((BH, s, d), jnp.float32)
+    monkeypatch.setenv("DR_TPU_FLASH_STREAM", "0")
+    ref = fa.flash_update(q, k, v, m, l, acc, 0, 0, causal=causal,
+                          bq=bq, bk=bk, interpret=True)
+    monkeypatch.setenv("DR_TPU_FLASH_STREAM", "1")
+    got = fa.flash_update(q, k, v, m, l, acc, 0, 0, causal=causal,
+                          bq=bq, bk=bk, interpret=True)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+    # nonzero offsets (ring-step positions) must agree too
+    monkeypatch.setenv("DR_TPU_FLASH_STREAM", "0")
+    ref = fa.flash_update(q, k, v, m, l, acc, s, 2 * s, causal=causal,
+                          bq=bq, bk=bk, interpret=True)
+    monkeypatch.setenv("DR_TPU_FLASH_STREAM", "1")
+    got = fa.flash_update(q, k, v, m, l, acc, s, 2 * s, causal=causal,
+                          bq=bq, bk=bk, interpret=True)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -200,4 +243,35 @@ def test_gqa_flash_multishard_interpret(causal):
     kr = np.repeat(to_f(k), h // hkv, axis=2)
     vr = np.repeat(to_f(v), h // hkv, axis=2)
     ref = _dense_attention(to_f(q), kr, vr, causal=causal)
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_streaming_multishard_interpret(causal, monkeypatch):
+    """The full flash ring with the STREAMING kernel forced — the
+    long-context configuration (K/V beyond the resident VMEM budget)
+    exercised end-to-end on the multi-shard mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    from dr_tpu.ops import ring_attention as ra
+    from dr_tpu.parallel import runtime as _rt
+
+    monkeypatch.setenv("DR_TPU_FLASH_STREAM", "1")
+    rt = _rt.runtime()
+    P = rt.nprocs
+    B, h, d = 1, 2, 128
+    s = 256
+    S = P * s
+    rng = np.random.default_rng(23)
+    q, k, v = (rng.standard_normal((B, S, h, d)).astype(np.float32)
+               for _ in range(3))
+    prog = ra._build_flash(rt.mesh, rt.axis, P, (B, s, h, d), causal,
+                           jnp.dtype(jnp.float32), interpret=True)
+    sh = NamedSharding(rt.mesh, PartitionSpec(None, rt.axis))
+    got = np.asarray(prog(*(jax.device_put(x, sh) for x in (q, k, v))))
+    qb, kb, vb = (np.asarray(
+        jnp.asarray(x, jnp.bfloat16).astype(jnp.float32), np.float64)
+        for x in (q, k, v))
+    ref = _dense_attention(qb, kb, vb, causal=causal)
     np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-3)
