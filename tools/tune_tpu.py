@@ -184,6 +184,17 @@ def tune_container(name):
                       f"{_errline(e)}", flush=True)
         os.environ.pop("DR_TPU_FLASH_BQ", None)
         os.environ.pop("DR_TPU_FLASH_BK", None)
+        # streaming kernel on the same (resident-eligible) config:
+        # compile check + the cost of HBM-streamed K/V tiles
+        os.environ["DR_TPU_FLASH_STREAM"] = "1"
+        try:
+            dt = _marginal(run, 2, 18)
+            print(f"ring attn STREAMING: {fl / dt / 1e12:.1f} TFLOP/s",
+                  flush=True)
+        except Exception as e:
+            print(f"ring attn STREAMING: FAIL {_errline(e)}", flush=True)
+        finally:
+            os.environ.pop("DR_TPU_FLASH_STREAM", None)
     elif name == "spmv":
         m, half = 2 ** 15, 128
         rng = np.random.default_rng(1)
