@@ -160,18 +160,20 @@ def _build_streaming(BH: int, s: int, skv: int, d: int, bq: int, bk: int,
             lo_ref[0] = li_ref[0]
             acco_ref[0] = acci_ref[0]
 
-        # strictly-future K block for every q row in this tile?
-        contributes = (k_off + ik * bk <= q_lo + bq - 1) if causal \
-            else (ik >= 0)
-
-        @pl.when(contributes)
-        def _():
+        def update():
             new_m, new_l, new_acc = _block_update(
                 q_ref[0], k_ref[0], v_ref[0], mo_ref[0], lo_ref[0],
                 acco_ref[0], scale, causal, q_lo, k_off + ik * bk)
             mo_ref[0] = new_m
             lo_ref[0] = new_l
             acco_ref[0] = new_acc
+
+        if causal:
+            # skip the compute of strictly-future K blocks (every q row
+            # in this tile precedes the block)
+            pl.when(k_off + ik * bk <= q_lo + bq - 1)(update)
+        else:
+            update()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
