@@ -92,3 +92,19 @@ def test_marginal_fast_path_no_widening(monkeypatch):
                          rmax=4096)
     assert dt == pytest.approx(0.05, rel=1e-6)
     assert max(op.calls) == 36
+
+
+@pytest.mark.parametrize("mod,argv", [
+    ("vector_add", ["-n", "4096"]),
+    ("dot_product", ["-n", "4096"]),
+    ("inclusive_scan_example", ["-n", "4096"]),
+    ("views_example", []),
+])
+def test_example_smoke(mod, argv, monkeypatch, capsys):
+    """Examples double as integration tests (the reference pattern:
+    examples/mhp/stencil-1d.cpp:21-45 ships its own check()); each main
+    returns 0 only when its built-in oracle passes."""
+    import importlib
+    m = importlib.import_module(mod)
+    monkeypatch.setattr(sys, "argv", [mod] + argv)
+    assert m.main() in (0, None)
