@@ -178,3 +178,25 @@ def test_segment_range_standalone():
     import pytest
     with pytest.raises(IndexError):
         sr[4]
+
+
+def test_bound_op_materialization_matches_fused():
+    """views.transform with bound scalars: the lazy materialization
+    (to_array / segments) and the fused reduce agree."""
+    import numpy as np
+
+    def scaled(x, c):
+        return x * c
+
+    n = 300
+    src = np.linspace(0.1, 2, n).astype(np.float32)
+    dv = dr_tpu.distributed_vector.from_array(src)
+    v = dr_tpu.views.transform(dv, scaled, 3.0)
+    np.testing.assert_allclose(np.asarray(v.to_array()), src * 3.0,
+                               rtol=1e-6)
+    got = dr_tpu.reduce(v)
+    assert got == pytest.approx(float((src * 3.0).sum()), rel=1e-4)
+    # segments materialize through the bound op too
+    segs = dr_tpu.segments(v)
+    joined = np.concatenate([s.materialize() for s in segs])
+    np.testing.assert_allclose(joined[:n], src * 3.0, rtol=1e-6)
