@@ -290,3 +290,37 @@ def test_transform_reduce_streamed_coefficient():
     ref1 = float((2.0 * src.astype(np.float64) * (2.0 - src)).sum())
     assert r1 == pytest.approx(ref1, rel=1e-4)
     assert r2 == pytest.approx(-1.5 * r1, rel=1e-4)
+
+
+def test_nested_bound_ops_in_reduce_pipeline():
+    """BoundOp at BOTH levels: bound component transforms inside a zip
+    whose combine is also bound — scalar ordering (chain-major, then
+    zip op) through one fused program."""
+    from dr_tpu.algorithms.elementwise import _prog_cache
+
+    def shift(x, c):
+        return x + c
+
+    def wmul(x, y, w):
+        return w * x * y
+
+    n = 320
+    rng = np.random.default_rng(9)
+    xs = rng.standard_normal(n).astype(np.float32)
+    ys = rng.standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(xs)
+    b = dr_tpu.distributed_vector.from_array(ys)
+
+    def pipeline(c1, c2, w):
+        z = dr_tpu.views.zip(dr_tpu.views.transform(a, shift, c1),
+                             dr_tpu.views.transform(b, shift, c2))
+        return dr_tpu.reduce(dr_tpu.views.transform(z, wmul, w))
+
+    got = pipeline(0.5, -1.0, 2.0)
+    ref = float((2.0 * (xs.astype(np.float64) + 0.5) * (ys - 1.0)).sum())
+    assert got == pytest.approx(ref, rel=1e-3)
+    n_progs = len(_prog_cache)
+    got2 = pipeline(-2.0, 3.0, 0.25)
+    assert len(_prog_cache) == n_progs  # all five scalars traced
+    ref2 = float((0.25 * (xs.astype(np.float64) - 2.0) * (ys + 3.0)).sum())
+    assert got2 == pytest.approx(ref2, rel=1e-3)
