@@ -301,7 +301,7 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
         if a.ensure_bcsr():
             # block-structured: dense-tile MXU path, one gather per tile
             prog = _gemv_bcsr_program(rt.mesh, rt.axis, a.nshards,
-                                      a.tile_rows // a._BCSR_BH,
+                                      a._bcsr_nbr,
                                       a._bcsr_kb, c.segment_size,
                                       c.halo_bounds.prev)
             c._data = prog(c._data, a._bcsr_vals, a._bcsr_cols, b_arr)

@@ -129,6 +129,7 @@ class sparse_matrix:
         self._bcsr_vals = None
         self._bcsr_cols = None
         self._bcsr_kb = 0
+        self._bcsr_nbr = 0
         self._bcsr_state = "maybe"
         self._tile_nnz = np.zeros(P, dtype=np.int64)
         self._nnz = 0
@@ -257,18 +258,20 @@ class sparse_matrix:
             return False
         bh, bw = self._BCSR_BH, self._BCSR_BW
         th = self._th
-        if th % bh:
-            return False
         P = self._nshards
         counts = self._tile_nnz
         rows_h = np.asarray(self._rows)
         cols_h = np.asarray(self._cols)
-        nbr = th // bh                      # block-rows per shard tile
+        # block-rows per shard tile; an unaligned tile height gets a
+        # zero-padded remainder block-row (_bcsr_local slices the local
+        # result back to seg_out)
+        nbr = -(-th // bh)
         # pass 1: per-shard block-row tile lists (block col ids); the
         # values stay on device until the gates below admit the layout
         per = []                            # (shard) -> {(br, cb)} maps
         kb = 1
         total_tiles = 0
+        total_cells = 0
         for t in range(P):
             c = int(counts[t])
             br = rows_h[t, :c] // bh
@@ -277,10 +280,19 @@ class sparse_matrix:
                              | cb.astype(np.int64))
             per.append(keys)
             total_tiles += len(keys)
+            # occupiable cells only: a remainder block-row (unaligned
+            # tile height) holds fewer than bh real rows, and the last
+            # block-column of a narrow matrix fewer than bw real
+            # columns — padding must not deflate the fill gate
+            kbr = (keys >> 32).astype(np.int64)
+            kcb = (keys & 0xFFFFFFFF).astype(np.int64)
+            rows_in = np.minimum(bh, th - kbr * bh)
+            cols_in = np.minimum(bw, self.shape[1] - kcb * bw)
+            total_cells += int((rows_in * cols_in).sum())
             if c:
                 kb = max(kb, int(np.bincount(
-                    (keys >> 32).astype(np.int64), minlength=nbr).max()))
-        fill = self._nnz / max(total_tiles * bh * bw, 1)
+                    kbr, minlength=nbr).max()))
+        fill = self._nnz / max(total_cells, 1)
         # skew gate: the block-ELL width kb applies to EVERY block-row,
         # so one dense block-row must not balloon the allocation — bound
         # kb by the average occupancy (the _ELL_FACTOR analog).  Mostly
@@ -319,6 +331,7 @@ class sparse_matrix:
         self._bcsr_vals = jax.device_put(jnp.asarray(bvals), sh)
         self._bcsr_cols = jax.device_put(jnp.asarray(bcols), shc)
         self._bcsr_kb = kb
+        self._bcsr_nbr = nbr
         self._bcsr_state = "yes"
         return True
 

@@ -153,8 +153,11 @@ def test_distributed_scan_with_kernel_interpret(monkeypatch):
         functools.partial(scan_pallas.chunked_cumsum, interpret=True))
     P = dr_tpu.nprocs()
     # seg stays 128*128 (lane-chunkable) but n is NOT P*seg: the last
-    # shard's tail is pad, exercising the gid<n mask ahead of the kernel
-    n = 128 * 128 * P - 3
+    # shard's tail is pad, exercising the gid<n mask ahead of the
+    # kernel.  The shortfall must stay < P so ceil(n/P) == 128*128 at
+    # EVERY mesh size (a fixed -3 made 3 | n at P=3, shrinking seg to a
+    # non-chunkable 16383)
+    n = 128 * 128 * P - max(P - 1, 0)
     rng = np.random.default_rng(12)
     src = rng.standard_normal(n).astype(np.float32)
     a = dr_tpu.distributed_vector.from_array(src)

@@ -94,11 +94,14 @@ def test_zip_common_prefix_aligns(dv):
     # same segment size, shorter vector: zip trims both lists to the common
     # prefix and stays aligned (an improvement over the reference, which
     # only compares full segment lists)
-    other = dr_tpu.distributed_vector(17, dtype=np.int32)
+    # one shorter than dv keeps ceil(n/P) equal at every mesh size
+    # (17 vs 24 diverges at P=3: seg 6 vs 8 -> correctly misaligned)
+    n_other = len(dv) - 1
+    other = dr_tpu.distributed_vector(n_other, dtype=np.int32)
     dr_tpu.iota(other, 0)
     z = views.zip_view(dv, other)
     segs = dr_tpu.segments(z)
-    assert segs and sum(len(s) for s in segs) == 17
+    assert segs and sum(len(s) for s in segs) == n_other
 
 
 def test_zip_shifted_misaligned(dv):
