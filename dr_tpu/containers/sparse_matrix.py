@@ -286,7 +286,9 @@ class sparse_matrix:
             # columns — padding must not deflate the fill gate
             kbr = (keys >> 32).astype(np.int64)
             kcb = (keys & 0xFFFFFFFF).astype(np.int64)
-            rows_in = np.minimum(bh, th - kbr * bh)
+            # the LAST tile's real height can be shorter than th too
+            real_h = max(0, min(th, self._m - (t // self._grid[1]) * th))
+            rows_in = np.maximum(np.minimum(bh, real_h - kbr * bh), 0)
             cols_in = np.minimum(bw, self.shape[1] - kcb * bw)
             total_cells += int((rows_in * cols_in).sum())
             if c:

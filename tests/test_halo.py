@@ -136,8 +136,11 @@ def test_halo_of_view():
 
 def test_exchange_begin_finalize():
     hb = dr_tpu.halo_bounds(1, 1)
+    # every shard must own elements at ANY mesh size (16 over 5 shards
+    # leaves the last shard empty under the ceil layout)
+    n = 4 * dr_tpu.nprocs()
     dv = dr_tpu.distributed_vector.from_array(
-        np.arange(16, dtype=np.float32), halo=hb)
+        np.arange(n, dtype=np.float32), halo=hb)
     h = dr_tpu.halo(dv)
     h.exchange_begin()
     h.exchange_finalize()

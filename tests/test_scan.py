@@ -156,7 +156,8 @@ def test_distributed_scan_with_kernel_interpret(monkeypatch):
     # shard's tail is pad, exercising the gid<n mask ahead of the
     # kernel.  The shortfall must stay < P so ceil(n/P) == 128*128 at
     # EVERY mesh size (a fixed -3 made 3 | n at P=3, shrinking seg to a
-    # non-chunkable 16383)
+    # non-chunkable 16383).  At P=1 there is no pad tail — the mask
+    # path is then covered by the multi-device runs.
     n = 128 * 128 * P - max(P - 1, 0)
     rng = np.random.default_rng(12)
     src = rng.standard_normal(n).astype(np.float32)

@@ -281,9 +281,11 @@ def test_bcsr_skew_guard():
 
 def test_bcsr_unaligned_tile_height():
     """th % 8 != 0 (remainder block-row zero-padded): the BCSR path
-    stays eligible and matches the dense oracle — at the default
-    8-device mesh, m=44 gives th=6."""
-    m = 44
+    stays eligible and matches the dense oracle.  m is derived so the
+    tile height is unaligned at ANY mesh size (6P-2 -> th in {5, 6},
+    never a multiple of 8)."""
+    P = dr_tpu.nprocs()
+    m = max(6 * P - 2, 12)
     rng = np.random.default_rng(60)
     d = np.zeros((m, m), dtype=np.float32)
     half = 5
