@@ -115,7 +115,7 @@ def test_fuzz_distributions(seed):
         src = rng.standard_normal(n).astype(np.float32)
         dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
         alg = rng.choice(["roundtrip", "transform", "reduce", "scan",
-                          "putget"])
+                          "putget", "axpy"])
         if alg == "roundtrip":
             np.testing.assert_allclose(dr_tpu.to_numpy(dv), src,
                                        rtol=1e-6)
@@ -140,6 +140,16 @@ def test_fuzz_distributions(seed):
             np.testing.assert_allclose(dr_tpu.to_numpy(out),
                                        np.cumsum(src, dtype=np.float32),
                                        rtol=1e-3, atol=1e-4)
+        elif alg == "axpy":
+            # traced scalar over an uneven distribution: same-layout zip
+            p_src = rng.standard_normal(n).astype(np.float32)
+            pv = dr_tpu.distributed_vector.from_array(
+                p_src, distribution=sizes)
+            alpha = float(rng.standard_normal())
+            dr_tpu.transform(views.zip(dv, pv), dv, _fuzz_axpy, alpha)
+            np.testing.assert_allclose(
+                dr_tpu.to_numpy(dv),
+                src + np.float32(alpha) * p_src, rtol=1e-5, atol=1e-5)
         else:
             k = int(rng.integers(1, min(8, n) + 1))
             idx = rng.choice(n, size=k, replace=False)
