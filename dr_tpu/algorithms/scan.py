@@ -110,11 +110,14 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
             and scan_pallas.pick_chunk(seg) is not None)
 
 
-def _kernel_variant() -> str:
-    """Trace-time kernel-variant selector (DR_TPU_SCAN_KERNEL): part of
-    every program cache key so A/B sweeps rebuild instead of reusing
-    the other variant's cached program."""
-    return os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower()
+def _kernel_variant():
+    """Trace-time kernel knobs (DR_TPU_SCAN_KERNEL variant and
+    DR_TPU_SCAN_CHUNK cap): part of every program cache key so A/B
+    sweeps rebuild instead of reusing the other configuration's cached
+    program."""
+    from ..ops import scan_pallas
+    return (os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower(),
+            scan_pallas.chunk_cap())
 
 
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,

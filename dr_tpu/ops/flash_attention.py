@@ -69,14 +69,11 @@ def pick_blocks(s: int, skv: int, d: int):
     # a large skv only gates when streaming is explicitly disabled
     if not use_streaming(skv, d) and not resident_fits(skv, d):
         return None
-    def pow2_cap(env, default):
-        # round down to a power of two: pick() only guarantees the
-        # sublane/lane tile alignment promised below for 2^k tiles
-        v = max(1, int(os.environ.get(env, default)))
-        return 1 << (v.bit_length() - 1)
-
-    cap_q = pow2_cap("DR_TPU_FLASH_BQ", "2048")
-    cap_k = pow2_cap("DR_TPU_FLASH_BK", "1024")
+    from ..utils.env import env_pow2
+    # round down to a power of two: pick() only guarantees the
+    # sublane/lane tile alignment promised below for 2^k tiles
+    cap_q = env_pow2("DR_TPU_FLASH_BQ", 2048)
+    cap_k = env_pow2("DR_TPU_FLASH_BK", 1024)
     bq = pick(s, cap_q, 16)  # sublane-aligned q tile (bf16 tile: (16, 128))
     bk = pick(skv, cap_k, 128)  # lane-aligned k tile (logits last dim)
     if bq is None or bk is None:

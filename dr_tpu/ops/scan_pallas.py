@@ -40,11 +40,22 @@ __all__ = ["chunked_cumsum", "pick_chunk", "prefix_matrix",
            "supported"]
 
 LANES = 128
-_MAX_ROWS = 512  # chunk rows: bounds the (R, R) row-offset operator
+_MAX_ROWS = 512  # default chunk rows: bounds the (R, R) row-offset operator
 
 
 def supported() -> bool:
     return _HAS_PLTPU
+
+
+def chunk_cap() -> int:
+    """Chunk-rows cap, DR_TPU_SCAN_CHUNK-overridable (rounded down to a
+    power of two, tolerant parse) for on-device tuning: larger chunks
+    amortize the sequential grid's per-step overhead; the (R, R)
+    matmul-variant offset operator and the 4*R KiB VMEM buffers push
+    back.  Read per call — scan program caches key on it
+    (algorithms/scan.py ``_kernel_variant``)."""
+    from ..utils.env import env_pow2
+    return env_pow2("DR_TPU_SCAN_CHUNK", _MAX_ROWS, floor=LANES)
 
 
 def pick_chunk(n: int):
@@ -53,7 +64,7 @@ def pick_chunk(n: int):
     if n % LANES:
         return None
     rows = n // LANES
-    R = _MAX_ROWS
+    R = chunk_cap()
     while R >= LANES:
         if rows % R == 0:
             return R

@@ -182,11 +182,11 @@ def _chunk_cap() -> int:
     """Fused-apply chunk cap (lane columns per DMA chunk): overridable
     per call via DR_TPU_MM_CHUNK_CAP for on-device tuning — the grid's
     per-step overhead amortizes with larger chunks until VMEM pressure
-    pushes back.  Rounded down to a power of two: _pick_chunk_rows
-    halves the cap looking for a divisor, so a non-2^k cap would
-    silently collapse the chunk size to ~1."""
-    v = max(1, int(os.environ.get("DR_TPU_MM_CHUNK_CAP", "4096")))
-    return 1 << (v.bit_length() - 1)
+    pushes back.  Rounded down to a power of two (tolerant parse):
+    _pick_chunk_rows halves the cap looking for a divisor, so a non-2^k
+    cap would silently collapse the chunk size to ~1."""
+    from ..utils.env import env_pow2
+    return env_pow2("DR_TPU_MM_CHUNK_CAP", 4096)
 
 
 def _pick_chunk_rows(segc: int, cap: int | None = None):

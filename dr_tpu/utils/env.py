@@ -1,0 +1,27 @@
+"""Tuning-knob env parsing shared by the kernels.
+
+Every on-device tuning variable (``DR_TPU_MM_CHUNK_CAP``,
+``DR_TPU_SCAN_CHUNK``, ``DR_TPU_FLASH_BQ/BK``) is a power-of-two cap
+read per call (so sweeps work in-process) and keyed into the relevant
+program caches.  Parsing is TOLERANT: a malformed value falls back to
+the default instead of taking down every caller at trace time — a typo
+in a tuning sweep must not brick unrelated programs.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_pow2"]
+
+
+def env_pow2(name: str, default: int, floor: int = 1) -> int:
+    """``max(floor, int($name))`` rounded DOWN to a power of two;
+    ``default`` on a missing or malformed value."""
+    raw = os.environ.get(name)
+    try:
+        v = int(raw) if raw is not None else default
+    except ValueError:
+        v = default
+    v = max(floor, v)
+    return 1 << (v.bit_length() - 1)

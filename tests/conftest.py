@@ -39,6 +39,19 @@ def _fresh_runtime():
     dr_tpu.final()
 
 
+@pytest.fixture(autouse=True)
+def _clear_tuning_knobs(monkeypatch):
+    """Tests run at the DEFAULT kernel configuration: an ambient tuning
+    sweep's env (tools/tune_tpu.py exports these) must not shift chunk
+    sizes, tiles, or variants under geometry-sensitive assertions."""
+    for var in ("DR_TPU_SCAN_CHUNK", "DR_TPU_SCAN_KERNEL",
+                "DR_TPU_MM_CHUNK_CAP", "DR_TPU_MM_BAND_COLS",
+                "DR_TPU_FLASH_BQ", "DR_TPU_FLASH_BK",
+                "DR_TPU_FLASH_STREAM", "DR_TPU_MM_PRECISION",
+                "DR_TPU_GATHER_W"):
+        monkeypatch.delenv(var, raising=False)
+
+
 @pytest.fixture(params=[1, 2, 3, 4, 8])
 def mesh_size(request):
     """Rank sweep, mirroring the reference's mpiexec -n {1,2,3,4} runs.

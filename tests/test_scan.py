@@ -199,3 +199,18 @@ def test_chunked_cumsum_vpu_variant_interpret(monkeypatch):
     got = np.asarray(scan_pallas.chunked_cumsum(x, interpret=True))
     ref = np.cumsum(np.asarray(x, np.float64))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-3)
+
+
+def test_scan_chunk_cap_env(monkeypatch):
+    """DR_TPU_SCAN_CHUNK tunes pick_chunk (pow2-rounded) and the kernel
+    still matches numpy at a non-default chunk."""
+    from dr_tpu.ops import scan_pallas
+    monkeypatch.setenv("DR_TPU_SCAN_CHUNK", "3000")  # rounds to 2048
+    assert scan_pallas.chunk_cap() == 2048
+    n = 128 * 2048
+    assert scan_pallas.pick_chunk(n) == 2048
+    rng = np.random.default_rng(14)
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    got = np.asarray(scan_pallas.chunked_cumsum(x, interpret=True))
+    np.testing.assert_allclose(got, np.cumsum(np.asarray(x, np.float64)),
+                               rtol=1e-5, atol=1e-2)

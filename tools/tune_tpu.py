@@ -90,11 +90,13 @@ def tune_scan():
     x = jnp.ones((n,), jnp.float32)
     print("pick_chunk:", scan_pallas.pick_chunk(n), flush=True)
 
-    for variant in ("mxu", "vpu"):
+    for variant, cap in (("mxu", 512), ("vpu", 512), ("mxu", 2048),
+                         ("vpu", 2048), ("vpu", 4096)):
         if variant == "vpu":
             os.environ["DR_TPU_SCAN_KERNEL"] = "vpu"
         else:
             os.environ.pop("DR_TPU_SCAN_KERNEL", None)
+        os.environ["DR_TPU_SCAN_CHUNK"] = str(cap)
 
         @jax.jit
         def run(x, r, salt):
@@ -113,12 +115,13 @@ def tune_scan():
             return float(run(x, r, s[0]))
         try:
             dt = _marginal(sync)
-            print(f"scan kernel [{variant}]: {dt * 1e3:.3f} ms -> "
-                  f"{2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
+            print(f"scan kernel [{variant} R={cap}]: {dt * 1e3:.3f} ms "
+                  f"-> {2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
         except Exception as e:
-            print(f"scan kernel [{variant}]: FAIL "
+            print(f"scan kernel [{variant} R={cap}]: FAIL "
                   f"{_errline(e)}", flush=True)
     os.environ.pop("DR_TPU_SCAN_KERNEL", None)
+    os.environ.pop("DR_TPU_SCAN_CHUNK", None)
 
 
 def tune_container(name):
