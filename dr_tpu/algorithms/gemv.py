@@ -19,8 +19,6 @@ it ships.)
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -66,7 +64,8 @@ def _gather_w() -> int:
     call so DR_TPU_GATHER_W sweeps work in-process — but note the ELL
     program caches do NOT key on it; clear caches (fresh process) or
     vary the layout between sweep points."""
-    return int(os.environ.get("DR_TPU_GATHER_W", "16"))
+    from ..utils.env import env_int
+    return env_int("DR_TPU_GATHER_W", 16)
 _ELL_CHUNK = 2 ** 13  # tile rows per lax.map chunk (bounds intermediates)
 
 

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.env import env_int
+
 __all__ = ["composed_taps", "matmul_stencil_row", "max_ksteps"]
 
 LANES = 128
@@ -61,7 +63,7 @@ def max_ksteps(radius: int, ncols: int | None = None) -> int:
     with the 3-pass HIGH-emulated apply the MXU stays under the DMA
     floor up to about 4 columns)."""
     if ncols is None:
-        ncols = max(1, int(os.environ.get("DR_TPU_MM_BAND_COLS", "2")))
+        ncols = env_int("DR_TPU_MM_BAND_COLS", 2)
     return ncols * LANES // radius
 
 
@@ -159,7 +161,7 @@ def _emulate_high(dtype) -> bool:
 
 # rows per matmul chunk: bounds the (chunk, 384) product intermediate so
 # billion-element rows don't triple HBM residency
-_CHUNK_ROWS = int(os.environ.get("DR_TPU_MM_CHUNK_ROWS", str(2 ** 16)))
+_CHUNK_ROWS = env_int("DR_TPU_MM_CHUNK_ROWS", 2 ** 16)
 
 
 def _apply(src, W, segc, D=1):
@@ -185,7 +187,7 @@ def _chunk_cap() -> int:
     pushes back.  Rounded down to a power of two (tolerant parse):
     _pick_chunk_rows halves the cap looking for a divisor, so a non-2^k
     cap would silently collapse the chunk size to ~1."""
-    from ..utils.env import env_pow2
+    from ..utils.env import env_pow2  # pow2 only used here
     return env_pow2("DR_TPU_MM_CHUNK_CAP", 4096)
 
 

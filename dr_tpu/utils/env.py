@@ -12,16 +12,22 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["env_pow2"]
+__all__ = ["env_int", "env_pow2"]
 
 
-def env_pow2(name: str, default: int, floor: int = 1) -> int:
-    """``max(floor, int($name))`` rounded DOWN to a power of two;
-    ``default`` on a missing or malformed value."""
+def env_int(name: str, default: int, floor: int = 1) -> int:
+    """``max(floor, int($name))``; ``default`` on a missing or
+    malformed value."""
     raw = os.environ.get(name)
     try:
         v = int(raw) if raw is not None else default
     except ValueError:
         v = default
-    v = max(floor, v)
+    return max(floor, v)
+
+
+def env_pow2(name: str, default: int, floor: int = 1) -> int:
+    """``max(floor, int($name))`` rounded DOWN to a power of two;
+    ``default`` on a missing or malformed value."""
+    v = env_int(name, default, floor)
     return 1 << (v.bit_length() - 1)

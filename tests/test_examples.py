@@ -108,3 +108,24 @@ def test_example_smoke(mod, argv, monkeypatch, capsys):
     m = importlib.import_module(mod)
     monkeypatch.setattr(sys, "argv", [mod] + argv)
     assert m.main() in (0, None)
+
+
+def test_env_knob_tolerant_parsing(monkeypatch):
+    """Malformed tuning env values fall back to defaults instead of
+    raising at trace time."""
+    from dr_tpu.utils.env import env_int, env_pow2
+
+    monkeypatch.setenv("DR_TPU_TEST_KNOB", "2k")
+    assert env_int("DR_TPU_TEST_KNOB", 7) == 7
+    assert env_pow2("DR_TPU_TEST_KNOB", 512) == 512
+    monkeypatch.setenv("DR_TPU_TEST_KNOB", "3000")
+    assert env_pow2("DR_TPU_TEST_KNOB", 512) == 2048
+    monkeypatch.setenv("DR_TPU_TEST_KNOB", "-4")
+    assert env_int("DR_TPU_TEST_KNOB", 7, floor=2) == 2
+
+    # the kernels survive a typo'd knob end-to-end
+    from dr_tpu.ops import scan_pallas, stencil_matmul
+    monkeypatch.setenv("DR_TPU_SCAN_CHUNK", "oops")
+    assert scan_pallas.chunk_cap() == scan_pallas._MAX_ROWS
+    monkeypatch.setenv("DR_TPU_MM_BAND_COLS", "wide")
+    assert stencil_matmul.max_ksteps(2) == 128
