@@ -380,6 +380,14 @@ def _zip_foreach_program(ins, outs, fn, alias, nscalars=0):
     cont = outs[0].cont
     off, n = outs[0].off, outs[0].n
     in_ops = tuple(c.ops for c in ins)
+    # The body below applies chain ops by DIRECT CALL, which bakes any
+    # BoundOp scalar values into the compiled program — while _Chain.key
+    # keys BoundOps by scalar COUNT.  Pairing the two would silently
+    # reuse stale scalars, so enforce the invariant _out_chain provides
+    # (zip components are outputs and outputs carry no ops).
+    assert not any(isinstance(o, _v.BoundOp) for ops in in_ops
+                   for o in ops), \
+        "zip for_each chains must not carry BoundOps (value-baking body)"
 
     def body(*datas):
         out_datas = datas[:k]

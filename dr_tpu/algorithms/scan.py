@@ -111,13 +111,14 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
 
 
 def _kernel_variant():
-    """Trace-time kernel knobs (DR_TPU_SCAN_KERNEL variant and
-    DR_TPU_SCAN_CHUNK cap): part of every program cache key so A/B
-    sweeps rebuild instead of reusing the other configuration's cached
-    program."""
+    """Trace-time kernel knobs (DR_TPU_SCAN_KERNEL variant,
+    DR_TPU_SCAN_CHUNK cap, DR_TPU_SCAN_PASSES split depth): part of
+    every program cache key so A/B sweeps rebuild instead of reusing
+    the other configuration's cached program."""
     from ..ops import scan_pallas
     return (os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower(),
-            scan_pallas.chunk_cap())
+            os.environ.get("DR_TPU_SCAN_PIPE", "").strip().lower(),
+            scan_pallas.chunk_cap(), scan_pallas.scan_passes())
 
 
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,

@@ -254,7 +254,7 @@ class sparse_matrix:
             return True
         if self._bcsr_state == "no" or self._vals is None:
             return False
-        if self.grid_shape[1] != 1 or not self._vals.is_fully_addressable:
+        if not self._vals.is_fully_addressable:
             return False
         bh, bw = self._BCSR_BH, self._BCSR_BW
         th = self._th
@@ -286,10 +286,15 @@ class sparse_matrix:
             # columns — padding must not deflate the fill gate
             kbr = (keys >> 32).astype(np.int64)
             kcb = (keys & 0xFFFFFFFF).astype(np.int64)
-            # the LAST tile's real height can be shorter than th too
-            real_h = max(0, min(th, self._m - (t // self._grid[1]) * th))
+            # the LAST tile's real height/width can be shorter than
+            # th/tw too; kcb is TILE-local, so the column bound is the
+            # tile's own width, not the full matrix width (round-2
+            # advisor: shape[1] here overcounts cells on 2-D grids)
+            gq = self._grid[1]
+            real_h = max(0, min(th, self._m - (t // gq) * th))
+            real_w = max(0, min(self._tw, self._n - (t % gq) * self._tw))
             rows_in = np.maximum(np.minimum(bh, real_h - kbr * bh), 0)
-            cols_in = np.minimum(bw, self.shape[1] - kcb * bw)
+            cols_in = np.maximum(np.minimum(bw, real_w - kcb * bw), 0)
             total_cells += int((rows_in * cols_in).sum())
             if c:
                 kb = max(kb, int(np.bincount(

@@ -12,12 +12,23 @@ so the carry "fixup" is a free broadcast-add while the chunk is still
 resident.
 
 Layout: x viewed as (rows, 128) lane-blocked; flat order is row-major,
-so the prefix decomposes as
+so the prefix decomposes HIERARCHICALLY (rows split into groups of 128):
   within-row lane prefix      (rows @ U128, upper-triangular ones, MXU)
-  + exclusive row offset      (Lstrict @ row_totals: strictly-LOWER
-                               triangular ones on the sublane axis —
-                               no cross-layout reshapes, all MXU)
+  + within-group row offset   (row totals reshaped (G, 128), one
+                               (G,128) @ Ustrict128 MXU matmul)
+  + group offset              ((G, G) strictly-lower matvec — one tile)
   + chunk carry               (SMEM scalar across the sequential grid).
+The round-2 kernel computed the row offset with ONE (R, R) strictly-
+lower operator instead; its O(R^2) cost forced R=512 chunks and the
+2048-step sequential grid ran per-step-overhead-bound at 148 GB/s
+(19% of HBM).  The hierarchy caps every operator at one MXU tile, so
+chunks grow until the DMA dominates.
+
+Precision: the prefix operators are 0/1 matrices — EXACT in bf16 — so
+``x @ U`` with x split into k bf16 terms (hi + residuals) costs k
+DEFAULT-precision MXU passes and reconstructs the f32 product to term
+precision (k=3 ~ f32-exact, the HIGHEST semantics at half the passes;
+DR_TPU_SCAN_PASSES to sweep, 0 = plain f32 HIGHEST).
 
 Reference workload: ``shp/algorithms/inclusive_scan.hpp:25-148``
 (BASELINE.json config 3).
@@ -40,7 +51,8 @@ __all__ = ["chunked_cumsum", "pick_chunk", "prefix_matrix",
            "supported"]
 
 LANES = 128
-_MAX_ROWS = 512  # default chunk rows: bounds the (R, R) row-offset operator
+_MAX_ROWS = 4096  # default chunk rows (hierarchical offsets: no (R, R)
+# operator to bound — the cap is the 2x double-buffered VMEM footprint)
 
 
 def supported() -> bool:
@@ -84,23 +96,148 @@ def prefix_matrix(k: int):
 
 @functools.lru_cache(maxsize=8)
 def _strict_lower(k: int):
-    """(Lstrict @ col)[i] = sum_{r<i} col[r]: the exclusive row-offset
+    """(Lstrict @ col)[i] = sum_{r<i} col[r]: the exclusive group-offset
     operator (NUMPY, see prefix_matrix)."""
     return np.tril(np.ones((k, k), dtype=np.float32), -1)
 
 
+@functools.lru_cache(maxsize=8)
+def _strict_upper(k: int):
+    """(rows @ Ustrict)[g, i] = sum_{i'<i} rows[g, i']: the exclusive
+    within-group row-offset operator (NUMPY, see prefix_matrix)."""
+    return np.triu(np.ones((k, k), dtype=np.float32), 1)
+
+
+def scan_passes() -> int:
+    """bf16 term count for the lane-prefix matmul (DR_TPU_SCAN_PASSES):
+    k terms cost k DEFAULT MXU passes and keep ~8k mantissa bits of the
+    input (the 0/1 operator is exact in bf16, so all error is in the
+    split).  0 selects plain f32 HIGHEST (6 fused passes).  Default 3
+    ~ f32-exact."""
+    from ..utils.env import env_int
+    return min(env_int("DR_TPU_SCAN_PASSES", 3, floor=0), 3)
+
+
+def _bf16_terms(x, k: int):
+    """k bf16 terms summing to x (f32) to ~8k mantissa bits; the last
+    term absorbs the running residual."""
+    terms = []
+    for _ in range(k - 1):
+        t = x.astype(jnp.bfloat16)
+        terms.append(t)
+        x = x - t.astype(jnp.float32)
+    terms.append(x.astype(jnp.bfloat16))
+    return terms
+
+
+def _chunk_prefix(x, u_ref, us_ref, lg_ref, carry_val, vpu, passes, G):
+    """One chunk's inclusive prefix (f32) given the incoming carry;
+    returns ``(out, chunk_total)``.  Shared by the manual-DMA and the
+    auto-pipelined kernel bodies."""
+    R = x.shape[0]
+    if vpu:
+        # log-step shifted adds on the vector unit (Hillis-Steele
+        # along lanes; Mosaic has no cumsum primitive, but lane
+        # rolls + masked adds lower fine)
+        P1 = x
+        lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        d = 1
+        while d < LANES:
+            sh = pltpu.roll(P1, d, 1)
+            P1 = P1 + jnp.where(lane >= d, sh, 0.0)
+            d *= 2
+    elif passes:
+        # lane prefix within each 128-wide row: the 0/1 operator is
+        # EXACT in bf16, so k split terms = k DEFAULT MXU passes
+        # with ~8k-bit effective input mantissa
+        P1 = None
+        for t in _bf16_terms(x, passes):
+            p = lax.dot_general(t, u_ref[:], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            P1 = p if P1 is None else P1 + p
+    else:
+        P1 = lax.dot_general(x, u_ref[:].astype(jnp.float32),
+                             (((1,), (0,)), ((), ())),
+                             precision=lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+    # hierarchical row offsets: totals regrouped (G, 128) so both
+    # prefix operators stay single-tile MXU work at any R
+    row_tot = P1[:, LANES - 1:LANES]              # (R, 1)
+    t2 = row_tot.reshape(G, LANES)                # (G, 128)
+    o2 = lax.dot_general(t2, us_ref[:], (((1,), (0,)), ((), ())),
+                         precision=lax.Precision.HIGHEST,
+                         preferred_element_type=jnp.float32)
+    s = (o2[:, LANES - 1:LANES]
+         + t2[:, LANES - 1:LANES])                # (G, 1) group sums
+    go = lax.dot_general(lg_ref[:], s, (((1,), (0,)), ((), ())),
+                         precision=lax.Precision.HIGHEST,
+                         preferred_element_type=jnp.float32)  # (G, 1)
+    off = o2 + go                                 # (G, 128) row offs
+    out = (P1.reshape(G, LANES, LANES)
+           + off[:, :, None] + carry_val).reshape(R, LANES)
+    return out, go[G - 1, 0] + s[G - 1, 0]
+
+
 @functools.lru_cache(maxsize=16)
-def _build(rows: int, R: int, dtype_name: str, interpret: bool,
-           vpu: bool = False):
-    """``vpu=True`` swaps the two MXU matmuls for log-step cumsums on
-    the vector unit — same math, different unit; which wins on a given
-    chip generation is an empirical question (DR_TPU_SCAN_KERNEL=vpu to
-    select, tools/tune_tpu.py to measure)."""
+def _build_grid(rows: int, R: int, dtype_name: str, interpret: bool,
+                vpu: bool = False, passes: int = 3):
+    """Auto-pipelined form: a sequential TPU grid over (R, 128) blocks
+    with Mosaic's implicit double-buffered block DMA; only the carry is
+    explicit state (SMEM scratch persists across grid steps).  Simpler
+    than the manual-DMA form and lets the compiler overlap the i-1
+    out-copy, the i compute, and the i+1 in-copy."""
     dtype = jnp.dtype(dtype_name)
     nch = rows // R
+    G = R // LANES
 
-    def kernel(u_ref, lo_ref, x_hbm, out_hbm, vin, vout, carry, in_sem,
-               out_sem):
+    def kernel(u_ref, us_ref, lg_ref, x_ref, o_ref, carry):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            carry[0, 0] = jnp.zeros((), jnp.float32)
+
+        x = x_ref[...].astype(jnp.float32)
+        out, tot = _chunk_prefix(x, u_ref, us_ref, lg_ref, carry[0, 0],
+                                 vpu, passes, G)
+        o_ref[...] = out.astype(dtype)
+        carry[0, 0] = carry[0, 0] + tot
+
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20,
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec((R, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((R, LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), dtype),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _build(rows: int, R: int, dtype_name: str, interpret: bool,
+           vpu: bool = False, passes: int = 3):
+    """Manual double-buffered DMA form (DR_TPU_SCAN_PIPE=manual);
+    ``vpu=True`` swaps the lane-prefix matmul for a log-step
+    Hillis-Steele on the vector unit (``pltpu.roll`` shifted adds) —
+    same math, different unit; which wins on a given chip generation is
+    an empirical question (DR_TPU_SCAN_KERNEL=vpu to select,
+    tools/tune_tpu.py to measure)."""
+    dtype = jnp.dtype(dtype_name)
+    nch = rows // R
+    G = R // LANES
+
+    def kernel(u_ref, us_ref, lg_ref, x_hbm, out_hbm, vin, vout, carry,
+               in_sem, out_sem):
         # carry lives in SMEM: scalar state across the sequential grid
         i = pl.program_id(0)
         slot = lax.rem(i, 2)
@@ -129,29 +266,9 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool,
             out_dma(i - 2, slot).wait()
 
         x = vin[slot].astype(jnp.float32)
-        if vpu:
-            # log-step shifted adds on the vector unit; the f32 HIGHEST
-            # matmuls cost 6 MXU passes each, which can exceed the DMA
-            # floor — the VPU does the same prefix in ~7+9 vector steps
-            P1 = jnp.cumsum(x, axis=1)
-            row_tot = P1[:, LANES - 1:LANES]          # (R, 1)
-            incl_rows = jnp.cumsum(row_tot, axis=0)   # (R, 1)
-            excl_rows = incl_rows - row_tot
-        else:
-            # lane prefix within each 128-wide row (MXU)
-            P1 = lax.dot_general(x, u_ref[:], (((1,), (0,)), ((), ())),
-                                 precision=lax.Precision.HIGHEST,
-                                 preferred_element_type=jnp.float32)
-            row_tot = P1[:, LANES - 1:LANES]          # (R, 1)
-            # exclusive row offsets on the SUBLANE axis: one (R, R)
-            # strictly-lower matmul — no cross-layout reshapes
-            excl_rows = lax.dot_general(
-                lo_ref[:], row_tot, (((1,), (0,)), ((), ())),
-                precision=lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32)   # (R, 1)
-        out = P1 + excl_rows + carry[0, 0]
-        carry[0, 0] = (carry[0, 0] + excl_rows[R - 1, 0]
-                       + row_tot[R - 1, 0])
+        out, tot = _chunk_prefix(x, u_ref, us_ref, lg_ref, carry[0, 0],
+                                 vpu, passes, G)
+        carry[0, 0] = carry[0, 0] + tot
         vout[slot] = out.astype(dtype)
         out_dma(i, slot).start()
 
@@ -172,6 +289,7 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool,
         kernel,
         grid=(nch,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -200,14 +318,19 @@ def chunked_cumsum(x, *, interpret: bool = False):
     R = pick_chunk(n)
     assert R is not None, "no lane-aligned chunking for this length"
     rows = n // LANES
+    G = R // LANES
     vpu = os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower() == "vpu"
-    fn = _build(rows, R, str(x.dtype), interpret, vpu)
+    passes = scan_passes()
+    manual = (os.environ.get("DR_TPU_SCAN_PIPE", "").strip().lower()
+              == "manual")
+    build = _build if manual else _build_grid
+    fn = build(rows, R, str(x.dtype), interpret, vpu, passes)
     if vpu:
-        # the vpu kernel never reads the matmul operands: ship 1x1
-        # dummies instead of the (128,128)+(R,R) matrices (the whole
-        # point of the variant is minimal VMEM/HBM traffic)
-        U = L = jnp.zeros((1, 1), jnp.float32)
+        # the vpu kernel never reads the lane-prefix operand
+        U = jnp.zeros((1, 1), jnp.bfloat16)
     else:
-        U = jnp.asarray(prefix_matrix(LANES), jnp.float32)
-        L = jnp.asarray(_strict_lower(R), jnp.float32)
-    return fn(U, L, x.reshape(rows, LANES)).reshape(n)
+        U = jnp.asarray(prefix_matrix(LANES),
+                        jnp.bfloat16 if passes else jnp.float32)
+    Us = jnp.asarray(_strict_upper(LANES), jnp.float32)
+    Lg = jnp.asarray(_strict_lower(G), jnp.float32)
+    return fn(U, Us, Lg, x.reshape(rows, LANES)).reshape(n)

@@ -28,6 +28,8 @@ def env_int(name: str, default: int, floor: int = 1) -> int:
 
 def env_pow2(name: str, default: int, floor: int = 1) -> int:
     """``max(floor, int($name))`` rounded DOWN to a power of two;
-    ``default`` on a missing or malformed value."""
+    ``default`` on a missing or malformed value.  The floor is re-applied
+    AFTER the round-down so a non-power-of-two floor can't be undershot
+    (floor=100, value=100 must not yield 64)."""
     v = env_int(name, default, floor)
-    return 1 << (v.bit_length() - 1)
+    return max(floor, 1 << (v.bit_length() - 1))

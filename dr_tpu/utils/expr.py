@@ -1,0 +1,89 @@
+"""Expression-DSL compiler for the native (C++) bridge.
+
+The reference's algorithms take arbitrary C++ callables (e.g. the
+stencil lambda at ``examples/mhp/stencil-1d.cpp:16-19`` or the
+``transform_reduce`` multiply at ``examples/shp/dot_product.cpp:11-18``).
+JAX cannot trace a C++ lambda, so the native API ships an arithmetic
+expression DSL instead (SURVEY.md §7 hard-part 2, option (a)): the C++
+side (``native/bridge/thp_bridge.hpp`` ``thp::expr``) serializes an
+expression tree over placeholders ``x0..x7`` to a canonical string, and
+this module compiles that string ONCE into a jax-traceable callable.
+
+Caching by string is load-bearing, not a nicety: the algorithm layer's
+program caches key user ops by IDENTITY (``core/pinning.pinned_id``),
+so the same expression must map to the SAME function object for
+repeated bridge calls to reuse their compiled XLA programs.
+
+The grammar is validated before ``eval``: only whitelisted function
+names, placeholders, numeric literals, and arithmetic punctuation may
+appear — a malformed or adversarial string raises instead of reaching
+the interpreter with any usable namespace.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import jax.numpy as jnp
+
+__all__ = ["op_from_expr", "FUNCTIONS"]
+
+# the callable surface the C++ DSL can name (thp::sqrt & co.)
+FUNCTIONS = {
+    "sqrt": jnp.sqrt,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "tanh": jnp.tanh,
+    "abs": jnp.abs,
+    "minimum": jnp.minimum,
+    "maximum": jnp.maximum,
+    "power": jnp.power,
+}
+
+_MAX_ARGS = 8
+_NAME = re.compile(r"[A-Za-z_][A-Za-z_0-9]*")
+# everything a serialized expression may contain besides names:
+# numbers (incl. scientific notation), arithmetic, parens, commas
+_PUNCT = re.compile(r"^[\d\s\.\+\-\*/%\(\),eE]*$")
+
+
+def _validate(expr: str, nargs: int) -> None:
+    names = set(_NAME.findall(expr))
+    allowed = set(FUNCTIONS) | {f"x{i}" for i in range(nargs)}
+    # exponent suffixes of numeric literals ("1e-3", "2.5e2") tokenize
+    # as the pseudo-names "e"/"e2" since the literal's digits precede
+    # them; they can never resolve to anything (globals carry no such
+    # names), so they are grammar, not identifiers
+    bad = sorted(n for n in names if n not in allowed
+                 and not re.fullmatch(r"[eE]\d*", n))
+    if bad:
+        raise ValueError(f"expr names outside the DSL surface: {bad} "
+                         f"(allowed: x0..x{nargs - 1} + {sorted(FUNCTIONS)})")
+    rest = _NAME.sub("", expr)
+    if not _PUNCT.match(rest):
+        raise ValueError(f"expr contains non-DSL characters: {expr!r}")
+    if "__" in expr:
+        raise ValueError("double underscore is not part of the DSL")
+
+
+@functools.lru_cache(maxsize=512)
+def op_from_expr(expr: str, nargs: int):
+    """Compile a DSL string into a jax-traceable callable of ``nargs``
+    positional arguments.  Cached by (string, nargs) so equal
+    expressions share one function object (see module docstring)."""
+    nargs = int(nargs)
+    if not (1 <= nargs <= _MAX_ARGS):
+        raise ValueError(f"nargs must be 1..{_MAX_ARGS}")
+    _validate(expr, nargs)
+    args = ", ".join(f"x{i}" for i in range(nargs))
+    code = compile(f"lambda {args}: ({expr})", f"<thp-expr:{expr}>", "eval")
+    # the lambda resolves free names from its __globals__ (the globals
+    # dict passed to eval), not from eval's locals — FUNCTIONS must live
+    # in globals.  __import__ stays available because jnp functions lazy-
+    # import submodules at call time; the validated grammar cannot name
+    # it (names are whitelisted above).
+    fn = eval(code, {"__builtins__": {"__import__": __import__},
+                     **FUNCTIONS})  # noqa: S307
+    fn.__name__ = f"thp_expr_{abs(hash((expr, nargs))) % 10 ** 8}"
+    return fn

@@ -1,11 +1,49 @@
-// bridge_demo: C++ program driving the TPU backend end-to-end —
-// the native equivalent of examples/stencil_1d.py + dot_product.py.
+// bridge_demo: C++ program driving the TPU backend end-to-end — the
+// native equivalent of the reference's example set (vector-add,
+// dot_product, stencil-1d, inclusive_scan, gemv, transpose) asserted
+// against serial C++ oracles (the reference's oracle pattern,
+// test/gtest/include/common-tests.hpp:52-81).
 // Usage: bridge_demo [ncpu_devices]  (0 = real device platform)
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
+#include <vector>
 
 #include "thp_bridge.hpp"
+
+namespace {
+
+int failures = 0;
+
+void check_close(const char* what, double got, double want,
+                 double tol = 1e-4) {
+  double scale = std::abs(want) > 1.0 ? std::abs(want) : 1.0;
+  if (std::abs(got - want) > tol * scale) {
+    std::printf("%s FAIL: got %.8g want %.8g\n", what, got, want);
+    ++failures;
+  }
+}
+
+void check_range(const char* what, const std::vector<double>& got,
+                 const std::vector<double>& want, double tol = 1e-4) {
+  if (got.size() != want.size()) {
+    std::printf("%s FAIL: size %zu vs %zu\n", what, got.size(),
+                want.size());
+    ++failures;
+    return;
+  }
+  for (std::size_t i = 0; i < got.size(); ++i) {
+    double scale = std::abs(want[i]) > 1.0 ? std::abs(want[i]) : 1.0;
+    if (std::abs(got[i] - want[i]) > tol * scale) {
+      std::printf("%s FAIL at %zu: got %.8g want %.8g\n", what, i,
+                  got[i], want[i]);
+      ++failures;
+      return;
+    }
+  }
+}
+
+}  // namespace
 
 int main(int argc, char** argv) {
   int ncpu = argc > 1 ? std::atoi(argv[1]) : 8;
@@ -14,40 +52,138 @@ int main(int argc, char** argv) {
 
   const std::size_t n = 1 << 14;
 
-  // iota + reduce
+  // ---- iota + reduce --------------------------------------------------
   thp::vector a = s.make_vector(n);
   a.iota(1.0);
-  double sum = a.reduce();
   double expect = 0.5 * (double)n * (double)(n + 1);
-  if (std::abs(sum - expect) > 1e-3 * expect) {
-    std::printf("reduce FAIL: %f vs %f\n", sum, expect);
-    return 1;
-  }
+  check_close("reduce", a.reduce(), expect);
 
-  // dot product
+  // ---- dot ------------------------------------------------------------
   thp::vector b = s.make_vector(n);
   b.fill(2.0);
-  double d = s.dot(a, b);
-  if (std::abs(d - 2.0 * expect) > 1e-3 * 2.0 * expect) {
-    std::printf("dot FAIL: %f vs %f\n", d, 2.0 * expect);
-    return 1;
+  check_close("dot", s.dot(a, b), 2.0 * expect);
+
+  // ---- vector-add via the zip op DSL (examples/mhp/vector-add.cpp) ----
+  thp::vector vsum = s.make_vector(n);
+  s.transform2(a, b, vsum, thp::x0 + thp::x1);
+  check_close("vector-add reduce", vsum.reduce(), expect + 2.0 * n);
+
+  // ---- unary transform + for_each DSL ---------------------------------
+  thp::vector t = s.make_vector(n);
+  s.transform(a, t, thp::x0 * 2.0 + 1.0);     // 2*i + 1
+  check_close("transform reduce", t.reduce(), 2.0 * expect + n);
+  s.for_each(t, thp::sqrt(thp::abs(thp::x0 - 1.0) / 2.0));  // back to
+  // sqrt(i): sum over i=1..n of sqrt(i)
+  {
+    double want = 0.0;
+    for (std::size_t i = 1; i <= n; ++i) want += std::sqrt((double)i);
+    check_close("for_each reduce", t.reduce(), want);
   }
 
-  // halo'd stencil, 4 fused steps on device
+  // ---- transform_reduce (the driver metric workload) ------------------
+  check_close("transform_reduce x^2",
+              s.transform_reduce(b, thp::x0 * thp::x0), 4.0 * n);
+
+  // ---- inclusive / exclusive scan -------------------------------------
+  thp::vector sc = s.make_vector(n);
+  s.inclusive_scan(a, sc);            // scan of 1..n: i*(i+1)/2
+  {
+    auto host = sc.to_host();
+    std::vector<double> want(n);
+    for (std::size_t i = 0; i < n; ++i)
+      want[i] = 0.5 * (double)(i + 1) * (double)(i + 2);
+    check_range("inclusive_scan", host, want);
+  }
+  s.exclusive_scan(a, sc, 10.0);      // 10 + i*(i+1)/2 shifted
+  {
+    auto host = sc.to_host();
+    std::vector<double> want(n);
+    double run = 10.0;
+    for (std::size_t i = 0; i < n; ++i) {
+      want[i] = run;
+      run += (double)(i + 1);
+    }
+    check_range("exclusive_scan", host, want);
+  }
+
+  // ---- halo'd stencil, 4 fused steps on device ------------------------
   thp::vector x = s.make_vector(n, 1, 1, false);
   thp::vector y = s.make_vector(n, 1, 1, false);
   x.iota(0.0);
   y.iota(0.0);
   s.stencil_iterate(x, y, {1.0 / 3, 1.0 / 3, 1.0 / 3}, 4);
-  auto host = x.to_host();
-  // iota is a fixed point of the mean stencil in the interior
-  for (std::size_t i = 8; i < n - 8; i += n / 7)
-    if (std::abs(host[i] - (double)i) > 1e-2) {
-      std::printf("stencil FAIL at %zu: %f\n", i, host[i]);
-      return 1;
-    }
+  {
+    auto host = x.to_host();
+    // iota is a fixed point of the mean stencil in the interior
+    for (std::size_t i = 8; i < n - 8; i += n / 7)
+      check_close("stencil interior", host[i], (double)i, 1e-2);
+  }
 
-  std::printf("bridge demo PASSED (n=%zu, sum=%.0f, dot=%.0f)\n", n, sum,
-              d);
+  // ---- sparse gemv (examples/shp/gemv_example.cpp) --------------------
+  {
+    const std::size_t m = 1024;
+    std::vector<std::int64_t> ri, ci;
+    std::vector<double> vv;
+    for (std::size_t i = 0; i < m; ++i)
+      for (std::int64_t dj = -1; dj <= 1; ++dj) {
+        std::int64_t j = (std::int64_t)i + dj;
+        if (j < 0 || j >= (std::int64_t)m) continue;
+        ri.push_back((std::int64_t)i);
+        ci.push_back(j);
+        vv.push_back(1.0 + 0.001 * (double)i + 0.01 * (double)dj);
+      }
+    thp::sparse_matrix A = s.make_sparse_coo(m, m, ri, ci, vv);
+    thp::vector bv = s.make_vector(m);
+    thp::vector cv = s.make_vector(m);
+    bv.iota(1.0);
+    cv.fill(0.5);
+    s.gemv(cv, A, bv);  // c += A·b
+    std::vector<double> want(m, 0.5);
+    for (std::size_t k = 0; k < vv.size(); ++k)
+      want[(std::size_t)ri[k]] += vv[k] * (double)(ci[k] + 1);
+    check_range("gemv", cv.to_host(), want);
+  }
+
+  // ---- dense gemm ------------------------------------------------------
+  {
+    const std::size_t m = 96, k = 64, p = 80;
+    std::vector<double> da(m * k), db(k * p);
+    for (std::size_t i = 0; i < da.size(); ++i)
+      da[i] = 0.01 * (double)(i % 37) - 0.1;
+    for (std::size_t i = 0; i < db.size(); ++i)
+      db[i] = 0.02 * (double)(i % 29) - 0.2;
+    thp::dense_matrix A = s.make_dense(m, k, da);
+    thp::dense_matrix B = s.make_dense(k, p, db);
+    thp::dense_matrix C = s.make_dense(m, p);
+    s.gemm(A, B, C);
+    std::vector<double> want(m * p, 0.0);
+    for (std::size_t i = 0; i < m; ++i)
+      for (std::size_t kk = 0; kk < k; ++kk)
+        for (std::size_t j = 0; j < p; ++j)
+          want[i * p + j] += da[i * k + kk] * db[kk * p + j];
+    check_range("gemm", C.to_host(), want, 1e-3);
+  }
+
+  // ---- mdarray transpose (examples/mhp/transpose-cpu.cpp) -------------
+  {
+    const std::size_t m = 64, p = 48;
+    std::vector<double> dm(m * p);
+    for (std::size_t i = 0; i < dm.size(); ++i)
+      dm[i] = (double)i * 0.5 - 3.0;
+    thp::mdarray M = s.make_mdarray(m, p, dm);
+    thp::mdarray T = s.make_mdarray(p, m);
+    s.transpose(T, M);
+    std::vector<double> want(p * m);
+    for (std::size_t i = 0; i < m; ++i)
+      for (std::size_t j = 0; j < p; ++j)
+        want[j * m + i] = dm[i * p + j];
+    check_range("transpose", T.to_host(), want);
+  }
+
+  if (failures) {
+    std::printf("bridge demo: %d FAILURES\n", failures);
+    return 1;
+  }
+  std::printf("bridge demo PASSED (n=%zu, all surfaces)\n", n);
   return 0;
 }

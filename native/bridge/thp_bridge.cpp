@@ -5,6 +5,7 @@
 #include <Python.h>
 
 #include <cstdlib>
+#include <cstring>
 #include <stdexcept>
 
 namespace thp {
@@ -21,12 +22,136 @@ PyObject* must(PyObject* p, const char* what) {
   return p;
 }
 
+// numpy view over host memory (no element boxing); the caller's buffer
+// must outlive uses of the returned array — every call site here copies
+// into a container/device layout before returning.
+PyObject* np_view(PyObject* np, const void* data, std::size_t nbytes,
+                  const char* dtype) {
+  PyObject* mv = must(
+      PyMemoryView_FromMemory(
+          const_cast<char*>(static_cast<const char*>(data)),
+          (Py_ssize_t)nbytes, PyBUF_READ),
+      "memoryview");
+  PyObject* arr = must(PyObject_CallMethod(np, "frombuffer", "Os", mv,
+                                           dtype),
+                       "np.frombuffer");
+  Py_DECREF(mv);
+  return arr;
+}
+
 }  // namespace
 
+// ---------------------------------------------------------------------
+// expression DSL
+// ---------------------------------------------------------------------
+namespace {
+std::string num(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof buf, "%.17g", v);
+  return buf;
+}
+expr mk(std::string s) { return expr(expr::raw_t{}, std::move(s)); }
+}  // namespace
+
+expr expr::arg(int i) { return mk("x" + std::to_string(i)); }
+expr expr::lit(double v) { return mk(num(v)); }
+
+expr operator+(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " + " + b.str() + ")");
+}
+expr operator-(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " - " + b.str() + ")");
+}
+expr operator*(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " * " + b.str() + ")");
+}
+expr operator/(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " / " + b.str() + ")");
+}
+expr operator-(const expr& a) { return mk("(0 - " + a.str() + ")"); }
+expr operator+(const expr& a, double b) { return a + expr::lit(b); }
+expr operator+(double a, const expr& b) { return expr::lit(a) + b; }
+expr operator-(const expr& a, double b) { return a - expr::lit(b); }
+expr operator-(double a, const expr& b) { return expr::lit(a) - b; }
+expr operator*(const expr& a, double b) { return a * expr::lit(b); }
+expr operator*(double a, const expr& b) { return expr::lit(a) * b; }
+expr operator/(const expr& a, double b) { return a / expr::lit(b); }
+expr operator/(double a, const expr& b) { return expr::lit(a) / b; }
+expr sqrt(const expr& a) { return mk("sqrt(" + a.str() + ")"); }
+expr exp(const expr& a) { return mk("exp(" + a.str() + ")"); }
+expr log(const expr& a) { return mk("log(" + a.str() + ")"); }
+expr tanh(const expr& a) { return mk("tanh(" + a.str() + ")"); }
+expr abs(const expr& a) { return mk("abs(" + a.str() + ")"); }
+expr min(const expr& a, const expr& b) {
+  return mk("minimum(" + a.str() + ", " + b.str() + ")");
+}
+expr max(const expr& a, const expr& b) {
+  return mk("maximum(" + a.str() + ", " + b.str() + ")");
+}
+expr pow(const expr& a, const expr& b) {
+  return mk("power(" + a.str() + ", " + b.str() + ")");
+}
+
+const expr x0 = expr::arg(0);
+const expr x1 = expr::arg(1);
+const expr x2 = expr::arg(2);
+const expr x3 = expr::arg(3);
+
+// ---------------------------------------------------------------------
+// session impl
+// ---------------------------------------------------------------------
 struct session::impl {
   PyObject* dr = nullptr;        // module dr_tpu
+  PyObject* views = nullptr;     // module dr_tpu.views.views
   PyObject* stencil_mod = nullptr;
+  PyObject* expr_mod = nullptr;  // module dr_tpu.utils.expr
+  PyObject* np = nullptr;        // module numpy
   bool owns_interpreter = false;
+
+  // op DSL -> cached jax callable (cache lives Python-side, keyed by
+  // the canonical string, so equal exprs share one function object)
+  PyObject* op(const expr& e, int nargs) {
+    return must(PyObject_CallMethod(expr_mod, "op_from_expr", "si",
+                                    e.str().c_str(), nargs),
+                "op_from_expr");
+  }
+
+  // f64 host view -> f32 numpy array (device dtype)
+  PyObject* np_f32(const std::vector<double>& v) {
+    PyObject* raw = np_view(np, v.data(), v.size() * sizeof(double),
+                            "float64");
+    PyObject* arr = must(PyObject_CallMethod(raw, "astype", "s",
+                                             "float32"),
+                         "astype(float32)");
+    Py_DECREF(raw);
+    return arr;
+  }
+
+  PyObject* np_i64(const std::vector<std::int64_t>& v) {
+    PyObject* raw = np_view(np, v.data(), v.size() * sizeof(std::int64_t),
+                            "int64");
+    // copy so the container owns its memory beyond this call
+    PyObject* arr = must(PyObject_CallMethod(raw, "copy", nullptr),
+                         "np.copy");
+    Py_DECREF(raw);
+    return arr;
+  }
+
+  // contiguous f64 copy-out of any numpy-convertible object
+  std::vector<double> to_host_f64(PyObject* arr_like) {
+    PyObject* asc = must(
+        PyObject_CallMethod(np, "ascontiguousarray", "Os", arr_like,
+                            "float64"),
+        "ascontiguousarray");
+    Py_buffer view;
+    if (PyObject_GetBuffer(asc, &view, PyBUF_CONTIG_RO) != 0)
+      fail("buffer protocol");
+    std::vector<double> out((std::size_t)view.len / sizeof(double));
+    std::memcpy(out.data(), view.buf, (std::size_t)view.len);
+    PyBuffer_Release(&view);
+    Py_DECREF(asc);
+    return out;
+  }
 };
 
 session::session(int ncpu_devices) : impl_(new impl) {
@@ -48,9 +173,14 @@ session::session(int ncpu_devices) : impl_(new impl) {
   }
   impl_->dr = must(PyImport_ImportModule("dr_tpu"), "import dr_tpu");
   must(PyObject_CallMethod(impl_->dr, "init", nullptr), "dr_tpu.init()");
+  impl_->views = must(PyImport_ImportModule("dr_tpu.views.views"),
+                      "import dr_tpu.views.views");
   impl_->stencil_mod = must(
       PyImport_ImportModule("dr_tpu.algorithms.stencil"),
       "import dr_tpu.algorithms.stencil");
+  impl_->expr_mod = must(PyImport_ImportModule("dr_tpu.utils.expr"),
+                         "import dr_tpu.utils.expr");
+  impl_->np = must(PyImport_ImportModule("numpy"), "import numpy");
   // XLA device-count flags are frozen at first interpreter/backend init,
   // so a later session cannot change the mesh size — fail loudly instead
   // of computing over the wrong partitioning
@@ -60,7 +190,10 @@ session::session(int ncpu_devices) : impl_(new impl) {
 }
 
 session::~session() {
+  Py_XDECREF(impl_->np);
+  Py_XDECREF(impl_->expr_mod);
   Py_XDECREF(impl_->stencil_mod);
+  Py_XDECREF(impl_->views);
   Py_XDECREF(impl_->dr);
   // keep the interpreter alive: other sessions/objects may still use it
 }
@@ -76,6 +209,8 @@ std::size_t session::nprocs() const {
 void session::exec(const std::string& code) {
   if (PyRun_SimpleString(code.c_str())) fail("exec");
 }
+
+// ------------------------------------------------------------ containers
 
 vector session::make_vector(std::size_t n, std::size_t prev,
                             std::size_t next, bool periodic) {
@@ -108,6 +243,79 @@ vector session::make_vector(std::size_t n, std::size_t prev,
   return vector(this, obj, n);
 }
 
+dense_matrix session::make_dense(std::size_t m, std::size_t n,
+                                 const std::vector<double>& row_major) {
+  PyObject* cls = must(PyObject_GetAttrString(impl_->dr, "dense_matrix"),
+                       "dense_matrix");
+  PyObject* obj;
+  if (row_major.empty()) {
+    obj = must(PyObject_CallFunction(cls, "((nn))", (Py_ssize_t)m,
+                                     (Py_ssize_t)n),
+               "dense_matrix((m, n))");
+  } else {
+    if (row_major.size() != m * n) fail("make_dense: data size != m*n");
+    PyObject* flat = impl_->np_f32(row_major);
+    PyObject* arr = must(PyObject_CallMethod(flat, "reshape", "nn",
+                                             (Py_ssize_t)m, (Py_ssize_t)n),
+                         "reshape");
+    obj = must(PyObject_CallMethod(cls, "from_array", "O", arr),
+               "dense_matrix.from_array");
+    Py_DECREF(arr);
+    Py_DECREF(flat);
+  }
+  Py_DECREF(cls);
+  return dense_matrix(this, obj, m, n);
+}
+
+sparse_matrix session::make_sparse_coo(
+    std::size_t m, std::size_t n, const std::vector<std::int64_t>& rows,
+    const std::vector<std::int64_t>& cols,
+    const std::vector<double>& values) {
+  if (rows.size() != cols.size() || rows.size() != values.size())
+    fail("make_sparse_coo: triple lengths differ");
+  PyObject* cls = must(PyObject_GetAttrString(impl_->dr, "sparse_matrix"),
+                       "sparse_matrix");
+  PyObject* ra = impl_->np_i64(rows);
+  PyObject* ca = impl_->np_i64(cols);
+  PyObject* va = impl_->np_f32(values);
+  PyObject* obj = must(
+      PyObject_CallMethod(cls, "from_coo", "(nn)OOO", (Py_ssize_t)m,
+                          (Py_ssize_t)n, ra, ca, va),
+      "sparse_matrix.from_coo");
+  Py_DECREF(va);
+  Py_DECREF(ca);
+  Py_DECREF(ra);
+  Py_DECREF(cls);
+  return sparse_matrix(this, obj, m, n, values.size());
+}
+
+mdarray session::make_mdarray(std::size_t m, std::size_t n,
+                              const std::vector<double>& row_major) {
+  PyObject* cls = must(
+      PyObject_GetAttrString(impl_->dr, "distributed_mdarray"),
+      "distributed_mdarray");
+  PyObject* obj;
+  if (row_major.empty()) {
+    obj = must(PyObject_CallFunction(cls, "((nn))", (Py_ssize_t)m,
+                                     (Py_ssize_t)n),
+               "distributed_mdarray((m, n))");
+  } else {
+    if (row_major.size() != m * n) fail("make_mdarray: data size != m*n");
+    PyObject* flat = impl_->np_f32(row_major);
+    PyObject* arr = must(PyObject_CallMethod(flat, "reshape", "nn",
+                                             (Py_ssize_t)m, (Py_ssize_t)n),
+                         "reshape");
+    obj = must(PyObject_CallMethod(cls, "from_array", "O", arr),
+               "distributed_mdarray.from_array");
+    Py_DECREF(arr);
+    Py_DECREF(flat);
+  }
+  Py_DECREF(cls);
+  return mdarray(this, obj, m, n);
+}
+
+// ------------------------------------------------------------ algorithms
+
 double session::dot(const vector& a, const vector& b) {
   PyObject* r = must(
       PyObject_CallMethod(impl_->dr, "dot", "OO",
@@ -116,6 +324,100 @@ double session::dot(const vector& a, const vector& b) {
   double v = PyFloat_AsDouble(r);
   Py_DECREF(r);
   return v;
+}
+
+void session::transform(const vector& in, vector& out, const expr& op) {
+  PyObject* fn = impl_->op(op, 1);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "transform", "OOO",
+                          (PyObject*)in.obj_, (PyObject*)out.obj_, fn),
+      "transform");
+  Py_DECREF(r);
+  Py_DECREF(fn);
+}
+
+void session::transform2(const vector& a, const vector& b, vector& out,
+                         const expr& op) {
+  PyObject* zv = must(
+      PyObject_CallMethod(impl_->views, "zip", "OO",
+                          (PyObject*)a.obj_, (PyObject*)b.obj_),
+      "views.zip");
+  PyObject* fn = impl_->op(op, 2);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "transform", "OOO", zv,
+                          (PyObject*)out.obj_, fn),
+      "transform(zip)");
+  Py_DECREF(r);
+  Py_DECREF(fn);
+  Py_DECREF(zv);
+}
+
+void session::for_each(vector& v, const expr& op) {
+  PyObject* fn = impl_->op(op, 1);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "for_each", "OO",
+                          (PyObject*)v.obj_, fn),
+      "for_each");
+  Py_DECREF(r);
+  Py_DECREF(fn);
+}
+
+double session::transform_reduce(const vector& v, const expr& op) {
+  PyObject* fn = impl_->op(op, 1);
+  PyObject* tr = must(
+      PyObject_GetAttrString(impl_->dr, "transform_reduce"),
+      "transform_reduce attr");
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "transform_op", fn);
+  PyObject* r = must(PyObject_Call(tr, args, kwargs), "transform_reduce");
+  double out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(tr);
+  Py_DECREF(fn);
+  return out;
+}
+
+void session::inclusive_scan(const vector& in, vector& out) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "inclusive_scan", "OO",
+                          (PyObject*)in.obj_, (PyObject*)out.obj_),
+      "inclusive_scan");
+  Py_DECREF(r);
+}
+
+void session::exclusive_scan(const vector& in, vector& out, double init) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "exclusive_scan", "OOd",
+                          (PyObject*)in.obj_, (PyObject*)out.obj_, init),
+      "exclusive_scan");
+  Py_DECREF(r);
+}
+
+void session::gemv(vector& c, const sparse_matrix& a, const vector& b) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "gemv", "OOO", (PyObject*)c.obj_,
+                          (PyObject*)a.obj_, (PyObject*)b.obj_),
+      "gemv");
+  Py_DECREF(r);
+}
+
+void session::gemm(const dense_matrix& a, const dense_matrix& b,
+                   dense_matrix& out) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "gemm", "OOO", (PyObject*)a.obj_,
+                          (PyObject*)b.obj_, (PyObject*)out.obj_),
+      "gemm");
+  Py_DECREF(r);
+}
+
+void session::transpose(mdarray& out, const mdarray& in) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "transpose", "OO",
+                          (PyObject*)out.obj_, (PyObject*)in.obj_),
+      "transpose");
+  Py_DECREF(r);
 }
 
 void session::stencil_iterate(vector& a, vector& b,
@@ -135,23 +437,25 @@ void session::stencil_iterate(vector& a, vector& b,
   Py_DECREF(w);
 }
 
-vector::~vector() { Py_XDECREF((PyObject*)obj_); }
+// ------------------------------------------------------------ handles
 
-vector::vector(vector&& o) noexcept
-    : sess_(o.sess_), obj_(o.obj_), n_(o.n_) {
+namespace detail {
+handle::~handle() { Py_XDECREF((PyObject*)obj_); }
+
+handle::handle(handle&& o) noexcept : sess_(o.sess_), obj_(o.obj_) {
   o.obj_ = nullptr;
 }
 
-vector& vector::operator=(vector&& o) noexcept {
+handle& handle::operator=(handle&& o) noexcept {
   if (this != &o) {
     Py_XDECREF((PyObject*)obj_);
     sess_ = o.sess_;
     obj_ = o.obj_;
-    n_ = o.n_;
     o.obj_ = nullptr;
   }
   return *this;
 }
+}  // namespace detail
 
 void vector::iota(double start) {
   PyObject* r = must(
@@ -194,14 +498,25 @@ std::vector<double> vector::to_host() const {
       PyObject_CallMethod(sess_->impl_->dr, "to_numpy", "O",
                           (PyObject*)obj_),
       "to_numpy");
-  PyObject* lst = must(PyObject_CallMethod(arr, "tolist", nullptr),
-                       "tolist");
-  std::vector<double> out;
-  Py_ssize_t n = PyList_Size(lst);
-  out.reserve((std::size_t)n);
-  for (Py_ssize_t i = 0; i < n; ++i)
-    out.push_back(PyFloat_AsDouble(PyList_GetItem(lst, i)));
-  Py_DECREF(lst);
+  std::vector<double> out = sess_->impl_->to_host_f64(arr);
+  Py_DECREF(arr);
+  return out;
+}
+
+std::vector<double> dense_matrix::to_host() const {
+  PyObject* arr = must(
+      PyObject_CallMethod((PyObject*)obj_, "materialize", nullptr),
+      "materialize");
+  std::vector<double> out = sess_->impl_->to_host_f64(arr);
+  Py_DECREF(arr);
+  return out;
+}
+
+std::vector<double> mdarray::to_host() const {
+  PyObject* arr = must(
+      PyObject_CallMethod((PyObject*)obj_, "materialize", nullptr),
+      "materialize");
+  std::vector<double> out = sess_->impl_->to_host_f64(arr);
   Py_DECREF(arr);
   return out;
 }

@@ -8,16 +8,36 @@
 // the calling thread, jax programs dispatched asynchronously by the
 // runtime underneath.
 //
-// Surface (mirrors the Python dr_tpu API; extend as needed):
+// User ops are expressed in a small arithmetic DSL (thp::expr over
+// placeholders thp::x0..x3) serialized to a canonical string and compiled
+// ONCE on the Python side into a jax-traceable callable
+// (dr_tpu/utils/expr.py) — the reference's C++-lambda surface
+// (cpu_algorithms.hpp:63-74, for_each.hpp:16-92) re-imagined for a traced
+// backend (SURVEY.md §7 hard-part 2, option (a)).  Equal expression
+// strings share one callable, so the algorithm layer's identity-keyed
+// program caches reuse compiled XLA programs across bridge calls.
+//
+// Surface (mirrors the Python dr_tpu API; reference parity targets:
+// include/dr/shp/shp.hpp:8-26, include/dr/mhp.hpp:41-59):
 //   thp::session s(ncpu_devices /*0 = real TPU*/);
-//   thp::vector v = s.vector(n, halo_prev, halo_next, periodic);
+//   thp::vector v = s.make_vector(n, halo_prev, halo_next, periodic);
 //   v.iota(0); v.fill(1.0);
 //   double r = v.reduce();  double d = s.dot(a, b);
+//   s.transform(a, out, thp::x0 * 2.0 + 1.0);          // lazy op DSL
+//   s.transform2(a, b, out, thp::x0 * thp::x1);        // zipped binary
+//   s.for_each(v, thp::sqrt(thp::abs(thp::x0)));
+//   s.inclusive_scan(in, out);  s.exclusive_scan(in, out, init);
+//   thp::sparse_matrix A = s.make_sparse_coo(m, n, rows, cols, vals);
+//   s.gemv(c, A, b);                                    // c += A·b
+//   thp::dense_matrix M = s.make_dense(m, n, host_data);
+//   thp::mdarray T = s.make_mdarray(m, n, host_data);
+//   s.transpose(out_md, in_md);                         // all-to-all T
 //   s.stencil_iterate(a, b, {w...}, steps);
-//   std::vector<double> host = v.to_host();
+//   std::vector<double> host = v.to_host();  // buffer-protocol copy
 #pragma once
 
 #include <cstddef>
+#include <cstdint>
 #include <memory>
 #include <string>
 #include <vector>
@@ -26,32 +46,136 @@ namespace thp {
 
 class session;
 
-class vector {
+// ---------------------------------------------------------------------
+// expression DSL: value-semantics nodes serializing to canonical strings
+// ---------------------------------------------------------------------
+class expr {
+ public:
+  static expr arg(int i);      // placeholder xi
+  static expr lit(double v);   // numeric literal
+  const std::string& str() const { return s_; }
+
+  // internal: wraps an already-serialized subexpression (used by the
+  // operator overloads; not a user entry point — the grammar is
+  // validated Python-side before compilation either way)
+  struct raw_t {};
+  expr(raw_t, std::string s) : s_(std::move(s)) {}
+
+ private:
+  std::string s_;
+};
+
+expr operator+(const expr& a, const expr& b);
+expr operator-(const expr& a, const expr& b);
+expr operator*(const expr& a, const expr& b);
+expr operator/(const expr& a, const expr& b);
+expr operator-(const expr& a);
+expr operator+(const expr& a, double b);
+expr operator+(double a, const expr& b);
+expr operator-(const expr& a, double b);
+expr operator-(double a, const expr& b);
+expr operator*(const expr& a, double b);
+expr operator*(double a, const expr& b);
+expr operator/(const expr& a, double b);
+expr operator/(double a, const expr& b);
+expr sqrt(const expr& a);
+expr exp(const expr& a);
+expr log(const expr& a);
+expr tanh(const expr& a);
+expr abs(const expr& a);
+expr min(const expr& a, const expr& b);
+expr max(const expr& a, const expr& b);
+expr pow(const expr& a, const expr& b);
+
+// ready-made placeholders (x0 = first range/zip component, ...)
+extern const expr x0, x1, x2, x3;
+
+// ---------------------------------------------------------------------
+// containers: move-only handles owning a PyObject* of the dr_tpu object
+// ---------------------------------------------------------------------
+namespace detail {
+class handle {
+ public:
+  handle() = default;
+  ~handle();
+  handle(handle&&) noexcept;
+  handle& operator=(handle&&) noexcept;
+  handle(const handle&) = delete;
+  handle& operator=(const handle&) = delete;
+
+ protected:
+  friend class ::thp::session;
+  handle(session* s, void* obj) : sess_(s), obj_(obj) {}
+  session* sess_ = nullptr;
+  void* obj_ = nullptr;  // PyObject*
+};
+}  // namespace detail
+
+class vector : public detail::handle {
  public:
   vector() = default;
-  ~vector();
-  vector(vector&&) noexcept;
-  vector& operator=(vector&&) noexcept;
-  vector(const vector&) = delete;
-  vector& operator=(const vector&) = delete;
-
   std::size_t size() const { return n_; }
 
   void iota(double start);
   void fill(double value);
   double reduce() const;
   void halo_exchange();
+  // buffer-protocol host copy: ONE contiguous memcpy, no element boxing
   std::vector<double> to_host() const;
 
  private:
   friend class session;
   vector(session* s, void* obj, std::size_t n)
-      : sess_(s), obj_(obj), n_(n) {}
-  session* sess_ = nullptr;
-  void* obj_ = nullptr;  // PyObject* of the dr_tpu.distributed_vector
+      : handle(s, obj), n_(n) {}
   std::size_t n_ = 0;
 };
 
+class dense_matrix : public detail::handle {
+ public:
+  dense_matrix() = default;
+  std::size_t rows() const { return m_; }
+  std::size_t cols() const { return n_; }
+  std::vector<double> to_host() const;  // row-major m*n
+
+ private:
+  friend class session;
+  dense_matrix(session* s, void* obj, std::size_t m, std::size_t n)
+      : handle(s, obj), m_(m), n_(n) {}
+  std::size_t m_ = 0, n_ = 0;
+};
+
+class sparse_matrix : public detail::handle {
+ public:
+  sparse_matrix() = default;
+  std::size_t rows() const { return m_; }
+  std::size_t cols() const { return n_; }
+  std::size_t nnz() const { return nnz_; }
+
+ private:
+  friend class session;
+  sparse_matrix(session* s, void* obj, std::size_t m, std::size_t n,
+                std::size_t nnz)
+      : handle(s, obj), m_(m), n_(n), nnz_(nnz) {}
+  std::size_t m_ = 0, n_ = 0, nnz_ = 0;
+};
+
+class mdarray : public detail::handle {
+ public:
+  mdarray() = default;
+  std::size_t rows() const { return m_; }
+  std::size_t cols() const { return n_; }
+  std::vector<double> to_host() const;  // row-major m*n
+
+ private:
+  friend class session;
+  mdarray(session* s, void* obj, std::size_t m, std::size_t n)
+      : handle(s, obj), m_(m), n_(n) {}
+  std::size_t m_ = 0, n_ = 0;
+};
+
+// ---------------------------------------------------------------------
+// session: the embedded runtime + the algorithm surface
+// ---------------------------------------------------------------------
 class session {
  public:
   // ncpu_devices > 0: force a virtual CPU mesh of that size (testing);
@@ -63,10 +187,37 @@ class session {
 
   std::size_t nprocs() const;
 
+  // containers
   vector make_vector(std::size_t n, std::size_t halo_prev = 0,
                      std::size_t halo_next = 0, bool periodic = false);
+  dense_matrix make_dense(std::size_t m, std::size_t n,
+                          const std::vector<double>& row_major = {});
+  sparse_matrix make_sparse_coo(std::size_t m, std::size_t n,
+                                const std::vector<std::int64_t>& rows,
+                                const std::vector<std::int64_t>& cols,
+                                const std::vector<double>& values);
+  mdarray make_mdarray(std::size_t m, std::size_t n,
+                       const std::vector<double>& row_major = {});
+
+  // elementwise / reduction algorithms (op = DSL expression)
+  void transform(const vector& in, vector& out, const expr& op);
+  void transform2(const vector& a, const vector& b, vector& out,
+                  const expr& op);  // zip(a, b) | transform
+  void for_each(vector& v, const expr& op);
+  double transform_reduce(const vector& v, const expr& op);
   double dot(const vector& a, const vector& b);
-  // weights.size() must be halo_prev + halo_next + 1
+
+  // prefix scans (add monoid — the reference's inclusive_scan surface)
+  void inclusive_scan(const vector& in, vector& out);
+  void exclusive_scan(const vector& in, vector& out, double init = 0.0);
+
+  // matrix algorithms
+  void gemv(vector& c, const sparse_matrix& a, const vector& b);
+  void gemm(const dense_matrix& a, const dense_matrix& b,
+            dense_matrix& out);
+  void transpose(mdarray& out, const mdarray& in);
+
+  // stencil: weights.size() must be halo_prev + halo_next + 1
   void stencil_iterate(vector& a, vector& b,
                        const std::vector<double>& weights, int steps);
 
@@ -75,6 +226,10 @@ class session {
 
  private:
   friend class vector;
+  friend class dense_matrix;
+  friend class sparse_matrix;
+  friend class mdarray;
+  friend class detail::handle;
   struct impl;
   std::unique_ptr<impl> impl_;
 };

@@ -1,0 +1,45 @@
+"""The native bridge's expression DSL compiler (dr_tpu/utils/expr.py):
+grammar validation, numeric parity with numpy, and the identity-caching
+contract the algorithm-layer program caches rely on."""
+
+import numpy as np
+import pytest
+
+from dr_tpu.utils.expr import op_from_expr
+
+
+def test_arithmetic_matches_numpy():
+    f = op_from_expr("(x0 * 2.0 + 1.0)", 1)
+    x = np.linspace(-2, 2, 64).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), x * 2.0 + 1.0,
+                               rtol=1e-6)
+
+
+def test_binary_and_functions():
+    f = op_from_expr("maximum(sqrt(abs(x0)), tanh(x1))", 2)
+    a = np.linspace(-4, 4, 32).astype(np.float32)
+    b = np.linspace(-1, 1, 32).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(f(a, b)), np.maximum(np.sqrt(np.abs(a)), np.tanh(b)),
+        rtol=1e-6)
+
+
+def test_identity_caching():
+    # equal strings MUST return the same function object: the program
+    # caches key user ops by identity (core/pinning.pinned_id)
+    assert op_from_expr("(x0 + x1)", 2) is op_from_expr("(x0 + x1)", 2)
+    assert op_from_expr("(x0 + x1)", 2) is not op_from_expr("(x0 - x1)", 2)
+
+
+def test_rejects_non_dsl_names():
+    for bad in ("__import__('os')", "open('x')", "x9", "foo(x0)",
+                "x0.__class__", "lambda: 1", "x0; x0"):
+        with pytest.raises(ValueError):
+            op_from_expr(bad, 2)
+
+
+def test_scientific_literals_ok():
+    f = op_from_expr("(x0 * 1e-3 + 2.5e2)", 1)
+    x = np.ones(8, np.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), x * 1e-3 + 250.0,
+                               rtol=1e-6)

@@ -214,3 +214,25 @@ def test_scan_chunk_cap_env(monkeypatch):
     got = np.asarray(scan_pallas.chunked_cumsum(x, interpret=True))
     np.testing.assert_allclose(got, np.cumsum(np.asarray(x, np.float64)),
                                rtol=1e-5, atol=1e-2)
+
+
+def test_chunked_cumsum_pipe_and_passes_variants(monkeypatch):
+    """Both DMA pipelines (auto-grid / manual) and every precision
+    split depth produce ~f32-exact prefixes (interpret mode)."""
+    import jax.numpy as jnp
+    from dr_tpu.ops import scan_pallas
+    rng = np.random.default_rng(7)
+    n = 128 * 1024
+    x = rng.standard_normal(n).astype(np.float32)
+    ref = np.cumsum(x.astype(np.float64))
+    scale = np.abs(ref).max() + 1
+    for pipe in ("", "manual"):
+        for passes in ("0", "2", "3"):
+            monkeypatch.setenv("DR_TPU_SCAN_PIPE", pipe)
+            monkeypatch.setenv("DR_TPU_SCAN_PASSES", passes)
+            monkeypatch.setenv("DR_TPU_SCAN_CHUNK", "512")
+            got = np.asarray(scan_pallas.chunked_cumsum(
+                jnp.asarray(x), interpret=True))
+            err = np.abs(got - ref).max() / scale
+            tol = 3e-5 if passes == "2" else 3e-6
+            assert err < tol, (pipe, passes, err)

@@ -307,3 +307,48 @@ def test_bcsr_unaligned_tile_height():
     gemv_n(c, sp, dr_tpu.distributed_vector.from_array(b), 2)
     np.testing.assert_allclose(dr_tpu.to_numpy(c), 2 * (d @ b),
                                rtol=1e-3, atol=1e-3)
+
+
+def test_bcsr_2d_grid_matches_dense():
+    """Dense-banded matrix on a 2-D tile grid takes the BCSR MXU path
+    (per-tile dense-tile contraction + psum over mesh columns) — the
+    layout/grid combination the reference's ``grid_shape[1]==1`` assert
+    forbids (gemv.hpp:21).  VERDICT r2 item 5."""
+    part = dr_tpu.block_cyclic(grid=_grid2d())
+    m, half = 96, 6
+    rng = np.random.default_rng(51)
+    d = np.zeros((m, m), dtype=np.float32)
+    for i in range(m):
+        lo, hi = max(0, i - half), min(m, i + half + 1)
+        d[i, lo:hi] = rng.standard_normal(hi - lo)
+    sp = dr_tpu.sparse_matrix.from_dense(d, partition=part)
+    assert sp.grid_shape == _grid2d()
+    assert sp.ensure_bcsr(), "band must pass the fill gate on 2-D grids"
+    b = np.linspace(-1, 1, m).astype(np.float32)
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 0.25)
+    dr_tpu.gemv(c, sp, b)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), 0.25 + d @ b,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bcsr_2d_fill_gate_uses_tile_width():
+    """The fill gate must count occupiable cells per TILE width: a 2-D
+    grid tile narrower than the matrix must not deflate the ratio
+    (round-2 advisor finding)."""
+    gp, gq = _grid2d()
+    if gq == 1:
+        import pytest
+        pytest.skip("needs a 2-D grid")
+    m = 8 * gp
+    n = 128 * gq        # each tile exactly one 128-wide block column
+    d = np.zeros((m, n), dtype=np.float32)
+    d[:, :] = 1.0       # fully dense: fill ratio must compute to ~1
+    part = dr_tpu.block_cyclic(grid=(gp, gq))
+    sp = dr_tpu.sparse_matrix.from_dense(d, partition=part)
+    assert sp.ensure_bcsr()
+    b = np.ones(n, dtype=np.float32)
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 0.0)
+    dr_tpu.gemv(c, sp, b)
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), d @ b, rtol=1e-4)

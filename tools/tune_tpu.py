@@ -90,21 +90,34 @@ def tune_scan():
     x = jnp.ones((n,), jnp.float32)
     print("pick_chunk:", scan_pallas.pick_chunk(n), flush=True)
 
-    for variant, cap in (("mxu", 512), ("vpu", 512), ("mxu", 2048),
-                         ("vpu", 2048), ("vpu", 4096)):
+    sweep = [("mxu3", 4096, "grid"), ("mxu3", 8192, "grid"),
+             ("mxu0", 8192, "grid"), ("mxu0", 16384, "grid"),
+             ("mxu3", 16384, "grid"), ("vpu", 8192, "grid"),
+             ("mxu0", 8192, "manual"), ("mxu0", 8192, "grid")]
+    for variant, cap, pipe in sweep:
         if variant == "vpu":
             os.environ["DR_TPU_SCAN_KERNEL"] = "vpu"
+            os.environ.pop("DR_TPU_SCAN_PASSES", None)
         else:
             os.environ.pop("DR_TPU_SCAN_KERNEL", None)
+            os.environ["DR_TPU_SCAN_PASSES"] = variant[-1]
+        if pipe == "manual":
+            os.environ["DR_TPU_SCAN_PIPE"] = "manual"
+        else:
+            os.environ.pop("DR_TPU_SCAN_PIPE", None)
         os.environ["DR_TPU_SCAN_CHUNK"] = str(cap)
 
         @jax.jit
         def run(x, r, salt):
+            # chain scans DIRECTLY (scan of the previous output): a
+            # rescale between rounds would add a whole extra HBM pass
+            # to every round and undercount the kernel by ~2x.  Values
+            # blow up to inf; inf arithmetic runs at full speed and
+            # the inclusive_scan_n bench measures the same way.
             x = x.at[0].add(salt * 1e-9)
 
             def body(i, acc):
-                return scan_pallas.chunked_cumsum(acc) * jnp.asarray(
-                    1e-9, acc.dtype)
+                return scan_pallas.chunked_cumsum(acc)
             out = jax.lax.fori_loop(0, r, body, x)
             return out[n // 2]
 
@@ -115,13 +128,16 @@ def tune_scan():
             return float(run(x, r, s[0]))
         try:
             dt = _marginal(sync)
-            print(f"scan kernel [{variant} R={cap}]: {dt * 1e3:.3f} ms "
+            print(f"scan kernel [{variant} {pipe} R={cap}]: "
+                  f"{dt * 1e3:.3f} ms "
                   f"-> {2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
         except Exception as e:
-            print(f"scan kernel [{variant} R={cap}]: FAIL "
+            print(f"scan kernel [{variant} {pipe} R={cap}]: FAIL "
                   f"{_errline(e)}", flush=True)
     os.environ.pop("DR_TPU_SCAN_KERNEL", None)
     os.environ.pop("DR_TPU_SCAN_CHUNK", None)
+    os.environ.pop("DR_TPU_SCAN_PASSES", None)
+    os.environ.pop("DR_TPU_SCAN_PIPE", None)
 
 
 def tune_container(name):
