@@ -51,8 +51,9 @@ __all__ = ["chunked_cumsum", "pick_chunk", "prefix_matrix",
            "supported"]
 
 LANES = 128
-_MAX_ROWS = 4096  # default chunk rows (hierarchical offsets: no (R, R)
-# operator to bound — the cap is the 2x double-buffered VMEM footprint)
+_MAX_ROWS = 8192  # default chunk rows (hierarchical offsets: no (R, R)
+# operator to bound — the cap is the 2x double-buffered VMEM footprint;
+# R=8192 measured best on the v5e, tools/tune_scan3.log)
 
 
 def supported() -> bool:
@@ -112,10 +113,12 @@ def scan_passes() -> int:
     """bf16 term count for the lane-prefix matmul (DR_TPU_SCAN_PASSES):
     k terms cost k DEFAULT MXU passes and keep ~8k mantissa bits of the
     input (the 0/1 operator is exact in bf16, so all error is in the
-    split).  0 selects plain f32 HIGHEST (6 fused passes).  Default 3
-    ~ f32-exact."""
+    split).  0 selects plain f32 HIGHEST (6 fused passes) — the default:
+    the kernel is DMA-bound, HIGHEST measured fastest on the v5e (one
+    fused op vs split casts + 3 dots, tools/tune_scan3.log), and it is
+    the most accurate form."""
     from ..utils.env import env_int
-    return min(env_int("DR_TPU_SCAN_PASSES", 3, floor=0), 3)
+    return min(env_int("DR_TPU_SCAN_PASSES", 0, floor=0), 3)
 
 
 def _bf16_terms(x, k: int):
@@ -321,9 +324,12 @@ def chunked_cumsum(x, *, interpret: bool = False):
     G = R // LANES
     vpu = os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower() == "vpu"
     passes = scan_passes()
-    manual = (os.environ.get("DR_TPU_SCAN_PIPE", "").strip().lower()
-              == "manual")
-    build = _build if manual else _build_grid
+    # default is the manual double-buffered pipeline: it has compiled
+    # and run on hardware; the auto-grid form is opt-in
+    # (DR_TPU_SCAN_PIPE=grid) until a chip compile proves it out
+    grid = (os.environ.get("DR_TPU_SCAN_PIPE", "").strip().lower()
+            == "grid")
+    build = _build_grid if grid else _build
     fn = build(rows, R, str(x.dtype), interpret, vpu, passes)
     if vpu:
         # the vpu kernel never reads the lane-prefix operand

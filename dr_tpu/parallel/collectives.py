@@ -86,8 +86,12 @@ class communicator:
 
     def gather(self, arr) -> np.ndarray:
         """Collect a (sharded) array to the host (communicator.hpp:47-62).
-        Result is valid on every rank (single controller)."""
-        return np.asarray(arr)
+        Result is valid on every rank: single-controller reads are plain
+        host copies, and in multi-process (MHP/DCN) runs non-addressable
+        shards arrive via ``process_allgather`` (utils/host.to_host) —
+        ``np.asarray`` alone cannot materialize them."""
+        from ..utils.host import to_host
+        return to_host(arr)
 
     def allgather(self, arr) -> np.ndarray:
         return self.gather(arr)

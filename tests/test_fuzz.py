@@ -359,3 +359,132 @@ def test_fuzz_matmul_stencil_band_widths(monkeypatch):
                     for wi, s in zip(w, (2, 1, 0, -1, -2)))
         np.testing.assert_allclose(dr_tpu.to_numpy(out), x,
                                    rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_halo_exchange_reduce(seed):
+    """Random (prev, next, periodic, n) through exchange + reduce — the
+    comm-layer layout edges (asymmetric radii, short tails, ring wrap)
+    vs a logical-index oracle (VERDICT r2 item 7).
+
+    Semantics: after exchange every ghost mirrors its logical neighbor
+    element; reduce(op) folds each ghost's value back into the cell it
+    mirrors (halo.hpp:73-110)."""
+    rng = np.random.default_rng(400 + seed)
+    P = dr_tpu.nprocs()
+    for _ in range(ITERS // 3):
+        prev = int(rng.integers(0, 4))
+        nxt = int(rng.integers(0, 4))
+        if prev == 0 and nxt == 0:
+            continue
+        periodic = bool(rng.integers(0, 2))
+        n = int(rng.integers(2 * P, 14 * P))
+        src = rng.standard_normal(n).astype(np.float32)
+        hb = dr_tpu.halo_bounds(prev, nxt, periodic)
+        try:
+            dv = dr_tpu.distributed_vector.from_array(src, halo=hb)
+        except ValueError:
+            continue  # shards too small for this halo (min-size check)
+        dr_tpu.halo(dv).exchange()
+        seg = dv.segment_size
+        rows = np.asarray(dv._data)
+        # ghost oracle: logical neighbors, wrap only when periodic
+        for r in range(dv.nshards):
+            lo = r * seg
+            hi = min(n, lo + seg)
+            if prev and (r > 0 or periodic):
+                want = src[(np.arange(lo - prev, lo)) % n]
+                np.testing.assert_allclose(rows[r, :prev], want,
+                                           err_msg=f"ghost_prev r={r}")
+            if nxt and (r < dv.nshards - 1 or periodic):
+                want = src[(np.arange(hi, hi + nxt)) % n]
+                # a short tail places its incoming ghost right after the
+                # owned cells (stencils read x[i+1] at prev+tail), not
+                # at the padded prev+seg slot
+                tail = hi - lo
+                np.testing.assert_allclose(
+                    rows[r, prev + tail:prev + tail + nxt], want,
+                    err_msg=f"ghost_next r={r}")
+        # reduce oracle: every live ghost adds into the cell it mirrors
+        dr_tpu.halo(dv).reduce_plus()
+        ref = src.astype(np.float64).copy()
+        for r in range(dv.nshards):
+            lo = r * seg
+            hi = min(n, lo + seg)
+            if prev and (r > 0 or periodic):
+                for g in range(lo - prev, lo):
+                    ref[g % n] += src[g % n]
+            if nxt and (r < dv.nshards - 1 or periodic):
+                for g in range(hi, hi + nxt):
+                    ref[g % n] += src[g % n]
+        np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_unstructured_halo(seed):
+    """Random index maps through the unstructured halo: exchange must
+    mirror owners into ghosts, and scatter-reduce must combine every
+    contribution — including DUPLICATE indices across ranks (the case
+    the reference's sequential unpack loop hides, halo.hpp:181-203)."""
+    rng = np.random.default_rng(500 + seed)
+    P = dr_tpu.nprocs()
+    for _ in range(ITERS // 4):
+        n = int(rng.integers(P, 20 * P))
+        src = rng.standard_normal(n).astype(np.float32)
+        dv = dr_tpu.distributed_vector.from_array(src)
+        ghost_map = {}
+        for r in range(P):
+            k = int(rng.integers(0, min(n, 6) + 1))
+            if k:
+                ghost_map[r] = rng.integers(0, n, size=k).tolist()
+        uh = dr_tpu.unstructured_halo(dv, ghost_map)
+        uh.exchange()
+        for r, ix in ghost_map.items():
+            np.testing.assert_allclose(np.asarray(uh.ghost_values(r)),
+                                       src[np.asarray(ix)], rtol=1e-6)
+        # each rank writes contributions into its ghosts, then reduce
+        contribs = {}
+        for r, ix in ghost_map.items():
+            vals = rng.standard_normal(len(ix)).astype(np.float32)
+            contribs[r] = vals
+            uh.set_ghost_values(r, vals)
+        uh.reduce("plus")
+        ref = src.astype(np.float64).copy()
+        for r, ix in ghost_map.items():
+            np.add.at(ref, np.asarray(ix), contribs[r].astype(np.float64))
+        np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_misaligned_zip_fallback(seed):
+    """Zips of differently-distributed operands: ``aligned()`` must
+    report False and every algorithm must still produce the serial
+    result through the resharding fallback (the reference falls back to
+    rank-0 serial RMA, cpu_algorithms.hpp:44-54; ours reshards)."""
+    rng = np.random.default_rng(600 + seed)
+    P = dr_tpu.nprocs()
+    for _ in range(ITERS // 4):
+        n = int(rng.integers(P, 80))
+
+        def cuts():
+            c = np.sort(rng.integers(0, n + 1, size=P - 1))
+            b = np.concatenate(([0], c, [n]))
+            return tuple(int(y - x) for x, y in zip(b[:-1], b[1:]))
+
+        da, db = cuts(), cuts()
+        a_src = rng.standard_normal(n).astype(np.float32)
+        b_src = rng.standard_normal(n).astype(np.float32)
+        a = dr_tpu.distributed_vector.from_array(a_src, distribution=da)
+        b = dr_tpu.distributed_vector.from_array(b_src, distribution=db)
+        if da != db:
+            assert not dr_tpu.aligned(a, b)
+        out = dr_tpu.distributed_vector(n)  # uniform: misaligned w/ both
+        dr_tpu.transform(views.zip(a, b), out, lambda x, y: x * y + 1)
+        np.testing.assert_allclose(dr_tpu.to_numpy(out),
+                                   a_src * b_src + 1, rtol=1e-5,
+                                   atol=1e-5)
+        got = dr_tpu.dot(a, b)
+        ref = float(a_src.astype(np.float64) @ b_src.astype(np.float64))
+        assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)

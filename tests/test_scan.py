@@ -226,7 +226,7 @@ def test_chunked_cumsum_pipe_and_passes_variants(monkeypatch):
     x = rng.standard_normal(n).astype(np.float32)
     ref = np.cumsum(x.astype(np.float64))
     scale = np.abs(ref).max() + 1
-    for pipe in ("", "manual"):
+    for pipe in ("grid", "manual"):
         for passes in ("0", "2", "3"):
             monkeypatch.setenv("DR_TPU_SCAN_PIPE", pipe)
             monkeypatch.setenv("DR_TPU_SCAN_PASSES", passes)

@@ -90,10 +90,13 @@ def tune_scan():
     x = jnp.ones((n,), jnp.float32)
     print("pick_chunk:", scan_pallas.pick_chunk(n), flush=True)
 
-    sweep = [("mxu3", 4096, "grid"), ("mxu3", 8192, "grid"),
-             ("mxu0", 8192, "grid"), ("mxu0", 16384, "grid"),
-             ("mxu3", 16384, "grid"), ("vpu", 8192, "grid"),
-             ("mxu0", 8192, "manual"), ("mxu0", 8192, "grid")]
+    # manual-pipeline entries first: the auto-grid form has hung the
+    # remote compiler before (round-3 notes), so the provable numbers
+    # must land before any grid attempt can stall the sweep
+    sweep = [("mxu0", 8192, "manual"), ("mxu3", 8192, "manual"),
+             ("mxu0", 16384, "manual"), ("mxu3", 16384, "manual"),
+             ("mxu0", 4096, "manual"), ("vpu", 8192, "manual"),
+             ("mxu0", 8192, "grid"), ("mxu3", 8192, "grid")]
     for variant, cap, pipe in sweep:
         if variant == "vpu":
             os.environ["DR_TPU_SCAN_KERNEL"] = "vpu"
@@ -101,10 +104,7 @@ def tune_scan():
         else:
             os.environ.pop("DR_TPU_SCAN_KERNEL", None)
             os.environ["DR_TPU_SCAN_PASSES"] = variant[-1]
-        if pipe == "manual":
-            os.environ["DR_TPU_SCAN_PIPE"] = "manual"
-        else:
-            os.environ.pop("DR_TPU_SCAN_PIPE", None)
+        os.environ["DR_TPU_SCAN_PIPE"] = pipe
         os.environ["DR_TPU_SCAN_CHUNK"] = str(cap)
 
         @jax.jit
