@@ -93,7 +93,7 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     radius = 2
     if matmul:
         from dr_tpu.ops import stencil_matmul
-        # composed band may reach two lane columns each side
+        # composed band may reach four lane columns each side (default)
         la = stencil_matmul.LANES
         tblock = min(tblock, stencil_matmul.max_ksteps(radius))
         halo_w = max(la, -(-tblock * radius // la) * la)
@@ -562,10 +562,11 @@ def main():
             (["pallas"] if stencil_pallas.supported() else []) + ["xla"]
     else:
         chain = ["xla"]
-    # 128 composed steps per HBM pass on the matmul path (band spans two
-    # lane columns each side at radius 2); the pallas VPU path clamps
-    # per its own budget
-    tblock = int(os.environ.get("DR_TPU_BENCH_TBLOCK", "128"))
+    # 256 composed steps per HBM pass on the matmul path (band spans
+    # four lane columns each side at radius 2 — the round-3 measured
+    # winner, tools/tune_stencil.log); the pallas VPU path clamps per
+    # its own budget
+    tblock = int(os.environ.get("DR_TPU_BENCH_TBLOCK", "256"))
     if on_cpu and "DR_TPU_BENCH_N" not in os.environ:
         n = 2 ** 24  # keep CPU smoke runs fast
 

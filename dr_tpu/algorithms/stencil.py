@@ -253,7 +253,7 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
     Same contract as :func:`stencil_iterate_blocked` (periodic ring,
     equal full shards, halo width >= k_block * radius); additionally
     k_block <= max_ksteps(radius) — the composed band may span up to
-    two lane columns each side by default (DR_TPU_MM_BAND_COLS widens
+    four lane columns each side by default (DR_TPU_MM_BAND_COLS moves
     the cap).  Returns ``dv`` stepped ``steps`` times.
     """
     from ..ops import stencil_matmul

@@ -59,11 +59,14 @@ def composed_taps(weights: Sequence[float], k: int) -> np.ndarray:
 def max_ksteps(radius: int, ncols: int | None = None) -> int:
     """Largest supported composable block: the band half-width ``k*r``
     may span up to ``ncols`` lane columns each side (D <= ncols;
-    default 2, DR_TPU_MM_BAND_COLS overrides for on-device tuning —
-    with the 3-pass HIGH-emulated apply the MXU stays under the DMA
-    floor up to about 4 columns)."""
+    default 4, DR_TPU_MM_BAND_COLS overrides for on-device tuning).
+    The round-3 sweep (tools/tune_stencil.log) measured the 4-column
+    band at k=256 BETTER on both axes than the old 2-column default —
+    phys 167 vs 153 GB/s, effective 21386 vs 9816 GB/s — the HIGH-
+    emulated apply keeps the MXU under the DMA floor through 4
+    columns."""
     if ncols is None:
-        ncols = env_int("DR_TPU_MM_BAND_COLS", 2)
+        ncols = env_int("DR_TPU_MM_BAND_COLS", 4)
     return ncols * LANES // radius
 
 

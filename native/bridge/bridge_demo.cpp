@@ -46,6 +46,21 @@ void check_range(const char* what, const std::vector<double>& got,
 }  // namespace
 
 int main(int argc, char** argv) {
+  // expr DSL serialization: canonical strings ARE the op cache keys
+  // (equal strings -> one Python callable -> reused XLA programs), so
+  // their exact shape is part of the bridge contract
+  if ((thp::x0 * 2.0 + 1.0).str() != "((x0 * 2) + 1)" ||
+      thp::max(thp::sqrt(thp::abs(thp::x0)), thp::x1).str() !=
+          "maximum(sqrt(abs(x0)), x1)" ||
+      (1.5 / thp::x2 - -thp::x3).str() != "((1.5 / x2) - (0 - x3))") {
+    std::printf("expr serialization FAIL: %s | %s | %s\n",
+                (thp::x0 * 2.0 + 1.0).str().c_str(),
+                thp::max(thp::sqrt(thp::abs(thp::x0)), thp::x1)
+                    .str().c_str(),
+                (1.5 / thp::x2 - -thp::x3).str().c_str());
+    return 1;
+  }
+
   int ncpu = argc > 1 ? std::atoi(argv[1]) : 8;
   thp::session s(ncpu);
   std::printf("nprocs=%zu\n", s.nprocs());

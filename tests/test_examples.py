@@ -128,4 +128,4 @@ def test_env_knob_tolerant_parsing(monkeypatch):
     monkeypatch.setenv("DR_TPU_SCAN_CHUNK", "oops")
     assert scan_pallas.chunk_cap() == scan_pallas._MAX_ROWS
     monkeypatch.setenv("DR_TPU_MM_BAND_COLS", "wide")
-    assert stencil_matmul.max_ksteps(2) == 128
+    assert stencil_matmul.max_ksteps(2) == 256  # 4-column default
