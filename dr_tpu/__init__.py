@@ -46,6 +46,7 @@ from .utils.logging import drlog
 from .utils.debug import print_range, print_matrix, range_details
 from .utils import checkpoint
 from .utils import profiling
+from .utils import spmd_guard
 from .ops.ring_attention import ring_attention, ring_attention_n
 from .views import views
 from .views.views import aligned, local_segments
@@ -87,6 +88,7 @@ __all__ = [
     "init_distributed", "distributed_span",
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
-    "checkpoint", "profiling", "ring_attention", "ring_attention_n",
+    "checkpoint", "profiling", "spmd_guard",
+    "ring_attention", "ring_attention_n",
     "dot_n", "inclusive_scan_n", "gemv_n", "stencil2d_n",
 ]

@@ -16,9 +16,19 @@ envelope as the program caches themselves (which never evict).
 _pins: dict = {}
 
 
+class PinnedId(int):
+    """An ``int`` that knows it is an object identity.  Hashing and
+    equality are inherited (cache keys behave exactly as before); the
+    distinct TYPE lets consumers that compare keys ACROSS processes
+    (utils/spmd_guard) canonicalize identities away without guessing
+    from magnitude — ids are process-local, structure is not."""
+
+    __slots__ = ()
+
+
 def pinned_id(obj):
     """Stable identity key for ``obj`` (None passes through)."""
     if obj is None:
         return None
     _pins.setdefault(id(obj), obj)
-    return id(obj)
+    return PinnedId(id(obj))

@@ -1,0 +1,144 @@
+"""SPMD dispatch-order guard: detect divergent collective ordering.
+
+The multi-process (MHP/DCN) dimension has one protocol invariant: every
+process must enqueue the same sharded programs in the same order
+(SURVEY.md §7 hard-part 6 — the discipline the reference gets for free
+from MPI's matched collectives).  A violation does not crash; it
+DEADLOCKS or silently mismatches data.  The reference ships no tool for
+this class of bug (its §5 race-detection row is empty); this guard is
+the TPU build's answer.
+
+Usage::
+
+    from dr_tpu.utils import spmd_guard
+    with spmd_guard.guard() as g:
+        ... run the SPMD section on every process ...
+        g.verify()          # collective: raises on divergence
+
+While active, every algorithm-layer program dispatch (all of them pass
+through the shared program cache) records a canonicalized form of its
+cache key.  ``verify()`` allgathers a digest across processes; on
+mismatch it allgathers the full traces and reports the first divergent
+dispatch index with both sides' entries — the information a deadlock
+postmortem cannot give you.
+
+Canonicalization: cache keys embed ``pinned_id`` values (process-local
+object identities, typed ``core.pinning.PinnedId``), which legitimately
+differ across processes; exactly those are replaced by a placeholder —
+every other int is structural and compared verbatim.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from contextlib import contextmanager
+from typing import List, Optional
+
+from ..core.pinning import PinnedId
+
+__all__ = ["guard", "active", "DivergenceError"]
+
+
+class DivergenceError(RuntimeError):
+    pass
+
+
+def _canon(x) -> str:
+    if isinstance(x, tuple):
+        return "(" + ",".join(_canon(e) for e in x) + ")"
+    if isinstance(x, PinnedId):
+        return "ptr"
+    if callable(x):
+        return getattr(x, "__name__", "fn")
+    return repr(x)
+
+
+class SpmdGuard:
+    def __init__(self):
+        self.trace: List[str] = []
+
+    def record(self, key) -> None:
+        self.trace.append(_canon(key))
+
+    def digest(self) -> str:
+        h = hashlib.sha1()
+        for t in self.trace:
+            h.update(t.encode())
+            h.update(b"\0")
+        return h.hexdigest()
+
+    def verify(self) -> None:
+        """Collective check (every process must call it at the same
+        point — it is itself a dispatch in the protocol).  No-op in
+        single-process runs beyond freezing the trace."""
+        import jax
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+        import numpy as np
+        me = jax.process_index()
+        # phase 1: fixed-size digest + count from every process
+        digest_bytes = np.frombuffer(
+            bytes.fromhex(self.digest()), dtype=np.uint8)
+        mine = np.concatenate(
+            [digest_bytes.astype(np.int64), [len(self.trace)]])
+        allv = np.asarray(multihost_utils.process_allgather(mine))
+        if (allv == allv[0]).all():
+            return
+        # phase 2 (all processes reach here together — everyone saw the
+        # same mismatching gather): ship the traces and locate the
+        # first divergence against process 0
+        import json
+        payload = json.dumps(self.trace).encode()
+        # pad to the max length so the gather has one static shape
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(payload)], np.int64))).reshape(-1)
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+        traces_raw = np.asarray(
+            multihost_utils.process_allgather(buf))
+        traces = [json.loads(bytes(traces_raw[p][:int(lens[p])]
+                                   ).decode())
+                  for p in range(traces_raw.shape[0])]
+        base = traces[0]
+        for p, tr in enumerate(traces[1:], start=1):
+            n = min(len(base), len(tr))
+            for i in range(n):
+                if base[i] != tr[i]:
+                    raise DivergenceError(
+                        f"SPMD dispatch divergence at index {i}: "
+                        f"process 0 dispatched {base[i]} but process "
+                        f"{p} dispatched {tr[i]} (I am process {me})")
+            if len(base) != len(tr):
+                raise DivergenceError(
+                    f"SPMD dispatch-count divergence: process 0 made "
+                    f"{len(base)} dispatches, process {p} made "
+                    f"{len(tr)} (first {n} agree; I am process {me})")
+        raise DivergenceError(
+            "SPMD digest mismatch with identical traces — "
+            "canonicalization bug, please report")
+
+
+_active: Optional[SpmdGuard] = None
+
+
+def active() -> Optional[SpmdGuard]:
+    return _active
+
+
+def record(key) -> None:
+    """Called by the shared program cache on every dispatch lookup."""
+    if _active is not None:
+        _active.record(key)
+
+
+@contextmanager
+def guard():
+    global _active
+    prev = _active
+    g = SpmdGuard()
+    _active = g
+    try:
+        yield g
+    finally:
+        _active = prev

@@ -45,6 +45,7 @@ def _clear_tuning_knobs(monkeypatch):
     sweep's env (tools/tune_tpu.py exports these) must not shift chunk
     sizes, tiles, or variants under geometry-sensitive assertions."""
     for var in ("DR_TPU_SCAN_CHUNK", "DR_TPU_SCAN_KERNEL",
+                "DR_TPU_SCAN_PIPE", "DR_TPU_SCAN_PASSES",
                 "DR_TPU_MM_CHUNK_CAP", "DR_TPU_MM_BAND_COLS",
                 "DR_TPU_FLASH_BQ", "DR_TPU_FLASH_BK",
                 "DR_TPU_FLASH_STREAM", "DR_TPU_MM_PRECISION",

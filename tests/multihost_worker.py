@@ -100,6 +100,17 @@ np.testing.assert_allclose(
     np.asarray(attn.addressable_shards[0].data),
     np.asarray(att1.addressable_shards[0].data), rtol=1e-5, atol=1e-6)
 
+# SPMD dispatch-order guard: both processes ran the same collective
+# sequence above — verify() must agree (and is itself collective)
+from dr_tpu.utils import spmd_guard  # noqa: E402
+with spmd_guard.guard() as _g:
+    _gv = dr_tpu.distributed_vector(n)
+    dr_tpu.iota(_gv, 0)
+    dr_tpu.fill(_gv, 1.0)
+    dr_tpu.dot(_gv, _gv)
+    _g.verify()
+assert len(_g.trace) >= 3
+
 # communicator gather/allgather must be valid on EVERY process: the
 # global logical array is not fully addressable here, so this exercises
 # the process_allgather route (utils/host.to_host) — np.asarray alone

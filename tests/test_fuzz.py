@@ -341,7 +341,9 @@ def test_fuzz_matmul_stencil_band_widths(monkeypatch):
     w = [0.05, 0.25, 0.4, 0.25, 0.05]  # radius 2
     r = 2
     P = dr_tpu.nprocs()
-    for k in (8, 32, 64, 96, 128, 192, 256):  # D = 1, 1, 1, 2, 2, 3, 4
+    # D = 1, 1, 1, 2, 2, 3, 4, 5 — the last case exceeds the 4-column
+    # default cap so the DR_TPU_MM_BAND_COLS widening path stays covered
+    for k in (8, 32, 64, 96, 128, 192, 256, 320):
         halo = max(128, -(-k * r // 128) * 128)
         n = P * 1024
         src = rng.standard_normal(n).astype(np.float32)
@@ -351,7 +353,7 @@ def test_fuzz_matmul_stencil_band_widths(monkeypatch):
         from dr_tpu.algorithms.stencil import stencil_iterate_matmul
         import dr_tpu.ops.stencil_matmul as sm
         if k > sm.max_ksteps(r):
-            monkeypatch.setenv("DR_TPU_MM_BAND_COLS", "4")
+            monkeypatch.setenv("DR_TPU_MM_BAND_COLS", "8")
         out = stencil_iterate_matmul(dv, w, steps, k_block=k)
         x = src.astype(np.float64)
         for _ in range(steps):
