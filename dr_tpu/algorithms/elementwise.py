@@ -133,26 +133,13 @@ def _fast_aligned(ins: Tuple[_Chain, ...], out: _Chain) -> bool:
 # fused elementwise programs
 # ---------------------------------------------------------------------------
 
-from ..utils.spmd_guard import record as _guard_record
+from ..utils.spmd_guard import TappedCache
 
-
-class _ProgCache(dict):
-    """Shared program cache for the whole algorithm layer.  Every
-    dispatch does a ``get``/``setdefault`` here FIRST (hit or miss), so
-    the lookup doubles as the SPMD dispatch-order tap for
-    ``utils.spmd_guard`` (record() is a no-op without an active
-    guard)."""
-
-    def get(self, key, default=None):
-        _guard_record(key)
-        return super().get(key, default)
-
-    def setdefault(self, key, default=None):
-        _guard_record(key)
-        return super().setdefault(key, default)
-
-
-_prog_cache: dict = _ProgCache()
+# Shared program cache for the algorithm layer.  Every dispatch does a
+# get/setdefault here FIRST (hit or miss), so the lookup doubles as the
+# SPMD dispatch-order tap (utils/spmd_guard); the per-module caches in
+# halo/collectives/containers/ring_attention are TappedCaches too.
+_prog_cache: dict = TappedCache()
 
 
 def _window_program(out_chain: _Chain, in_keys, in_ops, op, with_index,

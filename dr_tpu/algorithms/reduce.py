@@ -231,6 +231,13 @@ def dot_async(a, b):
     return reduce_async(_v.transform(z, _multiply2), operator.add)
 
 
+def _dot_kernel_platform_ok(rt) -> bool:
+    """Mosaic compiles for TPU only; tests monkeypatch this together
+    with an interpret-mode ``chunked_dot`` to cover the kernel path on
+    the CPU mesh."""
+    return rt.devices[0].platform == "tpu"
+
+
 def dot_n(a, b, iters: int):
     """``iters`` chained dot products in ONE jitted program — the
     measurement analog of ``span_halo.exchange_n`` (parallel/halo.py):
@@ -250,21 +257,56 @@ def dot_n(a, b, iters: int):
     assert c0.cont.layout == c1.cont.layout and c0.off == c1.off \
         and c0.n == c1.n
     assert not c0.ops and not c1.ops, "dot_n takes plain containers"
-    key = ("dot_n", c0.key, c1.key, int(iters))
+    layout, off, n = c0.cont.layout, c0.off, c0.n
+    nshards, seg, prev, nxt, total_n = layout
+    # opt-in Pallas chunked-dot path (DR_TPU_DOT_IMPL=pallas): per-shard
+    # streamed multiply+reduce + psum, salt folded inside the kernel
+    from ..ops import reduce_pallas, scan_pallas
+    rt = c0.cont.runtime
+    use_kern = (reduce_pallas.supported() and reduce_pallas.use_dot_kernel()
+                and _dot_kernel_platform_ok(rt)
+                # f32-accumulable input dtypes only (the kernel casts
+                # chunks to f32 and returns f32 — integer exactness and
+                # f64 must keep the XLA path, like _use_scan_kernel)
+                and jnp.dtype(c0.cont.dtype) in (
+                    jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                    jnp.dtype(jnp.float16))
+                and c0.cont.dtype == c1.cont.dtype
+                and prev == 0 and nxt == 0 and off == 0
+                and n == total_n and nshards * seg == total_n
+                and scan_pallas.pick_chunk(seg) is not None)
+    key = ("dot_n", c0.key, c1.key, int(iters), use_kern,
+           scan_pallas.chunk_cap() if use_kern else None)
     prog = _prog_cache.get(key)
     if prog is None:
-        layout, off, n = c0.cont.layout, c0.off, c0.n
+        if use_kern:
+            from jax.sharding import PartitionSpec as P
 
-        def many(d0, d1):
-            mask, _gid = owned_window_mask(layout, off, n)
+            def body(x_blk, y_blk):  # one shard: (1, seg)
+                def it(_, s):
+                    local = reduce_pallas.chunked_dot(
+                        x_blk[0], y_blk[0], salt=s * 1e-38)
+                    return jax.lax.psum(local, rt.axis)
 
-            def it(_, s):
-                prod = d0 * (d1 + s * jnp.asarray(1e-38, d1.dtype))
-                return jnp.sum(jnp.where(mask, prod, 0))
+                return jax.lax.fori_loop(0, iters, it,
+                                         jnp.zeros((), jnp.float32))
 
-            return jax.lax.fori_loop(0, iters, it,
-                                     jnp.zeros((), d0.dtype))
+            shm = jax.shard_map(body, mesh=rt.mesh,
+                                in_specs=(P(rt.axis, None),
+                                          P(rt.axis, None)),
+                                out_specs=P(), check_vma=False)
+            prog = jax.jit(shm)
+        else:
+            def many(d0, d1):
+                mask, _gid = owned_window_mask(layout, off, n)
 
-        prog = jax.jit(many)
+                def it(_, s):
+                    prod = d0 * (d1 + s * jnp.asarray(1e-38, d1.dtype))
+                    return jnp.sum(jnp.where(mask, prod, 0))
+
+                return jax.lax.fori_loop(0, iters, it,
+                                         jnp.zeros((), d0.dtype))
+
+            prog = jax.jit(many)
         _prog_cache[key] = prog
     return prog(c0.cont._data, c1.cont._data)

@@ -137,28 +137,44 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
         ident = _identity_for(kind, dtype) if kind is not None else None
         x = blk[0, prev:prev + seg]
         r = lax.axis_index(axis)
-        gid = r * seg + jnp.arange(seg)
-        if ident is not None:
+        # pad cells exist only when the ceil layout overshoots n: skip
+        # the masking pass (a whole extra HBM read-modify) when exact
+        exact = nshards * seg == n
+        if ident is not None and not exact:
+            gid = r * seg + jnp.arange(seg)
             x = jnp.where(gid < n, x, ident)
         if use_kernel:
+            # carry-seeded kernel: compute each shard's TOTAL first (a
+            # cheap reduction read), fold the preceding totals, and
+            # hand the carry to the kernel — the scan itself is then
+            # the ONLY full read+write pass; the round-2 form paid a
+            # third whole-array pass for the carry fixup
             from ..ops import scan_pallas
-            local = scan_pallas.chunked_cumsum(x)
+            if nshards == 1:
+                scanned = scan_pallas.chunked_cumsum(x)
+            else:
+                totals = lax.all_gather(jnp.sum(x), axis)  # (nshards,)
+                masked = jnp.where(jnp.arange(nshards) < r, totals,
+                                   jnp.zeros((), totals.dtype))
+                carry = jnp.sum(masked)
+                scanned = scan_pallas.chunked_cumsum(x, carry=carry)
         else:
             local = _blocked_scan(combine, x,
                                   ident if kind is not None else None,
                                   kind)
-        totals = lax.all_gather(local[-1], axis)          # (nshards,)
-        # exclusive fold of totals from ranks < r  ->  my carry
-        if ident is not None:
-            masked = jnp.where(jnp.arange(nshards) < r, totals, ident)
-            carry = lax.associative_scan(combine, masked)[-1]
-            scanned = jnp.where(r > 0, combine(carry, local), local)
-        else:
-            # no identity: fold sequentially with lax.fori_loop
-            def fold(i, acc):
-                return jnp.where(i < r, combine(acc, totals[i]), acc)
-            carry = lax.fori_loop(1, nshards, fold, totals[0])
-            scanned = jnp.where(r > 0, combine(carry, local), local)
+            totals = lax.all_gather(local[-1], axis)      # (nshards,)
+            # exclusive fold of totals from ranks < r  ->  my carry
+            if ident is not None:
+                masked = jnp.where(jnp.arange(nshards) < r, totals,
+                                   ident)
+                carry = lax.associative_scan(combine, masked)[-1]
+                scanned = jnp.where(r > 0, combine(carry, local), local)
+            else:
+                # no identity: fold sequentially with lax.fori_loop
+                def fold(i, acc):
+                    return jnp.where(i < r, combine(acc, totals[i]), acc)
+                carry = lax.fori_loop(1, nshards, fold, totals[0])
+                scanned = jnp.where(r > 0, combine(carry, local), local)
         if exclusive:
             shifted = jnp.roll(scanned, 1)
             prev_rank_last = lax.ppermute(

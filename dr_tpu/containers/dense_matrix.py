@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .partition import block_cyclic, matrix_partition
 from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
+from ..utils.spmd_guard import TappedCache
 
 __all__ = ["dense_matrix", "matrix_entry", "Index2D"]
 
@@ -335,7 +336,7 @@ class dense_matrix:
                 f"tile={self._tshape}, dtype={self._dtype})")
 
 
-_cache: dict = {}
+_cache: dict = TappedCache()
 
 
 def _zeros2d(mesh, mm, nn, dtype, sharding):

@@ -36,6 +36,7 @@ from ..core.segment import Segment
 from ..parallel import runtime as _rt
 from ..parallel.halo import halo_bounds, span_halo
 from .distribution import block_distribution
+from ..utils.spmd_guard import TappedCache
 
 __all__ = ["distributed_vector", "halo"]
 
@@ -312,7 +313,7 @@ class distributed_vector:
 # cached jitted layout programs
 # ---------------------------------------------------------------------------
 
-_jit_cache: dict = {}
+_jit_cache: dict = TappedCache()
 
 
 def _cached(key, builder):

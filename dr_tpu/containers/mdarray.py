@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .partition import factor
 from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
+from ..utils.spmd_guard import TappedCache
 
 __all__ = ["distributed_mdarray", "distributed_mdspan", "transpose"]
 
@@ -314,4 +315,4 @@ def transpose(out: distributed_mdarray, inp: distributed_mdarray) -> None:
     out.assign_array(fn(inp.to_array()))
 
 
-_md_cache: dict = {}
+_md_cache: dict = TappedCache()

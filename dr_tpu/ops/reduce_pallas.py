@@ -1,0 +1,122 @@
+"""Pallas TPU kernel: chunked dot product (fused multiply + reduce).
+
+The dot workload (``examples/shp/dot_product.cpp:11-18`` — the driver
+metric's transform_reduce config) reads two arrays once and reduces;
+its HBM floor is 8 B/element.  The XLA fused reduce measured ~57% of
+peak on the v5e (BENCH_r01), leaving real headroom — this kernel
+streams both operands through VMEM with the same manual double-buffered
+DMA template the scan kernel runs on hardware
+(``scan_pallas._build``), folding each chunk's product-sum into an SMEM
+f32 accumulator.  Per grid step the DMA engine moves 2 chunks in and
+nothing out, so the kernel is purely read-bound.
+
+``salt`` is a traced scalar added to ``y`` inside the kernel: the
+``dot_n`` measurement loop perturbs successive rounds through it so
+XLA cannot hoist or skip re-reading the operands — without paying the
+separate elementwise pass a host-side ``y + salt`` would cost.
+
+Opt-in (``DR_TPU_DOT_IMPL=pallas``) until measured on hardware; the
+XLA path stays the default.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from .scan_pallas import LANES, pick_chunk
+from .stencil_pallas import _HAS_PLTPU, pltpu
+
+__all__ = ["chunked_dot", "supported", "use_dot_kernel"]
+
+
+def supported() -> bool:
+    return _HAS_PLTPU
+
+
+def use_dot_kernel() -> bool:
+    """DR_TPU_DOT_IMPL=pallas opts the dot measurement family into the
+    kernel; read per call so tuning sweeps work in-process (callers key
+    their program caches on it)."""
+    import os
+    return os.environ.get("DR_TPU_DOT_IMPL", "").strip().lower() \
+        == "pallas"
+
+
+@functools.lru_cache(maxsize=16)
+def _build(rows: int, R: int, dtype_name: str, interpret: bool):
+    dtype = jnp.dtype(dtype_name)
+    nch = rows // R
+
+    def kernel(salt_ref, x_hbm, y_hbm, out_ref, vx, vy, acc, xs, ys):
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+
+        def in_dma(hbm, v, sem, c, s):
+            return pltpu.make_async_copy(
+                hbm.at[pl.ds(c * R, R), :], v.at[s], sem.at[s])
+
+        @pl.when(i == 0)
+        def _():
+            acc[0, 0] = jnp.zeros((), jnp.float32)
+            in_dma(x_hbm, vx, xs, 0, 0).start()
+            in_dma(y_hbm, vy, ys, 0, 0).start()
+
+        @pl.when(i + 1 < nch)
+        def _():
+            in_dma(x_hbm, vx, xs, i + 1, 1 - slot).start()
+            in_dma(y_hbm, vy, ys, i + 1, 1 - slot).start()
+
+        in_dma(x_hbm, vx, xs, i, slot).wait()
+        in_dma(y_hbm, vy, ys, i, slot).wait()
+        x = vx[slot].astype(jnp.float32)
+        y = vy[slot].astype(jnp.float32) + salt_ref[0, 0]
+        acc[0, 0] = acc[0, 0] + jnp.sum(x * y)
+
+        @pl.when(i == nch - 1)
+        def _():
+            out_ref[0, 0] = acc[0, 0]
+
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20)
+    return pl.pallas_call(
+        kernel,
+        grid=(nch,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, R, LANES), dtype),
+            pltpu.VMEM((2, R, LANES), dtype),
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+        **params,
+    )
+
+
+def chunked_dot(x, y, *, salt=None, interpret: bool = False):
+    """``sum(x * (y + salt))`` of two equal-length 1-D arrays in one
+    read-only HBM pass; returns an f32 scalar.  Requires
+    ``pick_chunk(len(x))`` (lane-blocked chunking) — callers fall back
+    to the XLA fused reduce otherwise."""
+    n = x.shape[0]
+    assert y.shape == x.shape and x.dtype == y.dtype
+    R = pick_chunk(n)
+    assert R is not None, "no lane-aligned chunking for this length"
+    rows = n // LANES
+    fn = _build(rows, R, str(x.dtype), interpret)
+    s = jnp.zeros((1, 1), jnp.float32) if salt is None else \
+        jnp.asarray(salt, jnp.float32).reshape(1, 1)
+    return fn(s, x.reshape(rows, LANES), y.reshape(rows, LANES))[0, 0]

@@ -29,10 +29,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.pinning import pinned_id
 from ..parallel import runtime as _rt
 from . import flash_attention as _fa
+from ..utils.spmd_guard import TappedCache
 
 __all__ = ["ring_attention", "ring_attention_n", "ring_self_attention"]
 
-_cache: dict = {}
+_cache: dict = TappedCache()
 
 
 def _flash_viable(shape, dtype, rt) -> bool:

@@ -193,12 +193,12 @@ def _build_grid(rows: int, R: int, dtype_name: str, interpret: bool,
     nch = rows // R
     G = R // LANES
 
-    def kernel(u_ref, us_ref, lg_ref, x_ref, o_ref, carry):
+    def kernel(c0_ref, u_ref, us_ref, lg_ref, x_ref, o_ref, carry):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _():
-            carry[0, 0] = jnp.zeros((), jnp.float32)
+            carry[0, 0] = c0_ref[0, 0]
 
         x = x_ref[...].astype(jnp.float32)
         out, tot = _chunk_prefix(x, u_ref, us_ref, lg_ref, carry[0, 0],
@@ -214,7 +214,8 @@ def _build_grid(rows: int, R: int, dtype_name: str, interpret: bool,
     return pl.pallas_call(
         kernel,
         grid=(nch,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec((R, LANES), lambda i: (i, 0))],
@@ -239,9 +240,12 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool,
     nch = rows // R
     G = R // LANES
 
-    def kernel(u_ref, us_ref, lg_ref, x_hbm, out_hbm, vin, vout, carry,
-               in_sem, out_sem):
-        # carry lives in SMEM: scalar state across the sequential grid
+    def kernel(c0_ref, u_ref, us_ref, lg_ref, x_hbm, out_hbm, vin, vout,
+               carry, in_sem, out_sem):
+        # carry lives in SMEM: scalar state across the sequential grid,
+        # SEEDED from the caller's scalar (the distributed scan's
+        # exclusive carry — folding it here saves the whole-array
+        # fixup pass)
         i = pl.program_id(0)
         slot = lax.rem(i, 2)
 
@@ -255,7 +259,7 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool,
 
         @pl.when(i == 0)
         def _():
-            carry[0, 0] = jnp.zeros((), jnp.float32)
+            carry[0, 0] = c0_ref[0, 0]
             in_dma(0, 0).start()
 
         @pl.when(i + 1 < nch)
@@ -291,7 +295,8 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool,
     return pl.pallas_call(
         kernel,
         grid=(nch,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
@@ -309,12 +314,16 @@ def _build(rows: int, R: int, dtype_name: str, interpret: bool,
     )
 
 
-def chunked_cumsum(x, *, interpret: bool = False):
+def chunked_cumsum(x, *, carry=None, interpret: bool = False):
     """Inclusive add-scan of a 1-D float array in ONE HBM pass.
+
+    ``carry`` (traced f32 scalar, default 0) seeds the running carry —
+    the distributed scan passes its exclusive cross-shard carry here so
+    no separate whole-array fixup pass ever touches HBM.
 
     Requires ``pick_chunk(len(x))`` to succeed (lane-blocked chunking);
     callers fall back to the XLA matmul-cumsum otherwise.
-    ``DR_TPU_SCAN_KERNEL=vpu`` selects the cumsum (vector-unit)
+    ``DR_TPU_SCAN_KERNEL=vpu`` selects the Hillis-Steele (vector-unit)
     variant of the in-chunk prefix; default is the MXU matmul form."""
     import os
     n = x.shape[0]
@@ -339,4 +348,6 @@ def chunked_cumsum(x, *, interpret: bool = False):
                         jnp.bfloat16 if passes else jnp.float32)
     Us = jnp.asarray(_strict_upper(LANES), jnp.float32)
     Lg = jnp.asarray(_strict_lower(G), jnp.float32)
-    return fn(U, Us, Lg, x.reshape(rows, LANES)).reshape(n)
+    c0 = jnp.zeros((1, 1), jnp.float32) if carry is None else \
+        jnp.asarray(carry, jnp.float32).reshape(1, 1)
+    return fn(c0, U, Us, Lg, x.reshape(rows, LANES)).reshape(n)

@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import runtime as _rt
 from ..core.pinning import pinned_id
+from ..utils.spmd_guard import TappedCache
 
 __all__ = ["communicator", "rma_window", "default_comm", "init_distributed"]
 
@@ -144,7 +145,7 @@ class communicator:
         return prog(arr)
 
 
-_shift_cache: dict = {}
+_shift_cache: dict = TappedCache()
 
 
 def default_comm() -> communicator:

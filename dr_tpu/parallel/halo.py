@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from ..core.pinning import pinned_id
+from ..utils.spmd_guard import TappedCache
 
 __all__ = ["halo_bounds", "span_halo", "halo_ops"]
 
@@ -205,7 +206,7 @@ def _reduce_program(mesh, axis, nshards, seg, prev, nxt, periodic, op, n):
     return jax.jit(shmapped, donate_argnums=0)
 
 
-_program_cache: dict = {}
+_program_cache: dict = TappedCache()
 
 
 def _cached(kind, mesh, axis, nshards, seg, prev, nxt, periodic, n, op=None,

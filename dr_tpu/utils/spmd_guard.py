@@ -15,9 +15,10 @@ Usage::
         ... run the SPMD section on every process ...
         g.verify()          # collective: raises on divergence
 
-While active, every algorithm-layer program dispatch (all of them pass
-through the shared program cache) records a canonicalized form of its
-cache key.  ``verify()`` allgathers a digest across processes; on
+While active, every program dispatch in the package — the algorithm
+layer's shared cache AND the per-module caches (halo, collectives,
+matrices, mdarray, ring attention) are all :class:`TappedCache`\\ s —
+records a canonicalized form of its cache key.  ``verify()`` allgathers a digest across processes; on
 mismatch it allgathers the full traces and reports the first divergent
 dispatch index with both sides' entries — the information a deadlock
 postmortem cannot give you.
@@ -34,9 +35,9 @@ import hashlib
 from contextlib import contextmanager
 from typing import List, Optional
 
-from ..core.pinning import PinnedId
+from ..core.pinning import PinnedId, _pins
 
-__all__ = ["guard", "active", "DivergenceError"]
+__all__ = ["guard", "active", "DivergenceError", "TappedCache"]
 
 
 class DivergenceError(RuntimeError):
@@ -47,10 +48,33 @@ def _canon(x) -> str:
     if isinstance(x, tuple):
         return "(" + ",".join(_canon(e) for e in x) + ")"
     if isinstance(x, PinnedId):
+        # resolve the pinned object: a user op's qualname is process-
+        # portable and keeps "same geometry, different op" divergences
+        # visible; non-callable identities (meshes) canonicalize away
+        obj = _pins.get(int(x))
+        if callable(obj):
+            return getattr(obj, "__qualname__", "fn")
         return "ptr"
     if callable(x):
-        return getattr(x, "__name__", "fn")
+        return getattr(x, "__qualname__",
+                       getattr(x, "__name__", "fn"))
     return repr(x)
+
+
+class TappedCache(dict):
+    """Program-cache dict whose lookups double as the guard's dispatch
+    tap: every algorithm dispatch does a ``get``/``setdefault`` on its
+    module's cache FIRST (hit or miss), so converting a module cache to
+    a TappedCache puts its dispatches on the verified trace.  No-op
+    overhead when no guard is active."""
+
+    def get(self, key, default=None):
+        record(key)
+        return super().get(key, default)
+
+    def setdefault(self, key, default=None):
+        record(key)
+        return super().setdefault(key, default)
 
 
 class SpmdGuard:

@@ -324,3 +324,29 @@ def test_nested_bound_ops_in_reduce_pipeline():
     assert len(_prog_cache) == n_progs  # all five scalars traced
     ref2 = float((0.25 * (xs.astype(np.float64) - 2.0) * (ys + 3.0)).sum())
     assert got2 == pytest.approx(ref2, rel=1e-3)
+
+
+def test_dot_n_kernel_path_interpret(monkeypatch):
+    """dot_n's opt-in Pallas path (DR_TPU_DOT_IMPL=pallas): per-shard
+    streamed kernel + psum on the multi-device mesh, interpret mode."""
+    import functools
+    import importlib
+    reduce_mod = importlib.import_module("dr_tpu.algorithms.reduce")
+    from dr_tpu.ops import reduce_pallas
+
+    monkeypatch.setenv("DR_TPU_DOT_IMPL", "pallas")
+    monkeypatch.setattr(reduce_mod, "_dot_kernel_platform_ok",
+                        lambda rt: True)
+    monkeypatch.setattr(
+        reduce_pallas, "chunked_dot",
+        functools.partial(reduce_pallas.chunked_dot, interpret=True))
+    P = dr_tpu.nprocs()
+    n = 128 * 128 * P  # exact uniform lane-chunkable layout
+    rng = np.random.default_rng(13)
+    xs = rng.standard_normal(n).astype(np.float32)
+    ys = rng.standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(xs)
+    b = dr_tpu.distributed_vector.from_array(ys)
+    got = float(dr_tpu.dot_n(a, b, 3))
+    ref = float(xs.astype(np.float64) @ ys.astype(np.float64))
+    assert abs(got - ref) < 1e-4 * abs(ref) + 1e-2

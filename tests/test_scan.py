@@ -236,3 +236,23 @@ def test_chunked_cumsum_pipe_and_passes_variants(monkeypatch):
             err = np.abs(got - ref).max() / scale
             tol = 3e-5 if passes == "2" else 3e-6
             assert err < tol, (pipe, passes, err)
+
+
+def test_chunked_dot_kernel_interpret(monkeypatch):
+    """Streamed dot kernel (interpret mode) vs numpy, incl. the in-
+    kernel salt the dot_n measurement loop uses."""
+    import jax.numpy as jnp
+    from dr_tpu.ops import reduce_pallas
+    rng = np.random.default_rng(11)
+    monkeypatch.setenv("DR_TPU_SCAN_CHUNK", "512")
+    n = 128 * 1024
+    x = rng.standard_normal(n).astype(np.float32)
+    y = rng.standard_normal(n).astype(np.float32)
+    got = float(reduce_pallas.chunked_dot(jnp.asarray(x), jnp.asarray(y),
+                                          interpret=True))
+    ref = float(x.astype(np.float64) @ y.astype(np.float64))
+    assert abs(got - ref) < 1e-4 * abs(ref) + 1e-3
+    got_s = float(reduce_pallas.chunked_dot(
+        jnp.asarray(x), jnp.asarray(y), salt=0.25, interpret=True))
+    ref_s = float(x.astype(np.float64) @ (y.astype(np.float64) + 0.25))
+    assert abs(got_s - ref_s) < 1e-4 * abs(ref_s) + 1e-3

@@ -75,3 +75,56 @@ def test_guard_nesting_restores():
             assert spmd_guard.active() is inner
         assert spmd_guard.active() is outer
     assert spmd_guard.active() is None
+
+
+def test_all_module_caches_are_tapped():
+    """Halo, collectives, matrix, mdarray and attention dispatches must
+    land on the trace too — the collective-heaviest paths are exactly
+    where divergence deadlocks live."""
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    dv = dr_tpu.distributed_vector.from_array(
+        np.arange(32, dtype=np.float32), halo=hb)
+    with spmd_guard.guard() as g:
+        dr_tpu.halo(dv).exchange()
+        n0 = len(g.trace)
+        assert n0 >= 1, "halo exchange not recorded"
+        comm = dr_tpu.default_comm()
+        comm.shift_forward(dv._data, periodic=True)
+        assert len(g.trace) > n0, "communicator shift not recorded"
+        n1 = len(g.trace)
+        M = dr_tpu.distributed_mdarray.from_array(
+            np.zeros((8, 8), np.float32))
+        T = dr_tpu.distributed_mdarray((8, 8))
+        dr_tpu.transpose(T, M)
+        assert len(g.trace) > n1, "mdarray transpose not recorded"
+        n2 = len(g.trace)
+        A = dr_tpu.dense_matrix.from_array(np.ones((8, 8), np.float32))
+        dr_tpu.gemm(A, A)
+        assert len(g.trace) > n2, "dense matrix dispatch not recorded"
+        n3 = len(g.trace)
+        S = 4 * dr_tpu.nprocs()
+        q = np.zeros((1, S, 1, 8), np.float32)
+        dr_tpu.ring_attention(q, q, q, causal=True)
+        assert len(g.trace) > n3, "ring attention not recorded"
+
+
+def test_op_identity_survives_canonicalization():
+    """Same geometry + DIFFERENT user op must diverge: pinned callables
+    canonicalize to their qualname, not to the 'ptr' placeholder."""
+
+    def op_a(x):
+        return x * 2
+
+    def op_b(x):
+        return x * 3
+
+    src = np.ones(64, np.float32)
+    out = dr_tpu.distributed_vector(64)
+    with spmd_guard.guard() as ga:
+        dr_tpu.transform(dr_tpu.distributed_vector.from_array(src), out,
+                         op_a)
+    with spmd_guard.guard() as gb:
+        dr_tpu.transform(dr_tpu.distributed_vector.from_array(src), out,
+                         op_b)
+    assert ga.digest() != gb.digest()
+    assert any("op_a" in t for t in ga.trace)

@@ -153,10 +153,22 @@ def tune_container(name):
         b = dr_tpu.distributed_vector(n, np.float32)
         dr_tpu.fill(a, 1.5)
         dr_tpu.fill(b, 2.0)
-        for r2 in (36, 150, 600):
-            dt = _marginal(lambda r: float(dr_tpu.dot_n(a, b, r)), 4, r2)
-            print(f"dot r2={r2}: {2.0 * n * 4 / dt / 1e9:.1f} GB/s",
-                  flush=True)
+        for impl in ("xla", "pallas"):
+            if impl == "pallas":
+                os.environ["DR_TPU_DOT_IMPL"] = "pallas"
+            else:
+                os.environ.pop("DR_TPU_DOT_IMPL", None)
+            for r2 in (36, 150, 600):
+                try:
+                    dt = _marginal(
+                        lambda r: float(dr_tpu.dot_n(a, b, r)), 4, r2)
+                    print(f"dot [{impl}] r2={r2}: "
+                          f"{2.0 * n * 4 / dt / 1e9:.1f} GB/s",
+                          flush=True)
+                except Exception as e:
+                    print(f"dot [{impl}] r2={r2}: FAIL "
+                          f"{_errline(e)}", flush=True)
+        os.environ.pop("DR_TPU_DOT_IMPL", None)
     elif name == "heat":
         m = 8192
         w = dr_tpu.heat_step_weights(0.25)
