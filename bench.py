@@ -318,9 +318,12 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         b = dr_tpu.distributed_vector(n, np.float32)
         dr_tpu.fill(a, 1.5)
         dr_tpu.fill(b, 2.0)
-        from dr_tpu.algorithms.reduce import dot_n
+        from dr_tpu.algorithms.reduce import dot_kernel_eligible, dot_n
         dt = _marginal(lambda r: float(dot_n(a, b, r)))
         out["dot_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
+        # the FULL gate, not just the env ask: report what actually ran
+        out["dot_impl"] = ("pallas" if dot_kernel_eligible(a, b)
+                           else "xla")
     except Exception as e:  # pragma: no cover - defensive
         out["dot_error"] = repr(e)[:160]
     finally:
@@ -341,6 +344,10 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         dt = _marginal_with_fallback(run_scan, on_tpu, "DR_TPU_SCAN_IMPL",
                                      "scan_kernel_error", out)
         out["scan_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
+        from dr_tpu.algorithms.scan import _kernel_variant
+        kern, pipe, cap, passes = _kernel_variant()
+        out["scan_cfg"] = (f"{kern or 'mxu'}/{pipe or 'manual'}"
+                           f"/R{cap}/p{passes}")
     except Exception as e:  # pragma: no cover - defensive
         out["scan_error"] = repr(e)[:160]
     finally:

@@ -10,7 +10,24 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["layout_geometry", "owned_window_mask", "uniform_layout",
-           "double_buffered_loop", "combine_for", "MONOID_COMBINE"]
+           "double_buffered_loop", "combine_for", "MONOID_COMBINE",
+           "f32_accumulable", "on_tpu"]
+
+
+def f32_accumulable(dtype) -> bool:
+    """True for input dtypes the Pallas kernels may accumulate in f32
+    without changing semantics (integer exactness and f64 precision
+    must keep the XLA paths).  Shared gate for the scan and dot kernel
+    families."""
+    return jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16),
+                                jnp.dtype(jnp.float16))
+
+
+def on_tpu(runtime) -> bool:
+    """Mosaic compiles for TPU only (interpret-mode tests monkeypatch
+    around this at the call sites)."""
+    return runtime.devices[0].platform == "tpu"
 
 
 def double_buffered_loop(step, steps, x, y):

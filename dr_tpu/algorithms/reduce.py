@@ -28,7 +28,8 @@ from .elementwise import (_apply_chain_ops, _chain_scalars, _op_key,
 from ..views import views as _v
 
 __all__ = ["reduce", "transform_reduce", "dot",
-           "reduce_async", "transform_reduce_async", "dot_async", "dot_n"]
+           "reduce_async", "transform_reduce_async", "dot_async", "dot_n",
+           "dot_kernel_eligible"]
 
 
 # known monoids: (jnp vector-reduce, identity)
@@ -235,7 +236,38 @@ def _dot_kernel_platform_ok(rt) -> bool:
     """Mosaic compiles for TPU only; tests monkeypatch this together
     with an interpret-mode ``chunked_dot`` to cover the kernel path on
     the CPU mesh."""
-    return rt.devices[0].platform == "tpu"
+    from ._common import on_tpu
+    return on_tpu(rt)
+
+
+def _dot_n_chains(a, b):
+    chains = _resolve(_v.zip_view(a, b))
+    assert chains is not None and len(chains) == 2, \
+        "dot_n needs two aligned container chains"
+    c0, c1 = chains
+    assert c0.cont.layout == c1.cont.layout and c0.off == c1.off \
+        and c0.n == c1.n
+    assert not c0.ops and not c1.ops, "dot_n takes plain containers"
+    return c0, c1
+
+
+def dot_kernel_eligible(a, b) -> bool:
+    """Whether ``dot_n(a, b)`` would actually take the Pallas streamed
+    kernel with DR_TPU_DOT_IMPL=pallas set — the FULL gate, so callers
+    (bench.py's ``dot_impl`` tag) report what ran, not what was asked
+    for."""
+    from ..ops import reduce_pallas, scan_pallas
+    from ._common import f32_accumulable
+    c0, c1 = _dot_n_chains(a, b)
+    nshards, seg, prev, nxt, total_n = c0.cont.layout
+    return (reduce_pallas.supported()
+            and reduce_pallas.use_dot_kernel()
+            and _dot_kernel_platform_ok(c0.cont.runtime)
+            and f32_accumulable(c0.cont.dtype)
+            and c0.cont.dtype == c1.cont.dtype
+            and prev == 0 and nxt == 0 and c0.off == 0
+            and c0.n == total_n and nshards * seg == total_n
+            and scan_pallas.pick_chunk(seg) is not None)
 
 
 def dot_n(a, b, iters: int):
@@ -250,31 +282,14 @@ def dot_n(a, b, iters: int):
     both arrays, no intermediates).  The returned value differs from
     ``dot(a, b)`` by O(1e-38 * |dot| * sum(a)) — negligible.  Returns
     the final device scalar."""
-    chains = _resolve(_v.zip_view(a, b))
-    assert chains is not None and len(chains) == 2, \
-        "dot_n needs two aligned container chains"
-    c0, c1 = chains
-    assert c0.cont.layout == c1.cont.layout and c0.off == c1.off \
-        and c0.n == c1.n
-    assert not c0.ops and not c1.ops, "dot_n takes plain containers"
+    c0, c1 = _dot_n_chains(a, b)
     layout, off, n = c0.cont.layout, c0.off, c0.n
     nshards, seg, prev, nxt, total_n = layout
     # opt-in Pallas chunked-dot path (DR_TPU_DOT_IMPL=pallas): per-shard
     # streamed multiply+reduce + psum, salt folded inside the kernel
     from ..ops import reduce_pallas, scan_pallas
     rt = c0.cont.runtime
-    use_kern = (reduce_pallas.supported() and reduce_pallas.use_dot_kernel()
-                and _dot_kernel_platform_ok(rt)
-                # f32-accumulable input dtypes only (the kernel casts
-                # chunks to f32 and returns f32 — integer exactness and
-                # f64 must keep the XLA path, like _use_scan_kernel)
-                and jnp.dtype(c0.cont.dtype) in (
-                    jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
-                    jnp.dtype(jnp.float16))
-                and c0.cont.dtype == c1.cont.dtype
-                and prev == 0 and nxt == 0 and off == 0
-                and n == total_n and nshards * seg == total_n
-                and scan_pallas.pick_chunk(seg) is not None)
+    use_kern = dot_kernel_eligible(a, b)
     key = ("dot_n", c0.key, c1.key, int(iters), use_kern,
            scan_pallas.chunk_cap() if use_kern else None)
     prog = _prog_cache.get(key)

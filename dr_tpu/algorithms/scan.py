@@ -99,14 +99,13 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
     if os.environ.get("DR_TPU_SCAN_IMPL", "").strip().lower() == "xla":
         return False
     from ..ops import scan_pallas
+    from ._common import f32_accumulable, on_tpu
     nshards, seg, prev, nxt, n = layout
-    if jnp.dtype(in_dtype) not in (jnp.dtype(jnp.float32),
-                                   jnp.dtype(jnp.bfloat16),
-                                   jnp.dtype(jnp.float16)):
+    if not f32_accumulable(in_dtype):
         return False
     return (kind == "add"
             and scan_pallas.supported()
-            and runtime.devices[0].platform == "tpu"
+            and on_tpu(runtime)
             and scan_pallas.pick_chunk(seg) is not None)
 
 
@@ -153,7 +152,11 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             if nshards == 1:
                 scanned = scan_pallas.chunked_cumsum(x)
             else:
-                totals = lax.all_gather(jnp.sum(x), axis)  # (nshards,)
+                # f32 totals regardless of input dtype: the kernel's
+                # carry seed is f32, and a bf16-rounded cross-shard
+                # carry would poison every later shard's prefixes
+                totals = lax.all_gather(
+                    jnp.sum(x, dtype=jnp.float32), axis)  # (nshards,)
                 masked = jnp.where(jnp.arange(nshards) < r, totals,
                                    jnp.zeros((), totals.dtype))
                 carry = jnp.sum(masked)

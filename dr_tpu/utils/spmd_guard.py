@@ -44,20 +44,34 @@ class DivergenceError(RuntimeError):
     pass
 
 
+def _canon_callable(obj) -> str:
+    """Process-portable identity of a callable: qualname plus a hash of
+    its compiled code — two different lambdas share the qualname
+    '<lambda>' but not their bytecode/constants, so a rank-dependent op
+    choice still diverges the trace."""
+    name = getattr(obj, "__qualname__", getattr(obj, "__name__", "fn"))
+    code = getattr(obj, "__code__", None)
+    if code is None:
+        return name
+    h = hashlib.sha1(code.co_code)
+    h.update(repr(code.co_consts).encode())
+    return f"{name}#{h.hexdigest()[:8]}"
+
+
 def _canon(x) -> str:
     if isinstance(x, tuple):
         return "(" + ",".join(_canon(e) for e in x) + ")"
     if isinstance(x, PinnedId):
-        # resolve the pinned object: a user op's qualname is process-
-        # portable and keeps "same geometry, different op" divergences
-        # visible; non-callable identities (meshes) canonicalize away
+        # resolve the pinned object: a user op's code identity is
+        # process-portable and keeps "same geometry, different op"
+        # divergences visible; non-callable identities (meshes)
+        # canonicalize away
         obj = _pins.get(int(x))
         if callable(obj):
-            return getattr(obj, "__qualname__", "fn")
+            return _canon_callable(obj)
         return "ptr"
     if callable(x):
-        return getattr(x, "__qualname__",
-                       getattr(x, "__name__", "fn"))
+        return _canon_callable(x)
     return repr(x)
 
 

@@ -23,12 +23,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
+@pytest.mark.parametrize("nproc", [2, 3, 4])
 def test_multi_process_spmd(nproc):
-    """2- and 4-process SPMD (the reference's 1-4-rank mpiexec sweep,
-    test/gtest/mhp/CMakeLists.txt:27-33).  At 4 processes factor(4) is
-    a (2, 2) grid, so the 2-D sparse-gemv branch in the worker runs
-    across a process boundary."""
+    """2-, 3- and 4-process SPMD (the reference's 1-4-rank mpiexec
+    sweep, test/gtest/mhp/CMakeLists.txt:27-33; 1 rank = the regular
+    suite).  3 processes exercises uneven tails everywhere; at 4,
+    factor(4) is a (2, 2) grid, so the 2-D sparse-gemv branch in the
+    worker runs across a process boundary."""
     port = _free_port()
     env = dict(os.environ)
     env["XLA_FLAGS"] = ""  # one local device per process

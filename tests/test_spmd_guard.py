@@ -128,3 +128,17 @@ def test_op_identity_survives_canonicalization():
                          op_b)
     assert ga.digest() != gb.digest()
     assert any("op_a" in t for t in ga.trace)
+
+
+def test_lambda_code_identity_diverges():
+    """Two different lambdas share __qualname__ '<lambda>'; the code
+    hash keeps a rank-dependent op choice visible in the trace."""
+    from dr_tpu.core.pinning import pinned_id
+    f = lambda x: x * 2  # noqa: E731
+    g = lambda x: x * 3  # noqa: E731
+    cf = spmd_guard._canon(("t", pinned_id(f)))
+    cg = spmd_guard._canon(("t", pinned_id(g)))
+    assert cf != cg
+    # while EQUAL source in the same position canonicalizes stably
+    h1 = lambda x: x * 2  # noqa: E731
+    assert spmd_guard._canon(("t", pinned_id(h1))) == cf

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-3 on-chip session: TUNE_PLAN.md steps in priority order.
+# One TPU process at a time; 5-minute gaps between claims (the round-3
+# second outage followed a 90 s gap — docs/ROUND3_NOTES.md).
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[chip_session $(date +%H:%M:%S)] $*"; }
+
+log "1/7 bench.py (the BENCH_r03 artifact rehearsal)"
+python -u bench.py > tools/bench_r3_dev.json 2> tools/bench_r3_dev.err
+log "bench exit=$? $(tail -c 300 tools/bench_r3_dev.json)"
+sleep 300
+
+log "2/7 attn sweep (ends with the streaming-kernel hardware compile)"
+python -u tools/tune_tpu.py attn > tools/tune_attn.log 2>&1
+log "attn exit=$?"
+sleep 300
+
+log "3/7 spmv (BCSR GFLOP/s)"
+python -u tools/tune_tpu.py spmv > tools/tune_spmv.log 2>&1
+log "spmv exit=$?"
+sleep 300
+
+log "4/7 dot (XLA vs pallas kernel)"
+python -u tools/tune_tpu.py dot > tools/tune_dot.log 2>&1
+log "dot exit=$?"
+sleep 300
+
+log "5/7 heat (time blocks)"
+python -u tools/tune_tpu.py heat > tools/tune_heat.log 2>&1
+log "heat exit=$?"
+sleep 300
+
+log "6/7 scan (grid-vs-manual A/B + carry-seeded path)"
+python -u tools/tune_tpu.py scan > tools/tune_scan5.log 2>&1
+log "scan exit=$?"
+sleep 300
+
+log "7/7 stencil at DEFAULT precision (phys bar)"
+DR_TPU_MM_PRECISION=default python -u tools/tune_tpu.py stencil \
+  > tools/tune_stencil_default.log 2>&1
+log "stencil-default exit=$?"
+log "session complete"
