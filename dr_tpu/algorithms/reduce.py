@@ -251,14 +251,9 @@ def _dot_n_chains(a, b):
     return c0, c1
 
 
-def dot_kernel_eligible(a, b) -> bool:
-    """Whether ``dot_n(a, b)`` would actually take the Pallas streamed
-    kernel with DR_TPU_DOT_IMPL=pallas set — the FULL gate, so callers
-    (bench.py's ``dot_impl`` tag) report what ran, not what was asked
-    for."""
+def _dot_kernel_eligible_chains(c0, c1) -> bool:
     from ..ops import reduce_pallas, scan_pallas
     from ._common import f32_accumulable
-    c0, c1 = _dot_n_chains(a, b)
     nshards, seg, prev, nxt, total_n = c0.cont.layout
     return (reduce_pallas.supported()
             and reduce_pallas.use_dot_kernel()
@@ -268,6 +263,14 @@ def dot_kernel_eligible(a, b) -> bool:
             and prev == 0 and nxt == 0 and c0.off == 0
             and c0.n == total_n and nshards * seg == total_n
             and scan_pallas.pick_chunk(seg) is not None)
+
+
+def dot_kernel_eligible(a, b) -> bool:
+    """Whether ``dot_n(a, b)`` would actually take the Pallas streamed
+    kernel with DR_TPU_DOT_IMPL=pallas set — the FULL gate, so callers
+    (bench.py's ``dot_impl`` tag) report what ran, not what was asked
+    for."""
+    return _dot_kernel_eligible_chains(*_dot_n_chains(a, b))
 
 
 def dot_n(a, b, iters: int):
@@ -289,7 +292,7 @@ def dot_n(a, b, iters: int):
     # streamed multiply+reduce + psum, salt folded inside the kernel
     from ..ops import reduce_pallas, scan_pallas
     rt = c0.cont.runtime
-    use_kern = dot_kernel_eligible(a, b)
+    use_kern = _dot_kernel_eligible_chains(c0, c1)
     key = ("dot_n", c0.key, c1.key, int(iters), use_kern,
            scan_pallas.chunk_cap() if use_kern else None)
     prog = _prog_cache.get(key)

@@ -302,15 +302,30 @@ class distributed_mdspan:
         return f"distributed_mdspan(box={self.box})"
 
 
-def transpose(out: distributed_mdarray, inp: distributed_mdarray) -> None:
-    """out = inp.T — the reference's planned-but-unbuilt transpose example
-    (examples/mhp/transpose-cpu.cpp:27-54).  Under jit the sharded
-    transpose lowers to an XLA all-to-all over the mesh."""
-    assert len(inp.shape) == 2 and out.shape == inp.shape[::-1]
-    key = ("mdT", pinned_id(inp._mesh), inp.shape, str(inp.dtype))
+def transpose(out: distributed_mdarray, inp: distributed_mdarray,
+              axes=None) -> None:
+    """out = inp permuted by ``axes`` (default: reversed — ``inp.T``) —
+    the reference's planned-but-unbuilt transpose example generalized
+    to N-D (examples/mhp/transpose-cpu.cpp:27-54 is the 2-D case).
+    Under jit the sharded permutation lowers to an XLA all-to-all over
+    the mesh."""
+    nd = len(inp.shape)
+    if axes is None:
+        axes = tuple(range(nd - 1, -1, -1))
+    else:
+        # normalize negatives only; out-of-range axes are an error like
+        # numpy's AxisError, not a silent wrap into another permutation
+        assert all(-nd <= int(a) < nd for a in axes), \
+            f"axes out of range for a {nd}-D array: {tuple(axes)}"
+        axes = tuple(int(a) % nd for a in axes)
+    assert sorted(axes) == list(range(nd)), \
+        f"axes must permute all {nd} dimensions"
+    assert out.shape == tuple(inp.shape[a] for a in axes), \
+        "output shape must be the permuted input shape"
+    key = ("mdT", pinned_id(inp._mesh), inp.shape, axes, str(inp.dtype))
     fn = _md_cache.get(key)
     if fn is None:
-        fn = jax.jit(lambda x: x.T)
+        fn = jax.jit(lambda x: jnp.transpose(x, axes))
         _md_cache[key] = fn
     out.assign_array(fn(inp.to_array()))
 

@@ -253,17 +253,20 @@ class span_halo:
         # stencil points (same "unspecified edge ghosts" contract as the
         # reference's first/last rank).
         tail = len(dv) - (dv.nshards - 1) * dv.segment_size
-        if hb.width and dv.nshards > 1:
-            if tail < 1:
-                raise ValueError(
-                    "halo requires every shard to own at least one "
-                    f"element (n={len(dv)}, shards={dv.nshards}, "
-                    f"segment={dv.segment_size})")
-            if hb.periodic and tail < max(hb.prev, hb.next):
-                raise ValueError(
-                    f"periodic halo: last shard owns {tail} element(s), "
-                    f"smaller than the radius {max(hb.prev, hb.next)}; "
-                    "grow the vector or shrink the mesh")
+        if hb.width and dv.nshards > 1 and tail < 1:
+            raise ValueError(
+                "halo requires every shard to own at least one "
+                f"element (n={len(dv)}, shards={dv.nshards}, "
+                f"segment={dv.segment_size})")
+        if hb.width and hb.periodic and tail < max(hb.prev, hb.next):
+            # applies at EVERY shard count: at nshards == 1 the "tail"
+            # is the whole logical vector, and a ring radius wider than
+            # it would need ghosts wrapping around more than once
+            # (round-3 fuzz catch) — reject like halo.hpp:354-356
+            raise ValueError(
+                f"periodic halo: last shard owns {tail} element(s), "
+                f"smaller than the radius {max(hb.prev, hb.next)}; "
+                "grow the vector or shrink the mesh")
 
     @property
     def bounds(self) -> halo_bounds:

@@ -44,6 +44,20 @@ class DivergenceError(RuntimeError):
     pass
 
 
+def _hash_code(h, code) -> None:
+    """Feed a code object into ``h`` process-portably: bytecode plus
+    constants, RECURSING into nested code objects (their repr embeds a
+    process-local 0x address — hashing it would make identical nested
+    lambdas diverge across processes, a false positive)."""
+    h.update(code.co_code)
+    for c in code.co_consts:
+        if hasattr(c, "co_code"):
+            _hash_code(h, c)
+        else:
+            h.update(repr(c).encode())
+        h.update(b"\0")
+
+
 def _canon_callable(obj) -> str:
     """Process-portable identity of a callable: qualname plus a hash of
     its compiled code — two different lambdas share the qualname
@@ -53,8 +67,8 @@ def _canon_callable(obj) -> str:
     code = getattr(obj, "__code__", None)
     if code is None:
         return name
-    h = hashlib.sha1(code.co_code)
-    h.update(repr(code.co_consts).encode())
+    h = hashlib.sha1()
+    _hash_code(h, code)
     return f"{name}#{h.hexdigest()[:8]}"
 
 

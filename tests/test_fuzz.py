@@ -399,13 +399,18 @@ def test_fuzz_halo_exchange_reduce(seed):
                 np.testing.assert_allclose(rows[r, :prev], want,
                                            err_msg=f"ghost_prev r={r}")
             if nxt and (r < dv.nshards - 1 or periodic):
-                want = src[(np.arange(hi, hi + nxt)) % n]
+                # wrap only under periodic; without it, ghost cells
+                # past the logical end are unspecified (the documented
+                # short-tail contract) and must not be asserted
+                idx = np.arange(hi, hi + nxt)
+                k = nxt if periodic else int((idx < n).sum())
+                want = src[idx[:k] % n]
                 # a short tail places its incoming ghost right after the
                 # owned cells (stencils read x[i+1] at prev+tail), not
                 # at the padded prev+seg slot
                 tail = hi - lo
                 np.testing.assert_allclose(
-                    rows[r, prev + tail:prev + tail + nxt], want,
+                    rows[r, prev + tail:prev + tail + k], want,
                     err_msg=f"ghost_next r={r}")
         # reduce oracle: every live ghost adds into the cell it mirrors
         dr_tpu.halo(dv).reduce_plus()
@@ -418,7 +423,8 @@ def test_fuzz_halo_exchange_reduce(seed):
                     ref[g % n] += src[g % n]
             if nxt and (r < dv.nshards - 1 or periodic):
                 for g in range(hi, hi + nxt):
-                    ref[g % n] += src[g % n]
+                    if periodic or g < n:
+                        ref[g % n] += src[g % n]
         np.testing.assert_allclose(dr_tpu.to_numpy(dv), ref, rtol=1e-5,
                                    atol=1e-5)
 

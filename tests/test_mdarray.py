@@ -78,3 +78,28 @@ def test_transpose():
     b = distributed_mdarray((12, 8), np.float32)
     transpose(b, a)
     np.testing.assert_array_equal(b.materialize(), src.T)
+
+
+def test_transpose_nd_axes():
+    """N-D axis permutations (the 2-D .T is the axes=None case)."""
+    rng = np.random.default_rng(20)
+    src = rng.standard_normal((6, 10, 4)).astype(np.float32)
+    M = dr_tpu.distributed_mdarray.from_array(src)
+    # default: full reversal
+    T = dr_tpu.distributed_mdarray((4, 10, 6))
+    dr_tpu.transpose(T, M)
+    np.testing.assert_array_equal(T.materialize(), src.transpose())
+    # explicit permutation (cycle)
+    P = dr_tpu.distributed_mdarray((10, 4, 6))
+    dr_tpu.transpose(P, M, axes=(1, 2, 0))
+    np.testing.assert_array_equal(P.materialize(),
+                                  src.transpose(1, 2, 0))
+    # negative axes normalize
+    Q = dr_tpu.distributed_mdarray((10, 4, 6))
+    dr_tpu.transpose(Q, M, axes=(-2, -1, 0))
+    np.testing.assert_array_equal(Q.materialize(),
+                                  src.transpose(1, 2, 0))
+    # invalid permutation rejected
+    import pytest
+    with pytest.raises(AssertionError):
+        dr_tpu.transpose(P, M, axes=(0, 0, 1))
