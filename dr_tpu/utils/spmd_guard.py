@@ -45,11 +45,15 @@ class DivergenceError(RuntimeError):
 
 
 def _hash_code(h, code) -> None:
-    """Feed a code object into ``h`` process-portably: bytecode plus
-    constants, RECURSING into nested code objects (their repr embeds a
-    process-local 0x address — hashing it would make identical nested
-    lambdas diverge across processes, a false positive)."""
+    """Feed a code object into ``h`` process-portably: bytecode,
+    referenced NAMES (sin vs cos differ only here — bytecode alone
+    merges them), and constants, RECURSING into nested code objects
+    (their repr embeds a process-local 0x address — hashing it would
+    make identical nested lambdas diverge across processes, a false
+    positive)."""
     h.update(code.co_code)
+    h.update(repr(code.co_names).encode())
+    h.update(repr((code.co_varnames, code.co_argcount)).encode())
     for c in code.co_consts:
         if hasattr(c, "co_code"):
             _hash_code(h, c)

@@ -195,6 +195,20 @@ int main(int argc, char** argv) {
     check_range("transpose", T.to_host(), want);
   }
 
+  // ---- checkpoint round-trip ------------------------------------------
+  {
+    thp::vector v = s.make_vector(777);
+    v.iota(3.0);
+    s.save("/tmp/thp_bridge_ckpt.npz", v);
+    thp::vector w = s.load_vector("/tmp/thp_bridge_ckpt.npz");
+    if (w.size() != 777) {
+      std::printf("checkpoint FAIL: size %zu\n", w.size());
+      ++failures;
+    } else {
+      check_range("checkpoint", w.to_host(), v.to_host());
+    }
+  }
+
   if (failures) {
     std::printf("bridge demo: %d FAILURES\n", failures);
     return 1;

@@ -420,6 +420,42 @@ void session::transpose(mdarray& out, const mdarray& in) {
   Py_DECREF(r);
 }
 
+void session::save(const std::string& path, const vector& v) {
+  PyObject* ckpt = must(PyObject_GetAttrString(impl_->dr, "checkpoint"),
+                        "checkpoint module");
+  PyObject* r = must(
+      PyObject_CallMethod(ckpt, "save", "sO", path.c_str(),
+                          (PyObject*)v.obj_),
+      "checkpoint.save");
+  Py_DECREF(r);
+  Py_DECREF(ckpt);
+}
+
+vector session::load_vector(const std::string& path) {
+  PyObject* ckpt = must(PyObject_GetAttrString(impl_->dr, "checkpoint"),
+                        "checkpoint module");
+  PyObject* obj = must(
+      PyObject_CallMethod(ckpt, "load", "s", path.c_str()),
+      "checkpoint.load");
+  Py_DECREF(ckpt);
+  // a checkpoint can hold any container kind; wrapping a matrix as a
+  // vector would fail later with a confusing in-bridge error
+  PyObject* cls = must(
+      PyObject_GetAttrString(impl_->dr, "distributed_vector"),
+      "distributed_vector");
+  int is_vec = PyObject_IsInstance(obj, cls);
+  Py_DECREF(cls);
+  if (is_vec != 1) {
+    Py_DECREF(obj);
+    fail("load_vector: checkpoint does not hold a distributed_vector");
+  }
+  PyObject* len_obj = must(PyObject_CallMethod(obj, "__len__", nullptr),
+                           "len(vector)");
+  std::size_t n = PyLong_AsSize_t(len_obj);
+  Py_DECREF(len_obj);
+  return vector(this, obj, n);
+}
+
 void session::stencil_iterate(vector& a, vector& b,
                               const std::vector<double>& weights,
                               int steps) {

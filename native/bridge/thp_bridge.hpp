@@ -221,6 +221,11 @@ class session {
   void stencil_iterate(vector& a, vector& b,
                        const std::vector<double>& weights, int steps);
 
+  // checkpoint / restore (Python layer utils/checkpoint.py; the
+  // reference has no serialization at all — SURVEY §5)
+  void save(const std::string& path, const vector& v);
+  vector load_vector(const std::string& path);
+
   // escape hatch: run a statement in the embedded interpreter
   void exec(const std::string& code);
 
