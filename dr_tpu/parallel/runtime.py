@@ -113,10 +113,16 @@ class Runtime:
                 jax.block_until_ready(data)
 
     def barrier(self) -> None:
-        # Single-controller: program order is the barrier.  Multi-host JAX
-        # processes synchronize through the collectives themselves; an
-        # explicit barrier only needs to drain dispatched work.
+        # Single-controller: program order is the barrier and fence()
+        # drains dispatched work.  Multi-process: a REAL rendezvous
+        # (the reference's mhp::barrier is MPI_Barrier) — device
+        # collectives synchronize devices, not host-side progress, so
+        # host effects (checkpoint writes, logs) need this to order
+        # across processes (round-3 4-proc checkpoint race).
         self.fence()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("dr_tpu_barrier")
 
 
 _runtime: Optional[Runtime] = None

@@ -56,8 +56,26 @@ def save(path: str, container) -> None:
     else:
         raise TypeError(f"cannot checkpoint {type(container).__name__}")
 
+    err = None
     if jax.process_index() == 0:
-        np.savez(path, meta=json.dumps(meta), **arrays)
+        try:
+            np.savez(path, meta=json.dumps(meta), **arrays)
+        except Exception as e:  # must still reach the collective below
+            err = e
+    if jax.process_count() > 1:
+        # one collective does double duty: save() returns only once the
+        # file is durable from every process's point of view (a later
+        # load() must never race the write), AND rank 0's write status
+        # propagates so a failed write raises on EVERY rank instead of
+        # hanging the others in a rendezvous rank 0 never reached
+        from jax.experimental import multihost_utils
+        flags = np.asarray(multihost_utils.process_allgather(
+            np.asarray([err is None], np.int32))).reshape(-1)
+        if err is None and not flags[0]:
+            raise RuntimeError(
+                "checkpoint save failed on process 0; see its log")
+    if err is not None:
+        raise err
 
 
 def load(path: str, *, runtime=None):

@@ -7,6 +7,7 @@
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
+#include <unistd.h>
 #include <vector>
 
 #include "thp_bridge.hpp"
@@ -199,8 +200,12 @@ int main(int argc, char** argv) {
   {
     thp::vector v = s.make_vector(777);
     v.iota(3.0);
-    s.save("/tmp/thp_bridge_ckpt.npz", v);
-    thp::vector w = s.load_vector("/tmp/thp_bridge_ckpt.npz");
+    char ckpt[64];
+    std::snprintf(ckpt, sizeof ckpt, "/tmp/thp_bridge_ckpt_%ld.npz",
+                  (long)getpid());
+    s.save(ckpt, v);
+    thp::vector w = s.load_vector(ckpt);
+    std::remove(ckpt);
     if (w.size() != 777) {
       std::printf("checkpoint FAIL: size %zu\n", w.size());
       ++failures;

@@ -100,6 +100,16 @@ np.testing.assert_allclose(
     np.asarray(attn.addressable_shards[0].data),
     np.asarray(att1.addressable_shards[0].data), rtol=1e-5, atol=1e-6)
 
+# checkpoint across processes: save() is collective (materialization
+# gathers), only process 0 writes, load() re-shards on every process
+import tempfile  # noqa: E402
+ck = f"{tempfile.gettempdir()}/dr_tpu_mh_ckpt_{nproc}.npz"
+dr_tpu.checkpoint.save(ck, dv)
+# no explicit barrier: save()'s OWN contract is that the write has
+# landed on every process's view when it returns — this load tests it
+lv = dr_tpu.checkpoint.load(ck)
+np.testing.assert_allclose(dr_tpu.to_numpy(lv), np.arange(1, n + 1))
+
 # SPMD dispatch-order guard: both processes ran the same collective
 # sequence above — verify() must agree (and is itself collective)
 from dr_tpu.utils import spmd_guard  # noqa: E402
