@@ -103,12 +103,18 @@ np.testing.assert_allclose(
 # checkpoint across processes: save() is collective (materialization
 # gathers), only process 0 writes, load() re-shards on every process
 import tempfile  # noqa: E402
-ck = f"{tempfile.gettempdir()}/dr_tpu_mh_ckpt_{nproc}.npz"
+# the rendezvous port is unique per run and SHARED by all ranks (a
+# pid would differ per rank) — concurrent suites can't race the file
+ck = f"{tempfile.gettempdir()}/dr_tpu_mh_ckpt_{port}_{nproc}.npz"
 dr_tpu.checkpoint.save(ck, dv)
 # no explicit barrier: save()'s OWN contract is that the write has
 # landed on every process's view when it returns — this load tests it
 lv = dr_tpu.checkpoint.load(ck)
 np.testing.assert_allclose(dr_tpu.to_numpy(lv), np.arange(1, n + 1))
+dr_tpu.barrier()  # all loads done before rank 0 removes the file
+if pid == 0:
+    import os as _os
+    _os.remove(ck)
 
 # SPMD dispatch-order guard: both processes ran the same collective
 # sequence above — verify() must agree (and is itself collective)

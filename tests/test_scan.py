@@ -256,3 +256,19 @@ def test_chunked_dot_kernel_interpret(monkeypatch):
         jnp.asarray(x), jnp.asarray(y), salt=0.25, interpret=True))
     ref_s = float(x.astype(np.float64) @ (y.astype(np.float64) + 0.25))
     assert abs(got_s - ref_s) < 1e-4 * abs(ref_s) + 1e-3
+
+
+def test_chunked_dot_bf16_interpret(monkeypatch):
+    import jax.numpy as jnp
+    from dr_tpu.ops import reduce_pallas
+    rng = np.random.default_rng(14)
+    monkeypatch.setenv("DR_TPU_SCAN_CHUNK", "256")
+    n = 128 * 512
+    x = rng.standard_normal(n).astype(np.float32)
+    y = rng.standard_normal(n).astype(np.float32)
+    got = float(reduce_pallas.chunked_dot(
+        jnp.asarray(x, jnp.bfloat16), jnp.asarray(y, jnp.bfloat16),
+        interpret=True))
+    ref = float(x.astype(np.float64) @ y.astype(np.float64))
+    # bf16 inputs round each operand; f32 accumulation keeps the rest
+    assert abs(got - ref) < 2e-2 * (abs(ref) + 1)
