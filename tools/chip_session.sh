@@ -36,8 +36,13 @@ python -u tools/tune_tpu.py scan > tools/tune_scan5.log 2>&1
 log "scan exit=$?"
 sleep 300
 
-log "7/7 stencil at DEFAULT precision (phys bar)"
+log "7/8 stencil at DEFAULT precision (phys bar)"
 DR_TPU_MM_PRECISION=default python -u tools/tune_tpu.py stencil \
   > tools/tune_stencil_default.log 2>&1
 log "stencil-default exit=$?"
+sleep 300
+
+log "8/8 physbw (VPU blocked kernel at small T)"
+python -u tools/tune_tpu.py physbw > tools/tune_physbw.log 2>&1
+log "physbw exit=$?"
 log "session complete"

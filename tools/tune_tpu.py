@@ -80,6 +80,46 @@ def tune_stencil():
     os.environ.pop("DR_TPU_MM_CHUNK_CAP", None)
 
 
+def tune_physbw():
+    """PHYSICAL-bandwidth sweep of the VPU blocked kernel at small T:
+    at T=1 the ~20 vector-ops/element-step sit well under the 2-pass
+    DMA floor, so the per-pass rate should approach HBM peak — the
+    datapoint for TUNE_PLAN's phys bar (the MXU composed apply is
+    MXU-bound near 180 GB/s; heat2d proves 91% of peak is reachable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dr_tpu.ops import stencil_pallas as sp
+
+    n = 2 ** 29
+    w = (0.05, 0.25, 0.4, 0.25, 0.05)  # radius 2
+    halo = 1024  # whole (8, 128) f32 tiles (kernel row alignment)
+    row = jnp.zeros((1, 2 * halo + n), jnp.float32) + 0.5
+    GB = n * 4 * 2 / 1e9
+    for T in (1, 2, 4, 8):
+        try:
+            @jax.jit
+            def run(row, r, salt):
+                row = row.at[0, 0].add(salt * 1e-9)
+
+                def body(i, acc):
+                    return sp.blocked_stencil_row(acc, n, halo, w, T)
+                out = jax.lax.fori_loop(0, r, body, row)
+                return out[0, n // 2]
+
+            s = [0]
+
+            def sync(r):
+                s[0] += 1
+                return float(run(row, r, s[0]))
+            dt = _marginal(sync)
+            print(f"physbw T={T}: {dt * 1e3:.2f} ms/pass "
+                  f"phys {GB / dt:.1f} GB/s "
+                  f"eff {GB * T / dt:.0f} GB/s", flush=True)
+        except Exception as e:
+            print(f"physbw T={T}: FAIL {_errline(e)}", flush=True)
+
+
 def tune_scan():
     import jax
     import jax.numpy as jnp
@@ -257,6 +297,8 @@ if __name__ == "__main__":
     what = sys.argv[1] if len(sys.argv) > 1 else "all"
     if what in ("stencil", "all"):
         tune_stencil()
+    if what in ("physbw", "all"):
+        tune_physbw()
     if what in ("scan", "all"):
         tune_scan()
     for nm in ("dot", "heat", "attn", "spmv"):
