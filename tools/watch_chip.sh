@@ -1,0 +1,40 @@
+#!/bin/bash
+# Autonomous chip watcher: patient probes on a 15-minute cadence; on
+# the first success, waits out the claim-gap and runs the full
+# measurement session (tools/chip_session.sh).  One TPU client at a
+# time by construction — the prober exits cleanly before the session
+# starts.  Log: tools/watch_chip.log
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[watch_chip $(date +%H:%M:%S)] $*" >> tools/watch_chip.log; }
+
+log "watcher started"
+for attempt in $(seq 1 40); do
+  log "probe attempt $attempt"
+  python -u - > tools/probe_watch.log 2>&1 <<'PY'
+import time, sys
+t0 = time.time()
+import jax
+try:
+    devs = jax.devices()
+    print(f"PATIENT PROBE OK after {time.time()-t0:.0f}s:", devs)
+    import jax.numpy as jnp
+    print("sum:", float(jnp.ones((64,)).sum()))
+    sys.exit(0)
+except Exception as e:
+    print(f"PATIENT PROBE FAIL after {time.time()-t0:.0f}s:", repr(e)[:200])
+    sys.exit(3)
+PY
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    log "CHIP ALIVE (attempt $attempt) — claim gap, then chip_session"
+    sleep 300
+    bash tools/chip_session.sh >> tools/watch_chip.log 2>&1
+    log "chip_session finished"
+    exit 0
+  fi
+  log "probe failed (rc=$rc); sleeping 15 min"
+  sleep 900
+done
+log "watcher exhausted its attempts"
+exit 1
