@@ -308,23 +308,36 @@ def exclusive_scan(in_r, out, init=0, op: Callable = None):
     """Exclusive variant (std::exclusive_scan surface; the reference spec
     names it, doc/spec/source/algorithms/)."""
     out = _scan(in_r, out, op, None, exclusive=True)
-    # exclusive scan seeds with init at position 0 and folds into the rest
-    if init is not None and init != 0:
+    # exclusive scan seeds with init at position 0 and folds into the
+    # rest.  Skippable only for the add identity: an UNCLASSIFIED op
+    # (kind None) has no identity, so even init=0 must be applied —
+    # op(0, x) need not equal x.
+    kind = _classify_op(op)  # None op classifies as "add"
+    skip = init is None or (kind == "add"
+                            and isinstance(init, (int, float))
+                            and init == 0)
+    if not skip:
         _scan_apply_init(out, init, op)
-    else:
-        pass
     return out
 
 
 def _scan_apply_init(out, init, op):
+    """Fold ``init`` into an exclusive-scan result: positions > 0 take
+    ``op(init, prefix)`` (exact by associativity); position 0 is set to
+    ``init`` EXACTLY — the scan program seeds it with the op identity
+    when one exists, but an unclassified op's pseudo-identity (zero)
+    would make ``op(init, 0)`` wrong there."""
     if op is None:
         op = operator.add
     kind = _classify_op(op)
     combine = combine_for(kind, op)
     chain = _out_chain(out)
     cont = chain.cont
+    if chain.n == 0:
+        return
     arr = cont.to_array()
     seg = arr[chain.off:chain.off + chain.n]
     seg = combine(jnp.asarray(init, cont.dtype), seg)
+    seg = seg.at[0].set(jnp.asarray(init, cont.dtype))
     arr = arr.at[chain.off:chain.off + chain.n].set(seg)
     cont.assign_array(arr)

@@ -61,6 +61,41 @@ def test_exclusive_scan():
     np.testing.assert_allclose(dr_tpu.to_numpy(out), ref)
 
 
+def test_exclusive_scan_mul_init():
+    """Classified non-add op with non-zero init: position 0 must be
+    exactly ``init``; later positions fold it into the shifted prefixes
+    (std::exclusive_scan semantics)."""
+    src = np.array([2.0, 3.0, 4.0, 5.0, 6.0, 7.0], dtype=np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(len(src))
+    dr_tpu.exclusive_scan(a, out, init=10.0, op=jnp.multiply)
+    ref = 10.0 * np.concatenate([[1.0], np.cumprod(src)[:-1]])
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=1e-5)
+
+
+def test_exclusive_scan_unclassified_op_init():
+    """UNCLASSIFIED associative op (a user lambda the kind-classifier
+    can't name): the scan program seeds position 0 with a pseudo-identity
+    zero, so the init fold must overwrite it with ``init`` exactly —
+    ``op(init, 0)`` would be 0 here.  Also covers init=0, which for an
+    unclassified op still has to be applied."""
+    src = np.array([2.0, 3.0, 4.0, 5.0], dtype=np.float32)
+
+    def op(x, y):
+        return x * y  # associative, but a lambda-like fn: kind is None
+
+    for init in (10.0, 0.0):
+        a = dr_tpu.distributed_vector.from_array(src)
+        out = dr_tpu.distributed_vector(len(src))
+        dr_tpu.exclusive_scan(a, out, init=init, op=op)
+        ref = np.empty_like(src)
+        acc = init
+        for i, v in enumerate(src):
+            ref[i] = acc
+            acc = acc * v
+        np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=1e-5)
+
+
 def test_scan_into_subrange_preserves_rest():
     """Regression: the fast path must not clobber output cells outside the
     requested window."""

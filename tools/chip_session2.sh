@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-3 session-3 on-chip run: the chip_session.sh steps whose logs
+# were lost with the previous VM (docs/ROUND3_NOTES.md chip session 2
+# ran bench + attn; the rest never ran).  Same discipline: one TPU
+# process at a time, clean exits, 5-minute gaps between claims.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[chip_session2 $(date +%H:%M:%S)] $*"; }
+
+log "1/7 bench.py (regenerate the BENCH_r03 rehearsal artifact)"
+python -u bench.py > tools/bench_r3_dev.json 2> tools/bench_r3_dev.err
+log "bench exit=$? $(tail -c 300 tools/bench_r3_dev.json)"
+sleep 300
+
+log "2/7 spmv (BCSR GFLOP/s)"
+python -u tools/tune_tpu.py spmv > tools/tune_spmv.log 2>&1
+log "spmv exit=$?"
+sleep 300
+
+log "3/7 dot (XLA vs pallas kernel)"
+python -u tools/tune_tpu.py dot > tools/tune_dot.log 2>&1
+log "dot exit=$?"
+sleep 300
+
+log "4/7 heat (time blocks)"
+python -u tools/tune_tpu.py heat > tools/tune_heat.log 2>&1
+log "heat exit=$?"
+sleep 300
+
+log "5/7 scan (grid-vs-manual A/B + carry-seeded path)"
+python -u tools/tune_tpu.py scan > tools/tune_scan5.log 2>&1
+log "scan exit=$?"
+sleep 300
+
+log "6/7 stencil at DEFAULT precision (phys bar)"
+DR_TPU_MM_PRECISION=default python -u tools/tune_tpu.py stencil \
+  > tools/tune_stencil_default.log 2>&1
+log "stencil-default exit=$?"
+sleep 300
+
+log "7/7 physbw (VPU blocked kernel at small T)"
+python -u tools/tune_tpu.py physbw > tools/tune_physbw.log 2>&1
+log "physbw exit=$?"
+log "session complete"
