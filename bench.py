@@ -319,11 +319,14 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         dr_tpu.fill(a, 1.5)
         dr_tpu.fill(b, 2.0)
         from dr_tpu.algorithms.reduce import dot_kernel_eligible, dot_n
-        dt = _marginal(lambda r: float(dot_n(a, b, r)))
+        kern = dot_kernel_eligible(a, b)
+        dt = _marginal_with_fallback(lambda r: float(dot_n(a, b, r)),
+                                     kern, "DR_TPU_DOT_IMPL",
+                                     "dot_kernel_error", out)
         out["dot_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
         # the FULL gate, not just the env ask: report what actually ran
-        out["dot_impl"] = ("pallas" if dot_kernel_eligible(a, b)
-                           else "xla")
+        out["dot_impl"] = ("pallas" if kern and
+                           "dot_kernel_error" not in out else "xla")
     except Exception as e:  # pragma: no cover - defensive
         out["dot_error"] = repr(e)[:160]
     finally:

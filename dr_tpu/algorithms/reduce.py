@@ -267,7 +267,8 @@ def _dot_kernel_eligible_chains(c0, c1) -> bool:
 
 def dot_kernel_eligible(a, b) -> bool:
     """Whether ``dot_n(a, b)`` would actually take the Pallas streamed
-    kernel with DR_TPU_DOT_IMPL=pallas set — the FULL gate, so callers
+    kernel (the TPU default; DR_TPU_DOT_IMPL=xla opts out) — the FULL
+    gate, so callers
     (bench.py's ``dot_impl`` tag) report what ran, not what was asked
     for."""
     return _dot_kernel_eligible_chains(*_dot_n_chains(a, b))
@@ -288,7 +289,8 @@ def dot_n(a, b, iters: int):
     c0, c1 = _dot_n_chains(a, b)
     layout, off, n = c0.cont.layout, c0.off, c0.n
     nshards, seg, prev, nxt, total_n = layout
-    # opt-in Pallas chunked-dot path (DR_TPU_DOT_IMPL=pallas): per-shard
+    # Pallas chunked-dot path (TPU default; DR_TPU_DOT_IMPL=xla opts
+    # out): per-shard
     # streamed multiply+reduce + psum, salt folded inside the kernel
     from ..ops import reduce_pallas, scan_pallas
     rt = c0.cont.runtime

@@ -327,8 +327,9 @@ def test_nested_bound_ops_in_reduce_pipeline():
 
 
 def test_dot_n_kernel_path_interpret(monkeypatch):
-    """dot_n's opt-in Pallas path (DR_TPU_DOT_IMPL=pallas): per-shard
-    streamed kernel + psum on the multi-device mesh, interpret mode."""
+    """dot_n's Pallas kernel path (the TPU default since the round-3
+    A/B; DR_TPU_DOT_IMPL=xla opts out): per-shard streamed kernel +
+    psum on the multi-device mesh, interpret mode."""
     import functools
     import importlib
     reduce_mod = importlib.import_module("dr_tpu.algorithms.reduce")

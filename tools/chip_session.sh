@@ -1,5 +1,6 @@
 #!/bin/bash
-# Round-3 on-chip session: TUNE_PLAN.md steps in priority order.
+# Round-3 on-chip session (historical; superseded by chip_session2.sh).
+# Sweep bars and dispositions are recorded in docs/PERF.md.
 # One TPU process at a time; 5-minute gaps between claims (the round-3
 # second outage followed a 90 s gap — docs/ROUND3_NOTES.md).
 set -u

@@ -84,7 +84,8 @@ def tune_physbw():
     """PHYSICAL-bandwidth sweep of the VPU blocked kernel at small T:
     at T=1 the ~20 vector-ops/element-step sit well under the 2-pass
     DMA floor, so the per-pass rate should approach HBM peak — the
-    datapoint for TUNE_PLAN's phys bar (the MXU composed apply is
+    datapoint for the >= 200 GB/s physical-bandwidth bar, docs/PERF.md
+    (the MXU composed apply is
     MXU-bound near 180 GB/s; heat2d proves 91% of peak is reachable)."""
     import jax
     import jax.numpy as jnp
@@ -194,10 +195,9 @@ def tune_container(name):
         dr_tpu.fill(a, 1.5)
         dr_tpu.fill(b, 2.0)
         for impl in ("xla", "pallas"):
-            if impl == "pallas":
-                os.environ["DR_TPU_DOT_IMPL"] = "pallas"
-            else:
-                os.environ.pop("DR_TPU_DOT_IMPL", None)
+            # explicit on BOTH arms: the kernel is the default when the
+            # var is unset, so popping would compare pallas vs pallas
+            os.environ["DR_TPU_DOT_IMPL"] = impl
             for r2 in (36, 150, 600):
                 try:
                     dt = _marginal(
