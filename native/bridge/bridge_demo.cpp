@@ -122,6 +122,26 @@ int main(int argc, char** argv) {
     check_range("exclusive_scan", host, want);
   }
 
+  // ---- distributed sample sort ----------------------------------------
+  thp::vector sv = s.make_vector(n);
+  sv.iota(0.0);
+  s.for_each(sv, 0.0 - thp::x0);      // n descending values -0..-(n-1)
+  s.sort(sv);
+  {
+    auto host = sv.to_host();
+    std::vector<double> want(n);
+    for (std::size_t i = 0; i < n; ++i)
+      want[i] = -(double)(n - 1 - i);
+    check_range("sort ascending", host, want);
+  }
+  s.sort(sv, /*descending=*/true);
+  {
+    auto host = sv.to_host();
+    std::vector<double> want(n);
+    for (std::size_t i = 0; i < n; ++i) want[i] = -(double)i;
+    check_range("sort descending", host, want);
+  }
+
   // ---- halo'd stencil, 4 fused steps on device ------------------------
   thp::vector x = s.make_vector(n, 1, 1, false);
   thp::vector y = s.make_vector(n, 1, 1, false);

@@ -395,6 +395,20 @@ void session::exclusive_scan(const vector& in, vector& out, double init) {
   Py_DECREF(r);
 }
 
+void session::sort(vector& v, bool descending) {
+  // keyword-only descending flag: PyObject_Call with a kwargs dict
+  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort"),
+                      "sort lookup");
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
+                                   descending ? Py_True : Py_False);
+  PyObject* r = must(PyObject_Call(fn, args, kwargs), "sort");
+  Py_DECREF(r);
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(fn);
+}
+
 void session::gemv(vector& c, const sparse_matrix& a, const vector& b) {
   PyObject* r = must(
       PyObject_CallMethod(impl_->dr, "gemv", "OOO", (PyObject*)c.obj_,

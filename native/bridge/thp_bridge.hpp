@@ -211,6 +211,11 @@ class session {
   void inclusive_scan(const vector& in, vector& out);
   void exclusive_scan(const vector& in, vector& out, double init = 0.0);
 
+  // distributed sample sort, in place (beyond-parity surface; one
+  // shard_map program: local sort + splitter all_gather + all_to_all
+  // bucket exchange + rebalance — algorithms/sort.py)
+  void sort(vector& v, bool descending = false);
+
   // matrix algorithms
   void gemv(vector& c, const sparse_matrix& a, const vector& b);
   void gemm(const dense_matrix& a, const dense_matrix& b,

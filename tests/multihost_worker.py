@@ -135,6 +135,16 @@ comm = dr_tpu.default_comm()
 g = comm.allgather(dv.to_array())
 np.testing.assert_allclose(g, np.arange(1, n + 1))
 
+# distributed sample sort: the all_to_all bucket exchange crosses the
+# process boundary (every process runs the same collective program)
+srt_src = np.asarray(
+    np.random.default_rng(7).standard_normal(n), dtype=np.float32)
+srt = dr_tpu.distributed_vector(n, dtype=np.float32)
+srt.assign_array(srt_src)
+dr_tpu.sort(srt)
+np.testing.assert_allclose(dr_tpu.to_numpy(srt), np.sort(srt_src),
+                           rtol=0, atol=0)
+
 # 2-D matrix op across processes: mdarray transpose (all-to-all route)
 src2 = np.arange(4 * nproc * 8, dtype=np.float32).reshape(4 * nproc, 8)
 M = dr_tpu.distributed_mdarray.from_array(src2)

@@ -98,6 +98,8 @@ def test_marginal_fast_path_no_widening(monkeypatch):
     ("vector_add", ["-n", "4096"]),
     ("dot_product", ["-n", "4096"]),
     ("inclusive_scan_example", ["-n", "4096"]),
+    ("sort_example", ["-n", "4096"]),
+    ("sort_example", ["-n", "4097", "--descending"]),
     ("views_example", []),
 ])
 def test_example_smoke(mod, argv, monkeypatch, capsys):

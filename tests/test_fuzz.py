@@ -32,7 +32,7 @@ def test_fuzz_subrange_ops(seed):
         b = int(rng.integers(0, n))
         e = int(rng.integers(b, n))
         alg = rng.choice(["copy", "transform", "reduce", "scan", "fill",
-                          "iota"])
+                          "iota", "sort"])
         src, dv = _mk(rng, n)
         if alg == "copy":
             dst_src, dst = _mk(rng, n)
@@ -70,6 +70,18 @@ def test_fuzz_subrange_ops(seed):
             ref = np.zeros(n, np.int32)
             ref[b:e] = np.arange(5, 5 + (e - b))
             np.testing.assert_array_equal(dr_tpu.to_numpy(iv), ref)
+        elif alg == "sort":
+            desc = bool(rng.integers(0, 2))
+            whole = bool(rng.integers(0, 2))
+            if whole:  # sample-sort fast path
+                dr_tpu.sort(dv, descending=desc)
+                ref = np.sort(src)[::-1] if desc else np.sort(src)
+            else:      # window fallback
+                dr_tpu.sort(dv[b:e], descending=desc)
+                ref = src.copy()
+                w = np.sort(ref[b:e])
+                ref[b:e] = w[::-1] if desc else w
+            np.testing.assert_array_equal(dr_tpu.to_numpy(dv), ref)
 
 
 @pytest.mark.parametrize("seed", range(2))
