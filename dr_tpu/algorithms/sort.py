@@ -49,7 +49,7 @@ from ._common import uniform_layout
 from .elementwise import _out_chain, _prog_cache, _write_window
 from ..core.pinning import pinned_id
 
-__all__ = ["sort"]
+__all__ = ["sort", "sort_by_key"]
 
 
 _NAN_KEY = np.uint32(0xFFFFFFFE)  # after +inf (numpy sorts NaNs last)
@@ -70,6 +70,10 @@ def _encode(x):
         b = jax.lax.bitcast_convert_type(x.astype(jnp.float32),
                                          jnp.uint32)
         k = jnp.where(b >> 31 == 1, ~b, b | jnp.uint32(0x80000000))
+        # -0.0 and +0.0 are IEEE-equal: give them ONE key so they tie
+        # (numpy-stable semantics); the decoded value is +0.0 — the
+        # zero's sign is canonicalized like a NaN's payload
+        k = jnp.where(x == 0, jnp.uint32(0x80000000), k)
         return jnp.where(jnp.isnan(x), _NAN_KEY, k), _PAD_KEY
     return x, jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
 
@@ -84,36 +88,73 @@ def _decode(k, dtype):
     return k.astype(dtype)
 
 
-def _sort_program(mesh, axis, layout, dtype, descending):
+def _pack_row(row, layout, dtype):
+    """Place an owned-width row back into a padded shard row."""
+    nshards, seg, prev, nxt, n = layout
+    if prev == 0 and nxt == 0:
+        return row.astype(dtype)[None]
+    out = jnp.zeros((1, prev + seg + nxt), dtype)
+    return out.at[0, prev:prev + seg].set(row.astype(dtype))
+
+
+def _sort_program(mesh, axis, layout, dtype, descending,
+                  pay_layout=None, pay_dtype=None):
+    """The sample-sort program; with ``pay_layout`` set it carries a
+    payload row through every phase (stable key-value sort — the
+    payload rides the same collectives, tie order preserved by
+    ``is_stable`` sorts and the source-major merge order)."""
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
-           bool(descending))
+           bool(descending), pay_layout,
+           str(pay_dtype) if pay_layout else None)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
 
     nshards, seg, prev, nxt, n = layout
     p = nshards
+    pprev = pay_layout[2] if pay_layout else 0
 
-    def body(blk):  # (1, prev+seg+nxt) — one shard row
+    GMAX = np.int32(np.iinfo(np.int32).max)
+
+    def body(blk, *pay):  # padded shard rows: keys (+ payload)
         key, big = _encode(blk[0, prev:prev + seg])
         r = lax.axis_index(axis)
         gid = r * seg + jnp.arange(seg)
         key = jnp.where(gid < n, key, big)      # mask ceil-layout pads
-        xs = jnp.sort(key)
+        vals = (key,) + tuple(v[0, pprev:pprev + seg] for v in pay)
+        nkeys = 1
+        if pay:
+            # SECONDARY sort key: the original global index, with pads
+            # at int32 max.  Two jobs: (a) real elements sort before
+            # pad slots among EQUAL keys — an integer key equal to the
+            # dtype-max pad sentinel would otherwise let a pad displace
+            # the real element's payload in the merge; (b) key ties
+            # keep original global order exactly (numpy-stable).
+            vals = (key, jnp.where(gid < n, gid, GMAX).astype(
+                jnp.int32)) + vals[1:]
+            nkeys = 2
+        srt = lax.sort(vals, dimension=0, num_keys=nkeys,
+                       is_stable=True)
+        xs, ps = srt[0], srt[1:]
         nvalid = jnp.clip(n - r * seg, 0, seg)  # my real element count
 
         if p == 1:
-            out_row = xs if not descending else xs[::-1]
-            # single shard: pads sorted to the end (or start); rotate
-            # them back outside the logical window
-            out_row = jnp.roll(out_row, nvalid - seg) if descending \
-                else out_row
+            if descending:
+                # pads sorted to the end; reverse, then rotate them
+                # back outside the logical window
+                outs = [jnp.roll(v[::-1], nvalid - seg)
+                        for v in (xs, *ps)]
+            else:
+                outs = [xs, *ps]
+            if pay:
+                del outs[1]  # the gid channel is not an output
         else:
             # 2. regular samples -> global splitters
             samp = xs[(jnp.arange(1, p) * seg) // p]          # (p-1,)
             allsamp = lax.all_gather(samp, axis).reshape(-1)  # (p(p-1),)
             spl = jnp.sort(allsamp)[jnp.arange(1, p) * (p - 1) - 1]
-            # 3. bucket exchange ((p, seg) send matrix, one all_to_all)
+            # 3. bucket exchange ((p, seg) send matrices, one
+            # all_to_all per channel)
             bucket = jnp.searchsorted(spl, xs, side="right")  # (seg,)
             vmask = jnp.arange(seg) < nvalid
             mine = (bucket[None, :] == jnp.arange(p)[:, None]) \
@@ -122,8 +163,23 @@ def _sort_program(mesh, axis, layout, dtype, descending):
             cnts = jnp.sum(mine, axis=1, dtype=jnp.int32)     # (p,)
             recv = lax.all_to_all(send, axis, 0, 0)           # (p, seg)
             rcnt = lax.all_to_all(cnts[:, None], axis, 0, 0)  # (p, 1)
-            # 4. local merge; cnt = my sorted run's true length
-            merged = jnp.sort(recv.reshape(-1))               # (p*seg,)
+            # pad values per channel: the gid channel pads at GMAX so
+            # pad slots stay AFTER real elements under the 2-key merge
+            ppad = [jnp.asarray(GMAX)] + \
+                [jnp.zeros((), q.dtype) for q in ps[1:]] if pay else []
+            precv = [lax.all_to_all(
+                jnp.where(mine, q[None, :], pv), axis, 0, 0)
+                for q, pv in zip(ps, ppad)]
+            # 4. stable local merge; cnt = my run's true length.  The
+            # flattened recv is source-major and each source row keeps
+            # its local sorted order, so stability composes; with a
+            # payload the global index is the explicit tiebreak.
+            msrt = lax.sort((recv.reshape(-1),)
+                            + tuple(q.reshape(-1) for q in precv),
+                            dimension=0, num_keys=nkeys,
+                            is_stable=True)
+            merged = msrt[0]
+            pmerged = msrt[2:] if pay else msrt[1:]
             cnt = jnp.sum(rcnt)
             # 5. rebalance to the block layout by masked-sum assembly
             allcnt = lax.all_gather(cnt, axis)                # (p,)
@@ -133,22 +189,26 @@ def _sort_program(mesh, axis, layout, dtype, descending):
             want = (n - 1 - gpos) if descending else gpos
             idx = want - off               # my local index for that cell
             ok = (idx >= 0) & (idx < cnt)
-            send2 = jnp.where(
-                ok, jnp.take(merged, jnp.clip(idx, 0, p * seg - 1)),
-                jnp.zeros((), merged.dtype))
-            recv2 = lax.all_to_all(send2, axis, 0, 0)
-            out_row = jnp.sum(recv2, axis=0)  # exactly-one coverage
-        out_row = _decode(out_row, dtype)
-        if prev == 0 and nxt == 0:
-            return out_row[None]
-        out = jnp.zeros((1, prev + seg + nxt), dtype)
-        return out.at[0, prev:prev + seg].set(out_row)
+            gidx = jnp.clip(idx, 0, p * seg - 1)
 
-    shmapped = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
-                             out_specs=P(axis, None))
-    # in-place rebind: donate the input buffer like the other in-place
+            def rebalance(m):
+                s2 = jnp.where(ok, jnp.take(m, gidx),
+                               jnp.zeros((), m.dtype))
+                return jnp.sum(lax.all_to_all(s2, axis, 0, 0), axis=0)
+            outs = [rebalance(m) for m in (merged, *pmerged)]
+        out_rows = [_pack_row(_decode(outs[0], dtype), layout, dtype)]
+        for row in outs[1:]:
+            out_rows.append(_pack_row(row, pay_layout, pay_dtype))
+        return out_rows[0] if not pay else tuple(out_rows)
+
+    nin = 1 if pay_layout is None else 2
+    shmapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis, None),) * nin,
+        out_specs=P(axis, None) if pay_layout is None
+        else (P(axis, None),) * 2)
+    # in-place rebind: donate the input buffers like the other in-place
     # cached programs (elementwise/gemv/stencil)
-    prog = jax.jit(shmapped, donate_argnums=0)
+    prog = jax.jit(shmapped, donate_argnums=tuple(range(nin)))
     _prog_cache[key] = prog
     return prog
 
@@ -177,3 +237,43 @@ def sort(r, *, descending: bool = False):
         win = win[::-1]
     _write_window(chain, win)
     return r
+
+
+def sort_by_key(keys, values, *, descending: bool = False):
+    """STABLE key-value sort: reorder ``values`` by ``keys`` (both in
+    place, rebinding).  Ties keep their original global order; with
+    ``descending`` the whole ascending order is reversed, ties
+    included.  Both arguments must be whole ``distributed_vector``\\ s
+    with the same logical length; matching uniform layouts take the
+    fast path (the payload rides the same collectives as the keys),
+    everything else an argsort-based materialize fallback."""
+    kc = _out_chain(keys)
+    vc = _out_chain(values)
+    if kc.n != vc.n:
+        raise ValueError(
+            f"keys and values must have equal length ({kc.n} != {vc.n})")
+    kcont, vcont = kc.cont, vc.cont
+    full = (kc.off == 0 and vc.off == 0
+            and kc.n == len(kcont) and vc.n == len(vcont)
+            and uniform_layout(kcont.layout)
+            and uniform_layout(vcont.layout)
+            # same (nshards, seg, n) geometry; halo widths may differ
+            and kcont.layout[0] == vcont.layout[0]
+            and kcont.layout[1] == vcont.layout[1]
+            and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
+            and jnp.dtype(vcont.dtype) != jnp.dtype(np.float64))
+    if full:
+        prog = _sort_program(kcont.runtime.mesh, kcont.runtime.axis,
+                             kcont.layout, kcont.dtype, descending,
+                             pay_layout=vcont.layout,
+                             pay_dtype=vcont.dtype)
+        kcont._data, vcont._data = prog(kcont._data, vcont._data)
+        return keys, values
+    karr = kcont.to_array()[kc.off:kc.off + kc.n]
+    varr = vcont.to_array()[vc.off:vc.off + vc.n]
+    order = jnp.argsort(karr, stable=True)
+    if descending:
+        order = order[::-1]
+    _write_window(kc, jnp.take(karr, order))
+    _write_window(vc, jnp.take(varr, order))
+    return keys, values

@@ -141,6 +141,22 @@ int main(int argc, char** argv) {
     for (std::size_t i = 0; i < n; ++i) want[i] = -(double)i;
     check_range("sort descending", host, want);
   }
+  // key-value: keys descending 0..-(n-1) after the sort above; payload
+  // iota must come out reversed when keys are sorted ascending
+  thp::vector pv = s.make_vector(n);
+  pv.iota(0.0);
+  s.sort_by_key(sv, pv);
+  {
+    auto hk = sv.to_host();
+    auto hp = pv.to_host();
+    std::vector<double> wk(n), wp(n);
+    for (std::size_t i = 0; i < n; ++i) {
+      wk[i] = -(double)(n - 1 - i);
+      wp[i] = (double)(n - 1 - i);
+    }
+    check_range("sort_by_key keys", hk, wk);
+    check_range("sort_by_key payload", hp, wp);
+  }
 
   // ---- halo'd stencil, 4 fused steps on device ------------------------
   thp::vector x = s.make_vector(n, 1, 1, false);

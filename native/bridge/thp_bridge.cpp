@@ -409,6 +409,20 @@ void session::sort(vector& v, bool descending) {
   Py_DECREF(fn);
 }
 
+void session::sort_by_key(vector& keys, vector& values, bool descending) {
+  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort_by_key"),
+                      "sort_by_key lookup");
+  PyObject* args = Py_BuildValue("(OO)", (PyObject*)keys.obj_,
+                                 (PyObject*)values.obj_);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
+                                   descending ? Py_True : Py_False);
+  PyObject* r = must(PyObject_Call(fn, args, kwargs), "sort_by_key");
+  Py_DECREF(r);
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(fn);
+}
+
 void session::gemv(vector& c, const sparse_matrix& a, const vector& b) {
   PyObject* r = must(
       PyObject_CallMethod(impl_->dr, "gemv", "OOO", (PyObject*)c.obj_,

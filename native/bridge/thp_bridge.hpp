@@ -213,8 +213,11 @@ class session {
 
   // distributed sample sort, in place (beyond-parity surface; one
   // shard_map program: local sort + splitter all_gather + all_to_all
-  // bucket exchange + rebalance — algorithms/sort.py)
+  // bucket exchange + rebalance — algorithms/sort.py); the _by_key
+  // form reorders values by keys, STABLY (payload rides the same
+  // collectives)
   void sort(vector& v, bool descending = false);
+  void sort_by_key(vector& keys, vector& values, bool descending = false);
 
   // matrix algorithms
   void gemv(vector& c, const sparse_matrix& a, const vector& b);

@@ -144,6 +144,15 @@ srt.assign_array(srt_src)
 dr_tpu.sort(srt)
 np.testing.assert_allclose(dr_tpu.to_numpy(srt), np.sort(srt_src),
                            rtol=0, atol=0)
+srt_pay = np.arange(n, dtype=np.float32)
+srt_k = dr_tpu.distributed_vector(n, dtype=np.float32)
+srt_k.assign_array(srt_src)
+srt_v = dr_tpu.distributed_vector(n, dtype=np.float32)
+srt_v.assign_array(srt_pay)
+dr_tpu.sort_by_key(srt_k, srt_v)
+np.testing.assert_allclose(
+    dr_tpu.to_numpy(srt_v),
+    srt_pay[np.argsort(srt_src, kind="stable")], rtol=0, atol=0)
 
 # 2-D matrix op across processes: mdarray transpose (all-to-all route)
 src2 = np.arange(4 * nproc * 8, dtype=np.float32).reshape(4 * nproc, 8)

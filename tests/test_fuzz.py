@@ -72,15 +72,25 @@ def test_fuzz_subrange_ops(seed):
             np.testing.assert_array_equal(dr_tpu.to_numpy(iv), ref)
         elif alg == "sort":
             desc = bool(rng.integers(0, 2))
-            whole = bool(rng.integers(0, 2))
-            if whole:  # sample-sort fast path
+            mode = int(rng.integers(0, 3))
+            if mode == 0:    # sample-sort fast path
                 dr_tpu.sort(dv, descending=desc)
                 ref = np.sort(src)[::-1] if desc else np.sort(src)
-            else:      # window fallback
+            elif mode == 1:  # window fallback
                 dr_tpu.sort(dv[b:e], descending=desc)
                 ref = src.copy()
                 w = np.sort(ref[b:e])
                 ref[b:e] = w[::-1] if desc else w
+            else:            # stable key-value form
+                pay = np.arange(n, dtype=np.float32)
+                pv = dr_tpu.distributed_vector.from_array(pay)
+                dr_tpu.sort_by_key(dv, pv, descending=desc)
+                order = np.argsort(src, kind="stable")
+                if desc:
+                    order = order[::-1]
+                ref = src[order]
+                np.testing.assert_array_equal(dr_tpu.to_numpy(pv),
+                                              pay[order])
             np.testing.assert_array_equal(dr_tpu.to_numpy(dv), ref)
 
 

@@ -120,6 +120,109 @@ def test_sort_uneven_distribution_fallback(mesh_size):
     np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src))
 
 
+def test_sort_by_key_random():
+    n = 777
+    rng = np.random.default_rng(11)
+    k = rng.standard_normal(n).astype(np.float32)
+    v = np.arange(n, dtype=np.int32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(v)
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), v[order])
+
+
+def test_sort_by_key_stability():
+    """Duplicate keys everywhere: the payload must come out in original
+    global order within each tie group (stable), and descending must be
+    the exact reverse of the ascending result."""
+    n = 500
+    rng = np.random.default_rng(12)
+    k = rng.integers(0, 7, n).astype(np.int32)   # heavy duplication
+    v = np.arange(n, dtype=np.float32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(v)
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), v[order])
+
+    kd2 = dr_tpu.distributed_vector.from_array(k)
+    vd2 = dr_tpu.distributed_vector.from_array(v)
+    dr_tpu.sort_by_key(kd2, vd2, descending=True)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd2), k[order][::-1])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd2), v[order][::-1])
+
+
+def test_sort_by_key_rank_sweep(mesh_size, oracle):
+    n = 6 * mesh_size + 5
+    rng = np.random.default_rng(mesh_size + 50)
+    k = rng.integers(0, 4, n).astype(np.float32)
+    v = rng.standard_normal(n).astype(np.float32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(v)
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    oracle.equal(kd, k[order])
+    oracle.equal(vd, v[order])
+
+
+def test_sort_by_key_mixed_halo_layouts():
+    """Key and payload containers with different halo widths still share
+    the (nshards, seg, n) geometry, so the fast path must handle the
+    differing physical row offsets."""
+    n = 200
+    rng = np.random.default_rng(13)
+    k = rng.standard_normal(n).astype(np.float32)
+    v = rng.standard_normal(n).astype(np.float32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(
+        v, halo=dr_tpu.halo_bounds(2, 2))
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), v[order])
+
+
+def test_sort_by_key_intmax_keys():
+    """Integer keys EQUAL to the pad sentinel (dtype max) must keep
+    their payloads: the global-index secondary key orders real elements
+    before pad slots in the merge."""
+    imax = np.iinfo(np.int32).max
+    k = np.array([5, imax, 1, 2, 3, 4, 6, 7], dtype=np.int32)
+    v = np.arange(8, dtype=np.float32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(v)
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), v[order])
+
+
+def test_sort_by_key_signed_zero_ties():
+    """-0.0 and +0.0 are IEEE-equal: numpy-stable tie order for the
+    payload (the zero's sign itself is canonicalized to +0.0, like a
+    NaN's payload)."""
+    k = np.array([0.0, -0.0, 1.0, -0.0, 0.0], dtype=np.float32)
+    v = np.array([10, 20, 30, 40, 50], dtype=np.float32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(v)
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), v[order])
+
+
+def test_sort_by_key_length_mismatch():
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(4, dtype=np.float32))
+    b = dr_tpu.distributed_vector.from_array(
+        np.arange(5, dtype=np.float32))
+    with pytest.raises(ValueError):
+        dr_tpu.sort_by_key(a, b)
+
+
 def test_sort_rejects_transform_views():
     src = np.arange(8, dtype=np.float32)
     v = dr_tpu.distributed_vector.from_array(src)

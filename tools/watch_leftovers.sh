@@ -1,0 +1,48 @@
+#!/bin/bash
+# Patient background watcher for the two sweeps outage 3 swallowed
+# (stencil at DEFAULT precision, physbw).  One patient probe at a time
+# (clean exits; a failing probe burns its ~25-min client retry budget,
+# so the effective cadence is ~40 min); on the first success, waits out
+# the claim gap and runs ONLY the two leftover sweeps.
+# Log: tools/watch_leftovers.log
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[watch_leftovers $(date +%H:%M:%S)] $*" >> tools/watch_leftovers.log; }
+
+log "watcher started"
+for attempt in $(seq 1 12); do
+  log "probe attempt $attempt"
+  python -u - > tools/probe_leftover.log 2>&1 <<'PY'
+import time, sys
+t0 = time.time()
+import jax
+try:
+    devs = jax.devices()
+    print(f"PATIENT PROBE OK after {time.time()-t0:.0f}s:", devs)
+    import jax.numpy as jnp
+    print("sum:", float(jnp.ones((64,)).sum()))
+    sys.exit(0)
+except Exception as e:
+    print(f"PATIENT PROBE FAIL after {time.time()-t0:.0f}s:", repr(e)[:200])
+    sys.exit(3)
+PY
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    log "CHIP ALIVE (attempt $attempt) — claim gap, then the two sweeps"
+    sleep 300
+    log "stencil at DEFAULT precision"
+    DR_TPU_MM_PRECISION=default python -u tools/tune_tpu.py stencil \
+      > tools/tune_stencil_default.log 2>&1
+    log "stencil-default exit=$?"
+    sleep 300
+    log "physbw"
+    python -u tools/tune_tpu.py physbw > tools/tune_physbw.log 2>&1
+    log "physbw exit=$?"
+    log "leftover sweeps complete"
+    exit 0
+  fi
+  log "probe failed (rc=$rc); sleeping 15 min"
+  sleep 900
+done
+log "watcher exhausted its attempts"
+exit 1
