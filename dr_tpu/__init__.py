@@ -18,7 +18,7 @@ namespaces through one TPU backend, called ``thp``):
   enumerate``
 - algorithms: ``fill / iota / copy / for_each / transform / reduce /
   transform_reduce / inclusive_scan / exclusive_scan / sort /
-  sort_by_key / dot / gemv``
+  sort_by_key / argsort / is_sorted / dot / gemv``
 - halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
 """
 
@@ -58,7 +58,7 @@ from .algorithms.reduce import (reduce, transform_reduce, dot, dot_n,
                                 dot_async)
 from .algorithms.scan import (inclusive_scan, exclusive_scan,
                               inclusive_scan_n)
-from .algorithms.sort import sort, sort_by_key
+from .algorithms.sort import sort, sort_by_key, argsort, is_sorted
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
                                    stencil2d_n, heat_step_weights)

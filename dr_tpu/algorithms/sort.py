@@ -46,10 +46,10 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ._common import uniform_layout
-from .elementwise import _out_chain, _prog_cache, _write_window
+from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from ..core.pinning import pinned_id
 
-__all__ = ["sort", "sort_by_key"]
+__all__ = ["sort", "sort_by_key", "argsort", "is_sorted"]
 
 
 _NAN_KEY = np.uint32(0xFFFFFFFE)  # after +inf (numpy sorts NaNs last)
@@ -277,3 +277,98 @@ def sort_by_key(keys, values, *, descending: bool = False):
     _write_window(kc, jnp.take(karr, order))
     _write_window(vc, jnp.take(varr, order))
     return keys, values
+
+
+def argsort(r, *, descending: bool = False):
+    """The stable sort permutation of ``r`` as a new int32
+    ``distributed_vector`` (``r`` itself is left untouched): index
+    ``i`` of the result holds the original position of the ``i``-th
+    element of the sorted order — ``sort_by_key`` over a scratch copy
+    of the keys with an iota payload.  READ-ONLY in ``r``: transform
+    views and other single-component ranges are accepted (the copy
+    fuses the view chain)."""
+    from ..containers.distributed_vector import distributed_vector
+    from .elementwise import copy as _copy, iota
+    res = _resolve(r)
+    if res is None or len(res) != 1:
+        raise TypeError("argsort takes a single distributed range")
+    chain = res[0]
+    scratch = distributed_vector(chain.n, dtype=chain.cont.dtype,
+                                 runtime=chain.cont.runtime)
+    _copy(r, scratch)
+    idx = distributed_vector(chain.n, dtype=np.int32,
+                             runtime=chain.cont.runtime)
+    iota(idx, 0)
+    sort_by_key(scratch, idx, descending=descending)
+    return idx
+
+
+def _is_sorted_program(mesh, axis, layout, dtype, pinned):
+    key = ("is_sorted", pinned, axis, layout, str(dtype))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    nshards, seg, prev, nxt, n = layout
+    p = nshards
+
+    def body(blk):
+        k, big = _encode(blk[0, prev:prev + seg])
+        r = lax.axis_index(axis)
+        gid = r * seg + jnp.arange(seg)
+        k = jnp.where(gid < n, k, big)  # pads: big, trailing -> sorted
+        local_ok = jnp.all(k[:-1] <= k[1:]) if seg > 1 else jnp.bool_(True)
+        # boundary check: my first real key vs the previous shard's
+        # last real key.  With the ceil layout every shard before the
+        # tail is full, so "last real" is simply position seg-1 of the
+        # masked row unless the shard is entirely past n (then the key
+        # is the pad sentinel, never a violation for the NEXT shard
+        # since nothing real follows it).
+        lasts = lax.all_gather(k[seg - 1], axis)     # (p,)
+        prev_last = jnp.where(r > 0, lasts[jnp.maximum(r - 1, 0)],
+                              jnp.zeros((), k.dtype))
+        first_ok = jnp.where(r > 0, prev_last <= k[0], True)
+        ok = jnp.logical_and(local_ok, first_ok)
+        return lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
+
+    shmapped = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                             out_specs=P())
+    prog = jax.jit(shmapped)
+    _prog_cache[key] = prog
+    return prog
+
+
+def is_sorted(r) -> bool:
+    """True when the range is ascending (``std::is_sorted``; NaNs
+    count as largest, numpy order).  READ-ONLY in ``r``.  Whole uniform
+    containers run one fused shard_map program (local vector compare +
+    one boundary all_gather); windows, views and f64 fall back to a
+    materialized DIRECT comparison (no f32 key encoding — f64 pairs
+    closer than an f32 ulp must still compare exactly)."""
+    res = _resolve(r)
+    if res is not None and len(res) != 1:
+        raise TypeError("is_sorted takes a single-component range")
+    chain = res[0] if res is not None and not res[0].ops else None
+    if chain is not None:
+        cont = chain.cont
+        full = (chain.off == 0 and chain.n == len(cont)
+                and uniform_layout(cont.layout)
+                and jnp.dtype(cont.dtype) != jnp.dtype(np.float64))
+        if full:
+            prog = _is_sorted_program(cont.runtime.mesh,
+                                      cont.runtime.axis, cont.layout,
+                                      cont.dtype,
+                                      pinned_id(cont.runtime.mesh))
+            return int(prog(cont._data)) == 0
+        arr = cont.to_array()[chain.off:chain.off + chain.n]
+    elif res is None:
+        raise TypeError("is_sorted takes a distributed range")
+    else:
+        arr = r.to_array() if hasattr(r, "to_array") \
+            else jnp.asarray(list(r))
+    if arr.shape[0] < 2:
+        return True
+    a, b = arr[:-1], arr[1:]
+    ok = (a <= b) | jnp.isnan(b) \
+        if jnp.issubdtype(arr.dtype, jnp.floating) else a <= b
+    return bool(jnp.all(ok))

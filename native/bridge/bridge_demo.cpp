@@ -157,6 +157,24 @@ int main(int argc, char** argv) {
     check_range("sort_by_key keys", hk, wk);
     check_range("sort_by_key payload", hp, wp);
   }
+  // after sort_by_key the keys are ascending and the payload is the
+  // reversed iota: one true case, one false case
+  if (!s.is_sorted(sv)) {
+    std::fprintf(stderr, "FAIL is_sorted: ascending keys read unsorted\n");
+    return 1;
+  }
+  if (s.is_sorted(pv)) {
+    std::fprintf(stderr, "FAIL is_sorted: reversed payload read sorted\n");
+    return 1;
+  }
+  {
+    // argsort of the (now ascending) keys is the identity permutation
+    thp::vector perm = s.argsort(sv);
+    auto host = perm.to_host();
+    std::vector<double> want(n);
+    for (std::size_t i = 0; i < n; ++i) want[i] = (double)i;
+    check_range("argsort identity", host, want);
+  }
 
   // ---- halo'd stencil, 4 fused steps on device ------------------------
   thp::vector x = s.make_vector(n, 1, 1, false);

@@ -423,6 +423,29 @@ void session::sort_by_key(vector& keys, vector& values, bool descending) {
   Py_DECREF(fn);
 }
 
+vector session::argsort(const vector& v, bool descending) {
+  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "argsort"),
+                      "argsort lookup");
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
+                                   descending ? Py_True : Py_False);
+  PyObject* obj = must(PyObject_Call(fn, args, kwargs), "argsort");
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(fn);
+  return vector(this, obj, v.size());
+}
+
+bool session::is_sorted(const vector& v) {
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "is_sorted", "O",
+                          (PyObject*)v.obj_),
+      "is_sorted");
+  int t = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return t == 1;
+}
+
 void session::gemv(vector& c, const sparse_matrix& a, const vector& b) {
   PyObject* r = must(
       PyObject_CallMethod(impl_->dr, "gemv", "OOO", (PyObject*)c.obj_,

@@ -218,6 +218,8 @@ class session {
   // collectives)
   void sort(vector& v, bool descending = false);
   void sort_by_key(vector& keys, vector& values, bool descending = false);
+  vector argsort(const vector& v, bool descending = false);  // int32 perm
+  bool is_sorted(const vector& v);
 
   // matrix algorithms
   void gemv(vector& c, const sparse_matrix& a, const vector& b);

@@ -223,6 +223,100 @@ def test_sort_by_key_length_mismatch():
         dr_tpu.sort_by_key(a, b)
 
 
+def test_argsort():
+    rng = np.random.default_rng(21)
+    src = rng.integers(0, 9, 300).astype(np.float32)  # many ties
+    v = dr_tpu.distributed_vector.from_array(src)
+    idx = dr_tpu.argsort(v)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(idx),
+                                  np.argsort(src, kind="stable"))
+    # the input is untouched
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), src)
+    idx_d = dr_tpu.argsort(v, descending=True)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(idx_d),
+                                  np.argsort(src, kind="stable")[::-1])
+
+
+def test_is_sorted(mesh_size):
+    p = mesh_size
+    n = 5 * p + 2
+    src = np.arange(n, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    assert dr_tpu.is_sorted(v)
+    # a violation only a LOCAL compare can see
+    bad = src.copy()
+    bad[0] = 1e9
+    assert not dr_tpu.is_sorted(dr_tpu.distributed_vector.from_array(bad))
+    if p > 1:
+        # a violation ONLY the cross-shard boundary check can see:
+        # every shard internally ascending, shard r's values all above
+        # shard r+1's (seg = ceil(n/p) with the exact n = p*seg)
+        seg = 6
+        cross = np.concatenate([
+            (p - r) * 1000.0 + np.arange(seg) for r in range(p)
+        ]).astype(np.float32)
+        vc = dr_tpu.distributed_vector.from_array(cross)
+        assert not dr_tpu.is_sorted(vc)
+    # equal runs are sorted; NaNs count as largest (numpy order)
+    ve = dr_tpu.distributed_vector.from_array(np.zeros(n, np.float32))
+    assert dr_tpu.is_sorted(ve)
+    wn = np.sort(np.r_[src[: n - 1], [np.nan]])
+    vn = dr_tpu.distributed_vector.from_array(wn.astype(np.float32))
+    assert dr_tpu.is_sorted(vn)
+    nan_first = np.r_[[np.nan], src[: n - 1]].astype(np.float32)
+    assert not dr_tpu.is_sorted(
+        dr_tpu.distributed_vector.from_array(nan_first))
+
+
+def test_is_sorted_window():
+    src = np.array([9, 1, 2, 3, 0], dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    assert not dr_tpu.is_sorted(v)
+    assert dr_tpu.is_sorted(v[1:4])
+
+
+def test_is_sorted_and_argsort_accept_views():
+    """Both are READ-ONLY: transform views are legal inputs (reduce's
+    convention), and the view chain fuses into argsort's scratch copy."""
+    from dr_tpu.views import views
+    src = np.array([3.0, 1.0, 2.0], dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    tv = views.transform(v, lambda x: -x)
+    assert not dr_tpu.is_sorted(tv)
+    assert dr_tpu.is_sorted(views.transform(
+        dr_tpu.distributed_vector.from_array(np.sort(src)),
+        lambda x: x * 2.0))
+    idx = dr_tpu.argsort(tv)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(idx),
+                                  np.argsort(-src, kind="stable"))
+
+
+def test_is_sorted_f64_exact():
+    """f64 pairs closer than an f32 ulp must compare exactly (the
+    fallback must NOT round through the f32 key encoding)."""
+    import jax
+    if not jax.config.jax_enable_x64:
+        a = np.array([1.0, 1.0 - 2 ** -53], dtype=np.float64)
+        # without x64 the container itself downcasts; assert the
+        # fallback path at least agrees with the stored values
+        v = dr_tpu.distributed_vector.from_array(
+            a.astype(np.float32))
+        assert dr_tpu.is_sorted(v)  # equal after f32 rounding
+    else:  # pragma: no cover - x64-enabled environments
+        v = dr_tpu.distributed_vector.from_array(
+            np.array([1.0, 1.0 - 2 ** -53], dtype=np.float64))
+        assert not dr_tpu.is_sorted(v)
+
+
+def test_sort_then_is_sorted_composes():
+    rng = np.random.default_rng(22)
+    src = rng.standard_normal(513).astype(np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    assert not dr_tpu.is_sorted(v)
+    dr_tpu.sort(v)
+    assert dr_tpu.is_sorted(v)
+
+
 def test_sort_rejects_transform_views():
     src = np.arange(8, dtype=np.float32)
     v = dr_tpu.distributed_vector.from_array(src)
