@@ -29,9 +29,14 @@ one), so this is designed TPU-first rather than re-designed: ONE jitted
 Descending order costs nothing extra: phase 5's index map places
 element ``g`` of the ascending order at global position ``n-1-g``.
 
-The fallback (subrange windows, uneven block distributions, float64)
-materializes the logical array, sorts it with XLA's global sort, and
-splices it back — correct everywhere, collective-optimal nowhere.
+Uneven ``block_distribution`` layouts (including zero-size "team"
+shards) run the SAME program: the geometry enters as static per-shard
+starts/sizes, phase 5 rebalances into the destination distribution's
+windows, and the bucket matrices stay overflow-free (a source's bucket
+never exceeds its own real count).  The fallback (subrange windows,
+float64) materializes the logical array, sorts it with XLA's global
+sort, and splices it back — correct everywhere, collective-optimal
+nowhere.
 The write target must be a ``distributed_vector`` or a subrange window
 over one; transform views and other read-only ranges are rejected with
 ``TypeError`` (sorting them in place has no meaning).
@@ -45,7 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import uniform_layout
+from ._common import layout_geometry
 from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from ..core.pinning import pinned_id
 
@@ -88,13 +93,25 @@ def _decode(k, dtype):
     return k.astype(dtype)
 
 
+def _sort_geometry(layout):
+    """(p, S, cap, prev, nxt, n, starts, sizes) with S = the max OWNED
+    width — the working row width for the sort programs.  (The
+    geometry helper's ``cap`` also absorbs halo widths; the physical
+    row is ``prev + cap + nxt`` with ``cap >= S``, so slicing
+    ``[prev, prev + S)`` always stays in range and covers every real
+    cell.)"""
+    p, cap, prev, nxt, n, starts, sizes = layout_geometry(layout)
+    S = max(int(sizes.max(initial=0)), 1)
+    return p, S, cap, prev, nxt, n, starts, sizes
+
+
 def _pack_row(row, layout, dtype):
-    """Place an owned-width row back into a padded shard row."""
-    nshards, seg, prev, nxt, n = layout
-    if prev == 0 and nxt == 0:
+    """Place a working-width row back into a padded shard row."""
+    p, S, cap, prev, nxt, n, starts, sizes = _sort_geometry(layout)
+    if prev == 0 and nxt == 0 and cap == S:
         return row.astype(dtype)[None]
-    out = jnp.zeros((1, prev + seg + nxt), dtype)
-    return out.at[0, prev:prev + seg].set(row.astype(dtype))
+    out = jnp.zeros((1, prev + cap + nxt), dtype)
+    return out.at[0, prev:prev + S].set(row.astype(dtype))
 
 
 def _sort_program(mesh, axis, layout, dtype, descending,
@@ -110,18 +127,25 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     if prog is not None:
         return prog
 
-    nshards, seg, prev, nxt, n = layout
-    p = nshards
+    # general geometry: uniform ceil layouts AND uneven
+    # block_distributions share one program shape — S is the max owned
+    # width, starts/sizes the per-shard logical windows
+    p, S, cap, prev, nxt, n, starts, sizes = _sort_geometry(layout)
     pprev = pay_layout[2] if pay_layout else 0
+    starts_c = jnp.asarray(starts, jnp.int32)
+    sizes_c = jnp.asarray(sizes, jnp.int32)
 
     GMAX = np.int32(np.iinfo(np.int32).max)
 
     def body(blk, *pay):  # padded shard rows: keys (+ payload)
-        key, big = _encode(blk[0, prev:prev + seg])
+        key, big = _encode(blk[0, prev:prev + S])
         r = lax.axis_index(axis)
-        gid = r * seg + jnp.arange(seg)
-        key = jnp.where(gid < n, key, big)      # mask ceil-layout pads
-        vals = (key,) + tuple(v[0, pprev:pprev + seg] for v in pay)
+        nvalid = jnp.minimum(sizes_c[r],
+                             jnp.clip(n - starts_c[r], 0, S))
+        gid = starts_c[r] + jnp.arange(S)
+        local_ok = jnp.arange(S) < nvalid
+        key = jnp.where(local_ok, key, big)     # mask pad cells
+        vals = (key,) + tuple(v[0, pprev:pprev + S] for v in pay)
         nkeys = 1
         if pay:
             # SECONDARY sort key: the original global index, with pads
@@ -130,38 +154,41 @@ def _sort_program(mesh, axis, layout, dtype, descending,
             # dtype-max pad sentinel would otherwise let a pad displace
             # the real element's payload in the merge; (b) key ties
             # keep original global order exactly (numpy-stable).
-            vals = (key, jnp.where(gid < n, gid, GMAX).astype(
+            vals = (key, jnp.where(local_ok, gid, GMAX).astype(
                 jnp.int32)) + vals[1:]
             nkeys = 2
         srt = lax.sort(vals, dimension=0, num_keys=nkeys,
                        is_stable=True)
         xs, ps = srt[0], srt[1:]
-        nvalid = jnp.clip(n - r * seg, 0, seg)  # my real element count
 
         if p == 1:
             if descending:
                 # pads sorted to the end; reverse, then rotate them
                 # back outside the logical window
-                outs = [jnp.roll(v[::-1], nvalid - seg)
+                outs = [jnp.roll(v[::-1], nvalid - S)
                         for v in (xs, *ps)]
             else:
                 outs = [xs, *ps]
             if pay:
                 del outs[1]  # the gid channel is not an output
         else:
-            # 2. regular samples -> global splitters
-            samp = xs[(jnp.arange(1, p) * seg) // p]          # (p-1,)
+            # 2. regular samples -> global splitters (positions scale
+            # with MY real count; a short shard samples its real keys,
+            # an EMPTY one contributes pad sentinels — either way only
+            # bucket balance is affected, never correctness)
+            samp = jnp.take(xs, (jnp.arange(1, p) * nvalid) // p)
             allsamp = lax.all_gather(samp, axis).reshape(-1)  # (p(p-1),)
             spl = jnp.sort(allsamp)[jnp.arange(1, p) * (p - 1) - 1]
-            # 3. bucket exchange ((p, seg) send matrices, one
-            # all_to_all per channel)
-            bucket = jnp.searchsorted(spl, xs, side="right")  # (seg,)
-            vmask = jnp.arange(seg) < nvalid
+            # 3. bucket exchange ((p, S) send matrices, one
+            # all_to_all per channel).  A source's bucket can't exceed
+            # its own real count (<= S): overflow-free by construction.
+            bucket = jnp.searchsorted(spl, xs, side="right")  # (S,)
+            vmask = jnp.arange(S) < nvalid
             mine = (bucket[None, :] == jnp.arange(p)[:, None]) \
                 & vmask[None, :]
             send = jnp.where(mine, xs[None, :], big)
             cnts = jnp.sum(mine, axis=1, dtype=jnp.int32)     # (p,)
-            recv = lax.all_to_all(send, axis, 0, 0)           # (p, seg)
+            recv = lax.all_to_all(send, axis, 0, 0)           # (p, S)
             rcnt = lax.all_to_all(cnts[:, None], axis, 0, 0)  # (p, 1)
             # pad values per channel: the gid channel pads at GMAX so
             # pad slots stay AFTER real elements under the 2-key merge
@@ -181,15 +208,18 @@ def _sort_program(mesh, axis, layout, dtype, descending,
             merged = msrt[0]
             pmerged = msrt[2:] if pay else msrt[1:]
             cnt = jnp.sum(rcnt)
-            # 5. rebalance to the block layout by masked-sum assembly
+            # 5. rebalance to the DESTINATION layout by masked-sum
+            # assembly: shard d's window is [starts[d], starts[d] +
+            # sizes[d])
             allcnt = lax.all_gather(cnt, axis)                # (p,)
             off = jnp.sum(jnp.where(jnp.arange(p) < r, allcnt, 0))
-            gpos = jnp.arange(p)[:, None] * seg \
-                + jnp.arange(seg)[None, :]                    # (p, seg)
+            gpos = starts_c[:, None] \
+                + jnp.arange(S)[None, :]                      # (p, S)
+            dest_ok = jnp.arange(S)[None, :] < sizes_c[:, None]
             want = (n - 1 - gpos) if descending else gpos
             idx = want - off               # my local index for that cell
-            ok = (idx >= 0) & (idx < cnt)
-            gidx = jnp.clip(idx, 0, p * seg - 1)
+            ok = dest_ok & (idx >= 0) & (idx < cnt)
+            gidx = jnp.clip(idx, 0, p * S - 1)
 
             def rebalance(m):
                 s2 = jnp.where(ok, jnp.take(m, gidx),
@@ -216,13 +246,12 @@ def _sort_program(mesh, axis, layout, dtype, descending,
 def sort(r, *, descending: bool = False):
     """Sort a distributed range in place (rebinding), ascending by
     default.  ``r`` must be a ``distributed_vector`` or a subrange
-    window over one (the write target); whole uniform-layout containers
-    take the single-program sample-sort fast path, everything else the
-    materialize-and-splice fallback."""
+    window over one (the write target); whole containers — uniform or
+    uneven block distributions — take the single-program sample-sort
+    fast path, windows and f64 the materialize-and-splice fallback."""
     chain = _out_chain(r)
     cont = chain.cont
     full = (chain.off == 0 and chain.n == len(cont)
-            and uniform_layout(cont.layout)
             # the key encoding upcasts floats through f32: exact for
             # f32/bf16/f16, lossy for f64 — f64 takes the fallback
             and jnp.dtype(cont.dtype) != jnp.dtype(np.float64))
@@ -244,9 +273,10 @@ def sort_by_key(keys, values, *, descending: bool = False):
     place, rebinding).  Ties keep their original global order; with
     ``descending`` the whole ascending order is reversed, ties
     included.  Both arguments must be whole ``distributed_vector``\\ s
-    with the same logical length; matching uniform layouts take the
-    fast path (the payload rides the same collectives as the keys),
-    everything else an argsort-based materialize fallback."""
+    with the same logical length; matching distributions (uniform or
+    uneven) take the fast path (the payload rides the same collectives
+    as the keys), everything else an argsort-based materialize
+    fallback."""
     kc = _out_chain(keys)
     vc = _out_chain(values)
     if kc.n != vc.n:
@@ -255,9 +285,8 @@ def sort_by_key(keys, values, *, descending: bool = False):
     kcont, vcont = kc.cont, vc.cont
     full = (kc.off == 0 and vc.off == 0
             and kc.n == len(kcont) and vc.n == len(vcont)
-            and uniform_layout(kcont.layout)
-            and uniform_layout(vcont.layout)
-            # same (nshards, seg, n) geometry; halo widths may differ
+            # same logical distribution (nshards + per-shard windows);
+            # halo widths may differ
             and kcont.layout[0] == vcont.layout[0]
             and kcont.layout[1] == vcont.layout[1]
             and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
@@ -309,25 +338,30 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned):
     if prog is not None:
         return prog
 
-    nshards, seg, prev, nxt, n = layout
-    p = nshards
+    p, S, cap, prev, nxt, n, starts, sizes = _sort_geometry(layout)
+    starts_c = jnp.asarray(starts, jnp.int32)
+    sizes_c = jnp.asarray(sizes, jnp.int32)
 
     def body(blk):
-        k, big = _encode(blk[0, prev:prev + seg])
+        k, big = _encode(blk[0, prev:prev + S])
         r = lax.axis_index(axis)
-        gid = r * seg + jnp.arange(seg)
-        k = jnp.where(gid < n, k, big)  # pads: big, trailing -> sorted
-        local_ok = jnp.all(k[:-1] <= k[1:]) if seg > 1 else jnp.bool_(True)
-        # boundary check: my first real key vs the previous shard's
-        # last real key.  With the ceil layout every shard before the
-        # tail is full, so "last real" is simply position seg-1 of the
-        # masked row unless the shard is entirely past n (then the key
-        # is the pad sentinel, never a violation for the NEXT shard
-        # since nothing real follows it).
-        lasts = lax.all_gather(k[seg - 1], axis)     # (p,)
-        prev_last = jnp.where(r > 0, lasts[jnp.maximum(r - 1, 0)],
-                              jnp.zeros((), k.dtype))
-        first_ok = jnp.where(r > 0, prev_last <= k[0], True)
+        nvalid = jnp.minimum(sizes_c[r],
+                             jnp.clip(n - starts_c[r], 0, S))
+        k = jnp.where(jnp.arange(S) < nvalid, k, big)
+        # pads are the key max and trail the reals, so the local
+        # vector compare stays monotone across the real->pad boundary
+        local_ok = jnp.all(k[:-1] <= k[1:]) if S > 1 else jnp.bool_(True)
+        # boundary check, empty-shard-safe: sorted <=> every shard is
+        # locally sorted AND the max over all PREVIOUS shards' last
+        # real keys <= my first real key (empty shards contribute the
+        # key-domain minimum, i.e. no constraint)
+        small = jnp.zeros((), k.dtype) if k.dtype == jnp.uint32 \
+            else jnp.array(jnp.iinfo(k.dtype).min, k.dtype)
+        last = jnp.where(nvalid > 0,
+                         k[jnp.clip(nvalid - 1, 0, S - 1)], small)
+        lasts = lax.all_gather(last, axis)           # (p,)
+        prevmax = jnp.max(jnp.where(jnp.arange(p) < r, lasts, small))
+        first_ok = jnp.logical_or(nvalid == 0, prevmax <= k[0])
         ok = jnp.logical_and(local_ok, first_ok)
         return lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
 
@@ -340,11 +374,12 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned):
 
 def is_sorted(r) -> bool:
     """True when the range is ascending (``std::is_sorted``; NaNs
-    count as largest, numpy order).  READ-ONLY in ``r``.  Whole uniform
-    containers run one fused shard_map program (local vector compare +
-    one boundary all_gather); windows, views and f64 fall back to a
-    materialized DIRECT comparison (no f32 key encoding — f64 pairs
-    closer than an f32 ulp must still compare exactly)."""
+    count as largest, numpy order).  READ-ONLY in ``r``.  Whole
+    containers (uniform or uneven distributions) run one fused
+    shard_map program (local vector compare + one boundary
+    all_gather); windows, views and f64 fall back to a materialized
+    DIRECT comparison (no f32 key encoding — f64 pairs closer than an
+    f32 ulp must still compare exactly)."""
     res = _resolve(r)
     if res is not None and len(res) != 1:
         raise TypeError("is_sorted takes a single-component range")
@@ -352,7 +387,6 @@ def is_sorted(r) -> bool:
     if chain is not None:
         cont = chain.cont
         full = (chain.off == 0 and chain.n == len(cont)
-                and uniform_layout(cont.layout)
                 and jnp.dtype(cont.dtype) != jnp.dtype(np.float64))
         if full:
             prog = _is_sorted_program(cont.runtime.mesh,

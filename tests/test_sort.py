@@ -106,8 +106,9 @@ def test_sort_window_fallback():
     np.testing.assert_array_equal(got, ref)
 
 
-def test_sort_uneven_distribution_fallback(mesh_size):
-    """Uneven block_distribution layouts take the materialize fallback."""
+def test_sort_uneven_distribution(mesh_size):
+    """Uneven block_distribution layouts run the SAME sample-sort
+    program (per-shard starts/sizes are static geometry)."""
     if mesh_size < 2:
         pytest.skip("needs >= 2 shards for an uneven split")
     sizes = [7] + [3] * (mesh_size - 1)
@@ -116,8 +117,59 @@ def test_sort_uneven_distribution_fallback(mesh_size):
     v = dr_tpu.distributed_vector(
         n, np.float32, distribution=dr_tpu.block_distribution(sizes))
     v.assign_array(src)
+    assert not dr_tpu.is_sorted(v)
     dr_tpu.sort(v)
     np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src))
+    assert dr_tpu.is_sorted(v)
+    dr_tpu.sort(v, descending=True)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src)[::-1])
+
+
+def test_sort_uneven_with_teams(mesh_size):
+    """Zero-size shards (teams) in the distribution: empty shards
+    contribute nothing, sample nothing, and receive exactly their
+    (empty) windows."""
+    if mesh_size < 3:
+        pytest.skip("needs >= 3 shards for a zero-size middle shard")
+    sizes = [5, 0] + [4] * (mesh_size - 2)
+    n = sum(sizes)
+    rng = np.random.default_rng(6)
+    src = rng.integers(0, 50, n).astype(np.int32)
+    dist = dr_tpu.block_distribution(sizes)
+    v = dr_tpu.distributed_vector(n, np.int32, distribution=dist)
+    v.assign_array(src)
+    dr_tpu.sort(v)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src))
+    assert dr_tpu.is_sorted(v)
+    # stable key-value over the same uneven distribution
+    k = rng.integers(0, 5, n).astype(np.float32)
+    pay = np.arange(n, dtype=np.float32)
+    kd = dr_tpu.distributed_vector(n, np.float32, distribution=dist)
+    kd.assign_array(k)
+    pd = dr_tpu.distributed_vector(n, np.float32, distribution=dist)
+    pd.assign_array(pay)
+    dr_tpu.sort_by_key(kd, pd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(pd), pay[order])
+
+
+def test_is_sorted_uneven_boundary(mesh_size):
+    """A violation visible only at an uneven shard boundary, with an
+    empty shard between the two conflicting shards."""
+    if mesh_size < 3:
+        pytest.skip("needs >= 3 shards")
+    sizes = [4, 0] + [4] * (mesh_size - 2)
+    n = sum(sizes)
+    # shard 0 ascending but ABOVE shard 2's values; shards 2+ ascending
+    src = np.concatenate([
+        1000.0 + np.arange(4),
+        np.arange(n - 4, dtype=np.float64) * 1.0,
+    ]).astype(np.float32)
+    dist = dr_tpu.block_distribution(sizes)
+    v = dr_tpu.distributed_vector(n, np.float32, distribution=dist)
+    v.assign_array(src)
+    assert not dr_tpu.is_sorted(v)
 
 
 def test_sort_by_key_random():
