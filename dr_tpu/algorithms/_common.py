@@ -10,8 +10,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["layout_geometry", "owned_window_mask", "uniform_layout",
-           "double_buffered_loop", "combine_for", "MONOID_COMBINE",
-           "f32_accumulable", "on_tpu"]
+           "working_geometry", "double_buffered_loop", "combine_for",
+           "MONOID_COMBINE", "f32_accumulable", "on_tpu"]
 
 
 def f32_accumulable(dtype) -> bool:
@@ -88,6 +88,18 @@ def layout_geometry(layout):
         cap = seg
         starts = np.arange(nshards, dtype=np.int64) * seg
     return nshards, cap, prev, nxt, n, starts, sizes
+
+
+def working_geometry(layout):
+    """(p, S, cap, prev, nxt, n, starts, sizes) with S = the max OWNED
+    width — the working row width for geometry-general shard programs
+    (sort, scan).  ``cap`` additionally absorbs halo widths; the
+    physical row is ``prev + cap + nxt`` with ``cap >= S``, so slicing
+    ``[prev, prev + S)`` always stays in range and covers every real
+    cell of every shard."""
+    p, cap, prev, nxt, n, starts, sizes = layout_geometry(layout)
+    S = max(int(sizes.max(initial=0)), 1)
+    return p, S, cap, prev, nxt, n, starts, sizes
 
 
 def owned_window_mask(layout, off, n):

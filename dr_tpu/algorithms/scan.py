@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import combine_for, uniform_layout
+from ._common import combine_for, uniform_layout, working_geometry
 from .elementwise import _op_key, _out_chain, _prog_cache, _resolve, _write_window
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
@@ -100,6 +100,8 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
         return False
     from ..ops import scan_pallas
     from ._common import f32_accumulable, on_tpu
+    if not uniform_layout(layout):  # the kernel tiles uniform rows only
+        return False
     nshards, seg, prev, nxt, n = layout
     if not f32_accumulable(in_dtype):
         return False
@@ -129,19 +131,26 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     if prog is not None:
         return prog
 
-    nshards, seg, prev, nxt, n = layout
+    nshards, S, cap, prev, nxt, n, starts, sizes = \
+        working_geometry(layout)
     combine = combine_for(kind, op)
+    starts_c = jnp.asarray(starts, jnp.int32)
+    sizes_c = jnp.asarray(sizes, jnp.int32)
+    # pad cells exist when the ceil layout overshoots n OR any shard of
+    # an uneven distribution is narrower than the working width: skip
+    # the masking pass (a whole extra HBM read-modify) when exact.
+    # Uneven layouts with pads REQUIRE an identity to mask with — the
+    # caller gates unclassified ops to the fallback there.
+    exact = bool((sizes == S).all()) and nshards * S == n
 
     def body(blk):  # (1, width) one shard row
         ident = _identity_for(kind, dtype) if kind is not None else None
-        x = blk[0, prev:prev + seg]
+        x = blk[0, prev:prev + S]
         r = lax.axis_index(axis)
-        # pad cells exist only when the ceil layout overshoots n: skip
-        # the masking pass (a whole extra HBM read-modify) when exact
-        exact = nshards * seg == n
         if ident is not None and not exact:
-            gid = r * seg + jnp.arange(seg)
-            x = jnp.where(gid < n, x, ident)
+            nvalid = jnp.minimum(sizes_c[r],
+                                 jnp.clip(n - starts_c[r], 0, S))
+            x = jnp.where(jnp.arange(S) < nvalid, x, ident)
         if use_kernel:
             # carry-seeded kernel: compute each shard's TOTAL first (a
             # cheap reduction read), fold the preceding totals, and
@@ -165,20 +174,41 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             local = _blocked_scan(combine, x,
                                   ident if kind is not None else None,
                                   kind)
+            # pads are masked to the identity, so position S-1 carries
+            # each shard's REAL total even when the shard is narrower
+            # than the working width (or empty)
             totals = lax.all_gather(local[-1], axis)      # (nshards,)
             # exclusive fold of totals from ranks < r  ->  my carry
             if ident is not None:
                 masked = jnp.where(jnp.arange(nshards) < r, totals,
                                    ident)
                 carry = lax.associative_scan(combine, masked)[-1]
-                scanned = jnp.where(r > 0, combine(carry, local), local)
+                if exclusive:
+                    # seed locally instead of via ppermute: out[j] =
+                    # carry ∘ (ident, local[0], ..., local[j-1]) — the
+                    # same values, one fewer collective, and correct
+                    # across EMPTY shards (the carry already folds
+                    # every preceding shard's total; for r = 0 it IS
+                    # the identity, so the fold is unconditional)
+                    local = jnp.concatenate(
+                        [jnp.full((1,), ident, local.dtype),
+                         local[:-1]])
+                    scanned = combine(carry, local)
+                else:
+                    scanned = jnp.where(r > 0, combine(carry, local),
+                                        local)
             else:
                 # no identity: fold sequentially with lax.fori_loop
                 def fold(i, acc):
                     return jnp.where(i < r, combine(acc, totals[i]), acc)
                 carry = lax.fori_loop(1, nshards, fold, totals[0])
                 scanned = jnp.where(r > 0, combine(carry, local), local)
-        if exclusive:
+        if exclusive and (use_kernel or kind is None):
+            # positional shift with the previous shard's last value via
+            # ppermute — valid on uniform ceil layouts (a nonempty
+            # shard's predecessor is always full there); the
+            # identity-bearing XLA path above seeds locally instead,
+            # and uneven layouts without an identity take the fallback
             shifted = jnp.roll(scanned, 1)
             prev_rank_last = lax.ppermute(
                 scanned[-1], axis,
@@ -186,12 +216,12 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             first = prev_rank_last if ident is None else \
                 jnp.where(r > 0, prev_rank_last, ident)
             scanned = shifted.at[0].set(first)
-        if prev == 0 and nxt == 0:
+        if prev == 0 and nxt == 0 and cap == S:
             # halo-free row: the scan IS the whole padded row — no
             # zeros+set copy pass (one fewer HBM pass on the hot path)
             return scanned.astype(dtype)[None]
-        out = jnp.zeros((1, prev + seg + nxt), dtype)
-        return out.at[0, prev:prev + seg].set(scanned.astype(dtype))
+        out = jnp.zeros((1, prev + cap + nxt), dtype)
+        return out.at[0, prev:prev + S].set(scanned.astype(dtype))
 
     # check_vma=False only for the kernel path: pallas outputs carry no
     # varying-mesh-axis metadata
@@ -213,9 +243,11 @@ def _scan(in_r, out, op, init, exclusive):
         ins is not None and len(ins) == 1 and not ins[0].ops
         and ins[0].off == 0 and out_chain.off == 0
         and ins[0].cont.layout == out_chain.cont.layout
-        # the shard_map program assumes the uniform ceil layout; uneven
-        # block distributions take the logical-array fallback below
-        and uniform_layout(ins[0].cont.layout)
+        # the shard_map program handles any uniform ceil layout, and
+        # uneven block distributions whenever the op has an identity
+        # to mask pad cells with; identityless custom ops on uneven
+        # layouts take the logical-array fallback below
+        and (uniform_layout(ins[0].cont.layout) or kind is not None)
         and ins[0].n == len(ins[0].cont)
         # the fast program rebuilds the whole output array, so the output
         # window must cover the whole container too

@@ -50,7 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import layout_geometry
+from ._common import working_geometry
 from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from ..core.pinning import pinned_id
 
@@ -93,21 +93,9 @@ def _decode(k, dtype):
     return k.astype(dtype)
 
 
-def _sort_geometry(layout):
-    """(p, S, cap, prev, nxt, n, starts, sizes) with S = the max OWNED
-    width — the working row width for the sort programs.  (The
-    geometry helper's ``cap`` also absorbs halo widths; the physical
-    row is ``prev + cap + nxt`` with ``cap >= S``, so slicing
-    ``[prev, prev + S)`` always stays in range and covers every real
-    cell.)"""
-    p, cap, prev, nxt, n, starts, sizes = layout_geometry(layout)
-    S = max(int(sizes.max(initial=0)), 1)
-    return p, S, cap, prev, nxt, n, starts, sizes
-
-
 def _pack_row(row, layout, dtype):
     """Place a working-width row back into a padded shard row."""
-    p, S, cap, prev, nxt, n, starts, sizes = _sort_geometry(layout)
+    p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
     if prev == 0 and nxt == 0 and cap == S:
         return row.astype(dtype)[None]
     out = jnp.zeros((1, prev + cap + nxt), dtype)
@@ -130,7 +118,7 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     # general geometry: uniform ceil layouts AND uneven
     # block_distributions share one program shape — S is the max owned
     # width, starts/sizes the per-shard logical windows
-    p, S, cap, prev, nxt, n, starts, sizes = _sort_geometry(layout)
+    p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
     pprev = pay_layout[2] if pay_layout else 0
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
@@ -338,7 +326,7 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned):
     if prog is not None:
         return prog
 
-    p, S, cap, prev, nxt, n, starts, sizes = _sort_geometry(layout)
+    p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
 

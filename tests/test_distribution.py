@@ -6,6 +6,7 @@ allocator/distribution test (test/gtest/mhp/distributed_vector.cpp:121-131).
 Here uneven block sizes (and zero-size "team" blocks) are first-class.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -130,6 +131,40 @@ def test_reduce_scan_on_uneven(oracle):
     s = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
     dr_tpu.inclusive_scan(a, s)
     oracle.equal(s, np.cumsum(np.arange(1, n + 1)))
+
+
+def test_scan_variants_on_uneven(oracle):
+    """The shard_map scan program on uneven layouts (round-3: no
+    longer the logical-array fallback for classified ops): inclusive
+    mul, exclusive with init, and a zero-size team shard."""
+    P = dr_tpu.nprocs()
+    n = 23
+    sizes = _uneven_sizes(n, P, seed=5)
+    src = np.random.default_rng(5).uniform(0.5, 1.5, n)\
+        .astype(np.float32)
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    a.assign_array(src)
+    s = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.inclusive_scan(a, s, op=jnp.multiply)
+    np.testing.assert_allclose(dr_tpu.to_numpy(s), np.cumprod(src),
+                               rtol=1e-4)
+    ex = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    dr_tpu.exclusive_scan(a, ex, init=10.0)
+    ref = 10.0 + np.concatenate([[0.0], np.cumsum(src)[:-1]])
+    np.testing.assert_allclose(dr_tpu.to_numpy(ex), ref, rtol=1e-4)
+    if P >= 3:
+        # an EMPTY shard in the middle: its total is the identity and
+        # the local exclusive seeding must still chain the carry across
+        tsizes = [5, 0] + list(dr_tpu.even_sizes(n - 5, P - 2))
+        at = dr_tpu.distributed_vector(n, np.float32,
+                                       distribution=tsizes)
+        at.assign_array(src)
+        st = dr_tpu.distributed_vector(n, np.float32,
+                                       distribution=tsizes)
+        dr_tpu.exclusive_scan(at, st, init=0.0)
+        np.testing.assert_allclose(
+            dr_tpu.to_numpy(st),
+            np.concatenate([[0.0], np.cumsum(src)[:-1]]), rtol=1e-4)
 
 
 def test_get_put_on_uneven():
