@@ -154,6 +154,25 @@ np.testing.assert_allclose(
     dr_tpu.to_numpy(srt_v),
     srt_pay[np.argsort(srt_src, kind="stable")], rtol=0, atol=0)
 
+# uneven block distribution ACROSS PROCESSES (one shard per process,
+# different sizes): scan and sort run their native geometry-general
+# programs over the DCN mesh
+usizes = [3 + 2 * r for r in range(nproc)]
+un = sum(usizes)
+usrc = np.random.default_rng(17).standard_normal(un).astype(np.float32)
+ud = dr_tpu.distributed_vector(un, dtype=np.float32,
+                               distribution=usizes)
+ud.assign_array(usrc)
+us = dr_tpu.distributed_vector(un, dtype=np.float32,
+                               distribution=usizes)
+dr_tpu.inclusive_scan(ud, us)
+np.testing.assert_allclose(dr_tpu.to_numpy(us), np.cumsum(usrc),
+                           rtol=1e-4)
+dr_tpu.sort(ud)
+np.testing.assert_allclose(dr_tpu.to_numpy(ud), np.sort(usrc),
+                           rtol=0, atol=0)
+assert dr_tpu.is_sorted(ud)
+
 # 2-D matrix op across processes: mdarray transpose (all-to-all route)
 src2 = np.arange(4 * nproc * 8, dtype=np.float32).reshape(4 * nproc, 8)
 M = dr_tpu.distributed_mdarray.from_array(src2)

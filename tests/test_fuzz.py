@@ -137,7 +137,7 @@ def test_fuzz_distributions(seed):
         src = rng.standard_normal(n).astype(np.float32)
         dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
         alg = rng.choice(["roundtrip", "transform", "reduce", "scan",
-                          "putget", "axpy"])
+                          "sort", "putget", "axpy"])
         if alg == "roundtrip":
             np.testing.assert_allclose(dr_tpu.to_numpy(dv), src,
                                        rtol=1e-6)
@@ -162,6 +162,12 @@ def test_fuzz_distributions(seed):
             np.testing.assert_allclose(dr_tpu.to_numpy(out),
                                        np.cumsum(src, dtype=np.float32),
                                        rtol=1e-3, atol=1e-4)
+        elif alg == "sort":
+            # sample sort over the random (team-bearing) distribution
+            dr_tpu.sort(dv)
+            np.testing.assert_array_equal(dr_tpu.to_numpy(dv),
+                                          np.sort(src))
+            assert dr_tpu.is_sorted(dv)
         elif alg == "axpy":
             # traced scalar over an uneven distribution: same-layout zip
             p_src = rng.standard_normal(n).astype(np.float32)
