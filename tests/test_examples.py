@@ -100,6 +100,7 @@ def test_marginal_fast_path_no_widening(monkeypatch):
     ("inclusive_scan_example", ["-n", "4096"]),
     ("sort_example", ["-n", "4096"]),
     ("sort_example", ["-n", "4097", "--descending"]),
+    ("top_k", ["-n", "4099", "-k", "5"]),
     ("views_example", []),
 ])
 def test_example_smoke(mod, argv, monkeypatch, capsys):
