@@ -32,6 +32,7 @@ every other int is structural and compared verbatim.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from contextlib import contextmanager
 from typing import List, Optional
 
@@ -93,20 +94,57 @@ def _canon(x) -> str:
     return repr(x)
 
 
-class TappedCache(dict):
-    """Program-cache dict whose lookups double as the guard's dispatch
-    tap: every algorithm dispatch does a ``get``/``setdefault`` on its
+def _cache_cap() -> int:
+    """Per-cache entry bound (``DR_TPU_PROG_CACHE_CAP``, default 512).
+
+    Compiled executables pin JIT code for the process lifetime; an
+    unbounded cache let a 400-iteration fuzz run segfault XLA's CPU
+    compiler after a few thousand live programs (the compile itself
+    crashed, not our code).  Normal workloads reuse a handful of
+    layouts and never approach the bound."""
+    from .env import env_int
+    return env_int("DR_TPU_PROG_CACHE_CAP", 512, floor=8)
+
+
+class TappedCache(OrderedDict):
+    """Program-cache whose lookups double as the guard's dispatch tap:
+    every algorithm dispatch does a ``get``/``setdefault`` on its
     module's cache FIRST (hit or miss), so converting a module cache to
-    a TappedCache puts its dispatches on the verified trace.  No-op
-    overhead when no guard is active."""
+    a TappedCache puts its dispatches on the verified trace.  The tap
+    itself is a no-op when no guard is active; the LRU bookkeeping
+    below costs one extra dict operation per dispatch — noise next to
+    a program launch.
+
+    Also a bounded LRU (:func:`_cache_cap`): hits refresh recency and
+    inserts evict the oldest entries.  Eviction is DETERMINISTIC given
+    the dispatch sequence, so SPMD processes running the same program
+    order evict identically — the guard's own invariant keeps the
+    caches coherent across the mesh."""
 
     def get(self, key, default=None):
         record(key)
+        try:
+            self.move_to_end(key)  # hit-refresh in ONE lookup
+        except KeyError:
+            pass
         return super().get(key, default)
 
     def setdefault(self, key, default=None):
         record(key)
-        return super().setdefault(key, default)
+        val = super().setdefault(key, default)
+        self.move_to_end(key)
+        self._evict()
+        return val
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        self._evict()
+
+    def _evict(self) -> None:
+        cap = _cache_cap()
+        while len(self) > cap:
+            self.popitem(last=False)
 
 
 class SpmdGuard:

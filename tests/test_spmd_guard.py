@@ -142,3 +142,33 @@ def test_lambda_code_identity_diverges():
     # while EQUAL source in the same position canonicalizes stably
     h1 = lambda x: x * 2  # noqa: E731
     assert spmd_guard._canon(("t", pinned_id(h1))) == cf
+
+
+def test_tapped_cache_lru_bound(monkeypatch):
+    """The program caches are bounded LRUs: inserts beyond the cap
+    evict the OLDEST entry, and a get refreshes recency (a hot program
+    survives a stream of one-shot layouts)."""
+    from dr_tpu.utils.spmd_guard import TappedCache
+
+    monkeypatch.setenv("DR_TPU_PROG_CACHE_CAP", "8")
+    c = TappedCache()
+    for i in range(8):
+        c[("k", i)] = i
+    assert len(c) == 8
+    # touch the oldest: it must survive the next insert
+    assert c.get(("k", 0)) == 0
+    c[("k", 8)] = 8
+    assert len(c) == 8
+    assert c.get(("k", 0)) == 0          # refreshed -> kept
+    assert c.get(("k", 1)) is None       # the true oldest -> evicted
+    # setdefault counts as a touch too
+    c.setdefault(("k", 2), None)
+    for i in range(9, 15):
+        c[("k", i)] = i
+    assert c.get(("k", 2)) is not None
+    # malformed cap falls back to the default 512 (tolerant knob
+    # parsing): the 9th insert must NOT evict under the fallback
+    monkeypatch.setenv("DR_TPU_PROG_CACHE_CAP", "lots")
+    before = len(c)
+    c[("k", 99)] = 99
+    assert len(c) == before + 1
