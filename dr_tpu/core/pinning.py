@@ -4,16 +4,30 @@ Compiled-program caches throughout the package key on the identity of
 Python objects (user callables, the runtime mesh).  A raw ``id()`` is only
 stable while the object lives: once collected, the id can be recycled by a
 later allocation, silently aliasing a different object's cache entry.
-``pinned_id`` returns the id AND pins the object for the process lifetime,
-so a key can never be recycled — independent of whether the cached
-artifact happens to retain the object (jitted closures do today;
-AOT-compiled entries would not).
+``pinned_id`` returns the id AND pins the object, so a live cache key can
+never be recycled — independent of whether the cached artifact happens to
+retain the object (jitted closures do today; AOT-compiled entries would
+not).
 
-Growth is bounded by the number of distinct pinned objects, the same
-envelope as the program caches themselves (which never evict).
+Pins are a bounded LRU like the program caches themselves
+(``DR_TPU_PIN_CAP``, default 65536 — two orders of magnitude above the
+worst-case number of identities referenced by all live cache entries at
+the default cache caps).  Touch discipline: every dispatch rebuilds its
+key through ``pinned_id``, so a hot object's pin is always recent.
+Soundness does NOT rely on the cap though: when a pin IS evicted, every
+registered program cache drops the entries whose keys reference that
+identity (``register_cache``), so a recycled id can never alias a stale
+program — the evicted object's programs simply recompile if it ever
+comes back.
 """
 
-_pins: dict = {}
+import weakref
+from collections import OrderedDict
+
+from ..utils.env import env_int
+
+_pins: "OrderedDict[int, object]" = OrderedDict()
+_caches: list = []  # weakref.ref of registered program caches
 
 
 class PinnedId(int):
@@ -26,9 +40,44 @@ class PinnedId(int):
     __slots__ = ()
 
 
+def register_cache(cache) -> None:
+    """Program caches register so pin eviction can purge the entries
+    that reference the evicted identity (utils/spmd_guard.TappedCache
+    does this on construction).  Held by weakref: dict subclasses are
+    unhashable, so a WeakSet cannot hold them — a ref list can."""
+    _caches.append(weakref.ref(cache))
+
+
+def _key_mentions(key, ident: int) -> bool:
+    if isinstance(key, PinnedId):
+        return int(key) == ident
+    if isinstance(key, (tuple, list, frozenset)):
+        return any(_key_mentions(part, ident) for part in key)
+    return False
+
+
+def _purge(ident: int) -> None:
+    live = []
+    for ref in _caches:
+        cache = ref()
+        if cache is None:
+            continue  # cache itself was collected; drop the ref
+        live.append(ref)
+        stale = [k for k in cache if _key_mentions(k, ident)]
+        for k in stale:
+            del cache[k]
+    _caches[:] = live
+
+
 def pinned_id(obj):
     """Stable identity key for ``obj`` (None passes through)."""
     if obj is None:
         return None
-    _pins.setdefault(id(obj), obj)
-    return PinnedId(id(obj))
+    i = id(obj)
+    _pins[i] = obj          # insert or refresh
+    _pins.move_to_end(i)
+    cap = env_int("DR_TPU_PIN_CAP", 65536, floor=1024)
+    while len(_pins) > cap:
+        old, _ = _pins.popitem(last=False)
+        _purge(old)
+    return PinnedId(i)

@@ -119,7 +119,15 @@ class TappedCache(OrderedDict):
     inserts evict the oldest entries.  Eviction is DETERMINISTIC given
     the dispatch sequence, so SPMD processes running the same program
     order evict identically — the guard's own invariant keeps the
-    caches coherent across the mesh."""
+    caches coherent across the mesh.  Instances register with
+    ``core.pinning`` so that when a PIN is evicted, the entries whose
+    keys reference that identity are purged here (id-reuse soundness,
+    see pinning's module docstring)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from ..core.pinning import register_cache
+        register_cache(self)
 
     def get(self, key, default=None):
         record(key)

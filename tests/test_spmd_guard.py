@@ -172,3 +172,35 @@ def test_tapped_cache_lru_bound(monkeypatch):
     before = len(c)
     c[("k", 99)] = 99
     assert len(c) == before + 1
+
+
+def test_pin_eviction_purges_cache_entries(monkeypatch):
+    """Pins are a bounded LRU; evicting a pin purges every cache entry
+    whose key references that identity, so a later id reuse can never
+    alias a stale program (core/pinning.py docstring)."""
+    from collections import OrderedDict
+
+    from dr_tpu.core import pinning
+    from dr_tpu.utils.spmd_guard import TappedCache
+
+    # isolate: fresh pin table so ambient pins are untouched (their
+    # objects stay alive, so their ids cannot collide with ours)
+    monkeypatch.setattr(pinning, "_pins", OrderedDict())
+    monkeypatch.setenv("DR_TPU_PIN_CAP", "1024")
+
+    c = TappedCache()
+    keep = [object() for _ in range(1025)]
+    pid0 = pinning.pinned_id(keep[0])
+    c[("prog", pid0, 7)] = "compiled"
+    c[("prog", "no-pin", 8)] = "other"
+    assert c.get(("prog", pid0, 7)) == "compiled"
+    for o in keep[1:]:
+        pinning.pinned_id(o)
+    # keep[0]'s pin was the oldest -> evicted -> its entry purged;
+    # unrelated entries survive
+    assert ("prog", pid0, 7) not in c
+    assert c.get(("prog", "no-pin", 8)) == "other"
+    # re-pinning the SAME object compiles fresh (no stale alias)
+    pid0b = pinning.pinned_id(keep[0])
+    assert int(pid0b) == int(pid0)
+    assert c.get(("prog", pid0b, 7)) is None
