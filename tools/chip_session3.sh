@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 on-chip session: the ROUND3_NOTES queue in priority order.
+# Discipline (docs/ROUND3_NOTES.md, memory: the claim path wedges after
+# some number of claims per VM session and only a relay restart brings
+# it back): bench FIRST, everything after ~5 claims is best-effort; one
+# TPU process at a time, clean exits, 5-minute claim gaps.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[chip_session3 $(date +%H:%M:%S)] $*"; }
+
+log "1/4 bench.py (the BENCH artifact; dot should now show ~760 GB/s)"
+python -u bench.py > tools/bench_r4_dev.json 2> tools/bench_r4_dev.err
+log "bench exit=$? $(tail -c 300 tools/bench_r4_dev.json)"
+sleep 300
+
+log "2/4 stencil at DEFAULT precision (phys bar >= 200 GB/s)"
+DR_TPU_MM_PRECISION=default python -u tools/tune_tpu.py stencil \
+  > tools/tune_stencil_default.log 2>&1
+log "stencil-default exit=$?"
+sleep 300
+
+log "3/4 physbw (VPU blocked kernel at T=1-8: the pure-DMA ceiling)"
+python -u tools/tune_tpu.py physbw > tools/tune_physbw.log 2>&1
+log "physbw exit=$?"
+sleep 300
+
+log "4/4 attn (regenerate the lost resident bq/bk + streaming log)"
+python -u tools/tune_tpu.py attn > tools/tune_attn.log 2>&1
+log "attn exit=$?"
+log "session complete — COMMIT THE LOGS IMMEDIATELY (uncommitted sweep"
+log "logs died with the VM twice this round)"
