@@ -61,7 +61,7 @@ _NAN_KEY = np.uint32(0xFFFFFFFE)  # after +inf (numpy sorts NaNs last)
 _PAD_KEY = np.uint32(0xFFFFFFFF)  # strictly after every real key
 
 
-def _encode(x):
+def _encode(x, distinct_zeros=False):
     """Monotone total-order sort key.
 
     Floats map through the IEEE sign-flip trick to ``uint32`` (bf16/f16
@@ -70,15 +70,22 @@ def _encode(x):
     sentinel, so the positional validity mask stays exact even for NaN
     data.  Integers are their own keys (the pad sentinel is the dtype
     max; real values equal to it merely tie with padding, and ties
-    among equals cannot change the sorted output)."""
+    among equals cannot change the sorted output).
+
+    ``distinct_zeros``: the sign-flip trick already orders -0.0
+    (0x7FFFFFFF) just before +0.0 (0x80000000) — a valid sort order
+    that round-trips the zero's sign through :func:`_decode`.  Keys-
+    only ``sort()`` uses it so the output is a bit-exact permutation of
+    the input.  Default OFF collapses both zeros to ONE key so they
+    tie: ``sort_by_key`` needs IEEE-equal keys to keep numpy-stable
+    tie order, and ``is_sorted`` must not report ``[0.0, -0.0]`` as
+    unsorted."""
     if jnp.issubdtype(x.dtype, jnp.floating):
         b = jax.lax.bitcast_convert_type(x.astype(jnp.float32),
                                          jnp.uint32)
         k = jnp.where(b >> 31 == 1, ~b, b | jnp.uint32(0x80000000))
-        # -0.0 and +0.0 are IEEE-equal: give them ONE key so they tie
-        # (numpy-stable semantics); the decoded value is +0.0 — the
-        # zero's sign is canonicalized like a NaN's payload
-        k = jnp.where(x == 0, jnp.uint32(0x80000000), k)
+        if not distinct_zeros:
+            k = jnp.where(x == 0, jnp.uint32(0x80000000), k)
         return jnp.where(jnp.isnan(x), _NAN_KEY, k), _PAD_KEY
     return x, jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
 
@@ -126,7 +133,11 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     GMAX = np.int32(np.iinfo(np.int32).max)
 
     def body(blk, *pay):  # padded shard rows: keys (+ payload)
-        key, big = _encode(blk[0, prev:prev + S])
+        # keys-only sort is a bit-exact permutation (distinct -0.0/+0.0
+        # keys); key-value sort collapses the zeros so ties keep
+        # numpy-stable original order
+        key, big = _encode(blk[0, prev:prev + S],
+                           distinct_zeros=not pay)
         r = lax.axis_index(axis)
         nvalid = jnp.minimum(sizes_c[r],
                              jnp.clip(n - starts_c[r], 0, S))

@@ -54,9 +54,10 @@ def use_dot_kernel() -> bool:
         return False
     import warnings
     warnings.warn(f"DR_TPU_DOT_IMPL={val!r} not recognized "
-                  "(expected 'pallas' or 'xla'); using the default "
-                  "Pallas kernel", stacklevel=2)
-    return True
+                  "(expected 'pallas' or 'xla'); failing CLOSED to the "
+                  "XLA path — anyone setting the variable is most "
+                  "likely opting out of the kernel", stacklevel=2)
+    return False
 
 
 @functools.lru_cache(maxsize=16)

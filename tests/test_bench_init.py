@@ -1,0 +1,109 @@
+"""Device-init hardening of the driver benchmark (bench.py).
+
+A wedged tunnel relay makes the first backend touch hang or fail; the
+bench must (1) retry ONCE in a fresh process after a cool-down — round-3
+probe tallies showed single claims failing where a later one landed
+instantly — and (2) fall back to a tagged CPU run only after the retry
+also fails, so the driver always records a number.  The re-execs are
+``os.execve`` (a hung probe thread blocks the singleton PJRT init lock,
+so an in-process retry would just join the hang); here they are
+monkeypatched so the chain is testable in-process on CPU.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod
+
+
+class _Exec(Exception):
+    def __init__(self, argv, env):
+        self.argv, self.env = argv, env
+
+
+def _arm(monkeypatch, bench, probe_result):
+    from dr_tpu.parallel import runtime
+    monkeypatch.setattr(runtime, "probe_devices",
+                        lambda t: probe_result)
+
+    def fake_execve(path, argv, env):
+        raise _Exec(argv, env)
+    monkeypatch.setattr(bench.os, "execve", fake_execve)
+
+
+def test_probe_success_no_exec(monkeypatch, bench):
+    _arm(monkeypatch, bench, (["dev0"], None))
+    assert bench._devices_or_die(1.0) == ["dev0"]
+
+
+def test_first_failure_re_execs_with_retry_flag(monkeypatch, bench):
+    monkeypatch.delenv("_DR_TPU_BENCH_RETRY", raising=False)
+    monkeypatch.delenv("_DR_TPU_BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.setattr(bench, "_relay_listening", lambda: True)
+    _arm(monkeypatch, bench, (None, "UNAVAILABLE: boom"))
+    with pytest.raises(_Exec) as ei:
+        bench._devices_or_die(1.0)
+    env = ei.value.env
+    assert env["_DR_TPU_BENCH_RETRY"] == "1"
+    assert env["_DR_TPU_BENCH_FIRST_ERR"] == "UNAVAILABLE: boom"
+    # still aimed at the TPU: no CPU fallback markers yet
+    assert "_DR_TPU_BENCH_CPU_FALLBACK" not in env
+    assert "_DR_TPU_BENCH_DEGRADED" not in env
+
+
+def test_relay_down_skips_retry(monkeypatch, bench):
+    """A dead relay (TCP connect refused) cannot serve a second claim:
+    go straight to the CPU fallback instead of paying the cool-down +
+    retry tax during an outage."""
+    monkeypatch.delenv("_DR_TPU_BENCH_RETRY", raising=False)
+    monkeypatch.delenv("_DR_TPU_BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.setattr(bench, "_relay_listening", lambda: False)
+    _arm(monkeypatch, bench, (None, "UNAVAILABLE: boom"))
+    with pytest.raises(_Exec) as ei:
+        bench._devices_or_die(1.0)
+    env = ei.value.env
+    assert env["_DR_TPU_BENCH_CPU_FALLBACK"] == "1"
+    assert "_DR_TPU_BENCH_RETRY" not in env
+    assert "retry skipped" in env["_DR_TPU_BENCH_DEGRADED"]
+
+
+def test_retry_failure_falls_back_to_cpu(monkeypatch, bench):
+    monkeypatch.setenv("_DR_TPU_BENCH_RETRY", "1")
+    monkeypatch.setenv("_DR_TPU_BENCH_FIRST_ERR", "UNAVAILABLE: first")
+    monkeypatch.delenv("_DR_TPU_BENCH_CPU_FALLBACK", raising=False)
+    _arm(monkeypatch, bench, (None, "UNAVAILABLE: second"))
+    with pytest.raises(_Exec) as ei:
+        bench._devices_or_die(1.0)
+    env = ei.value.env
+    assert env["_DR_TPU_BENCH_CPU_FALLBACK"] == "1"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    # degraded message keeps both causes for the artifact
+    assert "UNAVAILABLE: second" in env["_DR_TPU_BENCH_DEGRADED"]
+    assert "UNAVAILABLE: first" in env["_DR_TPU_BENCH_DEGRADED"]
+
+
+def test_retry_success_returns_devices(monkeypatch, bench):
+    monkeypatch.setenv("_DR_TPU_BENCH_RETRY", "1")
+    monkeypatch.setenv("DR_TPU_BENCH_RETRY_TIMEOUT", "33")
+    seen = {}
+    from dr_tpu.parallel import runtime
+
+    def probe(t):
+        seen["timeout"] = t
+        return ["dev0"], None
+    monkeypatch.setattr(runtime, "probe_devices", probe)
+    assert bench._devices_or_die(420.0) == ["dev0"]
+    # the retry leg honors its own (shorter) timeout budget
+    assert seen["timeout"] == 33.0

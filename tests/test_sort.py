@@ -70,6 +70,20 @@ def test_sort_nan_and_negzero():
     np.testing.assert_array_equal(got_d, ref[::-1])
 
 
+def test_sort_is_bit_exact_permutation():
+    """Keys-only sort() preserves VALUES bit-exactly: -0.0 stays -0.0
+    (distinct zero keys in the encoding — advisor r3), so 1/x on a
+    sorted zero keeps its sign.  Both zeros compare equal, so the only
+    valid placement question is the zeros' order: -0.0 first."""
+    src = np.array([0.0, 3.0, -0.0, -1.0, 0.0, -0.0], dtype=np.float32)
+    got = _roundtrip(src)
+    np.testing.assert_array_equal(got, np.sort(src))  # IEEE-equal view
+    # bit-level: [-1.0, -0.0, -0.0, 0.0, 0.0, 3.0] — the two -0.0s
+    # survived, ordered before the +0.0s
+    assert np.array_equal(np.signbit(got),
+                          [True, True, True, False, False, False])
+
+
 def test_sort_adversarial_distributions():
     """Skew that breaks naive splitter choices: constant arrays, already
     sorted, reverse sorted, one-hot — balance may suffer, correctness
