@@ -81,6 +81,27 @@ def pick_blocks(s: int, skv: int, d: int):
     return bq, bk
 
 
+def causal_computed_flops(s: int, skv: int, d: int, bq: int, bk: int,
+                          q_off: int = 0, k_off: int = 0) -> int:
+    """EXACT matmul flops both kernels execute for one causal update of
+    a ``s``-long q shard against a ``skv``-long K/V block, per (B*h)
+    slice — block-granular: a (bq, bk) cell runs fully when any of its
+    rows can attend (diagonal cells overshoot the ideal triangle).
+    Both kernels share the skip rule ``k_lo <= q_lo + bq - 1`` (resident
+    ``hi`` bound / streaming ``pl.when``), so one counter serves both.
+    Honest utilization for the tuning sweeps: ideal-triangle "effective"
+    figures divide by ~half this, which is how a >100%-of-peak number
+    can appear even with exact timing (docs/PERF.md round-4 note)."""
+    nk = skv // bk
+    cells = 0
+    for iq in range(s // bq):
+        q_hi = q_off + iq * bq + bq - 1     # last q row of the tile
+        if q_hi < k_off:
+            continue
+        cells += min(nk, (q_hi - k_off) // bk + 1)
+    return cells * 2 * 2 * bq * bk * d      # two MXU matmuls per cell
+
+
 def _block_update(qv, kblk, vblk, m, l, acc, scale, causal, q_lo, k_lo):
     """One online-softmax update of (m, l, acc) against a K/V tile —
     the shared core of the resident and streaming kernels (a numerical

@@ -93,23 +93,28 @@ def _ring_perms(nshards: int, periodic: bool):
     return fwd, bwd
 
 
-def _exchange_body(axis, nshards, seg, prev, nxt, periodic, n):
-    """Shard-local exchange body (one padded row in, one out).
+def _uniform_valid(nshards, seg, n) -> bool:
+    """True when every shard's valid width equals ``seg`` (aligned sizes
+    and the single-shard case).  Then ``valid`` is a PYTHON int and every
+    edge slice and ghost write gets a STATIC offset XLA can fold/fuse;
+    only a ragged tail pays per-shard dynamic offsets.
+    ``DR_TPU_HALO_DYNAMIC=1`` forces the dynamic-offset path for A/B
+    measurement (tools/tune_tpu.py halo)."""
+    import os
+    if os.environ.get("DR_TPU_HALO_DYNAMIC", "") == "1":
+        return False
+    return n - (nshards - 1) * seg == seg
 
-    The last shard may be logically short (pad-and-mask layout); its valid
-    tail is ``n - (nshards-1)*seg``, so edge sends slice at a per-shard
-    dynamic offset instead of assuming a full segment.
-    """
+
+def _ghost_updates(axis, nshards, prev, nxt, periodic):
+    """Per-round ghost computation shared by exchange and exchange_n:
+    read the owned edges of ``blk``, ship them over the ring, combine
+    with the OLD ghost values (kept on non-periodic edge shards).
+    Returns ``(new_p, new_n)``; either is None when that width is 0."""
     fwd, bwd = _ring_perms(nshards, periodic)
-    tail = n - (nshards - 1) * seg
 
-    def body(blk):  # blk: (1, prev + seg + nxt) — one shard row
+    def compute(blk, valid, old_p, old_n):
         idx = lax.axis_index(axis)
-        valid = jnp.where(idx == nshards - 1, tail, seg)
-        # ALL reads of the row happen before any write: with disjoint
-        # live ranges XLA can update the (fori_loop-carried) row in
-        # place instead of copying it per round — the copies, not the
-        # ghost traffic, dominated the measured exchange latency
         new_p = new_n = None
         if prev:
             # last `prev` VALID owned cells -> next rank's ghost_prev
@@ -118,25 +123,69 @@ def _exchange_body(axis, nshards, seg, prev, nxt, periodic, n):
             recv = lax.ppermute(send, axis, fwd)
             got = jnp.bool_(periodic) if (periodic or nshards == 1) \
                 else idx > 0
-            new_p = jnp.where(got, recv, blk[:, :prev])
+            new_p = jnp.where(got, recv, old_p)
         if nxt:
-            # first `nxt` owned cells -> prev rank's ghost_next, written
-            # IMMEDIATELY after the receiver's valid tail so every local row
-            # is contiguous [ghost_prev | valid owned | ghost_next] even on
-            # a short last shard
+            # first `nxt` owned cells -> prev rank's ghost_next, stored
+            # IMMEDIATELY after the receiver's valid tail so every local
+            # row is contiguous [ghost_prev | valid owned | ghost_next]
+            # even on a short last shard
             send = blk[:, prev: prev + nxt]
             recv = lax.ppermute(send, axis, bwd)
             got = jnp.bool_(periodic) if (periodic or nshards == 1) \
                 else idx < nshards - 1
-            old = lax.dynamic_slice_in_dim(blk, prev + valid, nxt, axis=1)
-            new_n = jnp.where(got, recv, old)
-        new = blk
-        if new_p is not None:
-            new = new.at[:, :prev].set(new_p)
-        if new_n is not None:
-            new = lax.dynamic_update_slice_in_dim(new, new_n, prev + valid,
-                                                  axis=1)
-        return new
+            new_n = jnp.where(got, recv, old_n)
+        return new_p, new_n
+
+    return compute
+
+
+def _row_valid(axis, nshards, seg, n):
+    """Per-shard valid width: a PYTHON int on uniform layouts (static
+    offsets everywhere), else traced from the shard index."""
+    tail = n - (nshards - 1) * seg
+    if _uniform_valid(nshards, seg, n):
+        return lambda: seg
+    return lambda: jnp.where(lax.axis_index(axis) == nshards - 1,
+                             tail, seg)
+
+
+def _ghost_reads(blk, valid, prev, nxt):
+    """Current ghost regions of a shard row: (old_p, old_n); either is
+    None when that width is 0.  ghost_next sits right after the valid
+    tail (contiguous short-shard layout)."""
+    old_p = blk[:, :prev] if prev else None
+    old_n = lax.dynamic_slice_in_dim(blk, prev + valid, nxt, axis=1) \
+        if nxt else None
+    return old_p, old_n
+
+
+def _ghost_writeback(blk, valid, prev, nxt, new_p, new_n):
+    """Write updated ghost regions back into a shard row."""
+    new = blk
+    if new_p is not None:
+        new = new.at[:, :prev].set(new_p)
+    if new_n is not None:
+        new = lax.dynamic_update_slice_in_dim(new, new_n, prev + valid,
+                                              axis=1)
+    return new
+
+
+def _exchange_body(axis, nshards, seg, prev, nxt, periodic, n):
+    """Shard-local exchange body (one padded row in, one out).
+
+    The last shard may be logically short (pad-and-mask layout); its valid
+    tail is ``n - (nshards-1)*seg``, so edge sends slice at a per-shard
+    dynamic offset instead of assuming a full segment.  Uniform layouts
+    (tail == seg) use static offsets throughout — see _uniform_valid.
+    """
+    valid_of = _row_valid(axis, nshards, seg, n)
+    compute = _ghost_updates(axis, nshards, prev, nxt, periodic)
+
+    def body(blk):  # blk: (1, prev + seg + nxt) — one shard row
+        valid = valid_of()
+        old_p, old_n = _ghost_reads(blk, valid, prev, nxt)
+        new_p, new_n = compute(blk, valid, old_p, old_n)
+        return _ghost_writeback(blk, valid, prev, nxt, new_p, new_n)
 
     return body
 
@@ -153,11 +202,44 @@ def _exchange_n_program(mesh, axis, nshards, seg, prev, nxt, periodic, n,
                         iters):
     """``iters`` exchanges fused into ONE program (lax.fori_loop): no host
     dispatch between rounds — the device-side latency of a single ring
-    exchange is this program's time / iters."""
-    body = _exchange_body(axis, nshards, seg, prev, nxt, periodic, n)
+    exchange is this program's time / iters.
 
-    def loop(blk):
-        return lax.fori_loop(0, iters, lambda i, x: body(x), blk)
+    The loop carries ONLY the ghost regions: an exchange never writes
+    owned cells, so each round reads the same owned edges from the
+    (closed-over) row and the full row is written ONCE after the loop.
+    The row-carried variant (``DR_TPU_HALO_NCARRY=row``, kept for A/B)
+    paid two full-row copies per round for the functional loop carry —
+    O(row) per exchange instead of O(ghost width), which dominated the
+    measured p50 (the bench halo config carries a 16 MB row for 8 KB of
+    ghost traffic).  Ghost-carry matches the reference engine's cost
+    model: it ships edge buffers, never the local array (halo.hpp:55-90).
+    """
+    import os
+    if os.environ.get("DR_TPU_HALO_NCARRY", "ghost") == "row":
+        body = _exchange_body(axis, nshards, seg, prev, nxt, periodic, n)
+
+        def loop(blk):
+            return lax.fori_loop(0, iters, lambda i, x: body(x), blk)
+    else:
+        valid_of = _row_valid(axis, nshards, seg, n)
+        compute = _ghost_updates(axis, nshards, prev, nxt, periodic)
+
+        def loop(blk):
+            valid = valid_of()
+            init = [g for g in _ghost_reads(blk, valid, prev, nxt)
+                    if g is not None]
+
+            def round_(_, carry):
+                it = iter(carry)
+                old_p = next(it) if prev else None
+                old_n = next(it) if nxt else None
+                new_p, new_n = compute(blk, valid, old_p, old_n)
+                return tuple(x for x in (new_p, new_n) if x is not None)
+
+            fin = iter(lax.fori_loop(0, iters, round_, tuple(init)))
+            return _ghost_writeback(blk, valid, prev, nxt,
+                                    next(fin) if prev else None,
+                                    next(fin) if nxt else None)
 
     shmapped = jax.shard_map(
         loop, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
@@ -168,12 +250,14 @@ def _reduce_program(mesh, axis, nshards, seg, prev, nxt, periodic, op, n):
     """Reverse path: fold ghost contributions back into their owners."""
     fwd, bwd = _ring_perms(nshards, periodic)
     tail = n - (nshards - 1) * seg
+    uniform = _uniform_valid(nshards, seg, n)
 
     def body(blk):
         S = prev + seg + nxt
         new = blk
         idx = lax.axis_index(axis)
-        valid = jnp.where(idx == nshards - 1, tail, seg)
+        valid = seg if uniform else \
+            jnp.where(idx == nshards - 1, tail, seg)
         if prev:
             # my ghost_prev mirrors rank r-1's LAST `prev` valid owned
             # cells: ship it backward and fold there.
@@ -211,8 +295,14 @@ _program_cache: dict = TappedCache()
 
 def _cached(kind, mesh, axis, nshards, seg, prev, nxt, periodic, n, op=None,
             iters=1):
+    import os
+    # the tuning knobs select a different program body: key them so
+    # in-process sweeps (tools/tune_tpu.py halo) don't reuse the other
+    # arm's cached program
+    knobs = (os.environ.get("DR_TPU_HALO_NCARRY", "ghost"),
+             os.environ.get("DR_TPU_HALO_DYNAMIC", ""))
     key = (kind, pinned_id(mesh), axis, nshards, seg, prev, nxt, periodic, n, op,
-           iters)
+           iters, knobs)
     prog = _program_cache.get(key)
     if prog is None:
         if kind == "exchange":

@@ -159,3 +159,24 @@ def test_exchange_n_matches_repeated_exchange():
         a.halo().exchange()
     b.halo().exchange_n(3)
     np.testing.assert_array_equal(np.asarray(a._data), np.asarray(b._data))
+
+
+@pytest.mark.parametrize("shape", [
+    # (n, prev, nxt, periodic): uniform, ragged tail, one-sided, both
+    (64, 2, 2, True), (61, 2, 3, False), (30, 0, 2, False),
+    (30, 2, 0, True), (29, 2, 2, False)])
+def test_exchange_n_carry_modes_agree(monkeypatch, shape):
+    """The ghost-carry fused loop (round-4 default: O(width) per round)
+    and the row-carry variant must produce identical rows — exchange
+    never writes owned cells, so carrying only the ghosts is exact."""
+    import numpy as np
+    n, prev, nxt, periodic = shape
+    hb = dr_tpu.halo_bounds(prev, nxt, periodic=periodic)
+    src = np.arange(n, dtype=np.float32) + 1
+    outs = {}
+    for carry in ("ghost", "row"):
+        monkeypatch.setenv("DR_TPU_HALO_NCARRY", carry)
+        v = dr_tpu.distributed_vector.from_array(src, halo=hb)
+        v.halo().exchange_n(4)
+        outs[carry] = np.asarray(v._data)
+    np.testing.assert_array_equal(outs["ghost"], outs["row"])

@@ -275,3 +275,28 @@ def test_flash_ring_streaming_multishard_interpret(causal, monkeypatch):
         for x in (q, k, v))
     ref = _dense_attention(qb, kb, vb, causal=causal)
     np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-3)
+
+
+def test_causal_computed_flops_exact():
+    """The block-granular flop counter matches a brute-force walk of the
+    kernels' shared skip rule, and never undercounts the ideal causal
+    triangle (so effective <= actual rate <= peak on honest timings)."""
+    from dr_tpu.ops.flash_attention import causal_computed_flops
+    for (s, skv, d, bq, bk, q_off, k_off) in [
+            (8192, 8192, 128, 2048, 1024, 0, 0),
+            (8192, 8192, 128, 1024, 2048, 0, 0),
+            (1024, 2048, 128, 256, 128, 2048, 0),   # ring: later q shard
+            (1024, 2048, 128, 256, 128, 0, 2048),   # future K block: 0
+            (512, 512, 128, 512, 512, 0, 0)]:
+        got = causal_computed_flops(s, skv, d, bq, bk, q_off, k_off)
+        cells = sum(
+            1
+            for iq in range(s // bq)
+            for ik in range(skv // bk)
+            if k_off + ik * bk <= q_off + iq * bq + bq - 1)
+        assert got == cells * 2 * 2 * bq * bk * d, (s, skv, bq, bk)
+        # ideal triangle (pairs with q_pos >= k_pos) is a lower bound
+        tri = 2 * 2 * sum(
+            min(max(q_off + i - k_off + 1, 0), skv)
+            for i in range(s)) * d
+        assert got >= tri, (got, tri)

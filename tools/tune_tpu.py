@@ -25,17 +25,16 @@ def _errline(e):
 
 
 def _marginal(run_sync, r1=2, r2=10, samples=5):
-    for r in (r1, r2):
-        run_sync(r)
-    t1s, t2s = [], []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        run_sync(r1)
-        t1s.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        run_sync(r2)
-        t2s.append(time.perf_counter() - t0)
-    return (float(np.median(t2s)) - float(np.median(t1s))) / (r2 - r1)
+    """bench._marginal: the jitter-proof variant.  The plain median
+    difference this tool used through round 3 had NO minimum-spread
+    guard, so a fast op over few rounds (16 x ~1 ms for the attn sweep)
+    measured a difference SMALLER than the tunnel's per-dispatch drift
+    (tens of ms) — that is how round-3 sweep figures exceeded the
+    chip's bf16 peak (VERDICT r3 item 7).  bench._marginal widens the
+    loop count until the delta dominates the jitter and raises
+    _JitterError instead of returning noise."""
+    from bench import _marginal as _bench_marginal
+    return _bench_marginal(run_sync, r1=r1, r2=r2, samples=samples)
 
 
 def tune_stencil():
@@ -238,18 +237,29 @@ def tune_container(name):
             rng.standard_normal((B, S, h, hd)).astype(np.float32),
             dtype=jnp.bfloat16) for _ in range(3))
 
+        from dr_tpu.ops.flash_attention import causal_computed_flops
+
         def run(r):
             res = dr_tpu.ring_attention_n(q, k, v, r, causal=True)
             float(res[0, 0, 0, 0].astype(jnp.float32))
+        # ideal causal triangle (the cross-round comparison number) AND
+        # the exact block-granular flops the kernel runs (utilization):
+        # dividing the triangle by an honest time can never exceed peak,
+        # so any figure above ~197 TFLOP/s flags a measurement bug
         fl = 2.0 * B * h * S * S * hd
+
+        def report(tag, bq, bk, dt):
+            actual = B * h * causal_computed_flops(S, S, hd, bq, bk)
+            print(f"ring attn {tag}: {fl / dt / 1e12:.1f} TFLOP/s eff "
+                  f"(ideal-causal), {actual / dt / 1e12:.1f} mxu "
+                  f"(exact computed)", flush=True)
         for bq, bk in ((2048, 1024), (1024, 1024), (2048, 512),
                        (512, 512), (1024, 2048)):
             os.environ["DR_TPU_FLASH_BQ"] = str(bq)
             os.environ["DR_TPU_FLASH_BK"] = str(bk)
             try:
                 dt = _marginal(run, 2, 18)
-                print(f"ring attn bq={bq} bk={bk}: "
-                      f"{fl / dt / 1e12:.1f} TFLOP/s", flush=True)
+                report(f"bq={bq} bk={bk}", bq, bk, dt)
             except Exception as e:
                 print(f"ring attn bq={bq} bk={bk}: FAIL "
                       f"{_errline(e)}", flush=True)
@@ -260,12 +270,47 @@ def tune_container(name):
         os.environ["DR_TPU_FLASH_STREAM"] = "1"
         try:
             dt = _marginal(run, 2, 18)
-            print(f"ring attn STREAMING: {fl / dt / 1e12:.1f} TFLOP/s",
-                  flush=True)
+            from dr_tpu.ops.flash_attention import pick_blocks
+            bq, bk = pick_blocks(S, S, hd)
+            report(f"STREAMING bq={bq} bk={bk}", bq, bk, dt)
         except Exception as e:
             print(f"ring attn STREAMING: FAIL {_errline(e)}", flush=True)
         finally:
             os.environ.pop("DR_TPU_FLASH_STREAM", None)
+    elif name == "halo":
+        # The driver metric's third term (halo p50) drifted 273 -> 462 us
+        # across rounds 1-3 on the same config; the round-4 ghost-carry
+        # exchange_n (halo.py:_exchange_n_program) removes the two
+        # full-row copies per round the row carry paid.  A/B both
+        # carries x ghost widths; bar: ghost-carry p50 <= the r1 273 us.
+        rounds = 64
+        for hw in (2, 1024):
+            n = dr_tpu.nprocs() * 2 ** 22
+            hb = dr_tpu.halo_bounds(hw, hw, periodic=True)
+            v = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+            dr_tpu.fill(v, 1.0)
+            h = v.halo()
+
+            def _sync(_=None):
+                return float(
+                    v._data.addressable_shards[0].data.reshape(-1)[0])
+
+            for carry in ("ghost", "row"):
+                os.environ["DR_TPU_HALO_NCARRY"] = carry
+
+                def run(r):
+                    h.exchange_n(rounds * r)
+                    _sync()
+                try:
+                    dt = _marginal(run, 2, 10)
+                    print(f"halo hw={hw} carry={carry}: "
+                          f"{dt / rounds * 1e6:.1f} us/exchange",
+                          flush=True)
+                except Exception as e:
+                    print(f"halo hw={hw} carry={carry}: FAIL "
+                          f"{_errline(e)}", flush=True)
+            os.environ.pop("DR_TPU_HALO_NCARRY", None)
+            v = h = None
     elif name == "spmv":
         m, half = 2 ** 15, 128
         rng = np.random.default_rng(1)
