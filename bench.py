@@ -478,6 +478,31 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
     finally:
         A = c = bv = None
 
+    # config 5a': multi-vector SpMM on the SAME random pattern as
+    # config 5.  Single-vector random SpMV is gather-ISSUE-bound at
+    # ~2.6 cycles/entry (docs/PERF.md roofline) — here each gathered
+    # slice feeds nv MACs, so aggregate GFLOP/s amortizes the bound.
+    try:
+        m = 2 ** 14 if on_cpu else 2 ** 17
+        k, nv = 32, 8
+        rng = np.random.default_rng(0)
+        rows = np.repeat(np.arange(m), k)
+        cols = rng.integers(0, m, size=m * k)
+        vals = rng.standard_normal(m * k).astype(np.float32)
+        A = dr_tpu.sparse_matrix.from_coo((m, m), rows, cols, vals)
+        import jax.numpy as jnp
+        Bm = jnp.asarray(rng.standard_normal((m, nv)).astype(np.float32))
+
+        def run_spmm(r):
+            y = dr_tpu.spmm_n(A, Bm, r)
+            float(y[0, 0])
+        dt = _marginal(run_spmm, r1=2, r2=18)
+        out["spmm8_gflops"] = round(2.0 * m * k * nv / dt / 1e9, 2)
+    except Exception as e:  # pragma: no cover - defensive
+        out["spmm_error"] = repr(e)[:160]
+    finally:
+        A = Bm = None
+
     # config 5b: block-banded SpMV — the BCSR dense-tile MXU path
     # (structured sparsity: one 128-slice gather per (8,128) tile)
     try:

@@ -62,7 +62,7 @@ from .algorithms.sort import sort, sort_by_key, argsort, is_sorted
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
                                    stencil2d_n, heat_step_weights)
-from .algorithms.gemv import gemv, gemv_n, flat_gemv, gemm
+from .algorithms.gemv import gemv, gemv_n, flat_gemv, gemm, spmm, spmm_n
 
 __version__ = "0.1.0"
 
@@ -82,7 +82,7 @@ __all__ = [
     "inclusive_scan", "exclusive_scan",
     "stencil_transform", "stencil_iterate",
     "stencil2d_transform", "stencil2d_iterate", "heat_step_weights",
-    "gemv", "flat_gemv", "gemm",
+    "gemv", "flat_gemv", "gemm", "spmm",
     "tile", "matrix_partition", "block_cyclic", "row_tiles", "factor",
     "dense_matrix", "matrix_entry", "Index2D",
     "sparse_matrix", "random_sparse_matrix",
@@ -92,5 +92,5 @@ __all__ = [
     "distributed_mdarray", "distributed_mdspan", "transpose",
     "checkpoint", "profiling", "spmd_guard",
     "ring_attention", "ring_attention_n",
-    "dot_n", "inclusive_scan_n", "gemv_n", "stencil2d_n",
+    "dot_n", "inclusive_scan_n", "gemv_n", "spmm_n", "stencil2d_n",
 ]

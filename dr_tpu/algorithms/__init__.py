@@ -9,4 +9,4 @@ from .stencil import (stencil_transform, stencil_iterate,
                       stencil_iterate_matmul)
 from .stencil2d import stencil2d_transform, stencil2d_iterate, \
     heat_step_weights
-from .gemv import gemv, flat_gemv, gemm
+from .gemv import gemv, flat_gemv, gemm, spmm

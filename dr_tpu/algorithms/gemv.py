@@ -31,7 +31,7 @@ from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix
 from ..containers.sparse_matrix import sparse_matrix
 
-__all__ = ["gemv", "gemv_n", "flat_gemv", "gemm"]
+__all__ = ["gemv", "gemv_n", "flat_gemv", "gemm", "spmm"]
 
 
 def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
@@ -304,6 +304,183 @@ def _gemv2d_ell_program(rt, grid, th, tw, kmax, m, n):
     prog = jax.jit(run)
     _prog_cache[key] = prog
     return prog
+
+
+def _ell_local_mm(vals0, cols0, B, th, kmax):
+    """One shard's ELL contraction against MULTIPLE vectors: (th, nv)
+    row sums of vals * B[cols, :].  Same W-slice gather as
+    :func:`_ell_local`, but each gathered slice now feeds ``nv`` MACs —
+    the gather-ISSUE cost (the random-SpMV bottleneck, docs/PERF.md
+    roofline) is paid once per entry regardless of nv.  The slice
+    width shrinks with nv so BYTES per gathered slice stay near the
+    single-vector sweet spot (the round-2 W sweep showed gather cost
+    growing with slice bytes past ~64 B); DR_TPU_SPMM_W overrides for
+    on-chip sweeps."""
+    nv = B.shape[1]
+    from ..utils.env import env_int
+    W = env_int("DR_TPU_SPMM_W", max(2, _gather_w() // max(1, nv // 2)))
+    pad = (-B.shape[0]) % W
+    Bp = jnp.concatenate([B, jnp.zeros((pad, nv), B.dtype)]) if pad else B
+    B3 = Bp.reshape(-1, W, nv)
+    q, r = cols0 // W, cols0 % W
+
+    def block(args):
+        v, qs, rs = args
+        gathered = B3[qs]                       # (ch, kmax, W, nv)
+        oh = rs[..., None] == jax.lax.broadcasted_iota(
+            jnp.int32, rs.shape + (W,), rs.ndim)
+        picked = jnp.einsum("ekwv,ekw->ekv", gathered,
+                            oh.astype(B.dtype))
+        return jnp.einsum("ekv,ek->ev", picked, v)
+
+    ch = max(1, _ELL_CHUNK // max(1, nv))  # bound the (ch,kmax,W,nv) temp
+    if th > ch:
+        nch, rem = divmod(th, ch)
+        body_rows = nch * ch
+        local = jax.lax.map(
+            block, (vals0[:body_rows].reshape(nch, ch, kmax),
+                    q[:body_rows].reshape(nch, ch, kmax),
+                    r[:body_rows].reshape(nch, ch, kmax))).reshape(
+                        body_rows, nv)
+        if rem:
+            tail = block((vals0[body_rows:], q[body_rows:],
+                          r[body_rows:]))
+            local = jnp.concatenate([local, tail])
+    else:
+        local = block((vals0, q, r))
+    return local
+
+
+def _bcsr_local_mm(bvals0, bcols0, B, seg_out):
+    """One shard's BCSR contraction against multiple vectors: (seg_out,
+    nv) from dense (8, 128) tiles — one 128-row slice gather of B per
+    tile, MXU einsum carries the extra vectors."""
+    BW = 128
+    nv = B.shape[1]
+    pad = (-B.shape[0]) % BW
+    Bp = jnp.concatenate([B, jnp.zeros((pad, nv), B.dtype)]) if pad else B
+    g = Bp.reshape(-1, BW, nv)[bcols0]        # (nbr, kb, BW, nv)
+    local = jnp.einsum(
+        "rkbc,rkcv->rbv", bvals0, g,
+        preferred_element_type=jnp.promote_types(B.dtype, jnp.float32))
+    return local.reshape(-1, nv)[:seg_out]
+
+
+def _spmm_w_key():
+    """Cache-key component for the SpMM gather width: the raw env
+    override (not env_int, whose floor collapses unset and '1') plus
+    the DR_TPU_GATHER_W value the default derives from — in-process W
+    sweeps must rebuild, not reuse the first-traced program."""
+    import os
+    return (os.environ.get("DR_TPU_SPMM_W", ""), _gather_w())
+
+
+def spmm(a: sparse_matrix, b) -> jax.Array:
+    """A·B for a row-tiled sparse A and a DENSE (n, nv) right-hand side
+    — the multi-vector SpMV.  Returns the (m, nv) product as an array.
+
+    Beyond-parity surface (the reference ships only the single-vector
+    ``gemv``, shp/algorithms/gemv.hpp:16-73) and the practical answer to
+    the random-pattern SpMV roofline (docs/PERF.md): the per-entry
+    gather-issue cost that bounds single-vector SpMV at ~2-4 GFLOP/s on
+    this chip is paid ONCE per entry here and amortized over ``nv``
+    right-hand sides, so aggregate throughput scales with nv until HBM
+    bandwidth binds."""
+    assert isinstance(a, sparse_matrix)
+    m, n = a.shape
+    B = b.to_array() if hasattr(b, "to_array") else jnp.asarray(b)
+    assert B.ndim == 2 and B.shape[0] == n, \
+        f"spmm needs a ({n}, nv) dense right-hand side, got {B.shape}"
+    if a._vals is None:
+        return jnp.zeros((m, B.shape[1]), a.dtype)
+    rt = a.runtime
+    nv = B.shape[1]
+    bcsr = a.grid_shape[1] == 1 and a.ensure_bcsr()
+    if a.grid_shape[1] == 1 and (bcsr or a.ensure_ell()):
+        th = a.tile_rows
+        kdim = a._bcsr_kb if bcsr else a._ell_width
+        key = ("spmm", pinned_id(rt.mesh), rt.axis, a.nshards, th,
+               kdim, bcsr, nv, m, _spmm_w_key())
+        prog = _prog_cache.get(key)
+        if prog is None:
+            if bcsr:
+                def body(bvals, bcols, B):
+                    return _bcsr_local_mm(bvals[0], bcols[0], B, th)
+                in_specs = (P(rt.axis, None, None, None, None),
+                            P(rt.axis, None, None), P())
+            else:
+                # close over the INT width, never the matrix: the
+                # process-lifetime program cache must not pin device
+                # buffers through the body closure
+                def body(vals, cols, B, kdim=kdim):
+                    return _ell_local_mm(vals[0], cols[0], B, th, kdim)
+                in_specs = (P(rt.axis, None, None),
+                            P(rt.axis, None, None), P())
+            shm = jax.shard_map(body, mesh=rt.mesh, in_specs=in_specs,
+                                out_specs=P(rt.axis, None))
+            prog = jax.jit(shm)
+            _prog_cache[key] = prog
+        args = (a._bcsr_vals, a._bcsr_cols) if bcsr \
+            else (a._ell_vals, a._ell_cols)
+        return prog(*args, B)[:m]
+    # general grids: one flat gemv per column (correct everywhere)
+    cols = [flat_gemv(a, B[:, j]) for j in range(nv)]
+    return jnp.stack(cols, axis=1)
+
+
+def spmm_n(a: sparse_matrix, b, iters: int) -> jax.Array:
+    """``iters`` chained SpMMs in ONE jitted program (the gemv_n
+    measurement analog): each round perturbs B by a scalar of the
+    running product (times 1e-38) so XLA can neither hoist the
+    contraction nor skip re-reading B.  Returns the last product."""
+    assert isinstance(a, sparse_matrix) and a.grid_shape[1] == 1
+    m, n = a.shape
+    B = b.to_array() if hasattr(b, "to_array") else jnp.asarray(b)
+    assert B.ndim == 2 and B.shape[0] == n
+    rt = a.runtime
+    nv = B.shape[1]
+    bcsr = a.ensure_bcsr()
+    have_ell = bcsr or a.ensure_ell()  # side effects survive python -O
+    assert have_ell, "spmm_n needs a grouped (BCSR/ELL) fast path"
+    th = a.tile_rows
+    kdim = a._bcsr_kb if bcsr else a._ell_width
+    key = ("spmm_n", pinned_id(rt.mesh), rt.axis, a.nshards, th, kdim,
+           bcsr, nv, m, int(iters), _spmm_w_key())
+    prog = _prog_cache.get(key)
+    if prog is None:
+        if bcsr:
+            def local_of(vals, cols, B):
+                return _bcsr_local_mm(vals[0], cols[0], B, th)
+            in_specs = (P(rt.axis, None, None, None, None),
+                        P(rt.axis, None, None), P())
+        else:
+            # close over the INT width, never the matrix (see spmm)
+            def local_of(vals, cols, B, kdim=kdim):
+                return _ell_local_mm(vals[0], cols[0], B, th, kdim)
+            in_specs = (P(rt.axis, None, None),
+                        P(rt.axis, None, None), P())
+
+        def body(vals, cols, B):
+            # both local bodies accumulate in (at least) f32: the loop
+            # carry must match that promoted dtype, not B's
+            out_dt = jnp.promote_types(B.dtype, jnp.float32)
+
+            def it(_, y):
+                s = y[0, 0] * jnp.asarray(1e-38, B.dtype)
+                return local_of(vals, cols, B + s).astype(out_dt)
+            # seed the carry VARYING over the mesh axis (zeros alone are
+            # replicated and shard_map's vma check rejects the loop)
+            y0 = jnp.zeros((th, nv), out_dt) \
+                + 0 * vals[(0,) * vals.ndim].astype(out_dt)
+            return jax.lax.fori_loop(0, iters, it, y0)
+
+        shm = jax.shard_map(body, mesh=rt.mesh, in_specs=in_specs,
+                            out_specs=P(rt.axis, None))
+        prog = jax.jit(shm)
+        _prog_cache[key] = prog
+    args = (a._bcsr_vals, a._bcsr_cols) if bcsr \
+        else (a._ell_vals, a._ell_cols)
+    return prog(*args, B)[:m]
 
 
 def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:

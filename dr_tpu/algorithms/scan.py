@@ -174,12 +174,12 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             local = _blocked_scan(combine, x,
                                   ident if kind is not None else None,
                                   kind)
-            # pads are masked to the identity, so position S-1 carries
-            # each shard's REAL total even when the shard is narrower
-            # than the working width (or empty)
-            totals = lax.all_gather(local[-1], axis)      # (nshards,)
             # exclusive fold of totals from ranks < r  ->  my carry
             if ident is not None:
+                # pads are masked to the identity, so position S-1
+                # carries each shard's REAL total even when the shard
+                # is narrower than the working width (or empty)
+                totals = lax.all_gather(local[-1], axis)  # (nshards,)
                 masked = jnp.where(jnp.arange(nshards) < r, totals,
                                    ident)
                 carry = lax.associative_scan(combine, masked)[-1]
@@ -198,11 +198,40 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                     scanned = jnp.where(r > 0, combine(carry, local),
                                         local)
             else:
-                # no identity: fold sequentially with lax.fori_loop
-                def fold(i, acc):
-                    return jnp.where(i < r, combine(acc, totals[i]), acc)
-                carry = lax.fori_loop(1, nshards, fold, totals[0])
-                scanned = jnp.where(r > 0, combine(carry, local), local)
+                # no identity: fold sequentially with lax.fori_loop.
+                # Trailing pad cells never affect a local scan's valid
+                # prefix, so `local` is correct as-is; only the TOTALS
+                # need care.  Uniform ceil layouts read local[-1] (only
+                # the last shard is short, and nobody folds its total);
+                # uneven layouts read each shard's REAL total at
+                # local[valid-1] and skip empty shards, seeding the
+                # fold at the FIRST nonempty shard (static: sizes are
+                # python ints), so no identity is ever required.
+                if exact or uniform_layout(layout):
+                    totals = lax.all_gather(local[-1], axis)
+
+                    def fold(i, acc):
+                        return jnp.where(i < r, combine(acc, totals[i]),
+                                         acc)
+                    carry = lax.fori_loop(1, nshards, fold, totals[0])
+                    scanned = jnp.where(r > 0, combine(carry, local),
+                                        local)
+                else:
+                    nvalid = jnp.minimum(sizes_c[r],
+                                         jnp.clip(n - starts_c[r], 0, S))
+                    mine = local[jnp.clip(nvalid - 1, 0, S - 1)]
+                    totals = lax.all_gather(mine, axis)
+                    nonempty = [i for i in range(nshards) if sizes[i] > 0]
+                    first = nonempty[0] if nonempty else 0
+
+                    def fold(i, acc):
+                        use = jnp.logical_and(i < r, sizes_c[i] > 0)
+                        return jnp.where(use, combine(acc, totals[i]),
+                                         acc)
+                    carry = lax.fori_loop(first + 1, nshards, fold,
+                                          totals[first])
+                    scanned = jnp.where(r > first,
+                                        combine(carry, local), local)
         if exclusive and (use_kernel or kind is None):
             # positional shift with the previous shard's last value via
             # ppermute — valid on uniform ceil layouts (a nonempty
@@ -243,11 +272,15 @@ def _scan(in_r, out, op, init, exclusive):
         ins is not None and len(ins) == 1 and not ins[0].ops
         and ins[0].off == 0 and out_chain.off == 0
         and ins[0].cont.layout == out_chain.cont.layout
-        # the shard_map program handles any uniform ceil layout, and
-        # uneven block distributions whenever the op has an identity
-        # to mask pad cells with; identityless custom ops on uneven
-        # layouts take the logical-array fallback below
-        and (uniform_layout(ins[0].cont.layout) or kind is not None)
+        # the shard_map program handles any uniform ceil layout; uneven
+        # block distributions run natively for ops WITH an identity
+        # (pad masking) and, for INCLUSIVE scans, identityless ops too
+        # (real totals at local[valid-1], empty-shard-skipping fold —
+        # _scan_program).  Only exclusive+identityless+uneven still
+        # takes the logical-array fallback (its first output needs an
+        # identity the op cannot provide).
+        and (uniform_layout(ins[0].cont.layout) or kind is not None
+             or not exclusive)
         and ins[0].n == len(ins[0].cont)
         # the fast program rebuilds the whole output array, so the output
         # window must cover the whole container too
@@ -265,6 +298,14 @@ def _scan(in_r, out, op, init, exclusive):
         out_chain.cont._data = prog(c.cont._data)
         scanned = None
     else:
+        from ..utils.fallback import warn_fallback
+        if (ins is not None and len(ins) == 1
+                and not uniform_layout(ins[0].cont.layout)
+                and kind is None and exclusive):
+            why = "exclusive identityless op on an uneven layout"
+        else:
+            why = "subrange window, view chain, or layout mismatch"
+        warn_fallback("scan", why)
         arr = in_r.to_array() if hasattr(in_r, "to_array") \
             else jnp.asarray(in_r)
         combine = combine_for(kind, op)
@@ -358,7 +399,11 @@ def _scan_apply_init(out, init, op):
     ``op(init, prefix)`` (exact by associativity); position 0 is set to
     ``init`` EXACTLY — the scan program seeds it with the op identity
     when one exists, but an unclassified op's pseudo-identity (zero)
-    would make ``op(init, 0)`` wrong there."""
+    would make ``op(init, 0)`` wrong there.
+
+    Whole-container outputs fold in ONE fused shard_map pass (init is a
+    traced scalar, so loop-varying inits reuse the cached program);
+    only window outputs materialize."""
     if op is None:
         op = operator.add
     kind = _classify_op(op)
@@ -366,6 +411,39 @@ def _scan_apply_init(out, init, op):
     chain = _out_chain(out)
     cont = chain.cont
     if chain.n == 0:
+        return
+    if chain.off == 0 and chain.n == len(cont):
+        mesh = cont.runtime.mesh
+        axis = cont.runtime.axis
+        key = ("scan_init", pinned_id(mesh), axis, cont.layout, kind,
+               _op_key(op) if kind is None else None, str(cont.dtype))
+        prog = _prog_cache.get(key)
+        if prog is None:
+            nshards, S, cap, prev, nxt, n, starts, sizes = \
+                working_geometry(cont.layout)
+
+            def body(blk, iv):
+                x = blk[0, prev:prev + S]
+                folded = combine(iv, x)
+                r = lax.axis_index(axis)
+                # global position 0 is init EXACTLY (first shard with a
+                # nonzero start offset never owns it)
+                starts_c = jnp.asarray(starts, jnp.int32)
+                here0 = starts_c[r] == 0
+                folded = folded.at[0].set(
+                    jnp.where(here0, iv, folded[0]))
+                if prev == 0 and nxt == 0 and cap == S:
+                    return folded.astype(blk.dtype)[None]
+                out_row = jnp.zeros((1, prev + cap + nxt), blk.dtype)
+                return out_row.at[0, prev:prev + S].set(
+                    folded.astype(blk.dtype))
+
+            shm = jax.shard_map(body, mesh=mesh,
+                                in_specs=(P(axis, None), P()),
+                                out_specs=P(axis, None))
+            prog = jax.jit(shm, donate_argnums=0)
+            _prog_cache[key] = prog
+        cont._data = prog(cont._data, jnp.asarray(init, cont.dtype))
         return
     arr = cont.to_array()
     seg = arr[chain.off:chain.off + chain.n]

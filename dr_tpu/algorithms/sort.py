@@ -53,6 +53,7 @@ from jax.sharding import PartitionSpec as P
 from ._common import working_geometry
 from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from ..core.pinning import pinned_id
+from ..utils.fallback import warn_fallback
 
 __all__ = ["sort", "sort_by_key", "argsort", "is_sorted"]
 
@@ -259,6 +260,8 @@ def sort(r, *, descending: bool = False):
                              cont.layout, cont.dtype, descending)
         cont._data = prog(cont._data)
         return r
+    warn_fallback("sort", "subrange window" if chain.n != len(cont)
+                  or chain.off else "float64 keys")
     arr = cont.to_array()
     win = jnp.sort(arr[chain.off:chain.off + chain.n])
     if descending:
@@ -297,6 +300,14 @@ def sort_by_key(keys, values, *, descending: bool = False):
                              pay_dtype=vcont.dtype)
         kcont._data, vcont._data = prog(kcont._data, vcont._data)
         return keys, values
+    if kcont.layout[0] != vcont.layout[0] \
+            or kcont.layout[1] != vcont.layout[1]:
+        why = "keys and values carry different distributions"
+    elif kc.off or vc.off or kc.n != len(kcont) or vc.n != len(vcont):
+        why = "subrange window"
+    else:
+        why = "float64 keys or values"
+    warn_fallback("sort_by_key", why)
     karr = kcont.to_array()[kc.off:kc.off + kc.n]
     varr = vcont.to_array()[vc.off:vc.off + vc.n]
     order = jnp.argsort(karr, stable=True)
@@ -393,6 +404,9 @@ def is_sorted(r) -> bool:
                                       cont.dtype,
                                       pinned_id(cont.runtime.mesh))
             return int(prog(cont._data)) == 0
+        warn_fallback("is_sorted", "subrange window"
+                      if chain.n != len(cont) or chain.off
+                      else "float64 (exact direct compare)")
         arr = cont.to_array()[chain.off:chain.off + chain.n]
     elif res is None:
         raise TypeError("is_sorted takes a distributed range")

@@ -19,6 +19,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# fallback-path tests exercise materialize routes on purpose; the
+# loud-once warning stays covered by test_fallbacks_warn_once, which
+# clears this
+os.environ.setdefault("DR_TPU_SILENCE_FALLBACKS", "1")
 
 import jax  # noqa: E402
 

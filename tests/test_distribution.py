@@ -244,3 +244,87 @@ def test_checkpoint_roundtrips_distribution(tmp_path):
     back = dr_tpu.checkpoint.load(path)
     assert back.layout == dv.layout  # placement survives, not just values
     np.testing.assert_allclose(dr_tpu.to_numpy(back), src)
+
+
+def _no_materialize(monkeypatch):
+    """Arm: any to_array during the armed window fails the test."""
+    def boom(self):
+        raise AssertionError("materialize fallback taken on a native path")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+
+
+def test_identityless_scan_on_uneven_is_native(monkeypatch, oracle):
+    """Round-4: identityless custom ops run the shard_map scan program
+    on uneven layouts too (real totals at local[valid-1], empty-shard-
+    skipping fold) — no materialize (VERDICT r3 item 5)."""
+    P = dr_tpu.nprocs()
+    if P < 3:
+        pytest.skip("needs a mesh with an empty team shard")
+    op = lambda a, b: a + b + a * b * 0.25  # unclassified op, no identity
+    sizes = [5, 0] + list(dr_tpu.even_sizes(18, P - 2))
+    n = sum(sizes)
+    src = np.random.default_rng(8).standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    a.assign_array(src)
+    out = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    _no_materialize(monkeypatch)
+    dr_tpu.inclusive_scan(a, out, op)
+    monkeypatch.undo()
+    ref = np.empty(n, np.float32)
+    acc = src[0]
+    ref[0] = acc
+    for i in range(1, n):
+        acc = acc + src[i] + acc * src[i] * 0.25
+        ref[i] = acc
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=2e-4,
+                               atol=1e-4)
+
+
+def test_native_paths_do_not_materialize(monkeypatch):
+    """The advertised uneven-native surface (sort, sort_by_key matching
+    distributions, is_sorted, classified scans, reduce, elementwise)
+    must never call to_array — the fallbacks are for windows/f64/
+    mixed-distribution shapes only (VERDICT r3 item 5)."""
+    P = dr_tpu.nprocs()
+    sizes = _uneven_sizes(21, P, seed=13)
+    n = sum(sizes)
+    src = np.random.default_rng(13).standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    a.assign_array(src)
+    k = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    k.assign_array(src)
+    v = dr_tpu.distributed_vector(n, np.int32, distribution=sizes)
+    v.assign_array(np.arange(n, dtype=np.int32))
+    s = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    _no_materialize(monkeypatch)
+    dr_tpu.sort(a)
+    dr_tpu.is_sorted(a)
+    dr_tpu.sort_by_key(k, v)
+    dr_tpu.inclusive_scan(a, s)
+    dr_tpu.exclusive_scan(a, s, init=1.0)
+    dr_tpu.inclusive_scan(a, s, op=jnp.multiply)
+    dr_tpu.reduce(a)
+    dr_tpu.fill(s, 1.0)
+    monkeypatch.undo()
+
+
+def test_fallbacks_warn_once(monkeypatch):
+    """Leaving a fast path announces itself once per (op, reason) —
+    no silent perf cliffs (VERDICT r3 item 5)."""
+    import warnings as w
+    from dr_tpu.utils import fallback
+    from dr_tpu.utils.fallback import MaterializeFallbackWarning
+    monkeypatch.setattr(fallback, "_seen", set())
+    monkeypatch.delenv("DR_TPU_SILENCE_FALLBACKS", raising=False)
+    n = 24
+    a = dr_tpu.distributed_vector.from_array(
+        np.random.default_rng(1).standard_normal(n).astype(np.float32))
+    win = a[4:12]
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        dr_tpu.sort(win)          # subrange window -> fallback, warns
+        dr_tpu.sort(win)          # same site: no second warning
+    hits = [r for r in rec if issubclass(r.category,
+                                         MaterializeFallbackWarning)]
+    assert len(hits) == 1, [str(r.message) for r in rec]
+    assert "subrange window" in str(hits[0].message)

@@ -352,3 +352,77 @@ def test_bcsr_2d_fill_gate_uses_tile_width():
     dr_tpu.fill(c, 0.0)
     dr_tpu.gemv(c, sp, b)
     np.testing.assert_allclose(dr_tpu.to_numpy(c), d @ b, rtol=1e-4)
+
+
+def _rand_coo(m, n, k, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = np.repeat(np.arange(m), k)
+    cols = rng.integers(0, n, size=m * k)
+    vals = rng.standard_normal(m * k).astype(np.float32)
+    return rows, cols, vals
+
+
+def test_spmm_random_matches_dense(mesh_size):
+    """Multi-vector SpMM on the random (ELL) path vs the dense oracle —
+    the gather-amortization surface (docs/PERF.md SpMV roofline)."""
+    m = n = 64
+    rows, cols, vals = _rand_coo(m, n, 4, seed=3)
+    A = dr_tpu.sparse_matrix.from_coo((m, n), rows, cols, vals)
+    rng = np.random.default_rng(7)
+    B = rng.standard_normal((n, 5)).astype(np.float32)
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    got = np.asarray(dr_tpu.spmm(A, B))
+    np.testing.assert_allclose(got, dense @ B, rtol=2e-5, atol=1e-5)
+
+
+def test_spmm_bcsr_banded_matches_dense():
+    m, half = 64, 4
+    rng = np.random.default_rng(50)
+    dense = np.zeros((m, m), dtype=np.float32)
+    for i in range(m):
+        lo, hi = max(0, i - half), min(m, i + half + 1)
+        dense[i, lo:hi] = rng.standard_normal(hi - lo)
+    A = dr_tpu.sparse_matrix.from_dense(dense)
+    assert A.ensure_bcsr()
+    B = np.random.default_rng(2).standard_normal((m, 3)).astype(np.float32)
+    got = np.asarray(dr_tpu.spmm(A, B))
+    np.testing.assert_allclose(got, dense @ B, rtol=2e-5, atol=1e-4)
+
+
+def test_spmm_single_column_matches_gemv():
+    m = n = 96
+    rows, cols, vals = _rand_coo(m, n, 3, seed=9)
+    A = dr_tpu.sparse_matrix.from_coo((m, n), rows, cols, vals)
+    b = np.random.default_rng(4).standard_normal(n).astype(np.float32)
+    got = np.asarray(dr_tpu.spmm(A, b[:, None]))[:, 0]
+    c = dr_tpu.distributed_vector(m, np.float32)
+    dr_tpu.fill(c, 0.0)
+    dr_tpu.gemv(c, A, b)
+    np.testing.assert_allclose(got, dr_tpu.to_numpy(c), rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_spmm_2d_grid_fallback():
+    """General tile grids take the per-column flat path."""
+    m = n = 64
+    rows, cols, vals = _rand_coo(m, n, 2, seed=11)
+    A = dr_tpu.sparse_matrix.from_coo(
+        (m, n), rows, cols, vals,
+        partition=dr_tpu.block_cyclic(
+            grid=dr_tpu.factor(dr_tpu.nprocs())))
+    B = np.random.default_rng(5).standard_normal((n, 3)).astype(np.float32)
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    got = np.asarray(dr_tpu.spmm(A, B))
+    np.testing.assert_allclose(got, dense @ B, rtol=2e-5, atol=1e-5)
+
+
+def test_spmm_rejects_bad_shapes():
+    m = n = 32
+    rows, cols, vals = _rand_coo(m, n, 2)
+    A = dr_tpu.sparse_matrix.from_coo((m, n), rows, cols, vals)
+    with pytest.raises(AssertionError):
+        dr_tpu.spmm(A, np.zeros((n + 1, 2), np.float32))
+    with pytest.raises(AssertionError):
+        dr_tpu.spmm(A, np.zeros((n,), np.float32))

@@ -5,7 +5,7 @@ Measures everything by the marginal method with a hard scalar-read sync
 (docs/PERF.md "measurement lesson"): block_until_ready can be a no-op
 on tunneled backends, so each timed call returns one device scalar.
 
-Usage:  python tools/tune_tpu.py [stencil|scan|dot|spmv|heat|attn|all]
+Usage:  python tools/tune_tpu.py [stencil|scan|dot|spmv|heat|attn|halo|all]
 
 Prints one line per configuration; safe to re-run (all programs cached
 per process).  This is a developer tool, not part of the bench contract.
@@ -336,6 +336,29 @@ def tune_container(name):
             dt = _marginal(run, 2, r2)
             print(f"bcsr spmv r2={r2}: {2.0 * len(ii) / dt / 1e9:.2f} "
                   f"GFLOP/s", flush=True)
+        # random pattern x multiple vectors: the gather-amortization
+        # surface (nv slices of work per gather issue; PERF.md roofline)
+        mr, kr = 2 ** 17, 32
+        rng = np.random.default_rng(0)
+        rrows = np.repeat(np.arange(mr), kr)
+        rcols = rng.integers(0, mr, size=mr * kr)
+        rvals = rng.standard_normal(mr * kr).astype(np.float32)
+        Ar = dr_tpu.sparse_matrix.from_coo((mr, mr), rrows, rcols, rvals)
+        for nv in (1, 4, 8, 16):
+            Bm = jnp.asarray(
+                rng.standard_normal((mr, nv)).astype(np.float32))
+
+            def run_mm(r):
+                y = dr_tpu.spmm_n(Ar, Bm, r)
+                float(y[0, 0])
+            try:
+                dt = _marginal(run_mm, 2, 18)
+                print(f"random spmm nv={nv}: "
+                      f"{2.0 * mr * kr * nv / dt / 1e9:.2f} GFLOP/s "
+                      "aggregate", flush=True)
+            except Exception as e:
+                print(f"random spmm nv={nv}: FAIL {_errline(e)}",
+                      flush=True)
 
 
 if __name__ == "__main__":
@@ -346,6 +369,6 @@ if __name__ == "__main__":
         tune_physbw()
     if what in ("scan", "all"):
         tune_scan()
-    for nm in ("dot", "heat", "attn", "spmv"):
+    for nm in ("dot", "heat", "attn", "halo", "spmv"):
         if what in (nm, "all"):
             tune_container(nm)
