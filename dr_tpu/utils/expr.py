@@ -27,7 +27,7 @@ import re
 
 import jax.numpy as jnp
 
-__all__ = ["op_from_expr", "FUNCTIONS"]
+__all__ = ["op_from_expr", "op_from_source", "FUNCTIONS"]
 
 # the callable surface the C++ DSL can name (thp::sqrt & co.)
 FUNCTIONS = {
@@ -65,6 +65,45 @@ def _validate(expr: str, nargs: int) -> None:
         raise ValueError(f"expr contains non-DSL characters: {expr!r}")
     if "__" in expr:
         raise ValueError("double underscore is not part of the DSL")
+
+
+@functools.lru_cache(maxsize=512)
+def op_from_source(src: str, nargs: int):
+    """Compile arbitrary jax-traceable Python source into an op — the
+    native bridge's ESCAPE HATCH (SURVEY.md §7 hard-part 2, option b)
+    for ops the arithmetic DSL cannot express: conditionals
+    (``jnp.where``), comparisons, clipping, casts, or anything else
+    traceable.  ``src`` must evaluate to a callable of ``nargs``
+    positional arguments, e.g. ``"lambda x0: jnp.where(x0 > 0, x0,
+    0.01 * x0)"``; ``jnp``, ``lax`` and ``np`` are in scope.
+
+    Unlike :func:`op_from_expr` there is NO grammar validation — this
+    is deliberate full Python, the same trust boundary as
+    ``thp::session::exec`` (the C++ caller already owns the embedded
+    interpreter).  Caching by (source, nargs) keeps the identity-keyed
+    program caches effective across bridge calls."""
+    nargs = int(nargs)
+    if not (1 <= nargs <= _MAX_ARGS):
+        raise ValueError(f"nargs must be 1..{_MAX_ARGS}")
+    import builtins
+
+    import numpy as np
+    from jax import lax
+    fn = eval(compile(src, f"<thp-custom-op:{src[:60]}>", "eval"),
+              {"__builtins__": builtins, "jnp": jnp, "np": np,
+               "lax": lax})
+    if not callable(fn):
+        raise TypeError(f"custom op source is not callable: {src!r}")
+    import inspect
+    try:
+        sig_n = len(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        sig_n = nargs
+    if sig_n != nargs:
+        raise ValueError(
+            f"custom op takes {sig_n} args, bridge declared {nargs}")
+    fn.__name__ = f"thp_custom_{abs(hash((src, nargs))) % 10 ** 8}"
+    return fn
 
 
 @functools.lru_cache(maxsize=512)

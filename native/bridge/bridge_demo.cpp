@@ -268,6 +268,71 @@ int main(int argc, char** argv) {
     }
   }
 
+  {
+    // custom-op escape hatch (round 4; SURVEY §7 hard-part 2 option
+    // b): conditionals are outside the arithmetic DSL — leaky relu
+    // needs jnp.where, expressible only as traceable Python source
+    thp::custom_op leaky{"lambda x0: jnp.where(x0 > 0, x0, 0.01 * x0)",
+                         1};
+    thp::vector cin = s.make_vector(64);
+    thp::vector cout = s.make_vector(64);
+    cin.iota(-32.0);  // half negative, half positive
+    s.transform(cin, cout, leaky);
+    std::vector<double> ch = cout.to_host();
+    for (std::size_t i = 0; i < ch.size(); ++i) {
+      double x = -32.0 + (double)i;
+      double want = x > 0 ? x : 0.01 * x;
+      if (std::abs(ch[i] - want) > 1e-5) {
+        std::printf("custom op FAIL at %zu: got %g want %g\n", i, ch[i],
+                    want);
+        ++failures;
+        break;
+      }
+    }
+    // zipped binary custom op + custom transform_reduce
+    thp::custom_op takegt{
+        "lambda x0, x1: jnp.where(x0 > x1, x0, x1)", 2};
+    thp::vector cz = s.make_vector(64);
+    s.transform2(cin, cout, cz, takegt);
+    check_close("custom zip reduce", cz.reduce(), [&] {
+      double acc = 0;
+      for (std::size_t i = 0; i < ch.size(); ++i) {
+        double x = -32.0 + (double)i;
+        acc += x > ch[i] ? x : ch[i];
+      }
+      return acc;
+    }());
+    thp::custom_op clip6{"lambda x0: jnp.clip(x0, 0.0, 6.0)", 1};
+    double clipped = s.transform_reduce(cin, clip6);
+    check_close("custom transform_reduce", clipped, [&] {
+      double acc = 0;
+      for (std::size_t i = 0; i < 64; ++i) {
+        double x = -32.0 + (double)i;
+        acc += x < 0 ? 0.0 : (x > 6 ? 6.0 : x);
+      }
+      return acc;
+    }());
+  }
+
+  {
+    // typed containers (round 4): the device dtype is selectable —
+    // f32 stays the default (what earlier bridge versions allocated);
+    // i32 holds exact integers through iota/reduce/to_host
+    thp::vector f = s.make_vector(64);
+    if (f.element_dtype() != thp::dtype::f32) {
+      std::printf("dtype FAIL: default is not f32\n");
+      ++failures;
+    }
+    thp::vector iv = s.make_vector(100, 0, 0, false, thp::dtype::i32);
+    iv.iota(0.0);
+    check_close("i32 reduce", iv.reduce(), 100.0 * 99.0 / 2.0);
+    std::vector<double> ih = iv.to_host();
+    if (ih.size() != 100 || ih[7] != 7.0 || ih[99] != 99.0) {
+      std::printf("i32 to_host FAIL\n");
+      ++failures;
+    }
+  }
+
   if (failures) {
     std::printf("bridge demo: %d FAILURES\n", failures);
     return 1;

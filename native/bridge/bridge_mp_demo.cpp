@@ -1,0 +1,89 @@
+// Multi-process SPMD bridge demo: the C++ analog of
+// tests/multihost_worker.py.  Each OS process constructs a
+// thp::session with the SAME coordinator (thp::distributed) and runs
+// the SAME program in the same order — the reference's MPI-rank
+// discipline (mhp/global.hpp:24-28, mpiexec -n {1..4} suites) carried
+// to the embedded JAX runtime over jax.distributed.
+//
+// Usage: bridge_mp_demo <pid> <nproc> <port>
+// The Makefile's bridge-mp-test target launches 2 processes and
+// requires both to exit 0.  Checks are a local macro, NOT assert():
+// python3-config's cflags define NDEBUG, which would compile assert
+// away and turn this into a smoke test that can't fail.
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "thp_bridge.hpp"
+
+namespace {
+int failures = 0;
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                  \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s <pid> <nproc> <port>\n", argv[0]);
+    return 2;
+  }
+  int pid = std::atoi(argv[1]);
+  int nproc = std::atoi(argv[2]);
+  thp::distributed d;
+  d.coordinator = std::string("localhost:") + argv[3];
+  d.num_processes = nproc;
+  d.process_id = pid;
+  d.ncpu_devices = 1;  // one virtual CPU device per process
+  thp::session s(d);
+  CHECK((int)s.nprocs() == nproc);
+
+  // every collective result must be valid on EVERY process
+  std::size_t n = 4 * (std::size_t)nproc;
+  thp::vector v = s.make_vector(n);
+  v.iota(1.0);
+  double total = v.reduce();
+  CHECK(total == (double)n * (n + 1) / 2.0);
+
+  thp::vector w = s.make_vector(n);
+  w.fill(2.0);
+  double dp = s.dot(v, w);
+  CHECK(dp == 2.0 * total);
+
+  // op DSL across the process boundary
+  thp::vector out = s.make_vector(n);
+  s.transform(v, out, thp::x0 * 2.0 + 1.0);
+  std::vector<double> host = out.to_host();
+  CHECK(host.size() == n);
+  for (std::size_t i = 0; i < n && i < host.size(); ++i)
+    CHECK(host[i] == 2.0 * (double)(i + 1) + 1.0);
+
+  // distributed sample sort exercises all_gather + all_to_all over DCN
+  thp::vector keys = s.make_vector(n);
+  s.transform(v, keys, 0.0 - thp::x0);  // descending values
+  s.sort(keys);
+  std::vector<double> sorted = keys.to_host();
+  for (std::size_t i = 1; i < sorted.size(); ++i)
+    CHECK(sorted[i - 1] <= sorted[i]);
+  CHECK(s.is_sorted(keys));
+
+  // typed container across processes: int32 device dtype
+  thp::vector iv = s.make_vector(n, 0, 0, false, thp::dtype::i32);
+  iv.iota(0.0);
+  CHECK(iv.element_dtype() == thp::dtype::i32);
+  CHECK(iv.reduce() == (double)(n * (n - 1) / 2));
+
+  if (failures) {
+    std::fprintf(stderr, "bridge_mp_demo pid=%d/%d: %d FAILURES\n", pid,
+                 nproc, failures);
+    return 1;
+  }
+  std::printf("bridge_mp_demo pid=%d/%d: PASSED\n", pid, nproc);
+  return 0;
+}

@@ -116,6 +116,14 @@ struct session::impl {
                 "op_from_expr");
   }
 
+  // custom-op escape hatch -> cached jax callable (full Python source;
+  // same trust boundary as session::exec)
+  PyObject* op(const custom_op& e) {
+    return must(PyObject_CallMethod(expr_mod, "op_from_source", "si",
+                                    e.source.c_str(), e.nargs),
+                "op_from_source");
+  }
+
   // f64 host view -> f32 numpy array (device dtype)
   PyObject* np_f32(const std::vector<double>& v) {
     PyObject* raw = np_view(np, v.data(), v.size() * sizeof(double),
@@ -137,6 +145,40 @@ struct session::impl {
     return arr;
   }
 
+  // shared interpreter boot: XLA device-count flags must be in the env
+  // before the first interpreter/backend init; CPU forcing must go
+  // through jax.config (the env var alone is frozen by any site
+  // customization that already imported jax)
+  void boot(int ncpu_devices) {
+    if (!Py_IsInitialized()) {
+      if (ncpu_devices > 0) {
+        std::string flags = "--xla_force_host_platform_device_count=" +
+                            std::to_string(ncpu_devices);
+        setenv("XLA_FLAGS", flags.c_str(), 1);
+      }
+      Py_InitializeEx(0);
+      owns_interpreter = true;
+    }
+    if (ncpu_devices > 0) {
+      if (PyRun_SimpleString(
+              "import jax\n"
+              "jax.config.update('jax_platforms', 'cpu')\n"))
+        fail("forcing cpu platform");
+    }
+    dr = must(PyImport_ImportModule("dr_tpu"), "import dr_tpu");
+  }
+
+  void import_modules() {
+    views = must(PyImport_ImportModule("dr_tpu.views.views"),
+                 "import dr_tpu.views.views");
+    stencil_mod = must(
+        PyImport_ImportModule("dr_tpu.algorithms.stencil"),
+        "import dr_tpu.algorithms.stencil");
+    expr_mod = must(PyImport_ImportModule("dr_tpu.utils.expr"),
+                    "import dr_tpu.utils.expr");
+    np = must(PyImport_ImportModule("numpy"), "import numpy");
+  }
+
   // contiguous f64 copy-out of any numpy-convertible object
   std::vector<double> to_host_f64(PyObject* arr_like) {
     PyObject* asc = must(
@@ -155,38 +197,32 @@ struct session::impl {
 };
 
 session::session(int ncpu_devices) : impl_(new impl) {
-  if (!Py_IsInitialized()) {
-    if (ncpu_devices > 0) {
-      std::string flags = "--xla_force_host_platform_device_count=" +
-                          std::to_string(ncpu_devices);
-      setenv("XLA_FLAGS", flags.c_str(), 1);
-    }
-    Py_InitializeEx(0);
-    impl_->owns_interpreter = true;
-  }
-  if (ncpu_devices > 0) {
-    // env alone is not enough if site customization imported jax already
-    if (PyRun_SimpleString(
-            "import jax\n"
-            "jax.config.update('jax_platforms', 'cpu')\n"))
-      fail("forcing cpu platform");
-  }
-  impl_->dr = must(PyImport_ImportModule("dr_tpu"), "import dr_tpu");
+  impl_->boot(ncpu_devices);
   must(PyObject_CallMethod(impl_->dr, "init", nullptr), "dr_tpu.init()");
-  impl_->views = must(PyImport_ImportModule("dr_tpu.views.views"),
-                      "import dr_tpu.views.views");
-  impl_->stencil_mod = must(
-      PyImport_ImportModule("dr_tpu.algorithms.stencil"),
-      "import dr_tpu.algorithms.stencil");
-  impl_->expr_mod = must(PyImport_ImportModule("dr_tpu.utils.expr"),
-                         "import dr_tpu.utils.expr");
-  impl_->np = must(PyImport_ImportModule("numpy"), "import numpy");
+  impl_->import_modules();
   // XLA device-count flags are frozen at first interpreter/backend init,
   // so a later session cannot change the mesh size — fail loudly instead
   // of computing over the wrong partitioning
   if (ncpu_devices > 0 && nprocs() != (std::size_t)ncpu_devices)
     fail("requested virtual mesh size differs from the initialized "
          "backend; device-count flags are fixed at first init");
+}
+
+session::session(const distributed& d) : impl_(new impl) {
+  // CPU multi-process testing is the supported transport here (each
+  // process contributes ncpu_devices virtual CPU devices; TPU pods
+  // would pass ncpu_devices = 0 and let the platform enumerate)
+  impl_->boot(d.ncpu_devices);
+  must(PyObject_CallMethod(impl_->dr, "init_distributed", "sii",
+                           d.coordinator.c_str(), d.num_processes,
+                           d.process_id),
+       "dr_tpu.init_distributed(...)");
+  impl_->import_modules();
+  std::size_t want = (std::size_t)d.num_processes *
+                     (d.ncpu_devices > 0 ? d.ncpu_devices : 1);
+  if (d.ncpu_devices > 0 && nprocs() != want)
+    fail("distributed mesh size differs from num_processes * "
+         "ncpu_devices; device-count flags are fixed at first init");
 }
 
 session::~session() {
@@ -212,8 +248,18 @@ void session::exec(const std::string& code) {
 
 // ------------------------------------------------------------ containers
 
+namespace {
+const char* np_name(dtype dt) {
+  switch (dt) {
+    case dtype::f32: return "float32";
+    case dtype::i32: return "int32";
+    default: return "float64";
+  }
+}
+}  // namespace
+
 vector session::make_vector(std::size_t n, std::size_t prev,
-                            std::size_t next, bool periodic) {
+                            std::size_t next, bool periodic, dtype dt) {
   PyObject* hb = nullptr;
   if (prev || next) {
     PyObject* hb_cls = must(
@@ -227,20 +273,20 @@ vector session::make_vector(std::size_t n, std::size_t prev,
   PyObject* cls = must(
       PyObject_GetAttrString(impl_->dr, "distributed_vector"),
       "distributed_vector");
-  PyObject* obj;
-  if (hb) {
-    PyObject* args = Py_BuildValue("(n)", (Py_ssize_t)n);
-    PyObject* kwargs = Py_BuildValue("{s:O}", "halo", hb);
-    obj = must(PyObject_Call(cls, args, kwargs), "distributed_vector(...)");
-    Py_DECREF(args);
-    Py_DECREF(kwargs);
-    Py_DECREF(hb);
-  } else {
-    obj = must(PyObject_CallFunction(cls, "n", (Py_ssize_t)n),
-               "distributed_vector(n)");
-  }
+  PyObject* np_dt = must(
+      PyObject_GetAttrString(impl_->np, np_name(dt)), "numpy dtype");
+  PyObject* args = Py_BuildValue("(n)", (Py_ssize_t)n);
+  PyObject* kwargs = hb
+      ? Py_BuildValue("{s:O,s:O}", "dtype", np_dt, "halo", hb)
+      : Py_BuildValue("{s:O}", "dtype", np_dt);
+  PyObject* obj = must(PyObject_Call(cls, args, kwargs),
+                       "distributed_vector(...)");
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_XDECREF(hb);
+  Py_DECREF(np_dt);
   Py_DECREF(cls);
-  return vector(this, obj, n);
+  return vector(this, obj, n, dt);
 }
 
 dense_matrix session::make_dense(std::size_t m, std::size_t n,
@@ -370,6 +416,63 @@ double session::transform_reduce(const vector& v, const expr& op) {
   PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
   PyObject* kwargs = Py_BuildValue("{s:O}", "transform_op", fn);
   PyObject* r = must(PyObject_Call(tr, args, kwargs), "transform_reduce");
+  double out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(tr);
+  Py_DECREF(fn);
+  return out;
+}
+
+// -------------------------------------------- custom-op escape hatch
+
+void session::transform(const vector& in, vector& out,
+                        const custom_op& op) {
+  PyObject* fn = impl_->op(op);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "transform", "OOO",
+                          (PyObject*)in.obj_, (PyObject*)out.obj_, fn),
+      "transform(custom)");
+  Py_DECREF(r);
+  Py_DECREF(fn);
+}
+
+void session::transform2(const vector& a, const vector& b, vector& out,
+                         const custom_op& op) {
+  PyObject* zv = must(
+      PyObject_CallMethod(impl_->views, "zip", "OO",
+                          (PyObject*)a.obj_, (PyObject*)b.obj_),
+      "views.zip");
+  PyObject* fn = impl_->op(op);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "transform", "OOO", zv,
+                          (PyObject*)out.obj_, fn),
+      "transform(zip, custom)");
+  Py_DECREF(r);
+  Py_DECREF(fn);
+  Py_DECREF(zv);
+}
+
+void session::for_each(vector& v, const custom_op& op) {
+  PyObject* fn = impl_->op(op);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "for_each", "OO",
+                          (PyObject*)v.obj_, fn),
+      "for_each(custom)");
+  Py_DECREF(r);
+  Py_DECREF(fn);
+}
+
+double session::transform_reduce(const vector& v, const custom_op& op) {
+  PyObject* fn = impl_->op(op);
+  PyObject* tr = must(
+      PyObject_GetAttrString(impl_->dr, "transform_reduce"),
+      "transform_reduce attr");
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "transform_op", fn);
+  PyObject* r = must(PyObject_Call(tr, args, kwargs),
+                     "transform_reduce(custom)");
   double out = PyFloat_AsDouble(r);
   Py_DECREF(r);
   Py_DECREF(kwargs);

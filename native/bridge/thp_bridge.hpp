@@ -46,6 +46,28 @@ namespace thp {
 
 class session;
 
+// Element dtype of a device container.  The reference templates its
+// containers over T (mhp/containers/distributed_vector.hpp:176); the
+// bridge keys the DEVICE dtype (what occupies HBM and feeds the
+// MXU/VPU) while the host interchange stays double — to_host()
+// converts on the way out, scalar arguments convert on the way in.
+// f32 is the default (TPU-native; also what pre-dtype bridge versions
+// allocated); f64 needs an x64-enabled CPU backend.
+enum class dtype { f32, f64, i32 };
+
+// Multi-process SPMD membership (the MHP dimension): every process
+// constructs a session with the SAME coordinator and runs the SAME
+// program in the same order — the discipline the reference gets from
+// MPI (mhp/global.hpp:24-28, mpiexec -n {1..4} test sweeps).  Backed
+// by jax.distributed over DCN; the global mesh spans
+// num_processes * ncpu_devices devices.
+struct distributed {
+  std::string coordinator;   // "host:port" (process 0 binds it)
+  int num_processes = 1;
+  int process_id = 0;
+  int ncpu_devices = 1;      // per-process virtual CPU devices (tests)
+};
+
 // ---------------------------------------------------------------------
 // expression DSL: value-semantics nodes serializing to canonical strings
 // ---------------------------------------------------------------------
@@ -90,6 +112,20 @@ expr pow(const expr& a, const expr& b);
 // ready-made placeholders (x0 = first range/zip component, ...)
 extern const expr x0, x1, x2, x3;
 
+// Escape hatch (SURVEY §7 hard-part 2, option b): an op the arithmetic
+// DSL cannot express, written as jax-traceable Python source that
+// evaluates to a callable of `nargs` placeholders — conditionals,
+// comparisons, clips, casts, anything traceable.  `jnp`, `lax`, `np`
+// are in scope.  Same trust boundary as session::exec (the C++ caller
+// owns the embedded interpreter); compiled once per (source, nargs)
+// Python-side so program caches stay warm across calls.
+//   thp::custom_op leaky{"lambda x0: jnp.where(x0 > 0, x0, 0.01*x0)", 1};
+//   s.for_each(v, leaky);
+struct custom_op {
+  std::string source;
+  int nargs = 1;
+};
+
 // ---------------------------------------------------------------------
 // containers: move-only handles owning a PyObject* of the dr_tpu object
 // ---------------------------------------------------------------------
@@ -115,19 +151,22 @@ class vector : public detail::handle {
  public:
   vector() = default;
   std::size_t size() const { return n_; }
+  dtype element_dtype() const { return dt_; }
 
   void iota(double start);
   void fill(double value);
   double reduce() const;
   void halo_exchange();
-  // buffer-protocol host copy: ONE contiguous memcpy, no element boxing
+  // buffer-protocol host copy: ONE contiguous memcpy, no element
+  // boxing; non-f64 device dtypes convert numpy-side on the way out
   std::vector<double> to_host() const;
 
  private:
   friend class session;
-  vector(session* s, void* obj, std::size_t n)
-      : handle(s, obj), n_(n) {}
+  vector(session* s, void* obj, std::size_t n, dtype dt = dtype::f32)
+      : handle(s, obj), n_(n), dt_(dt) {}
   std::size_t n_ = 0;
+  dtype dt_ = dtype::f32;
 };
 
 class dense_matrix : public detail::handle {
@@ -181,6 +220,10 @@ class session {
   // ncpu_devices > 0: force a virtual CPU mesh of that size (testing);
   // ncpu_devices == 0: use the real device platform (TPU under the driver).
   explicit session(int ncpu_devices = 0);
+  // multi-process SPMD member: joins the coordinator's global mesh
+  // (dr_tpu.init_distributed / jax.distributed underneath).  All
+  // processes must make the same calls in the same order.
+  explicit session(const distributed& d);
   ~session();
   session(const session&) = delete;
   session& operator=(const session&) = delete;
@@ -189,7 +232,8 @@ class session {
 
   // containers
   vector make_vector(std::size_t n, std::size_t halo_prev = 0,
-                     std::size_t halo_next = 0, bool periodic = false);
+                     std::size_t halo_next = 0, bool periodic = false,
+                     dtype dt = dtype::f32);
   dense_matrix make_dense(std::size_t m, std::size_t n,
                           const std::vector<double>& row_major = {});
   sparse_matrix make_sparse_coo(std::size_t m, std::size_t n,
@@ -206,6 +250,13 @@ class session {
   void for_each(vector& v, const expr& op);
   double transform_reduce(const vector& v, const expr& op);
   double dot(const vector& a, const vector& b);
+
+  // the same algorithms with the custom-op escape hatch
+  void transform(const vector& in, vector& out, const custom_op& op);
+  void transform2(const vector& a, const vector& b, vector& out,
+                  const custom_op& op);
+  void for_each(vector& v, const custom_op& op);
+  double transform_reduce(const vector& v, const custom_op& op);
 
   // prefix scans (add monoid — the reference's inclusive_scan surface)
   void inclusive_scan(const vector& in, vector& out);

@@ -2,9 +2,12 @@
 grammar validation, numeric parity with numpy, and the identity-caching
 contract the algorithm-layer program caches rely on."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import dr_tpu
 from dr_tpu.utils.expr import op_from_expr
 
 
@@ -43,3 +46,33 @@ def test_scientific_literals_ok():
     x = np.ones(8, np.float32)
     np.testing.assert_allclose(np.asarray(f(x)), x * 1e-3 + 250.0,
                                rtol=1e-6)
+
+
+def test_op_from_source_escape_hatch():
+    """Full-Python custom ops (SURVEY §7 hard-part 2 option b): jax-
+    traceable source the arithmetic DSL cannot express, cached by
+    (source, nargs) so identity-keyed program caches stay warm."""
+    from dr_tpu.utils.expr import op_from_source
+    src = "lambda x0: jnp.where(x0 > 0, x0, 0.01 * x0)"
+    fn = op_from_source(src, 1)
+    assert fn is op_from_source(src, 1)  # identity-stable
+    x = jnp.asarray([-2.0, 3.0], jnp.float32)
+    np.testing.assert_allclose(np.asarray(fn(x)), [-0.02, 3.0])
+    # traceable under jit
+    np.testing.assert_allclose(np.asarray(jax.jit(fn)(x)), [-0.02, 3.0])
+    # arity mismatch is a loud error
+    with pytest.raises(ValueError):
+        op_from_source("lambda x0, x1: x0 + x1", 1)
+    with pytest.raises(TypeError):
+        op_from_source("42", 1)
+
+
+def test_op_from_source_drives_algorithms():
+    src_clip = "lambda x0: jnp.clip(x0, 0.0, 6.0)"
+    from dr_tpu.utils.expr import op_from_source
+    v = dr_tpu.distributed_vector(32)
+    dr_tpu.iota(v, -16)
+    out = dr_tpu.distributed_vector(32)
+    dr_tpu.transform(v, out, op_from_source(src_clip, 1))
+    ref = np.clip(np.arange(-16, 16, dtype=np.float32), 0.0, 6.0)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref)
