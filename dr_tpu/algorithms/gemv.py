@@ -366,6 +366,26 @@ def _bcsr_local_mm(bvals0, bcols0, B, seg_out):
     return local.reshape(-1, nv)[:seg_out]
 
 
+def _local_mm_parts(rt, a, th, kdim, bcsr):
+    """(local_fn, in_specs, device_args) for one shard's multi-vector
+    contraction — shared by spmm and spmm_n.  local_fn closes over the
+    INT width, never the matrix: the process-lifetime program cache
+    must not pin device buffers through the body closure."""
+    if bcsr:
+        def local_of(vals, cols, B):
+            return _bcsr_local_mm(vals[0], cols[0], B, th)
+        in_specs = (P(rt.axis, None, None, None, None),
+                    P(rt.axis, None, None), P())
+        args = (a._bcsr_vals, a._bcsr_cols)
+    else:
+        def local_of(vals, cols, B, kdim=kdim):
+            return _ell_local_mm(vals[0], cols[0], B, th, kdim)
+        in_specs = (P(rt.axis, None, None),
+                    P(rt.axis, None, None), P())
+        args = (a._ell_vals, a._ell_cols)
+    return local_of, in_specs, args
+
+
 def _spmm_w_key():
     """Cache-key component for the SpMM gather width: the raw env
     override (not env_int, whose floor collapses unset and '1') plus
@@ -401,27 +421,15 @@ def spmm(a: sparse_matrix, b) -> jax.Array:
         kdim = a._bcsr_kb if bcsr else a._ell_width
         key = ("spmm", pinned_id(rt.mesh), rt.axis, a.nshards, th,
                kdim, bcsr, nv, m, _spmm_w_key())
+        local_of, in_specs, args = _local_mm_parts(rt, a, th, kdim,
+                                                   bcsr)
         prog = _prog_cache.get(key)
         if prog is None:
-            if bcsr:
-                def body(bvals, bcols, B):
-                    return _bcsr_local_mm(bvals[0], bcols[0], B, th)
-                in_specs = (P(rt.axis, None, None, None, None),
-                            P(rt.axis, None, None), P())
-            else:
-                # close over the INT width, never the matrix: the
-                # process-lifetime program cache must not pin device
-                # buffers through the body closure
-                def body(vals, cols, B, kdim=kdim):
-                    return _ell_local_mm(vals[0], cols[0], B, th, kdim)
-                in_specs = (P(rt.axis, None, None),
-                            P(rt.axis, None, None), P())
-            shm = jax.shard_map(body, mesh=rt.mesh, in_specs=in_specs,
+            shm = jax.shard_map(local_of, mesh=rt.mesh,
+                                in_specs=in_specs,
                                 out_specs=P(rt.axis, None))
             prog = jax.jit(shm)
             _prog_cache[key] = prog
-        args = (a._bcsr_vals, a._bcsr_cols) if bcsr \
-            else (a._ell_vals, a._ell_cols)
         return prog(*args, B)[:m]
     # general grids: one flat gemv per column (correct everywhere)
     cols = [flat_gemv(a, B[:, j]) for j in range(nv)]
@@ -446,20 +454,9 @@ def spmm_n(a: sparse_matrix, b, iters: int) -> jax.Array:
     kdim = a._bcsr_kb if bcsr else a._ell_width
     key = ("spmm_n", pinned_id(rt.mesh), rt.axis, a.nshards, th, kdim,
            bcsr, nv, m, int(iters), _spmm_w_key())
+    local_of, in_specs, args = _local_mm_parts(rt, a, th, kdim, bcsr)
     prog = _prog_cache.get(key)
     if prog is None:
-        if bcsr:
-            def local_of(vals, cols, B):
-                return _bcsr_local_mm(vals[0], cols[0], B, th)
-            in_specs = (P(rt.axis, None, None, None, None),
-                        P(rt.axis, None, None), P())
-        else:
-            # close over the INT width, never the matrix (see spmm)
-            def local_of(vals, cols, B, kdim=kdim):
-                return _ell_local_mm(vals[0], cols[0], B, th, kdim)
-            in_specs = (P(rt.axis, None, None),
-                        P(rt.axis, None, None), P())
-
         def body(vals, cols, B):
             # both local bodies accumulate in (at least) f32: the loop
             # carry must match that promoted dtype, not B's
@@ -478,8 +475,6 @@ def spmm_n(a: sparse_matrix, b, iters: int) -> jax.Array:
                             out_specs=P(rt.axis, None))
         prog = jax.jit(shm)
         _prog_cache[key] = prog
-    args = (a._bcsr_vals, a._bcsr_cols) if bcsr \
-        else (a._ell_vals, a._ell_cols)
     return prog(*args, B)[:m]
 
 

@@ -222,29 +222,41 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                     mine = local[jnp.clip(nvalid - 1, 0, S - 1)]
                     totals = lax.all_gather(mine, axis)
                     nonempty = [i for i in range(nshards) if sizes[i] > 0]
-                    first = nonempty[0] if nonempty else 0
+                    first_nz = nonempty[0] if nonempty else 0
 
                     def fold(i, acc):
                         use = jnp.logical_and(i < r, sizes_c[i] > 0)
                         return jnp.where(use, combine(acc, totals[i]),
                                          acc)
-                    carry = lax.fori_loop(first + 1, nshards, fold,
-                                          totals[first])
-                    scanned = jnp.where(r > first,
-                                        combine(carry, local), local)
+                    ue_carry = lax.fori_loop(first_nz + 1, nshards, fold,
+                                             totals[first_nz])
+                    scanned = jnp.where(r > first_nz,
+                                        combine(ue_carry, local), local)
         if exclusive and (use_kernel or kind is None):
-            # positional shift with the previous shard's last value via
-            # ppermute — valid on uniform ceil layouts (a nonempty
-            # shard's predecessor is always full there); the
-            # identity-bearing XLA path above seeds locally instead,
-            # and uneven layouts without an identity take the fallback
-            shifted = jnp.roll(scanned, 1)
-            prev_rank_last = lax.ppermute(
-                scanned[-1], axis,
-                [(i, i + 1) for i in range(nshards - 1)])
-            first = prev_rank_last if ident is None else \
-                jnp.where(r > 0, prev_rank_last, ident)
-            scanned = shifted.at[0].set(first)
+            if kind is None and not (exact or uniform_layout(layout)):
+                # uneven identityless: my first exclusive value is the
+                # global prefix through the nearest preceding NONEMPTY
+                # shard — exactly ue_carry (its fold skips empty
+                # shards, which a neighbor ppermute could not).  The
+                # first nonempty shard seeds the fallback's dtype zero
+                # (overwritten when exclusive_scan folds an init).
+                shifted = jnp.roll(scanned, 1)
+                scanned = shifted.at[0].set(
+                    jnp.where(r > first_nz, ue_carry,
+                              jnp.zeros((), scanned.dtype)))
+            else:
+                # positional shift with the previous shard's last value
+                # via ppermute — valid on uniform ceil layouts (a
+                # nonempty shard's predecessor is always full there);
+                # the identity-bearing XLA path above seeds locally
+                # instead
+                shifted = jnp.roll(scanned, 1)
+                prev_rank_last = lax.ppermute(
+                    scanned[-1], axis,
+                    [(i, i + 1) for i in range(nshards - 1)])
+                first = prev_rank_last if ident is None else \
+                    jnp.where(r > 0, prev_rank_last, ident)
+                scanned = shifted.at[0].set(first)
         if prev == 0 and nxt == 0 and cap == S:
             # halo-free row: the scan IS the whole padded row — no
             # zeros+set copy pass (one fewer HBM pass on the hot path)
@@ -272,15 +284,13 @@ def _scan(in_r, out, op, init, exclusive):
         ins is not None and len(ins) == 1 and not ins[0].ops
         and ins[0].off == 0 and out_chain.off == 0
         and ins[0].cont.layout == out_chain.cont.layout
-        # the shard_map program handles any uniform ceil layout; uneven
-        # block distributions run natively for ops WITH an identity
-        # (pad masking) and, for INCLUSIVE scans, identityless ops too
-        # (real totals at local[valid-1], empty-shard-skipping fold —
-        # _scan_program).  Only exclusive+identityless+uneven still
-        # takes the logical-array fallback (its first output needs an
-        # identity the op cannot provide).
-        and (uniform_layout(ins[0].cont.layout) or kind is not None
-             or not exclusive)
+        # the shard_map program handles any uniform ceil layout, and
+        # uneven block distributions for EVERY op: identity ops mask
+        # pads; identityless ops read real totals at local[valid-1]
+        # with an empty-shard-skipping fold (round 4 — the exclusive
+        # variant seeds shard boundaries from that same fold, so no
+        # identity is ever required).  Only windows/view chains
+        # materialize now.
         and ins[0].n == len(ins[0].cont)
         # the fast program rebuilds the whole output array, so the output
         # window must cover the whole container too
@@ -299,13 +309,8 @@ def _scan(in_r, out, op, init, exclusive):
         scanned = None
     else:
         from ..utils.fallback import warn_fallback
-        if (ins is not None and len(ins) == 1
-                and not uniform_layout(ins[0].cont.layout)
-                and kind is None and exclusive):
-            why = "exclusive identityless op on an uneven layout"
-        else:
-            why = "subrange window, view chain, or layout mismatch"
-        warn_fallback("scan", why)
+        warn_fallback("scan", "subrange window, view chain, or layout "
+                      "mismatch")
         arr = in_r.to_array() if hasattr(in_r, "to_array") \
             else jnp.asarray(in_r)
         combine = combine_for(kind, op)

@@ -96,13 +96,29 @@ def op_from_source(src: str, nargs: int):
         raise TypeError(f"custom op source is not callable: {src!r}")
     import inspect
     try:
-        sig_n = len(inspect.signature(fn).parameters)
+        params = inspect.signature(fn).parameters.values()
     except (TypeError, ValueError):
-        sig_n = nargs
-    if sig_n != nargs:
-        raise ValueError(
-            f"custom op takes {sig_n} args, bridge declared {nargs}")
-    fn.__name__ = f"thp_custom_{abs(hash((src, nargs))) % 10 ** 8}"
+        params = None  # builtins/ufuncs: trust the declared arity
+    if params is not None:
+        # the op is CALLED with exactly nargs positionals: reject only
+        # genuinely incompatible signatures (required > nargs, or more
+        # positionals than accepted without *args)
+        required = sum(
+            p.default is p.empty
+            and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            for p in params)
+        max_pos = sum(p.kind in (p.POSITIONAL_ONLY,
+                                 p.POSITIONAL_OR_KEYWORD)
+                      for p in params)
+        var_pos = any(p.kind == p.VAR_POSITIONAL for p in params)
+        if required > nargs or (not var_pos and max_pos < nargs):
+            raise ValueError(
+                f"custom op signature incompatible with {nargs} "
+                f"positional args: {src!r}")
+    try:
+        fn.__name__ = f"thp_custom_{abs(hash((src, nargs))) % 10 ** 8}"
+    except (AttributeError, TypeError):
+        pass  # ufuncs and some builtins have read-only names
     return fn
 
 

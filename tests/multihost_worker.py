@@ -76,6 +76,12 @@ dr_tpu.fill(c, 0.0)
 dr_tpu.gemv(c, A, bv)
 np.testing.assert_allclose(dr_tpu.to_numpy(c), np.full(m, 3.0), rtol=1e-6)
 
+# multi-vector SpMM (round 4): each row of A holds a single 1, so the
+# product replicates B's rows — valid on every process
+Bmm = np.tile(np.array([1.0, 2.0], np.float32), (m, 1))
+Ymm = np.asarray(dr_tpu.spmm(A, Bmm))
+np.testing.assert_allclose(Ymm, Bmm, rtol=1e-6)
+
 # fused measurement family must be SPMD-safe (every process runs the
 # same chained program; psum keeps results identical everywhere)
 from dr_tpu.algorithms.reduce import dot_n  # noqa: E402

@@ -267,8 +267,10 @@ def test_identityless_scan_on_uneven_is_native(monkeypatch, oracle):
     a = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
     a.assign_array(src)
     out = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
+    ex = dr_tpu.distributed_vector(n, np.float32, distribution=sizes)
     _no_materialize(monkeypatch)
     dr_tpu.inclusive_scan(a, out, op)
+    dr_tpu.exclusive_scan(a, ex, init=None, op=op)
     monkeypatch.undo()
     ref = np.empty(n, np.float32)
     acc = src[0]
@@ -277,6 +279,11 @@ def test_identityless_scan_on_uneven_is_native(monkeypatch, oracle):
         acc = acc + src[i] + acc * src[i] * 0.25
         ref[i] = acc
     np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=2e-4,
+                               atol=1e-4)
+    # exclusive (no init): global shift of the inclusive result with a
+    # dtype zero at position 0 — across the EMPTY shard boundary too
+    exref = np.concatenate([[0.0], ref[:-1]]).astype(np.float32)
+    np.testing.assert_allclose(dr_tpu.to_numpy(ex), exref, rtol=2e-4,
                                atol=1e-4)
 
 

@@ -65,6 +65,16 @@ def test_op_from_source_escape_hatch():
         op_from_source("lambda x0, x1: x0 + x1", 1)
     with pytest.raises(TypeError):
         op_from_source("42", 1)
+    # compatible signatures are NOT rejected: defaulted extras, *args,
+    # and signatureless ufuncs all accept nargs positionals
+    f2 = op_from_source(
+        "lambda x0, alpha=0.5: jnp.where(x0 > 0, x0, alpha * x0)", 1)
+    np.testing.assert_allclose(np.asarray(f2(jnp.asarray([-2.0]))),
+                               [-1.0])
+    f3 = op_from_source("lambda *xs: xs[0] + xs[1]", 2)
+    assert float(f3(jnp.asarray(1.0), jnp.asarray(2.0))) == 3.0
+    f4 = op_from_source("jnp.abs", 1)  # read-only __name__: no crash
+    assert float(f4(jnp.asarray(-3.0))) == 3.0
 
 
 def test_op_from_source_drives_algorithms():

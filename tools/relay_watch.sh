@@ -25,13 +25,14 @@ set -u
 cd "$(dirname "$0")/.."
 log() { echo "[relay_watch $(date +%H:%M:%S)] $*" >> tools/relay_watch.log; }
 
-port_open() {
+port_open() {  # same knob as bench.py's _relay_listening
   python - <<'PY'
-import socket, sys
+import os, socket, sys
 s = socket.socket()
 s.settimeout(3)
 try:
-    s.connect(("127.0.0.1", 8082))
+    s.connect(("127.0.0.1",
+               int(os.environ.get("DR_TPU_RELAY_PROBE_PORT", "8082"))))
     sys.exit(0)
 except Exception:
     sys.exit(1)

@@ -333,9 +333,14 @@ def tune_container(name):
             def run(r):
                 dr_tpu.gemv_n(c, A, bv, r)
                 _sync(c)
-            dt = _marginal(run, 2, r2)
-            print(f"bcsr spmv r2={r2}: {2.0 * len(ii) / dt / 1e9:.2f} "
-                  f"GFLOP/s", flush=True)
+            try:
+                dt = _marginal(run, 2, r2)
+                print(f"bcsr spmv r2={r2}: "
+                      f"{2.0 * len(ii) / dt / 1e9:.2f} GFLOP/s",
+                      flush=True)
+            except Exception as e:
+                print(f"bcsr spmv r2={r2}: FAIL {_errline(e)}",
+                      flush=True)
         # random pattern x multiple vectors: the gather-amortization
         # surface (nv slices of work per gather issue; PERF.md roofline)
         mr, kr = 2 ** 17, 32
