@@ -98,6 +98,7 @@ def test_marginal_fast_path_no_widening(monkeypatch):
     ("vector_add", ["-n", "4096"]),
     ("dot_product", ["-n", "4096"]),
     ("inclusive_scan_example", ["-n", "4096"]),
+    ("spmm_example", ["-m", "512", "-k", "4", "--nv", "3"]),
     ("sort_example", ["-n", "4096"]),
     ("sort_example", ["-n", "4097", "--descending"]),
     ("top_k", ["-n", "4099", "-k", "5"]),

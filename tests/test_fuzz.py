@@ -137,7 +137,7 @@ def test_fuzz_distributions(seed):
         src = rng.standard_normal(n).astype(np.float32)
         dv = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
         alg = rng.choice(["roundtrip", "transform", "reduce", "scan",
-                          "sort", "putget", "axpy"])
+                          "sort", "putget", "axpy", "cscan"])
         if alg == "roundtrip":
             np.testing.assert_allclose(dr_tpu.to_numpy(dv), src,
                                        rtol=1e-6)
@@ -168,6 +168,28 @@ def test_fuzz_distributions(seed):
             np.testing.assert_array_equal(dr_tpu.to_numpy(dv),
                                           np.sort(src))
             assert dr_tpu.is_sorted(dv)
+        elif alg == "cscan":
+            # identityless custom op over the uneven distribution:
+            # round 4 runs these NATIVELY (inclusive and exclusive)
+            out = dr_tpu.distributed_vector(n, np.float32,
+                                            distribution=sizes)
+            excl = bool(rng.integers(0, 2))
+            if excl:
+                dr_tpu.exclusive_scan(dv, out, init=None, op=_fuzz_chain)
+            else:
+                dr_tpu.inclusive_scan(dv, out, op=_fuzz_chain)
+            ref = np.empty(n, np.float32)
+            acc = src[0]
+            ref[0] = acc
+            for i in range(1, n):
+                acc = np.float32(acc + src[i]
+                                 + acc * src[i] * np.float32(0.25))
+                ref[i] = acc
+            if excl:
+                ref = np.concatenate(
+                    [[np.float32(0.0)], ref[:-1]]).astype(np.float32)
+            np.testing.assert_allclose(dr_tpu.to_numpy(out), ref,
+                                       rtol=2e-3, atol=2e-3)
         elif alg == "axpy":
             # traced scalar over an uneven distribution: same-layout zip
             p_src = rng.standard_normal(n).astype(np.float32)
@@ -524,3 +546,33 @@ def test_fuzz_misaligned_zip_fallback(seed):
         got = dr_tpu.dot(a, b)
         ref = float(a_src.astype(np.float64) @ b_src.astype(np.float64))
         assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)
+
+
+def _fuzz_chain(a, b):
+    """Unclassified (identityless) fold for the distribution fuzz."""
+    return a + b + a * b * np.float32(0.25)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_spmm(seed):
+    """Multi-vector SpMM over random patterns, nv widths, and (banded)
+    BCSR-eligible shapes vs the dense oracle."""
+    rng = np.random.default_rng(900 + seed)
+    for it in range(max(4, ITERS // 6)):
+        m = int(rng.integers(8, 200))
+        nn = int(rng.integers(8, 200))
+        nv = int(rng.integers(1, 7))
+        k = int(rng.integers(1, 6))
+        rows = np.repeat(np.arange(m), k)
+        cols = rng.integers(0, nn, size=m * k)
+        vals = rng.standard_normal(m * k).astype(np.float32)
+        A = dr_tpu.sparse_matrix.from_coo((m, nn), rows, cols, vals)
+        B = rng.standard_normal((nn, nv)).astype(np.float32)
+        dense = np.zeros((m, nn), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        got = np.asarray(dr_tpu.spmm(A, B))
+        np.testing.assert_allclose(got, dense @ B, rtol=2e-4,
+                                   atol=2e-4)
+        # chained-measurement program agrees with the one-shot product
+        got_n = np.asarray(dr_tpu.spmm_n(A, B, int(rng.integers(1, 4))))
+        np.testing.assert_allclose(got_n, got, rtol=2e-4, atol=2e-4)
