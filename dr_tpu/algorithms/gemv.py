@@ -395,6 +395,53 @@ def _spmm_w_key():
     return (os.environ.get("DR_TPU_SPMM_W", ""), _gather_w())
 
 
+def _spmm2d_program(rt, grid, th, tw, kdim, bcsr, m, n, nv):
+    """SpMM on a 2-D tile grid: per-tile multi-vector contraction
+    (:func:`_bcsr_local_mm` / :func:`_ell_local_mm`) against the tile's
+    LOCAL B row-window, then partials ``psum`` over the mesh columns —
+    the spmm analog of :func:`_gemv2d_bcsr_program`."""
+    gp, gq = grid
+    mesh2 = rt.mesh2d(grid)
+    key = ("spmm2d", pinned_id(mesh2), grid, th, tw, kdim, bcsr, m, n,
+           nv, _spmm_w_key())
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    cspec = P("mr", "mc", None, None)
+    if bcsr:
+        def local_of(vals, cols, B2):
+            return _bcsr_local_mm(vals[0, 0], cols[0, 0], B2[0], th)
+        vspec = P("mr", "mc", None, None, None, None)
+    else:
+        def local_of(vals, cols, B2, kdim=kdim):
+            return _ell_local_mm(vals[0, 0], cols[0, 0], B2[0], th,
+                                 kdim)
+        vspec = cspec
+
+    def body(vals, cols, B2):
+        y = jax.lax.psum(local_of(vals, cols, B2), "mc")
+        return y[None]                               # (1, th, nv)
+
+    shm = jax.shard_map(
+        body, mesh=mesh2,
+        in_specs=(vspec, cspec, P("mc", None, None)),
+        out_specs=P("mr", None, None))
+
+    def run(vals, cols, B):
+        shape = vals.shape
+        v = vals.reshape(gp, gq, *shape[1:])
+        c4 = cols.reshape(gp, gq, *cols.shape[1:])
+        pad = gq * tw - B.shape[0]
+        Bp = jnp.pad(B, ((0, pad), (0, 0))) if pad else B
+        return shm(v, c4, Bp.reshape(gq, tw, -1)).reshape(
+            -1, B.shape[1])[:m]
+
+    prog = jax.jit(run)
+    _prog_cache[key] = prog
+    return prog
+
+
 def spmm(a: sparse_matrix, b) -> jax.Array:
     """A·B for a row-tiled sparse A and a DENSE (n, nv) right-hand side
     — the multi-vector SpMV.  Returns the (m, nv) product as an array.
@@ -431,7 +478,17 @@ def spmm(a: sparse_matrix, b) -> jax.Array:
             prog = jax.jit(shm)
             _prog_cache[key] = prog
         return prog(*args, B)[:m]
-    # general grids: one flat gemv per column (correct everywhere)
+    if a.grid_shape[1] > 1:
+        bcsr2 = a.ensure_bcsr()
+        if bcsr2 or a.ensure_ell():
+            prog = _spmm2d_program(
+                rt, a.grid_shape, a.tile_rows, a.tile_cols,
+                a._bcsr_kb if bcsr2 else a._ell_width, bcsr2,
+                m, n, nv)
+            args = (a._bcsr_vals, a._bcsr_cols) if bcsr2 \
+                else (a._ell_vals, a._ell_cols)
+            return prog(*args, B)
+    # degenerate layouts: one flat gemv per column (correct everywhere)
     cols = [flat_gemv(a, B[:, j]) for j in range(nv)]
     return jnp.stack(cols, axis=1)
 

@@ -403,8 +403,9 @@ def test_spmm_single_column_matches_gemv():
                                atol=1e-5)
 
 
-def test_spmm_2d_grid_fallback():
-    """General tile grids take the per-column flat path."""
+def test_spmm_2d_grid_native():
+    """2-D tile grids run the per-tile partial + psum program (round
+    4), not the per-column flat fallback."""
     m = n = 64
     rows, cols, vals = _rand_coo(m, n, 2, seed=11)
     A = dr_tpu.sparse_matrix.from_coo(
@@ -414,8 +415,40 @@ def test_spmm_2d_grid_fallback():
     B = np.random.default_rng(5).standard_normal((n, 3)).astype(np.float32)
     dense = np.zeros((m, n), np.float32)
     np.add.at(dense, (rows, cols), vals)
-    got = np.asarray(dr_tpu.spmm(A, B))
+    # pin THIS call to the native program: a fall-through to the flat
+    # per-column path would call flat_gemv
+    import importlib
+    gemv_mod = importlib.import_module("dr_tpu.algorithms.gemv")
+
+    def no_flat(*a, **kw):
+        raise AssertionError("2-D spmm fell back to flat_gemv")
+    real = gemv_mod.flat_gemv
+    gemv_mod.flat_gemv = no_flat
+    try:
+        got = np.asarray(dr_tpu.spmm(A, B))
+    finally:
+        gemv_mod.flat_gemv = real
     np.testing.assert_allclose(got, dense @ B, rtol=2e-5, atol=1e-5)
+
+
+def test_spmm_2d_skewed_flat_fallback():
+    """A skewed 2-D matrix (one huge row defeats the ELL pad budget)
+    takes the per-column flat path and stays correct."""
+    m = n = 64
+    rows = np.concatenate([np.zeros(n, np.int64), np.arange(m)])
+    cols = np.concatenate([np.arange(n), np.zeros(m, np.int64)])
+    vals = np.random.default_rng(3).standard_normal(
+        len(rows)).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo(
+        (m, n), rows, cols, vals,
+        partition=dr_tpu.block_cyclic(
+            grid=dr_tpu.factor(dr_tpu.nprocs())))
+    B = np.random.default_rng(4).standard_normal((n, 2)).astype(
+        np.float32)
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    got = np.asarray(dr_tpu.spmm(A, B))
+    np.testing.assert_allclose(got, dense @ B, rtol=2e-4, atol=2e-4)
 
 
 def test_spmm_rejects_bad_shapes():
