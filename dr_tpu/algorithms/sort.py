@@ -33,10 +33,12 @@ Uneven ``block_distribution`` layouts (including zero-size "team"
 shards) run the SAME program: the geometry enters as static per-shard
 starts/sizes, phase 5 rebalances into the destination distribution's
 windows, and the bucket matrices stay overflow-free (a source's bucket
-never exceeds its own real count).  The fallback (subrange windows,
-float64) materializes the logical array, sorts it with XLA's global
-sort, and splices it back — correct everywhere, collective-optimal
-nowhere.
+never exceeds its own real count).  Subrange windows run the SAME
+program in window-relative coordinates (round 4): the window's shard
+intersections are static uneven geometry, and a masked row blend
+leaves outside cells untouched bit-exactly.  Only float64 keys
+materialize the logical array, sort it with XLA's global sort, and
+splice it back — correct, collective-optimal nowhere.
 The write target must be a ``distributed_vector`` or a subrange window
 over one; transform views and other read-only ranges are rejected with
 ``TypeError`` (sorting them in place has no meaning).
@@ -50,7 +52,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import working_geometry
+from ._common import owned_window_mask, working_geometry
 from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from ..core.pinning import pinned_id
 from ..utils.fallback import warn_fallback
@@ -110,15 +112,40 @@ def _pack_row(row, layout, dtype):
     return out.at[0, prev:prev + S].set(row.astype(dtype))
 
 
+def _window_geometry(layout, off, wn):
+    """Window-coordinate geometry: the logical window [off, off+wn)
+    intersected with each shard's owned span.  Everything is STATIC
+    (numpy over the layout's python ints): ``wstart`` is each shard's
+    local offset of its window slice, ``wsize`` its width, ``vstarts``
+    the exclusive prefix of widths — i.e. the window re-expressed as an
+    uneven block distribution of length ``wn``, which the sample-sort
+    program already speaks natively."""
+    p, _, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
+    starts = np.asarray(starts)
+    sizes = np.asarray(sizes)
+    wstart = np.clip(off - starts, 0, sizes)
+    wsize = np.clip(off + wn - starts, 0, sizes) - wstart
+    vstarts = np.concatenate(([0], np.cumsum(wsize)[:-1]))
+    S = max(int(wsize.max(initial=0)), 1)
+    return p, S, cap, prev, nxt, wn, vstarts, wsize, wstart
+
+
 def _sort_program(mesh, axis, layout, dtype, descending,
-                  pay_layout=None, pay_dtype=None):
+                  pay_layout=None, pay_dtype=None, window=None):
     """The sample-sort program; with ``pay_layout`` set it carries a
     payload row through every phase (stable key-value sort — the
     payload rides the same collectives, tie order preserved by
-    ``is_stable`` sorts and the source-major merge order)."""
+    ``is_stable`` sorts and the source-major merge order).
+
+    ``window=(off, wn)`` sorts ONLY the logical subrange [off, off+wn)
+    in place (round 4 — windows used to materialize): the window's
+    shard intersections form a static uneven geometry the same phases
+    run over, each shard reads its slice at a static per-shard offset,
+    and the output row blends sorted window cells with untouched
+    originals through the static owned_window_mask."""
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
            bool(descending), pay_layout,
-           str(pay_dtype) if pay_layout else None)
+           str(pay_dtype) if pay_layout else None, window)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -126,7 +153,17 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     # general geometry: uniform ceil layouts AND uneven
     # block_distributions share one program shape — S is the max owned
     # width, starts/sizes the per-shard logical windows
-    p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
+    if window is None:
+        p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
+        wstart = None
+    else:
+        assert pay_layout is None, "windowed sort is keys-only"
+        p, S, cap, prev, nxt, n, starts, sizes, wstart = \
+            _window_geometry(layout, *window)
+        width = prev + cap + nxt
+        woff_c = jnp.asarray(wstart, jnp.int32)
+        mask_c = jnp.asarray(
+            np.asarray(owned_window_mask(layout, *window)[0]))
     pprev = pay_layout[2] if pay_layout else 0
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
@@ -134,12 +171,20 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     GMAX = np.int32(np.iinfo(np.int32).max)
 
     def body(blk, *pay):  # padded shard rows: keys (+ payload)
+        r = lax.axis_index(axis)
+        if window is None:
+            raw = blk[0, prev:prev + S]
+        else:
+            # my window slice, at a per-shard static offset (traced
+            # via the constant table); clip keeps the take in range,
+            # the nvalid mask discards the clipped tail
+            idx = jnp.clip(prev + woff_c[r] + jnp.arange(S), 0,
+                           width - 1)
+            raw = jnp.take(blk[0], idx)
         # keys-only sort is a bit-exact permutation (distinct -0.0/+0.0
         # keys); key-value sort collapses the zeros so ties keep
         # numpy-stable original order
-        key, big = _encode(blk[0, prev:prev + S],
-                           distinct_zeros=not pay)
-        r = lax.axis_index(axis)
+        key, big = _encode(raw, distinct_zeros=not pay)
         nvalid = jnp.minimum(sizes_c[r],
                              jnp.clip(n - starts_c[r], 0, S))
         gid = starts_c[r] + jnp.arange(S)
@@ -226,6 +271,15 @@ def _sort_program(mesh, axis, layout, dtype, descending,
                                jnp.zeros((), m.dtype))
                 return jnp.sum(lax.all_to_all(s2, axis, 0, 0), axis=0)
             outs = [rebalance(m) for m in (merged, *pmerged)]
+        if window is not None:
+            # blend: window cells take their sorted value (the window-
+            # coordinate result, re-addressed per full-row column),
+            # everything else keeps the original row
+            decoded = _decode(outs[0], dtype)
+            col_idx = jnp.clip(jnp.arange(width) - prev - woff_c[r],
+                               0, S - 1)
+            return jnp.where(mask_c[r], jnp.take(decoded, col_idx),
+                             blk[0])[None]
         out_rows = [_pack_row(_decode(outs[0], dtype), layout, dtype)]
         for row in outs[1:]:
             out_rows.append(_pack_row(row, pay_layout, pay_dtype))
@@ -246,22 +300,25 @@ def _sort_program(mesh, axis, layout, dtype, descending,
 def sort(r, *, descending: bool = False):
     """Sort a distributed range in place (rebinding), ascending by
     default.  ``r`` must be a ``distributed_vector`` or a subrange
-    window over one (the write target); whole containers — uniform or
-    uneven block distributions — take the single-program sample-sort
-    fast path, windows and f64 the materialize-and-splice fallback."""
+    window over one (the write target).  Whole containers AND subrange
+    windows — uniform or uneven block distributions — run the single
+    sample-sort shard_map program (windows in window-relative
+    coordinates with a masked row blend, round 4); only f64 keys take
+    the materialize-and-splice fallback (the key encoding upcasts
+    floats through f32)."""
     chain = _out_chain(r)
     cont = chain.cont
-    full = (chain.off == 0 and chain.n == len(cont)
-            # the key encoding upcasts floats through f32: exact for
-            # f32/bf16/f16, lossy for f64 — f64 takes the fallback
-            and jnp.dtype(cont.dtype) != jnp.dtype(np.float64))
-    if full:
-        prog = _sort_program(cont.runtime.mesh, cont.runtime.axis,
-                             cont.layout, cont.dtype, descending)
+    if jnp.dtype(cont.dtype) != jnp.dtype(np.float64):
+        full = chain.off == 0 and chain.n == len(cont)
+        if chain.n == 0:
+            return r
+        prog = _sort_program(
+            cont.runtime.mesh, cont.runtime.axis, cont.layout,
+            cont.dtype, descending,
+            window=None if full else (chain.off, chain.n))
         cont._data = prog(cont._data)
         return r
-    warn_fallback("sort", "subrange window" if chain.n != len(cont)
-                  or chain.off else "float64 keys")
+    warn_fallback("sort", "float64 keys")
     arr = cont.to_array()
     win = jnp.sort(arr[chain.off:chain.off + chain.n])
     if descending:

@@ -323,15 +323,23 @@ def test_fallbacks_warn_once(monkeypatch):
     from dr_tpu.utils.fallback import MaterializeFallbackWarning
     monkeypatch.setattr(fallback, "_seen", set())
     monkeypatch.delenv("DR_TPU_SILENCE_FALLBACKS", raising=False)
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("mixed distributions need >= 2 shards")
     n = 24
-    a = dr_tpu.distributed_vector.from_array(
-        np.random.default_rng(1).standard_normal(n).astype(np.float32))
-    win = a[4:12]
+    rng = np.random.default_rng(1)
+    sizes = list(dr_tpu.even_sizes(n, P))
+    sizes[0] += 1
+    sizes[-1] -= 1
+    k = dr_tpu.distributed_vector.from_array(
+        rng.standard_normal(n).astype(np.float32))
+    v = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32), distribution=sizes)
     with w.catch_warnings(record=True) as rec:
         w.simplefilter("always")
-        dr_tpu.sort(win)          # subrange window -> fallback, warns
-        dr_tpu.sort(win)          # same site: no second warning
+        dr_tpu.sort_by_key(k, v)   # mixed distributions -> fallback
+        dr_tpu.sort_by_key(k, v)   # same site: no second warning
     hits = [r for r in rec if issubclass(r.category,
                                          MaterializeFallbackWarning)]
     assert len(hits) == 1, [str(r.message) for r in rec]
-    assert "subrange window" in str(hits[0].message)
+    assert "different distributions" in str(hits[0].message)
