@@ -399,19 +399,32 @@ def argsort(r, *, descending: bool = False):
     return idx
 
 
-def _is_sorted_program(mesh, axis, layout, dtype, pinned):
-    key = ("is_sorted", pinned, axis, layout, str(dtype))
+def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
+    key = ("is_sorted", pinned, axis, layout, str(dtype), window)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
 
-    p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
+    if window is None:
+        p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
+        wstart = None
+    else:
+        p, S, cap, prev, nxt, n, starts, sizes, wstart = \
+            _window_geometry(layout, *window)
+        width = prev + cap + nxt
+        woff_c = jnp.asarray(wstart, jnp.int32)
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
 
     def body(blk):
-        k, big = _encode(blk[0, prev:prev + S])
         r = lax.axis_index(axis)
+        if window is None:
+            raw = blk[0, prev:prev + S]
+        else:
+            idx = jnp.clip(prev + woff_c[r] + jnp.arange(S), 0,
+                           width - 1)
+            raw = jnp.take(blk[0], idx)
+        k, big = _encode(raw)
         nvalid = jnp.minimum(sizes_c[r],
                              jnp.clip(n - starts_c[r], 0, S))
         k = jnp.where(jnp.arange(S) < nvalid, k, big)
@@ -453,17 +466,16 @@ def is_sorted(r) -> bool:
     chain = res[0] if res is not None and not res[0].ops else None
     if chain is not None:
         cont = chain.cont
-        full = (chain.off == 0 and chain.n == len(cont)
-                and jnp.dtype(cont.dtype) != jnp.dtype(np.float64))
-        if full:
-            prog = _is_sorted_program(cont.runtime.mesh,
-                                      cont.runtime.axis, cont.layout,
-                                      cont.dtype,
-                                      pinned_id(cont.runtime.mesh))
+        if jnp.dtype(cont.dtype) != jnp.dtype(np.float64):
+            if chain.n == 0:
+                return True
+            full = chain.off == 0 and chain.n == len(cont)
+            prog = _is_sorted_program(
+                cont.runtime.mesh, cont.runtime.axis, cont.layout,
+                cont.dtype, pinned_id(cont.runtime.mesh),
+                window=None if full else (chain.off, chain.n))
             return int(prog(cont._data)) == 0
-        warn_fallback("is_sorted", "subrange window"
-                      if chain.n != len(cont) or chain.off
-                      else "float64 (exact direct compare)")
+        warn_fallback("is_sorted", "float64 (exact direct compare)")
         arr = cont.to_array()[chain.off:chain.off + chain.n]
     elif res is None:
         raise TypeError("is_sorted takes a distributed range")

@@ -434,3 +434,32 @@ def test_sort_window_signed_zero_bit_exact():
     np.testing.assert_array_equal(got, [1.0, -1.0, -0.0, -0.0, 0.0, 2.0])
     assert list(np.signbit(got)) == [False, True, True, True, False,
                                      False]
+
+
+def test_is_sorted_window_native(monkeypatch):
+    """Round 4: is_sorted on subrange windows runs the fused program
+    (window coordinates) — no materialize."""
+    src = np.array([9.0, 1.0, 2.0, 3.0, -5.0], dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+
+    def boom(self):
+        raise AssertionError("is_sorted window materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    assert dr_tpu.is_sorted(v[1:4])
+    assert not dr_tpu.is_sorted(v[0:3])
+    assert not dr_tpu.is_sorted(v[2:5])
+    assert dr_tpu.is_sorted(v[3:3])  # empty window
+    monkeypatch.undo()
+
+
+def test_is_sorted_window_uneven(mesh_size):
+    if mesh_size < 3:
+        pytest.skip("needs a team-bearing distribution")
+    sizes = [5, 0] + [4] * (mesh_size - 2)
+    n = sum(sizes)
+    src = np.arange(n, dtype=np.float32)
+    src[0] = 99.0  # violation OUTSIDE the window only
+    v = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
+    assert not dr_tpu.is_sorted(v)
+    assert dr_tpu.is_sorted(v[1:n])
+    assert not dr_tpu.is_sorted(v[0:4])
