@@ -167,6 +167,18 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     pprev = pay_layout[2] if pay_layout else 0
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
+    if pay_layout is not None:
+        # the payload may carry a DIFFERENT block distribution (round
+        # 4): its own static geometry drives an input realignment to
+        # key coordinates and the phase-5 rebalance into its own
+        # windows — the materialize fallback is gone
+        _, Sp, _, _, _, _, pstarts, psizes = working_geometry(pay_layout)
+        same_dist = (np.array_equal(pstarts, starts)
+                     and np.array_equal(psizes, sizes))
+        pstarts_c = jnp.asarray(pstarts, jnp.int32)
+        psizes_c = jnp.asarray(psizes, jnp.int32)
+    else:
+        Sp, same_dist = S, True
 
     GMAX = np.int32(np.iinfo(np.int32).max)
 
@@ -190,7 +202,28 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         gid = starts_c[r] + jnp.arange(S)
         local_ok = jnp.arange(S) < nvalid
         key = jnp.where(local_ok, key, big)     # mask pad cells
-        vals = (key,) + tuple(v[0, pprev:pprev + S] for v in pay)
+
+        def realign(vrow):
+            # payload cells (own-distribution local order, width Sp) ->
+            # key coordinates: destination slot (d, j) holds global
+            # position kstarts[d]+j, owned by exactly one source under
+            # the payload distribution — masked-sum assembly over one
+            # all_to_all, the same pattern as phase 5
+            gpos_k = starts_c[:, None] + jnp.arange(S)[None, :]
+            dest_ok = jnp.arange(S)[None, :] < sizes_c[:, None]
+            idxl = gpos_k - pstarts_c[r]
+            own = dest_ok & (idxl >= 0) & (idxl < psizes_c[r])
+            send = jnp.where(own,
+                             jnp.take(vrow, jnp.clip(idxl, 0, Sp - 1)),
+                             jnp.zeros((), vrow.dtype))
+            return jnp.sum(lax.all_to_all(send, axis, 0, 0), axis=0)
+
+        if same_dist:
+            pay_vecs = tuple(v[0, pprev:pprev + S] for v in pay)
+        else:
+            pay_vecs = tuple(realign(v[0, pprev:pprev + Sp])
+                             for v in pay)
+        vals = (key,) + pay_vecs
         nkeys = 1
         if pay:
             # SECONDARY sort key: the original global index, with pads
@@ -255,22 +288,28 @@ def _sort_program(mesh, axis, layout, dtype, descending,
             cnt = jnp.sum(rcnt)
             # 5. rebalance to the DESTINATION layout by masked-sum
             # assembly: shard d's window is [starts[d], starts[d] +
-            # sizes[d])
+            # sizes[d]) — per CHANNEL geometry, so a payload carrying a
+            # different distribution lands directly in its own windows
             allcnt = lax.all_gather(cnt, axis)                # (p,)
             off = jnp.sum(jnp.where(jnp.arange(p) < r, allcnt, 0))
-            gpos = starts_c[:, None] \
-                + jnp.arange(S)[None, :]                      # (p, S)
-            dest_ok = jnp.arange(S)[None, :] < sizes_c[:, None]
-            want = (n - 1 - gpos) if descending else gpos
-            idx = want - off               # my local index for that cell
-            ok = dest_ok & (idx >= 0) & (idx < cnt)
-            gidx = jnp.clip(idx, 0, p * S - 1)
 
-            def rebalance(m):
+            def rebalance(m, dstarts, dsizes, Sd):
+                gpos = dstarts[:, None] \
+                    + jnp.arange(Sd)[None, :]                 # (p, Sd)
+                dest_ok = jnp.arange(Sd)[None, :] < dsizes[:, None]
+                want = (n - 1 - gpos) if descending else gpos
+                idx = want - off       # my local index for that cell
+                ok = dest_ok & (idx >= 0) & (idx < cnt)
+                gidx = jnp.clip(idx, 0, p * S - 1)
                 s2 = jnp.where(ok, jnp.take(m, gidx),
                                jnp.zeros((), m.dtype))
                 return jnp.sum(lax.all_to_all(s2, axis, 0, 0), axis=0)
-            outs = [rebalance(m) for m in (merged, *pmerged)]
+            # pmerged is nonempty only with a payload, whose channels
+            # rebalance into the PAYLOAD geometry (== the key geometry
+            # when the distributions match)
+            outs = [rebalance(merged, starts_c, sizes_c, S)] \
+                + [rebalance(q, pstarts_c, psizes_c, Sp)
+                   for q in pmerged]
         if window is not None:
             # blend: window cells take their sorted value (the window-
             # coordinate result, re-addressed per full-row column),
@@ -344,10 +383,11 @@ def sort_by_key(keys, values, *, descending: bool = False):
     kcont, vcont = kc.cont, vc.cont
     full = (kc.off == 0 and vc.off == 0
             and kc.n == len(kcont) and vc.n == len(vcont)
-            # same logical distribution (nshards + per-shard windows);
-            # halo widths may differ
+            # distributions MAY differ (round 4): the program realigns
+            # the payload to key coordinates on entry and rebalances it
+            # into its own windows on exit.  Shard counts must match —
+            # one shard_map program spans both containers
             and kcont.layout[0] == vcont.layout[0]
-            and kcont.layout[1] == vcont.layout[1]
             and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
             and jnp.dtype(vcont.dtype) != jnp.dtype(np.float64))
     if full:
@@ -357,9 +397,8 @@ def sort_by_key(keys, values, *, descending: bool = False):
                              pay_dtype=vcont.dtype)
         kcont._data, vcont._data = prog(kcont._data, vcont._data)
         return keys, values
-    if kcont.layout[0] != vcont.layout[0] \
-            or kcont.layout[1] != vcont.layout[1]:
-        why = "keys and values carry different distributions"
+    if kcont.layout[0] != vcont.layout[0]:
+        why = "keys and values live on different shard counts"
     elif kc.off or vc.off or kc.n != len(kcont) or vc.n != len(vcont):
         why = "subrange window"
     else:
@@ -455,11 +494,12 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
 def is_sorted(r) -> bool:
     """True when the range is ascending (``std::is_sorted``; NaNs
     count as largest, numpy order).  READ-ONLY in ``r``.  Whole
-    containers (uniform or uneven distributions) run one fused
-    shard_map program (local vector compare + one boundary
-    all_gather); windows, views and f64 fall back to a materialized
-    DIRECT comparison (no f32 key encoding — f64 pairs closer than an
-    f32 ulp must still compare exactly)."""
+    containers AND subrange windows (uniform or uneven
+    distributions) run one fused shard_map program (local vector
+    compare + one boundary all_gather; windows in window coordinates —
+    round 4); views and f64 fall back to a materialized DIRECT
+    comparison (no f32 key encoding — f64 pairs closer than an f32 ulp
+    must still compare exactly)."""
     res = _resolve(r)
     if res is not None and len(res) != 1:
         raise TypeError("is_sorted takes a single-component range")

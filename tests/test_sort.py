@@ -463,3 +463,41 @@ def test_is_sorted_window_uneven(mesh_size):
     assert not dr_tpu.is_sorted(v)
     assert dr_tpu.is_sorted(v[1:n])
     assert not dr_tpu.is_sorted(v[0:4])
+
+
+def test_sort_by_key_mixed_distributions_native(mesh_size, monkeypatch):
+    """Round 4: keys and values may carry DIFFERENT block
+    distributions — the payload realigns to key coordinates on entry
+    (one static masked all_to_all) and rebalances into its own windows
+    on exit.  No materialize; stable ties; empty team shards included."""
+    if mesh_size < 3:
+        pytest.skip("needs a team-bearing distribution")
+    P = mesh_size
+    ksizes = [5, 0] + [4] * (P - 2)
+    n = sum(ksizes)
+    vsizes = list(dr_tpu.even_sizes(n, P))
+    rng = np.random.default_rng(n)
+    k = rng.integers(0, 5, n).astype(np.float32)   # heavy ties
+    pay = np.arange(n, dtype=np.float32)
+    kd = dr_tpu.distributed_vector.from_array(
+        k, distribution=dr_tpu.block_distribution(ksizes))
+    pd = dr_tpu.distributed_vector.from_array(pay, distribution=vsizes)
+
+    def boom(self):
+        raise AssertionError("mixed-distribution sort_by_key "
+                             "materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.sort_by_key(kd, pd)
+    monkeypatch.undo()
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(pd), pay[order])
+    # descending too (whole order reversed, ties included)
+    kd2 = dr_tpu.distributed_vector.from_array(
+        k, distribution=dr_tpu.block_distribution(ksizes))
+    pd2 = dr_tpu.distributed_vector.from_array(pay,
+                                               distribution=vsizes)
+    dr_tpu.sort_by_key(kd2, pd2, descending=True)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd2), k[order][::-1])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(pd2),
+                                  pay[order][::-1])
