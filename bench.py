@@ -556,6 +556,15 @@ def _relay_listening() -> bool:
         s.close()
 
 
+def _dead_relay() -> bool:
+    """True when the tunneled (axon) platform is in play but its relay
+    is not even listening — a state where no claim can be served and
+    probing only burns the caller's timeout budget."""
+    import jax
+    return ("axon" in str(getattr(jax.config, "jax_platforms", ""))
+            and not _relay_listening())
+
+
 def _devices_or_die(timeout_s: float):
     """First backend touch via runtime.probe_devices: a recorded result
     beats the eternal hang a wedged tunnel relay produces.
@@ -581,7 +590,25 @@ def _devices_or_die(timeout_s: float):
     if os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
         import jax
         jax.config.update("jax_platforms", "cpu")
-    elif os.environ.get("_DR_TPU_BENCH_RETRY"):
+    elif not os.environ.get("_DR_TPU_BENCH_RETRY"):
+        # DEAD relay (nothing listening): skip the doomed first probe
+        # entirely — its watchdog would burn the whole timeout_s of the
+        # driver's budget for a claim that cannot be served.  Gated on
+        # the axon platform being in play so a directly attached TPU is
+        # unaffected.
+        if _dead_relay():
+            err = ("relay not listening (TCP check); probe skipped, "
+                   "retry skipped")
+            print(f"device init failed with the relay down ({err}); "
+                  "re-running on CPU", file=sys.stderr)
+            env = dict(os.environ)
+            env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
+            env["_DR_TPU_BENCH_DEGRADED"] = err
+            env["JAX_PLATFORMS"] = "cpu"
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
+    if os.environ.get("_DR_TPU_BENCH_RETRY") \
+            and not os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
         # Cool down HERE, in the fresh child, before its first claim:
         # the exec that spawned this process killed the first probe's
         # (possibly mid-claim) client, and the server-side grant needs

@@ -107,3 +107,25 @@ def test_retry_success_returns_devices(monkeypatch, bench):
     assert bench._devices_or_die(420.0) == ["dev0"]
     # the retry leg honors its own (shorter) timeout budget
     assert seen["timeout"] == 33.0
+
+
+def test_dead_relay_skips_probe_entirely(monkeypatch, bench):
+    """A dead relay (axon platform, port refusing) execs straight to
+    the CPU fallback WITHOUT spending the probe timeout."""
+    monkeypatch.delenv("_DR_TPU_BENCH_RETRY", raising=False)
+    monkeypatch.delenv("_DR_TPU_BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.setattr(bench, "_dead_relay", lambda: True)
+    from dr_tpu.parallel import runtime
+
+    def no_probe(t):
+        raise AssertionError("probe must not run with a dead relay")
+    monkeypatch.setattr(runtime, "probe_devices", no_probe)
+
+    def fake_execve(path, argv, env):
+        raise _Exec(argv, env)
+    monkeypatch.setattr(bench.os, "execve", fake_execve)
+    with pytest.raises(_Exec) as ei:
+        bench._devices_or_die(420.0)
+    env = ei.value.env
+    assert env["_DR_TPU_BENCH_CPU_FALLBACK"] == "1"
+    assert "probe skipped" in env["_DR_TPU_BENCH_DEGRADED"]
