@@ -565,6 +565,20 @@ def _dead_relay() -> bool:
             and not _relay_listening())
 
 
+def _exec_cpu_fallback(err: str):
+    """Re-exec this benchmark with the CPU platform forced and the
+    degraded cause recorded — the single exit ramp for every
+    dead-relay / failed-probe path."""
+    print(f"device init failed ({err}); re-running on CPU",
+          file=sys.stderr)
+    env = dict(os.environ)
+    env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
+    env["_DR_TPU_BENCH_DEGRADED"] = err
+    env["JAX_PLATFORMS"] = "cpu"
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)], env)
+
+
 def _devices_or_die(timeout_s: float):
     """First backend touch via runtime.probe_devices: a recorded result
     beats the eternal hang a wedged tunnel relay produces.
@@ -597,16 +611,8 @@ def _devices_or_die(timeout_s: float):
         # the axon platform being in play so a directly attached TPU is
         # unaffected.
         if _dead_relay():
-            err = ("relay not listening (TCP check); probe skipped, "
-                   "retry skipped")
-            print(f"device init failed with the relay down ({err}); "
-                  "re-running on CPU", file=sys.stderr)
-            env = dict(os.environ)
-            env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
-            env["_DR_TPU_BENCH_DEGRADED"] = err
-            env["JAX_PLATFORMS"] = "cpu"
-            os.execve(sys.executable,
-                      [sys.executable, os.path.abspath(__file__)], env)
+            _exec_cpu_fallback("relay not listening (TCP check); "
+                               "probe skipped, retry skipped")
     if os.environ.get("_DR_TPU_BENCH_RETRY") \
             and not os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
         # Cool down HERE, in the fresh child, before its first claim:
@@ -635,14 +641,10 @@ def _devices_or_die(timeout_s: float):
                 first = os.environ.get("_DR_TPU_BENCH_FIRST_ERR", "")
                 if first and first != err:
                     err = f"{err}; first attempt: {first}"
-                why = "device init retry failed"
+                err = f"retry failed: {err}"
             else:
                 err = f"{err}; relay not listening, retry skipped"
-                why = "device init failed with the relay down"
-            print(f"{why} ({err}); re-running on CPU", file=sys.stderr)
-            env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
-            env["_DR_TPU_BENCH_DEGRADED"] = err
-            env["JAX_PLATFORMS"] = "cpu"
+            _exec_cpu_fallback(err)
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
     detail = {"error": err}

@@ -20,10 +20,12 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import combine_for, uniform_layout, working_geometry
+from ._common import (combine_for, owned_window_mask, uniform_layout,
+                      working_geometry)
 from .elementwise import _op_key, _out_chain, _prog_cache, _resolve, _write_window
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
@@ -123,10 +125,18 @@ def _kernel_variant():
 
 
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
-                  use_kernel=False):
+                  use_kernel=False, window=None, aliased=False):
+    """``window=(off, wn)`` scans ONLY the logical subrange (round 4):
+    with an identity op, the window scan IS the whole-container scan of
+    an identity-masked input — cells before the window contribute the
+    identity to every window prefix — so the same phases run unchanged
+    and the output row blends scanned window cells into the OUT
+    container's original row (the program then takes out's data as a
+    second, donated argument).  Identityless windows keep the
+    materialize fallback (no value can mask the outside cells)."""
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
            else None, exclusive, str(dtype), use_kernel,
-           _kernel_variant() if use_kernel else None)
+           _kernel_variant() if use_kernel else None, window, aliased)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -141,13 +151,22 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     # the masking pass (a whole extra HBM read-modify) when exact.
     # Uneven layouts with pads REQUIRE an identity to mask with — the
     # caller gates unclassified ops to the fallback there.
-    exact = bool((sizes == S).all()) and nshards * S == n
+    exact = (bool((sizes == S).all()) and nshards * S == n
+             and window is None)
+    if window is not None:
+        assert kind is not None, "windowed scans need an identity op"
+        wmask_c = jnp.asarray(np.asarray(
+            owned_window_mask(layout, *window)[0]))
 
-    def body(blk):  # (1, width) one shard row
+    def body(blk, *out_blk):  # (1, width) one shard row
         ident = _identity_for(kind, dtype) if kind is not None else None
         x = blk[0, prev:prev + S]
         r = lax.axis_index(axis)
-        if ident is not None and not exact:
+        if window is not None:
+            # outside-window cells become the identity: every window
+            # prefix then sees only window contributions
+            x = jnp.where(wmask_c[r, prev:prev + S], x, ident)
+        elif ident is not None and not exact:
             nvalid = jnp.minimum(sizes_c[r],
                                  jnp.clip(n - starts_c[r], 0, S))
             x = jnp.where(jnp.arange(S) < nvalid, x, ident)
@@ -257,6 +276,15 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                 first = prev_rank_last if ident is None else \
                     jnp.where(r > 0, prev_rank_last, ident)
                 scanned = shifted.at[0].set(first)
+        if window is not None:
+            # blend: window cells take the scanned value, everything
+            # else keeps the OUT container's original content (for the
+            # in-place form, the input row IS the out row — a second
+            # argument would trip donation aliasing)
+            full = jnp.zeros((prev + cap + nxt,), dtype) \
+                .at[prev:prev + S].set(scanned.astype(dtype))
+            keep = blk[0] if aliased else out_blk[0][0]
+            return jnp.where(wmask_c[r], full, keep)[None]
         if prev == 0 and nxt == 0 and cap == S:
             # halo-free row: the scan IS the whole padded row — no
             # zeros+set copy pass (one fewer HBM pass on the hot path)
@@ -266,10 +294,15 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
 
     # check_vma=False only for the kernel path: pallas outputs carry no
     # varying-mesh-axis metadata
-    shmapped = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+    nin = 1 if window is None or aliased else 2
+    shmapped = jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(axis, None),) * nin,
                              out_specs=P(axis, None),
                              check_vma=not use_kernel)
-    prog = jax.jit(shmapped)
+    # donate the OUT buffer the window blend rebinds (the aliased form
+    # donates its single in/out row)
+    donate = () if window is None else ((0,) if aliased else (1,))
+    prog = jax.jit(shmapped, donate_argnums=donate)
     _prog_cache[key] = prog
     return prog
 
@@ -296,16 +329,32 @@ def _scan(in_r, out, op, init, exclusive):
         # window must cover the whole container too
         and out_chain.n == len(out_chain.cont)
     )
-    if full:
+    # aligned subrange windows with an identity op run the SAME
+    # program over an identity-masked input (round 4) — the fallback
+    # remains for identityless windows, view chains, and mismatched
+    # in/out windows
+    win_ok = (
+        not full
+        and ins is not None and len(ins) == 1 and not ins[0].ops
+        and kind is not None
+        and ins[0].cont.layout == out_chain.cont.layout
+        and ins[0].off == out_chain.off
+        and ins[0].n == out_chain.n
+        and ins[0].n > 0
+    )
+    if full or win_ok:
         c = ins[0]
         mesh = c.cont.runtime.mesh
         dt = out_chain.cont.dtype
+        aliased = (not full) and c.cont is out_chain.cont
         prog = _scan_program(
             mesh, c.cont.runtime.axis, c.cont.layout, kind, op,
             exclusive, dt,
             use_kernel=_use_scan_kernel(c.cont.layout, kind,
-                                        c.cont.dtype, c.cont.runtime))
-        out_chain.cont._data = prog(c.cont._data)
+                                        c.cont.dtype, c.cont.runtime),
+            window=None if full else (c.off, c.n), aliased=aliased)
+        out_chain.cont._data = prog(c.cont._data) if full or aliased \
+            else prog(c.cont._data, out_chain.cont._data)
         scanned = None
     else:
         from ..utils.fallback import warn_fallback
@@ -325,14 +374,9 @@ def _scan(in_r, out, op, init, exclusive):
                 [ident[None].astype(arr.dtype), scanned[:-1]])
         _write_window(out_chain, scanned[:out_chain.n])
     if init is not None:
-        # std::inclusive_scan init semantics: init folds into every prefix
-        cont = out_chain.cont
-        combine = combine_for(kind, op)
-        arr = cont.to_array()
-        arr = arr.at[out_chain.off:out_chain.off + out_chain.n].set(
-            combine(jnp.asarray(init, cont.dtype),
-                    arr[out_chain.off:out_chain.off + out_chain.n]))
-        cont.assign_array(arr)
+        # std::inclusive_scan init semantics: init folds into every
+        # prefix (position 0 included) — one fused pass, windows too
+        _scan_apply_init(out, init, op, set_first=False)
     return out
 
 
@@ -399,16 +443,20 @@ def exclusive_scan(in_r, out, init=0, op: Callable = None):
     return out
 
 
-def _scan_apply_init(out, init, op):
-    """Fold ``init`` into an exclusive-scan result: positions > 0 take
-    ``op(init, prefix)`` (exact by associativity); position 0 is set to
+def _scan_apply_init(out, init, op, set_first=True):
+    """Fold ``init`` into a scan result: every covered position takes
+    ``op(init, prefix)`` (exact by associativity); with ``set_first``
+    (the exclusive-scan form) the first covered position is set to
     ``init`` EXACTLY — the scan program seeds it with the op identity
     when one exists, but an unclassified op's pseudo-identity (zero)
-    would make ``op(init, 0)`` wrong there.
+    would make ``op(init, 0)`` wrong there.  Inclusive init folds pass
+    ``set_first=False`` (init folds into EVERY prefix).
 
-    Whole-container outputs fold in ONE fused shard_map pass (init is a
-    traced scalar, so loop-varying inits reuse the cached program);
-    only window outputs materialize."""
+    Both whole-container AND window outputs fold in ONE fused
+    shard_map pass (round 4; init is a traced scalar, so loop-varying
+    inits reuse the cached program): windows fold only masked cells,
+    and the first covered position's owning shard + local column are
+    static."""
     if op is None:
         op = operator.add
     kind = _classify_op(op)
@@ -417,42 +465,58 @@ def _scan_apply_init(out, init, op):
     cont = chain.cont
     if chain.n == 0:
         return
-    if chain.off == 0 and chain.n == len(cont):
-        mesh = cont.runtime.mesh
-        axis = cont.runtime.axis
-        key = ("scan_init", pinned_id(mesh), axis, cont.layout, kind,
-               _op_key(op) if kind is None else None, str(cont.dtype))
-        prog = _prog_cache.get(key)
-        if prog is None:
-            nshards, S, cap, prev, nxt, n, starts, sizes = \
-                working_geometry(cont.layout)
+    mesh = cont.runtime.mesh
+    axis = cont.runtime.axis
+    full = chain.off == 0 and chain.n == len(cont)
+    window = None if full else (chain.off, chain.n)
+    key = ("scan_init", pinned_id(mesh), axis, cont.layout, kind,
+           _op_key(op) if kind is None else None, str(cont.dtype),
+           window, set_first)
+    prog = _prog_cache.get(key)
+    if prog is None:
+        nshards, S, cap, prev, nxt, n, starts, sizes = \
+            working_geometry(cont.layout)
+        starts_np = np.asarray(starts)
+        sizes_np = np.asarray(sizes)
+        off0 = chain.off
+        # the shard owning the first covered position, and its local
+        # column — STATIC (the first shard whose window contains off0)
+        owner = next((i for i in range(nshards)
+                      if sizes_np[i] > 0
+                      and starts_np[i] <= off0 < starts_np[i]
+                      + sizes_np[i]), 0)
+        col0 = prev + (off0 - int(starts_np[owner]))
+        if window is not None:
+            wmask_c = jnp.asarray(np.asarray(
+                owned_window_mask(cont.layout, *window)[0]))
 
-            def body(blk, iv):
+        def body(blk, iv):
+            r = lax.axis_index(axis)
+            if window is None:
                 x = blk[0, prev:prev + S]
                 folded = combine(iv, x)
-                r = lax.axis_index(axis)
-                # global position 0 is init EXACTLY (first shard with a
-                # nonzero start offset never owns it)
-                starts_c = jnp.asarray(starts, jnp.int32)
-                here0 = starts_c[r] == 0
-                folded = folded.at[0].set(
-                    jnp.where(here0, iv, folded[0]))
+                if set_first:
+                    folded = folded.at[col0 - prev].set(
+                        jnp.where(jnp.asarray(starts_np,
+                                              jnp.int32)[r] == 0,
+                                  iv, folded[col0 - prev]))
                 if prev == 0 and nxt == 0 and cap == S:
                     return folded.astype(blk.dtype)[None]
                 out_row = jnp.zeros((1, prev + cap + nxt), blk.dtype)
                 return out_row.at[0, prev:prev + S].set(
                     folded.astype(blk.dtype))
+            row = blk[0]
+            folded = jnp.where(wmask_c[r], combine(iv, row),
+                               row).astype(blk.dtype)
+            if set_first:
+                folded = folded.at[col0].set(
+                    jnp.where(lax.axis_index(axis) == owner, iv,
+                              folded[col0]).astype(blk.dtype))
+            return folded[None]
 
-            shm = jax.shard_map(body, mesh=mesh,
-                                in_specs=(P(axis, None), P()),
-                                out_specs=P(axis, None))
-            prog = jax.jit(shm, donate_argnums=0)
-            _prog_cache[key] = prog
-        cont._data = prog(cont._data, jnp.asarray(init, cont.dtype))
-        return
-    arr = cont.to_array()
-    seg = arr[chain.off:chain.off + chain.n]
-    seg = combine(jnp.asarray(init, cont.dtype), seg)
-    seg = seg.at[0].set(jnp.asarray(init, cont.dtype))
-    arr = arr.at[chain.off:chain.off + chain.n].set(seg)
-    cont.assign_array(arr)
+        shm = jax.shard_map(body, mesh=mesh,
+                            in_specs=(P(axis, None), P()),
+                            out_specs=P(axis, None))
+        prog = jax.jit(shm, donate_argnums=0)
+        _prog_cache[key] = prog
+    cont._data = prog(cont._data, jnp.asarray(init, cont.dtype))
