@@ -131,7 +131,8 @@ def _window_geometry(layout, off, wn):
 
 
 def _sort_program(mesh, axis, layout, dtype, descending,
-                  pay_layout=None, pay_dtype=None, window=None):
+                  pay_layout=None, pay_dtype=None, window=None,
+                  pay_window=None):
     """The sample-sort program; with ``pay_layout`` set it carries a
     payload row through every phase (stable key-value sort — the
     payload rides the same collectives, tie order preserved by
@@ -145,7 +146,7 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     originals through the static owned_window_mask."""
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
            bool(descending), pay_layout,
-           str(pay_dtype) if pay_layout else None, window)
+           str(pay_dtype) if pay_layout else None, window, pay_window)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -157,7 +158,6 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         p, S, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
         wstart = None
     else:
-        assert pay_layout is None, "windowed sort is keys-only"
         p, S, cap, prev, nxt, n, starts, sizes, wstart = \
             _window_geometry(layout, *window)
         width = prev + cap + nxt
@@ -167,7 +167,23 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     pprev = pay_layout[2] if pay_layout else 0
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
-    if pay_layout is not None:
+    if pay_layout is not None and window is not None:
+        # windowed key-value sort (round 4): the payload window has its
+        # OWN static geometry — extraction offsets, realign source, the
+        # phase-5 destination, and the output blend mask all come from
+        # it, exactly the mixed-distribution machinery in window
+        # coordinates
+        _, Sp, pcap2, pprev2, pnxt2, _, pstarts, psizes, pwstart = \
+            _window_geometry(pay_layout, *pay_window)
+        pwidth = pprev2 + pcap2 + pnxt2
+        pwoff_c = jnp.asarray(pwstart, jnp.int32)
+        pay_mask_c = jnp.asarray(np.asarray(
+            owned_window_mask(pay_layout, *pay_window)[0]))
+        same_dist = (np.array_equal(pstarts, starts)
+                     and np.array_equal(psizes, sizes))
+        pstarts_c = jnp.asarray(pstarts, jnp.int32)
+        psizes_c = jnp.asarray(psizes, jnp.int32)
+    elif pay_layout is not None:
         # the payload may carry a DIFFERENT block distribution (round
         # 4): its own static geometry drives an input realignment to
         # key coordinates and the phase-5 rebalance into its own
@@ -218,7 +234,15 @@ def _sort_program(mesh, axis, layout, dtype, descending,
                              jnp.zeros((), vrow.dtype))
             return jnp.sum(lax.all_to_all(send, axis, 0, 0), axis=0)
 
-        if same_dist:
+        if pay and window is not None:
+            def pay_raw(v):
+                pidx = jnp.clip(pprev2 + pwoff_c[r] + jnp.arange(Sp),
+                                0, pwidth - 1)
+                return jnp.take(v[0], pidx)
+            pay_vecs = tuple(
+                pay_raw(v) if same_dist else realign(pay_raw(v))
+                for v in pay)
+        elif same_dist:
             pay_vecs = tuple(v[0, pprev:pprev + S] for v in pay)
         else:
             pay_vecs = tuple(realign(v[0, pprev:pprev + Sp])
@@ -313,12 +337,24 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         if window is not None:
             # blend: window cells take their sorted value (the window-
             # coordinate result, re-addressed per full-row column),
-            # everything else keeps the original row
+            # everything else keeps the original row — per channel,
+            # each through its own container's window mask
             decoded = _decode(outs[0], dtype)
             col_idx = jnp.clip(jnp.arange(width) - prev - woff_c[r],
                                0, S - 1)
-            return jnp.where(mask_c[r], jnp.take(decoded, col_idx),
+            krow = jnp.where(mask_c[r], jnp.take(decoded, col_idx),
                              blk[0])[None]
+            if not pay:
+                return krow
+            prows = []
+            pcol_idx = jnp.clip(
+                jnp.arange(pwidth) - pprev2 - pwoff_c[r], 0, Sp - 1)
+            for row, src in zip(outs[1:], pay):
+                prows.append(jnp.where(
+                    pay_mask_c[r],
+                    jnp.take(row.astype(pay_dtype), pcol_idx),
+                    src[0])[None])
+            return (krow, *prows)
         out_rows = [_pack_row(_decode(outs[0], dtype), layout, dtype)]
         for row in outs[1:]:
             out_rows.append(_pack_row(row, pay_layout, pay_dtype))
@@ -390,17 +426,31 @@ def sort_by_key(keys, values, *, descending: bool = False):
             and kcont.layout[0] == vcont.layout[0]
             and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
             and jnp.dtype(vcont.dtype) != jnp.dtype(np.float64))
-    if full:
+    if kc.n == 0:
+        return keys, values
+    win_ok = (not full
+              and kcont.layout[0] == vcont.layout[0]
+              # two windows of ONE container would need a single
+              # blended output row (and would double-donate the
+              # buffer): that shape keeps the sequential fallback
+              and kcont is not vcont
+              and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
+              and jnp.dtype(vcont.dtype) != jnp.dtype(np.float64))
+    if full or win_ok:
+        kw = None if full else (kc.off, kc.n)
         prog = _sort_program(kcont.runtime.mesh, kcont.runtime.axis,
                              kcont.layout, kcont.dtype, descending,
                              pay_layout=vcont.layout,
-                             pay_dtype=vcont.dtype)
+                             pay_dtype=vcont.dtype,
+                             window=kw,
+                             pay_window=None if full
+                             else (vc.off, vc.n))
         kcont._data, vcont._data = prog(kcont._data, vcont._data)
         return keys, values
     if kcont.layout[0] != vcont.layout[0]:
         why = "keys and values live on different shard counts"
-    elif kc.off or vc.off or kc.n != len(kcont) or vc.n != len(vcont):
-        why = "subrange window"
+    elif kcont is vcont:
+        why = "key and value windows share one container"
     else:
         why = "float64 keys or values"
     warn_fallback("sort_by_key", why)

@@ -2,9 +2,8 @@
 
 Some algorithm configurations leave the fused shard_map fast paths and
 run through a materialized logical array instead (device-side gather →
-global op → re-scatter): float64 sorts, sort_by_key over subrange
-windows or mismatched shard counts, identityless or mismatched-window
-scans.
+global op → re-scatter): float64 sorts, sort_by_key over mismatched
+shard counts, identityless or mismatched-window scans.
 Each is correct but collective-suboptimal, and VERDICT r3 item 5 calls
 the silent version a perf cliff: this module makes every such fallback
 announce itself ONCE per (operation, reason) pair so users see the

@@ -326,15 +326,15 @@ def test_fallbacks_warn_once(monkeypatch):
     monkeypatch.delenv("DR_TPU_SILENCE_FALLBACKS", raising=False)
     n = 24
     rng = np.random.default_rng(1)
-    k = dr_tpu.distributed_vector.from_array(
+    a = dr_tpu.distributed_vector.from_array(
         rng.standard_normal(n).astype(np.float32))
-    v = dr_tpu.distributed_vector.from_array(
-        np.arange(n, dtype=np.float32))
+    out = dr_tpu.distributed_vector(n, np.float32)
     with w.catch_warnings(record=True) as rec:
         w.simplefilter("always")
-        dr_tpu.sort_by_key(k[2:10], v[2:10])   # window -> fallback
-        dr_tpu.sort_by_key(k[2:10], v[2:10])   # no second warning
+        # MISMATCHED in/out windows: a real remaining fallback
+        dr_tpu.inclusive_scan(a[0:8], out[1:9])
+        dr_tpu.inclusive_scan(a[0:8], out[1:9])  # no second warning
     hits = [r for r in rec if issubclass(r.category,
                                          MaterializeFallbackWarning)]
     assert len(hits) == 1, [str(r.message) for r in rec]
-    assert "subrange window" in str(hits[0].message)
+    assert "mismatch" in str(hits[0].message)
