@@ -53,11 +53,21 @@ commit_logs() {  # $1 = message, rest = paths
 }
 
 DEADLINE=$(( $(date +%s) + 5 * 3600 ))  # "early recovery" cutoff
+# HARD claim cutoff: near end of round the driver's own bench is
+# imminent — a watcher bench started on late recovery could run
+# CONCURRENTLY with it (two TPU clients, the one thing the relay
+# rules forbid).  After the cutoff the watcher only logs.
+STOP=${DR_TPU_WATCH_STOP_EPOCH:-$(( $(date +%s) + 29700 ))}  # ~8.25 h
 
 log "watcher started: TCP-checking 127.0.0.1:8082 every 120 s (claim-free)"
 n=0
 while true; do
   n=$((n + 1))
+  if [ "$(date +%s)" -ge "$STOP" ]; then
+    log "claim cutoff reached (driver bench imminent) — exiting" \
+        "without claiming; the driver owns any recovered relay"
+    exit 0
+  fi
   if port_open; then
     log "RELAY PORT OPEN (check $n) — settling 60 s"
     sleep 60
