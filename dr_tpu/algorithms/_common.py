@@ -10,7 +10,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["layout_geometry", "owned_window_mask", "uniform_layout",
-           "working_geometry", "double_buffered_loop", "combine_for",
+           "window_geometry", "working_geometry",
+           "double_buffered_loop", "combine_for",
            "MONOID_COMBINE", "f32_accumulable", "on_tpu"]
 
 
@@ -119,3 +120,21 @@ def owned_window_mask(layout, off, n):
     gid = jnp.asarray(starts)[:, None] + local
     mask = owned & (gid >= off) & (gid < off + n) & (gid < total_n)
     return mask, gid
+
+
+def window_geometry(layout, off, wn):
+    """Window-coordinate geometry: the logical window [off, off+wn)
+    intersected with each shard's owned span.  Everything is STATIC
+    (numpy over the layout's python ints): ``wstart`` is each shard's
+    local offset of its window slice, ``wsize`` its width, ``vstarts``
+    the exclusive prefix of widths — i.e. the window re-expressed as an
+    uneven block distribution of length ``wn``, which the sample-sort
+    program already speaks natively."""
+    p, _, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
+    starts = np.asarray(starts)
+    sizes = np.asarray(sizes)
+    wstart = np.clip(off - starts, 0, sizes)
+    wsize = np.clip(off + wn - starts, 0, sizes) - wstart
+    vstarts = np.concatenate(([0], np.cumsum(wsize)[:-1]))
+    S = max(int(wsize.max(initial=0)), 1)
+    return p, S, cap, prev, nxt, wn, vstarts, wsize, wstart

@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ._common import (combine_for, owned_window_mask, uniform_layout,
-                      working_geometry)
+                      window_geometry, working_geometry)
 from .elementwise import _op_key, _out_chain, _prog_cache, _resolve, _write_window
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
@@ -129,11 +129,12 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     """``window=(off, wn)`` scans ONLY the logical subrange (round 4):
     with an identity op, the window scan IS the whole-container scan of
     an identity-masked input — cells before the window contribute the
-    identity to every window prefix — so the same phases run unchanged
-    and the output row blends scanned window cells into the OUT
-    container's original row (the program then takes out's data as a
-    second, donated argument).  Identityless windows keep the
-    materialize fallback (no value can mask the outside cells)."""
+    identity to every window prefix — so the same phases run unchanged;
+    identityless ops run in WINDOW coordinates instead (static window
+    geometry + the empty-shard-skipping fold — no identity needed).
+    Either way the output row blends scanned window cells into the OUT
+    container's original row (the program takes out's data as a second,
+    donated argument, or one aliased argument for in-place forms)."""
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
            else None, exclusive, str(dtype), use_kernel,
            _kernel_variant() if use_kernel else None, window, aliased)
@@ -144,25 +145,43 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     nshards, S, cap, prev, nxt, n, starts, sizes = \
         working_geometry(layout)
     combine = combine_for(kind, op)
+    wgeom = False
+    if window is not None:
+        wmask_c = jnp.asarray(np.asarray(
+            owned_window_mask(layout, *window)[0]))
+        width = prev + cap + nxt
+        if kind is None:
+            # identityless window: no value can mask outside cells —
+            # run the phases in WINDOW coordinates instead (the sort
+            # family's approach): the window's shard intersections are
+            # static uneven geometry, each shard reads its slice at a
+            # static offset, and the identityless uneven machinery
+            # (real totals at local[valid-1], empty-shard-skipping
+            # fold) needs no identity anywhere
+            _, S, _, _, _, n, starts, sizes, wstart = \
+                window_geometry(layout, *window)
+            woff_c = jnp.asarray(wstart, jnp.int32)
+            wgeom = True
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
     # pad cells exist when the ceil layout overshoots n OR any shard of
     # an uneven distribution is narrower than the working width: skip
     # the masking pass (a whole extra HBM read-modify) when exact.
-    # Uneven layouts with pads REQUIRE an identity to mask with — the
-    # caller gates unclassified ops to the fallback there.
-    exact = (bool((sizes == S).all()) and nshards * S == n
+    exact = (bool((np.asarray(sizes) == S).all()) and nshards * S == n
              and window is None)
-    if window is not None:
-        assert kind is not None, "windowed scans need an identity op"
-        wmask_c = jnp.asarray(np.asarray(
-            owned_window_mask(layout, *window)[0]))
 
     def body(blk, *out_blk):  # (1, width) one shard row
         ident = _identity_for(kind, dtype) if kind is not None else None
-        x = blk[0, prev:prev + S]
         r = lax.axis_index(axis)
-        if window is not None:
+        if wgeom:
+            # my window slice at a per-shard static offset; the
+            # clipped tail is discarded by the nvalid mask downstream
+            idx = jnp.clip(prev + woff_c[r] + jnp.arange(S), 0,
+                           width - 1)
+            x = jnp.take(blk[0], idx)
+        else:
+            x = blk[0, prev:prev + S]
+        if window is not None and not wgeom:
             # outside-window cells become the identity: every window
             # prefix then sees only window contributions
             x = jnp.where(wmask_c[r, prev:prev + S], x, ident)
@@ -226,7 +245,8 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                 # local[valid-1] and skip empty shards, seeding the
                 # fold at the FIRST nonempty shard (static: sizes are
                 # python ints), so no identity is ever required.
-                if exact or uniform_layout(layout):
+                if (exact or uniform_layout(layout)) \
+                        and not wgeom:
                     totals = lax.all_gather(local[-1], axis)
 
                     def fold(i, acc):
@@ -252,7 +272,8 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                     scanned = jnp.where(r > first_nz,
                                         combine(ue_carry, local), local)
         if exclusive and (use_kernel or kind is None):
-            if kind is None and not (exact or uniform_layout(layout)):
+            if kind is None and (wgeom or not
+                                 (exact or uniform_layout(layout))):
                 # uneven identityless: my first exclusive value is the
                 # global prefix through the nearest preceding NONEMPTY
                 # shard — exactly ue_carry (its fold skips empty
@@ -281,9 +302,15 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             # else keeps the OUT container's original content (for the
             # in-place form, the input row IS the out row — a second
             # argument would trip donation aliasing)
+            keep = blk[0] if aliased else out_blk[0][0]
+            if wgeom:
+                # re-address window-coordinate results per column
+                col_idx = jnp.clip(
+                    jnp.arange(width) - prev - woff_c[r], 0, S - 1)
+                vals = jnp.take(scanned.astype(dtype), col_idx)
+                return jnp.where(wmask_c[r], vals, keep)[None]
             full = jnp.zeros((prev + cap + nxt,), dtype) \
                 .at[prev:prev + S].set(scanned.astype(dtype))
-            keep = blk[0] if aliased else out_blk[0][0]
             return jnp.where(wmask_c[r], full, keep)[None]
         if prev == 0 and nxt == 0 and cap == S:
             # halo-free row: the scan IS the whole padded row — no
@@ -329,14 +356,13 @@ def _scan(in_r, out, op, init, exclusive):
         # window must cover the whole container too
         and out_chain.n == len(out_chain.cont)
     )
-    # aligned subrange windows with an identity op run the SAME
-    # program over an identity-masked input (round 4) — the fallback
-    # remains for identityless windows, view chains, and mismatched
-    # in/out windows
+    # aligned subrange windows run the SAME program for every op
+    # (round 4: identity-masked input, or window coordinates for
+    # identityless ops) — the fallback remains for view chains,
+    # layout mismatches, and mismatched in/out windows
     win_ok = (
         not full
         and ins is not None and len(ins) == 1 and not ins[0].ops
-        and kind is not None
         and ins[0].cont.layout == out_chain.cont.layout
         and ins[0].off == out_chain.off
         and ins[0].n == out_chain.n
@@ -358,8 +384,8 @@ def _scan(in_r, out, op, init, exclusive):
         scanned = None
     else:
         from ..utils.fallback import warn_fallback
-        warn_fallback("scan", "subrange window, view chain, or layout "
-                      "mismatch")
+        warn_fallback("scan", "view chain, in/out layout mismatch, or "
+                      "mismatched in/out windows")
         arr = in_r.to_array() if hasattr(in_r, "to_array") \
             else jnp.asarray(in_r)
         combine = combine_for(kind, op)

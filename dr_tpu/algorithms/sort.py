@@ -52,7 +52,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import owned_window_mask, working_geometry
+from ._common import (owned_window_mask, window_geometry,
+                      working_geometry)
 from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
 from ..core.pinning import pinned_id
 from ..utils.fallback import warn_fallback
@@ -112,24 +113,6 @@ def _pack_row(row, layout, dtype):
     return out.at[0, prev:prev + S].set(row.astype(dtype))
 
 
-def _window_geometry(layout, off, wn):
-    """Window-coordinate geometry: the logical window [off, off+wn)
-    intersected with each shard's owned span.  Everything is STATIC
-    (numpy over the layout's python ints): ``wstart`` is each shard's
-    local offset of its window slice, ``wsize`` its width, ``vstarts``
-    the exclusive prefix of widths — i.e. the window re-expressed as an
-    uneven block distribution of length ``wn``, which the sample-sort
-    program already speaks natively."""
-    p, _, cap, prev, nxt, n, starts, sizes = working_geometry(layout)
-    starts = np.asarray(starts)
-    sizes = np.asarray(sizes)
-    wstart = np.clip(off - starts, 0, sizes)
-    wsize = np.clip(off + wn - starts, 0, sizes) - wstart
-    vstarts = np.concatenate(([0], np.cumsum(wsize)[:-1]))
-    S = max(int(wsize.max(initial=0)), 1)
-    return p, S, cap, prev, nxt, wn, vstarts, wsize, wstart
-
-
 def _sort_program(mesh, axis, layout, dtype, descending,
                   pay_layout=None, pay_dtype=None, window=None,
                   pay_window=None):
@@ -159,7 +142,7 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         wstart = None
     else:
         p, S, cap, prev, nxt, n, starts, sizes, wstart = \
-            _window_geometry(layout, *window)
+            window_geometry(layout, *window)
         width = prev + cap + nxt
         woff_c = jnp.asarray(wstart, jnp.int32)
         mask_c = jnp.asarray(
@@ -174,7 +157,7 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         # it, exactly the mixed-distribution machinery in window
         # coordinates
         _, Sp, pcap2, pprev2, pnxt2, _, pstarts, psizes, pwstart = \
-            _window_geometry(pay_layout, *pay_window)
+            window_geometry(pay_layout, *pay_window)
         pwidth = pprev2 + pcap2 + pnxt2
         pwoff_c = jnp.asarray(pwstart, jnp.int32)
         pay_mask_c = jnp.asarray(np.asarray(
@@ -499,7 +482,7 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
         wstart = None
     else:
         p, S, cap, prev, nxt, n, starts, sizes, wstart = \
-            _window_geometry(layout, *window)
+            window_geometry(layout, *window)
         width = prev + cap + nxt
         woff_c = jnp.asarray(wstart, jnp.int32)
     starts_c = jnp.asarray(starts, jnp.int32)

@@ -350,3 +350,40 @@ def test_scan_window_native_uneven_mul(mesh_size):
     ref = np.full(n, 3.0, np.float32)
     ref[b:e] = np.cumprod(src[b:e]).astype(np.float32)
     np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=2e-4)
+
+
+@pytest.mark.parametrize("exclusive", [False, True])
+def test_scan_window_identityless_native(monkeypatch, mesh_size,
+                                         exclusive):
+    """Round 4: identityless custom ops on aligned subrange windows run
+    the fused program in WINDOW coordinates (the sort family's static
+    window geometry + the identityless empty-shard-skipping fold) —
+    no materialize, including the in-place aliased form."""
+    if mesh_size < 3:
+        pytest.skip("needs a team-bearing distribution")
+    op = lambda a, b: a + b + a * b * 0.25
+    sizes = [5, 0] + [4] * (mesh_size - 2)
+    n = sum(sizes)
+    src = np.random.default_rng(n).standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(src, distribution=sizes)
+    b, e = 2, n - 3
+
+    def boom(self):
+        raise AssertionError("identityless windowed scan materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    if exclusive:
+        dr_tpu.exclusive_scan(a[b:e], a[b:e], init=None, op=op)
+    else:
+        dr_tpu.inclusive_scan(a[b:e], a[b:e], op=op)
+    monkeypatch.undo()
+    ref = src.copy()
+    acc = src[b]
+    w = np.empty(e - b, np.float32)
+    w[0] = acc
+    for i in range(b + 1, e):
+        acc = np.float32(acc + src[i] + acc * src[i] * np.float32(0.25))
+        w[i - b] = acc
+    ref[b:e] = np.concatenate([[np.float32(0.0)], w[:-1]]) \
+        if exclusive else w
+    np.testing.assert_allclose(dr_tpu.to_numpy(a), ref, rtol=2e-3,
+                               atol=2e-3)
