@@ -539,8 +539,12 @@ def _relay_listening() -> bool:
     connect costs nothing server-side, unlike a jax claim.  Gates the
     retry leg — when the relay is not even listening (a down/restarting
     relay, vs a wedged claim path), a second claim cannot succeed and
-    the CPU fallback should run immediately.  Unknown states count as
-    listening so an unusual relay config never disables the retry."""
+    the CPU fallback should run immediately.  A connect TIMEOUT (a
+    SYN-dropping/firewalled relay — the half-dead state rounds 2/3
+    hit) also counts as not-listening, since a claim against it would
+    just burn the probe watchdog; truly unknown errors still count as
+    listening so an unusual relay config never disables the retry.
+    DR_TPU_RELAY_UNKNOWN=down flips that last policy for ops use."""
     import socket
     port = int(os.environ.get("DR_TPU_RELAY_PROBE_PORT", "8082"))
     s = socket.socket()
@@ -548,10 +552,10 @@ def _relay_listening() -> bool:
     try:
         s.connect(("127.0.0.1", port))
         return True
-    except ConnectionRefusedError:
+    except (ConnectionRefusedError, socket.timeout, TimeoutError):
         return False
     except Exception:
-        return True
+        return os.environ.get("DR_TPU_RELAY_UNKNOWN", "up") != "down"
     finally:
         s.close()
 

@@ -522,10 +522,11 @@ def _scan_apply_init(out, init, op, set_first=True):
                 x = blk[0, prev:prev + S]
                 folded = combine(iv, x)
                 if set_first:
+                    # same owner predicate as the window path below:
+                    # leading zero-size shards share start==0 with the
+                    # owner and must not touch their pad cells
                     folded = folded.at[col0 - prev].set(
-                        jnp.where(jnp.asarray(starts_np,
-                                              jnp.int32)[r] == 0,
-                                  iv, folded[col0 - prev]))
+                        jnp.where(r == owner, iv, folded[col0 - prev]))
                 if prev == 0 and nxt == 0 and cap == S:
                     return folded.astype(blk.dtype)[None]
                 out_row = jnp.zeros((1, prev + cap + nxt), blk.dtype)

@@ -77,11 +77,16 @@ def op_from_source(src: str, nargs: int):
     positional arguments, e.g. ``"lambda x0: jnp.where(x0 > 0, x0,
     0.01 * x0)"``; ``jnp``, ``lax`` and ``np`` are in scope.
 
-    Unlike :func:`op_from_expr` there is NO grammar validation — this
-    is deliberate full Python, the same trust boundary as
-    ``thp::session::exec`` (the C++ caller already owns the embedded
-    interpreter).  Caching by (source, nargs) keeps the identity-keyed
-    program caches effective across bridge calls."""
+    .. warning:: UNSAFE BY DESIGN — ``src`` is ``eval``'d with full
+       builtins.  Unlike :func:`op_from_expr` there is NO grammar
+       validation: this is deliberate full Python, the same trust
+       boundary as ``thp::session::exec`` (the C++ caller already owns
+       the embedded interpreter).  It must ONLY ever receive
+       embedder-authored source — never strings from config files,
+       serialized programs, or any other less-trusted channel; route
+       those through :func:`op_from_expr`'s validated grammar instead.
+       Caching by (source, nargs) keeps the identity-keyed program
+       caches effective across bridge calls."""
     nargs = int(nargs)
     if not (1 <= nargs <= _MAX_ARGS):
         raise ValueError(f"nargs must be 1..{_MAX_ARGS}")

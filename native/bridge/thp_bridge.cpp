@@ -107,6 +107,23 @@ struct session::impl {
   PyObject* expr_mod = nullptr;  // module dr_tpu.utils.expr
   PyObject* np = nullptr;        // module numpy
   bool owns_interpreter = false;
+  // JAX x64 state, re-read per query (a cheap attribute read): with
+  // x64 disabled (the default) a float64 device buffer silently
+  // becomes f32, so make_vector(dtype::f64) must fail loudly instead
+  // (ADVICE r4).  Not cached — the embedder can legitimately flip
+  // jax_enable_x64 via session::exec between calls.
+  bool x64_enabled() {
+    PyObject* jax = must(PyImport_ImportModule("jax"), "import jax");
+    PyObject* cfg = must(PyObject_GetAttrString(jax, "config"),
+                         "jax.config");
+    PyObject* v = must(PyObject_GetAttrString(cfg, "jax_enable_x64"),
+                       "jax_enable_x64");
+    bool on = PyObject_IsTrue(v) == 1;
+    Py_DECREF(v);
+    Py_DECREF(cfg);
+    Py_DECREF(jax);
+    return on;
+  }
 
   // op DSL -> cached jax callable (cache lives Python-side, keyed by
   // the canonical string, so equal exprs share one function object)
@@ -260,6 +277,12 @@ const char* np_name(dtype dt) {
 
 vector session::make_vector(std::size_t n, std::size_t prev,
                             std::size_t next, bool periodic, dtype dt) {
+  if (dt == dtype::f64 && !impl_->x64_enabled())
+    fail("make_vector: dtype::f64 requested but JAX x64 is disabled — "
+         "the device buffer would silently be f32 while "
+         "element_dtype() reports f64; enable x64 "
+         "(JAX_ENABLE_X64=1 before session construction) or use "
+         "dtype::f32");
   PyObject* hb = nullptr;
   if (prev || next) {
     PyObject* hb_cls = must(

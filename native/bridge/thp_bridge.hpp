@@ -52,7 +52,9 @@ class session;
 // MXU/VPU) while the host interchange stays double — to_host()
 // converts on the way out, scalar arguments convert on the way in.
 // f32 is the default (TPU-native; also what pre-dtype bridge versions
-// allocated); f64 needs an x64-enabled CPU backend.
+// allocated); f64 needs an x64-enabled CPU backend — make_vector
+// fails loudly when f64 is requested with JAX x64 disabled, instead
+// of silently allocating an f32 buffer under an f64 label.
 enum class dtype { f32, f64, i32 };
 
 // Multi-process SPMD membership (the MHP dimension): every process

@@ -1,10 +1,10 @@
 #!/bin/bash
-# Round-4 relay-recovery watcher.
+# Round-5 relay-recovery watcher (re-armed; round 4's exited at its
+# claim cutoff without the relay ever listening).
 #
-# The round STARTED with the relay down: every loopback relay port
-# (see /root/.relay.py PORTS) refuses connections, so round 3's outage-3
-# wedge outlived the round boundary — bench.py's first probe burned its
-# 420 s watchdog and fell back to CPU (tools/bench_r4_dev.err).
+# Round 5 ALSO started with the relay down: every loopback relay port
+# (see /root/.relay.py PORTS) refuses connections — the round-3 wedge
+# has now outlived TWO round boundaries.
 #
 # Detection is CLAIM-FREE: a TCP connect to the relay's first port costs
 # nothing on the server side, unlike a jax claim whose failure burns the
@@ -57,7 +57,11 @@ DEADLINE=$(( $(date +%s) + 5 * 3600 ))  # "early recovery" cutoff
 # imminent — a watcher bench started on late recovery could run
 # CONCURRENTLY with it (two TPU clients, the one thing the relay
 # rules forbid).  After the cutoff the watcher only logs.
-STOP=${DR_TPU_WATCH_STOP_EPOCH:-$(( $(date +%s) + 29700 ))}  # ~8.25 h
+# Margin math: the round is ~12 h and the driver bench lands after it.
+# A claim started at the cutoff runs bench only (~7-17 min; the sweep
+# leg is gated behind the 5 h DEADLINE), so a 9 h cutoff leaves ~2.5 h
+# of slack before any driver claim — never two TPU clients at once.
+STOP=${DR_TPU_WATCH_STOP_EPOCH:-$(( $(date +%s) + 32400 ))}  # ~9 h
 
 log "watcher started: TCP-checking 127.0.0.1:8082 every 120 s (claim-free)"
 n=0
@@ -78,10 +82,10 @@ while true; do
 done
 
 log "claim 1: bench.py (the rehearsal; dot should show ~760 GB/s pallas)"
-python -u bench.py > tools/bench_r4_dev.json 2> tools/bench_r4_dev.err
-log "bench exit=$? $(tail -c 200 tools/bench_r4_dev.json)"
-commit_logs "Record the round-4 on-chip bench rehearsal" \
-  tools/bench_r4_dev.json tools/bench_r4_dev.err tools/relay_watch.log
+python -u bench.py > tools/bench_r5_dev.json 2> tools/bench_r5_dev.err
+log "bench exit=$? $(tail -c 200 tools/bench_r5_dev.json)"
+commit_logs "Record the round-5 on-chip bench rehearsal" \
+  tools/bench_r5_dev.json tools/bench_r5_dev.err tools/relay_watch.log
 
 if [ "$(date +%s)" -lt "$DEADLINE" ]; then
   sleep 300
