@@ -123,9 +123,13 @@ def _resolve(r) -> Optional[Tuple[_Chain, ...]]:
 
 
 def _fast_aligned(ins: Tuple[_Chain, ...], out: _Chain) -> bool:
-    """Aligned == same layout AND same window offset: segment (rank, size)
-    lists are then pairwise equal, the mhp::aligned condition."""
+    """Aligned == same MESH, same layout, same window offset: segment
+    (rank, size) lists are then pairwise equal, the mhp::aligned
+    condition.  Mesh equality matters beyond the layout: equal shard
+    counts over different device sets cannot share one program
+    (round-5 review finding)."""
     return all(c.cont.layout == out.cont.layout and c.off == out.off
+               and c.cont.runtime.mesh == out.cont.runtime.mesh
                for c in ins)
 
 
@@ -214,6 +218,15 @@ def _run_fused(ins: Tuple[_Chain, ...], out_chain: _Chain, op,
 def _write_window(out_chain: _Chain, values) -> None:
     """Fallback write: splice values into the container's logical array."""
     cont = out_chain.cont
+    if isinstance(values, jax.Array) and values.sharding.device_set \
+            != frozenset(cont.runtime.devices):
+        # cross-MESH write (e.g. the sort_by_key reshard route): a
+        # committed array from another device mesh cannot enter this
+        # mesh's programs — explicit transfer first (XLA resharding;
+        # same-device-set sharding mismatches need no help, GSPMD
+        # reshards inside the program)
+        values = jax.device_put(
+            values, cont.runtime.sharding(None))
     arr = cont.to_array()
     arr = arr.at[out_chain.off:out_chain.off + out_chain.n].set(
         values.astype(cont.dtype))

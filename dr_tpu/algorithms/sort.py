@@ -36,9 +36,9 @@ windows, and the bucket matrices stay overflow-free (a source's bucket
 never exceeds its own real count).  Subrange windows run the SAME
 program in window-relative coordinates (round 4): the window's shard
 intersections are static uneven geometry, and a masked row blend
-leaves outside cells untouched bit-exactly.  Only float64 keys
-materialize the logical array, sort it with XLA's global sort, and
-splice it back — correct, collective-optimal nowhere.
+leaves outside cells untouched bit-exactly.  float64 keys run the
+SAME program through a 64-bit sign-flip encoding (round 5; exact —
+only reachable on x64-enabled CPU meshes, TPU has no f64).
 The write target must be a ``distributed_vector`` or a subrange window
 over one; transform views and other read-only ranges are rejected with
 ``TypeError`` (sorting them in place has no meaning).
@@ -63,18 +63,25 @@ __all__ = ["sort", "sort_by_key", "argsort", "is_sorted"]
 
 _NAN_KEY = np.uint32(0xFFFFFFFE)  # after +inf (numpy sorts NaNs last)
 _PAD_KEY = np.uint32(0xFFFFFFFF)  # strictly after every real key
+# 64-bit twins for real float64 keys (only reachable on x64-enabled CPU
+# meshes — TPU has no f64; with x64 disabled a "float64" container
+# stores f32 and takes the 32-bit path, which is then exact)
+_NAN_KEY64 = np.uint64(0xFFFFFFFFFFFFFFFE)
+_PAD_KEY64 = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def _encode(x, distinct_zeros=False):
     """Monotone total-order sort key.
 
     Floats map through the IEEE sign-flip trick to ``uint32`` (bf16/f16
-    upcast exactly first), with every NaN canonicalized to ``_NAN_KEY``
-    — after +inf, matching numpy's NaNs-last order, and BEFORE the pad
-    sentinel, so the positional validity mask stays exact even for NaN
-    data.  Integers are their own keys (the pad sentinel is the dtype
-    max; real values equal to it merely tie with padding, and ties
-    among equals cannot change the sorted output).
+    upcast exactly first; real f64 arrays — x64-enabled meshes only —
+    through the same trick at 64 bits, so f64 pairs closer than an f32
+    ulp keep their exact order), with every NaN canonicalized to
+    ``_NAN_KEY`` — after +inf, matching numpy's NaNs-last order, and
+    BEFORE the pad sentinel, so the positional validity mask stays
+    exact even for NaN data.  Integers are their own keys (the pad
+    sentinel is the dtype max; real values equal to it merely tie with
+    padding, and ties among equals cannot change the sorted output).
 
     ``distinct_zeros``: the sign-flip trick already orders -0.0
     (0x7FFFFFFF) just before +0.0 (0x80000000) — a valid sort order
@@ -84,6 +91,12 @@ def _encode(x, distinct_zeros=False):
     tie: ``sort_by_key`` needs IEEE-equal keys to keep numpy-stable
     tie order, and ``is_sorted`` must not report ``[0.0, -0.0]`` as
     unsorted."""
+    if x.dtype == jnp.dtype(np.float64):
+        b = jax.lax.bitcast_convert_type(x, jnp.uint64)
+        k = jnp.where(b >> 63 == 1, ~b, b | jnp.uint64(1 << 63))
+        if not distinct_zeros:
+            k = jnp.where(x == 0, jnp.uint64(1 << 63), k)
+        return jnp.where(jnp.isnan(x), _NAN_KEY64, k), _PAD_KEY64
     if jnp.issubdtype(x.dtype, jnp.floating):
         b = jax.lax.bitcast_convert_type(x.astype(jnp.float32),
                                          jnp.uint32)
@@ -95,7 +108,14 @@ def _encode(x, distinct_zeros=False):
 
 
 def _decode(k, dtype):
-    """Inverse of :func:`_encode` (NaN payload/sign canonicalized)."""
+    """Inverse of :func:`_encode` (NaN payload/sign canonicalized);
+    the key WIDTH picks the float branch — a declared-f64 container on
+    an x64-disabled mesh stores f32 and round-trips through uint32."""
+    if k.dtype == jnp.dtype(np.uint64):
+        b = jnp.where(k >> 63 == 1, k ^ jnp.uint64(1 << 63), ~k)
+        x = jax.lax.bitcast_convert_type(b, jnp.float64)
+        return jnp.where(k == _NAN_KEY64, jnp.float64(jnp.nan),
+                         x).astype(dtype)
     if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
         b = jnp.where(k >> 31 == 1, k ^ jnp.uint32(0x80000000), ~k)
         x = jax.lax.bitcast_convert_type(b, jnp.float32)
@@ -115,7 +135,7 @@ def _pack_row(row, layout, dtype):
 
 def _sort_program(mesh, axis, layout, dtype, descending,
                   pay_layout=None, pay_dtype=None, window=None,
-                  pay_window=None):
+                  pay_window=None, aliased=False):
     """The sample-sort program; with ``pay_layout`` set it carries a
     payload row through every phase (stable key-value sort — the
     payload rides the same collectives, tie order preserved by
@@ -126,10 +146,20 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     shard intersections form a static uneven geometry the same phases
     run over, each shard reads its slice at a static per-shard offset,
     and the output row blends sorted window cells with untouched
-    originals through the static owned_window_mask."""
+    originals through the static owned_window_mask.
+
+    ``aliased`` (round 5): key and payload windows live in ONE
+    container — the program takes a single donated row, reads both
+    windows from it, and blends both results into that one row (the
+    caller guarantees the windows are disjoint, so the blends commute
+    and neither overwrites the other)."""
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
            bool(descending), pay_layout,
-           str(pay_dtype) if pay_layout else None, window, pay_window)
+           str(pay_dtype) if pay_layout else None, window, pay_window,
+           aliased,
+           # x64 state changes the traced key width for declared-f64
+           # containers (uint32 under x64-off, uint64 under x64-on)
+           bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -182,6 +212,8 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     GMAX = np.int32(np.iinfo(np.int32).max)
 
     def body(blk, *pay):  # padded shard rows: keys (+ payload)
+        if aliased:
+            pay = (blk,)  # payload window read from the SAME row
         r = lax.axis_index(axis)
         if window is None:
             raw = blk[0, prev:prev + S]
@@ -329,9 +361,18 @@ def _sort_program(mesh, axis, layout, dtype, descending,
                              blk[0])[None]
             if not pay:
                 return krow
-            prows = []
             pcol_idx = jnp.clip(
                 jnp.arange(pwidth) - pprev2 - pwoff_c[r], 0, Sp - 1)
+            if aliased:
+                # both windows blend into the ONE row: the key blend
+                # already carries untouched originals outside its
+                # window, and the (disjoint) payload mask can never
+                # strike a key-window cell
+                return jnp.where(
+                    pay_mask_c[r],
+                    jnp.take(outs[1].astype(pay_dtype), pcol_idx),
+                    krow[0])[None]
+            prows = []
             for row, src in zip(outs[1:], pay):
                 prows.append(jnp.where(
                     pay_mask_c[r],
@@ -343,10 +384,10 @@ def _sort_program(mesh, axis, layout, dtype, descending,
             out_rows.append(_pack_row(row, pay_layout, pay_dtype))
         return out_rows[0] if not pay else tuple(out_rows)
 
-    nin = 1 if pay_layout is None else 2
+    nin = 1 if pay_layout is None or aliased else 2
     shmapped = jax.shard_map(
         body, mesh=mesh, in_specs=(P(axis, None),) * nin,
-        out_specs=P(axis, None) if pay_layout is None
+        out_specs=P(axis, None) if pay_layout is None or aliased
         else (P(axis, None),) * 2)
     # in-place rebind: donate the input buffers like the other in-place
     # cached programs (elementwise/gemv/stencil)
@@ -361,27 +402,19 @@ def sort(r, *, descending: bool = False):
     window over one (the write target).  Whole containers AND subrange
     windows — uniform or uneven block distributions — run the single
     sample-sort shard_map program (windows in window-relative
-    coordinates with a masked row blend, round 4); only f64 keys take
-    the materialize-and-splice fallback (the key encoding upcasts
-    floats through f32)."""
+    coordinates with a masked row blend, round 4).  Every dtype is
+    native (round 5): f64 keys encode through the 64-bit sign-flip
+    trick on x64-enabled meshes, exactly."""
     chain = _out_chain(r)
     cont = chain.cont
-    if jnp.dtype(cont.dtype) != jnp.dtype(np.float64):
-        full = chain.off == 0 and chain.n == len(cont)
-        if chain.n == 0:
-            return r
-        prog = _sort_program(
-            cont.runtime.mesh, cont.runtime.axis, cont.layout,
-            cont.dtype, descending,
-            window=None if full else (chain.off, chain.n))
-        cont._data = prog(cont._data)
+    full = chain.off == 0 and chain.n == len(cont)
+    if chain.n == 0:
         return r
-    warn_fallback("sort", "float64 keys")
-    arr = cont.to_array()
-    win = jnp.sort(arr[chain.off:chain.off + chain.n])
-    if descending:
-        win = win[::-1]
-    _write_window(chain, win)
+    prog = _sort_program(
+        cont.runtime.mesh, cont.runtime.axis, cont.layout,
+        cont.dtype, descending,
+        window=None if full else (chain.off, chain.n))
+    cont._data = prog(cont._data)
     return r
 
 
@@ -389,36 +422,46 @@ def sort_by_key(keys, values, *, descending: bool = False):
     """STABLE key-value sort: reorder ``values`` by ``keys`` (both in
     place, rebinding).  Ties keep their original global order; with
     ``descending`` the whole ascending order is reversed, ties
-    included.  Both arguments must be whole ``distributed_vector``\\ s
-    with the same logical length; matching distributions (uniform or
-    uneven) take the fast path (the payload rides the same collectives
-    as the keys), everything else an argsort-based materialize
-    fallback."""
+    included.  Arguments are ``distributed_vector``\\ s or subrange
+    windows over them, with equal logical lengths.  Same-mesh channels
+    run ONE shard_map program whatever their distributions, windows,
+    or dtypes (f64 included — 64-bit key encoding, round 5); disjoint
+    windows of one container run an aliased single-row variant;
+    different meshes (mismatched shard counts) reshard the payload
+    onto the key runtime, sort natively there, and reshard back.  Only
+    OVERLAPPING windows of one container keep the argsort-based
+    materialize fallback (the two blends would race)."""
     kc = _out_chain(keys)
     vc = _out_chain(values)
     if kc.n != vc.n:
         raise ValueError(
             f"keys and values must have equal length ({kc.n} != {vc.n})")
     kcont, vcont = kc.cont, vc.cont
+    # one shard_map program spans both containers, so they must share
+    # a MESH (runtime identity is too strict — re-init'd runtimes over
+    # the same devices still align; shard count alone is too loose —
+    # equal counts over different device sets would crash the jit)
+    same_mesh = kcont.runtime.mesh == vcont.runtime.mesh
     full = (kc.off == 0 and vc.off == 0
             and kc.n == len(kcont) and vc.n == len(vcont)
             # distributions MAY differ (round 4): the program realigns
             # the payload to key coordinates on entry and rebalances it
-            # into its own windows on exit.  Shard counts must match —
-            # one shard_map program spans both containers
-            and kcont.layout[0] == vcont.layout[0]
-            and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
-            and jnp.dtype(vcont.dtype) != jnp.dtype(np.float64))
+            # into its own windows on exit
+            and same_mesh)
     if kc.n == 0:
         return keys, values
+    if kcont is vcont and kc.off == vc.off:
+        # keys ARE the values (same window of one container): sorting
+        # the keys reorders the payload identically — plain sort
+        sort(keys, descending=descending)
+        return keys, values
+    aliased = (kcont is vcont
+               # DISJOINT windows of one container blend into a single
+               # donated row (round 5); overlapping windows would make
+               # the two blends race, so they keep the fallback
+               and (kc.off + kc.n <= vc.off or vc.off + vc.n <= kc.off))
     win_ok = (not full
-              and kcont.layout[0] == vcont.layout[0]
-              # two windows of ONE container would need a single
-              # blended output row (and would double-donate the
-              # buffer): that shape keeps the sequential fallback
-              and kcont is not vcont
-              and jnp.dtype(kcont.dtype) != jnp.dtype(np.float64)
-              and jnp.dtype(vcont.dtype) != jnp.dtype(np.float64))
+              and (aliased or (same_mesh and kcont is not vcont)))
     if full or win_ok:
         kw = None if full else (kc.off, kc.n)
         prog = _sort_program(kcont.runtime.mesh, kcont.runtime.axis,
@@ -427,16 +470,32 @@ def sort_by_key(keys, values, *, descending: bool = False):
                              pay_dtype=vcont.dtype,
                              window=kw,
                              pay_window=None if full
-                             else (vc.off, vc.n))
-        kcont._data, vcont._data = prog(kcont._data, vcont._data)
+                             else (vc.off, vc.n),
+                             aliased=aliased)
+        if aliased:
+            kcont._data = prog(kcont._data)
+        else:
+            kcont._data, vcont._data = prog(kcont._data, vcont._data)
         return keys, values
-    if kcont.layout[0] != vcont.layout[0]:
-        why = "keys and values live on different shard counts"
-    elif kcont is vcont:
-        why = "key and value windows share one container"
-    else:
-        why = "float64 keys or values"
-    warn_fallback("sort_by_key", why)
+    if not same_mesh:
+        # DIFFERENT MESHES (mismatched shard counts, or equal counts
+        # over different device sets) take the reshard route (round 5
+        # — this used to be the argsort materialize): the payload
+        # reshards onto the key runtime (two
+        # collective copies, the same XLA-resharding class the
+        # elementwise fallback uses), the sample-sort runs NATIVELY
+        # there with the keys never leaving their shards, and the
+        # reordered payload reshards back into its own windows.
+        from ..containers.distributed_vector import distributed_vector
+        from .elementwise import copy as _copy
+        scratch = distributed_vector(vc.n, dtype=vcont.dtype,
+                                     runtime=kcont.runtime)
+        _copy(values, scratch)
+        sort_by_key(keys, scratch, descending=descending)
+        _copy(scratch, values)
+        return keys, values
+    warn_fallback("sort_by_key",
+                  "overlapping key and value windows of one container")
     karr = kcont.to_array()[kc.off:kc.off + kc.n]
     varr = vcont.to_array()[vc.off:vc.off + vc.n]
     order = jnp.argsort(karr, stable=True)
@@ -472,7 +531,8 @@ def argsort(r, *, descending: bool = False):
 
 
 def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
-    key = ("is_sorted", pinned, axis, layout, str(dtype), window)
+    key = ("is_sorted", pinned, axis, layout, str(dtype), window,
+           bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -507,7 +567,8 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
         # locally sorted AND the max over all PREVIOUS shards' last
         # real keys <= my first real key (empty shards contribute the
         # key-domain minimum, i.e. no constraint)
-        small = jnp.zeros((), k.dtype) if k.dtype == jnp.uint32 \
+        small = jnp.zeros((), k.dtype) \
+            if jnp.issubdtype(k.dtype, jnp.unsignedinteger) \
             else jnp.array(jnp.iinfo(k.dtype).min, k.dtype)
         last = jnp.where(nvalid > 0,
                          k[jnp.clip(nvalid - 1, 0, S - 1)], small)
@@ -530,31 +591,26 @@ def is_sorted(r) -> bool:
     containers AND subrange windows (uniform or uneven
     distributions) run one fused shard_map program (local vector
     compare + one boundary all_gather; windows in window coordinates —
-    round 4); views and f64 fall back to a materialized DIRECT
-    comparison (no f32 key encoding — f64 pairs closer than an f32 ulp
-    must still compare exactly)."""
+    round 4; f64 through the exact 64-bit key encoding, round 5);
+    only views fall back to a materialized direct comparison."""
     res = _resolve(r)
     if res is not None and len(res) != 1:
         raise TypeError("is_sorted takes a single-component range")
     chain = res[0] if res is not None and not res[0].ops else None
     if chain is not None:
         cont = chain.cont
-        if jnp.dtype(cont.dtype) != jnp.dtype(np.float64):
-            if chain.n == 0:
-                return True
-            full = chain.off == 0 and chain.n == len(cont)
-            prog = _is_sorted_program(
-                cont.runtime.mesh, cont.runtime.axis, cont.layout,
-                cont.dtype, pinned_id(cont.runtime.mesh),
-                window=None if full else (chain.off, chain.n))
-            return int(prog(cont._data)) == 0
-        warn_fallback("is_sorted", "float64 (exact direct compare)")
-        arr = cont.to_array()[chain.off:chain.off + chain.n]
-    elif res is None:
+        if chain.n == 0:
+            return True
+        full = chain.off == 0 and chain.n == len(cont)
+        prog = _is_sorted_program(
+            cont.runtime.mesh, cont.runtime.axis, cont.layout,
+            cont.dtype, pinned_id(cont.runtime.mesh),
+            window=None if full else (chain.off, chain.n))
+        return int(prog(cont._data)) == 0
+    if res is None:
         raise TypeError("is_sorted takes a distributed range")
-    else:
-        arr = r.to_array() if hasattr(r, "to_array") \
-            else jnp.asarray(list(r))
+    arr = r.to_array() if hasattr(r, "to_array") \
+        else jnp.asarray(list(r))
     if arr.shape[0] < 2:
         return True
     a, b = arr[:-1], arr[1:]

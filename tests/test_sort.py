@@ -2,6 +2,7 @@
 snapshot has no sort — algorithms/sort.py docstring).  Oracle pattern:
 distributed result vs numpy's sort, per SURVEY.md §4."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -537,18 +538,65 @@ def test_sort_by_key_window_native(mesh_size, monkeypatch):
     np.testing.assert_array_equal(dr_tpu.to_numpy(pd), pref)
 
 
-def test_sort_by_key_same_container_windows_fallback():
-    """Two windows of ONE container keep the sequential fallback (a
-    single blended row would be needed otherwise) and stay correct."""
+def test_sort_by_key_same_container_disjoint_windows_native(monkeypatch):
+    """DISJOINT windows of ONE container run the aliased single-row
+    program (round 5 — this shape used to take the sequential
+    fallback): both blends land in one donated buffer, no
+    materialize."""
     n = 20
     src = np.random.default_rng(2).standard_normal(n).astype(np.float32)
     x = dr_tpu.distributed_vector.from_array(src)
+
+    def boom(self):
+        raise AssertionError("aliased sort_by_key materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
     dr_tpu.sort_by_key(x[0:8], x[10:18])
+    monkeypatch.undo()
     ref = src.copy()
     order = np.argsort(src[0:8], kind="stable")
     ref[0:8] = src[0:8][order]
     ref[10:18] = src[10:18][order]
     np.testing.assert_array_equal(dr_tpu.to_numpy(x), ref)
+    # value window BEFORE the key window, uneven split point
+    src2 = np.random.default_rng(3).standard_normal(n).astype(np.float32)
+    y = dr_tpu.distributed_vector.from_array(src2)
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.sort_by_key(y[11:18], y[2:9], descending=True)
+    monkeypatch.undo()
+    ref2 = src2.copy()
+    order2 = np.argsort(src2[11:18], kind="stable")[::-1]
+    ref2[11:18] = src2[11:18][order2]
+    ref2[2:9] = src2[2:9][order2]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(y), ref2)
+
+
+def test_sort_by_key_same_container_overlap_fallback():
+    """OVERLAPPING windows of one container keep the sequential
+    fallback (the two blends would race) and stay correct."""
+    n = 20
+    src = np.random.default_rng(4).standard_normal(n).astype(np.float32)
+    x = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.sort_by_key(x[0:8], x[5:13])
+    ref = src.copy()
+    order = np.argsort(src[0:8], kind="stable")
+    ref[0:8] = src[0:8][order]
+    ref[5:13] = src[5:13][order]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(x), ref)
+
+
+def test_sort_by_key_keys_are_values():
+    """sort_by_key(x, x) (and equal windows of one container) is plain
+    sort — no double donation of one buffer."""
+    n = 33
+    src = np.random.default_rng(6).standard_normal(n).astype(np.float32)
+    x = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.sort_by_key(x, x)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(x), np.sort(src))
+    y = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.sort_by_key(y[3:17], y[3:17])
+    ref = src.copy()
+    ref[3:17] = np.sort(src[3:17])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(y), ref)
 
 
 def test_sort_by_key_empty_window_noop():
@@ -559,3 +607,164 @@ def test_sort_by_key_empty_window_noop():
     dr_tpu.sort_by_key(k[3:3], v[5:5])
     np.testing.assert_array_equal(dr_tpu.to_numpy(k), src)
     np.testing.assert_array_equal(dr_tpu.to_numpy(v), src)
+
+
+def test_f64_sort_native_under_x64_subprocess():
+    """Real f64 keys (x64-enabled mesh) run the NATIVE sample-sort /
+    is_sorted programs through the 64-bit sign-flip encoding — no
+    materialize, and pairs closer than an f32 ulp order exactly
+    (round 5; the old fallback is gone)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+    import os
+    repo = Path(__file__).resolve().parent.parent
+    code = r"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import dr_tpu
+
+dr_tpu.init()
+# any to_array during an armed window => the native path was left
+import contextlib
+
+@contextlib.contextmanager
+def armed():
+    real = dr_tpu.distributed_vector.to_array
+    def boom(self):
+        raise AssertionError("f64 path materialized")
+    dr_tpu.distributed_vector.to_array = boom
+    try:
+        yield
+    finally:
+        dr_tpu.distributed_vector.to_array = real
+
+n = 97
+rng = np.random.default_rng(5)
+base = rng.standard_normal(n)
+# adjacent pairs closer than an f32 ulp: f32 rounding would tie them
+src = (base + rng.uniform(-2**-40, 2**-40, n)).astype(np.float64)
+v = dr_tpu.distributed_vector(n, np.float64)
+v.assign_array(src)
+assert v._data.dtype == np.float64, v._data.dtype  # real f64 buffer
+with armed():
+    dr_tpu.sort(v)
+got = np.asarray(dr_tpu.to_numpy(v))
+assert got.dtype == np.float64
+np.testing.assert_array_equal(got, np.sort(src))
+with armed():
+    assert dr_tpu.is_sorted(v)
+
+# is_sorted must see sub-f32-ulp inversions exactly
+w = dr_tpu.distributed_vector(2, np.float64)
+w.assign_array(np.array([1.0, 1.0 - 2**-53], dtype=np.float64))
+with armed():
+    assert not dr_tpu.is_sorted(w)
+
+# f64 keys + f64 payload, stable, descending too
+k = rng.standard_normal(n)
+k[13] = k[31]  # a tie
+pay = np.arange(n, dtype=np.float64)
+kd = dr_tpu.distributed_vector(n, np.float64); kd.assign_array(k)
+pd = dr_tpu.distributed_vector(n, np.float64); pd.assign_array(pay)
+with armed():
+    dr_tpu.sort_by_key(kd, pd)
+order = np.argsort(k, kind="stable")
+np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+np.testing.assert_array_equal(dr_tpu.to_numpy(pd), pay[order])
+
+# NaNs last, -0.0/+0.0 handling on the 64-bit path
+z = np.array([np.nan, -0.0, 1.5, 0.0, -1.5, np.nan], dtype=np.float64)
+zd = dr_tpu.distributed_vector(len(z), np.float64)
+zd.assign_array(z)
+with armed():
+    dr_tpu.sort(zd)
+zg = np.asarray(dr_tpu.to_numpy(zd))
+np.testing.assert_array_equal(zg, np.sort(z))
+assert np.signbit(zg[1]) and not np.signbit(zg[2])  # -0.0 before +0.0
+print("X64-SORT-OK")
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    out = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "X64-SORT-OK" in out.stdout
+
+
+def test_sort_by_key_mismatched_shard_counts_native():
+    """Keys and values on DIFFERENT runtimes (shard counts) take the
+    reshard route (round 5 — this used to be the argsort materialize):
+    payload reshards onto the key runtime, the sample-sort runs
+    natively there, result reshards back.  No MaterializeFallback
+    warning fires."""
+    import warnings
+    from dr_tpu.parallel.runtime import Runtime
+    from dr_tpu.utils.fallback import MaterializeFallbackWarning
+    from jax.sharding import Mesh
+    ndev = len(jax.devices())
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices for distinct shard counts")
+    rt_small = Runtime(mesh=Mesh(np.asarray(jax.devices()[:ndev // 2]),
+                                 ("x",)))
+    n = 101
+    rng = np.random.default_rng(7)
+    k = rng.standard_normal(n).astype(np.float32)
+    pay = np.arange(n, dtype=np.int32)
+    kd = dr_tpu.distributed_vector(n, np.float32)
+    kd.assign_array(k)
+    vd = dr_tpu.distributed_vector(n, np.int32, runtime=rt_small)
+    vd.assign_array(pay)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        dr_tpu.sort_by_key(kd, vd)
+    assert not [r for r in rec
+                if issubclass(r.category, MaterializeFallbackWarning)], \
+        [str(r.message) for r in rec]
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), pay[order])
+
+    # windows on both sides, descending, int payload
+    kd2 = dr_tpu.distributed_vector(n, np.float32)
+    kd2.assign_array(k)
+    vd2 = dr_tpu.distributed_vector(n, np.int32, runtime=rt_small)
+    vd2.assign_array(pay)
+    dr_tpu.sort_by_key(kd2[5:60], vd2[10:65], descending=True)
+    kref = k.copy()
+    pref = pay.copy()
+    o = np.argsort(k[5:60], kind="stable")[::-1]
+    kref[5:60] = k[5:60][o]
+    pref[10:65] = pay[10:65][o]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd2), kref)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd2), pref)
+
+
+def test_sort_by_key_equal_counts_different_devices_native():
+    """EQUAL shard counts over DIFFERENT device sets must also take
+    the reshard route — mesh identity, not shard count, is the
+    dispatch (round-5 review finding)."""
+    from dr_tpu.parallel.runtime import Runtime
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices for two disjoint 4-device meshes")
+    rt_a = Runtime(mesh=Mesh(np.asarray(devs[:4]), ("x",)))
+    rt_b = Runtime(mesh=Mesh(np.asarray(devs[4:8]), ("x",)))
+    n = 57
+    rng = np.random.default_rng(8)
+    k = rng.standard_normal(n).astype(np.float32)
+    pay = np.arange(n, dtype=np.int32)
+    kd = dr_tpu.distributed_vector(n, np.float32, runtime=rt_a)
+    kd.assign_array(k)
+    vd = dr_tpu.distributed_vector(n, np.int32, runtime=rt_b)
+    vd.assign_array(pay)
+    dr_tpu.sort_by_key(kd, vd)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), pay[order])
