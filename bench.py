@@ -423,6 +423,37 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
     finally:
         A = B = M = None
 
+    # beyond-parity: distributed sample sort (sort_n fused loop; the
+    # reference has no sort — the repo's own perf bar needs a recorded
+    # number for the surfaces it advertises, VERDICT r4 missing #3)
+    try:
+        n = (2 ** 20 if on_cpu else 2 ** 24) // P * P
+        rng = np.random.default_rng(3)
+        v = dr_tpu.distributed_vector(n, np.float32)
+        v.assign_array(rng.standard_normal(n).astype(np.float32))
+        from dr_tpu.algorithms.sort import sort_by_key_n, sort_n
+
+        def run_sort(r):
+            sort_n(v, r)
+            _sync(v)
+        dt = _marginal(run_sort, r1=2, r2=10, samples=5)
+        out["sort_gbps"] = round(n * itemsize / dt / 1e9, 2)
+        out["sort_mkeys"] = round(n / dt / 1e6, 1)
+        kd = dr_tpu.distributed_vector(n, np.float32)
+        kd.assign_array(rng.standard_normal(n).astype(np.float32))
+        pd = dr_tpu.distributed_vector(n, np.int32)
+        dr_tpu.iota(pd, 0)
+
+        def run_kv(r):
+            sort_by_key_n(kd, pd, r)
+            _sync(kd)
+        dt = _marginal(run_kv, r1=2, r2=10, samples=5)
+        out["sortkv_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
+    except Exception as e:  # pragma: no cover - defensive
+        out["sort_error"] = repr(e)[:160]
+    finally:
+        v = kd = pd = None
+
     # long-context: causal ring attention (sequence-parallel over the
     # same ppermute ring as the halo subsystem; SURVEY §5).  bf16
     # inputs take the fused Pallas flash kernel (f32 accumulation);

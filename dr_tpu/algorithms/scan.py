@@ -26,7 +26,8 @@ from jax.sharding import PartitionSpec as P
 
 from ._common import (combine_for, owned_window_mask, uniform_layout,
                       window_geometry, working_geometry)
-from .elementwise import _op_key, _out_chain, _prog_cache, _resolve, _write_window
+from .elementwise import (_Chain, _op_key, _out_chain, _prog_cache,
+                          _resolve, _write_window)
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
 
@@ -125,7 +126,8 @@ def _kernel_variant():
 
 
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
-                  use_kernel=False, window=None, aliased=False):
+                  use_kernel=False, window=None, aliased=False,
+                  ops=(), out_layout=None, out_window=None):
     """``window=(off, wn)`` scans ONLY the logical subrange (round 4):
     with an identity op, the window scan IS the whole-container scan of
     an identity-masked input — cells before the window contribute the
@@ -134,10 +136,25 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     geometry + the empty-shard-skipping fold — no identity needed).
     Either way the output row blends scanned window cells into the OUT
     container's original row (the program takes out's data as a second,
-    donated argument, or one aliased argument for in-place forms)."""
+    donated argument, or one aliased argument for in-place forms).
+
+    Round-5 extensions:
+
+    - ``ops``: a view chain's elementwise op stack, fused into the
+      program — applied to the extracted slice BEFORE any identity
+      masking (the masks live in the post-op domain, where the scan
+      identity is meaningful).
+    - ``out_layout``/``out_window``: a MISMATCHED destination (different
+      offsets, or a different distribution on the same mesh).  The scan
+      then always runs in WINDOW coordinates; the scanned values
+      realign from the in-window's per-shard geometry to the
+      out-window's by one static masked all_to_all (the sort family's
+      rebalance pattern) and blend through the OUT container's mask."""
+    mismatched = out_window is not None
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
            else None, exclusive, str(dtype), use_kernel,
-           _kernel_variant() if use_kernel else None, window, aliased)
+           _kernel_variant() if use_kernel else None, window, aliased,
+           tuple(_op_key(f) for f in ops), out_layout, out_window)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -150,20 +167,35 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
         wmask_c = jnp.asarray(np.asarray(
             owned_window_mask(layout, *window)[0]))
         width = prev + cap + nxt
-        if kind is None:
+        if kind is None or mismatched:
             # identityless window: no value can mask outside cells —
             # run the phases in WINDOW coordinates instead (the sort
             # family's approach): the window's shard intersections are
             # static uneven geometry, each shard reads its slice at a
             # static offset, and the identityless uneven machinery
             # (real totals at local[valid-1], empty-shard-skipping
-            # fold) needs no identity anywhere
+            # fold) needs no identity anywhere.  Mismatched in/out
+            # geometries ALWAYS take window coordinates — they are the
+            # common coordinate system the realign maps between.
             _, S, _, _, _, n, starts, sizes, wstart = \
                 window_geometry(layout, *window)
             woff_c = jnp.asarray(wstart, jnp.int32)
             wgeom = True
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
+    if mismatched:
+        # destination-side static geometry (its own layout and window)
+        oL = out_layout or layout
+        _, oS, ocap, oprev, onxt, _, ostarts, osizes, owstart = \
+            window_geometry(oL, *out_window)
+        owidth = oprev + ocap + onxt
+        owoff_c = jnp.asarray(owstart, jnp.int32)
+        omask_c = jnp.asarray(np.asarray(
+            owned_window_mask(oL, *out_window)[0]))
+        ostarts_c = jnp.asarray(ostarts, jnp.int32)
+        osizes_c = jnp.asarray(osizes, jnp.int32)
+        same_geom = (np.array_equal(ostarts, starts)
+                     and np.array_equal(osizes, sizes))
     # pad cells exist when the ceil layout overshoots n OR any shard of
     # an uneven distribution is narrower than the working width: skip
     # the masking pass (a whole extra HBM read-modify) when exact.
@@ -181,6 +213,11 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             x = jnp.take(blk[0], idx)
         else:
             x = blk[0, prev:prev + S]
+        for f in ops:
+            # the view chain's elementwise stack, fused (round 5);
+            # masks below live in the POST-op domain, where the scan
+            # identity is meaningful
+            x = f(x)
         if window is not None and not wgeom:
             # outside-window cells become the identity: every window
             # prefix then sees only window contributions
@@ -303,6 +340,29 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             # in-place form, the input row IS the out row — a second
             # argument would trip donation aliasing)
             keep = blk[0] if aliased else out_blk[0][0]
+            if mismatched:
+                # window-coordinate results live on the IN-window's
+                # shard geometry; destination cells follow the OUT
+                # window's.  Each window position is owned by exactly
+                # one source shard under the in-geometry, so one
+                # static masked all_to_all + column sum re-homes every
+                # value (the sort family's rebalance pattern), and the
+                # blend runs through the OUT container's mask.
+                sc = scanned.astype(dtype)
+                if not same_geom:
+                    gpos_o = ostarts_c[:, None] + jnp.arange(oS)[None, :]
+                    dest_ok = jnp.arange(oS)[None, :] < osizes_c[:, None]
+                    idxl = gpos_o - starts_c[r]
+                    own = dest_ok & (idxl >= 0) & (idxl < sizes_c[r])
+                    send = jnp.where(
+                        own, jnp.take(sc, jnp.clip(idxl, 0, S - 1)),
+                        jnp.zeros((), sc.dtype))
+                    sc = jnp.sum(lax.all_to_all(send, axis, 0, 0),
+                                 axis=0)
+                ocol_idx = jnp.clip(
+                    jnp.arange(owidth) - oprev - owoff_c[r], 0, oS - 1)
+                vals = jnp.take(sc, ocol_idx)
+                return jnp.where(omask_c[r], vals, keep)[None]
             if wgeom:
                 # re-address window-coordinate results per column
                 col_idx = jnp.clip(
@@ -340,52 +400,84 @@ def _scan(in_r, out, op, init, exclusive):
     kind = _classify_op(op)
     out_chain = _out_chain(out)
     ins = _resolve(in_r)
+    if ins is not None and len(ins) == 1 and ins[0].n != out_chain.n:
+        # transform's window convention (elementwise.py): a LARGER out
+        # window narrows to the input length; a smaller one is a clear
+        # error at the call site, not a broadcast crash downstream
+        if out_chain.n < ins[0].n:
+            raise ValueError(
+                f"scan output window too small ({out_chain.n} < "
+                f"{ins[0].n})")
+        out_chain = _Chain(out_chain.cont, out_chain.off, ins[0].n,
+                           out_chain.ops)
+    single = ins is not None and len(ins) == 1
+    c = ins[0] if single else None
+    if single and c.n == 0:
+        return out  # empty window: nothing to scan, nothing to seed
+    same_mesh = (single and
+                 c.cont.runtime.mesh == out_chain.cont.runtime.mesh)
     full = (
-        ins is not None and len(ins) == 1 and not ins[0].ops
-        and ins[0].off == 0 and out_chain.off == 0
-        and ins[0].cont.layout == out_chain.cont.layout
+        single and same_mesh
+        and c.off == 0 and out_chain.off == 0
+        and c.cont.layout == out_chain.cont.layout
         # the shard_map program handles any uniform ceil layout, and
         # uneven block distributions for EVERY op: identity ops mask
         # pads; identityless ops read real totals at local[valid-1]
         # with an empty-shard-skipping fold (round 4 — the exclusive
         # variant seeds shard boundaries from that same fold, so no
-        # identity is ever required).  Only windows/view chains
-        # materialize now.
-        and ins[0].n == len(ins[0].cont)
+        # identity is ever required).  View-chain ops fuse into the
+        # program (round 5).
+        and c.n == len(c.cont)
         # the fast program rebuilds the whole output array, so the output
         # window must cover the whole container too
         and out_chain.n == len(out_chain.cont)
     )
     # aligned subrange windows run the SAME program for every op
     # (round 4: identity-masked input, or window coordinates for
-    # identityless ops) — the fallback remains for view chains,
-    # layout mismatches, and mismatched in/out windows
+    # identityless ops)
     win_ok = (
-        not full
-        and ins is not None and len(ins) == 1 and not ins[0].ops
-        and ins[0].cont.layout == out_chain.cont.layout
-        and ins[0].off == out_chain.off
-        and ins[0].n == out_chain.n
-        and ins[0].n > 0
+        not full and single and same_mesh
+        and c.cont.layout == out_chain.cont.layout
+        and c.off == out_chain.off
     )
-    if full or win_ok:
-        c = ins[0]
+    # mismatched in/out windows or distributions on ONE mesh run the
+    # window-coordinate program with a realign into the destination
+    # geometry (round 5)
+    mis_ok = not full and not win_ok and single and same_mesh
+    if full or win_ok or mis_ok:
         mesh = c.cont.runtime.mesh
         dt = out_chain.cont.dtype
         aliased = (not full) and c.cont is out_chain.cont
+        # view-chain ops make the post-op dtype program-defined; the
+        # Pallas kernel's f32-accumulation contract is keyed on the
+        # INPUT dtype, so chains conservatively take the XLA path
+        use_kernel = (not c.ops) and _use_scan_kernel(
+            c.cont.layout, kind, c.cont.dtype, c.cont.runtime)
         prog = _scan_program(
             mesh, c.cont.runtime.axis, c.cont.layout, kind, op,
-            exclusive, dt,
-            use_kernel=_use_scan_kernel(c.cont.layout, kind,
-                                        c.cont.dtype, c.cont.runtime),
-            window=None if full else (c.off, c.n), aliased=aliased)
+            exclusive, dt, use_kernel=use_kernel,
+            window=None if full else (c.off, c.n), aliased=aliased,
+            ops=tuple(c.ops),
+            out_layout=out_chain.cont.layout if mis_ok else None,
+            out_window=(out_chain.off, out_chain.n) if mis_ok else None)
         out_chain.cont._data = prog(c.cont._data) if full or aliased \
             else prog(c.cont._data, out_chain.cont._data)
         scanned = None
+    elif single:
+        # DIFFERENT MESHES: scan natively on the input's runtime, then
+        # reshard the result into the destination window (the same
+        # XLA-resharding transport class as the elementwise fallback —
+        # the scan collectives stay native; round 5)
+        from ..containers.distributed_vector import distributed_vector
+        from .elementwise import copy as _copy
+        scratch = distributed_vector(c.n, dtype=out_chain.cont.dtype,
+                                     runtime=c.cont.runtime)
+        _scan(in_r, scratch, op, None, exclusive)
+        _copy(scratch, out)
+        scanned = None
     else:
         from ..utils.fallback import warn_fallback
-        warn_fallback("scan", "view chain, in/out layout mismatch, or "
-                      "mismatched in/out windows")
+        warn_fallback("scan", "multi-component input range")
         arr = in_r.to_array() if hasattr(in_r, "to_array") \
             else jnp.asarray(in_r)
         combine = combine_for(kind, op)

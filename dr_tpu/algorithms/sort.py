@@ -506,6 +506,66 @@ def sort_by_key(keys, values, *, descending: bool = False):
     return keys, values
 
 
+def sort_n(v, iters: int):
+    """``iters`` chained whole-container sorts in ONE jitted program
+    (the ``inclusive_scan_n`` measurement analog): per-sort device
+    time then excludes the tunneled per-dispatch overhead.  After the
+    first round the data is already sorted — ``lax.sort``'s
+    sorting-network cost is data-independent on TPU, so the marginal
+    rounds still price the real program.  Timing aid for bench.py; the
+    final content is simply the sorted input."""
+    chain = _out_chain(v)
+    cont = chain.cont
+    assert chain.off == 0 and chain.n == len(cont), \
+        "sort_n takes a whole container"
+    mesh, axis = cont.runtime.mesh, cont.runtime.axis
+    key = ("sort_n", pinned_id(mesh), axis, cont.layout,
+           str(cont.dtype), int(iters), bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        one = _sort_program(mesh, axis, cont.layout, cont.dtype, False)
+
+        def many(d):
+            # jit-of-jit inlines `one`; its donation applies only at
+            # top-level dispatch, so the loop carry is clean
+            return lax.fori_loop(0, iters, lambda _, x: one(x), d)
+
+        prog = jax.jit(many, donate_argnums=0)
+        _prog_cache[key] = prog
+    cont._data = prog(cont._data)
+    return v
+
+
+def sort_by_key_n(keys, values, iters: int):
+    """``iters`` chained key-value sorts in ONE jitted program (see
+    :func:`sort_n`)."""
+    kc = _out_chain(keys)
+    vc = _out_chain(values)
+    kcont, vcont = kc.cont, vc.cont
+    assert (kc.off == 0 and vc.off == 0 and kc.n == len(kcont)
+            and vc.n == len(vcont)
+            and kcont.runtime.mesh == vcont.runtime.mesh), \
+        "sort_by_key_n takes two whole same-mesh containers"
+    mesh, axis = kcont.runtime.mesh, kcont.runtime.axis
+    key = ("sortkv_n", pinned_id(mesh), axis, kcont.layout,
+           str(kcont.dtype), vcont.layout, str(vcont.dtype), int(iters),
+           bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        one = _sort_program(mesh, axis, kcont.layout, kcont.dtype,
+                            False, pay_layout=vcont.layout,
+                            pay_dtype=vcont.dtype)
+
+        def many(kd, vd):
+            return lax.fori_loop(0, iters, lambda _, kv: one(*kv),
+                                 (kd, vd))
+
+        prog = jax.jit(many, donate_argnums=(0, 1))
+        _prog_cache[key] = prog
+    kcont._data, vcont._data = prog(kcont._data, vcont._data)
+    return keys, values
+
+
 def argsort(r, *, descending: bool = False):
     """The stable sort permutation of ``r`` as a new int32
     ``distributed_vector`` (``r`` itself is left untouched): index

@@ -22,6 +22,7 @@ the interpreter with any usable namespace.
 
 from __future__ import annotations
 
+import ast
 import functools
 import re
 
@@ -42,6 +43,11 @@ FUNCTIONS = {
 }
 
 _MAX_ARGS = 8
+# validator-side arity for each whitelisted function (the structural
+# AST gate rejects wrong-arity calls at the trust boundary)
+_ARITY = {"sqrt": 1, "exp": 1, "log": 1, "tanh": 1, "abs": 1,
+          "minimum": 2, "maximum": 2, "power": 2}
+assert set(_ARITY) == set(FUNCTIONS), "every DSL function needs an arity"
 _NAME = re.compile(r"[A-Za-z_][A-Za-z_0-9]*")
 # everything a serialized expression may contain besides names:
 # numbers (incl. scientific notation), arithmetic, parens, commas
@@ -65,6 +71,48 @@ def _validate(expr: str, nargs: int) -> None:
         raise ValueError(f"expr contains non-DSL characters: {expr!r}")
     if "__" in expr:
         raise ValueError("double underscore is not part of the DSL")
+    # structural gate (round-5 fuzz finding: the character classes
+    # alone admit "x0, x1" — a TUPLE — and similar shapes): the string
+    # must parse as ONE scalar expression whose AST contains only DSL
+    # nodes.  Commas are legal solely as whitelisted-call argument
+    # separators, which this walk enforces for free.
+    try:
+        tree = ast.parse(expr.strip(), mode="eval")
+    except SyntaxError:
+        raise ValueError(f"expr does not parse as one expression: "
+                         f"{expr!r}") from None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Expression, ast.operator, ast.unaryop,
+                             ast.expr_context)):
+            continue
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div,
+                          ast.Mod, ast.Pow)):
+            continue
+        if isinstance(node, ast.UnaryOp) and isinstance(
+                node.op, (ast.UAdd, ast.USub)):
+            continue
+        if isinstance(node, ast.Call):
+            if (not isinstance(node.func, ast.Name)
+                    or node.func.id not in FUNCTIONS or node.keywords):
+                raise ValueError(
+                    f"expr call outside the DSL surface: {expr!r}")
+            want = _ARITY[node.func.id]
+            if len(node.args) != want:
+                # arity belongs to the validator: a wrong-arity call
+                # must fail HERE with ValueError, not as a TypeError
+                # when the op first runs inside a jitted algorithm
+                raise ValueError(
+                    f"{node.func.id} takes {want} argument(s), got "
+                    f"{len(node.args)} in {expr!r}")
+            continue
+        if isinstance(node, ast.Name):  # membership checked above
+            continue
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, (int, float)):
+            continue
+        raise ValueError(f"expr node outside the DSL: "
+                         f"{type(node).__name__} in {expr!r}")
 
 
 @functools.lru_cache(maxsize=512)

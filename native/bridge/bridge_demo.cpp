@@ -250,6 +250,55 @@ int main(int argc, char** argv) {
     check_range("transpose", T.to_host(), want);
   }
 
+  // ---- N-D mdarray: 3-D axis-permutation transpose + submdspan
+  // (round 5 — the spec'd N-D surface reached from C++,
+  // doc/spec/source/containers/distributed_mdarray.rst:12-23) --------
+  {
+    const std::size_t a = 12, b = 10, c = 8;
+    std::vector<double> d3(a * b * c);
+    for (std::size_t i = 0; i < d3.size(); ++i)
+      d3[i] = (double)i * 0.25 - 40.0;
+    thp::mdarray M = s.make_mdarray({a, b, c}, d3);
+    if (M.rank() != 3 || M.shape()[1] != b) {
+      std::printf("mdarray3d shape FAIL\n");
+      ++failures;
+    }
+    check_range("mdarray3d roundtrip", M.to_host(), d3);
+    // permute (a,b,c) -> (c,a,b) via axes {2,0,1}
+    thp::mdarray T3 = s.make_mdarray({c, a, b});
+    s.transpose(T3, M, {2, 0, 1});
+    std::vector<double> want3(c * a * b);
+    for (std::size_t i = 0; i < a; ++i)
+      for (std::size_t j = 0; j < b; ++j)
+        for (std::size_t k3 = 0; k3 < c; ++k3)
+          want3[(k3 * a + i) * b + j] = d3[(i * b + j) * c + k3];
+    check_range("transpose3d axes(2,0,1)", T3.to_host(), want3);
+    // default (reversed) permutation on the same 3-D array
+    thp::mdarray TR = s.make_mdarray({c, b, a});
+    s.transpose(TR, M);
+    std::vector<double> wantr(c * b * a);
+    for (std::size_t i = 0; i < a; ++i)
+      for (std::size_t j = 0; j < b; ++j)
+        for (std::size_t k3 = 0; k3 < c; ++k3)
+          wantr[(k3 * b + j) * a + i] = d3[(i * b + j) * c + k3];
+    check_range("transpose3d reversed", TR.to_host(), wantr);
+    // submdspan window [2,9) x [1,6) x [3,8): materializes ONLY the
+    // window, row-major over the window shape
+    thp::mdspan W = s.submdspan(M, {{2, 9}, {1, 6}, {3, 8}});
+    if (W.rank() != 3 || W.shape()[0] != 7 || W.shape()[1] != 5 ||
+        W.shape()[2] != 5) {
+      std::printf("submdspan shape FAIL\n");
+      ++failures;
+    }
+    std::vector<double> wantw(7 * 5 * 5);
+    for (std::size_t i = 0; i < 7; ++i)
+      for (std::size_t j = 0; j < 5; ++j)
+        for (std::size_t k3 = 0; k3 < 5; ++k3)
+          wantw[(i * 5 + j) * 5 + k3] =
+              d3[((i + 2) * b + (j + 1)) * c + (k3 + 3)];
+    check_range("submdspan3d", W.to_host(), wantw);
+  }
+
   // ---- checkpoint round-trip ------------------------------------------
   {
     thp::vector v = s.make_vector(777);

@@ -42,62 +42,6 @@ PyObject* np_view(PyObject* np, const void* data, std::size_t nbytes,
 }  // namespace
 
 // ---------------------------------------------------------------------
-// expression DSL
-// ---------------------------------------------------------------------
-namespace {
-std::string num(double v) {
-  char buf[64];
-  std::snprintf(buf, sizeof buf, "%.17g", v);
-  return buf;
-}
-expr mk(std::string s) { return expr(expr::raw_t{}, std::move(s)); }
-}  // namespace
-
-expr expr::arg(int i) { return mk("x" + std::to_string(i)); }
-expr expr::lit(double v) { return mk(num(v)); }
-
-expr operator+(const expr& a, const expr& b) {
-  return mk("(" + a.str() + " + " + b.str() + ")");
-}
-expr operator-(const expr& a, const expr& b) {
-  return mk("(" + a.str() + " - " + b.str() + ")");
-}
-expr operator*(const expr& a, const expr& b) {
-  return mk("(" + a.str() + " * " + b.str() + ")");
-}
-expr operator/(const expr& a, const expr& b) {
-  return mk("(" + a.str() + " / " + b.str() + ")");
-}
-expr operator-(const expr& a) { return mk("(0 - " + a.str() + ")"); }
-expr operator+(const expr& a, double b) { return a + expr::lit(b); }
-expr operator+(double a, const expr& b) { return expr::lit(a) + b; }
-expr operator-(const expr& a, double b) { return a - expr::lit(b); }
-expr operator-(double a, const expr& b) { return expr::lit(a) - b; }
-expr operator*(const expr& a, double b) { return a * expr::lit(b); }
-expr operator*(double a, const expr& b) { return expr::lit(a) * b; }
-expr operator/(const expr& a, double b) { return a / expr::lit(b); }
-expr operator/(double a, const expr& b) { return expr::lit(a) / b; }
-expr sqrt(const expr& a) { return mk("sqrt(" + a.str() + ")"); }
-expr exp(const expr& a) { return mk("exp(" + a.str() + ")"); }
-expr log(const expr& a) { return mk("log(" + a.str() + ")"); }
-expr tanh(const expr& a) { return mk("tanh(" + a.str() + ")"); }
-expr abs(const expr& a) { return mk("abs(" + a.str() + ")"); }
-expr min(const expr& a, const expr& b) {
-  return mk("minimum(" + a.str() + ", " + b.str() + ")");
-}
-expr max(const expr& a, const expr& b) {
-  return mk("maximum(" + a.str() + ", " + b.str() + ")");
-}
-expr pow(const expr& a, const expr& b) {
-  return mk("power(" + a.str() + ", " + b.str() + ")");
-}
-
-const expr x0 = expr::arg(0);
-const expr x1 = expr::arg(1);
-const expr x2 = expr::arg(2);
-const expr x3 = expr::arg(3);
-
-// ---------------------------------------------------------------------
 // session impl
 // ---------------------------------------------------------------------
 struct session::impl {
@@ -358,21 +302,27 @@ sparse_matrix session::make_sparse_coo(
   return sparse_matrix(this, obj, m, n, values.size());
 }
 
-mdarray session::make_mdarray(std::size_t m, std::size_t n,
+mdarray session::make_mdarray(const std::vector<std::size_t>& shape,
                               const std::vector<double>& row_major) {
+  if (shape.empty()) fail("make_mdarray: shape must have rank >= 1");
+  std::size_t total = 1;
+  for (std::size_t s : shape) total *= s;
+  PyObject* shp = must(PyTuple_New((Py_ssize_t)shape.size()),
+                       "shape tuple");
+  for (std::size_t i = 0; i < shape.size(); ++i)
+    PyTuple_SET_ITEM(shp, (Py_ssize_t)i, PyLong_FromSize_t(shape[i]));
   PyObject* cls = must(
       PyObject_GetAttrString(impl_->dr, "distributed_mdarray"),
       "distributed_mdarray");
   PyObject* obj;
   if (row_major.empty()) {
-    obj = must(PyObject_CallFunction(cls, "((nn))", (Py_ssize_t)m,
-                                     (Py_ssize_t)n),
-               "distributed_mdarray((m, n))");
+    obj = must(PyObject_CallFunction(cls, "(O)", shp),
+               "distributed_mdarray(shape)");
   } else {
-    if (row_major.size() != m * n) fail("make_mdarray: data size != m*n");
+    if (row_major.size() != total)
+      fail("make_mdarray: data size != product(shape)");
     PyObject* flat = impl_->np_f32(row_major);
-    PyObject* arr = must(PyObject_CallMethod(flat, "reshape", "nn",
-                                             (Py_ssize_t)m, (Py_ssize_t)n),
+    PyObject* arr = must(PyObject_CallMethod(flat, "reshape", "O", shp),
                          "reshape");
     obj = must(PyObject_CallMethod(cls, "from_array", "O", arr),
                "distributed_mdarray.from_array");
@@ -380,7 +330,42 @@ mdarray session::make_mdarray(std::size_t m, std::size_t n,
     Py_DECREF(flat);
   }
   Py_DECREF(cls);
-  return mdarray(this, obj, m, n);
+  Py_DECREF(shp);
+  return mdarray(this, obj, shape);
+}
+
+mdarray session::make_mdarray(std::size_t m, std::size_t n,
+                              const std::vector<double>& row_major) {
+  return make_mdarray(std::vector<std::size_t>{m, n}, row_major);
+}
+
+mdspan session::submdspan(
+    const mdarray& a,
+    const std::vector<std::pair<std::size_t, std::size_t>>& box) {
+  if (box.size() != a.rank())
+    fail("submdspan: box rank != array rank");
+  std::vector<std::size_t> wshape(box.size());
+  PyObject* args = must(PyTuple_New((Py_ssize_t)box.size()),
+                        "slice tuple");
+  for (std::size_t d = 0; d < box.size(); ++d) {
+    auto [lo, hi] = box[d];
+    if (lo > hi || hi > a.shape()[d])
+      fail("submdspan: window out of bounds");
+    wshape[d] = hi - lo;
+    PyObject* plo = PyLong_FromSize_t(lo);
+    PyObject* phi = PyLong_FromSize_t(hi);
+    PyObject* sl = must(PySlice_New(plo, phi, nullptr), "slice");
+    Py_DECREF(plo);
+    Py_DECREF(phi);
+    PyTuple_SET_ITEM(args, (Py_ssize_t)d, sl);
+  }
+  PyObject* fn = must(PyObject_GetAttrString((PyObject*)a.obj_,
+                                             "submdspan"),
+                      "submdspan attr");
+  PyObject* obj = must(PyObject_CallObject(fn, args), "submdspan(...)");
+  Py_DECREF(fn);
+  Py_DECREF(args);
+  return mdspan(this, obj, std::move(wshape));
 }
 
 // ------------------------------------------------------------ algorithms
@@ -589,11 +574,26 @@ void session::gemm(const dense_matrix& a, const dense_matrix& b,
   Py_DECREF(r);
 }
 
-void session::transpose(mdarray& out, const mdarray& in) {
-  PyObject* r = must(
-      PyObject_CallMethod(impl_->dr, "transpose", "OO",
-                          (PyObject*)out.obj_, (PyObject*)in.obj_),
-      "transpose");
+void session::transpose(mdarray& out, const mdarray& in,
+                        const std::vector<std::size_t>& axes) {
+  PyObject* r;
+  if (axes.empty()) {  // numpy default: reversed axes
+    r = must(PyObject_CallMethod(impl_->dr, "transpose", "OO",
+                                 (PyObject*)out.obj_, (PyObject*)in.obj_),
+             "transpose");
+  } else {
+    if (axes.size() != in.rank())
+      fail("transpose: axes rank != array rank");
+    PyObject* ax = must(PyTuple_New((Py_ssize_t)axes.size()),
+                        "axes tuple");
+    for (std::size_t i = 0; i < axes.size(); ++i)
+      PyTuple_SET_ITEM(ax, (Py_ssize_t)i, PyLong_FromSize_t(axes[i]));
+    r = must(PyObject_CallMethod(impl_->dr, "transpose", "OOO",
+                                 (PyObject*)out.obj_, (PyObject*)in.obj_,
+                                 ax),
+             "transpose(axes)");
+    Py_DECREF(ax);
+  }
   Py_DECREF(r);
 }
 
@@ -726,6 +726,15 @@ std::vector<double> dense_matrix::to_host() const {
 }
 
 std::vector<double> mdarray::to_host() const {
+  PyObject* arr = must(
+      PyObject_CallMethod((PyObject*)obj_, "materialize", nullptr),
+      "materialize");
+  std::vector<double> out = sess_->impl_->to_host_f64(arr);
+  Py_DECREF(arr);
+  return out;
+}
+
+std::vector<double> mdspan::to_host() const {
   PyObject* arr = must(
       PyObject_CallMethod((PyObject*)obj_, "materialize", nullptr),
       "materialize");

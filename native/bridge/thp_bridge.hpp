@@ -30,8 +30,9 @@
 //   thp::sparse_matrix A = s.make_sparse_coo(m, n, rows, cols, vals);
 //   s.gemv(c, A, b);                                    // c += A·b
 //   thp::dense_matrix M = s.make_dense(m, n, host_data);
-//   thp::mdarray T = s.make_mdarray(m, n, host_data);
-//   s.transpose(out_md, in_md);                         // all-to-all T
+//   thp::mdarray T = s.make_mdarray({a, b, c}, host_data);  // N-D
+//   s.transpose(out_md, in_md, {2, 0, 1});              // all-to-all T
+//   thp::mdspan W = s.submdspan(T, {{2, 9}, {0, b}, {3, 8}});
 //   s.stencil_iterate(a, b, {w...}, steps);
 //   std::vector<double> host = v.to_host();  // buffer-protocol copy
 #pragma once
@@ -203,15 +204,39 @@ class sparse_matrix : public detail::handle {
 class mdarray : public detail::handle {
  public:
   mdarray() = default;
-  std::size_t rows() const { return m_; }
-  std::size_t cols() const { return n_; }
-  std::vector<double> to_host() const;  // row-major m*n
+  // N-D (round 5): the spec'd surface is arbitrary rank
+  // (doc/spec/source/containers/distributed_mdarray.rst:12-23); the
+  // Python container has been N-D since round 3 — the bridge now
+  // reaches all of it.
+  const std::vector<std::size_t>& shape() const { return shape_; }
+  std::size_t rank() const { return shape_.size(); }
+  // 2-D convenience accessors (the historical surface)
+  std::size_t rows() const { return shape_.empty() ? 0 : shape_[0]; }
+  std::size_t cols() const { return shape_.size() < 2 ? 1 : shape_[1]; }
+  std::vector<double> to_host() const;  // row-major, product(shape)
 
  private:
   friend class session;
-  mdarray(session* s, void* obj, std::size_t m, std::size_t n)
-      : handle(s, obj), m_(m), n_(n) {}
-  std::size_t m_ = 0, n_ = 0;
+  mdarray(session* s, void* obj, std::vector<std::size_t> shape)
+      : handle(s, obj), shape_(std::move(shape)) {}
+  std::vector<std::size_t> shape_;
+};
+
+// Non-owning N-D window over an mdarray (the spec's submdspan;
+// Python: distributed_mdspan).  to_host() materializes ONLY the
+// window, row-major over the window's shape.
+class mdspan : public detail::handle {
+ public:
+  mdspan() = default;
+  const std::vector<std::size_t>& shape() const { return shape_; }
+  std::size_t rank() const { return shape_.size(); }
+  std::vector<double> to_host() const;
+
+ private:
+  friend class session;
+  mdspan(session* s, void* obj, std::vector<std::size_t> shape)
+      : handle(s, obj), shape_(std::move(shape)) {}
+  std::vector<std::size_t> shape_;
 };
 
 // ---------------------------------------------------------------------
@@ -242,8 +267,16 @@ class session {
                                 const std::vector<std::int64_t>& rows,
                                 const std::vector<std::int64_t>& cols,
                                 const std::vector<double>& values);
+  // N-D mdarray over an arbitrary shape (round 5); the (m, n) form
+  // below is the historical 2-D convenience.
+  mdarray make_mdarray(const std::vector<std::size_t>& shape,
+                       const std::vector<double>& row_major = {});
   mdarray make_mdarray(std::size_t m, std::size_t n,
                        const std::vector<double>& row_major = {});
+  // half-open [lo, hi) windows, one per dimension (rank must match)
+  mdspan submdspan(
+      const mdarray& a,
+      const std::vector<std::pair<std::size_t, std::size_t>>& box);
 
   // elementwise / reduction algorithms (op = DSL expression)
   void transform(const vector& in, vector& out, const expr& op);
@@ -278,7 +311,10 @@ class session {
   void gemv(vector& c, const sparse_matrix& a, const vector& b);
   void gemm(const dense_matrix& a, const dense_matrix& b,
             dense_matrix& out);
-  void transpose(mdarray& out, const mdarray& in);
+  // out = in permuted by axes (empty = reversed, numpy's default);
+  // lowers to an XLA all-to-all over the mesh (containers/mdarray.py)
+  void transpose(mdarray& out, const mdarray& in,
+                 const std::vector<std::size_t>& axes = {});
 
   // stencil: weights.size() must be halo_prev + halo_next + 1
   void stencil_iterate(vector& a, vector& b,
@@ -297,6 +333,7 @@ class session {
   friend class dense_matrix;
   friend class sparse_matrix;
   friend class mdarray;
+  friend class mdspan;
   friend class detail::handle;
   struct impl;
   std::unique_ptr<impl> impl_;

@@ -1,0 +1,65 @@
+// thp::expr DSL serializer — its OWN translation unit, deliberately
+// free of any Python dependency: the native fuzz harness
+// (tests/fuzz_native.cpp) links it stand-alone to property-test the
+// serialized grammar, and `make -C native test` must keep building on
+// a machine with only a C++20 compiler (no python3-config --embed).
+#include "thp_bridge.hpp"
+
+#include <cstdio>
+#include <string>
+
+namespace thp {
+
+namespace {
+std::string num(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof buf, "%.17g", v);
+  return buf;
+}
+expr mk(std::string s) { return expr(expr::raw_t{}, std::move(s)); }
+}  // namespace
+
+expr expr::arg(int i) { return mk("x" + std::to_string(i)); }
+expr expr::lit(double v) { return mk(num(v)); }
+
+expr operator+(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " + " + b.str() + ")");
+}
+expr operator-(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " - " + b.str() + ")");
+}
+expr operator*(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " * " + b.str() + ")");
+}
+expr operator/(const expr& a, const expr& b) {
+  return mk("(" + a.str() + " / " + b.str() + ")");
+}
+expr operator-(const expr& a) { return mk("(0 - " + a.str() + ")"); }
+expr operator+(const expr& a, double b) { return a + expr::lit(b); }
+expr operator+(double a, const expr& b) { return expr::lit(a) + b; }
+expr operator-(const expr& a, double b) { return a - expr::lit(b); }
+expr operator-(double a, const expr& b) { return expr::lit(a) - b; }
+expr operator*(const expr& a, double b) { return a * expr::lit(b); }
+expr operator*(double a, const expr& b) { return expr::lit(a) * b; }
+expr operator/(const expr& a, double b) { return a / expr::lit(b); }
+expr operator/(double a, const expr& b) { return expr::lit(a) / b; }
+expr sqrt(const expr& a) { return mk("sqrt(" + a.str() + ")"); }
+expr exp(const expr& a) { return mk("exp(" + a.str() + ")"); }
+expr log(const expr& a) { return mk("log(" + a.str() + ")"); }
+expr tanh(const expr& a) { return mk("tanh(" + a.str() + ")"); }
+expr abs(const expr& a) { return mk("abs(" + a.str() + ")"); }
+expr min(const expr& a, const expr& b) {
+  return mk("minimum(" + a.str() + ", " + b.str() + ")");
+}
+expr max(const expr& a, const expr& b) {
+  return mk("maximum(" + a.str() + ", " + b.str() + ")");
+}
+expr pow(const expr& a, const expr& b) {
+  return mk("power(" + a.str() + ", " + b.str() + ")");
+}
+
+const expr x0 = expr::arg(0);
+const expr x1 = expr::arg(1);
+const expr x2 = expr::arg(2);
+const expr x3 = expr::arg(3);
+}  // namespace thp

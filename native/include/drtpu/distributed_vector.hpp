@@ -124,6 +124,13 @@ class distributed_vector {
       if (hb.periodic && tail < std::max(hb.prev, hb.next))
         throw std::invalid_argument("periodic halo: tail below radius");
     }
+    // P == 1 periodic self-wrap: the single shard IS the ring tail, so
+    // the same radius rule applies (n < radius would read pad cells —
+    // round-5 native-fuzz finding; the Python container already
+    // rejects this shape, parallel/halo.py generalized min-size checks)
+    if ((hb.prev || hb.next) && hb.periodic && nprocs_ == 1 &&
+        n_ < std::max(hb.prev, hb.next))
+      throw std::invalid_argument("periodic halo: n below radius");
   }
 
   // Explicit distribution: rank r owns sizes[r] contiguous elements.

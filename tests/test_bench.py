@@ -50,5 +50,5 @@ def test_bench_json_contract():
                 "phys_gbps", "target_gbps"):
         assert key in d, f"missing detail.{key}"
     # secondary configs must each report a number or a tagged error
-    for cfg in ("dot", "scan", "heat2d", "spmv"):
+    for cfg in ("dot", "scan", "heat2d", "spmv", "sort"):
         assert any(k.startswith(cfg) for k in d), f"no {cfg} field"

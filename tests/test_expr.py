@@ -86,3 +86,19 @@ def test_op_from_source_drives_algorithms():
     dr_tpu.transform(v, out, op_from_source(src_clip, 1))
     ref = np.clip(np.arange(-16, 16, dtype=np.float32), 0.0, 6.0)
     np.testing.assert_allclose(dr_tpu.to_numpy(out), ref)
+
+
+def test_expr_arity_validated_at_boundary():
+    """Wrong-arity DSL calls fail in the VALIDATOR (ValueError), not as
+    a TypeError when the op first runs inside a jitted algorithm
+    (round-5 review finding)."""
+    import pytest
+
+    from dr_tpu.utils.expr import op_from_expr
+    for bad in ("abs(x0, x1)", "minimum(x0)", "sqrt()", "power(x0)",
+                "maximum(x0, x1, x0)"):
+        with pytest.raises(ValueError):
+            op_from_expr(bad, 2)
+    # the boundary cases still pass
+    assert callable(op_from_expr("minimum(x0, x1)", 2))
+    assert callable(op_from_expr("abs(x0)", 1))

@@ -576,3 +576,82 @@ def test_fuzz_spmm(seed):
         # chained-measurement program agrees with the one-shot product
         got_n = np.asarray(dr_tpu.spmm_n(A, B, int(rng.integers(1, 4))))
         np.testing.assert_allclose(got_n, got, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_expr_grammar(seed):
+    """The expr-DSL validator is a trust boundary (the bridge feeds it
+    serialized strings): VALID generated expressions must compile and
+    match a numpy oracle; random junk must be REJECTED with ValueError
+    — never reach eval with non-DSL content (round 5; native twin:
+    fuzz_native arm_expr_dsl)."""
+    import string
+
+    from dr_tpu.utils import expr as ex
+    rng = np.random.default_rng(500 + seed)
+
+    FN1 = {"abs": np.abs, "sqrt": lambda v: np.sqrt(np.abs(v) + 1.0)}
+    FN2 = {"minimum": np.minimum, "maximum": np.maximum}
+
+    def gen(depth, nargs):
+        r = rng.integers(0, 6 if depth > 0 else 2)
+        if r == 0:
+            i = int(rng.integers(0, nargs))
+            return f"x{i}", lambda vs, i=i: vs[i]
+        if r == 1:
+            c = round(float(rng.uniform(-4, 4)), 3)
+            return repr(c), lambda vs, c=c: np.float32(c)
+        if r in (2, 3):
+            op = rng.choice(["+", "-", "*"])
+            ls, lf = gen(depth - 1, nargs)
+            rs, rf = gen(depth - 1, nargs)
+            f = {"+": np.add, "-": np.subtract,
+                 "*": np.multiply}[str(op)]
+            return (f"({ls} {op} {rs})",
+                    lambda vs, lf=lf, rf=rf, f=f: f(lf(vs), rf(vs)))
+        if r == 4:
+            name = str(rng.choice(list(FN2)))
+            ls, lf = gen(depth - 1, nargs)
+            rs, rf = gen(depth - 1, nargs)
+            return (f"{name}({ls}, {rs})",
+                    lambda vs, lf=lf, rf=rf, f=FN2[name]: f(lf(vs),
+                                                            rf(vs)))
+        name = "abs"  # sqrt of negatives would NaN the oracle: abs only
+        ls, lf = gen(depth - 1, nargs)
+        return (f"{name}({ls})",
+                lambda vs, lf=lf: np.abs(lf(vs)))
+
+    for _ in range(ITERS):
+        nargs = int(rng.integers(1, 4))
+        s, oracle = gen(int(rng.integers(1, 4)), nargs)
+        fn = ex.op_from_expr(s, nargs)
+        vs = [rng.standard_normal(8).astype(np.float32)
+              for _ in range(nargs)]
+        got = np.asarray(fn(*[jnp.asarray(v) for v in vs]))
+        np.testing.assert_allclose(got, oracle(vs).astype(np.float32),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"expr: {s}")
+
+    # junk must be rejected, not evaluated: non-DSL names, stray
+    # punctuation, dunders, out-of-range args
+    alphabet = string.ascii_letters + string.digits + "()+-*/., _'\"[]"
+    for _ in range(ITERS * 3):
+        junk = "".join(rng.choice(list(alphabet))
+                       for _ in range(int(rng.integers(1, 30))))
+        try:
+            ex.op_from_expr(junk, 2)
+        except (ValueError, SyntaxError):
+            continue
+        # anything accepted must genuinely be inside the grammar:
+        # names only x0/x1 + whitelisted functions, DSL chars only
+        import re
+        names = set(re.findall(r"[A-Za-z_][A-Za-z_0-9]*", junk))
+        allowed = {"x0", "x1"} | set(ex.FUNCTIONS)
+        assert all(n in allowed or re.fullmatch(r"[eE]\d*", n)
+                   for n in names), f"accepted junk: {junk!r}"
+        assert "__" not in junk
+    # targeted escapes stay closed
+    for bad in ("__import__('os')", "x0.__class__", "x9", "lambda: 1",
+                "x0 ; x1", "open('/etc/passwd')", "x0\n+x1", "x0,x1"):
+        with pytest.raises((ValueError, SyntaxError)):
+            ex.op_from_expr(bad, 2)

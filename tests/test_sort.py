@@ -768,3 +768,24 @@ def test_sort_by_key_equal_counts_different_devices_native():
     order = np.argsort(k, kind="stable")
     np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
     np.testing.assert_array_equal(dr_tpu.to_numpy(vd), pay[order])
+
+
+def test_sort_n_fused_loop():
+    """sort_n / sort_by_key_n (bench helpers): chained in-program
+    rounds leave the same result as one sort."""
+    from dr_tpu.algorithms.sort import sort_by_key_n, sort_n
+    n = 200
+    src = np.random.default_rng(9).standard_normal(n).astype(np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    sort_n(v, 3)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src))
+    k = np.random.default_rng(10).standard_normal(n).astype(np.float32)
+    kd = dr_tpu.distributed_vector.from_array(k)
+    pd = dr_tpu.distributed_vector(n, np.int32)
+    dr_tpu.iota(pd, 0)
+    sort_by_key_n(kd, pd, 2)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), np.sort(k))
+    # after round 1 keys are sorted, so round 2's stable order is the
+    # identity over round 1's payload — i.e. the single-sort payload
+    np.testing.assert_array_equal(dr_tpu.to_numpy(pd),
+                                  np.argsort(k, kind="stable"))

@@ -95,6 +95,26 @@ if [ "$(date +%s)" -lt "$DEADLINE" ]; then
   log "stencil-default exit=$?"
   commit_logs "Record the DEFAULT-precision stencil sweep" \
     tools/tune_stencil_default.log tools/relay_watch.log
+  sleep 300
+  # claim 3 is long (three sweeps); it may START only with >= 2 h of
+  # slack before the STOP cutoff so even a slow run cannot straddle
+  # the driver's own bench window (the margin math above assumes only
+  # a short bench can ever run near STOP)
+  if [ "$(date +%s)" -lt $(( STOP - 7200 )) ]; then
+    # no timeout wrapper: SIGTERM-killing a TPU client mid-claim is
+    # the one forbidden operation (relay wedge postmortems); the 2 h
+    # slack gate bounds the exposure instead (sweeps historically run
+    # 30-60 min)
+    log "claim 3: halo carry A/B + attn honest re-rank + sort ladder" \
+        "(one process = one claim)"
+    python -u tools/tune_tpu.py halo attn sort \
+      > tools/tune_r5_sweeps.log 2>&1
+    log "halo/attn/sort exit=$?"
+    commit_logs "Record the round-5 halo/attn/sort on-chip sweeps" \
+      tools/tune_r5_sweeps.log tools/relay_watch.log
+  else
+    log "skipping claim 3: < 2 h before the claim cutoff"
+  fi
 else
   log "late recovery: bench only, preserving the driver's claim budget"
 fi

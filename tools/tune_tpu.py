@@ -366,14 +366,59 @@ def tune_container(name):
                       flush=True)
 
 
+def tune_sort():
+    """Size ladder for the sample-sort family (sort_n / sort_by_key_n
+    fused loops): records where the collective phases amortize — the
+    on-chip row for docs/PERF.md (the reference has no sort to compare
+    against; the bar is the repo's own advertised surface)."""
+    import jax
+    import dr_tpu
+    dr_tpu.init()
+    P = dr_tpu.nprocs()
+    from dr_tpu.algorithms.sort import sort_by_key_n, sort_n
+    rng = np.random.default_rng(3)
+    for logn in (18, 20, 22, 24):
+        n = (2 ** logn) // P * P
+        try:
+            v = dr_tpu.distributed_vector(n, np.float32)
+            v.assign_array(rng.standard_normal(n).astype(np.float32))
+
+            def run(r):
+                sort_n(v, r)
+                float(v[0])
+            dt = _marginal(run, 2, 10)
+            print(f"sort n=2^{logn}: {n / dt / 1e6:.1f} Mkeys/s "
+                  f"({n * 4 / dt / 1e9:.2f} GB/s)", flush=True)
+            kd = dr_tpu.distributed_vector(n, np.float32)
+            kd.assign_array(rng.standard_normal(n).astype(np.float32))
+            pd = dr_tpu.distributed_vector(n, np.int32)
+            dr_tpu.iota(pd, 0)
+
+            def run_kv(r):
+                sort_by_key_n(kd, pd, r)
+                float(kd[0])
+            dt = _marginal(run_kv, 2, 10)
+            print(f"sort_by_key n=2^{logn}: {n / dt / 1e6:.1f} Mpairs/s "
+                  f"({2 * n * 4 / dt / 1e9:.2f} GB/s)", flush=True)
+        except Exception as e:
+            print(f"sort n=2^{logn}: FAIL {_errline(e)}", flush=True)
+        finally:
+            v = kd = pd = None
+
+
 if __name__ == "__main__":
-    what = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if what in ("stencil", "all"):
-        tune_stencil()
-    if what in ("physbw", "all"):
-        tune_physbw()
-    if what in ("scan", "all"):
-        tune_scan()
-    for nm in ("dot", "heat", "attn", "halo", "spmv"):
-        if what in (nm, "all"):
-            tune_container(nm)
+    # several modes may share ONE process (= one relay claim):
+    # `tune_tpu.py halo attn sort` runs all three back to back
+    whats = sys.argv[1:] or ["all"]
+    for what in whats:
+        if what in ("stencil", "all"):
+            tune_stencil()
+        if what in ("physbw", "all"):
+            tune_physbw()
+        if what in ("scan", "all"):
+            tune_scan()
+        if what in ("sort", "all"):
+            tune_sort()
+        for nm in ("dot", "heat", "attn", "halo", "spmv"):
+            if what in (nm, "all"):
+                tune_container(nm)
