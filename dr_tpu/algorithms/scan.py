@@ -477,7 +477,7 @@ def _scan(in_r, out, op, init, exclusive):
         scanned = None
     else:
         from ..utils.fallback import warn_fallback
-        warn_fallback("scan", "multi-component input range")
+        warn_fallback("scan", "multi-component or host (non-distributed) input range")
         arr = in_r.to_array() if hasattr(in_r, "to_array") \
             else jnp.asarray(in_r)
         combine = combine_for(kind, op)

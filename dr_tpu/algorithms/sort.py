@@ -54,9 +54,8 @@ from jax.sharding import PartitionSpec as P
 
 from ._common import (owned_window_mask, window_geometry,
                       working_geometry)
-from .elementwise import _out_chain, _prog_cache, _resolve, _write_window
+from .elementwise import _out_chain, _prog_cache, _resolve
 from ..core.pinning import pinned_id
-from ..utils.fallback import warn_fallback
 
 __all__ = ["sort", "sort_by_key", "argsort", "is_sorted"]
 
@@ -150,9 +149,10 @@ def _sort_program(mesh, axis, layout, dtype, descending,
 
     ``aliased`` (round 5): key and payload windows live in ONE
     container — the program takes a single donated row, reads both
-    windows from it, and blends both results into that one row (the
-    caller guarantees the windows are disjoint, so the blends commute
-    and neither overwrites the other)."""
+    windows from it (both slices come from the ORIGINAL row), and
+    blends both results into that one row, payload LAST — so
+    overlapping windows deterministically take the payload value,
+    the same order the old sequential fallback wrote."""
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
            bool(descending), pay_layout,
            str(pay_dtype) if pay_layout else None, window, pay_window,
@@ -365,9 +365,11 @@ def _sort_program(mesh, axis, layout, dtype, descending,
                 jnp.arange(pwidth) - pprev2 - pwoff_c[r], 0, Sp - 1)
             if aliased:
                 # both windows blend into the ONE row: the key blend
-                # already carries untouched originals outside its
-                # window, and the (disjoint) payload mask can never
-                # strike a key-window cell
+                # carries untouched originals outside its window, and
+                # the payload blend composes LAST — on overlapping
+                # windows the payload value deterministically wins,
+                # the order the old sequential fallback wrote (this
+                # blend ORDER is load-bearing, see sort_by_key)
                 return jnp.where(
                     pay_mask_c[r],
                     jnp.take(outs[1].astype(pay_dtype), pcol_idx),
@@ -428,9 +430,10 @@ def sort_by_key(keys, values, *, descending: bool = False):
     or dtypes (f64 included — 64-bit key encoding, round 5); disjoint
     windows of one container run an aliased single-row variant;
     different meshes (mismatched shard counts) reshard the payload
-    onto the key runtime, sort natively there, and reshard back.  Only
-    OVERLAPPING windows of one container keep the argsort-based
-    materialize fallback (the two blends would race)."""
+    onto the key runtime, sort natively there, and reshard back.
+    EVERY shape is native (round 5): overlapping windows of one
+    container compose their blends payload-last, the deterministic
+    order the old sequential fallback used."""
     kc = _out_chain(keys)
     vc = _out_chain(values)
     if kc.n != vc.n:
@@ -455,11 +458,12 @@ def sort_by_key(keys, values, *, descending: bool = False):
         # the keys reorders the payload identically — plain sort
         sort(keys, descending=descending)
         return keys, values
-    aliased = (kcont is vcont
-               # DISJOINT windows of one container blend into a single
-               # donated row (round 5); overlapping windows would make
-               # the two blends race, so they keep the fallback
-               and (kc.off + kc.n <= vc.off or vc.off + vc.n <= kc.off))
+    # ANY two windows of one container blend into a single donated row
+    # (round 5): both window slices are extracted from the ORIGINAL
+    # row before either blend, and the payload blend composes LAST —
+    # exactly the old sequential fallback's write order, so overlap
+    # cells deterministically take the payload value
+    aliased = kcont is vcont
     win_ok = (not full
               and (aliased or (same_mesh and kcont is not vcont)))
     if full or win_ok:
@@ -477,32 +481,21 @@ def sort_by_key(keys, values, *, descending: bool = False):
         else:
             kcont._data, vcont._data = prog(kcont._data, vcont._data)
         return keys, values
-    if not same_mesh:
-        # DIFFERENT MESHES (mismatched shard counts, or equal counts
-        # over different device sets) take the reshard route (round 5
-        # — this used to be the argsort materialize): the payload
-        # reshards onto the key runtime (two
-        # collective copies, the same XLA-resharding class the
-        # elementwise fallback uses), the sample-sort runs NATIVELY
-        # there with the keys never leaving their shards, and the
-        # reordered payload reshards back into its own windows.
-        from ..containers.distributed_vector import distributed_vector
-        from .elementwise import copy as _copy
-        scratch = distributed_vector(vc.n, dtype=vcont.dtype,
-                                     runtime=kcont.runtime)
-        _copy(values, scratch)
-        sort_by_key(keys, scratch, descending=descending)
-        _copy(scratch, values)
-        return keys, values
-    warn_fallback("sort_by_key",
-                  "overlapping key and value windows of one container")
-    karr = kcont.to_array()[kc.off:kc.off + kc.n]
-    varr = vcont.to_array()[vc.off:vc.off + vc.n]
-    order = jnp.argsort(karr, stable=True)
-    if descending:
-        order = order[::-1]
-    _write_window(kc, jnp.take(karr, order))
-    _write_window(vc, jnp.take(varr, order))
+    # DIFFERENT MESHES (mismatched shard counts, or equal counts over
+    # different device sets) take the reshard route (round 5 — this
+    # used to be the argsort materialize): the payload reshards onto
+    # the key runtime (two collective copies, the same XLA-resharding
+    # class the elementwise fallback uses), the sample-sort runs
+    # NATIVELY there with the keys never leaving their shards, and the
+    # reordered payload reshards back into its own windows.  This is
+    # the LAST remaining route — every same-mesh shape is native.
+    from ..containers.distributed_vector import distributed_vector
+    from .elementwise import copy as _copy
+    scratch = distributed_vector(vc.n, dtype=vcont.dtype,
+                                 runtime=kcont.runtime)
+    _copy(values, scratch)
+    sort_by_key(keys, scratch, descending=descending)
+    _copy(scratch, values)
     return keys, values
 
 
@@ -590,8 +583,11 @@ def argsort(r, *, descending: bool = False):
     return idx
 
 
-def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
+def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None,
+                       ops=()):
+    from .elementwise import _op_key
     key = ("is_sorted", pinned, axis, layout, str(dtype), window,
+           tuple(_op_key(f) for f in ops),
            bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -616,6 +612,8 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None):
             idx = jnp.clip(prev + woff_c[r] + jnp.arange(S), 0,
                            width - 1)
             raw = jnp.take(blk[0], idx)
+        for f in ops:  # view-chain op stack, fused (round 5)
+            raw = f(raw)
         k, big = _encode(raw)
         nvalid = jnp.minimum(sizes_c[r],
                              jnp.clip(n - starts_c[r], 0, S))
@@ -651,12 +649,12 @@ def is_sorted(r) -> bool:
     containers AND subrange windows (uniform or uneven
     distributions) run one fused shard_map program (local vector
     compare + one boundary all_gather; windows in window coordinates —
-    round 4; f64 through the exact 64-bit key encoding, round 5);
-    only views fall back to a materialized direct comparison."""
+    round 4; f64 through the exact 64-bit key encoding, and transform-
+    view chains with the op stack fused into the program, round 5)."""
     res = _resolve(r)
     if res is not None and len(res) != 1:
         raise TypeError("is_sorted takes a single-component range")
-    chain = res[0] if res is not None and not res[0].ops else None
+    chain = res[0] if res is not None else None
     if chain is not None:
         cont = chain.cont
         if chain.n == 0:
@@ -665,15 +663,7 @@ def is_sorted(r) -> bool:
         prog = _is_sorted_program(
             cont.runtime.mesh, cont.runtime.axis, cont.layout,
             cont.dtype, pinned_id(cont.runtime.mesh),
-            window=None if full else (chain.off, chain.n))
+            window=None if full else (chain.off, chain.n),
+            ops=chain.ops)
         return int(prog(cont._data)) == 0
-    if res is None:
-        raise TypeError("is_sorted takes a distributed range")
-    arr = r.to_array() if hasattr(r, "to_array") \
-        else jnp.asarray(list(r))
-    if arr.shape[0] < 2:
-        return True
-    a, b = arr[:-1], arr[1:]
-    ok = (a <= b) | jnp.isnan(b) \
-        if jnp.issubdtype(arr.dtype, jnp.floating) else a <= b
-    return bool(jnp.all(ok))
+    raise TypeError("is_sorted takes a distributed range")

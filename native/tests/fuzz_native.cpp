@@ -26,6 +26,7 @@
 
 #include "drtpu/algorithms.hpp"
 #include "drtpu/distributed_vector.hpp"
+#include "drtpu/matrix.hpp"
 #include "drtpu/segment_tools.hpp"
 #include "drtpu/unstructured_halo.hpp"
 #include "drtpu/views.hpp"
@@ -388,6 +389,68 @@ void arm_unstructured_halo(Rng& rng, std::uint64_t seed, int iter) {
   }
 }
 
+void arm_matrix(Rng& rng, std::uint64_t seed, int iter) {
+  // dense tiled matrices with INDEPENDENT random tilings: element
+  // round-trip, gemv, and gemm (the SUMMA traversal explicitly
+  // supports mismatched tilings of A, B, C) vs triple-loop oracles
+  std::size_t m = 1 + rng.pick(24);
+  std::size_t k = 1 + rng.pick(24);
+  std::size_t n = 1 + rng.pick(24);
+  auto tile = [&](std::size_t d) {
+    return drtpu::index2d{1 + rng.pick(d), 1 + rng.pick(d)};
+  };
+  std::size_t p = 1 + rng.pick(8);
+  drtpu::dense_matrix<double> A({m, k}, tile(m), drtpu::block_cyclic(p));
+  drtpu::dense_matrix<double> B({k, n}, tile(k), drtpu::block_cyclic(p));
+  drtpu::dense_matrix<double> C({m, n}, tile(m), drtpu::block_cyclic(p));
+  std::vector<double> oa(m * k), ob(k * n);
+  for (std::size_t i = 0; i < m; ++i)
+    for (std::size_t j = 0; j < k; ++j) {
+      oa[i * k + j] = rng.val();
+      A(i, j) = oa[i * k + j];
+    }
+  for (std::size_t i = 0; i < k; ++i)
+    for (std::size_t j = 0; j < n; ++j) {
+      ob[i * n + j] = rng.val();
+      B(i, j) = ob[i * n + j];
+    }
+  // element round-trip through the tile indexing
+  for (int t = 0; t < 6; ++t) {
+    std::size_t i = rng.pick(m), j = rng.pick(k);
+    if (!close(A(i, j), oa[i * k + j])) {
+      fail_at("matrix", seed, iter, "element round-trip");
+      return;
+    }
+  }
+  // gemv with accumulate semantics (c starts nonzero)
+  std::vector<double> c0(m), bvec(k), want(m);
+  for (auto& x : bvec) x = rng.val();
+  for (auto& x : c0) x = rng.val();
+  std::vector<double> cv = c0;
+  drtpu::gemv(cv, A, bvec);
+  for (std::size_t i = 0; i < m; ++i) {
+    want[i] = c0[i];
+    for (std::size_t j = 0; j < k; ++j)
+      want[i] += oa[i * k + j] * bvec[j];
+    if (!close(cv[i], want[i])) {
+      fail_at("matrix", seed, iter, "dense gemv");
+      return;
+    }
+  }
+  // gemm across the three independent tilings
+  drtpu::gemm(C, A, B);
+  for (std::size_t i = 0; i < m; ++i)
+    for (std::size_t j = 0; j < n; ++j) {
+      double acc = 0.0;
+      for (std::size_t kk = 0; kk < k; ++kk)
+        acc += oa[i * k + kk] * ob[kk * n + j];
+      if (!close(C(i, j), acc)) {
+        fail_at("matrix", seed, iter, "gemm mismatched tilings");
+        return;
+      }
+    }
+}
+
 void arm_expr_dsl(Rng& rng, std::uint64_t seed, int iter) {
   // random expression trees: serializer output must stay inside the
   // validated grammar's alphabet and be deterministic (cache-key
@@ -450,7 +513,7 @@ int main(int argc, char** argv) {
               (unsigned long long)seed);
   Rng rng(seed);
   for (int i = 0; i < iters; ++i) {
-    switch (rng.pick(8)) {
+    switch (rng.pick(9)) {
       case 0: arm_segments_invariant(rng, seed, i); break;
       case 1: arm_fill_iota_reduce(rng, seed, i); break;
       case 2: arm_transform_dot(rng, seed, i); break;
@@ -459,6 +522,7 @@ int main(int argc, char** argv) {
       case 5: arm_span_halo(rng, seed, i); break;
       case 6: arm_unstructured_halo(rng, seed, i); break;
       case 7: arm_expr_dsl(rng, seed, i); break;
+      case 8: arm_matrix(rng, seed, i); break;
     }
     if (failures > 10) break;  // enough signal; keep the log readable
   }

@@ -411,6 +411,26 @@ static int test_distribution(std::size_t P) {
     CHECK(threw);
   }
 
+  // P == 1 periodic self-wrap below the radius must be rejected (the
+  // exchange would read pad cells — round-5 native-fuzz finding; the
+  // Python container rejects the same shape)
+  threw = false;
+  try {
+    distributed_vector<double> bad4(2, 1, drtpu::halo_bounds{3, 0, true});
+    (void)bad4;
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  {
+    // ...and AT the radius it is legal and wraps correctly
+    distributed_vector<double> okp(3, 1, drtpu::halo_bounds{3, 0, true});
+    drtpu::iota(okp, 1.0);
+    okp.halo().exchange();
+    auto row = okp.shard_row(0);
+    CHECK(row[0] == 1.0 && row[1] == 2.0 && row[2] == 3.0);
+  }
+
   // explicitly-even sizes behave as the default layout (uniform fast path)
   std::size_t m = 8 * P;
   std::vector<std::size_t> even(P, 8);

@@ -570,18 +570,34 @@ def test_sort_by_key_same_container_disjoint_windows_native(monkeypatch):
     np.testing.assert_array_equal(dr_tpu.to_numpy(y), ref2)
 
 
-def test_sort_by_key_same_container_overlap_fallback():
-    """OVERLAPPING windows of one container keep the sequential
-    fallback (the two blends would race) and stay correct."""
+def test_sort_by_key_same_container_overlap_native(monkeypatch):
+    """OVERLAPPING windows of one container are native too (round 5):
+    both slices read the original row, blends compose payload-last —
+    byte-for-byte the old sequential fallback's write order."""
     n = 20
     src = np.random.default_rng(4).standard_normal(n).astype(np.float32)
     x = dr_tpu.distributed_vector.from_array(src)
+
+    def boom(self):
+        raise AssertionError("overlapping sort_by_key materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
     dr_tpu.sort_by_key(x[0:8], x[5:13])
+    monkeypatch.undo()
     ref = src.copy()
     order = np.argsort(src[0:8], kind="stable")
     ref[0:8] = src[0:8][order]
     ref[5:13] = src[5:13][order]
     np.testing.assert_array_equal(dr_tpu.to_numpy(x), ref)
+    # value window first, partial overlap the other direction
+    y = dr_tpu.distributed_vector.from_array(src)
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.sort_by_key(y[9:17], y[4:12])
+    monkeypatch.undo()
+    ref2 = src.copy()
+    o2 = np.argsort(src[9:17], kind="stable")
+    ref2[9:17] = src[9:17][o2]
+    ref2[4:12] = src[4:12][o2]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(y), ref2)
 
 
 def test_sort_by_key_keys_are_values():
@@ -789,3 +805,33 @@ def test_sort_n_fused_loop():
     # identity over round 1's payload — i.e. the single-sort payload
     np.testing.assert_array_equal(dr_tpu.to_numpy(pd),
                                   np.argsort(k, kind="stable"))
+
+
+def test_is_sorted_view_chain_native(monkeypatch):
+    """is_sorted over transform-view chains fuses the op stack into
+    the program (round 5 — views used to materialize)."""
+    from dr_tpu.views import views
+    src = np.arange(40, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+
+    def boom(self):
+        raise AssertionError("is_sorted view chain materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    assert dr_tpu.is_sorted(views.transform(v, lambda x: x * 2.0))
+    assert not dr_tpu.is_sorted(views.transform(v, lambda x: -x))
+    # windowed chain: monotone op keeps the window sorted...
+    assert dr_tpu.is_sorted(views.transform(v[5:30], lambda x: x + 3.0))
+    # ...and a violation INSIDE the window that only appears after the
+    # op is applied (negation flips the ascending run) must be seen by
+    # the windowed boundary compare too
+    assert not dr_tpu.is_sorted(views.transform(v[5:30], lambda x: -x))
+    # boundary-only violation: data sorted within every shard, one
+    # inversion exactly at a shard boundary, visible through the chain
+    P = dr_tpu.nprocs()
+    if P >= 2:
+        seg = -(-32 // P)
+        w = np.arange(32, dtype=np.float32)
+        w[seg] = -50.0  # first element of shard 1 undercuts shard 0
+        wv = dr_tpu.distributed_vector.from_array(w)
+        assert not dr_tpu.is_sorted(views.transform(wv, lambda x: x * 2.0))
+    monkeypatch.undo()
