@@ -138,3 +138,23 @@ def window_geometry(layout, off, wn):
     vstarts = np.concatenate(([0], np.cumsum(wsize)[:-1]))
     S = max(int(wsize.max(initial=0)), 1)
     return p, S, cap, prev, nxt, wn, vstarts, wsize, wstart
+
+
+def identityless_fold(op, totals, sizes_c, nshards, first_nz, upto=None):
+    """In-order fold of per-shard totals for IDENTITYLESS ops, skipping
+    empty shards — the machinery the scan and custom-reduce programs
+    share (one home so the subtle seeding/skip rules cannot drift).
+
+    ``totals`` is the all_gather'ed per-shard real totals, ``first_nz``
+    the statically-known first nonempty shard (the fold's seed — no
+    identity element is ever needed).  ``upto=None`` folds EVERY
+    nonempty shard (a global reduce); ``upto=r`` folds only shards
+    before ``r`` (a scan carry)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fold(i, acc):
+        use = sizes_c[i] > 0 if upto is None \
+            else jnp.logical_and(i < upto, sizes_c[i] > 0)
+        return jnp.where(use, op(acc, totals[i]), acc)
+    return lax.fori_loop(first_nz + 1, nshards, fold, totals[first_nz])

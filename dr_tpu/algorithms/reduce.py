@@ -136,6 +136,72 @@ def _zip_reduce_chains(r):
     return chains, r.op
 
 
+def _custom_reduce_program(mesh, axis, layout, op, ops, window):
+    """Fused reduce for UNCLASSIFIED (identityless) ops — round 5; this
+    shape used to materialize silently.  The scan family's identityless
+    machinery, without building the scan array: each shard folds its
+    valid cells with ``lax.associative_scan`` (``std::reduce`` already
+    requires associativity) and reads its REAL total at
+    ``local[valid-1]``; the cross-shard fold walks the gathered totals
+    skipping empty shards, seeded at the statically-known first
+    nonempty shard — no identity element is ever needed.  View-chain
+    ``ops`` fuse like everywhere else; ``window`` runs in window
+    coordinates (the sort family's static geometry)."""
+    from ._common import (identityless_fold, window_geometry,
+                          working_geometry)
+    from ..core.pinning import pinned_id
+    key = ("gredd", pinned_id(mesh), axis, layout, _op_key(op),
+           tuple(_traced_op_key(f) for f in ops), window)
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    if window is None:
+        nshards, S, cap, prev, nxt, n, starts, sizes = \
+            working_geometry(layout)
+        wstart = None
+    else:
+        nshards, S, cap, prev, nxt, n, starts, sizes, wstart = \
+            window_geometry(layout, *window)
+        width = prev + cap + nxt
+        woff_c = jnp.asarray(wstart, jnp.int32)
+    starts_c = jnp.asarray(starts, jnp.int32)
+    sizes_c = jnp.asarray(sizes, jnp.int32)
+    nonempty = [i for i in range(nshards) if sizes[i] > 0]
+    first_nz = nonempty[0] if nonempty else 0
+    # BoundOp chain ops feed their scalars as TRACED trailing operands
+    # (the _fused_reduce_program convention) so a streaming coefficient
+    # reuses ONE compiled program instead of re-jitting per value
+    nsc = sum(len(o.scalars) for o in ops if isinstance(o, _v.BoundOp))
+
+    def body(blk, *scalars):
+        r_ = lax.axis_index(axis)
+        if window is None:
+            x = blk[0, prev:prev + S]
+        else:
+            idx = jnp.clip(prev + woff_c[r_] + jnp.arange(S), 0,
+                           width - 1)
+            x = jnp.take(blk[0], idx)
+        x = _apply_chain_ops(x, ops, iter(scalars))
+        local = lax.associative_scan(op, x)
+        nvalid = jnp.minimum(sizes_c[r_],
+                             jnp.clip(n - starts_c[r_], 0, S))
+        mine = local[jnp.clip(nvalid - 1, 0, S - 1)]
+        totals = lax.all_gather(mine, axis)  # (nshards,)
+        return identityless_fold(op, totals, sizes_c, nshards, first_nz)
+
+    # check_vma=False: every shard folds the same all_gather'ed totals
+    # in the same order, so the P() output IS replicated — the static
+    # checker just cannot see through the fori_loop to prove it
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) + (P(),) * nsc,
+                        out_specs=P(), check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
 def reduce_async(r, op: Callable = None):
     """Like :func:`reduce` but returns the DEVICE scalar without waiting —
     the analog of the reference's oneDPL ``reduce_async`` path
@@ -156,14 +222,28 @@ def reduce_async(r, op: Callable = None):
                 chains, zip_op = zipped
     if chains is not None:
         val = _call_fused_reduce(chains, kind, zip_op)
+        return val
+    if kind is None and op is not None:
+        # UNCLASSIFIED custom op over a single distributed chain:
+        # native identityless program (round 5 — used to materialize
+        # silently).  Zip shapes and host inputs keep the fallback.
+        gchains = _resolve(r) if not isinstance(r, _v.zip_view) else None
+        if gchains is not None and len(gchains) == 1 \
+                and gchains[0].n > 0:
+            c = gchains[0]
+            svals = [jnp.asarray(s) for s in _chain_scalars([c])]
+            return _custom_reduce_program(
+                c.cont.runtime.mesh, c.cont.runtime.axis,
+                c.cont.layout, op, tuple(c.ops),
+                None if (c.off == 0 and c.n == len(c.cont))
+                else (c.off, c.n))(c.cont._data, *svals)
+    arr = r.to_array() if hasattr(r, "to_array") else jnp.asarray(r)
+    assert not isinstance(arr, tuple), \
+        "reduce over a zip needs a transform to combine components"
+    if kind is not None:
+        val = _MONOIDS[kind][0](arr)
     else:
-        arr = r.to_array() if hasattr(r, "to_array") else jnp.asarray(r)
-        assert not isinstance(arr, tuple), \
-            "reduce over a zip needs a transform to combine components"
-        if kind is not None:
-            val = _MONOIDS[kind][0](arr)
-        else:
-            val = _generic_reduce(arr, op)
+        val = _generic_reduce(arr, op)
     return val
 
 

@@ -24,8 +24,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import (combine_for, owned_window_mask, uniform_layout,
-                      window_geometry, working_geometry)
+from ._common import (combine_for, identityless_fold, owned_window_mask,
+                      uniform_layout, window_geometry, working_geometry)
 from .elementwise import (_Chain, _op_key, _out_chain, _prog_cache,
                           _resolve, _write_window)
 from .reduce import _classify_op, _identity_for
@@ -299,13 +299,9 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                     totals = lax.all_gather(mine, axis)
                     nonempty = [i for i in range(nshards) if sizes[i] > 0]
                     first_nz = nonempty[0] if nonempty else 0
-
-                    def fold(i, acc):
-                        use = jnp.logical_and(i < r, sizes_c[i] > 0)
-                        return jnp.where(use, combine(acc, totals[i]),
-                                         acc)
-                    ue_carry = lax.fori_loop(first_nz + 1, nshards, fold,
-                                             totals[first_nz])
+                    ue_carry = identityless_fold(
+                        combine, totals, sizes_c, nshards, first_nz,
+                        upto=r)
                     scanned = jnp.where(r > first_nz,
                                         combine(ue_carry, local), local)
         if exclusive and (use_kernel or kind is None):

@@ -198,5 +198,38 @@ if gq > 1:
     dr_tpu.gemv(c2, sp2, np.ones(2 * nproc, dtype=np.float32))
     np.testing.assert_allclose(dr_tpu.to_numpy(c2), d2.sum(axis=1))
 
+# round-5 surfaces across process boundaries: windowed sort (window-
+# coordinate geometry), a mismatched-window scan (the realign
+# all_to_all), and an overlapping same-container sort_by_key (aliased
+# payload-last blend)
+r5 = np.random.default_rng(50).standard_normal(n).astype(np.float32)
+wv5 = dr_tpu.distributed_vector(n, dtype=np.float32)
+wv5.assign_array(r5)
+wb5, we5 = 1, n - 2
+dr_tpu.sort(wv5[wb5:we5])
+wref5 = r5.copy()
+wref5[wb5:we5] = np.sort(r5[wb5:we5])
+np.testing.assert_allclose(dr_tpu.to_numpy(wv5), wref5, rtol=0, atol=0)
+
+ms5 = dr_tpu.distributed_vector(n, dtype=np.float32)
+dr_tpu.fill(ms5, 0.0)
+dr_tpu.inclusive_scan(wv5[0:n - 3], ms5[3:n])
+msg5 = dr_tpu.to_numpy(ms5)
+np.testing.assert_allclose(msg5[3:n], np.cumsum(wref5[0:n - 3]),
+                           rtol=1e-4, atol=1e-4)
+
+ov5 = dr_tpu.distributed_vector(n, dtype=np.float32)
+ov5.assign_array(r5)
+ka, kb = 0, max(2, n // 2)
+va, vb = max(1, n // 4), max(1, n // 4) + (kb - ka)
+assert vb <= n, "overlap coverage must never silently vanish"
+dr_tpu.sort_by_key(ov5[ka:kb], ov5[va:vb])
+oref5 = r5.copy()
+oo5 = np.argsort(r5[ka:kb], kind="stable")
+oref5[ka:kb] = r5[ka:kb][oo5]
+oref5[va:vb] = r5[va:vb][oo5]
+np.testing.assert_allclose(dr_tpu.to_numpy(ov5), oref5, rtol=0,
+                           atol=0)
+
 print(f"MULTIHOST-OK pid={pid} reduce={total} scan_last={got[-1]}",
       flush=True)
