@@ -140,6 +140,15 @@ def window_geometry(layout, off, wn):
     return p, S, cap, prev, nxt, wn, vstarts, wsize, wstart
 
 
+def first_nonempty(sizes) -> int:
+    """The statically-known first nonempty shard — the identityless
+    fold's seed.  ONE home for the rule (reduce and scan both use it);
+    an all-empty geometry seeds shard 0 (whose total is never read by
+    a caller that checked n > 0)."""
+    nonempty = [i for i in range(len(sizes)) if sizes[i] > 0]
+    return nonempty[0] if nonempty else 0
+
+
 def identityless_fold(op, totals, sizes_c, nshards, first_nz, upto=None):
     """In-order fold of per-shard totals for IDENTITYLESS ops, skipping
     empty shards — the machinery the scan and custom-reduce programs

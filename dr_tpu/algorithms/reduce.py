@@ -147,8 +147,8 @@ def _custom_reduce_program(mesh, axis, layout, op, ops, window):
     nonempty shard — no identity element is ever needed.  View-chain
     ``ops`` fuse like everywhere else; ``window`` runs in window
     coordinates (the sort family's static geometry)."""
-    from ._common import (identityless_fold, window_geometry,
-                          working_geometry)
+    from ._common import (first_nonempty, identityless_fold,
+                          window_geometry, working_geometry)
     from ..core.pinning import pinned_id
     key = ("gredd", pinned_id(mesh), axis, layout, _op_key(op),
            tuple(_traced_op_key(f) for f in ops), window)
@@ -168,8 +168,7 @@ def _custom_reduce_program(mesh, axis, layout, op, ops, window):
         woff_c = jnp.asarray(wstart, jnp.int32)
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
-    nonempty = [i for i in range(nshards) if sizes[i] > 0]
-    first_nz = nonempty[0] if nonempty else 0
+    first_nz = first_nonempty(sizes)
     # BoundOp chain ops feed their scalars as TRACED trailing operands
     # (the _fused_reduce_program convention) so a streaming coefficient
     # reuses ONE compiled program instead of re-jitting per value

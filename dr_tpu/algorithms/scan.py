@@ -24,8 +24,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._common import (combine_for, identityless_fold, owned_window_mask,
-                      uniform_layout, window_geometry, working_geometry)
+from ._common import (combine_for, first_nonempty, identityless_fold,
+                      owned_window_mask, uniform_layout, window_geometry,
+                      working_geometry)
 from .elementwise import (_Chain, _op_key, _out_chain, _prog_cache,
                           _resolve, _write_window)
 from .reduce import _classify_op, _identity_for
@@ -297,8 +298,7 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                                          jnp.clip(n - starts_c[r], 0, S))
                     mine = local[jnp.clip(nvalid - 1, 0, S - 1)]
                     totals = lax.all_gather(mine, axis)
-                    nonempty = [i for i in range(nshards) if sizes[i] > 0]
-                    first_nz = nonempty[0] if nonempty else 0
+                    first_nz = first_nonempty(sizes)
                     ue_carry = identityless_fold(
                         combine, totals, sizes_c, nshards, first_nz,
                         upto=r)

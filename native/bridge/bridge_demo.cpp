@@ -167,6 +167,42 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "FAIL is_sorted: reversed payload read sorted\n");
     return 1;
   }
+  // ---- round 5: subrange-window sort family from C++ ------------------
+  {
+    const std::size_t wn = 64;
+    thp::vector wv = s.make_vector(wn);
+    wv.iota(0.0);
+    s.sort(wv, 5, 40, /*descending=*/true);  // window descending
+    auto host = wv.to_host();
+    std::vector<double> want(wn);
+    for (std::size_t i = 0; i < wn; ++i) want[i] = (double)i;
+    for (std::size_t i = 5; i < 40; ++i) want[i] = (double)(44 - i);
+    check_range("sort window desc", host, want);
+    if (s.is_sorted(wv, 5, 40)) {
+      std::printf("is_sorted window FAIL: descending read sorted\n");
+      ++failures;
+    }
+    if (!s.is_sorted(wv, 40, wn)) {
+      std::printf("is_sorted window FAIL: ascending tail\n");
+      ++failures;
+    }
+    // overlapping key/value windows of ONE vector (payload-last blend)
+    thp::vector ov = s.make_vector(wn);
+    ov.iota(0.0);
+    s.for_each(ov, thp::x0 * -1.0);  // descending data
+    auto before = ov.to_host();
+    s.sort_by_key(ov, 0, 20, ov, 10, 30);
+    auto after = ov.to_host();
+    std::vector<double> wantv = before;
+    // keys [0,20) ascending; ties impossible; payload [10,30) follows
+    std::vector<std::size_t> order(20);
+    for (std::size_t i = 0; i < 20; ++i) order[i] = 19 - i;  // reversed
+    for (std::size_t i = 0; i < 20; ++i)
+      wantv[i] = before[order[i]];
+    for (std::size_t i = 0; i < 20; ++i)
+      wantv[10 + i] = before[10 + order[i]];
+    check_range("sort_by_key overlap windows", after, wantv);
+  }
   {
     // argsort of the (now ascending) keys is the identity permutation
     thp::vector perm = s.argsort(sv);

@@ -534,6 +534,73 @@ void session::sort_by_key(vector& keys, vector& values, bool descending) {
   Py_DECREF(fn);
 }
 
+namespace {
+// v[lo:hi] as a Python subrange view (new reference)
+PyObject* py_window(void* obj, std::size_t lo, std::size_t hi) {
+  PyObject* plo = PyLong_FromSize_t(lo);
+  PyObject* phi = PyLong_FromSize_t(hi);
+  PyObject* sl = must(PySlice_New(plo, phi, nullptr), "slice");
+  Py_DECREF(plo);
+  Py_DECREF(phi);
+  PyObject* w = must(PyObject_GetItem((PyObject*)obj, sl), "v[lo:hi]");
+  Py_DECREF(sl);
+  return w;
+}
+}  // namespace
+
+void session::sort(vector& v, std::size_t lo, std::size_t hi,
+                   bool descending) {
+  if (lo > hi || hi > v.size()) fail("sort: window out of bounds");
+  PyObject* w = py_window(v.obj_, lo, hi);
+  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort"),
+                      "sort lookup");
+  PyObject* args = Py_BuildValue("(O)", w);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
+                                   descending ? Py_True : Py_False);
+  PyObject* r = must(PyObject_Call(fn, args, kwargs), "sort(window)");
+  Py_DECREF(r);
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(fn);
+  Py_DECREF(w);
+}
+
+void session::sort_by_key(vector& keys, std::size_t klo, std::size_t khi,
+                          vector& values, std::size_t vlo,
+                          std::size_t vhi, bool descending) {
+  if (klo > khi || khi > keys.size() || vlo > vhi ||
+      vhi > values.size() || khi - klo != vhi - vlo)
+    fail("sort_by_key: bad windows");
+  PyObject* kw = py_window(keys.obj_, klo, khi);
+  PyObject* vw = py_window(values.obj_, vlo, vhi);
+  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort_by_key"),
+                      "sort_by_key lookup");
+  PyObject* args = Py_BuildValue("(OO)", kw, vw);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
+                                   descending ? Py_True : Py_False);
+  PyObject* r = must(PyObject_Call(fn, args, kwargs),
+                     "sort_by_key(windows)");
+  Py_DECREF(r);
+  Py_DECREF(kwargs);
+  Py_DECREF(args);
+  Py_DECREF(fn);
+  Py_DECREF(vw);
+  Py_DECREF(kw);
+}
+
+bool session::is_sorted(const vector& v, std::size_t lo,
+                        std::size_t hi) {
+  if (lo > hi || hi > v.size()) fail("is_sorted: window out of bounds");
+  PyObject* w = py_window(v.obj_, lo, hi);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "is_sorted", "O", w),
+      "is_sorted(window)");
+  bool ok = PyObject_IsTrue(r) == 1;
+  Py_DECREF(r);
+  Py_DECREF(w);
+  return ok;
+}
+
 vector session::argsort(const vector& v, bool descending) {
   PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "argsort"),
                       "argsort lookup");

@@ -306,6 +306,16 @@ class session {
   void sort_by_key(vector& keys, vector& values, bool descending = false);
   vector argsort(const vector& v, bool descending = false);  // int32 perm
   bool is_sorted(const vector& v);
+  // subrange-window forms (round 5 — the Python windows reached from
+  // C++): half-open [lo, hi); sort_by_key windows may overlap when
+  // keys and values share one vector (payload-last blend order), and
+  // key/value windows must have equal lengths
+  void sort(vector& v, std::size_t lo, std::size_t hi,
+            bool descending = false);
+  void sort_by_key(vector& keys, std::size_t klo, std::size_t khi,
+                   vector& values, std::size_t vlo, std::size_t vhi,
+                   bool descending = false);
+  bool is_sorted(const vector& v, std::size_t lo, std::size_t hi);
 
   // matrix algorithms
   void gemv(vector& c, const sparse_matrix& a, const vector& b);

@@ -8,6 +8,7 @@
 #pragma once
 
 #include <algorithm>
+#include <cmath>
 #include <functional>
 #include <numeric>
 
@@ -100,6 +101,74 @@ T dot(A&& a, B&& b, T init = T{}) {
   auto bi = std::ranges::begin(b);
   for (std::size_t i = 0; i < n; ++i, ++ai, ++bi) acc += (*ai) * (*bi);
   return acc;
+}
+
+// Host-executor sort family (the TPU path's beyond-parity surface,
+// dr_tpu/algorithms/sort.py, mirrored on the host executor so a
+// vocabulary program runs identically on either; the reference ships
+// no sort).  On shared memory the sample-sort's collective phases
+// degenerate to one stable sort over the segment walk.
+// NaN-aware strict weak order matching the TPU path's numpy contract
+// (NaNs rank LAST ascending; plain operator< over NaNs is UB for
+// std::stable_sort — round-5 review finding)
+template <class T>
+inline bool nan_less(const T& a, const T& b) {
+  if constexpr (std::is_floating_point_v<T>) {
+    bool na = std::isnan(a), nb = std::isnan(b);
+    if (na || nb) return !na && nb;  // non-NaN < NaN
+  }
+  return a < b;
+}
+
+template <distributed_range R>
+void sort(R&& r, bool descending = false) {
+  using T = std::ranges::range_value_t<std::remove_cvref_t<R>>;
+  std::vector<T> vals;
+  for (auto&& s : drtpu::segments(r))
+    for (auto& x : drtpu::local(s)) vals.push_back(x);
+  std::stable_sort(vals.begin(), vals.end(), nan_less<T>);
+  if (descending) std::reverse(vals.begin(), vals.end());
+  std::size_t at = 0;
+  for (auto&& s : drtpu::segments(r))
+    for (auto& x : drtpu::local(s)) x = vals[at++];
+}
+
+template <distributed_range K, distributed_range V>
+void sort_by_key(K&& keys, V&& values, bool descending = false) {
+  using T = std::ranges::range_value_t<std::remove_cvref_t<K>>;
+  using U = std::ranges::range_value_t<std::remove_cvref_t<V>>;
+  std::vector<T> ks;
+  std::vector<U> vs;
+  for (auto&& s : drtpu::segments(keys))
+    for (auto& x : drtpu::local(s)) ks.push_back(x);
+  for (auto&& s : drtpu::segments(values))
+    for (auto& x : drtpu::local(s)) vs.push_back(x);
+  std::vector<std::size_t> order(ks.size());
+  for (std::size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](std::size_t a, std::size_t b) {
+                     return nan_less(ks[a], ks[b]);
+                   });
+  if (descending) std::reverse(order.begin(), order.end());
+  std::size_t at = 0;
+  for (auto&& s : drtpu::segments(keys))
+    for (auto& x : drtpu::local(s)) x = ks[order[at++]];
+  at = 0;
+  for (auto&& s : drtpu::segments(values))
+    for (auto& x : drtpu::local(s)) x = vs[order[at++]];
+}
+
+template <distributed_range R>
+bool is_sorted(R&& r) {
+  bool have = false;
+  std::ranges::range_value_t<std::remove_cvref_t<R>> prev{};
+  for (auto&& s : drtpu::segments(r))
+    for (auto& x : drtpu::local(s)) {
+      if (have && nan_less(x, prev)) return false;  // NaNs rank last
+      prev = x;
+      have = true;
+    }
+  return true;
 }
 
 // per-segment scan + carried prefix (the reference's 3-phase scan,

@@ -451,6 +451,68 @@ void arm_matrix(Rng& rng, std::uint64_t seed, int iter) {
     }
 }
 
+void arm_host_sort(Rng& rng, std::uint64_t seed, int iter) {
+  // host-executor sort family vs std oracles (mirrors the TPU-side
+  // beyond-parity surface on the same vocabulary)
+  Geom g = draw_geom(rng);
+  auto dv = make_dv(g);
+  std::vector<double> oracle;
+  seed_random(rng, dv, oracle);
+  // sprinkle NaNs sometimes: the numpy contract (NaNs last) must hold
+  // and the comparator must stay a strict weak order (review finding)
+  if (g.n && rng.pick(3) == 0)
+    for (std::size_t k = 0; k < 1 + rng.pick(3); ++k) {
+      std::size_t i = rng.pick(g.n);
+      oracle[i] = std::nan("");
+      dv[i] = oracle[i];
+    }
+  bool desc = rng.pick(2) == 1;
+  drtpu::sort(dv, desc);
+  std::vector<double> want = oracle;
+  std::stable_sort(want.begin(), want.end(), drtpu::nan_less<double>);
+  if (desc) std::reverse(want.begin(), want.end());
+  auto got = read_all(dv);
+  for (std::size_t i = 0; i < g.n; ++i) {
+    bool both_nan = std::isnan(got[i]) && std::isnan(want[i]);
+    if (!both_nan && !close(got[i], want[i])) {
+      fail_at("host_sort", seed, iter, "sort mismatch");
+      return;
+    }
+  }
+  if (drtpu::is_sorted(dv) != !desc && g.n > 1) {
+    // descending data of >1 distinct values must read unsorted
+    bool distinct = false;
+    for (std::size_t i = 1; i < g.n; ++i)
+      if (got[i] != got[0]) distinct = true;
+    if (distinct) {
+      fail_at("host_sort", seed, iter, "is_sorted disagrees");
+      return;
+    }
+  }
+  // key-value: payload follows the stable key order
+  Geom g2 = draw_geom(rng);
+  auto k = make_dv(g2);
+  auto v = make_dv(g2);
+  std::vector<double> ok2, ov2;
+  seed_random(rng, k, ok2);
+  seed_random(rng, v, ov2);
+  drtpu::sort_by_key(k, v, desc);
+  std::vector<std::size_t> order(g2.n);
+  for (std::size_t i = 0; i < g2.n; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](std::size_t a, std::size_t b) {
+                     return drtpu::nan_less(ok2[a], ok2[b]);
+                   });
+  if (desc) std::reverse(order.begin(), order.end());
+  auto gk = read_all(k);
+  auto gv = read_all(v);
+  for (std::size_t i = 0; i < g2.n; ++i)
+    if (!close(gk[i], ok2[order[i]]) || !close(gv[i], ov2[order[i]])) {
+      fail_at("host_sort", seed, iter, "sort_by_key mismatch");
+      return;
+    }
+}
+
 void arm_expr_dsl(Rng& rng, std::uint64_t seed, int iter) {
   // random expression trees: serializer output must stay inside the
   // validated grammar's alphabet and be deterministic (cache-key
@@ -513,7 +575,7 @@ int main(int argc, char** argv) {
               (unsigned long long)seed);
   Rng rng(seed);
   for (int i = 0; i < iters; ++i) {
-    switch (rng.pick(9)) {
+    switch (rng.pick(10)) {
       case 0: arm_segments_invariant(rng, seed, i); break;
       case 1: arm_fill_iota_reduce(rng, seed, i); break;
       case 2: arm_transform_dot(rng, seed, i); break;
@@ -523,6 +585,7 @@ int main(int argc, char** argv) {
       case 6: arm_unstructured_halo(rng, seed, i); break;
       case 7: arm_expr_dsl(rng, seed, i); break;
       case 8: arm_matrix(rng, seed, i); break;
+      case 9: arm_host_sort(rng, seed, i); break;
     }
     if (failures > 10) break;  // enough signal; keep the log readable
   }

@@ -655,3 +655,76 @@ def test_fuzz_expr_grammar(seed):
                 "x0 ; x1", "open('/etc/passwd')", "x0\n+x1", "x0,x1"):
         with pytest.raises((ValueError, SyntaxError)):
             ex.op_from_expr(bad, 2)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_round5_window_shapes(seed):
+    """Round-5 native shapes under random geometry: window pairs of ONE
+    container for sort_by_key (disjoint, overlapping, nested, equal),
+    mismatched in/out scan windows, and identityless custom reduces —
+    all vs numpy oracles, all with materialize disarmed."""
+    rng = np.random.default_rng(700 + seed)
+    real = dr_tpu.distributed_vector.to_array
+
+    def boom(self):
+        raise AssertionError("round-5 native shape materialized")
+
+    for it in range(ITERS):
+        n = int(rng.integers(4, 160))
+        src = rng.standard_normal(n).astype(np.float32)
+        case = rng.choice(["kv_windows", "scan_mismatch", "reduce"])
+        if case == "kv_windows":
+            wn = int(rng.integers(1, n // 2 + 1))
+            ka = int(rng.integers(0, n - wn + 1))
+            va = int(rng.integers(0, n - wn + 1))
+            x = dr_tpu.distributed_vector.from_array(src)
+            dr_tpu.distributed_vector.to_array = boom
+            try:
+                dr_tpu.sort_by_key(x[ka:ka + wn], x[va:va + wn])
+            finally:
+                dr_tpu.distributed_vector.to_array = real
+            ref = src.copy()
+            order = np.argsort(src[ka:ka + wn], kind="stable")
+            ref[ka:ka + wn] = src[ka:ka + wn][order]
+            ref[va:va + wn] = src[va:va + wn][order]
+            np.testing.assert_array_equal(
+                dr_tpu.to_numpy(x), ref,
+                err_msg=f"kv n={n} ka={ka} va={va} wn={wn}")
+        elif case == "scan_mismatch":
+            wn = int(rng.integers(1, n + 1))
+            ia = int(rng.integers(0, n - wn + 1))
+            oa = int(rng.integers(0, n - wn + 1))
+            a = dr_tpu.distributed_vector.from_array(src)
+            aliased = bool(rng.integers(0, 2))
+            out = a if aliased \
+                else dr_tpu.distributed_vector.from_array(0.0 * src)
+            dr_tpu.distributed_vector.to_array = boom
+            try:
+                dr_tpu.inclusive_scan(a[ia:ia + wn], out[oa:oa + wn])
+            finally:
+                dr_tpu.distributed_vector.to_array = real
+            base = src if aliased else 0.0 * src
+            ref = base.copy()
+            ref[oa:oa + wn] = np.cumsum(src[ia:ia + wn])
+            np.testing.assert_allclose(
+                dr_tpu.to_numpy(out), ref, rtol=1e-4, atol=1e-4,
+                err_msg=f"scan n={n} ia={ia} oa={oa} wn={wn} "
+                        f"aliased={aliased}")
+        else:
+            pos = np.abs(src) * 0.2 + 0.9
+            v = dr_tpu.distributed_vector.from_array(pos)
+            wn = int(rng.integers(1, n + 1))
+            b = int(rng.integers(0, n - wn + 1))
+            dr_tpu.distributed_vector.to_array = boom
+            try:
+                got = dr_tpu.reduce(v[b:b + wn],
+                                    op=_CUSTOM_MUL)
+            finally:
+                dr_tpu.distributed_vector.to_array = real
+            np.testing.assert_allclose(
+                got,
+                float(np.prod(pos[b:b + wn].astype(np.float64))),
+                rtol=1e-3, err_msg=f"reduce n={n} b={b} wn={wn}")
+
+
+_CUSTOM_MUL = lambda a, b: a * b * 1.0  # defined once: program reuse
