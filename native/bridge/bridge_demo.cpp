@@ -121,6 +121,32 @@ int main(int argc, char** argv) {
     }
     check_range("exclusive_scan", host, want);
   }
+  {
+    // round 5: MISMATCHED in/out windows (the Python layer realigns
+    // window-coordinate results with one masked all_to_all)
+    const std::size_t wn = 96;
+    thp::vector wi = s.make_vector(wn);
+    thp::vector wo = s.make_vector(wn);
+    wi.iota(1.0);
+    wo.fill(-1.0);
+    s.inclusive_scan(wi, 0, 50, wo, 7, 57);
+    auto host = wo.to_host();
+    std::vector<double> want(wn, -1.0);
+    double run = 0.0;
+    for (std::size_t i = 0; i < 50; ++i) {
+      run += (double)(i + 1);
+      want[7 + i] = run;
+    }
+    check_range("inclusive_scan windows", host, want);
+    s.exclusive_scan(wi, 10, 40, wo, 0, 30, 5.0);
+    host = wo.to_host();
+    run = 5.0;
+    for (std::size_t i = 0; i < 30; ++i) {
+      want[i] = run;
+      run += (double)(10 + i + 1);
+    }
+    check_range("exclusive_scan windows", host, want);
+  }
 
   // ---- distributed sample sort ----------------------------------------
   thp::vector sv = s.make_vector(n);

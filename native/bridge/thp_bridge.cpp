@@ -490,6 +490,20 @@ double session::transform_reduce(const vector& v, const custom_op& op) {
   return out;
 }
 
+namespace {
+// v[lo:hi] as a Python subrange view (new reference)
+PyObject* py_window(void* obj, std::size_t lo, std::size_t hi) {
+  PyObject* plo = PyLong_FromSize_t(lo);
+  PyObject* phi = PyLong_FromSize_t(hi);
+  PyObject* sl = must(PySlice_New(plo, phi, nullptr), "slice");
+  Py_DECREF(plo);
+  Py_DECREF(phi);
+  PyObject* w = must(PyObject_GetItem((PyObject*)obj, sl), "v[lo:hi]");
+  Py_DECREF(sl);
+  return w;
+}
+}  // namespace
+
 void session::inclusive_scan(const vector& in, vector& out) {
   PyObject* r = must(
       PyObject_CallMethod(impl_->dr, "inclusive_scan", "OO",
@@ -506,62 +520,76 @@ void session::exclusive_scan(const vector& in, vector& out, double init) {
   Py_DECREF(r);
 }
 
-void session::sort(vector& v, bool descending) {
-  // keyword-only descending flag: PyObject_Call with a kwargs dict
-  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort"),
-                      "sort lookup");
-  PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
-  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
-                                   descending ? Py_True : Py_False);
-  PyObject* r = must(PyObject_Call(fn, args, kwargs), "sort");
+void session::inclusive_scan(const vector& in, std::size_t ilo,
+                             std::size_t ihi, vector& out,
+                             std::size_t olo, std::size_t ohi) {
+  if (ilo > ihi || ihi > in.size() || olo > ohi || ohi > out.size() ||
+      ihi - ilo != ohi - olo)
+    fail("inclusive_scan: bad windows");
+  PyObject* iw = py_window(in.obj_, ilo, ihi);
+  PyObject* ow = py_window(out.obj_, olo, ohi);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "inclusive_scan", "OO", iw, ow),
+      "inclusive_scan(windows)");
   Py_DECREF(r);
-  Py_DECREF(kwargs);
-  Py_DECREF(args);
-  Py_DECREF(fn);
+  Py_DECREF(ow);
+  Py_DECREF(iw);
 }
 
-void session::sort_by_key(vector& keys, vector& values, bool descending) {
-  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort_by_key"),
-                      "sort_by_key lookup");
-  PyObject* args = Py_BuildValue("(OO)", (PyObject*)keys.obj_,
-                                 (PyObject*)values.obj_);
-  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
-                                   descending ? Py_True : Py_False);
-  PyObject* r = must(PyObject_Call(fn, args, kwargs), "sort_by_key");
+void session::exclusive_scan(const vector& in, std::size_t ilo,
+                             std::size_t ihi, vector& out,
+                             std::size_t olo, std::size_t ohi,
+                             double init) {
+  if (ilo > ihi || ihi > in.size() || olo > ohi || ohi > out.size() ||
+      ihi - ilo != ohi - olo)
+    fail("exclusive_scan: bad windows");
+  PyObject* iw = py_window(in.obj_, ilo, ihi);
+  PyObject* ow = py_window(out.obj_, olo, ohi);
+  PyObject* r = must(
+      PyObject_CallMethod(impl_->dr, "exclusive_scan", "OOd", iw, ow,
+                          init),
+      "exclusive_scan(windows)");
   Py_DECREF(r);
-  Py_DECREF(kwargs);
-  Py_DECREF(args);
-  Py_DECREF(fn);
+  Py_DECREF(ow);
+  Py_DECREF(iw);
 }
 
 namespace {
-// v[lo:hi] as a Python subrange view (new reference)
-PyObject* py_window(void* obj, std::size_t lo, std::size_t hi) {
-  PyObject* plo = PyLong_FromSize_t(lo);
-  PyObject* phi = PyLong_FromSize_t(hi);
-  PyObject* sl = must(PySlice_New(plo, phi, nullptr), "slice");
-  Py_DECREF(plo);
-  Py_DECREF(phi);
-  PyObject* w = must(PyObject_GetItem((PyObject*)obj, sl), "v[lo:hi]");
-  Py_DECREF(sl);
-  return w;
+// dr.<name>(*args, descending=...) — the sort family's shared call
+// shape (five call sites); returns the result as a NEW reference and
+// consumes nothing (caller still owns args)
+PyObject* call_descending(PyObject* dr, const char* name, PyObject* args,
+                          bool descending) {
+  PyObject* fn = must(PyObject_GetAttrString(dr, name), name);
+  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
+                                   descending ? Py_True : Py_False);
+  PyObject* r = must(PyObject_Call(fn, args, kwargs), name);
+  Py_DECREF(kwargs);
+  Py_DECREF(fn);
+  return r;
 }
 }  // namespace
+
+void session::sort(vector& v, bool descending) {
+  PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
+  Py_DECREF(call_descending(impl_->dr, "sort", args, descending));
+  Py_DECREF(args);
+}
+
+void session::sort_by_key(vector& keys, vector& values, bool descending) {
+  PyObject* args = Py_BuildValue("(OO)", (PyObject*)keys.obj_,
+                                 (PyObject*)values.obj_);
+  Py_DECREF(call_descending(impl_->dr, "sort_by_key", args, descending));
+  Py_DECREF(args);
+}
 
 void session::sort(vector& v, std::size_t lo, std::size_t hi,
                    bool descending) {
   if (lo > hi || hi > v.size()) fail("sort: window out of bounds");
   PyObject* w = py_window(v.obj_, lo, hi);
-  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort"),
-                      "sort lookup");
   PyObject* args = Py_BuildValue("(O)", w);
-  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
-                                   descending ? Py_True : Py_False);
-  PyObject* r = must(PyObject_Call(fn, args, kwargs), "sort(window)");
-  Py_DECREF(r);
-  Py_DECREF(kwargs);
+  Py_DECREF(call_descending(impl_->dr, "sort", args, descending));
   Py_DECREF(args);
-  Py_DECREF(fn);
   Py_DECREF(w);
 }
 
@@ -573,17 +601,10 @@ void session::sort_by_key(vector& keys, std::size_t klo, std::size_t khi,
     fail("sort_by_key: bad windows");
   PyObject* kw = py_window(keys.obj_, klo, khi);
   PyObject* vw = py_window(values.obj_, vlo, vhi);
-  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "sort_by_key"),
-                      "sort_by_key lookup");
   PyObject* args = Py_BuildValue("(OO)", kw, vw);
-  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
-                                   descending ? Py_True : Py_False);
-  PyObject* r = must(PyObject_Call(fn, args, kwargs),
-                     "sort_by_key(windows)");
-  Py_DECREF(r);
-  Py_DECREF(kwargs);
+  Py_DECREF(call_descending(impl_->dr, "sort_by_key", args,
+                            descending));
   Py_DECREF(args);
-  Py_DECREF(fn);
   Py_DECREF(vw);
   Py_DECREF(kw);
 }
@@ -602,15 +623,10 @@ bool session::is_sorted(const vector& v, std::size_t lo,
 }
 
 vector session::argsort(const vector& v, bool descending) {
-  PyObject* fn = must(PyObject_GetAttrString(impl_->dr, "argsort"),
-                      "argsort lookup");
   PyObject* args = Py_BuildValue("(O)", (PyObject*)v.obj_);
-  PyObject* kwargs = Py_BuildValue("{s:O}", "descending",
-                                   descending ? Py_True : Py_False);
-  PyObject* obj = must(PyObject_Call(fn, args, kwargs), "argsort");
-  Py_DECREF(kwargs);
+  PyObject* obj = call_descending(impl_->dr, "argsort", args,
+                                  descending);
   Py_DECREF(args);
-  Py_DECREF(fn);
   return vector(this, obj, v.size());
 }
 

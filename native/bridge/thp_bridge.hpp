@@ -296,6 +296,14 @@ class session {
   // prefix scans (add monoid — the reference's inclusive_scan surface)
   void inclusive_scan(const vector& in, vector& out);
   void exclusive_scan(const vector& in, vector& out, double init = 0.0);
+  // windowed forms (round 5): scan in[ilo, ihi) into out[olo, ohi) —
+  // equal lengths; offsets/distributions may differ (the Python layer
+  // realigns window-coordinate results with one masked all_to_all)
+  void inclusive_scan(const vector& in, std::size_t ilo, std::size_t ihi,
+                      vector& out, std::size_t olo, std::size_t ohi);
+  void exclusive_scan(const vector& in, std::size_t ilo, std::size_t ihi,
+                      vector& out, std::size_t olo, std::size_t ohi,
+                      double init = 0.0);
 
   // distributed sample sort, in place (beyond-parity surface; one
   // shard_map program: local sort + splitter all_gather + all_to_all

@@ -143,6 +143,9 @@ void sort_by_key(K&& keys, V&& values, bool descending = false) {
     for (auto& x : drtpu::local(s)) ks.push_back(x);
   for (auto&& s : drtpu::segments(values))
     for (auto& x : drtpu::local(s)) vs.push_back(x);
+  if (ks.size() != vs.size())
+    throw std::invalid_argument(
+        "sort_by_key: keys and values lengths differ");
   std::vector<std::size_t> order(ks.size());
   for (std::size_t i = 0; i < order.size(); ++i) order[i] = i;
   std::stable_sort(order.begin(), order.end(),

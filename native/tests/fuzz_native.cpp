@@ -483,7 +483,11 @@ void arm_host_sort(Rng& rng, std::uint64_t seed, int iter) {
     // descending data of >1 distinct values must read unsorted
     bool distinct = false;
     for (std::size_t i = 1; i < g.n; ++i)
-      if (got[i] != got[0]) distinct = true;
+      // NaN-aware inequality: NaN != NaN is true but all-NaN data is
+      // NOT distinct under the sort order (review finding)
+      if (drtpu::nan_less(got[i], got[0]) ||
+          drtpu::nan_less(got[0], got[i]))
+        distinct = true;
     if (distinct) {
       fail_at("host_sort", seed, iter, "is_sorted disagrees");
       return;
