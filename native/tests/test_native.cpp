@@ -651,6 +651,50 @@ int test_segment_range() {
   return 0;
 }
 
+static int test_host_sort(std::size_t P) {
+  using drtpu::distributed_vector;
+  // NaN contract: NaNs rank LAST ascending (the TPU path's numpy
+  // order), sort is stable, and sort_by_key validates lengths
+  distributed_vector<double> v(7, P);
+  double vals[] = {3.0, std::nan(""), 1.0, 2.0, std::nan(""), 0.5, 4.0};
+  for (std::size_t i = 0; i < 7; ++i) v[i] = vals[i];
+  CHECK(!drtpu::is_sorted(v));
+  drtpu::sort(v);
+  CHECK(v[0] == 0.5 && v[1] == 1.0 && v[2] == 2.0 && v[3] == 3.0 &&
+        v[4] == 4.0 && std::isnan(v[5]) && std::isnan(v[6]));
+  CHECK(drtpu::is_sorted(v));
+  // [1.0, nan] is sorted; [nan, 1.0] is not
+  distributed_vector<double> w(2, P);
+  w[0] = 1.0;
+  w[1] = std::nan("");
+  CHECK(drtpu::is_sorted(w));
+  w[0] = std::nan("");
+  w[1] = 1.0;
+  CHECK(!drtpu::is_sorted(w));
+  // STABILITY: duplicate keys keep their payloads in original order
+  distributed_vector<double> dk(6, P), dp(6, P);
+  double kv[] = {2.0, 1.0, 2.0, 1.0, 2.0, 1.0};
+  for (std::size_t i = 0; i < 6; ++i) {
+    dk[i] = kv[i];
+    dp[i] = (double)i;
+  }
+  drtpu::sort_by_key(dk, dp);
+  // ascending stable: 1-keys' payloads 1,3,5 then 2-keys' 0,2,4
+  CHECK(dp[0] == 1.0 && dp[1] == 3.0 && dp[2] == 5.0 &&
+        dp[3] == 0.0 && dp[4] == 2.0 && dp[5] == 4.0);
+
+  // mismatched key/value lengths fail cleanly, never read OOB
+  distributed_vector<double> k(4, P), p2(6, P);
+  bool threw = false;
+  try {
+    drtpu::sort_by_key(k, p2);
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+  return 0;
+}
+
 int main() {
   if (test_concepts()) return 1;
   if (test_segment_range()) return 1;
@@ -667,6 +711,7 @@ int main() {
     if (test_unstructured_halo(P)) return 1;
     if (test_rma_window(P)) return 1;
     if (test_exclusive_scan(P)) return 1;
+    if (test_host_sort(P)) return 1;
   }
   {
     // logger: no-op until a sink is set; writes call-site-prefixed lines
