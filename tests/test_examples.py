@@ -101,6 +101,7 @@ def test_marginal_fast_path_no_widening(monkeypatch):
     ("spmm_example", ["-m", "512", "-k", "4", "--nv", "3"]),
     ("sort_example", ["-n", "4096"]),
     ("sort_example", ["-n", "4097", "--descending"]),
+    ("windows_example", ["-n", "4096"]),
     ("top_k", ["-n", "4099", "-k", "5"]),
     ("views_example", []),
 ])
