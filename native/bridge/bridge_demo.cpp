@@ -147,6 +147,39 @@ int main(int argc, char** argv) {
     }
     check_range("exclusive_scan windows", host, want);
   }
+  {
+    // round 5: uneven block distribution (teams) from C++ — shard 0
+    // owns 10, shard 1 owns 0 (empty team), the rest splits the tail
+    std::size_t P = s.nprocs();
+    std::vector<std::size_t> sizes(P, 0);
+    const std::size_t un = 57;
+    sizes[0] = 10;
+    if (P > 2) {
+      std::size_t rest = un - 10, each = rest / (P - 2);
+      for (std::size_t r = 2; r < P; ++r) sizes[r] = each;
+      sizes[P - 1] += rest - each * (P - 2);
+    } else {
+      sizes[P - 1] += un - 10;
+    }
+    thp::vector uv = s.make_vector_blocks(sizes);
+    uv.iota(1.0);
+    check_close("uneven reduce", uv.reduce(),
+                0.5 * (double)un * (double)(un + 1));
+    s.sort(uv, /*descending=*/true);
+    auto host = uv.to_host();
+    std::vector<double> want(un);
+    for (std::size_t i = 0; i < un; ++i) want[i] = (double)(un - i);
+    check_range("uneven sort desc", host, want);
+    thp::vector us = s.make_vector_blocks(sizes);
+    s.inclusive_scan(uv, us);  // scan of un..1
+    host = us.to_host();
+    double run = 0.0;
+    for (std::size_t i = 0; i < un; ++i) {
+      run += (double)(un - i);
+      want[i] = run;
+    }
+    check_range("uneven scan", host, want);
+  }
 
   // ---- distributed sample sort ----------------------------------------
   thp::vector sv = s.make_vector(n);

@@ -256,6 +256,34 @@ vector session::make_vector(std::size_t n, std::size_t prev,
   return vector(this, obj, n, dt);
 }
 
+vector session::make_vector_blocks(
+    const std::vector<std::size_t>& sizes, dtype dt) {
+  if (dt == dtype::f64 && !impl_->x64_enabled())
+    fail("make_vector: dtype::f64 requested but JAX x64 is disabled");
+  std::size_t n = 0;
+  for (std::size_t s : sizes) n += s;
+  PyObject* szl = must(PyList_New((Py_ssize_t)sizes.size()),
+                       "sizes list");
+  for (std::size_t i = 0; i < sizes.size(); ++i)
+    PyList_SET_ITEM(szl, (Py_ssize_t)i, PyLong_FromSize_t(sizes[i]));
+  PyObject* cls = must(
+      PyObject_GetAttrString(impl_->dr, "distributed_vector"),
+      "distributed_vector");
+  PyObject* np_dt = must(
+      PyObject_GetAttrString(impl_->np, np_name(dt)), "numpy dtype");
+  PyObject* args = Py_BuildValue("(n)", (Py_ssize_t)n);
+  PyObject* kwargs = Py_BuildValue("{s:O,s:O}", "dtype", np_dt,
+                                   "distribution", szl);
+  PyObject* obj = must(PyObject_Call(cls, args, kwargs),
+                       "distributed_vector(distribution=...)");
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(np_dt);
+  Py_DECREF(cls);
+  Py_DECREF(szl);
+  return vector(this, obj, n, dt);
+}
+
 dense_matrix session::make_dense(std::size_t m, std::size_t n,
                                  const std::vector<double>& row_major) {
   PyObject* cls = must(PyObject_GetAttrString(impl_->dr, "dense_matrix"),

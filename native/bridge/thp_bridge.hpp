@@ -21,6 +21,7 @@
 // include/dr/shp/shp.hpp:8-26, include/dr/mhp.hpp:41-59):
 //   thp::session s(ncpu_devices /*0 = real TPU*/);
 //   thp::vector v = s.make_vector(n, halo_prev, halo_next, periodic);
+//   thp::vector u = s.make_vector_blocks({10, 0, 24, 23});  // teams
 //   v.iota(0); v.fill(1.0);
 //   double r = v.reduce();  double d = s.dot(a, b);
 //   s.transform(a, out, thp::x0 * 2.0 + 1.0);          // lazy op DSL
@@ -261,6 +262,14 @@ class session {
   vector make_vector(std::size_t n, std::size_t halo_prev = 0,
                      std::size_t halo_next = 0, bool periodic = false,
                      dtype dt = dtype::f32);
+  // uneven block distribution (round 5): shard r owns sizes[r]
+  // contiguous elements; zero sizes express "teams" (the Python
+  // container's block_distribution surface reached from C++; halo
+  // requires the uniform layout, so these take none).  A distinct
+  // NAME, not an overload: make_vector({64}) would silently prefer
+  // the scalar size_t conversion and drop the distribution intent
+  vector make_vector_blocks(const std::vector<std::size_t>& sizes,
+                            dtype dt = dtype::f32);
   dense_matrix make_dense(std::size_t m, std::size_t n,
                           const std::vector<double>& row_major = {});
   sparse_matrix make_sparse_coo(std::size_t m, std::size_t n,
