@@ -79,6 +79,22 @@ int main(int argc, char** argv) {
   CHECK(iv.element_dtype() == thp::dtype::i32);
   CHECK(iv.reduce() == (double)(n * (n - 1) / 2));
 
+  // round 5 across REAL process boundaries: a windowed sort (the
+  // window-coordinate program) and an uneven-teams container
+  thp::vector wv = s.make_vector(n);
+  s.transform(v, wv, 0.0 - thp::x0);  // descending again
+  s.sort(wv, 1, n - 1);               // window leaves the ends alone
+  std::vector<double> wh = wv.to_host();
+  CHECK(wh[0] == -1.0 && wh[n - 1] == -(double)n);
+  for (std::size_t i = 2; i + 1 < n; ++i) CHECK(wh[i - 1] <= wh[i]);
+  CHECK(s.is_sorted(wv, 1, n - 1));
+  std::vector<std::size_t> sizes((std::size_t)nproc, 0);
+  sizes[0] = n - 1;
+  sizes[(std::size_t)nproc - 1] += 1;
+  thp::vector uv = s.make_vector_blocks(sizes);
+  uv.iota(1.0);
+  CHECK(uv.reduce() == (double)n * (n + 1) / 2.0);
+
   if (failures) {
     std::fprintf(stderr, "bridge_mp_demo pid=%d/%d: %d FAILURES\n", pid,
                  nproc, failures);

@@ -259,7 +259,10 @@ vector session::make_vector(std::size_t n, std::size_t prev,
 vector session::make_vector_blocks(
     const std::vector<std::size_t>& sizes, dtype dt) {
   if (dt == dtype::f64 && !impl_->x64_enabled())
-    fail("make_vector: dtype::f64 requested but JAX x64 is disabled");
+    fail("make_vector_blocks: dtype::f64 requested but JAX x64 is "
+         "disabled — the device buffer would silently be f32; enable "
+         "x64 (JAX_ENABLE_X64=1 before session construction) or use "
+         "dtype::f32");
   std::size_t n = 0;
   for (std::size_t s : sizes) n += s;
   PyObject* szl = must(PyList_New((Py_ssize_t)sizes.size()),

@@ -47,3 +47,57 @@ def test_int32_stencil_callable_op():
     out = dr_tpu.stencil_iterate(v, w, lambda l, c, r: l + c + r, steps=1)
     ref = np.roll(src, 1) + src + np.roll(src, -1)
     np.testing.assert_array_equal(dr_tpu.to_numpy(out), ref)
+
+
+def test_round5_window_shapes_across_dtypes(monkeypatch):
+    """The round-5 native shapes (windowed sort, mismatched-window
+    scan, overlapping same-container sort_by_key) across i32 and
+    bfloat16 — the key-encode and realign paths differ per dtype, and
+    none may materialize."""
+    def boom(self):
+        raise AssertionError("dtype window shape materialized")
+
+    n = 96
+    # i32: integers are their own sort keys (pad sentinel = dtype max)
+    isrc = np.random.default_rng(21).integers(-1000, 1000, n) \
+        .astype(np.int32)
+    iv = dr_tpu.distributed_vector(n, np.int32)
+    iv.assign_array(isrc)
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.sort(iv[7:80])
+    monkeypatch.undo()
+    iref = isrc.copy()
+    iref[7:80] = np.sort(isrc[7:80])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(iv), iref)
+    # i32 mismatched-window scan stays exact
+    iout = dr_tpu.distributed_vector(n, np.int32)
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.inclusive_scan(iv[0:40], iout[5:45])
+    monkeypatch.undo()
+    np.testing.assert_array_equal(dr_tpu.to_numpy(iout)[5:45],
+                                  np.cumsum(iref[0:40]))
+    # i32 overlapping same-container kv windows
+    iw = dr_tpu.distributed_vector(n, np.int32)
+    iw.assign_array(isrc)
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.sort_by_key(iw[0:30], iw[15:45])
+    monkeypatch.undo()
+    iwref = isrc.copy()
+    order = np.argsort(isrc[0:30], kind="stable")
+    iwref[0:30] = isrc[0:30][order]
+    iwref[15:45] = isrc[15:45][order]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(iw), iwref)
+
+    # bfloat16: keys upcast exactly through f32 before the sign-flip
+    bsrc = np.random.default_rng(22).standard_normal(n).astype(
+        jnp.bfloat16)
+    bv = dr_tpu.distributed_vector(n, jnp.bfloat16)
+    bv.assign_array(bsrc)
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    dr_tpu.sort(bv[3:90])
+    monkeypatch.undo()
+    bref = np.asarray(bsrc, dtype=np.float32).copy()
+    bref[3:90] = np.sort(bref[3:90])
+    np.testing.assert_array_equal(
+        np.asarray(dr_tpu.to_numpy(bv), dtype=np.float32), bref)
+    assert dr_tpu.is_sorted(bv[3:90])
