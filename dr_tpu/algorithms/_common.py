@@ -140,6 +140,20 @@ def window_geometry(layout, off, wn):
     return p, S, cap, prev, nxt, wn, vstarts, wsize, wstart
 
 
+def effective_sizes(starts, sizes, n):
+    """TRUE per-shard valid counts for geometries whose reported sizes
+    are NOMINAL (working_geometry's uniform ceil layouts): a shard
+    whose window lies at or beyond ``n`` owns zero cells, whatever its
+    nominal width says.  Window geometries are already clipped — do
+    not re-clip them.  ONE home for the rule, next to
+    :func:`first_nonempty` / :func:`identityless_fold` (round-5 fuzz
+    finding: folding a nominal-but-empty shard's pad "total" poisoned
+    a product to 0.0)."""
+    import numpy as np
+    return np.minimum(np.asarray(sizes),
+                      np.clip(n - np.asarray(starts), 0, None))
+
+
 def first_nonempty(sizes) -> int:
     """The statically-known first nonempty shard — the identityless
     fold's seed.  ONE home for the rule (reduce and scan both use it);

@@ -147,8 +147,9 @@ def _custom_reduce_program(mesh, axis, layout, op, ops, window):
     nonempty shard — no identity element is ever needed.  View-chain
     ``ops`` fuse like everywhere else; ``window`` runs in window
     coordinates (the sort family's static geometry)."""
-    from ._common import (first_nonempty, identityless_fold,
-                          window_geometry, working_geometry)
+    from ._common import (effective_sizes, first_nonempty,
+                          identityless_fold, window_geometry,
+                          working_geometry)
     from ..core.pinning import pinned_id
     key = ("gredd", pinned_id(mesh), axis, layout, _op_key(op),
            tuple(_traced_op_key(f) for f in ops), window)
@@ -161,7 +162,12 @@ def _custom_reduce_program(mesh, axis, layout, op, ops, window):
         nshards, S, cap, prev, nxt, n, starts, sizes = \
             working_geometry(layout)
         wstart = None
+        # working_geometry reports NOMINAL widths for uniform ceil
+        # layouts; the fold's skip predicate needs TRUE emptiness
+        # (_common.effective_sizes docstring has the fuzz story)
+        sizes = effective_sizes(starts, sizes, n)
     else:
+        # window geometries are already clipped exactly
         nshards, S, cap, prev, nxt, n, starts, sizes, wstart = \
             window_geometry(layout, *window)
         width = prev + cap + nxt

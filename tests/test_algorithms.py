@@ -424,3 +424,29 @@ def test_reduce_custom_op_streaming_scalar_reuses_program():
         got1, float(np.prod((src + 0.01).astype(np.float64))), rtol=1e-4)
     np.testing.assert_allclose(
         got2, float(np.prod((src + 0.02).astype(np.float64))), rtol=1e-4)
+
+
+def test_reduce_custom_op_trailing_empty_nominal_shard(monkeypatch):
+    """n=33 on 8 shards: the uniform ceil layout leaves shard 7's
+    nominal window entirely beyond n.  Its pad cells must never enter
+    the identityless fold (round-5 fuzz finding: the product came
+    back 0.0)."""
+    P = dr_tpu.nprocs()
+    n = 4 * P + 1  # forces a trailing all-beyond-n nominal shard
+    pos = (np.abs(np.random.default_rng(3).standard_normal(n)) * 0.2
+           + 0.9).astype(np.float32)
+    v = dr_tpu.distributed_vector.from_array(pos)
+
+    def boom(self):
+        raise AssertionError("materialized")
+    monkeypatch.setattr(dr_tpu.distributed_vector, "to_array", boom)
+    got = dr_tpu.reduce(v, op=lambda a, b: a * b * 1.0)
+    # a PROPER sub-window so the window_geometry branch actually runs
+    # against the trailing-empty geometry (v[0:n] normalizes to the
+    # non-window program)
+    got_w = dr_tpu.reduce(v[1:n], op=lambda a, b: a * b * 1.0)
+    monkeypatch.undo()
+    want = float(np.prod(pos.astype(np.float64)))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(
+        got_w, float(np.prod(pos[1:].astype(np.float64))), rtol=1e-4)
