@@ -1,0 +1,36 @@
+#!/bin/bash
+# Long fuzz cranks, one pytest PROCESS per fuzz-test function.
+#
+# Why not one big `DR_TPU_FUZZ_ITERS=N pytest tests/test_fuzz.py`?
+# Each random geometry compiles a fresh XLA CPU executable; a
+# 300-iteration all-arms crank accumulates tens of thousands of
+# compiled programs in one process, and the XLA CPU compiler was
+# observed to SEGFAULT under that load (round 5: crash inside
+# backend_compile_and_load after ~30 min; the same arm at 400
+# iterations in its own process passes).  Per-function processes
+# bound the compile count and make a crash attributable to ONE arm.
+#
+# Usage: tools/fuzz_crank.sh [iters]    (default 300)
+set -u
+cd "$(dirname "$0")/.."
+ITERS=${1:-300}
+nodes=$(python -m pytest tests/test_fuzz.py --collect-only -q 2>/dev/null \
+        | grep "::" | cut -d"[" -f1 | sort -u)
+if [ -z "$nodes" ]; then
+  # a broken collection (import/syntax error) must NOT read as a clean
+  # crank that ran zero arms
+  echo "FAILED: test collection produced no fuzz arms" >&2
+  python -m pytest tests/test_fuzz.py --collect-only -q >&2 | tail -5
+  exit 2
+fi
+rc=0
+for nd in $nodes; do
+  echo "=== $nd (DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_FUZZ_ITERS=$ITERS python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd"
+    rc=1
+  fi
+done
+exit $rc
