@@ -26,6 +26,11 @@ blocking.  ``detail.phys_gbps`` estimates the physical traffic rate.
 vs_baseline: achieved effective GB/s divided by the north-star target
 (0.7 x the chip's peak HBM bandwidth).  The reference publishes no
 numbers (BASELINE.md), so the target is the hardware-derived bar.
+
+``--phases`` (or DR_TPU_BENCH_PHASES=1) additionally emits the
+key-value sort phase ladder into detail; the keys-only sort phase
+breakdown (``detail.sort_phases_gbps``) is always on (round 6 —
+utils/profiling.profile_phases over the sample-sort truncations).
 """
 
 import json
@@ -192,68 +197,16 @@ def _time_best(fn, iters=3):
     return best
 
 
-class _JitterError(RuntimeError):
-    """Measurement (not kernel) failure from :func:`_marginal`."""
-
-
-def _marginal(run_sync, r1=4, r2=36, samples=5, min_spread=0.3, rmax=4096):
-    """Device-side per-op seconds by the MARGINAL method: time a fused
-    loop of r1 ops and one of r2 ops (each dispatched once and synced
-    once), interleaved, and divide the median difference by r2 - r1.
-    The tunneled per-dispatch constant — large and drifting (tens of
-    ms) — cancels in the difference; fused loops come from the *_n
-    program family (dot_n, inclusive_scan_n, ring_attention_n,
-    exchange_n).
-
-    ADAPTIVE: the difference only means anything once it dominates the
-    dispatch jitter.  After a pilot estimate, if (r2-r1) * dt falls
-    under ``min_spread`` seconds the loop count is widened (one extra
-    compile — fori_loop compile time is iteration-count independent)
-    until the measured delta is jitter-proof.  Fast ops (e.g. the BCSR
-    SpMV at ~100 us) previously measured as noise, occasionally even
-    negative."""
-    def once(ra, rb):
-        t1s, t2s = [], []
-        for _ in range(samples):
-            t0 = time.perf_counter()
-            run_sync(ra)
-            t1s.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            run_sync(rb)
-            t2s.append(time.perf_counter() - t0)
-        return (float(np.median(t2s)) - float(np.median(t1s))) / (rb - ra)
-
-    run_sync(r1)  # compile + warm
-    run_sync(r2)
-    dt = once(r1, r2)
-    if (r2 - r1) * dt < min_spread:
-        # pilot was noise-level (possibly <= 0): widen so the true delta
-        # would exceed min_spread even if the op is ~10x faster than the
-        # noisy pilot suggests.  t_warm/r2 overestimates per-op time (it
-        # still contains the dispatch constant), so the ~3 s budget cap
-        # it implies is conservative.
-        t0 = time.perf_counter()
-        run_sync(r2)
-        t_warm = time.perf_counter() - t0
-        per = max(dt, min_spread / 10.0 / rmax)
-        cap = max(r2, int(3.0 * r2 / max(t_warm, 1e-3)))
-        r2w = min(rmax, cap, r1 + max(2 * (r2 - r1),
-                                      int(np.ceil(min_spread / per))))
-        if r2w > r2:
-            run_sync(r2w)  # compile + warm the widened loop
-            dt = once(r1, r2w)
-            r2 = r2w
-    if dt <= 0 or (r2 - r1) * dt < min_spread / 10.0:
-        # even the widened spread stayed an order of magnitude under the
-        # jitter-proof threshold: the number is noise (possibly negative
-        # or absurdly small-positive).  Report the failure (the caller's
-        # except records an error string) instead of printing it into
-        # the benchmark JSON.  _JitterError so the kernel-fallback
-        # wrapper does not misread it as a kernel bug.
-        raise _JitterError("marginal measurement drowned in dispatch "
-                           f"jitter (dt={dt:.3e} s/op over "
-                           f"{r2 - r1} ops)")
-    return dt
+# The MARGINAL measurement core lives in utils/profiling (round 6 —
+# it used to be defined here; one implementation, library-importable):
+# time a fused loop of r1 ops and one of r2 ops, divide the median
+# difference by r2 - r1 (the tunneled per-dispatch constant cancels),
+# adaptively widening the loop count until the delta dominates the
+# dispatch jitter, and raising JitterError instead of returning noise.
+# Fused loops come from the *_n program family (dot_n,
+# inclusive_scan_n, ring_attention_n, exchange_n, sort_n).
+from dr_tpu.utils.profiling import JitterError as _JitterError  # noqa: E402
+from dr_tpu.utils.profiling import marginal as _marginal  # noqa: E402
 
 
 def _marginal_with_fallback(run_sync, kernel_possible, env_var, err_key,
@@ -298,12 +251,22 @@ def _time_amortized(dispatch, sync, calls=16, batches=3):
     return float(np.median(times))
 
 
-def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
+def _secondary_metrics(on_cpu: bool, on_tpu: bool,
+                       phases: bool = False) -> dict:
     """The remaining BASELINE.json configs, each as one number in detail:
     transform_reduce dot (GB/s), inclusive_scan (GB/s), halo-exchange
     p50 latency (us), 2-D heat stencil (GB/s), CSR SpMV (GFLOP/s).
     Every config is independently guarded — a failure records an error
-    string instead of killing the headline metric."""
+    string instead of killing the headline metric.
+
+    The sort config additionally emits its PHASE BREAKDOWN
+    (``sort_phases_gbps``: per-phase effective GB/s over the
+    sample-sort truncation ladder, ``sort_phase_dominant``) — round 6;
+    ``phases=True`` (``--phases`` / ``DR_TPU_BENCH_PHASES=1``) adds the
+    key-value ladder (``sortkv_phases_gbps``).  On a single-device mesh
+    the collective phases collapse into ``local_sort`` (the program has
+    no exchange to run), which is itself the honest story: the CPU
+    fallback's sort cost IS the local XLA sort."""
     import dr_tpu
     out = {}
     P = dr_tpu.nprocs()
@@ -439,6 +402,45 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
         dt = _marginal(run_sort, r1=2, r2=10, samples=5)
         out["sort_gbps"] = round(n * itemsize / dt / 1e9, 2)
         out["sort_mkeys"] = round(n / dt / 1e6, 1)
+
+        # keys-only per-phase breakdown over the truncation ladder
+        # (round 6): consecutive stop_after prefixes timed by the
+        # marginal method; differences are the phase costs.
+        # Independently guarded (like every config) and BEFORE the
+        # key-value leg, so a kv failure cannot eat the breakdown that
+        # rides sort_gbps.  v's content is scrap afterwards — this is
+        # the last keys-only use of it.
+        spread = 0.1 if on_cpu else 0.3
+        try:
+            if P == 1:
+                # no collective phases exist at p=1 (every truncation
+                # IS the full program, so ladder differences would be
+                # pure noise): the whole sort is the local XLA sort —
+                # the honest, platform-bound breakdown (docs/PERF.md
+                # round 6)
+                out["sort_phases_gbps"] = {
+                    "local_sort": out["sort_gbps"]}
+                out["sort_phase_dominant"] = "local_sort"
+                out["sort_phases_note"] = \
+                    "p=1: collective phases collapse; sort IS the " \
+                    "local XLA sort"
+            else:
+                from dr_tpu.algorithms.sort import (SORT_PHASES,
+                                                    sort_phases_n)
+                from dr_tpu.utils.profiling import profile_phases
+
+                def mk_sort(i):
+                    def run(r):
+                        sort_phases_n(v, SORT_PHASES[i], r)
+                        _sync(v)
+                    return run
+                bd = profile_phases(mk_sort, SORT_PHASES, r1=2, r2=6,
+                                    samples=3, min_spread=spread)
+                out["sort_phases_gbps"] = bd.detail(n * itemsize)
+                out["sort_phase_dominant"] = bd.dominant
+        except Exception as e:  # pragma: no cover - defensive
+            out["sort_phases_error"] = repr(e)[:160]
+
         kd = dr_tpu.distributed_vector(n, np.float32)
         kd.assign_array(rng.standard_normal(n).astype(np.float32))
         pd = dr_tpu.distributed_vector(n, np.int32)
@@ -449,6 +451,31 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool) -> dict:
             _sync(kd)
         dt = _marginal(run_kv, r1=2, r2=10, samples=5)
         out["sortkv_gbps"] = round(2.0 * n * itemsize / dt / 1e9, 2)
+        if phases:
+            try:
+                if P == 1:
+                    out["sortkv_phases_gbps"] = {
+                        "local_sort": out["sortkv_gbps"]}
+                    out["sortkv_phase_dominant"] = "local_sort"
+                else:
+                    from dr_tpu.algorithms.sort import (
+                        SORTKV_PHASES, sort_by_key_phases_n)
+                    from dr_tpu.utils.profiling import profile_phases
+
+                    def mk_kv(i):
+                        def run(r):
+                            sort_by_key_phases_n(kd, pd,
+                                                 SORTKV_PHASES[i], r)
+                            _sync(kd)
+                        return run
+                    bdk = profile_phases(mk_kv, SORTKV_PHASES,
+                                         r1=2, r2=6, samples=3,
+                                         min_spread=spread)
+                    out["sortkv_phases_gbps"] = bdk.detail(
+                        2.0 * n * itemsize)
+                    out["sortkv_phase_dominant"] = bdk.dominant
+            except Exception as e:  # pragma: no cover - defensive
+                out["sortkv_phases_error"] = repr(e)[:160]
     except Exception as e:  # pragma: no cover - defensive
         out["sort_error"] = repr(e)[:160]
     finally:
@@ -610,8 +637,10 @@ def _exec_cpu_fallback(err: str):
     env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
     env["_DR_TPU_BENCH_DEGRADED"] = err
     env["JAX_PLATFORMS"] = "cpu"
+    # keep the CLI (--phases) across the re-exec
     os.execve(sys.executable,
-              [sys.executable, os.path.abspath(__file__)], env)
+              [sys.executable, os.path.abspath(__file__)]
+              + sys.argv[1:], env)
 
 
 def _devices_or_die(timeout_s: float):
@@ -681,7 +710,8 @@ def _devices_or_die(timeout_s: float):
                 err = f"{err}; relay not listening, retry skipped"
             _exec_cpu_fallback(err)
         os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__)], env)
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
     detail = {"error": err}
     if os.environ.get("_DR_TPU_BENCH_DEGRADED"):
         # keep the original TPU-side cause alongside the child's error
@@ -755,7 +785,11 @@ def main():
 
     secondary = {}
     if os.environ.get("DR_TPU_BENCH_SECONDARY", "1") != "0":
-        secondary = _secondary_metrics(on_cpu, on_tpu)
+        # --phases (or DR_TPU_BENCH_PHASES=1): add the key-value sort
+        # phase ladder on top of the always-on keys-only breakdown
+        phases = ("--phases" in sys.argv[1:]
+                  or os.environ.get("DR_TPU_BENCH_PHASES", "") == "1")
+        secondary = _secondary_metrics(on_cpu, on_tpu, phases=phases)
 
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",

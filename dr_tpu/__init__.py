@@ -22,6 +22,7 @@ namespaces through one TPU backend, called ``thp``):
 - halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
 """
 
+from .utils import jax_compat  # noqa: F401  (jax.shard_map shim, first)
 from .parallel.runtime import (init, final, finalize, runtime, nprocs,
                                devices, mesh, barrier, fence,
                                get_duplicated_devices)

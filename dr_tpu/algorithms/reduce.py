@@ -242,6 +242,15 @@ def reduce_async(r, op: Callable = None):
                 c.cont.layout, op, tuple(c.ops),
                 None if (c.off == 0 and c.n == len(c.cont))
                 else (c.off, c.n))(c.cont._data, *svals)
+        if hasattr(r, "to_array") and not (gchains is not None
+                                           and len(gchains) == 1):
+            # custom-op reduce over a MULTI-component distributed range
+            # (e.g. transform over zip): the one distributed reduce
+            # shape still materializing — announce the cliff (ADVICE
+            # r5; empty single chains fall through silently, their
+            # materialize is trivial)
+            from ..utils.fallback import warn_fallback
+            warn_fallback("reduce", "multi-component custom-op range")
     arr = r.to_array() if hasattr(r, "to_array") else jnp.asarray(r)
     assert not isinstance(arr, tuple), \
         "reduce over a zip needs a transform to combine components"

@@ -27,8 +27,10 @@ from jax.sharding import PartitionSpec as P
 from ._common import (combine_for, first_nonempty, identityless_fold,
                       owned_window_mask, uniform_layout, window_geometry,
                       working_geometry)
-from .elementwise import (_Chain, _op_key, _out_chain, _prog_cache,
-                          _resolve, _write_window)
+from ..views import views as _v
+from .elementwise import (_Chain, _apply_chain_ops, _chain_scalars,
+                          _op_key, _out_chain, _prog_cache, _resolve,
+                          _traced_op_key, _write_window)
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
 
@@ -144,7 +146,10 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     - ``ops``: a view chain's elementwise op stack, fused into the
       program — applied to the extracted slice BEFORE any identity
       masking (the masks live in the post-op domain, where the scan
-      identity is meaningful).
+      identity is meaningful).  BoundOp ops key on op identity + scalar
+      COUNT and feed their values as TRACED trailing operands (round 6;
+      the _custom_reduce_program convention), so a streamed coefficient
+      reuses ONE compiled program instead of re-jitting per value.
     - ``out_layout``/``out_window``: a MISMATCHED destination (different
       offsets, or a different distribution on the same mesh).  The scan
       then always runs in WINDOW coordinates; the scanned values
@@ -155,7 +160,7 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
            else None, exclusive, str(dtype), use_kernel,
            _kernel_variant() if use_kernel else None, window, aliased,
-           tuple(_op_key(f) for f in ops), out_layout, out_window)
+           tuple(_traced_op_key(f) for f in ops), out_layout, out_window)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -202,8 +207,12 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     # the masking pass (a whole extra HBM read-modify) when exact.
     exact = (bool((np.asarray(sizes) == S).all()) and nshards * S == n
              and window is None)
+    # BoundOp chain scalars arrive as traced trailing operands
+    nsc = sum(len(o.scalars) for o in ops if isinstance(o, _v.BoundOp))
 
-    def body(blk, *out_blk):  # (1, width) one shard row
+    def body(blk, *rest):  # (1, width) one shard row (+ out + scalars)
+        out_blk = rest[:len(rest) - nsc]
+        chain_scalars = rest[len(rest) - nsc:]
         ident = _identity_for(kind, dtype) if kind is not None else None
         r = lax.axis_index(axis)
         if wgeom:
@@ -214,11 +223,10 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             x = jnp.take(blk[0], idx)
         else:
             x = blk[0, prev:prev + S]
-        for f in ops:
-            # the view chain's elementwise stack, fused (round 5);
-            # masks below live in the POST-op domain, where the scan
-            # identity is meaningful
-            x = f(x)
+        # the view chain's elementwise stack, fused (round 5); masks
+        # below live in the POST-op domain, where the scan identity is
+        # meaningful.  BoundOp coefficients are traced (round 6).
+        x = _apply_chain_ops(x, ops, iter(chain_scalars))
         if window is not None and not wgeom:
             # outside-window cells become the identity: every window
             # prefix then sees only window contributions
@@ -379,7 +387,8 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     # varying-mesh-axis metadata
     nin = 1 if window is None or aliased else 2
     shmapped = jax.shard_map(body, mesh=mesh,
-                             in_specs=(P(axis, None),) * nin,
+                             in_specs=(P(axis, None),) * nin
+                             + (P(),) * nsc,
                              out_specs=P(axis, None),
                              check_vma=not use_kernel)
     # donate the OUT buffer the window blend rebinds (the aliased form
@@ -446,8 +455,13 @@ def _scan(in_r, out, op, init, exclusive):
         aliased = (not full) and c.cont is out_chain.cont
         # view-chain ops make the post-op dtype program-defined; the
         # Pallas kernel's f32-accumulation contract is keyed on the
-        # INPUT dtype, so chains conservatively take the XLA path
-        use_kernel = (not c.ops) and _use_scan_kernel(
+        # INPUT dtype, so chains conservatively take the XLA path.
+        # The MISMATCHED route is gated off too (ADVICE r5 high): it
+        # forces window-coordinate geometry whose per-shard slice
+        # length comes from window_geometry and is generally not
+        # lane-aligned — chunked_cumsum's pick_chunk assertion would
+        # crash at trace time on TPU.
+        use_kernel = (not c.ops) and not mis_ok and _use_scan_kernel(
             c.cont.layout, kind, c.cont.dtype, c.cont.runtime)
         prog = _scan_program(
             mesh, c.cont.runtime.axis, c.cont.layout, kind, op,
@@ -456,8 +470,10 @@ def _scan(in_r, out, op, init, exclusive):
             ops=tuple(c.ops),
             out_layout=out_chain.cont.layout if mis_ok else None,
             out_window=(out_chain.off, out_chain.n) if mis_ok else None)
-        out_chain.cont._data = prog(c.cont._data) if full or aliased \
-            else prog(c.cont._data, out_chain.cont._data)
+        svals = [jnp.asarray(s) for s in _chain_scalars([c])]
+        out_chain.cont._data = prog(c.cont._data, *svals) \
+            if full or aliased \
+            else prog(c.cont._data, out_chain.cont._data, *svals)
         scanned = None
     elif single:
         # DIFFERENT MESHES: scan natively on the input's runtime, then

@@ -5,7 +5,10 @@ distributed sort (its spec and later revisions of the proposal name
 one), so this is designed TPU-first rather than re-designed: ONE jitted
 ``shard_map`` program per layout doing
 
-1. local ``jnp.sort`` of the owned (masked) cells,
+1. local sort of the owned (masked) cells — the monotone key encoding
+   (64-bit sign-flip for f64) is FUSED into the same program, and with
+   a payload only the GLOBAL INDEX rides as a tiebreak channel (the
+   payload itself never enters a sort — see phase 6),
 2. splitter selection by REGULAR SAMPLING — each shard contributes
    ``p-1`` evenly spaced elements of its sorted run, the ``p*(p-1)``
    samples are ``all_gather``-ed and the global splitters are the
@@ -14,20 +17,52 @@ one), so this is designed TPU-first rather than re-designed: ONE jitted
    which only affects balance — correctness never depends on it),
 3. bucket exchange as ONE ``all_to_all`` of a ``(p, seg)`` send matrix
    (row ``d`` = my elements belonging to shard ``d``, padded with the
-   dtype's maximum).  A single source's bucket can never exceed its own
-   ``seg`` elements, so the matrix is overflow-free BY CONSTRUCTION —
-   no variable-length transport needed under XLA's static shapes,
-4. local merge (``jnp.sort`` of the received matrix), and
-5. rebalance back to the uniform block layout: run lengths are
-   ``all_gather``-ed into exclusive offsets, each source pre-places its
-   elements at their destination-window positions in a second
-   ``(p, seg)`` matrix, and after a second ``all_to_all`` each output
-   cell is the SUM of its column — every global position is covered by
-   exactly one source, so masked-sum assembly replaces the scatter TPU
-   doesn't like.
+   dtype's maximum).  The sorted run makes every destination's bucket a
+   CONTIGUOUS slice (round 6), so the matrix is a shifted take with
+   FRONT-ALIGNED rows, its per-destination counts come from ``p``
+   searchsorteds, and ONE ``all_gather`` of the count vector replaces
+   the old count ``all_to_all`` plus the rebalance-side ``all_gather``.
+   A single source's bucket can never exceed its own ``seg`` elements,
+   so the matrix is overflow-free BY CONSTRUCTION — no variable-length
+   transport needed under XLA's static shapes,
+4. local merge (one ``lax.sort`` of the received matrix — every
+   sorted channel set is a TOTAL order, so no stable comparator: see
+   "comparator discipline" below), and
+5. rebalance back to the uniform block layout: the counts matrix gives
+   exclusive offsets, each source pre-places its elements at their
+   destination-window positions in a second ``(p, seg)`` matrix, and
+   after a second ``all_to_all`` each output cell is the SUM of its
+   column — every global position is covered by exactly one source, so
+   masked-sum assembly replaces the scatter TPU doesn't like,
+6. (key-value only, round 6 "single-exchange payload plan") payload
+   move: the rebalanced GLOBAL-INDEX channel IS the sort permutation in
+   destination coordinates, so each payload channel moves ONCE — one
+   ``all_gather`` of the request indices plus one masked ``all_to_all``
+   per channel — instead of riding the local sort, the bucket exchange,
+   the merge, and the rebalance as a data channel.
+
+Comparator discipline (round 6): XLA's VARIADIC sort (multiple
+operands) costs several times its single-channel form, and stable
+comparators cost more than unstable ones on the structured inputs the
+hot path actually sees (the merge's concatenated sorted runs, chained
+re-sorts of sorted data).  Keys-only sorts therefore run ONE channel
+unstable (duplicates are bit-identical — placement among equals is
+unobservable) and key-value sorts run exactly two channels — (key,
+global index), a TOTAL order, so unstable is still exact and the old
+explicit-stability flag is unnecessary.  ``DR_TPU_SORT_STABLE=1``
+forces stable comparators back on for A/B sweeps (tune_tpu.py sort).
 
 Descending order costs nothing extra: phase 5's index map places
 element ``g`` of the ascending order at global position ``n-1-g``.
+
+PHASE PROFILING (round 6): ``_sort_program`` takes ``stop_after`` — a
+phase name from :data:`SORT_PHASES` / :data:`SORTKV_PHASES` — and
+builds the SAME program truncated after that phase (returning a row of
+the normal output shape derived from the last phase's values, so the
+``sort_phases_n`` / ``sort_by_key_phases_n`` fused loops can chain it).
+``utils.profiling.profile_phases`` turns consecutive truncations into a
+per-phase time breakdown; bench.py emits it into the bench JSON detail
+and ``tools/tune_tpu.py sort`` prints the ladder.
 
 Uneven ``block_distribution`` layouts (including zero-size "team"
 shards) run the SAME program: the geometry enters as static per-shard
@@ -46,6 +81,8 @@ over one; transform views and other read-only ranges are rejected with
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,10 +91,13 @@ from jax.sharding import PartitionSpec as P
 
 from ._common import (owned_window_mask, window_geometry,
                       working_geometry)
-from .elementwise import _out_chain, _prog_cache, _resolve
+from .elementwise import (_apply_chain_ops, _chain_scalars, _out_chain,
+                          _prog_cache, _resolve, _traced_op_key)
 from ..core.pinning import pinned_id
+from ..views import views as _v
 
-__all__ = ["sort", "sort_by_key", "argsort", "is_sorted"]
+__all__ = ["sort", "sort_by_key", "argsort", "is_sorted",
+           "SORT_PHASES", "SORTKV_PHASES"]
 
 
 _NAN_KEY = np.uint32(0xFFFFFFFE)  # after +inf (numpy sorts NaNs last)
@@ -67,6 +107,21 @@ _PAD_KEY = np.uint32(0xFFFFFFFF)  # strictly after every real key
 # stores f32 and takes the 32-bit path, which is then exact)
 _NAN_KEY64 = np.uint64(0xFFFFFFFFFFFFFFFE)
 _PAD_KEY64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+# program phases, in execution order (profiling vocabulary; the last
+# name denotes the FULL program).  p == 1 meshes have no collective
+# phases: every truncation beyond local_sort runs the full program.
+SORT_PHASES = ("local_sort", "splitter", "exchange", "merge",
+               "rebalance")
+SORTKV_PHASES = ("local_sort", "splitter", "exchange", "merge",
+                 "rebalance", "payload")
+
+
+def _stable_override() -> bool:
+    """``DR_TPU_SORT_STABLE=1`` forces stable comparators on every
+    ``lax.sort`` in the family (A/B knob for ``tune_tpu.py sort``);
+    part of every program cache key so in-process sweeps rebuild."""
+    return os.environ.get("DR_TPU_SORT_STABLE", "").strip() == "1"
 
 
 def _encode(x, distinct_zeros=False):
@@ -134,11 +189,14 @@ def _pack_row(row, layout, dtype):
 
 def _sort_program(mesh, axis, layout, dtype, descending,
                   pay_layout=None, pay_dtype=None, window=None,
-                  pay_window=None, aliased=False):
+                  pay_window=None, aliased=False, stop_after=None):
     """The sample-sort program; with ``pay_layout`` set it carries a
-    payload row through every phase (stable key-value sort — the
-    payload rides the same collectives, tie order preserved by
-    ``is_stable`` sorts and the source-major merge order).
+    stable key-value sort — the keys travel with the original GLOBAL
+    INDEX as an explicit tiebreak channel, and the payload moves ONCE
+    at the end through the rebalanced index channel (phase 6, the
+    round-6 single-exchange payload plan; the round-5 form dragged the
+    payload through the local sort, the exchange, the merge, and the
+    rebalance as a data channel — on XLA's costly variadic sort path).
 
     ``window=(off, wn)`` sorts ONLY the logical subrange [off, off+wn)
     in place (round 4 — windows used to materialize): the window's
@@ -152,11 +210,26 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     windows from it (both slices come from the ORIGINAL row), and
     blends both results into that one row, payload LAST — so
     overlapping windows deterministically take the payload value,
-    the same order the old sequential fallback wrote."""
+    the same order the old sequential fallback wrote.
+
+    ``stop_after`` (round 6, profiling aid): a phase name from
+    :data:`SORT_PHASES` / :data:`SORTKV_PHASES` truncates the program
+    after that phase.  The truncated program still returns rows of the
+    normal output shape — the key row is derived from the last phase's
+    values (mixed so XLA can neither fold nor dead-code-eliminate the
+    phase work), the payload row passes through untouched — so the
+    fused ``*_phases_n`` loops chain it and the marginal method prices
+    each prefix; consecutive differences are the per-phase costs."""
+    phases = SORTKV_PHASES if pay_layout is not None else SORT_PHASES
+    if stop_after is not None:
+        assert stop_after in phases, (stop_after, phases)
+        if stop_after == phases[-1]:
+            stop_after = None  # the full program IS the last phase
+    stable = _stable_override()
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
            bool(descending), pay_layout,
            str(pay_dtype) if pay_layout else None, window, pay_window,
-           aliased,
+           aliased, stop_after, stable,
            # x64 state changes the traced key width for declared-f64
            # containers (uint32 under x64-off, uint64 under x64-on)
            bool(jax.config.jax_enable_x64))
@@ -182,32 +255,27 @@ def _sort_program(mesh, axis, layout, dtype, descending,
     sizes_c = jnp.asarray(sizes, jnp.int32)
     if pay_layout is not None and window is not None:
         # windowed key-value sort (round 4): the payload window has its
-        # OWN static geometry — extraction offsets, realign source, the
-        # phase-5 destination, and the output blend mask all come from
-        # it, exactly the mixed-distribution machinery in window
-        # coordinates
+        # OWN static geometry — extraction offsets, the phase-5 index
+        # rebalance destination, the phase-6 gather ownership, and the
+        # output blend mask all come from it, in window coordinates
         _, Sp, pcap2, pprev2, pnxt2, _, pstarts, psizes, pwstart = \
             window_geometry(pay_layout, *pay_window)
         pwidth = pprev2 + pcap2 + pnxt2
         pwoff_c = jnp.asarray(pwstart, jnp.int32)
         pay_mask_c = jnp.asarray(np.asarray(
             owned_window_mask(pay_layout, *pay_window)[0]))
-        same_dist = (np.array_equal(pstarts, starts)
-                     and np.array_equal(psizes, sizes))
         pstarts_c = jnp.asarray(pstarts, jnp.int32)
         psizes_c = jnp.asarray(psizes, jnp.int32)
     elif pay_layout is not None:
         # the payload may carry a DIFFERENT block distribution (round
-        # 4): its own static geometry drives an input realignment to
-        # key coordinates and the phase-5 rebalance into its own
-        # windows — the materialize fallback is gone
+        # 4): its own static geometry drives the index rebalance and
+        # the gather ownership test — nothing realigns on entry any
+        # more (round 6: the payload is only ever read by the gather)
         _, Sp, _, _, _, _, pstarts, psizes = working_geometry(pay_layout)
-        same_dist = (np.array_equal(pstarts, starts)
-                     and np.array_equal(psizes, sizes))
         pstarts_c = jnp.asarray(pstarts, jnp.int32)
         psizes_c = jnp.asarray(psizes, jnp.int32)
     else:
-        Sp, same_dist = S, True
+        Sp = S
 
     GMAX = np.int32(np.iinfo(np.int32).max)
 
@@ -227,164 +295,242 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         # keys-only sort is a bit-exact permutation (distinct -0.0/+0.0
         # keys); key-value sort collapses the zeros so ties keep
         # numpy-stable original order
-        key, big = _encode(raw, distinct_zeros=not pay)
+        kv, big = _encode(raw, distinct_zeros=not pay)
         nvalid = jnp.minimum(sizes_c[r],
                              jnp.clip(n - starts_c[r], 0, S))
         gid = starts_c[r] + jnp.arange(S)
         local_ok = jnp.arange(S) < nvalid
-        key = jnp.where(local_ok, key, big)     # mask pad cells
+        kv = jnp.where(local_ok, kv, big)       # mask pad cells
 
-        def realign(vrow):
-            # payload cells (own-distribution local order, width Sp) ->
-            # key coordinates: destination slot (d, j) holds global
-            # position kstarts[d]+j, owned by exactly one source under
-            # the payload distribution — masked-sum assembly over one
-            # all_to_all, the same pattern as phase 5
-            gpos_k = starts_c[:, None] + jnp.arange(S)[None, :]
-            dest_ok = jnp.arange(S)[None, :] < sizes_c[:, None]
-            idxl = gpos_k - pstarts_c[r]
-            own = dest_ok & (idxl >= 0) & (idxl < psizes_c[r])
-            send = jnp.where(own,
-                             jnp.take(vrow, jnp.clip(idxl, 0, Sp - 1)),
-                             jnp.zeros((), vrow.dtype))
-            return jnp.sum(lax.all_to_all(send, axis, 0, 0), axis=0)
-
-        if pay and window is not None:
-            def pay_raw(v):
+        def pay_vec(v):
+            # payload cells in their OWN (window) coordinates; only the
+            # phase-6 gather ever reads them
+            if window is not None:
                 pidx = jnp.clip(pprev2 + pwoff_c[r] + jnp.arange(Sp),
                                 0, pwidth - 1)
                 return jnp.take(v[0], pidx)
-            pay_vecs = tuple(
-                pay_raw(v) if same_dist else realign(pay_raw(v))
-                for v in pay)
-        elif same_dist:
-            pay_vecs = tuple(v[0, pprev:pprev + S] for v in pay)
-        else:
-            pay_vecs = tuple(realign(v[0, pprev:pprev + Sp])
-                             for v in pay)
-        vals = (key,) + pay_vecs
-        nkeys = 1
+            return v[0, pprev:pprev + Sp]
+
+        def pay_gather(perm):
+            # phase 6: move each payload channel ONCE.  ``perm`` holds,
+            # per destination slot of MY payload window, the original
+            # window position whose payload lands there (the rebalanced
+            # global-index channel).  Every position is owned by exactly
+            # one source shard under the payload distribution, so one
+            # all_gather of the request indices + one masked all_to_all
+            # per channel assembles the result (the rebalance pattern).
+            rows = [pay_vec(v) for v in pay]
+            if p == 1:
+                ok = jnp.arange(Sp) < psizes_c[r]
+                return [jnp.where(ok,
+                                  jnp.take(vr, jnp.clip(perm, 0,
+                                                        Sp - 1)),
+                                  jnp.zeros((), vr.dtype))
+                        for vr in rows]
+            G = lax.all_gather(perm, axis)                   # (p, Sp)
+            idxl = G - pstarts_c[r]
+            dest_ok = jnp.arange(Sp)[None, :] < psizes_c[:, None]
+            own = dest_ok & (idxl >= 0) & (idxl < psizes_c[r])
+            outs = []
+            for vr in rows:
+                send = jnp.where(
+                    own, jnp.take(vr, jnp.clip(idxl, 0, Sp - 1)),
+                    jnp.zeros((), vr.dtype))
+                outs.append(jnp.sum(lax.all_to_all(send, axis, 0, 0),
+                                    axis=0))
+            return outs
+
+        def finish(kvec, pay_res=None):
+            # shared output tail: decode + window blend / row pack.
+            # ``pay_res=None`` with a payload means a TRUNCATED
+            # program: the payload rows pass through untouched (honest
+            # — no phase before "payload" touches them).
+            if window is not None:
+                decoded = _decode(kvec, dtype)
+                col_idx = jnp.clip(
+                    jnp.arange(width) - prev - woff_c[r], 0, S - 1)
+                krow = jnp.where(mask_c[r], jnp.take(decoded, col_idx),
+                                 blk[0])[None]
+                if not pay:
+                    return krow
+                if pay_res is None:
+                    return krow if aliased else (krow, pay[0])
+                pcol_idx = jnp.clip(
+                    jnp.arange(pwidth) - pprev2 - pwoff_c[r], 0,
+                    Sp - 1)
+                if aliased:
+                    # both windows blend into the ONE row: the key
+                    # blend carries untouched originals outside its
+                    # window, and the payload blend composes LAST — on
+                    # overlapping windows the payload value
+                    # deterministically wins, the order the old
+                    # sequential fallback wrote (this blend ORDER is
+                    # load-bearing, see sort_by_key)
+                    return jnp.where(
+                        pay_mask_c[r],
+                        jnp.take(pay_res[0].astype(pay_dtype),
+                                 pcol_idx),
+                        krow[0])[None]
+                prows = []
+                for rowv, src in zip(pay_res, pay):
+                    prows.append(jnp.where(
+                        pay_mask_c[r],
+                        jnp.take(rowv.astype(pay_dtype), pcol_idx),
+                        src[0])[None])
+                return (krow, *prows)
+            kout = _pack_row(_decode(kvec, dtype), layout, dtype)
+            if not pay:
+                return kout
+            if pay_res is None:
+                return kout if aliased else (kout, pay[0])
+            return (kout,) + tuple(
+                _pack_row(rowv, pay_layout, pay_dtype)
+                for rowv in pay_res)
+
+        # --- phase 1: local sort, key-encode fused.  Keys-only: ONE
+        # unstable channel (duplicates are bit-identical).  Key-value:
+        # (key, global index) — a TOTAL order, so unstable is exact,
+        # and the index channel does double duty: (a) real elements
+        # sort before pad slots among EQUAL keys — an integer key equal
+        # to the dtype-max pad sentinel would otherwise let a pad
+        # displace the real element in the merge; (b) key ties keep
+        # original global order exactly (numpy-stable).
         if pay:
-            # SECONDARY sort key: the original global index, with pads
-            # at int32 max.  Two jobs: (a) real elements sort before
-            # pad slots among EQUAL keys — an integer key equal to the
-            # dtype-max pad sentinel would otherwise let a pad displace
-            # the real element's payload in the merge; (b) key ties
-            # keep original global order exactly (numpy-stable).
-            vals = (key, jnp.where(local_ok, gid, GMAX).astype(
-                jnp.int32)) + vals[1:]
-            nkeys = 2
-        srt = lax.sort(vals, dimension=0, num_keys=nkeys,
-                       is_stable=True)
-        xs, ps = srt[0], srt[1:]
+            vals = (kv, jnp.where(local_ok, gid, GMAX).astype(
+                jnp.int32))
+        else:
+            vals = (kv,)
+        srt = lax.sort(vals, dimension=0, num_keys=len(vals),
+                       is_stable=stable)
+        xs = srt[0]
+        gs = srt[1] if pay else None
+        if stop_after == "local_sort":
+            # value-mix the secondary channel in so XLA cannot narrow
+            # the variadic sort to a single-operand one
+            X = xs if not pay else xs.at[0].set(
+                jnp.minimum(xs[0], gs[0].astype(xs.dtype)))
+            return finish(X)
 
         if p == 1:
+            # no collective phases exist: every later truncation is
+            # the full program.  Pads sorted to the end; reverse, then
+            # rotate them back outside the logical window.
             if descending:
-                # pads sorted to the end; reverse, then rotate them
-                # back outside the logical window
-                outs = [jnp.roll(v[::-1], nvalid - S)
-                        for v in (xs, *ps)]
-            else:
-                outs = [xs, *ps]
-            if pay:
-                del outs[1]  # the gid channel is not an output
-        else:
-            # 2. regular samples -> global splitters (positions scale
-            # with MY real count; a short shard samples its real keys,
-            # an EMPTY one contributes pad sentinels — either way only
-            # bucket balance is affected, never correctness)
-            samp = jnp.take(xs, (jnp.arange(1, p) * nvalid) // p)
-            allsamp = lax.all_gather(samp, axis).reshape(-1)  # (p(p-1),)
-            spl = jnp.sort(allsamp)[jnp.arange(1, p) * (p - 1) - 1]
-            # 3. bucket exchange ((p, S) send matrices, one
-            # all_to_all per channel).  A source's bucket can't exceed
-            # its own real count (<= S): overflow-free by construction.
-            bucket = jnp.searchsorted(spl, xs, side="right")  # (S,)
-            vmask = jnp.arange(S) < nvalid
-            mine = (bucket[None, :] == jnp.arange(p)[:, None]) \
-                & vmask[None, :]
-            send = jnp.where(mine, xs[None, :], big)
-            cnts = jnp.sum(mine, axis=1, dtype=jnp.int32)     # (p,)
-            recv = lax.all_to_all(send, axis, 0, 0)           # (p, S)
-            rcnt = lax.all_to_all(cnts[:, None], axis, 0, 0)  # (p, 1)
-            # pad values per channel: the gid channel pads at GMAX so
-            # pad slots stay AFTER real elements under the 2-key merge
-            ppad = [jnp.asarray(GMAX)] + \
-                [jnp.zeros((), q.dtype) for q in ps[1:]] if pay else []
-            precv = [lax.all_to_all(
-                jnp.where(mine, q[None, :], pv), axis, 0, 0)
-                for q, pv in zip(ps, ppad)]
-            # 4. stable local merge; cnt = my run's true length.  The
-            # flattened recv is source-major and each source row keeps
-            # its local sorted order, so stability composes; with a
-            # payload the global index is the explicit tiebreak.
-            msrt = lax.sort((recv.reshape(-1),)
-                            + tuple(q.reshape(-1) for q in precv),
-                            dimension=0, num_keys=nkeys,
-                            is_stable=True)
-            merged = msrt[0]
-            pmerged = msrt[2:] if pay else msrt[1:]
-            cnt = jnp.sum(rcnt)
-            # 5. rebalance to the DESTINATION layout by masked-sum
-            # assembly: shard d's window is [starts[d], starts[d] +
-            # sizes[d]) — per CHANNEL geometry, so a payload carrying a
-            # different distribution lands directly in its own windows
-            allcnt = lax.all_gather(cnt, axis)                # (p,)
-            off = jnp.sum(jnp.where(jnp.arange(p) < r, allcnt, 0))
-
-            def rebalance(m, dstarts, dsizes, Sd):
-                gpos = dstarts[:, None] \
-                    + jnp.arange(Sd)[None, :]                 # (p, Sd)
-                dest_ok = jnp.arange(Sd)[None, :] < dsizes[:, None]
-                want = (n - 1 - gpos) if descending else gpos
-                idx = want - off       # my local index for that cell
-                ok = dest_ok & (idx >= 0) & (idx < cnt)
-                gidx = jnp.clip(idx, 0, p * S - 1)
-                s2 = jnp.where(ok, jnp.take(m, gidx),
-                               jnp.zeros((), m.dtype))
-                return jnp.sum(lax.all_to_all(s2, axis, 0, 0), axis=0)
-            # pmerged is nonempty only with a payload, whose channels
-            # rebalance into the PAYLOAD geometry (== the key geometry
-            # when the distributions match)
-            outs = [rebalance(merged, starts_c, sizes_c, S)] \
-                + [rebalance(q, pstarts_c, psizes_c, Sp)
-                   for q in pmerged]
-        if window is not None:
-            # blend: window cells take their sorted value (the window-
-            # coordinate result, re-addressed per full-row column),
-            # everything else keeps the original row — per channel,
-            # each through its own container's window mask
-            decoded = _decode(outs[0], dtype)
-            col_idx = jnp.clip(jnp.arange(width) - prev - woff_c[r],
-                               0, S - 1)
-            krow = jnp.where(mask_c[r], jnp.take(decoded, col_idx),
-                             blk[0])[None]
+                xs = jnp.roll(xs[::-1], nvalid - S)
+                if pay:
+                    gs = jnp.roll(gs[::-1], nvalid - S)
             if not pay:
-                return krow
-            pcol_idx = jnp.clip(
-                jnp.arange(pwidth) - pprev2 - pwoff_c[r], 0, Sp - 1)
-            if aliased:
-                # both windows blend into the ONE row: the key blend
-                # carries untouched originals outside its window, and
-                # the payload blend composes LAST — on overlapping
-                # windows the payload value deterministically wins,
-                # the order the old sequential fallback wrote (this
-                # blend ORDER is load-bearing, see sort_by_key)
-                return jnp.where(
-                    pay_mask_c[r],
-                    jnp.take(outs[1].astype(pay_dtype), pcol_idx),
-                    krow[0])[None]
-            prows = []
-            for row, src in zip(outs[1:], pay):
-                prows.append(jnp.where(
-                    pay_mask_c[r],
-                    jnp.take(row.astype(pay_dtype), pcol_idx),
-                    src[0])[None])
-            return (krow, *prows)
-        out_rows = [_pack_row(_decode(outs[0], dtype), layout, dtype)]
-        for row in outs[1:]:
-            out_rows.append(_pack_row(row, pay_layout, pay_dtype))
-        return out_rows[0] if not pay else tuple(out_rows)
+                return finish(xs)
+            return finish(xs, pay_gather(gs))
+
+        # --- phase 2: regular samples -> global splitters (positions
+        # scale with MY real count; a short shard samples its real
+        # keys, an EMPTY one contributes pad sentinels — either way
+        # only bucket balance is affected, never correctness).  The
+        # classic p-1 samples per shard stay: the overflow-free
+        # exchange bound hangs off them, and the measured phase cost
+        # is noise-level (docs/PERF.md round-6 table).
+        samp = jnp.take(xs, (jnp.arange(1, p) * nvalid) // p)
+        allsamp = lax.all_gather(samp, axis).reshape(-1)  # (p(p-1),)
+        spl = jnp.sort(allsamp)[jnp.arange(1, p) * (p - 1) - 1]
+        if stop_after == "splitter":
+            X = xs.at[0].set(jnp.minimum(xs[0], spl[0]))
+            if pay:
+                # keep the index channel alive here too, or XLA strips
+                # the unused operand and the phase-1 sort compiles
+                # single-channel — the ladder would then misattribute
+                # the variadic-sort cost to the exchange phase
+                X = X.at[1].set(jnp.minimum(X[1], gs[0].astype(X.dtype)))
+            return finish(X)
+
+        # --- phase 3: bucket exchange.  xs is sorted, so destination
+        # d's elements form ONE CONTIGUOUS run (round 6): the send
+        # matrix is a shifted take with front-aligned rows, the
+        # per-destination counts are p searchsorteds into the monotone
+        # bucket vector, and ONE all_gather of the count vector yields
+        # both my merged length and the global offsets (the round-5
+        # form paid a count all_to_all here plus a second all_gather
+        # in the rebalance).  A source's bucket can't exceed its own
+        # real count (<= S): overflow-free by construction.
+        bucket = jnp.searchsorted(spl, xs, side="right")  # (S,) nondec
+        dd = jnp.arange(p)
+        lo = jnp.minimum(jnp.searchsorted(bucket, dd, side="left"),
+                         nvalid)
+        hi = jnp.minimum(jnp.searchsorted(bucket, dd, side="right"),
+                         nvalid)
+        cnts = (hi - lo).astype(jnp.int32)                # (p,)
+        sidx = jnp.clip(lo[:, None] + jnp.arange(S)[None, :], 0, S - 1)
+        in_run = jnp.arange(S)[None, :] < cnts[:, None]
+        send = jnp.where(in_run, jnp.take(xs, sidx), big)
+        recv = lax.all_to_all(send, axis, 0, 0)           # (p, S)
+        C = lax.all_gather(cnts, axis)                    # (p, p)
+        cnt = jnp.sum(C[:, r])       # my merged run's true length
+        if pay:
+            # the index channel pads at GMAX so pad slots stay AFTER
+            # real elements under the 2-key merge
+            grecv = lax.all_to_all(
+                jnp.where(in_run, jnp.take(gs, sidx), GMAX),
+                axis, 0, 0)
+        if stop_after == "exchange":
+            X = jnp.minimum(xs, recv[r])
+            X = X.at[0].set(jnp.minimum(X[0], cnt.astype(X.dtype)))
+            if pay:
+                X = X.at[1].set(jnp.minimum(
+                    X[1], grecv[r, 0].astype(X.dtype)))
+            return finish(X)
+
+        # --- phase 4: local merge.  The flattened recv is source-major
+        # and each source row keeps its local sorted order front-
+        # aligned, so stability composes; the channel set is a total
+        # order either way (see module docstring), so the comparator
+        # stays unstable.
+        flat = recv.reshape(-1)
+        if pay:
+            msrt = lax.sort((flat, grecv.reshape(-1)), dimension=0,
+                            num_keys=2, is_stable=stable)
+            merged, gidm = msrt
+        else:
+            merged = lax.sort((flat,), dimension=0, num_keys=1,
+                              is_stable=stable)[0]
+            gidm = None
+        if stop_after == "merge":
+            X = merged[::p]  # strided sample keeps the value spread
+            X = X.at[0].set(jnp.minimum(X[0], cnt.astype(X.dtype)))
+            if pay:
+                X = X.at[1].set(jnp.minimum(X[1],
+                                            gidm[0].astype(X.dtype)))
+            return finish(X)
+
+        # --- phase 5: rebalance to the DESTINATION layout by
+        # masked-sum assembly: shard d's window is [starts[d],
+        # starts[d] + sizes[d]) — per CHANNEL geometry, so the index
+        # channel lands directly in the PAYLOAD distribution's windows
+        allcnt = jnp.sum(C, axis=0)                       # (p,)
+        off = jnp.sum(jnp.where(jnp.arange(p) < r, allcnt, 0))
+
+        def rebalance(m, dstarts, dsizes, Sd):
+            gpos = dstarts[:, None] \
+                + jnp.arange(Sd)[None, :]                 # (p, Sd)
+            dest_ok = jnp.arange(Sd)[None, :] < dsizes[:, None]
+            want = (n - 1 - gpos) if descending else gpos
+            idx = want - off       # my local index for that cell
+            ok = dest_ok & (idx >= 0) & (idx < cnt)
+            gidx = jnp.clip(idx, 0, p * S - 1)
+            s2 = jnp.where(ok, jnp.take(m, gidx),
+                           jnp.zeros((), m.dtype))
+            return jnp.sum(lax.all_to_all(s2, axis, 0, 0), axis=0)
+
+        kreb = rebalance(merged, starts_c, sizes_c, S)
+        if not pay:
+            return finish(kreb)
+        # the rebalanced index channel IS the sort permutation, homed
+        # in the payload distribution's own windows
+        gperm = rebalance(gidm, pstarts_c, psizes_c, Sp)
+        if stop_after == "rebalance":
+            return finish(kreb.at[0].set(
+                jnp.minimum(kreb[0], gperm[0].astype(kreb.dtype))))
+        # --- phase 6: single payload move ---
+        return finish(kreb, pay_gather(gperm))
 
     nin = 1 if pay_layout is None or aliased else 2
     shmapped = jax.shard_map(
@@ -433,7 +579,9 @@ def sort_by_key(keys, values, *, descending: bool = False):
     onto the key runtime, sort natively there, and reshard back.
     EVERY shape is native (round 5): overlapping windows of one
     container compose their blends payload-last, the deterministic
-    order the old sequential fallback used."""
+    order the old sequential fallback used.  The payload itself moves
+    exactly ONCE (round 6): it never rides a sort or the bucket
+    exchange — the rebalanced global-index channel drives one gather."""
     kc = _out_chain(keys)
     vc = _out_chain(values)
     if kc.n != vc.n:
@@ -447,9 +595,9 @@ def sort_by_key(keys, values, *, descending: bool = False):
     same_mesh = kcont.runtime.mesh == vcont.runtime.mesh
     full = (kc.off == 0 and vc.off == 0
             and kc.n == len(kcont) and vc.n == len(vcont)
-            # distributions MAY differ (round 4): the program realigns
-            # the payload to key coordinates on entry and rebalances it
-            # into its own windows on exit
+            # distributions MAY differ (round 4): the rebalanced index
+            # channel lands in the payload's own windows and the gather
+            # honors its ownership — no realignment anywhere
             and same_mesh)
     if kc.n == 0:
         return keys, values
@@ -505,15 +653,18 @@ def sort_n(v, iters: int):
     time then excludes the tunneled per-dispatch overhead.  After the
     first round the data is already sorted — ``lax.sort``'s
     sorting-network cost is data-independent on TPU, so the marginal
-    rounds still price the real program.  Timing aid for bench.py; the
-    final content is simply the sorted input."""
+    rounds still price the real program (on CPU meshes the comparator
+    sorts run FASTER on sorted data; docs/PERF.md round 6 records the
+    gap).  Timing aid for bench.py; the final content is simply the
+    sorted input."""
     chain = _out_chain(v)
     cont = chain.cont
     assert chain.off == 0 and chain.n == len(cont), \
         "sort_n takes a whole container"
     mesh, axis = cont.runtime.mesh, cont.runtime.axis
     key = ("sort_n", pinned_id(mesh), axis, cont.layout,
-           str(cont.dtype), int(iters), bool(jax.config.jax_enable_x64))
+           str(cont.dtype), int(iters), _stable_override(),
+           bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is None:
         one = _sort_program(mesh, axis, cont.layout, cont.dtype, False)
@@ -542,12 +693,74 @@ def sort_by_key_n(keys, values, iters: int):
     mesh, axis = kcont.runtime.mesh, kcont.runtime.axis
     key = ("sortkv_n", pinned_id(mesh), axis, kcont.layout,
            str(kcont.dtype), vcont.layout, str(vcont.dtype), int(iters),
-           bool(jax.config.jax_enable_x64))
+           _stable_override(), bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is None:
         one = _sort_program(mesh, axis, kcont.layout, kcont.dtype,
                             False, pay_layout=vcont.layout,
                             pay_dtype=vcont.dtype)
+
+        def many(kd, vd):
+            return lax.fori_loop(0, iters, lambda _, kv: one(*kv),
+                                 (kd, vd))
+
+        prog = jax.jit(many, donate_argnums=(0, 1))
+        _prog_cache[key] = prog
+    kcont._data, vcont._data = prog(kcont._data, vcont._data)
+    return keys, values
+
+
+def sort_phases_n(v, stop_after, iters: int):
+    """``iters`` chained PHASE-TRUNCATED keys-only sorts in ONE jitted
+    program (profiling aid — see :data:`SORT_PHASES` and
+    ``utils.profiling.profile_phases``).  The container's content after
+    a truncated run is a phase-dependent value mix, NOT a sorted range;
+    use scratch data."""
+    chain = _out_chain(v)
+    cont = chain.cont
+    assert chain.off == 0 and chain.n == len(cont), \
+        "sort_phases_n takes a whole container"
+    mesh, axis = cont.runtime.mesh, cont.runtime.axis
+    key = ("sortph_n", pinned_id(mesh), axis, cont.layout,
+           str(cont.dtype), stop_after, int(iters), _stable_override(),
+           bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        one = _sort_program(mesh, axis, cont.layout, cont.dtype, False,
+                            stop_after=stop_after)
+
+        def many(d):
+            return lax.fori_loop(0, iters, lambda _, x: one(x), d)
+
+        prog = jax.jit(many, donate_argnums=0)
+        _prog_cache[key] = prog
+    cont._data = prog(cont._data)
+    return v
+
+
+def sort_by_key_phases_n(keys, values, stop_after, iters: int):
+    """Key-value twin of :func:`sort_phases_n` (see
+    :data:`SORTKV_PHASES`).  Truncations before the "payload" phase
+    leave the payload container bit-untouched — honest accounting: no
+    earlier phase reads or moves it."""
+    kc = _out_chain(keys)
+    vc = _out_chain(values)
+    kcont, vcont = kc.cont, vc.cont
+    assert (kc.off == 0 and vc.off == 0 and kc.n == len(kcont)
+            and vc.n == len(vcont)
+            and kcont.runtime.mesh == vcont.runtime.mesh), \
+        "sort_by_key_phases_n takes two whole same-mesh containers"
+    mesh, axis = kcont.runtime.mesh, kcont.runtime.axis
+    key = ("sortkvph_n", pinned_id(mesh), axis, kcont.layout,
+           str(kcont.dtype), vcont.layout, str(vcont.dtype),
+           stop_after, int(iters), _stable_override(),
+           bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is None:
+        one = _sort_program(mesh, axis, kcont.layout, kcont.dtype,
+                            False, pay_layout=vcont.layout,
+                            pay_dtype=vcont.dtype,
+                            stop_after=stop_after)
 
         def many(kd, vd):
             return lax.fori_loop(0, iters, lambda _, kv: one(*kv),
@@ -585,9 +798,12 @@ def argsort(r, *, descending: bool = False):
 
 def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None,
                        ops=()):
-    from .elementwise import _op_key
+    # view-chain ops key through _traced_op_key and feed their BoundOp
+    # scalars as TRACED trailing operands (round 6 — the round-5 form
+    # keyed on object identity and baked the values, recompiling per
+    # streamed coefficient; _custom_reduce_program's convention)
     key = ("is_sorted", pinned, axis, layout, str(dtype), window,
-           tuple(_op_key(f) for f in ops),
+           tuple(_traced_op_key(f) for f in ops),
            bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -603,8 +819,9 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None,
         woff_c = jnp.asarray(wstart, jnp.int32)
     starts_c = jnp.asarray(starts, jnp.int32)
     sizes_c = jnp.asarray(sizes, jnp.int32)
+    nsc = sum(len(o.scalars) for o in ops if isinstance(o, _v.BoundOp))
 
-    def body(blk):
+    def body(blk, *scalars):
         r = lax.axis_index(axis)
         if window is None:
             raw = blk[0, prev:prev + S]
@@ -612,8 +829,8 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None,
             idx = jnp.clip(prev + woff_c[r] + jnp.arange(S), 0,
                            width - 1)
             raw = jnp.take(blk[0], idx)
-        for f in ops:  # view-chain op stack, fused (round 5)
-            raw = f(raw)
+        # view-chain op stack, fused (round 5; BoundOp scalars traced)
+        raw = _apply_chain_ops(raw, ops, iter(scalars))
         k, big = _encode(raw)
         nvalid = jnp.minimum(sizes_c[r],
                              jnp.clip(n - starts_c[r], 0, S))
@@ -636,7 +853,8 @@ def _is_sorted_program(mesh, axis, layout, dtype, pinned, window=None,
         ok = jnp.logical_and(local_ok, first_ok)
         return lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
 
-    shmapped = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+    shmapped = jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(axis, None),) + (P(),) * nsc,
                              out_specs=P())
     prog = jax.jit(shmapped)
     _prog_cache[key] = prog
@@ -650,7 +868,9 @@ def is_sorted(r) -> bool:
     distributions) run one fused shard_map program (local vector
     compare + one boundary all_gather; windows in window coordinates —
     round 4; f64 through the exact 64-bit key encoding, and transform-
-    view chains with the op stack fused into the program, round 5)."""
+    view chains with the op stack fused into the program — BoundOp
+    coefficients as traced operands, so streams reuse one program,
+    round 6)."""
     res = _resolve(r)
     if res is not None and len(res) != 1:
         raise TypeError("is_sorted takes a single-component range")
@@ -665,5 +885,6 @@ def is_sorted(r) -> bool:
             cont.dtype, pinned_id(cont.runtime.mesh),
             window=None if full else (chain.off, chain.n),
             ops=chain.ops)
-        return int(prog(cont._data)) == 0
+        svals = [jnp.asarray(s) for s in _chain_scalars([chain])]
+        return int(prog(cont._data, *svals)) == 0
     raise TypeError("is_sorted takes a distributed range")

@@ -1,0 +1,34 @@
+"""jax version portability shims.
+
+The package is written against the current jax surface (top-level
+``jax.shard_map`` with the ``check_vma`` kwarg).  Older jax releases
+(<= 0.4.x, the toolchain this container bakes in) ship the same
+functionality as ``jax.experimental.shard_map.shard_map`` with the
+kwarg spelled ``check_rep``.  :func:`install` bridges the gap in one
+place — every module keeps calling ``jax.shard_map(...)`` — and is a
+no-op on a jax that already has the attribute.
+
+Imported for its side effect at the top of ``dr_tpu/__init__``; safe
+to call repeatedly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kwargs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma,
+                          **kwargs)
+
+    jax.shard_map = shard_map
+
+
+install()

@@ -3,7 +3,7 @@
 The reference has NO tracing/profiling subsystem (SURVEY.md §5:
 "Tracing/profiling: none"); on TPU the right tool is the JAX/XLA
 profiler, so this module is a thin, dependency-light veneer over it
-plus a device-honest timer for the tunneled single-chip environment
+plus device-honest timers for the tunneled single-chip environment
 (see docs/PERF.md "measurement lesson"):
 
 - ``trace(logdir)``: context manager around ``jax.profiler.trace`` —
@@ -13,6 +13,20 @@ plus a device-honest timer for the tunneled single-chip environment
 - ``device_timer(run_sync, r1, r2, samples)``: the marginal method as
   a library utility — per-op device seconds for a fused ``*_n``-style
   callable, with the per-dispatch constant cancelled.
+- ``marginal(...)``: the jitter-proof adaptive variant (bench.py's
+  measurement core as a library API): widens the loop count until the
+  measured delta dominates the tunneled dispatch drift, and raises
+  :class:`JitterError` instead of returning noise.
+- ``profile_phases(make_run, names)``: PHASE-LEVEL breakdown of a fused
+  shard_map program from prefix-truncated variants (round 6).  The
+  program family exposes a ``stop_after`` knob (e.g. the sample-sort's
+  ``_sort_program``) building the same program cut after a named phase;
+  ``make_run(i)`` returns a fused-loop ``run_sync`` for the prefix
+  ending at ``names[i]``.  Each prefix is timed by the marginal method
+  and phase ``i``'s cost is the difference of consecutive prefix
+  times — the per-dispatch constant AND the shared earlier-phase work
+  cancel.  Caveat: truncation changes what XLA can fuse across the cut,
+  so per-phase figures are estimates, not an exact partition.
 """
 
 from __future__ import annotations
@@ -22,7 +36,8 @@ import time
 
 import numpy as np
 
-__all__ = ["trace", "annotate", "device_timer"]
+__all__ = ["trace", "annotate", "device_timer", "marginal",
+           "JitterError", "PhaseBreakdown", "profile_phases"]
 
 
 @contextlib.contextmanager
@@ -43,6 +58,24 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def _interleaved_delta(run_sync, ra: int, rb: int,
+                       samples: int) -> float:
+    """The marginal method's measurement core: interleave ``samples``
+    timings of the ra-round and rb-round fused loops and divide the
+    median difference by rb - ra (the per-dispatch constant cancels).
+    Shared by :func:`device_timer` and :func:`marginal` — ONE copy of
+    the discipline."""
+    t1s, t2s = [], []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        run_sync(ra)
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_sync(rb)
+        t2s.append(time.perf_counter() - t0)
+    return (float(np.median(t2s)) - float(np.median(t1s))) / (rb - ra)
+
+
 def device_timer(run_sync, r1: int = 4, r2: int = 36,
                  samples: int = 5) -> float:
     """Per-op device seconds for a fused-loop callable by the marginal
@@ -51,15 +84,141 @@ def device_timer(run_sync, r1: int = 4, r2: int = 36,
     host-dispatch constant — large and drifting on tunneled backends —
     cancels in the r2-r1 difference.  See the ``*_n`` family
     (``dot_n``, ``inclusive_scan_n``, ``ring_attention_n``, ``gemv_n``,
-    ``span_halo.exchange_n``) for ready-made fused loops."""
+    ``span_halo.exchange_n``) for ready-made fused loops.  For a
+    jitter-proof adaptive variant use :func:`marginal`."""
     for r in (r1, r2):
         run_sync(r)  # compile + warm
-    t1s, t2s = [], []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        run_sync(r1)
-        t1s.append(time.perf_counter() - t0)
+    return _interleaved_delta(run_sync, r1, r2, samples)
+
+
+class JitterError(RuntimeError):
+    """Measurement (not kernel) failure from :func:`marginal`: the
+    widened delta still drowned in the per-dispatch jitter."""
+
+
+def marginal(run_sync, r1: int = 4, r2: int = 36, samples: int = 5,
+             min_spread: float = 0.3, rmax: int = 4096) -> float:
+    """Device-side per-op seconds by the MARGINAL method (the library
+    form of ``bench._marginal`` — docs/PERF.md "measurement lesson"):
+    time a fused loop of r1 ops and one of r2 ops (each dispatched once
+    and synced once), interleaved, and divide the median difference by
+    r2 - r1.  The tunneled per-dispatch constant — large and drifting
+    (tens of ms) — cancels in the difference.
+
+    ADAPTIVE: the difference only means anything once it dominates the
+    dispatch jitter.  After a pilot estimate, if (r2-r1) * dt falls
+    under ``min_spread`` seconds the loop count is widened (one extra
+    compile — fori_loop compile time is iteration-count independent)
+    until the measured delta is jitter-proof; a delta that STILL stays
+    an order of magnitude under the threshold raises
+    :class:`JitterError` instead of returning noise."""
+    def once(ra, rb):
+        return _interleaved_delta(run_sync, ra, rb, samples)
+
+    run_sync(r1)  # compile + warm
+    run_sync(r2)
+    dt = once(r1, r2)
+    # min_spread <= 0 disables the adaptive widening entirely (test
+    # harnesses pin the loop counts for determinism)
+    if min_spread > 0 and (r2 - r1) * dt < min_spread:
+        # pilot was noise-level (possibly <= 0): widen so the true delta
+        # would exceed min_spread even if the op is ~10x faster than the
+        # noisy pilot suggests.  t_warm/r2 overestimates per-op time (it
+        # still contains the dispatch constant), so the ~3 s budget cap
+        # it implies is conservative.
         t0 = time.perf_counter()
         run_sync(r2)
-        t2s.append(time.perf_counter() - t0)
-    return (float(np.median(t2s)) - float(np.median(t1s))) / (r2 - r1)
+        t_warm = time.perf_counter() - t0
+        per = max(dt, min_spread / 10.0 / rmax)
+        cap = max(r2, int(3.0 * r2 / max(t_warm, 1e-3)))
+        r2w = min(rmax, cap, r1 + max(2 * (r2 - r1),
+                                      int(np.ceil(min_spread / per))))
+        if r2w > r2:
+            run_sync(r2w)  # compile + warm the widened loop
+            dt = once(r1, r2w)
+            r2 = r2w
+    if dt <= 0 or (r2 - r1) * dt < min_spread / 10.0:
+        raise JitterError("marginal measurement drowned in dispatch "
+                          f"jitter (dt={dt:.3e} s/op over "
+                          f"{r2 - r1} ops)")
+    return dt
+
+
+class PhaseBreakdown:
+    """Per-phase seconds of a fused program, from cumulative prefix
+    timings (:func:`profile_phases`).  ``seconds`` maps phase name to
+    its marginal cost (clamped at 0 — timing noise can order two
+    near-identical prefixes backwards); ``total`` is the LAST prefix's
+    cumulative per-op time (the full program)."""
+
+    def __init__(self, names, cumulative):
+        assert len(names) == len(cumulative) and names
+        self.names = tuple(names)
+        self.cumulative = tuple(float(c) for c in cumulative)
+        per = []
+        prev = 0.0
+        for c in self.cumulative:
+            per.append(max(0.0, c - prev))
+            prev = max(prev, c)
+        self.seconds = dict(zip(self.names, per))
+        self.total = self.cumulative[-1]
+
+    @property
+    def dominant(self) -> str:
+        """The costliest phase's name."""
+        return max(self.names, key=lambda nm: self.seconds[nm])
+
+    def fractions(self) -> dict:
+        """Phase share of the total (0 when the total itself is 0)."""
+        tot = sum(self.seconds.values())
+        return {nm: (self.seconds[nm] / tot if tot > 0 else 0.0)
+                for nm in self.names}
+
+    def detail(self, bytes_per_op: float, digits: int = 3) -> dict:
+        """Bench-JSON form: per-phase effective GB/s for a program
+        moving ``bytes_per_op`` logical bytes per fused iteration
+        (phases that measured ~0 report 0.0, not inf)."""
+        out = {}
+        for nm in self.names:
+            s = self.seconds[nm]
+            out[nm] = round(bytes_per_op / s / 1e9, digits) if s > 0 \
+                else 0.0
+        return out
+
+    def table(self, bytes_per_op: float = None) -> str:
+        """Human-readable per-phase table (tune_tpu.py output)."""
+        tot = sum(self.seconds.values()) or 1.0
+        lines = []
+        for nm in self.names:
+            s = self.seconds[nm]
+            line = f"  {nm:<12s} {s * 1e3:9.3f} ms  {s / tot:6.1%}"
+            if bytes_per_op is not None and s > 0:
+                line += f"  {bytes_per_op / s / 1e9:8.2f} GB/s"
+            lines.append(line)
+        lines.append(f"  {'total':<12s} {self.total * 1e3:9.3f} ms")
+        return "\n".join(lines)
+
+
+def profile_phases(make_run, names, r1: int = 2, r2: int = 10,
+                   samples: int = 5, min_spread: float = 0.3,
+                   rmax: int = 4096) -> PhaseBreakdown:
+    """Phase breakdown of a fused program from prefix truncations.
+
+    ``make_run(i)`` must return a ``run_sync(r)`` callable executing
+    ``r`` fused iterations of the program truncated after phase
+    ``names[i]`` (the last name being the FULL program) and hard-sync.
+    Each prefix is timed by :func:`marginal`; per-phase cost is the
+    difference of consecutive prefixes.  A prefix whose measurement
+    drowns in jitter (:class:`JitterError`) is recorded at its
+    predecessor's cumulative time (phase cost 0) rather than failing
+    the whole breakdown."""
+    cum = []
+    for i in range(len(names)):
+        run = make_run(i)
+        try:
+            dt = marginal(run, r1=r1, r2=r2, samples=samples,
+                          min_spread=min_spread, rmax=rmax)
+        except JitterError:
+            dt = cum[-1] if cum else 0.0
+        cum.append(dt)
+    return PhaseBreakdown(names, cum)

@@ -53,7 +53,8 @@ def _clear_tuning_knobs(monkeypatch):
                 "DR_TPU_MM_CHUNK_CAP", "DR_TPU_MM_BAND_COLS",
                 "DR_TPU_FLASH_BQ", "DR_TPU_FLASH_BK",
                 "DR_TPU_FLASH_STREAM", "DR_TPU_MM_PRECISION",
-                "DR_TPU_GATHER_W", "DR_TPU_DOT_IMPL"):
+                "DR_TPU_GATHER_W", "DR_TPU_DOT_IMPL",
+                "DR_TPU_SORT_STABLE"):
         monkeypatch.delenv(var, raising=False)
 
 

@@ -206,6 +206,92 @@ def test_profiling_device_timer_and_annotate():
         float(dot_n(a, b, 1))
 
 
+def test_profiling_marginal_widens_and_raises(monkeypatch):
+    """utils.profiling.marginal (the bench measurement core as a
+    library API): fast ops widen their loop count; a measurement with
+    zero marginal cost raises the typed JitterError."""
+    from dr_tpu.utils import profiling
+
+    class _FakeOp:
+        def __init__(self, per_op, constant=0.01):
+            self.per_op, self.constant = per_op, constant
+            self.clock = [0.0]
+            self.calls = []
+
+        def __call__(self, r):
+            self.calls.append(r)
+            self.clock[0] += self.constant + self.per_op * r
+
+    op = _FakeOp(per_op=1e-4)
+    monkeypatch.setattr(profiling.time, "perf_counter",
+                        lambda: op.clock[0])
+    dt = profiling.marginal(op, r1=4, r2=36, samples=3,
+                            min_spread=0.3, rmax=4096)
+    assert dt == pytest.approx(1e-4, rel=1e-6)
+    assert max(op.calls) > 36  # widened beyond the pilot loop count
+    noise = _FakeOp(per_op=0.0)
+    monkeypatch.setattr(profiling.time, "perf_counter",
+                        lambda: noise.clock[0])
+    with pytest.raises(profiling.JitterError):
+        profiling.marginal(noise, r1=4, r2=36, samples=3,
+                           min_spread=0.3, rmax=4096)
+
+
+def test_profiling_phase_breakdown_math(monkeypatch):
+    """profile_phases: cumulative prefix times become per-phase costs
+    (clamped at 0 on noise inversions); jitter-drowned prefixes record
+    a zero-cost phase instead of failing the breakdown."""
+    from dr_tpu.utils import profiling
+    cums = [0.010, 0.014, 0.013, None, 0.040]  # None -> JitterError
+    names = ("a", "b", "c", "d", "e")
+
+    def fake_marginal(run, **kw):
+        v = cums[run]
+        if v is None:
+            raise profiling.JitterError("noise")
+        return v
+
+    monkeypatch.setattr(profiling, "marginal", fake_marginal)
+    bd = profiling.profile_phases(lambda i: i, names, r1=2, r2=6)
+    assert bd.total == pytest.approx(0.040)
+    assert bd.seconds["a"] == pytest.approx(0.010)
+    assert bd.seconds["b"] == pytest.approx(0.004)
+    assert bd.seconds["c"] == 0.0            # inversion clamps to 0
+    assert bd.seconds["d"] == 0.0            # jitter-drowned prefix
+    assert bd.seconds["e"] == pytest.approx(0.040 - 0.014)
+    assert bd.dominant == "e"
+    det = bd.detail(bytes_per_op=4e9)
+    assert det["a"] == pytest.approx(400.0)  # 4 GB / 10 ms
+    assert det["c"] == 0.0
+    assert "total" in bd.table(4e9)
+    fr = bd.fractions()
+    assert sum(fr.values()) == pytest.approx(1.0)
+
+
+def test_profiling_phases_on_sort_program():
+    """End-to-end: profile_phases over the sample-sort truncation
+    ladder on the CPU mesh returns a breakdown with every phase named
+    (timings themselves are noise at this scale — min_spread=0 keeps
+    the harness deterministic)."""
+    from dr_tpu.algorithms.sort import SORT_PHASES, sort_phases_n
+    from dr_tpu.utils import profiling
+    n = 64 * dr_tpu.nprocs()
+    rng = np.random.default_rng(3)
+    v = dr_tpu.distributed_vector.from_array(
+        rng.standard_normal(n).astype(np.float32))
+
+    def mk(i):
+        def run(r):
+            sort_phases_n(v, SORT_PHASES[i], r)
+            float(dr_tpu.to_numpy(v)[0])
+        return run
+
+    bd = profiling.profile_phases(mk, SORT_PHASES, r1=1, r2=3,
+                                  samples=1, min_spread=0.0)
+    assert bd.names == SORT_PHASES
+    assert all(s >= 0 for s in bd.seconds.values())
+
+
 def test_transform_scalar_args_reuse_program():
     """Trailing transform scalars are traced: two calls with different
     values share ONE cached program (the CG-loop pattern)."""

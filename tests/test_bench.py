@@ -52,3 +52,13 @@ def test_bench_json_contract():
     # secondary configs must each report a number or a tagged error
     for cfg in ("dot", "scan", "heat2d", "spmv", "sort"):
         assert any(k.startswith(cfg) for k in d), f"no {cfg} field"
+    # the sort phase breakdown (round 6) rides the sort config: either
+    # the ladder (p>1), the honest p=1 collapse, or its own tagged
+    # error (independently guarded like every config)
+    if "sort_gbps" in d:
+        assert "sort_phases_gbps" in d or "sort_phases_error" in d, \
+            "missing detail.sort_phases_gbps"
+        if "sort_phases_gbps" in d:
+            assert "sort_phase_dominant" in d
+            assert all(vv >= 0
+                       for vv in d["sort_phases_gbps"].values())

@@ -339,3 +339,47 @@ def test_fallbacks_warn_once(monkeypatch):
                                          MaterializeFallbackWarning)]
     assert len(hits) == 1, [str(r.message) for r in rec]
     assert "multi-component or host" in str(hits[0].message).lower()
+
+
+def _fuzz_unclassified(a, b):
+    return a + b + a * b * np.float32(0.125)
+
+
+def test_reduce_multicomponent_custom_op_warns(monkeypatch):
+    """Round-6 satellite (ADVICE r5): a custom-op reduce over a
+    MULTI-component distributed chain (transform over zip) still
+    materializes — it must announce the cliff once, like the scan
+    catch-all, and still produce the serial result."""
+    import warnings as w
+    from dr_tpu.utils import fallback
+    from dr_tpu.utils.fallback import MaterializeFallbackWarning
+    from dr_tpu.views import views
+    monkeypatch.setattr(fallback, "_seen", set())
+    monkeypatch.delenv("DR_TPU_SILENCE_FALLBACKS", raising=False)
+    n = 20
+    rng = np.random.default_rng(2)
+    a_src = rng.uniform(0.5, 1.5, n).astype(np.float32)
+    b_src = rng.uniform(0.5, 1.5, n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(a_src)
+    b = dr_tpu.distributed_vector.from_array(b_src)
+    z = views.transform(views.zip_view(a, b), lambda x, y: x * y)
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        got = dr_tpu.reduce(z, op=_fuzz_unclassified)
+        dr_tpu.reduce(z, op=_fuzz_unclassified)  # once only
+    hits = [r for r in rec if issubclass(r.category,
+                                         MaterializeFallbackWarning)]
+    assert len(hits) == 1, [str(r.message) for r in rec]
+    assert "multi-component custom-op" in str(hits[0].message)
+    acc = np.float32(a_src[0] * b_src[0])
+    for x in (a_src[1:] * b_src[1:]):
+        acc = _fuzz_unclassified(acc, np.float32(x))
+    np.testing.assert_allclose(got, acc, rtol=1e-3)
+
+    # the SINGLE-chain custom-op route stays native and silent
+    monkeypatch.setattr(fallback, "_seen", set())
+    with w.catch_warnings(record=True) as rec2:
+        w.simplefilter("always")
+        dr_tpu.reduce(a, op=_fuzz_unclassified)
+    assert not [r for r in rec2 if issubclass(
+        r.category, MaterializeFallbackWarning)]

@@ -728,3 +728,158 @@ def test_fuzz_round5_window_shapes(seed):
 
 
 _CUSTOM_MUL = lambda a, b: a * b * 1.0  # defined once: program reuse
+
+
+def _fuzz_shift(x, mu):
+    """Monotone BoundOp for the is_sorted view-chain arm."""
+    return x + mu
+
+
+def _np_is_sorted(a):
+    """numpy-order sortedness oracle (NaNs largest, ties fine)."""
+    return np.array_equal(np.sort(a), a, equal_nan=True)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_sort_family(seed):
+    """Round-6 sort-family arm (tools/fuzz_crank.sh): random geometry,
+    dtypes, NaNs, tie density, windows, mixed distributions, and
+    aliased window pairs through sort / sort_by_key / argsort /
+    is_sorted vs numpy oracles — the crank discipline that caught real
+    bugs in rounds 4 and 5, pointed at the restructured single-exchange
+    hot path.  CI default runs ITERS // 2 per seed (each iteration
+    compiles fresh geometry — the heaviest arm in the file); cranks set
+    DR_TPU_FUZZ_ITERS explicitly (tools/fuzz_crank.sh 300
+    sort_family)."""
+    rng = np.random.default_rng(800 + seed)
+    P = dr_tpu.nprocs()
+
+    def dist(n):
+        if P < 2 or not rng.integers(0, 2):
+            return None
+        cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+        b = np.concatenate(([0], cuts, [n]))
+        return tuple(int(y - x) for x, y in zip(b[:-1], b[1:]))
+
+    def mkvec(src, d):
+        if d is None:
+            return dr_tpu.distributed_vector.from_array(src)
+        return dr_tpu.distributed_vector.from_array(src, distribution=d)
+
+    def keysrc(n):
+        kind = int(rng.integers(0, 3))
+        if kind == 0:
+            src = rng.standard_normal(n).astype(np.float32)
+            if rng.integers(0, 4) == 0:
+                src[rng.integers(0, n, size=max(1, n // 8))] = np.nan
+            return src
+        if kind == 1:  # heavy ties: the stability surface
+            return rng.integers(0, 5, n).astype(np.float32)
+        return rng.integers(-40, 40, n).astype(np.int32)
+
+    iters = ITERS if "DR_TPU_FUZZ_ITERS" in os.environ else ITERS // 2
+    for it in range(iters):
+        n = int(rng.integers(1, 170))
+        desc = bool(rng.integers(0, 2))
+        case = str(rng.choice(["sort", "sort_win", "kv", "kv_win",
+                               "kv_alias", "argsort", "is_sorted"]))
+        tag = f"{case} n={n} desc={desc} it={it}"
+        if case == "sort":
+            src = keysrc(n)
+            v = mkvec(src, dist(n))
+            dr_tpu.sort(v, descending=desc)
+            ref = np.sort(src)
+            np.testing.assert_array_equal(
+                dr_tpu.to_numpy(v), ref[::-1] if desc else ref,
+                err_msg=tag)
+        elif case == "sort_win":
+            src = keysrc(n)
+            b = int(rng.integers(0, n))
+            e = int(rng.integers(b, n + 1))
+            v = mkvec(src, dist(n))
+            dr_tpu.sort(v[b:e], descending=desc)
+            ref = src.copy()
+            w = np.sort(src[b:e])
+            ref[b:e] = w[::-1] if desc else w
+            np.testing.assert_array_equal(dr_tpu.to_numpy(v), ref,
+                                          err_msg=tag)
+        elif case in ("kv", "kv_win"):
+            k = keysrc(n)
+            pay = (np.arange(n, dtype=np.int32)
+                   if rng.integers(0, 2)
+                   else rng.standard_normal(n).astype(np.float32))
+            kd = mkvec(k, dist(n))
+            vd = mkvec(pay, dist(n))  # distributions MAY differ
+            if case == "kv":
+                dr_tpu.sort_by_key(kd, vd, descending=desc)
+                order = np.argsort(k, kind="stable")
+                if desc:
+                    order = order[::-1]
+                np.testing.assert_array_equal(dr_tpu.to_numpy(kd),
+                                              k[order], err_msg=tag)
+                np.testing.assert_array_equal(dr_tpu.to_numpy(vd),
+                                              pay[order], err_msg=tag)
+            else:
+                wn = int(rng.integers(1, n + 1))
+                ka = int(rng.integers(0, n - wn + 1))
+                va = int(rng.integers(0, n - wn + 1))
+                dr_tpu.sort_by_key(kd[ka:ka + wn], vd[va:va + wn],
+                                   descending=desc)
+                order = np.argsort(k[ka:ka + wn], kind="stable")
+                if desc:
+                    order = order[::-1]
+                kref = k.copy()
+                kref[ka:ka + wn] = k[ka:ka + wn][order]
+                pref = pay.copy()
+                pref[va:va + wn] = pay[va:va + wn][order]
+                np.testing.assert_array_equal(dr_tpu.to_numpy(kd),
+                                              kref, err_msg=tag)
+                np.testing.assert_array_equal(dr_tpu.to_numpy(vd),
+                                              pref, err_msg=tag)
+        elif case == "kv_alias":
+            # two windows of ONE container: disjoint, nested,
+            # overlapping, or equal — blends compose payload-last
+            src = rng.standard_normal(n).astype(np.float32)
+            wn = int(rng.integers(1, n + 1))
+            ka = int(rng.integers(0, n - wn + 1))
+            va = int(rng.integers(0, n - wn + 1))
+            x = mkvec(src, dist(n))
+            dr_tpu.sort_by_key(x[ka:ka + wn], x[va:va + wn],
+                               descending=desc)
+            order = np.argsort(src[ka:ka + wn], kind="stable")
+            if desc:
+                order = order[::-1]
+            ref = src.copy()
+            ref[ka:ka + wn] = src[ka:ka + wn][order]
+            ref[va:va + wn] = src[va:va + wn][order]
+            np.testing.assert_array_equal(dr_tpu.to_numpy(x), ref,
+                                          err_msg=tag)
+        elif case == "argsort":
+            src = keysrc(n)
+            v = mkvec(src, dist(n))
+            idx = dr_tpu.argsort(v, descending=desc)
+            order = np.argsort(src, kind="stable")
+            if desc:
+                order = order[::-1]
+            np.testing.assert_array_equal(dr_tpu.to_numpy(idx), order,
+                                          err_msg=tag)
+            # the input is untouched
+            np.testing.assert_array_equal(dr_tpu.to_numpy(v), src,
+                                          err_msg=tag)
+        else:  # is_sorted, whole + windowed + view chain
+            src = np.sort(keysrc(n))
+            if rng.integers(0, 2) and n > 1:
+                src[int(rng.integers(0, n))] = src.min() - 1 \
+                    if np.isfinite(src.min()) else np.float32(0)
+            v = mkvec(src, dist(n))
+            got = dr_tpu.is_sorted(v)
+            assert got == _np_is_sorted(src), tag
+            b = int(rng.integers(0, n))
+            e = int(rng.integers(b, n + 1))
+            assert dr_tpu.is_sorted(v[b:e]) == _np_is_sorted(src[b:e]), \
+                tag
+            # monotone BoundOp chain: sortedness is invariant, and the
+            # streamed coefficient must reuse one program (round 6)
+            mu = float(rng.standard_normal())
+            assert dr_tpu.is_sorted(
+                views.transform(v, _fuzz_shift, mu)) == got, tag

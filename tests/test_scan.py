@@ -548,3 +548,66 @@ def test_scan_length_mismatch_is_clear():
     np.testing.assert_array_equal(got[8:], np.full(12, -1.0, np.float32))
     with pytest.raises(ValueError, match="too small"):
         dr_tpu.inclusive_scan(a, out[0:4])
+
+
+def test_scan_mismatched_window_never_takes_kernel(monkeypatch):
+    """ADVICE r5 HIGH regression: the mismatched-window route forces
+    window-coordinate geometry whose per-shard slice length is not
+    lane-aligned — the Pallas chunked_cumsum would assert at trace
+    time.  Even with the kernel gate claiming eligibility (as it does
+    on TPU for an add-monoid f32 uniform container), the mis_ok route
+    must build the XLA program."""
+    import dr_tpu.algorithms.scan as scan_mod
+    from dr_tpu.ops import scan_pallas
+
+    def boom(*a, **k):
+        raise AssertionError("Pallas kernel taken on the "
+                             "mismatched-window scan route")
+    monkeypatch.setattr(scan_mod, "_use_scan_kernel",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(scan_pallas, "chunked_cumsum", boom)
+    n = 61
+    src = np.random.default_rng(61).standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector.from_array(0.0 * src)
+    wn = 40
+    dr_tpu.inclusive_scan(a[3:3 + wn], out[9:9 + wn])  # olo != ilo
+    ref = 0.0 * src
+    ref[9:9 + wn] = np.cumsum(src[3:3 + wn], dtype=np.float64)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_scan_streamed_boundop_zero_recompile():
+    """Round-6 compile-churn fix: fused view-chain BoundOps key on op
+    identity + scalar COUNT and feed values as traced operands, so a
+    loop streaming coefficients through a scan pipeline reuses ONE
+    compiled program (the _custom_reduce_program convention)."""
+    from dr_tpu.algorithms.elementwise import _prog_cache
+    from dr_tpu import views
+    n = 48
+    src = np.random.default_rng(7).standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    out = dr_tpu.distributed_vector(n, np.float32)
+
+    def run(mu):
+        dr_tpu.inclusive_scan(
+            views.transform(a, _scaled_shift, mu), out)
+        return dr_tpu.to_numpy(out)
+
+    first = run(0.5)
+    np.testing.assert_allclose(
+        first, np.cumsum(src + np.float32(0.5), dtype=np.float64),
+        rtol=1e-4, atol=1e-5)
+    n_progs = len(_prog_cache)
+    for mu in (0.25, -1.5, 3.0):
+        got = run(mu)
+        np.testing.assert_allclose(
+            got, np.cumsum(src + np.float32(mu), dtype=np.float64),
+            rtol=1e-4, atol=1e-5)
+    assert len(_prog_cache) == n_progs, \
+        "streamed BoundOp coefficients recompiled the scan program"
+
+
+def _scaled_shift(x, mu):
+    return x + mu

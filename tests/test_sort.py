@@ -835,3 +835,85 @@ def test_is_sorted_view_chain_native(monkeypatch):
         wv = dr_tpu.distributed_vector.from_array(w)
         assert not dr_tpu.is_sorted(views.transform(wv, lambda x: x * 2.0))
     monkeypatch.undo()
+
+
+def _shift_op(x, mu):
+    return x + mu
+
+
+def test_is_sorted_streamed_boundop_zero_recompile():
+    """Round-6 compile-churn fix (the scan twin): is_sorted over a
+    BoundOp transform chain keys on op identity + scalar count and
+    feeds the coefficient traced — a streamed-coefficient loop builds
+    ZERO new programs after the first call."""
+    from dr_tpu.algorithms.elementwise import _prog_cache
+    from dr_tpu.views import views
+    src = np.arange(40, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    assert dr_tpu.is_sorted(views.transform(v, _shift_op, 0.5))
+    n_progs = len(_prog_cache)
+    for mu in (0.25, -1.5, 3.0, 7.25):
+        assert dr_tpu.is_sorted(views.transform(v, _shift_op, mu))
+    assert len(_prog_cache) == n_progs, \
+        "streamed BoundOp coefficients recompiled is_sorted"
+
+
+def test_sort_phase_truncations_chain_and_complete():
+    """Round-6 profiling surface: every stop_after prefix of the
+    keys-only program builds, runs as a fused loop, and keeps the
+    container shape; the full prefix (the last phase name) IS the
+    real sort."""
+    from dr_tpu.algorithms.sort import (SORT_PHASES, sort_phases_n)
+    n = 96
+    src = np.random.default_rng(5).standard_normal(n).astype(np.float32)
+    for phase in SORT_PHASES[:-1]:
+        v = dr_tpu.distributed_vector.from_array(src)
+        sort_phases_n(v, phase, 2)
+        got = dr_tpu.to_numpy(v)
+        assert got.shape == (n,) and got.dtype == np.float32
+    v = dr_tpu.distributed_vector.from_array(src)
+    sort_phases_n(v, SORT_PHASES[-1], 2)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src))
+
+
+def test_sortkv_phase_truncations_leave_payload_untouched():
+    """Truncations before the "payload" phase must leave the payload
+    container bit-identical — the single-exchange plan's accounting
+    claim (no earlier phase reads or moves the payload) made
+    testable."""
+    from dr_tpu.algorithms.sort import (SORTKV_PHASES,
+                                        sort_by_key_phases_n)
+    n = 80
+    rng = np.random.default_rng(6)
+    k = rng.standard_normal(n).astype(np.float32)
+    pay = rng.standard_normal(n).astype(np.float32)
+    for phase in SORTKV_PHASES[:-1]:
+        kd = dr_tpu.distributed_vector.from_array(k)
+        vd = dr_tpu.distributed_vector.from_array(pay)
+        sort_by_key_phases_n(kd, vd, phase, 2)
+        np.testing.assert_array_equal(dr_tpu.to_numpy(vd), pay,
+                                      err_msg=f"phase={phase}")
+    kd = dr_tpu.distributed_vector.from_array(k)
+    vd = dr_tpu.distributed_vector.from_array(pay)
+    sort_by_key_phases_n(kd, vd, SORTKV_PHASES[-1], 2)
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(kd), k[order])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), pay[order])
+
+
+def test_sort_stable_override_env(monkeypatch):
+    """DR_TPU_SORT_STABLE=1 (the tune A/B knob) still sorts correctly
+    and builds its own cached programs."""
+    monkeypatch.setenv("DR_TPU_SORT_STABLE", "1")
+    n = 120
+    rng = np.random.default_rng(8)
+    src = rng.integers(0, 6, n).astype(np.float32)
+    pay = np.arange(n, dtype=np.int32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.sort(v)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), np.sort(src))
+    kd = dr_tpu.distributed_vector.from_array(src)
+    pd = dr_tpu.distributed_vector.from_array(pay)
+    dr_tpu.sort_by_key(kd, pd)
+    order = np.argsort(src, kind="stable")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(pd), pay[order])

@@ -10,18 +10,32 @@
 # iterations in its own process passes).  Per-function processes
 # bound the compile count and make a crash attributable to ONE arm.
 #
-# Usage: tools/fuzz_crank.sh [iters]    (default 300)
+# Usage: tools/fuzz_crank.sh [iters] [filter]    (default 300, all arms)
+#
+# [filter] cranks only arms whose node id matches the substring — e.g.
+# `tools/fuzz_crank.sh 300 sort_family` runs the round-6 sort-family
+# arm (sort / sort_by_key / argsort / is_sorted, the restructured
+# single-exchange plan included) at the full 300-iteration discipline.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
+FILTER=${2:-}
 nodes=$(python -m pytest tests/test_fuzz.py --collect-only -q 2>/dev/null \
         | grep "::" | cut -d"[" -f1 | sort -u)
 if [ -z "$nodes" ]; then
   # a broken collection (import/syntax error) must NOT read as a clean
   # crank that ran zero arms
   echo "FAILED: test collection produced no fuzz arms" >&2
-  python -m pytest tests/test_fuzz.py --collect-only -q >&2 | tail -5
+  python -m pytest tests/test_fuzz.py --collect-only -q 2>&1 | tail -5 >&2
   exit 2
+fi
+if [ -n "$FILTER" ]; then
+  nodes=$(printf '%s\n' $nodes | grep -- "$FILTER")
+  if [ -z "$nodes" ]; then
+    # collection was fine — the FILTER just matched nothing (typo?)
+    echo "FAILED: no fuzz arm matches filter '$FILTER'" >&2
+    exit 2
+  fi
 fi
 rc=0
 for nd in $nodes; do

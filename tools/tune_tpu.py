@@ -370,14 +370,25 @@ def tune_sort():
     """Size ladder for the sample-sort family (sort_n / sort_by_key_n
     fused loops): records where the collective phases amortize — the
     on-chip row for docs/PERF.md (the reference has no sort to compare
-    against; the bar is the repo's own advertised surface)."""
+    against; the bar is the repo's own advertised surface).
+
+    Round 6: each size also prints the PHASE LADDER (per-phase ms and
+    share via the stop_after truncations + utils.profiling), an A/B of
+    the stable-comparator override (DR_TPU_SORT_STABLE), and the
+    key-value ladder at the top size — so the staged TPU tuning starts
+    from an understood shape instead of one opaque number."""
     import jax
     import dr_tpu
     dr_tpu.init()
     P = dr_tpu.nprocs()
-    from dr_tpu.algorithms.sort import sort_by_key_n, sort_n
+    from dr_tpu.algorithms.sort import (SORT_PHASES, SORTKV_PHASES,
+                                        sort_by_key_n,
+                                        sort_by_key_phases_n, sort_n,
+                                        sort_phases_n)
+    from dr_tpu.utils import profiling
     rng = np.random.default_rng(3)
-    for logn in (18, 20, 22, 24):
+    sizes = (18, 20, 22, 24)
+    for logn in sizes:
         n = (2 ** logn) // P * P
         try:
             v = dr_tpu.distributed_vector(n, np.float32)
@@ -389,6 +400,45 @@ def tune_sort():
             dt = _marginal(run, 2, 10)
             print(f"sort n=2^{logn}: {n / dt / 1e6:.1f} Mkeys/s "
                   f"({n * 4 / dt / 1e9:.2f} GB/s)", flush=True)
+
+            # stable-comparator A/B (the unstable default won round 6
+            # on sorted/structured inputs; re-confirm on each chip).
+            # Restore the operator's own setting afterwards — a sweep
+            # run entirely under DR_TPU_SORT_STABLE=1 must stay stable.
+            prior = os.environ.get("DR_TPU_SORT_STABLE")
+            os.environ["DR_TPU_SORT_STABLE"] = "1"
+            try:
+                dt_s = _marginal(run, 2, 10)
+                print(f"sort n=2^{logn} [stable]: "
+                      f"{n / dt_s / 1e6:.1f} Mkeys/s", flush=True)
+            except Exception as e:
+                print(f"sort n=2^{logn} [stable]: FAIL {_errline(e)}",
+                      flush=True)
+            finally:
+                if prior is None:
+                    os.environ.pop("DR_TPU_SORT_STABLE", None)
+                else:
+                    os.environ["DR_TPU_SORT_STABLE"] = prior
+
+            if P == 1:
+                # the single-chip deployment: no collective phases —
+                # every truncation IS the full program, so a ladder
+                # would print pure dispatch noise (bench.py makes the
+                # same collapse)
+                print(f"sort n=2^{logn} phase ladder: p=1 — "
+                      "collective phases collapse; sort IS the local "
+                      "XLA sort", flush=True)
+            else:
+                def mk(i):
+                    def runp(r):
+                        sort_phases_n(v, SORT_PHASES[i], r)
+                        float(v[0])
+                    return runp
+                bd = profiling.profile_phases(mk, SORT_PHASES,
+                                              r1=2, r2=10)
+                print(f"sort n=2^{logn} phase ladder:\n"
+                      + bd.table(n * 4.0), flush=True)
+
             kd = dr_tpu.distributed_vector(n, np.float32)
             kd.assign_array(rng.standard_normal(n).astype(np.float32))
             pd = dr_tpu.distributed_vector(n, np.int32)
@@ -400,6 +450,17 @@ def tune_sort():
             dt = _marginal(run_kv, 2, 10)
             print(f"sort_by_key n=2^{logn}: {n / dt / 1e6:.1f} Mpairs/s "
                   f"({2 * n * 4 / dt / 1e9:.2f} GB/s)", flush=True)
+            if logn == sizes[-1] and P > 1:
+                def mkv(i):
+                    def runp(r):
+                        sort_by_key_phases_n(kd, pd, SORTKV_PHASES[i],
+                                             r)
+                        float(kd[0])
+                    return runp
+                bdk = profiling.profile_phases(mkv, SORTKV_PHASES,
+                                               r1=2, r2=10)
+                print(f"sort_by_key n=2^{logn} phase ladder:\n"
+                      + bdk.table(2 * n * 4.0), flush=True)
         except Exception as e:
             print(f"sort n=2^{logn}: FAIL {_errline(e)}", flush=True)
         finally:
