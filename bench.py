@@ -593,49 +593,37 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
 
 
 def _relay_listening() -> bool:
-    """Claim-free reachability check of the loopback tunnel relay: a TCP
-    connect costs nothing server-side, unlike a jax claim.  Gates the
-    retry leg — when the relay is not even listening (a down/restarting
-    relay, vs a wedged claim path), a second claim cannot succeed and
-    the CPU fallback should run immediately.  A connect TIMEOUT (a
-    SYN-dropping/firewalled relay — the half-dead state rounds 2/3
-    hit) also counts as not-listening, since a claim against it would
-    just burn the probe watchdog; truly unknown errors still count as
-    listening so an unusual relay config never disables the retry.
-    DR_TPU_RELAY_UNKNOWN=down flips that last policy for ops use."""
-    import socket
-    port = int(os.environ.get("DR_TPU_RELAY_PROBE_PORT", "8082"))
-    s = socket.socket()
-    s.settimeout(3)
-    try:
-        s.connect(("127.0.0.1", port))
-        return True
-    except (ConnectionRefusedError, socket.timeout, TimeoutError):
-        return False
-    except Exception:
-        return os.environ.get("DR_TPU_RELAY_UNKNOWN", "up") != "down"
-    finally:
-        s.close()
+    """Claim-free reachability check of the loopback tunnel relay (ONE
+    copy for the whole repo: utils/resilience.relay_listening — shared
+    with ``entry()``/``dryrun_multichip`` and ``tools/tune_tpu.py``).
+    Kept as a module global so tests monkeypatch bench's policy alone."""
+    from dr_tpu.utils import resilience
+    return resilience.relay_listening()
 
 
 def _dead_relay() -> bool:
     """True when the tunneled (axon) platform is in play but its relay
     is not even listening — a state where no claim can be served and
     probing only burns the caller's timeout budget."""
-    import jax
-    return ("axon" in str(getattr(jax.config, "jax_platforms", ""))
-            and not _relay_listening())
+    from dr_tpu.utils import resilience
+    return resilience.dead_relay(listening=_relay_listening)
 
 
-def _exec_cpu_fallback(err: str):
+def _exec_cpu_fallback(err: str, *, probe_s: float = 0.0,
+                       retries: int = 0):
     """Re-exec this benchmark with the CPU platform forced and the
-    degraded cause recorded — the single exit ramp for every
-    dead-relay / failed-probe path."""
+    degradation STORY recorded — the single exit ramp for every
+    dead-relay / failed-probe path.  The story (fallback reason,
+    original probe error, retry count, probe wall time) rides the env
+    into the child so the tagged CPU run's JSON carries it
+    (resilience.degradation_story), not only stderr."""
     print(f"device init failed ({err}); re-running on CPU",
           file=sys.stderr)
     env = dict(os.environ)
     env["_DR_TPU_BENCH_CPU_FALLBACK"] = "1"
     env["_DR_TPU_BENCH_DEGRADED"] = err
+    env["_DR_TPU_BENCH_RETRIES"] = str(retries)
+    env["_DR_TPU_BENCH_PROBE_S"] = f"{probe_s:.3f}"
     env["JAX_PLATFORMS"] = "cpu"
     # keep the CLI (--phases) across the re-exec
     os.execve(sys.executable,
@@ -644,41 +632,42 @@ def _exec_cpu_fallback(err: str):
 
 
 def _devices_or_die(timeout_s: float):
-    """First backend touch via runtime.probe_devices: a recorded result
-    beats the eternal hang a wedged tunnel relay produces.
+    """First backend touch through the SHARED degradation router
+    (resilience.route_first_touch over runtime.probe_devices): a
+    recorded result beats the eternal hang a wedged tunnel relay
+    produces.  The router owns the policy; bench owns the exec
+    mechanics its decisions map onto:
 
-    On probe failure with the relay still LISTENING (wedged claim path,
-    not a dead relay — see _relay_listening), retry ONCE in a fresh
-    process after a cool-down (round-3 probe tallies show single claims
-    failing where a later one lands instantly; a hung claim blocks the
-    singleton PJRT init lock, so an in-process retry would just join
-    the hang).  If the retry also fails — or the relay is down — re-exec
-    with the CPU platform forced: an honest smoke number with
-    ``detail.device = cpu`` and ``detail.degraded`` naming the cause
-    still beats a zero.  The child sets the platform before backend
-    init, so its probe returns immediately; if even that fails, record
-    the error and exit.  Worst-case init time stays bounded: timeout_s
-    + cooldown + min(timeout_s, retry timeout) — defaults 420 + 45 +
-    240 s.  The cool-down runs in the RETRY child (after the exec that
-    killed the first, possibly mid-claim, client), so the server-side
-    grant gets the whole gap to expire before the fresh claim.
+    * ``"ok"``    -> return the probed devices.
+    * ``"retry"`` -> probe failed with the relay still LISTENING
+      (wedged claim path): retry ONCE in a fresh process after a
+      cool-down (round-3 probe tallies show single claims failing
+      where a later one lands instantly; a hung claim blocks the
+      singleton PJRT init lock, so an in-process retry would just
+      join the hang).
+    * ``"cpu"``   -> dead relay, or the retry leg failed too: re-exec
+      with the CPU platform forced — an honest smoke number with
+      ``detail.device = cpu`` and ``detail.degraded`` naming the cause
+      still beats a zero.  The child sets the platform before backend
+      init, so its probe returns immediately; if even that fails,
+      record the error and exit.
+
+    Worst-case init time stays bounded: timeout_s + cooldown +
+    min(timeout_s, retry timeout) — defaults 420 + 45 + 240 s.  The
+    cool-down runs in the RETRY child (after the exec that killed the
+    first, possibly mid-claim, client), so the server-side grant gets
+    the whole gap to expire before the fresh claim.
     """
     from dr_tpu.parallel.runtime import probe_devices
+    from dr_tpu.utils.resilience import (degradation_story,
+                                         route_first_touch)
 
-    if os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
+    retried = bool(os.environ.get("_DR_TPU_BENCH_RETRY"))
+    cpu_child = bool(os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"))
+    if cpu_child:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    elif not os.environ.get("_DR_TPU_BENCH_RETRY"):
-        # DEAD relay (nothing listening): skip the doomed first probe
-        # entirely — its watchdog would burn the whole timeout_s of the
-        # driver's budget for a claim that cannot be served.  Gated on
-        # the axon platform being in play so a directly attached TPU is
-        # unaffected.
-        if _dead_relay():
-            _exec_cpu_fallback("relay not listening (TCP check); "
-                               "probe skipped, retry skipped")
-    if os.environ.get("_DR_TPU_BENCH_RETRY") \
-            and not os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
+    elif retried:
         # Cool down HERE, in the fresh child, before its first claim:
         # the exec that spawned this process killed the first probe's
         # (possibly mid-claim) client, and the server-side grant needs
@@ -689,33 +678,39 @@ def _devices_or_die(timeout_s: float):
         timeout_s = min(timeout_s,
                         float(os.environ.get("DR_TPU_BENCH_RETRY_TIMEOUT",
                                              "240")))
-    devs, err = probe_devices(timeout_s)
-    if devs is not None:
-        return devs
-    if not os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"):
+    ft = route_first_touch(timeout_s, retried=retried or cpu_child,
+                           probe=probe_devices, is_dead=_dead_relay,
+                           listening=_relay_listening)
+    if ft.decision == "ok":
+        return ft.devices
+    prior_s = float(os.environ.get("_DR_TPU_BENCH_PROBE_S", "0") or 0.0)
+    if ft.decision == "retry":
+        print(f"device init failed ({ft.err}); retrying once in a "
+              "fresh process after a cool-down", file=sys.stderr)
         env = dict(os.environ)
-        if not os.environ.get("_DR_TPU_BENCH_RETRY") \
-                and _relay_listening():
-            print(f"device init failed ({err}); retrying once in a "
-                  "fresh process after a cool-down", file=sys.stderr)
-            env["_DR_TPU_BENCH_RETRY"] = "1"
-            env["_DR_TPU_BENCH_FIRST_ERR"] = err
-        else:
-            if os.environ.get("_DR_TPU_BENCH_RETRY"):
-                first = os.environ.get("_DR_TPU_BENCH_FIRST_ERR", "")
-                if first and first != err:
-                    err = f"{err}; first attempt: {first}"
-                err = f"retry failed: {err}"
-            else:
-                err = f"{err}; relay not listening, retry skipped"
-            _exec_cpu_fallback(err)
+        env["_DR_TPU_BENCH_RETRY"] = "1"
+        env["_DR_TPU_BENCH_FIRST_ERR"] = ft.err
+        env["_DR_TPU_BENCH_PROBE_S"] = f"{ft.probe_wall_s:.3f}"
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)]
                   + sys.argv[1:], env)
-    detail = {"error": err}
-    if os.environ.get("_DR_TPU_BENCH_DEGRADED"):
+    if not cpu_child:
+        err = ft.err
+        if retried:
+            first = os.environ.get("_DR_TPU_BENCH_FIRST_ERR", "")
+            if first and first != err:
+                err = f"{err}; first attempt: {first}"
+            err = f"retry failed: {err}"
+        elif not ft.probe_skipped:
+            err = f"{err}; relay not listening, retry skipped"
+        _exec_cpu_fallback(err, probe_s=prior_s + ft.probe_wall_s,
+                           retries=1 if retried else 0)
+    # even the CPU child could not init: record the error and exit
+    detail = {"error": ft.err}
+    story = degradation_story()
+    if story:
         # keep the original TPU-side cause alongside the child's error
-        detail["degraded"] = os.environ["_DR_TPU_BENCH_DEGRADED"]
+        detail["degraded"] = story
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
         "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
@@ -783,6 +778,12 @@ def main():
     peak = _peak_for(dev)
     target = 0.7 * peak
 
+    # tagged CPU fallback: the full degradation story (reason, original
+    # probe error, retry count, probe wall time) survives into the
+    # artifact, not only stderr
+    from dr_tpu.utils.resilience import degradation_story
+    story = degradation_story()
+
     secondary = {}
     if os.environ.get("DR_TPU_BENCH_SECONDARY", "1") != "0":
         # --phases (or DR_TPU_BENCH_PHASES=1): add the key-value sort
@@ -802,8 +803,7 @@ def main():
             "device": str(dev), "peak_hbm_gbps": peak,
             "phys_gbps": round(res["phys_gbps"] / nchips, 2),
             "target_gbps": round(target, 1),
-            **({"degraded": os.environ["_DR_TPU_BENCH_DEGRADED"]}
-               if os.environ.get("_DR_TPU_BENCH_DEGRADED") else {}),
+            **({"degraded": story} if story else {}),
             **secondary,
         },
     }))

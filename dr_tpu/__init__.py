@@ -47,7 +47,9 @@ from .containers.mdarray import (distributed_mdarray, distributed_mdspan,
 from .utils.logging import drlog
 from .utils.debug import print_range, print_matrix, range_details
 from .utils import checkpoint
+from .utils import faults
 from .utils import profiling
+from .utils import resilience
 from .utils import spmd_guard
 from .ops.ring_attention import ring_attention, ring_attention_n
 from .views import views
@@ -91,7 +93,7 @@ __all__ = [
     "init_distributed", "distributed_span",
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
-    "checkpoint", "profiling", "spmd_guard",
+    "checkpoint", "profiling", "spmd_guard", "faults", "resilience",
     "ring_attention", "ring_attention_n",
     "dot_n", "inclusive_scan_n", "gemv_n", "spmm_n", "stencil2d_n",
 ]

@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import runtime as _rt
 from ..core.pinning import pinned_id
+from ..utils import faults as _faults
 from ..utils.spmd_guard import TappedCache
 
 __all__ = ["communicator", "rma_window", "default_comm", "init_distributed"]
@@ -106,6 +107,7 @@ class communicator:
         return self._shift(arr, -1, periodic)
 
     def _shift(self, arr, direction: int, periodic: bool) -> jax.Array:
+        _faults.fire("collectives.shift")
         rt = self._rt
         n = self.size
         if direction > 0:
@@ -131,6 +133,7 @@ class communicator:
     def alltoall(self, arr) -> jax.Array:
         """lax.all_to_all over the mesh axis: arr (nshards, nshards, ...)
         sharded on axis 0; block (i, j) moves to shard j."""
+        _faults.fire("collectives.alltoall")
         rt = self._rt
         key = ("a2a", pinned_id(rt.mesh), arr.shape[1:], str(arr.dtype))
         prog = _shift_cache.get(key)

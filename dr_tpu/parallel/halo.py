@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 from ..core.pinning import pinned_id
+from ..utils import faults as _faults
 from ..utils.spmd_guard import TappedCache
 
 __all__ = ["halo_bounds", "span_halo", "halo_ops"]
@@ -367,6 +368,10 @@ class span_halo:
         hb = dv.halo_bounds
         if hb.width == 0 or dv.nshards == 0:
             return
+        # injection sites fire BEFORE the dispatch: a faulted exchange
+        # never enqueues, so the container's value stays consistent
+        _faults.fire("halo.reduce" if kind == "reduce"
+                     else "halo.exchange")
         prog = _cached(kind, dv.runtime.mesh, dv.runtime.axis, dv.nshards,
                        dv.segment_size, hb.prev, hb.next, hb.periodic,
                        len(dv), op)
@@ -384,6 +389,7 @@ class span_halo:
         hb = dv.halo_bounds
         if hb.width == 0 or dv.nshards == 0 or iters <= 0:
             return
+        _faults.fire("halo.exchange")
         prog = _cached("exchange_n", dv.runtime.mesh, dv.runtime.axis,
                        dv.nshards, dv.segment_size, hb.prev, hb.next,
                        hb.periodic, len(dv), None, iters)

@@ -27,6 +27,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import faults as _faults
+from ..utils import resilience as _resilience
+
 __all__ = [
     "init",
     "final",
@@ -129,32 +132,30 @@ _runtime: Optional[Runtime] = None
 
 
 def probe_devices(timeout_s: float):
-    """First backend touch behind a watchdog thread: ``(devices, None)``
-    on success, ``(None, error_repr_or_timeout_message)`` otherwise.
+    """First backend touch behind a watchdog (resilience.with_deadline):
+    ``(devices, None)`` on success, ``(None, error_repr_or_timeout)``
+    otherwise.
 
     A wedged tunnel relay makes ``jax.devices()`` block forever inside
     the PJRT client (observed when an earlier client died mid-claim and
     the chip's server-side grant had not expired).  Callers decide the
     policy — fail fast, record an error artifact, or fall back to a
-    virtual mesh; this helper only guarantees the probe terminates."""
-    import threading
-
-    box = {}
-
-    def probe():
-        try:
-            box["devices"] = jax.devices()
-        except Exception as e:  # pragma: no cover - backend specific
-            box["error"] = repr(e)[:200]
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" in box:
-        return box["devices"], None
-    return None, box.get(
-        "error", f"device init exceeded {timeout_s:.0f}s "
-        "(wedged tunnel relay?)")
+    virtual mesh; this helper only guarantees the probe terminates.
+    Injection site ``runtime.probe`` (utils/faults) makes both failure
+    legs exercisable on the CPU mesh."""
+    try:
+        _faults.fire("runtime.probe")
+        # dump=False: a probe timeout is a ROUTED decision (retry / CPU
+        # fallback), not a hang needing a dispatch postmortem — no
+        # guard is active this early anyway
+        return _resilience.with_deadline(
+            jax.devices, timeout_s, site="runtime.probe",
+            dump=False), None
+    except _resilience.DeadlineExpired:
+        return None, (f"device init exceeded {timeout_s:.0f}s "
+                      "(wedged tunnel relay?)")
+    except Exception as e:  # pragma: no cover - backend specific
+        return None, repr(e)[:200]
 
 
 def get_duplicated_devices(n: int, devices: Optional[Sequence] = None):
@@ -186,6 +187,7 @@ def init(
     shp/util.hpp:119-136 — see tests/conftest.py).
     """
     global _runtime
+    _faults.fire("runtime.init")
     if devices is None:
         devices = jax.devices()
     devices = list(devices)

@@ -6,15 +6,94 @@ containers round-trip through a single ``.npz`` per object (logical value
 + layout metadata).  In multi-process runs every process calls save()
 (collective: materialization gathers), only process 0 writes, and load()
 rebuilds the same sharded layout on every process.
+
+Failure model (docs/SPEC.md "Failure model & recovery"):
+
+* save() is ATOMIC: the archive is written to a same-directory temp
+  file, fsync'd, and ``os.replace``'d into place — a process killed
+  mid-write leaves either the previous checkpoint or nothing, never a
+  torn file.  ``meta`` carries a ``format_version`` so future layout
+  changes stay detectable.
+* load() raises :class:`~.resilience.CheckpointCorruptError` (a
+  classified ProgramError) on truncated/corrupt/newer-format files —
+  never a raw zipfile traceback.
+* Injection sites ``checkpoint.write`` / ``checkpoint.read``
+  (utils/faults) exercise both paths on the CPU mesh; the behavioral
+  ``truncate`` kind leaves the torn file a NON-atomic writer would
+  have, so the corrupt-load leg has a live regression test.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import zlib
+import zipfile
 
 import numpy as np
 
-__all__ = ["save", "load"]
+from . import faults as _faults
+from .resilience import CheckpointCorruptError
+
+__all__ = ["save", "load", "FORMAT_VERSION"]
+
+#: bump on any incompatible meta/arrays layout change; load() accepts
+#: anything <= this (absent = 0, the pre-versioned round-6 format).
+FORMAT_VERSION = 1
+
+
+def _member(f, fname: str, name: str):
+    """Read one archive member, classifying corruption NARROWLY: the
+    surrounding load() body raises intentional ValueErrors (mesh/layout
+    mismatches) that must keep their class, so only the member read
+    itself maps onto CheckpointCorruptError (a zip-intact archive whose
+    .npy bytes were overwritten raises ValueError from np.lib.format)."""
+    try:
+        return f[name]
+    except KeyError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {fname} is missing member {name!r}",
+            site="checkpoint.read") from e
+    except (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+            ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {fname} member {name!r} is corrupt: {e}",
+            site="checkpoint.read") from e
+
+
+def _final_path(path) -> str:
+    """np.savez appends .npz to bare paths; with the atomic temp-file
+    protocol WE control the name, so normalize once here (load accepts
+    both spellings, as before)."""
+    p = str(path)
+    return p if p.endswith(".npz") else p + ".npz"
+
+
+def _write_atomic(final: str, meta: dict, arrays: dict) -> None:
+    """Write the archive to ``final`` via temp file + fsync + rename.
+    The ``checkpoint.write`` injection site fires between the write and
+    the rename: exception kinds abort with the destination untouched
+    (what atomicity buys); the behavioral ``truncate`` kind installs a
+    torn file — the state a mid-stream kill leaves a NON-atomic writer
+    in — so load()'s corrupt-file classification stays regression-
+    tested."""
+    tmp = final + ".tmp"
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, meta=json.dumps(meta), **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
+        kind = _faults.fire("checkpoint.write", path=final)
+        if kind == "truncate":
+            with open(tmp, "r+b") as fh:
+                fh.truncate(max(1, os.path.getsize(tmp) // 2))
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def save(path: str, container) -> None:
@@ -55,11 +134,12 @@ def save(path: str, container) -> None:
         }
     else:
         raise TypeError(f"cannot checkpoint {type(container).__name__}")
+    meta["format_version"] = FORMAT_VERSION
 
     err = None
     if jax.process_index() == 0:
         try:
-            np.savez(path, meta=json.dumps(meta), **arrays)
+            _write_atomic(_final_path(path), meta, arrays)
         except Exception as e:  # must still reach the collective below
             err = e
     if jax.process_count() > 1:
@@ -85,39 +165,66 @@ def load(path: str, *, runtime=None):
     from ..containers.mdarray import distributed_mdarray
     from ..parallel.halo import halo_bounds
 
-    with np.load(path if str(path).endswith(".npz") else f"{path}.npz",
-                 allow_pickle=False) as f:
-        meta = json.loads(str(f["meta"]))
-        kind = meta["kind"]
-        if kind == "vector":
-            prev, nxt, periodic = meta["halo"]
-            hb = halo_bounds(int(prev), int(nxt), bool(periodic)) \
-                if (prev or nxt) else None
-            sizes = meta.get("sizes")
-            if sizes is not None:
-                from ..parallel import runtime as _rt
-                P = (runtime or _rt.runtime()).nprocs
-                if len(sizes) != P:
-                    raise ValueError(
-                        f"checkpointed block_distribution has {len(sizes)} "
-                        f"blocks but the current mesh has {P} shards; "
-                        "re-save without an explicit distribution to "
-                        "re-block on load")
-            return distributed_vector.from_array(f["data"], halo=hb,
-                                                 distribution=sizes,
-                                                 runtime=runtime)
-        if kind == "dense_matrix":
-            part = _matrix_partition(meta, runtime, cyclic_ok=True)
-            return dense_matrix.from_array(f["data"], part,
-                                           runtime=runtime)
-        if kind == "mdarray":
-            return distributed_mdarray.from_array(f["data"],
-                                                  runtime=runtime)
-        if kind == "sparse_matrix":
-            part = _matrix_partition(meta, runtime, cyclic_ok=False)
-            return sparse_matrix.from_coo(tuple(meta["shape"]), f["rows"],
-                                          f["cols"], f["vals"],
-                                          partition=part, runtime=runtime)
+    fname = _final_path(path)
+    _faults.fire("checkpoint.read", path=fname)
+    try:
+        f = np.load(fname, allow_pickle=False)
+    except (zipfile.BadZipFile, zlib.error, EOFError, ValueError) as e:
+        # a truncated/torn archive; FileNotFoundError stays itself
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint {fname}: {e}",
+            site="checkpoint.read") from e
+    with f:
+        try:
+            meta = json.loads(str(_member(f, fname, "meta")))
+            kind = meta["kind"]
+            version = int(meta.get("format_version", 0))
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {fname} has no readable meta record: {e}",
+                site="checkpoint.read") from e
+        if version > FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                f"checkpoint {fname} written by a newer dr_tpu "
+                f"(format_version={version} > {FORMAT_VERSION}); "
+                "upgrade to load it", site="checkpoint.read")
+        try:
+            if kind == "vector":
+                prev, nxt, periodic = meta["halo"]
+                hb = halo_bounds(int(prev), int(nxt), bool(periodic)) \
+                    if (prev or nxt) else None
+                sizes = meta.get("sizes")
+                if sizes is not None:
+                    from ..parallel import runtime as _rt
+                    P = (runtime or _rt.runtime()).nprocs
+                    if len(sizes) != P:
+                        raise ValueError(
+                            f"checkpointed block_distribution has "
+                            f"{len(sizes)} blocks but the current mesh "
+                            f"has {P} shards; re-save without an "
+                            "explicit distribution to re-block on load")
+                return distributed_vector.from_array(
+                    _member(f, fname, "data"), halo=hb,
+                    distribution=sizes, runtime=runtime)
+            if kind == "dense_matrix":
+                part = _matrix_partition(meta, runtime, cyclic_ok=True)
+                return dense_matrix.from_array(
+                    _member(f, fname, "data"), part, runtime=runtime)
+            if kind == "mdarray":
+                return distributed_mdarray.from_array(
+                    _member(f, fname, "data"), runtime=runtime)
+            if kind == "sparse_matrix":
+                part = _matrix_partition(meta, runtime, cyclic_ok=False)
+                return sparse_matrix.from_coo(
+                    tuple(meta["shape"]), _member(f, fname, "rows"),
+                    _member(f, fname, "cols"), _member(f, fname, "vals"),
+                    partition=part, runtime=runtime)
+        except (zipfile.BadZipFile, zlib.error, EOFError) as e:
+            # the archive opened but a member is torn (a non-atomic
+            # writer's legacy, or the injected 'truncate' kind)
+            raise CheckpointCorruptError(
+                f"checkpoint {fname} is truncated/corrupt: {e}",
+                site="checkpoint.read") from e
     raise ValueError(f"unknown checkpoint kind: {kind}")
 
 

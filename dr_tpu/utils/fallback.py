@@ -19,6 +19,8 @@ from __future__ import annotations
 import os
 import warnings
 
+from . import faults as _faults
+
 _seen: set = set()
 
 
@@ -26,9 +28,21 @@ class MaterializeFallbackWarning(UserWarning):
     """An operation left its fused fast path for a materialized run."""
 
 
+def reset() -> None:
+    """Clear the once-per-site memory so tests (and long-lived servers
+    that want a fresh warning epoch) see each fallback announce itself
+    again."""
+    _seen.clear()
+
+
 def warn_fallback(op: str, reason: str) -> None:
     """Warn (once per site) that ``op`` is materializing because of
-    ``reason``.  Cheap on the hot path: a set lookup after the first."""
+    ``reason``.  Cheap on the hot path: a set lookup after the first.
+    Every call — silenced or repeated — routes through the
+    ``fallback.warn`` fault-registry site first, so a chaos run counts
+    materialize fallbacks (a degraded-but-correct outcome) instead of
+    losing them to the once-per-site budget."""
+    _faults.fire("fallback.warn", op=op, reason=reason)
     key = (op, reason)
     if key in _seen:
         return

@@ -1,0 +1,298 @@
+"""Deterministic fault-injection registry.
+
+Every failure path the resilience layer (utils/resilience.py) routes is
+exercisable on the 8-device CPU mesh: instrumented modules call
+:func:`fire` at NAMED injection sites; when an injection is armed for
+that site, the registered fault class is raised (or, for behavioral
+kinds like ``truncate``, returned for the site to act on).  With
+nothing armed, ``fire`` is a single module-global check — noise next to
+a program dispatch.
+
+Registered sites (the chaos sweep — tests/test_chaos.py,
+``tools/fuzz_crank.sh`` chaos arm — iterates this table):
+
+===================  ============================  =======================
+site                 where it fires                kinds
+===================  ============================  =======================
+runtime.probe        runtime.probe_devices          transient, relay_down
+runtime.init         runtime.init                   transient, program
+dispatch.cache       every TappedCache lookup       transient, program
+                     (the algorithm dispatch
+                     cache + all module caches)
+collectives.shift    communicator shift_*           transient, oom, program
+collectives.alltoall communicator.alltoall          transient, oom, program
+halo.exchange        span_halo exchange/exchange_n  transient, oom, program
+halo.reduce          span_halo.reduce               transient, oom, program
+checkpoint.write     checkpoint.save (pre-replace)  transient, truncate,
+                                                    program
+checkpoint.read      checkpoint.load                transient, program
+fallback.warn        utils/fallback.warn_fallback   (counting only)
+===================  ============================  =======================
+
+Exception kinds map onto the taxonomy: ``transient`` ->
+TransientBackendError, ``relay_down`` -> RelayDownError, ``oom`` ->
+DeviceOOM (message carries RESOURCE_EXHAUSTED so string-matching
+backoff paths treat it like the real thing), ``program`` ->
+ProgramError.  ``truncate`` is behavioral: checkpoint.save truncates
+the written file — the torn write a mid-stream kill leaves behind.
+
+Spec grammar (``DR_TPU_FAULT_SPEC``, parsed at import; call
+:func:`reload_env` after changing the variable in-process)::
+
+    spec  := entry (';' entry)*            (',' also splits)
+    entry := site ':' kind ['*' times] ['@' after]
+    site  := registered site name, '*' globs allowed
+    times := int or 'inf'   (default 1 — fire once, then pass clean)
+    after := int            (clean passes before the first firing)
+
+Example::
+
+    DR_TPU_FAULT_SPEC="halo.exchange:transient*2;checkpoint.write:truncate@1"
+
+Programmatic API: :func:`inject` / :func:`injected` (context manager) /
+:func:`clear`.  While ANY injection is armed the registry also counts
+site visits (:func:`stats`) — the chaos arm uses this to assert the
+battery actually reached every site, and ``fallback.warn`` exists only
+to be counted.  See docs/SPEC.md "Failure model & recovery".
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from contextlib import contextmanager
+from fnmatch import fnmatchcase
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["fire", "inject", "injected", "clear", "sites", "stats",
+           "parse_spec", "reload_env", "arm_counting", "pending",
+           "EXCEPTION_KINDS", "BEHAVIORAL_KINDS", "SITES"]
+
+#: site -> fault kinds it supports (exception kinds raise at the site;
+#: behavioral kinds are returned from fire() for the site to act on).
+SITES: Dict[str, Tuple[str, ...]] = {
+    "runtime.probe": ("transient", "relay_down"),
+    "runtime.init": ("transient", "program"),
+    "dispatch.cache": ("transient", "program"),
+    "collectives.shift": ("transient", "oom", "program"),
+    "collectives.alltoall": ("transient", "oom", "program"),
+    "halo.exchange": ("transient", "oom", "program"),
+    "halo.reduce": ("transient", "oom", "program"),
+    "checkpoint.write": ("transient", "truncate", "program"),
+    "checkpoint.read": ("transient", "program"),
+    "fallback.warn": (),
+}
+
+EXCEPTION_KINDS = ("transient", "relay_down", "oom", "program")
+BEHAVIORAL_KINDS = ("truncate",)
+_ALL_KINDS = EXCEPTION_KINDS + BEHAVIORAL_KINDS
+
+
+class _Injection:
+    __slots__ = ("site", "kind", "remaining", "skip", "fired")
+
+    def __init__(self, site: str, kind: str, times, after: int):
+        self.site = site
+        self.kind = kind
+        self.remaining = times  # int or None (= unbounded)
+        self.skip = after
+        self.fired = 0
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        times = "inf" if self.remaining is None else self.remaining
+        return (f"_Injection({self.site}:{self.kind}*{times}"
+                f"@{self.skip}, fired={self.fired})")
+
+
+_specs: List[_Injection] = []
+_counts: Dict[str, int] = {}
+_counting = False
+#: hot-path gate: fire() returns immediately unless something is armed
+_armed = False
+
+
+def _rearm() -> None:
+    global _armed
+    _armed = bool(_specs) or _counting
+
+
+def sites() -> Dict[str, Tuple[str, ...]]:
+    """The registered injection-site table (copy)."""
+    return dict(SITES)
+
+
+def stats() -> Dict[str, int]:
+    """Per-site visit counts since the last :func:`clear` (collected
+    only while armed — chaos runs, not production dispatch)."""
+    return dict(_counts)
+
+
+def pending() -> List[str]:
+    """Human-readable list of injections that have not exhausted."""
+    return [repr(s) for s in _specs
+            if s.remaining is None or s.remaining > 0]
+
+
+def arm_counting(on: bool = True) -> None:
+    """Count site visits even with no injection armed (the chaos arm's
+    coverage assertion; ``DR_TPU_FAULT_COUNT=1`` sets this at import)."""
+    global _counting
+    _counting = on
+    _rearm()
+
+
+def inject(site: str, kind: str, *, times: Optional[int] = 1,
+           after: int = 0) -> None:
+    """Arm ``kind`` at ``site`` (glob patterns allowed): the next
+    ``after`` matching visits pass clean, then ``times`` visits fault
+    (``times=None`` = every visit).  Unknown sites/kinds — and kinds no
+    matched site SUPPORTS (e.g. ``truncate`` anywhere but
+    checkpoint.write) — are errors: a typo in a chaos spec must not
+    read as a clean sweep."""
+    if kind not in _ALL_KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; "
+                         f"known: {', '.join(_ALL_KINDS)}")
+    matched = [s for s in SITES if fnmatchcase(s, site)]
+    if not matched:
+        raise ValueError(f"fault site {site!r} matches no registered "
+                         f"site; known: {', '.join(sorted(SITES))}")
+    if not any(kind in SITES[s] for s in matched):
+        raise ValueError(
+            f"fault kind {kind!r} is unsupported at every site matching "
+            f"{site!r} (supported there: "
+            f"{', '.join(sorted(set().union(*(SITES[s] for s in matched))) or ['none'])})")
+    _specs.append(_Injection(site, kind, times, int(after)))
+    _rearm()
+
+
+@contextmanager
+def injected(site: str, kind: str, *, times: Optional[int] = 1,
+             after: int = 0):
+    """Scoped :func:`inject`: the injection is removed on exit (other
+    armed injections are untouched)."""
+    inject(site, kind, times=times, after=after)
+    sp = _specs[-1]
+    try:
+        yield sp
+    finally:
+        try:
+            _specs.remove(sp)
+        except ValueError:  # a clear() inside the block already took it
+            pass
+        _rearm()
+
+
+def clear() -> None:
+    """Disarm every injection and zero the visit counters."""
+    global _counting
+    _specs.clear()
+    _counts.clear()
+    _counting = False
+    _rearm()
+
+
+def fire(site: str, **ctx) -> Optional[str]:
+    """Hot-path hook at a named injection site.
+
+    No-op (one global check) when nothing is armed.  Armed: counts the
+    visit, and if an injection matches, raises its classified exception
+    — or returns the behavioral kind string (e.g. ``"truncate"``) for
+    the site to act on.  Returns None on a clean pass."""
+    if not _armed:
+        return None
+    _counts[site] = _counts.get(site, 0) + 1
+    for sp in _specs:
+        if sp.remaining is not None and sp.remaining <= 0:
+            continue
+        if not fnmatchcase(site, sp.site):
+            continue
+        if sp.kind not in SITES.get(site, ()):
+            continue  # glob spec: fire only where the kind is supported
+        if sp.skip > 0:
+            sp.skip -= 1
+            continue
+        if sp.remaining is not None:
+            sp.remaining -= 1
+        sp.fired += 1
+        return _trigger(site, sp.kind, ctx)
+    return None
+
+
+def _trigger(site: str, kind: str, ctx: dict) -> Optional[str]:
+    from . import resilience as R
+    tag = f"injected fault '{kind}' at site {site}"
+    if ctx:
+        tag += f" ({', '.join(f'{k}={v!r}' for k, v in sorted(ctx.items()))})"
+    if kind == "transient":
+        raise R.TransientBackendError(f"UNAVAILABLE: {tag}", site=site)
+    if kind == "relay_down":
+        raise R.RelayDownError(f"relay not listening: {tag}", site=site)
+    if kind == "oom":
+        raise R.DeviceOOM(f"RESOURCE_EXHAUSTED: {tag}", site=site)
+    if kind == "program":
+        raise R.ProgramError(tag, site=site)
+    return kind  # behavioral: the site acts on it
+
+
+# ---------------------------------------------------------------------------
+# env spec
+# ---------------------------------------------------------------------------
+
+def parse_spec(text: str) -> List[Tuple[str, str, Optional[int], int]]:
+    """Parse the ``DR_TPU_FAULT_SPEC`` grammar into
+    ``(site, kind, times, after)`` tuples.  Raises ValueError on a
+    malformed ENTRY (reload_env downgrades that to a warning so a typo
+    cannot brick an unrelated run, but never silently arms nothing)."""
+    out = []
+    for raw in text.replace(",", ";").split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(f"fault spec entry {entry!r}: expected "
+                             "site:kind[*times][@after]")
+        site, rest = entry.split(":", 1)
+        after = 0
+        if "@" in rest:
+            rest, a = rest.rsplit("@", 1)
+            after = int(a)
+        times: Optional[int] = 1
+        if "*" in rest:
+            rest, t = rest.split("*", 1)
+            times = None if t.strip() == "inf" else int(t)
+        out.append((site.strip(), rest.strip(), times, after))
+    return out
+
+
+def reload_env() -> int:
+    """(Re)install injections from ``DR_TPU_FAULT_SPEC`` (clears any
+    previously armed set first).  Returns the number installed.
+    Malformed entries warn and are skipped — but a spec that arms
+    NOTHING despite being nonempty also warns, so a typo'd chaos run
+    cannot read as a clean sweep."""
+    clear()
+    if os.environ.get("DR_TPU_FAULT_COUNT", "") == "1":
+        arm_counting()
+    text = os.environ.get("DR_TPU_FAULT_SPEC", "")
+    if not text.strip():
+        return 0
+    installed = 0
+    try:
+        entries = parse_spec(text)
+    except ValueError as e:
+        warnings.warn(f"DR_TPU_FAULT_SPEC ignored: {e}", stacklevel=2)
+        return 0
+    for site, kind, times, after in entries:
+        try:
+            inject(site, kind, times=times, after=after)
+            installed += 1
+        except ValueError as e:
+            warnings.warn(f"DR_TPU_FAULT_SPEC entry skipped: {e}",
+                          stacklevel=2)
+    if installed == 0:
+        warnings.warn("DR_TPU_FAULT_SPEC set but armed no injections",
+                      stacklevel=2)
+    return installed
+
+
+reload_env()

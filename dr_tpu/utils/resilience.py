@@ -1,0 +1,409 @@
+"""Classified failure taxonomy + retry/deadline substrate + the shared
+graceful-degradation router for the first backend touch.
+
+Before this module every failure path in the package was ad hoc:
+``bench.py`` hand-rolled its relay TCP probe, probe retry, and tagged
+CPU-fallback re-exec; ``__graft_entry__.py`` carried a second copy;
+``tools/tune_tpu.py`` had none and could hang on a wedged relay.  The
+multi-controller failure literature this build leans on (Mesh-TensorFlow
+arxiv 1811.02084; array-redistribution collectives arxiv 2112.01075)
+assumes exactly one classified-error + retry substrate under every
+collective program — this module is it.
+
+Three layers:
+
+* **Taxonomy** — every backend failure is classified into
+  :class:`TransientBackendError` (a second attempt may land),
+  :class:`RelayDownError` (nothing is listening; retrying burns the
+  caller's budget), :class:`DeviceOOM` (back off the problem size, not
+  the clock), or :class:`ProgramError` (deterministic; retrying is
+  futile).  :func:`classify` maps raw backend error text onto the
+  taxonomy; :func:`classified` wraps an error into its class.
+* **retry / with_deadline** — :func:`retry` runs a callable with
+  exponential backoff and DETERMINISTIC seeded jitter
+  (:func:`backoff_schedule` is a pure function of its arguments, so
+  tests and SPMD processes agree on every delay).  :func:`with_deadline`
+  bounds a possibly-hanging call (first touch, compile) with a watchdog
+  thread; on expiry it dumps the active spmd_guard dispatch trace —
+  the postmortem a silent hang can never give you — and raises
+  :class:`DeadlineExpired`.
+* **Degradation router** — :func:`relay_listening` /
+  :func:`dead_relay` (the claim-free TCP reachability check, moved
+  here from bench.py), :func:`route_first_touch` (the probe/retry/CPU
+  decision bench.py's re-exec chain executes), and
+  :func:`first_touch_or_cpu` (the in-process variant ``entry()`` and
+  ``tools/tune_tpu.py`` share: dead relay -> switch to CPU before
+  backend init; probe failure -> classified error, never a hang).
+
+Fault injection (utils/faults.py) raises these classes at registered
+sites, so every path here is exercisable on the 8-device CPU mesh.
+See docs/SPEC.md "Failure model & recovery".
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+__all__ = [
+    "ResilienceError", "TransientBackendError", "RelayDownError",
+    "DeviceOOM", "ProgramError", "CheckpointCorruptError",
+    "DeadlineExpired", "classify", "classified", "backoff_schedule",
+    "retry", "with_deadline", "dump_dispatch_trace", "relay_listening",
+    "dead_relay", "route_first_touch", "first_touch_or_cpu",
+    "FirstTouch", "degradation_story",
+]
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+class ResilienceError(RuntimeError):
+    """Base of the classified failure taxonomy.  ``site`` names the
+    injection/dispatch site that raised (empty when classified from a
+    raw backend error with no site context)."""
+
+    def __init__(self, message: str, *, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+class TransientBackendError(ResilienceError):
+    """The backend hiccuped (UNAVAILABLE / reset / wedged claim): a
+    later attempt may land — the retryable class."""
+
+
+class RelayDownError(ResilienceError):
+    """The tunnel relay is not even listening: no claim can be served,
+    retrying only burns the caller's timeout budget.  Degrade (CPU
+    fallback) instead of retrying."""
+
+
+class DeviceOOM(ResilienceError):
+    """RESOURCE_EXHAUSTED: back off the problem size, not the clock."""
+
+
+class ProgramError(ResilienceError):
+    """Deterministic program/user error: retrying is futile; surface."""
+
+
+class CheckpointCorruptError(ProgramError):
+    """A checkpoint file is truncated/corrupt/foreign — the classified
+    answer to a torn write (utils/checkpoint.py)."""
+
+
+class DeadlineExpired(ResilienceError):
+    """A watchdogged call overran its deadline (hung first touch /
+    compile).  Raised by :func:`with_deadline` after the dispatch-trace
+    dump; the hung worker thread is left behind (daemon)."""
+
+
+# substring evidence for each class (matched case-insensitively),
+# checked in order: OOM first (its messages often also contain
+# transient-looking words), then relay-down, then the transient bucket;
+# anything else is a program error.  The OOM tokens are ANCHORED
+# ("out of memory", not bench._measure's looser "emory" net): as a
+# global classifier gating retry decisions, a transient error that
+# merely MENTIONS memory must stay retryable.
+_OOM_TOKENS = ("resource_exhausted", "out of memory")
+_RELAY_TOKENS = ("relay not listening", "connection refused",
+                 "econnrefused", "failed to connect")
+# no bare "exceeded": deterministic errors phrase limits that way too
+# ("maximum recursion depth exceeded") and must NOT become retryable;
+# the probe-timeout message matches via "wedged"/"timeout" instead
+_TRANSIENT_TOKENS = ("unavailable", "deadline_exceeded", "aborted",
+                     "socket closed", "connection reset", "wedged",
+                     "timed out", "timeout")
+
+
+def classify(err) -> type:
+    """Map an exception or raw error text onto the taxonomy.  Already
+    classified errors keep their class."""
+    if isinstance(err, ResilienceError):
+        return type(err)
+    text = (err if isinstance(err, str)
+            else f"{type(err).__name__}: {err}").lower()
+    for tokens, cls in ((_OOM_TOKENS, DeviceOOM),
+                        (_RELAY_TOKENS, RelayDownError),
+                        (_TRANSIENT_TOKENS, TransientBackendError)):
+        if any(t in text for t in tokens):
+            return cls
+    return ProgramError
+
+
+def classified(err, *, site: str = "") -> ResilienceError:
+    """Return ``err`` as a taxonomy instance: pass-through when already
+    classified, else wrap (keeping the original as ``__cause__``)."""
+    if isinstance(err, ResilienceError):
+        if site and not err.site:
+            err.site = site
+        return err
+    cls = classify(err)
+    msg = err if isinstance(err, str) else f"{type(err).__name__}: {err}"
+    out = cls(msg, site=site)
+    if isinstance(err, BaseException):
+        out.__cause__ = err
+    return out
+
+
+# ---------------------------------------------------------------------------
+# retry with deterministic backoff
+# ---------------------------------------------------------------------------
+
+def backoff_schedule(attempts: int, *, base: float = 0.05,
+                     factor: float = 2.0, max_delay: float = 30.0,
+                     jitter: float = 0.25, seed: int = 0) -> list:
+    """Exponential backoff delays with DETERMINISTIC jitter: a pure
+    function of its arguments (seeded ``random.Random``), so tests — and
+    SPMD processes sharing a seed — reproduce every delay exactly.
+    Jitter multiplies each delay by a factor in [1-jitter, 1+jitter]."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(max(0, attempts)):
+        d = min(max_delay, base * (factor ** i))
+        out.append(d * (1.0 + jitter * (2.0 * rng.random() - 1.0)))
+    return out
+
+
+def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
+          factor: float = 2.0, max_delay: float = 30.0,
+          jitter: float = 0.25, seed: int = 0,
+          retry_on: Sequence[type] = (TransientBackendError,),
+          sleep: Callable = time.sleep, on_retry: Callable = None):
+    """Run ``fn()`` with classified retries.
+
+    Every raised error is classified first; only instances of
+    ``retry_on`` classes are retried (default: transients only — a dead
+    relay or an OOM must be routed, not hammered).  Delays come from
+    :func:`backoff_schedule`, so the whole timing story is deterministic
+    given ``seed``.  ``on_retry(attempt_index, error, delay)`` observes
+    each retry.  The final failure is re-raised CLASSIFIED."""
+    if attempts < 1:
+        # a config-derived attempts=0 must fail loudly, not silently
+        # skip the protected call and hand back None
+        raise ValueError(f"retry needs attempts >= 1, got {attempts}")
+    delays = backoff_schedule(attempts - 1, base=base, factor=factor,
+                              max_delay=max_delay, jitter=jitter, seed=seed)
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            ce = classified(e)
+            if i == attempts - 1 or not isinstance(ce, tuple(retry_on)):
+                if ce is e:
+                    raise  # already classified: keep its cause chain
+                raise ce from e
+            if on_retry is not None:
+                on_retry(i, ce, delays[i])
+            sleep(delays[i])
+
+
+# ---------------------------------------------------------------------------
+# deadline watchdog + dispatch-trace escalation
+# ---------------------------------------------------------------------------
+
+def dump_dispatch_trace(file=None, limit: int = 40) -> int:
+    """Print the tail of the active spmd_guard dispatch trace — the
+    information a hang postmortem cannot give you (which program the
+    process was enqueueing when it stopped making progress).  Returns
+    the number of entries printed (0 when no guard is active)."""
+    from . import spmd_guard
+    file = file or sys.stderr
+    g = spmd_guard.active()
+    if g is None or not g.trace:
+        print("resilience: no active spmd_guard dispatch trace "
+              "(run inside spmd_guard.guard() for a dispatch postmortem)",
+              file=file)
+        return 0
+    tail = g.trace[-limit:]
+    start = len(g.trace) - len(tail)
+    print(f"resilience: last {len(tail)} of {len(g.trace)} recorded "
+          "dispatches before the deadline expired:", file=file)
+    for i, entry in enumerate(tail, start=start):
+        print(f"  [{i}] {entry}", file=file)
+    return len(tail)
+
+
+def with_deadline(fn: Callable, timeout_s: float, *, site: str = "",
+                  dump: bool = True, file=None):
+    """Run ``fn()`` under a watchdog: its value (or its exception) when
+    it finishes within ``timeout_s``; :class:`DeadlineExpired` — after
+    an spmd_guard dispatch-trace dump — when it hangs.  The worker is a
+    daemon thread, so a truly wedged call (a PJRT claim against a dead
+    relay) cannot pin process exit."""
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # must cross the thread boundary
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        if dump:
+            dump_dispatch_trace(file)
+        name = site or getattr(fn, "__name__", "call")
+        raise DeadlineExpired(
+            f"{name} exceeded its {timeout_s:.1f}s deadline "
+            "(hung first touch / compile?)", site=site)
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# ---------------------------------------------------------------------------
+# relay reachability (moved here from bench.py — one copy, three callers)
+# ---------------------------------------------------------------------------
+
+def relay_listening() -> bool:
+    """Claim-free reachability check of the loopback tunnel relay: a TCP
+    connect costs nothing server-side, unlike a jax claim.  Gates the
+    retry leg — when the relay is not even listening (a down/restarting
+    relay, vs a wedged claim path), a second claim cannot succeed and
+    the CPU fallback should run immediately.  A connect TIMEOUT (a
+    SYN-dropping/firewalled relay — the half-dead state rounds 2/3
+    hit) also counts as not-listening, since a claim against it would
+    just burn the probe watchdog; truly unknown errors still count as
+    listening so an unusual relay config never disables the retry.
+    ``DR_TPU_RELAY_UNKNOWN=down`` flips that last policy for ops use."""
+    import socket
+    port = int(os.environ.get("DR_TPU_RELAY_PROBE_PORT", "8082"))
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", port))
+        return True
+    except (ConnectionRefusedError, socket.timeout, TimeoutError):
+        return False
+    except Exception:
+        return os.environ.get("DR_TPU_RELAY_UNKNOWN", "up") != "down"
+    finally:
+        s.close()
+
+
+def dead_relay(listening: Optional[Callable] = None) -> bool:
+    """True when the tunneled (axon) platform is in play but its relay
+    is not even listening — a state where no claim can be served and
+    probing only burns the caller's timeout budget.  ``listening``
+    overrides the reachability check (bench.py threads its
+    monkeypatchable module global through here)."""
+    import jax
+    return ("axon" in str(getattr(jax.config, "jax_platforms", ""))
+            and not (listening or relay_listening)())
+
+
+# ---------------------------------------------------------------------------
+# first-touch degradation router
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FirstTouch:
+    """Decision record of one first-backend-touch attempt.
+
+    ``decision``:
+
+    * ``"ok"``    — devices probed; carry on.
+    * ``"retry"`` — probe failed but the relay still listens (wedged
+      claim path): retry once in a FRESH process (an in-process retry
+      would join the hang on jax's singleton init lock).
+    * ``"cpu"``   — unrecoverable here (dead relay, or the retry leg
+      failed too): degrade to a tagged CPU run.
+    """
+
+    decision: str
+    devices: Optional[list] = None
+    err: Optional[str] = None
+    probe_wall_s: float = 0.0
+    probe_skipped: bool = False
+
+
+#: the degradation reason used whenever the dead-relay fast path fires
+RELAY_DOWN_REASON = "relay not listening (TCP check)"
+
+
+def route_first_touch(timeout_s: float, *, retried: bool = False,
+                      probe: Optional[Callable] = None,
+                      is_dead: Optional[Callable] = None,
+                      listening: Optional[Callable] = None) -> FirstTouch:
+    """ONE probe/degradation decision, shared by bench.py (which maps it
+    onto its re-exec chain), ``entry()`` and ``tools/tune_tpu.py``
+    (which map it onto in-process CPU fallback / classified errors).
+
+    * Dead relay and not yet retried -> ``"cpu"`` without spending the
+      probe timeout (the watchdog would burn the whole budget for a
+      claim that cannot be served).
+    * Probe success -> ``"ok"`` (with the probe wall time recorded for
+      the degradation story).
+    * First failure with the relay still listening -> ``"retry"``.
+    * Anything else -> ``"cpu"``.
+    """
+    if probe is None:
+        from ..parallel import runtime as _rt
+        probe = _rt.probe_devices
+    is_dead = is_dead or (lambda: dead_relay(listening))
+    if not retried and is_dead():
+        return FirstTouch(
+            "cpu", err=f"{RELAY_DOWN_REASON}; probe skipped, retry skipped",
+            probe_skipped=True)
+    t0 = time.perf_counter()
+    devs, err = probe(timeout_s)
+    wall = round(time.perf_counter() - t0, 3)
+    if devs is not None:
+        return FirstTouch("ok", devices=devs, probe_wall_s=wall)
+    if not retried and (listening or relay_listening)():
+        return FirstTouch("retry", err=err, probe_wall_s=wall)
+    return FirstTouch("cpu", err=err, probe_wall_s=wall)
+
+
+def first_touch_or_cpu(timeout_s: float, *, tag: str = "first_touch",
+                       file=None):
+    """In-process first touch for tools that cannot re-exec (``entry()``,
+    ``tools/tune_tpu.py``): returns ``(devices, degraded_reason|None)``.
+
+    A dead relay switches the platform to CPU BEFORE backend init (the
+    jittable work is platform-agnostic; an in-process retry after a HUNG
+    probe would deadlock on jax's backend-init lock, which is why
+    bench.py re-execs instead) and reports the degradation reason.  A
+    probe failure raises the CLASSIFIED error — a recorded, typed
+    failure always beats the eternal hang a wedged relay produces."""
+    import jax
+    degraded = None
+    ft = route_first_touch(timeout_s, probe=None)
+    if ft.decision == "cpu" and ft.probe_skipped:
+        degraded = RELAY_DOWN_REASON
+        print(f"{tag}: {degraded}; falling back to CPU", file=file or
+              sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        ft = route_first_touch(timeout_s, retried=True)
+    if ft.decision != "ok":
+        raise classified(f"device init failed: {ft.err}", site=tag)
+    return ft.devices, degraded
+
+
+def degradation_story(env=None) -> Optional[dict]:
+    """Assemble the degradation story a tagged CPU fallback run must
+    carry into its JSON artifact (fallback reason, ORIGINAL probe error,
+    retry count, probe wall time) from the ``_DR_TPU_BENCH_*`` markers
+    the re-exec chain threads through the environment.  None when the
+    run is not degraded."""
+    env = os.environ if env is None else env
+    reason = env.get("_DR_TPU_BENCH_DEGRADED")
+    if not reason:
+        return None
+    story = {"reason": reason,
+             "retries": int(env.get("_DR_TPU_BENCH_RETRIES", "0") or 0),
+             "probe_wall_s": float(env.get("_DR_TPU_BENCH_PROBE_S", "0")
+                                   or 0.0)}
+    first = env.get("_DR_TPU_BENCH_FIRST_ERR")
+    if first:
+        story["first_error"] = first
+    return story

@@ -37,8 +37,10 @@ from contextlib import contextmanager
 from typing import List, Optional
 
 from ..core.pinning import PinnedId, _pins
+from . import faults
 
-__all__ = ["guard", "active", "DivergenceError", "TappedCache"]
+__all__ = ["guard", "active", "DivergenceError", "TappedCache",
+           "first_divergence"]
 
 
 class DivergenceError(RuntimeError):
@@ -130,6 +132,13 @@ class TappedCache(OrderedDict):
         register_cache(self)
 
     def get(self, key, default=None):
+        # the dispatch moment doubles as the 'dispatch.cache' injection
+        # site (utils/faults): a per-process fault here drops exactly
+        # one dispatch from the trace — the divergence class the guard
+        # exists to catch.  fire() precedes record(): a faulted
+        # dispatch never reached the backend, so it must not appear on
+        # the verified trace either.
+        faults.fire("dispatch.cache")
         record(key)
         try:
             self.move_to_end(key)  # hit-refresh in ONE lookup
@@ -138,6 +147,7 @@ class TappedCache(OrderedDict):
         return super().get(key, default)
 
     def setdefault(self, key, default=None):
+        faults.fire("dispatch.cache")
         record(key)
         val = super().setdefault(key, default)
         self.move_to_end(key)
@@ -153,6 +163,22 @@ class TappedCache(OrderedDict):
         cap = _cache_cap()
         while len(self) > cap:
             self.popitem(last=False)
+
+
+def first_divergence(base, other):
+    """Locate the first divergent dispatch between two traces:
+    ``(index, base_entry, other_entry)``; a pure length mismatch after a
+    matching prefix returns ``(min_len, None, None)``; identical traces
+    return None.  Shared by ``verify()`` and the resilience tests (a
+    per-process injected fault drops a dispatch — this is the tool that
+    names it)."""
+    n = min(len(base), len(other))
+    for i in range(n):
+        if base[i] != other[i]:
+            return i, base[i], other[i]
+    if len(base) != len(other):
+        return n, None, None
+    return None
 
 
 class SpmdGuard:
@@ -204,18 +230,19 @@ class SpmdGuard:
                   for p in range(traces_raw.shape[0])]
         base = traces[0]
         for p, tr in enumerate(traces[1:], start=1):
-            n = min(len(base), len(tr))
-            for i in range(n):
-                if base[i] != tr[i]:
-                    raise DivergenceError(
-                        f"SPMD dispatch divergence at index {i}: "
-                        f"process 0 dispatched {base[i]} but process "
-                        f"{p} dispatched {tr[i]} (I am process {me})")
-            if len(base) != len(tr):
+            div = first_divergence(base, tr)
+            if div is None:
+                continue
+            i, be, te = div
+            if be is not None:
                 raise DivergenceError(
-                    f"SPMD dispatch-count divergence: process 0 made "
-                    f"{len(base)} dispatches, process {p} made "
-                    f"{len(tr)} (first {n} agree; I am process {me})")
+                    f"SPMD dispatch divergence at index {i}: "
+                    f"process 0 dispatched {be} but process "
+                    f"{p} dispatched {te} (I am process {me})")
+            raise DivergenceError(
+                f"SPMD dispatch-count divergence: process 0 made "
+                f"{len(base)} dispatches, process {p} made "
+                f"{len(tr)} (first {i} agree; I am process {me})")
         raise DivergenceError(
             "SPMD digest mismatch with identical traces — "
             "canonicalization bug, please report")

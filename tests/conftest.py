@@ -44,6 +44,17 @@ def _fresh_runtime():
 
 
 @pytest.fixture(autouse=True)
+def _disarm_faults():
+    """A leaked fault injection (utils/faults) must not outlive its
+    test: the next test's dr_tpu.init() would trip it.  reload_env()
+    (not clear()) so a suite deliberately run under DR_TPU_FAULT_SPEC /
+    DR_TPU_FAULT_COUNT keeps its env-declared arming across tests."""
+    yield
+    from dr_tpu.utils import faults
+    faults.reload_env()
+
+
+@pytest.fixture(autouse=True)
 def _clear_tuning_knobs(monkeypatch):
     """Tests run at the DEFAULT kernel configuration: an ambient tuning
     sweep's env (tools/tune_tpu.py exports these) must not shift chunk

@@ -92,6 +92,41 @@ def test_retry_failure_falls_back_to_cpu(monkeypatch, bench):
     # degraded message keeps both causes for the artifact
     assert "UNAVAILABLE: second" in env["_DR_TPU_BENCH_DEGRADED"]
     assert "UNAVAILABLE: first" in env["_DR_TPU_BENCH_DEGRADED"]
+    # ... and the rest of the degradation story (round 7): retry count
+    # and probe wall time ride the env into the tagged CPU child
+    assert env["_DR_TPU_BENCH_RETRIES"] == "1"
+    assert float(env["_DR_TPU_BENCH_PROBE_S"]) >= 0.0
+
+
+def test_degradation_story_reaches_json_detail(monkeypatch, bench,
+                                               capsys):
+    """The degradation story (fallback reason, original probe error,
+    retry count, probe wall time) must survive into bench's JSON
+    artifact, not just stderr — exercised through bench's REAL
+    report path (the CPU child's zero-report leg builds the same
+    detail.degraded object main() emits)."""
+    import json as _json
+    monkeypatch.setenv("_DR_TPU_BENCH_CPU_FALLBACK", "1")
+    monkeypatch.setenv("_DR_TPU_BENCH_DEGRADED", "retry failed: boom")
+    monkeypatch.setenv("_DR_TPU_BENCH_FIRST_ERR", "UNAVAILABLE: first")
+    monkeypatch.setenv("_DR_TPU_BENCH_RETRIES", "1")
+    monkeypatch.setenv("_DR_TPU_BENCH_PROBE_S", "3.25")
+    _arm(monkeypatch, bench, (None, "cpu probe also failed"))
+
+    class _Exit(Exception):
+        pass
+
+    monkeypatch.setattr(bench.os, "_exit",
+                        lambda code: (_ for _ in ()).throw(_Exit()))
+    with pytest.raises(_Exit):
+        bench._devices_or_die(1.0)
+    rec = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 0.0
+    assert rec["detail"]["error"] == "cpu probe also failed"
+    assert rec["detail"]["degraded"] == {
+        "reason": "retry failed: boom",
+        "first_error": "UNAVAILABLE: first",
+        "retries": 1, "probe_wall_s": 3.25}
 
 
 def test_retry_success_returns_devices(monkeypatch, bench):

@@ -1,0 +1,128 @@
+"""Chaos sweep over the fault-injection registry (ISSUE 2 acceptance):
+for EVERY registered injection site, each supported fault class must
+yield either a CLASSIFIED exception or a successful degraded run within
+its deadline — zero hangs, zero unclassified tracebacks.
+
+Driven standalone by ``tools/fuzz_crank.sh``'s chaos arm
+(``DR_TPU_CHAOS_ROUNDS`` cranks repetitions); in the tier-1 suite each
+(site, kind) combo runs once.  The battery is the sort/scan/halo fuzz
+programs plus checkpoint IO and the probe/init path — small shapes, so
+programs compile once and the sweep stays cheap on the 8-device CPU
+mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu.utils import fallback, faults, resilience
+
+ROUNDS = int(os.environ.get("DR_TPU_CHAOS_ROUNDS", "1"))
+DEADLINE = float(os.environ.get("DR_TPU_CHAOS_DEADLINE", "180"))
+
+
+def _battery(tmpdir: str, tag: str) -> None:
+    """One pass through the programs the resilience layer protects,
+    visiting EVERY registered injection site (asserted by
+    test_battery_reaches_every_site): probe -> init -> dispatch cache ->
+    halo exchange/reduce -> collectives shift/alltoall -> sort -> scan
+    -> checkpoint write/read -> fallback.warn."""
+    from dr_tpu.parallel.runtime import probe_devices
+    devs, err = probe_devices(30.0)
+    if err is not None:
+        raise resilience.classified(err, site="runtime.probe")
+    dr_tpu.init(devs)
+    P = dr_tpu.nprocs()
+    n = 16 * P
+    rng = np.random.default_rng(7)
+    src = rng.standard_normal(n).astype(np.float32)
+
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    v = dr_tpu.distributed_vector.from_array(src, halo=hb)
+    h = dr_tpu.halo(v)
+    h.exchange()
+    h.reduce_plus()
+
+    comm = dr_tpu.default_comm()
+    comm.shift_forward(v._data, periodic=True)
+    comm.alltoall(comm.scatter(np.zeros((P, P, 4), np.float32)))
+
+    sv = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.sort(sv)
+    got = dr_tpu.to_numpy(sv)
+    np.testing.assert_array_equal(got, np.sort(src))
+
+    out = dr_tpu.distributed_vector(n)
+    dr_tpu.inclusive_scan(dr_tpu.distributed_vector.from_array(src), out)
+    np.testing.assert_allclose(dr_tpu.to_numpy(out),
+                               np.cumsum(src, dtype=np.float32),
+                               rtol=1e-4, atol=1e-5)
+
+    ck = os.path.join(tmpdir, f"chaos_{tag}.npz")
+    dr_tpu.checkpoint.save(ck, dr_tpu.distributed_vector.from_array(src))
+    back = dr_tpu.checkpoint.load(ck)
+    np.testing.assert_allclose(np.asarray(back.materialize()), src,
+                               rtol=1e-6)
+
+    fallback.warn_fallback("chaos", "battery sweep")
+
+
+def _combos():
+    return [(site, kind) for site, kinds in sorted(faults.sites().items())
+            for kind in kinds]
+
+
+#: first hang seen — later combos skip instead of interleaving with the
+#: orphaned battery thread still running against the shared mesh (the
+#: spurious follow-on failures would bury the one-line hang signal)
+_hang_seen: list = []
+
+
+@pytest.mark.parametrize("site,kind", _combos())
+def test_chaos_every_site_and_kind(site, kind, tmp_path):
+    """Inject one fault at (site, kind); the battery must finish clean
+    (degraded-but-correct) or die with a CLASSIFIED error — within the
+    deadline either way.  An unclassified traceback or a hang is the
+    bug this sweep exists to catch."""
+    if _hang_seen:
+        pytest.skip(f"prior hang at {_hang_seen[0]}: its orphaned "
+                    "battery thread may still interleave")
+    for r in range(ROUNDS):
+        with faults.injected(site, kind, times=1) as sp:
+            try:
+                resilience.with_deadline(
+                    lambda: _battery(str(tmp_path), f"{r}"),
+                    DEADLINE, site=f"chaos:{site}:{kind}", dump=False)
+            except resilience.DeadlineExpired:
+                _hang_seen.append(f"{site}:{kind}")
+                raise AssertionError(
+                    f"HANG: {site}:{kind} exceeded the {DEADLINE}s "
+                    "chaos deadline")
+            except resilience.ResilienceError:
+                pass  # classified failure: an acceptable outcome
+            # (any OTHER exception propagates = unclassified = failure)
+            assert sp.fired == 1, \
+                f"battery never reached site {site} (vacuous sweep)"
+
+
+def test_battery_reaches_every_site(tmp_path):
+    """Coverage guard for the sweep itself: the battery must VISIT every
+    registered site, else a combo above could pass without testing
+    anything (and fallback.warn — counting-only — is asserted here)."""
+    faults.clear()
+    faults.arm_counting()
+    _battery(str(tmp_path), "coverage")
+    visits = faults.stats()
+    missing = [s for s in faults.sites() if visits.get(s, 0) == 0]
+    assert not missing, f"battery misses injection sites: {missing}"
+
+
+def test_transient_retry_recovers_midstream(tmp_path):
+    """Acceptance: a transient fault inside the battery recovers via
+    retry() IN PROCESS — no re-exec, same mesh, correct results."""
+    with faults.injected("halo.exchange", "transient", times=1) as sp:
+        resilience.retry(lambda: _battery(str(tmp_path), "retry"),
+                         attempts=2, sleep=lambda s: None)
+        assert sp.fired == 1

@@ -1,10 +1,18 @@
 """Checkpoint round-trip tests (capability beyond the reference, which
-has no serialization — SURVEY.md §5)."""
+has no serialization — SURVEY.md §5), plus the round-7 failure model:
+atomic writes, format versioning, and classified corrupt-file errors
+under fault injection."""
+
+import json
+import os
 
 import numpy as np
+import pytest
 
 import dr_tpu
-from dr_tpu.utils import checkpoint
+from dr_tpu.utils import checkpoint, faults
+from dr_tpu.utils.resilience import (CheckpointCorruptError,
+                                     TransientBackendError)
 
 
 def test_vector_roundtrip(tmp_path):
@@ -74,3 +82,104 @@ def test_sparse_2d_partition_roundtrip(tmp_path):
     back = checkpoint.load(p)
     assert back.grid_shape == part.grid_for(dr_tpu.nprocs())
     np.testing.assert_array_equal(back.to_dense(), d)
+
+
+# ---------------------------------------------------------------------------
+# failure model (round 7): atomic writes, versioning, classified errors
+# ---------------------------------------------------------------------------
+
+def _save_vec(path, values):
+    checkpoint.save(str(path),
+                    dr_tpu.distributed_vector.from_array(values))
+
+
+def test_save_is_atomic_under_midwrite_kill(tmp_path):
+    """A write killed mid-stream (injected fault between the temp-file
+    write and the rename) must leave the PREVIOUS checkpoint intact and
+    loadable — the torn-file regression the non-atomic round-6 save()
+    could not pass — and no temp debris behind."""
+    p = tmp_path / "vec.npz"
+    old = np.arange(10, dtype=np.float32)
+    _save_vec(p, old)
+    with faults.injected("checkpoint.write", "transient"):
+        with pytest.raises(TransientBackendError):
+            _save_vec(p, old * 7)
+    back = checkpoint.load(str(p))
+    np.testing.assert_array_equal(back.materialize(), old)
+    assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+
+def test_truncated_checkpoint_raises_classified(tmp_path):
+    """The injected 'truncate' kind installs the torn file a mid-stream
+    kill leaves a NON-atomic writer in; load() must answer with the
+    classified error, not a raw zipfile traceback."""
+    p = tmp_path / "vec.npz"
+    with faults.injected("checkpoint.write", "truncate") as sp:
+        _save_vec(p, np.arange(32, dtype=np.float32))
+        assert sp.fired == 1
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(str(p))
+
+
+def test_corrupt_bytes_raise_classified(tmp_path):
+    p = tmp_path / "garbage.npz"
+    p.write_bytes(b"not a zip archive at all")
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(str(p))
+    # a MISSING file is not corruption: the original error class stays
+    with pytest.raises(FileNotFoundError):
+        checkpoint.load(str(tmp_path / "never_written.npz"))
+
+
+def test_corrupt_member_raises_classified(tmp_path):
+    """A zip-INTACT archive whose .npy member bytes were overwritten
+    (bit rot / partial overwrite, not tail truncation) must classify
+    too — np.lib.format raises ValueError at the member read."""
+    import io
+    import zipfile as zf
+    meta = io.BytesIO()
+    np.save(meta, np.array(json.dumps(
+        {"kind": "vector", "halo": [0, 0, False], "format_version": 1})))
+    p = tmp_path / "member.npz"
+    with zf.ZipFile(p, "w") as z:
+        z.writestr("meta.npy", meta.getvalue())
+        z.writestr("data.npy", b"\x93NUMPY garbage, not a real header")
+    with pytest.raises(CheckpointCorruptError, match="member"):
+        checkpoint.load(str(p))
+
+
+def test_format_version_recorded_and_future_rejected(tmp_path):
+    p = tmp_path / "vec.npz"
+    _save_vec(p, np.arange(8, dtype=np.float32))
+    with np.load(str(p), allow_pickle=False) as f:
+        meta = json.loads(str(f["meta"]))
+    assert meta["format_version"] == checkpoint.FORMAT_VERSION
+    # a file from a NEWER dr_tpu must fail closed, not misparse
+    meta["format_version"] = checkpoint.FORMAT_VERSION + 1
+    with open(tmp_path / "future.npz", "wb") as fh:
+        np.savez(fh, meta=json.dumps(meta),
+                 data=np.arange(8, dtype=np.float32))
+    with pytest.raises(CheckpointCorruptError, match="newer"):
+        checkpoint.load(str(tmp_path / "future.npz"))
+
+
+def test_legacy_unversioned_checkpoint_loads(tmp_path):
+    """Round-6 files carry no format_version: they read as version 0
+    and keep loading."""
+    legacy = {"kind": "vector", "halo": [0, 0, False]}
+    with open(tmp_path / "legacy.npz", "wb") as fh:
+        np.savez(fh, meta=json.dumps(legacy),
+                 data=np.arange(12, dtype=np.float32))
+    back = checkpoint.load(str(tmp_path / "legacy.npz"))
+    np.testing.assert_array_equal(back.materialize(),
+                                  np.arange(12, dtype=np.float32))
+
+
+def test_checkpoint_read_site_classified(tmp_path):
+    p = tmp_path / "vec.npz"
+    _save_vec(p, np.arange(8, dtype=np.float32))
+    with faults.injected("checkpoint.read", "transient"):
+        with pytest.raises(TransientBackendError):
+            checkpoint.load(str(p))
+    # clean afterwards
+    checkpoint.load(str(p))

@@ -16,17 +16,28 @@
 # `tools/fuzz_crank.sh 300 sort_family` runs the round-6 sort-family
 # arm (sort / sort_by_key / argsort / is_sorted, the restructured
 # single-exchange plan included) at the full 300-iteration discipline.
+#
+# CHAOS arm (round 7): tests/test_chaos.py sweeps every registered
+# fault-injection site x kind under the sort/scan/halo battery and
+# asserts "classified error or clean degraded result, never a hang"
+# (utils/faults + utils/resilience).  It collects alongside the fuzz
+# arms (filter `chaos` to crank it alone); DR_TPU_CHAOS_ROUNDS scales
+# its per-combo repetitions off the iteration budget.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
 FILTER=${2:-}
-nodes=$(python -m pytest tests/test_fuzz.py --collect-only -q 2>/dev/null \
-        | grep "::" | cut -d"[" -f1 | sort -u)
-if [ -z "$nodes" ]; then
-  # a broken collection (import/syntax error) must NOT read as a clean
-  # crank that ran zero arms
-  echo "FAILED: test collection produced no fuzz arms" >&2
-  python -m pytest tests/test_fuzz.py --collect-only -q 2>&1 | tail -5 >&2
+CHAOS_ROUNDS=$(( ITERS / 60 + 1 ))
+# a broken collection (import/syntax error) must NOT read as a clean
+# crank — with TWO files collected, one broken file still leaves nodes
+# non-empty, so the pytest exit status is the guard, not just emptiness
+collect_out=$(python -m pytest tests/test_fuzz.py tests/test_chaos.py \
+              --collect-only -q 2>&1)
+collect_rc=$?
+nodes=$(printf '%s\n' "$collect_out" | grep "::" | cut -d"[" -f1 | sort -u)
+if [ "$collect_rc" -ne 0 ] || [ -z "$nodes" ]; then
+  echo "FAILED: broken test collection (rc=$collect_rc)" >&2
+  printf '%s\n' "$collect_out" | tail -5 >&2
   exit 2
 fi
 if [ -n "$FILTER" ]; then
@@ -39,8 +50,9 @@ if [ -n "$FILTER" ]; then
 fi
 rc=0
 for nd in $nodes; do
-  echo "=== $nd (DR_TPU_FUZZ_ITERS=$ITERS) ==="
-  DR_TPU_FUZZ_ITERS=$ITERS python -m pytest "$nd" -q 2>&1 | tail -2
+  echo "=== $nd (DR_TPU_FUZZ_ITERS=$ITERS DR_TPU_CHAOS_ROUNDS=$CHAOS_ROUNDS) ==="
+  DR_TPU_FUZZ_ITERS=$ITERS DR_TPU_CHAOS_ROUNDS=$CHAOS_ROUNDS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
   st=${PIPESTATUS[0]}
   if [ "$st" -ne 0 ]; then
     echo "FAILED ($st): $nd"

@@ -468,6 +468,24 @@ def tune_sort():
 
 
 if __name__ == "__main__":
+    # Guarded first backend touch through the SAME degradation router
+    # as bench.py and entry() (utils/resilience): a dead relay degrades
+    # to a tagged CPU run instead of hanging the sweep; a wedged claim
+    # raises a CLASSIFIED error within its deadline.  Before this the
+    # tool had no guard at all and a wedged relay ate the session.
+    from dr_tpu.utils import resilience as _resilience
+    try:
+        _devs, _degraded = _resilience.first_touch_or_cpu(
+            float(os.environ.get("DR_TPU_TUNE_INIT_TIMEOUT", "420")),
+            tag="tune_tpu")
+    except _resilience.ResilienceError as e:
+        print(f"tune_tpu: device init failed "
+              f"({type(e).__name__}: {e}); aborting sweep", flush=True)
+        sys.exit(1)
+    if _degraded:
+        print(f"tune_tpu: DEGRADED run ({_degraded}) — numbers below "
+              "are CPU-bound, not TPU tuning data", flush=True)
+
     # several modes may share ONE process (= one relay claim):
     # `tune_tpu.py halo attn sort` runs all three back to back
     whats = sys.argv[1:] or ["all"]
