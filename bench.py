@@ -31,6 +31,13 @@ numbers (BASELINE.md), so the target is the hardware-derived bar.
 key-value sort phase ladder into detail; the keys-only sort phase
 breakdown (``detail.sort_phases_gbps``) is always on (round 6 —
 utils/profiling.profile_phases over the sample-sort truncations).
+
+Round 8: ``detail.pipeline_gbps`` (eager-vs-deferred 5-op chain through
+``dr_tpu.deferred()``, marginal method) and ``detail.dispatch_counts``
+(spmd_guard tap counts for the headline timed run and one pipeline
+chain per arm) are always on; ``--pipeline`` (or
+DR_TPU_BENCH_PIPELINE=1 — survives the CPU-fallback re-execs) adds the
+deferred chain-length ladder.
 """
 
 import json
@@ -165,7 +172,13 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
 
     # best-of-3: the timed run is ~0.3 s, the tunneled dispatch constant
     # drifts by tens of ms — a single sample can be inflated ~25%
+    from dr_tpu.utils.spmd_guard import dispatch_count
+    d0 = dispatch_count()
     dt = _time_best(lambda: _sync(run(steps)), iters=3)
+    # tap dispatches per timed run (round 8): dispatch-count regressions
+    # become visible in every BENCH_r*.json
+    dpr = (dispatch_count() - d0) / 3.0
+    dispatches = int(dpr) if dpr == int(dpr) else round(dpr, 2)
 
     # effective traffic: the per-step XLA path would read n + write n
     bytes_eff = 2.0 * n * np.dtype(dtype).itemsize * steps
@@ -175,7 +188,7 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     passes = steps if not blocked else nfull + (1 if rest else 0)
     phys_gbps = 2.0 * n * np.dtype(dtype).itemsize * passes / dt / 1e9
     return {"n": n, "steps": steps, "seconds": round(dt, 4), "impl": impl,
-            "gbps": gbps, "phys_gbps": phys_gbps}
+            "gbps": gbps, "phys_gbps": phys_gbps, "dispatches": dispatches}
 
 
 def _settle(seconds):
@@ -249,6 +262,112 @@ def _time_amortized(dispatch, sync, calls=16, batches=3):
         sync(last)
         times.append((time.perf_counter() - t0) / calls)
     return float(np.median(times))
+
+
+def _pl_scale(x, c):
+    return x * c
+
+
+def _pl_shift(x, c):
+    return x + c
+
+
+def _pipeline_chain(a, b, coef):
+    """One 5-op cross-algorithm chain (fill -> for_each -> halo exchange
+    -> transform -> reduce) — the deferred-plan workload.  Module-level
+    ops keep the program-cache keys stable across calls; ``coef`` rides
+    as a traced scalar, so streaming values reuse one compiled plan."""
+    import dr_tpu
+    dr_tpu.fill(a, 0.5)
+    dr_tpu.for_each(a, _pl_scale, coef)
+    a.halo().exchange()
+    dr_tpu.transform(a, b, _pl_shift, 1.0)
+    return dr_tpu.reduce(b)
+
+
+def _pipeline_runners(a, b):
+    """(run_eager, run_deferred) over the shared chain — ONE home for
+    the measurement protocol (bench's pipeline config and
+    tune_tpu.py's on-chip ladder must time the identical workload).
+    ``run(r)`` executes r chains and hard-syncs; the streamed
+    coefficient keeps the program caches hot across r."""
+    import dr_tpu
+
+    def run_eager(r):
+        for i in range(r):
+            _pipeline_chain(a, b, 1.0 + i * 1e-7)
+        _sync(b)
+
+    def run_deferred(r):
+        with dr_tpu.deferred():
+            vals = [_pipeline_chain(a, b, 1.0 + i * 1e-7)
+                    for i in range(r)]
+        float(vals[-1])  # ONE host sync for the whole region
+
+    return run_eager, run_deferred
+
+
+def _pipeline_metrics(on_cpu: bool, ladder: bool = False) -> dict:
+    """Eager-vs-deferred per-chain rate of the 5-op pipeline chain by
+    the marginal method (``run(r)`` = r chains; the per-measurement
+    constant cancels, while the per-op dispatch cost — the thing
+    deferred mode erases — properly scales with r on the eager arm).
+    Also reports the tap dispatch count of ONE chain on each arm.
+    ``ladder=True`` (--pipeline) adds a raw chain-length ladder for the
+    next chip session (per-chain wall ms at r = 1..16)."""
+    import dr_tpu
+    from dr_tpu.utils.spmd_guard import dispatch_count
+    out = {}
+    P = dr_tpu.nprocs()
+    itemsize = 4
+    # CPU smoke size stays small: the config measures DISPATCH
+    # amortization, which dominates regardless of n off-chip
+    n = (2 ** 18 if on_cpu else 2 ** 24) // P * P
+    hb = dr_tpu.halo_bounds(2, 2, periodic=True)
+    a = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+    b = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+    # fill n + for_each 2n + transform 2n + reduce n (exchange moves
+    # ghost widths — noise): the chain's logical traffic
+    bytes_chain = 6.0 * n * itemsize
+    run_eager, run_deferred = _pipeline_runners(a, b)
+
+    try:
+        run_eager(1)
+        run_deferred(1)  # warm both arms (compile the r=1 plan)
+        d0 = dispatch_count()
+        run_eager(1)
+        eager_d = dispatch_count() - d0
+        d0 = dispatch_count()
+        run_deferred(1)
+        deferred_d = dispatch_count() - d0
+        out["dispatch_counts"] = {"pipeline_chain_eager": eager_d,
+                                  "pipeline_chain_deferred": deferred_d}
+        # rmax bounds the adaptive widening: a deferred r-chain plan
+        # traces 5*r ops, so unbounded widening would compile a
+        # monster program just to beat the jitter guard
+        dt_e = _marginal(run_eager, r1=2, r2=8, samples=3,
+                         min_spread=0.05 if on_cpu else 0.3, rmax=32)
+        dt_d = _marginal(run_deferred, r1=2, r2=8, samples=3,
+                         min_spread=0.05 if on_cpu else 0.3, rmax=32)
+        out["pipeline_gbps"] = {
+            "eager": round(bytes_chain / dt_e / 1e9, 3),
+            "deferred": round(bytes_chain / dt_d / 1e9, 3)}
+        out["pipeline_chain_us"] = {"eager": round(dt_e * 1e6, 1),
+                                    "deferred": round(dt_d * 1e6, 1)}
+        if ladder:
+            lad = {}
+            for r in (1, 2, 4, 8, 16):
+                run_deferred(r)  # compile the r-chain plan
+                t0 = time.perf_counter()
+                run_deferred(r)
+                lad[str(r)] = round((time.perf_counter() - t0) / r * 1e6,
+                                    1)
+            out["pipeline_deferred_ladder_us_per_chain"] = lad
+    except _JitterError as e:
+        out["pipeline_error"] = f"JitterError: {e}"[:160]
+    except Exception as e:  # pragma: no cover - defensive
+        out["pipeline_error"] = repr(e)[:160]
+    return out
 
 
 def _secondary_metrics(on_cpu: bool, on_tpu: bool,
@@ -791,6 +910,19 @@ def main():
         phases = ("--phases" in sys.argv[1:]
                   or os.environ.get("DR_TPU_BENCH_PHASES", "") == "1")
         secondary = _secondary_metrics(on_cpu, on_tpu, phases=phases)
+        # pipeline config (round 8): eager-vs-deferred 5-op chain.
+        # Always on; --pipeline (or DR_TPU_BENCH_PIPELINE=1 — the flag
+        # survives both CPU-fallback re-execs like --phases) adds the
+        # chain-length ladder for the next chip session.
+        ladder = ("--pipeline" in sys.argv[1:]
+                  or os.environ.get("DR_TPU_BENCH_PIPELINE", "") == "1")
+        secondary.update(_pipeline_metrics(on_cpu, ladder=ladder))
+
+    # tap dispatch counts (round 8): the headline timed run's count
+    # joins the pipeline arms so dispatch regressions show in every
+    # BENCH_r*.json artifact
+    dispatch_counts = {"headline_timed_run": res.get("dispatches")}
+    dispatch_counts.update(secondary.pop("dispatch_counts", {}))
 
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
@@ -803,6 +935,7 @@ def main():
             "device": str(dev), "peak_hbm_gbps": peak,
             "phys_gbps": round(res["phys_gbps"] / nchips, 2),
             "target_gbps": round(target, 1),
+            "dispatch_counts": dispatch_counts,
             **({"degraded": story} if story else {}),
             **secondary,
         },

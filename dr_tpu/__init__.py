@@ -20,6 +20,8 @@ namespaces through one TPU backend, called ``thp``):
   transform_reduce / inclusive_scan / exclusive_scan / sort /
   sort_by_key / argsort / is_sorted / dot / gemv``
 - halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
+- plans:      ``deferred`` / ``Plan`` — record algorithm chains, flush
+  them as ONE fused dispatch (cross-algorithm dispatch fusion)
 """
 
 from .utils import jax_compat  # noqa: F401  (jax.shard_map shim, first)
@@ -66,6 +68,8 @@ from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
                                    stencil2d_n, heat_step_weights)
 from .algorithms.gemv import gemv, gemv_n, flat_gemv, gemm, spmm, spmm_n
+from . import plan
+from .plan import Plan, PlanScalar, deferred
 
 __version__ = "0.1.0"
 
@@ -96,4 +100,5 @@ __all__ = [
     "checkpoint", "profiling", "spmd_guard", "faults", "resilience",
     "ring_attention", "ring_attention_n",
     "dot_n", "inclusive_scan_n", "gemv_n", "spmm_n", "stencil2d_n",
+    "plan", "Plan", "PlanScalar", "deferred",
 ]

@@ -43,6 +43,14 @@ __all__ = ["fill", "iota", "copy", "copy_async", "for_each", "transform",
 _op_key = pinned_id
 
 
+def _plan_active():
+    """The recording deferred plan, if any (dr_tpu/plan.py).  Lazy
+    import: plan builds on this module, so the dependency must point
+    this way only at call time."""
+    from ..plan import active
+    return active()
+
+
 def _traced_op_key(op):
     """Cache key for a chain op in the SPECIALIZED program paths (the
     ones that feed BoundOp scalars as traced operands): a BoundOp keys
@@ -272,6 +280,10 @@ def _generator_program(out_chain: _Chain, kind: str):
 def fill(r, value) -> None:
     """Collective fill (cpu_algorithms.hpp:14-28; shp/copy.hpp:147-174)."""
     out = _out_chain(r)
+    p = _plan_active()
+    if p is not None:
+        p.record_generator(out, "fill", value)
+        return
     prog = _generator_program(out, "fill")
     out.cont._data = prog(out.cont._data, jnp.asarray(value, out.cont.dtype))
 
@@ -280,6 +292,10 @@ def iota(r, start=0) -> None:
     """Collective iota (cpu_algorithms.hpp:83-94).  The reference routes
     every element through rank-0 RMA; here it is one sharded program."""
     out = _out_chain(r)
+    p = _plan_active()
+    if p is not None:
+        p.record_generator(out, "iota", start - out.off)
+        return
     prog = _generator_program(out, "iota")
     out.cont._data = prog(out.cont._data,
                           jnp.asarray(start - out.off))
@@ -304,8 +320,16 @@ def transform(in_r, out, op: Callable, *scalars) -> None:
         out_chain = _Chain(out_chain.cont, out_chain.off, n,
                            out_chain.ops)
     if ins is not None and _fast_aligned(ins, out_chain):
+        p = _plan_active()
+        if p is not None:
+            p.record_transform(ins, out_chain, op, scalars)
+            return
         _run_fused(ins, out_chain, op, scalars=scalars)
         return
+    p = _plan_active()
+    if p is not None:
+        # the materialize route cannot fuse into a deferred run
+        p.nonfusible("transform (unaligned/materialize route)")
     # fallback: logical-array evaluation; XLA inserts the resharding
     arr = in_r.to_array() if hasattr(in_r, "to_array") else jnp.asarray(in_r)
     vals = op(*arr, *scalars) if isinstance(arr, tuple) \
@@ -320,6 +344,10 @@ def copy(src, dst) -> None:
     if isinstance(src, (np.ndarray, jax.Array, list, tuple)) and \
             not hasattr(src, "__dr_segments__"):
         out = _out_chain(dst)
+        p = _plan_active()
+        if p is not None:
+            p.record_splice(out, jnp.asarray(src, out.cont.dtype))
+            return
         _write_window(out, jnp.asarray(src, out.cont.dtype))
         return
     if isinstance(dst, np.ndarray):
@@ -362,6 +390,10 @@ def for_each(r, fn: Callable, *scalars) -> None:
         outs = [_out_chain(c) for c in r.components]
         ins = _resolve(r)
         if ins is not None and all(_fast_aligned(ins, oc) for oc in outs):
+            p = _plan_active()
+            if p is not None:
+                p.record_zip_foreach(ins, outs, fn, scalars)
+                return
             conts = [oc.cont for oc in outs]
             # inputs that are also outputs read the donated buffers
             alias = tuple(
@@ -379,6 +411,9 @@ def for_each(r, fn: Callable, *scalars) -> None:
             for cont, nd in builtin_zip(conts, datas):
                 cont._data = nd
             return
+        p = _plan_active()
+        if p is not None:
+            p.nonfusible("for_each (misaligned zip route)")
         arrs = r.to_array()
         vals = fn(*arrs, *scalars)
         if not isinstance(vals, tuple):

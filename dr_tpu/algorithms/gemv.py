@@ -177,6 +177,8 @@ def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
     running output (times 1e-38) so XLA can neither hoist the
     contraction nor skip re-reading b.  Accumulates into ``c`` like
     ``iters`` gemv calls (up to the negligible perturbation)."""
+    from ..plan import flush_reads
+    flush_reads("gemv_n")  # reads c._data directly: pending writes first
     assert isinstance(a, sparse_matrix) and a.grid_shape[1] == 1
     m, n = a.shape
     b_arr = b.to_array() if hasattr(b, "to_array") else jnp.asarray(b)
@@ -538,6 +540,10 @@ def spmm_n(a: sparse_matrix, b, iters: int) -> jax.Array:
 def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     """c += A·b (reference gemv semantics: accumulate into c,
     gemv.hpp:45-66)."""
+    # gemv is NON-FUSIBLE in deferred regions (ISSUE 3): flush the
+    # recorded prefix (order!) before dispatching eagerly
+    from ..plan import barrier as _plan_barrier
+    _plan_barrier("gemv")
     assert isinstance(a, sparse_matrix)
     m, n = a.shape
     assert len(c) == m, "output length must equal matrix rows"

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from ._common import owned_window_mask
 from .elementwise import (_apply_chain_ops, _chain_scalars, _op_key,
-                          _prog_cache, _resolve, _traced_op_key)
+                          _plan_active, _prog_cache, _resolve,
+                          _traced_op_key)
 from ..views import views as _v
 
 __all__ = ["reduce", "transform_reduce", "dot",
@@ -226,6 +227,11 @@ def reduce_async(r, op: Callable = None):
             if zipped is not None:
                 chains, zip_op = zipped
     if chains is not None:
+        p = _plan_active()
+        if p is not None:
+            # deferred: the reduction rides the plan's carry; callers
+            # get a lazy PlanScalar resolving on host materialization
+            return p.record_reduce(chains, kind, zip_op)
         val = _call_fused_reduce(chains, kind, zip_op)
         return val
     if kind is None and op is not None:
@@ -235,6 +241,10 @@ def reduce_async(r, op: Callable = None):
         gchains = _resolve(r) if not isinstance(r, _v.zip_view) else None
         if gchains is not None and len(gchains) == 1 \
                 and gchains[0].n > 0:
+            # identityless custom-op reduce keeps its own shard_map
+            # machinery; it does not fuse into a deferred run
+            from ..plan import barrier as _plan_barrier
+            _plan_barrier("custom-op reduce")
             c = gchains[0]
             svals = [jnp.asarray(s) for s in _chain_scalars([c])]
             return _custom_reduce_program(
@@ -262,8 +272,17 @@ def reduce_async(r, op: Callable = None):
 
 
 def reduce(r, init=None, op: Callable = None):
-    """Collective reduction; returns a host scalar (valid on all ranks)."""
+    """Collective reduction; returns a host scalar (valid on all ranks).
+    Inside ``dr_tpu.deferred()`` it returns a lazy ``PlanScalar``
+    instead: the reduction rides the fused program's carry and resolves
+    (flushing the plan) on ``float()``/``item()``."""
     val = reduce_async(r, op)
+    from ..plan import PlanScalar
+    if isinstance(val, PlanScalar):
+        if init is not None:
+            pyop = op if op is not None else operator.add
+            return val.with_post(lambda v: pyop(init, v))
+        return val
     if init is not None:
         pyop = op if op is not None else operator.add
         return pyop(init, val.item())
@@ -380,6 +399,8 @@ def dot_n(a, b, iters: int):
     both arrays, no intermediates).  The returned value differs from
     ``dot(a, b)`` by O(1e-38 * |dot| * sum(a)) — negligible.  Returns
     the final device scalar."""
+    from ..plan import flush_reads
+    flush_reads("dot_n")  # reads _data directly: pending writes first
     c0, c1 = _dot_n_chains(a, b)
     layout, off, n = c0.cont.layout, c0.off, c0.n
     nshards, seg, prev, nxt, total_n = layout

@@ -29,8 +29,8 @@ from ._common import (combine_for, first_nonempty, identityless_fold,
                       working_geometry)
 from ..views import views as _v
 from .elementwise import (_Chain, _apply_chain_ops, _chain_scalars,
-                          _op_key, _out_chain, _prog_cache, _resolve,
-                          _traced_op_key, _write_window)
+                          _op_key, _out_chain, _plan_active, _prog_cache,
+                          _resolve, _traced_op_key, _write_window)
 from .reduce import _classify_op, _identity_for
 from ..core.pinning import pinned_id
 
@@ -512,7 +512,16 @@ def _scan(in_r, out, op, init, exclusive):
 
 def inclusive_scan(in_r, out, op: Callable = None, init=None):
     """Distributed inclusive prefix scan
-    (shp/algorithms/inclusive_scan.hpp:25-148)."""
+    (shp/algorithms/inclusive_scan.hpp:25-148).  Inside
+    ``dr_tpu.deferred()`` the scan is recorded OPAQUE: deferred until
+    flush (record order preserved) but dispatched through its own
+    program rather than fused into the neighboring run."""
+    p = _plan_active()
+    if p is not None:
+        p.record_opaque(
+            "inclusive_scan",
+            lambda: _scan(in_r, out, op, init, exclusive=False))
+        return out
     return _scan(in_r, out, op, init, exclusive=False)
 
 
@@ -524,6 +533,8 @@ def inclusive_scan_n(in_v, out, iters: int):
     the per-op traffic.  Values grow without bound (inf arithmetic
     runs at full speed on TPU): ``out`` is a timing aid, NOT
     cumsum(in)."""
+    from ..plan import flush_reads
+    flush_reads("inclusive_scan_n")  # direct _data access below
     ins = _resolve(in_v)
     out_chain = _out_chain(out)
     assert (ins is not None and len(ins) == 1 and not ins[0].ops
@@ -558,7 +569,18 @@ def inclusive_scan_n(in_v, out, iters: int):
 
 def exclusive_scan(in_r, out, init=0, op: Callable = None):
     """Exclusive variant (std::exclusive_scan surface; the reference spec
-    names it, doc/spec/source/algorithms/)."""
+    names it, doc/spec/source/algorithms/).  Deferred regions record it
+    opaque, like :func:`inclusive_scan`."""
+    p = _plan_active()
+    if p is not None:
+        p.record_opaque(
+            "exclusive_scan",
+            lambda: _exclusive_scan_eager(in_r, out, init, op))
+        return out
+    return _exclusive_scan_eager(in_r, out, init, op)
+
+
+def _exclusive_scan_eager(in_r, out, init, op):
     out = _scan(in_r, out, op, None, exclusive=True)
     # exclusive scan seeds with init at position 0 and folds into the
     # rest.  Skippable only for the add identity: an UNCLASSIFIED op

@@ -100,6 +100,15 @@ __all__ = ["sort", "sort_by_key", "argsort", "is_sorted",
            "SORT_PHASES", "SORTKV_PHASES"]
 
 
+def _plan_barrier(what: str) -> None:
+    """Sort-family ops are NON-FUSIBLE in deferred regions (ISSUE 3):
+    flush the active plan (warn_fallback-announced) before dispatching
+    eagerly, so the recorded prefix lands first and in order.  Lazy
+    delegation to the ONE implementation in dr_tpu/plan.py."""
+    from ..plan import barrier
+    barrier(what)
+
+
 _NAN_KEY = np.uint32(0xFFFFFFFE)  # after +inf (numpy sorts NaNs last)
 _PAD_KEY = np.uint32(0xFFFFFFFF)  # strictly after every real key
 # 64-bit twins for real float64 keys (only reachable on x64-enabled CPU
@@ -553,6 +562,7 @@ def sort(r, *, descending: bool = False):
     coordinates with a masked row blend, round 4).  Every dtype is
     native (round 5): f64 keys encode through the 64-bit sign-flip
     trick on x64-enabled meshes, exactly."""
+    _plan_barrier("sort")
     chain = _out_chain(r)
     cont = chain.cont
     full = chain.off == 0 and chain.n == len(cont)
@@ -582,6 +592,7 @@ def sort_by_key(keys, values, *, descending: bool = False):
     order the old sequential fallback used.  The payload itself moves
     exactly ONCE (round 6): it never rides a sort or the bucket
     exchange — the rebalanced global-index channel drives one gather."""
+    _plan_barrier("sort_by_key")
     kc = _out_chain(keys)
     vc = _out_chain(values)
     if kc.n != vc.n:
@@ -657,6 +668,7 @@ def sort_n(v, iters: int):
     sorts run FASTER on sorted data; docs/PERF.md round 6 records the
     gap).  Timing aid for bench.py; the final content is simply the
     sorted input."""
+    _plan_barrier("sort_n")
     chain = _out_chain(v)
     cont = chain.cont
     assert chain.off == 0 and chain.n == len(cont), \
@@ -683,6 +695,7 @@ def sort_n(v, iters: int):
 def sort_by_key_n(keys, values, iters: int):
     """``iters`` chained key-value sorts in ONE jitted program (see
     :func:`sort_n`)."""
+    _plan_barrier("sort_by_key_n")
     kc = _out_chain(keys)
     vc = _out_chain(values)
     kcont, vcont = kc.cont, vc.cont
@@ -716,6 +729,7 @@ def sort_phases_n(v, stop_after, iters: int):
     ``utils.profiling.profile_phases``).  The container's content after
     a truncated run is a phase-dependent value mix, NOT a sorted range;
     use scratch data."""
+    _plan_barrier("sort_phases_n")
     chain = _out_chain(v)
     cont = chain.cont
     assert chain.off == 0 and chain.n == len(cont), \
@@ -743,6 +757,7 @@ def sort_by_key_phases_n(keys, values, stop_after, iters: int):
     :data:`SORTKV_PHASES`).  Truncations before the "payload" phase
     leave the payload container bit-untouched — honest accounting: no
     earlier phase reads or moves it."""
+    _plan_barrier("sort_by_key_phases_n")
     kc = _out_chain(keys)
     vc = _out_chain(values)
     kcont, vcont = kc.cont, vc.cont
@@ -780,6 +795,7 @@ def argsort(r, *, descending: bool = False):
     of the keys with an iota payload.  READ-ONLY in ``r``: transform
     views and other single-component ranges are accepted (the copy
     fuses the view chain)."""
+    _plan_barrier("argsort")
     from ..containers.distributed_vector import distributed_vector
     from .elementwise import copy as _copy, iota
     res = _resolve(r)
@@ -871,6 +887,7 @@ def is_sorted(r) -> bool:
     view chains with the op stack fused into the program — BoundOp
     coefficients as traced operands, so streams reuse one program,
     round 6)."""
+    _plan_barrier("is_sorted")
     res = _resolve(r)
     if res is not None and len(res) != 1:
         raise TypeError("is_sorted takes a single-component range")

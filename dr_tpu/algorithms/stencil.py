@@ -27,7 +27,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ._common import double_buffered_loop, uniform_layout
-from .elementwise import _op_key, _out_chain, _prog_cache, _resolve
+from .elementwise import (_op_key, _out_chain, _plan_active, _prog_cache,
+                          _resolve)
 from ..core.pinning import pinned_id
 from ..parallel.halo import _ring_perms
 
@@ -130,6 +131,13 @@ def stencil_transform(in_dv, out_dv, op: Union[Callable, Sequence[float]],
             prev = nxt = (len(key_op) - 1) // 2
         assert hb.prev >= prev and hb.next >= nxt, \
             "halo narrower than the weight-stencil radius"
+    p = _plan_active()
+    if p is not None:
+        # one fused exchange+transform step joins the deferred run
+        p.record_stencil(cont, oc.cont, cont.layout, hb.periodic,
+                         prev, nxt, key_op, body_op,
+                         cont.runtime.axis, cont.runtime.mesh)
+        return
     key = ("stencil", pinned_id(cont.runtime.mesh), cont.layout, hb.periodic,
            prev, nxt, key_op, str(cont.dtype))
     prog = _prog_cache.get(key)
@@ -154,6 +162,13 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
     b for odd), mirroring the reference's buffer swap loop
     (stencil-1d.cpp:54-58).
     """
+    p = _plan_active()
+    if p is not None:
+        # already one dispatch for S steps: record OPAQUE (deferred in
+        # order, dispatched through its own program at flush)
+        p.record_opaque("stencil_iterate",
+                        lambda: stencil_iterate(a_dv, b_dv, op, steps))
+        return a_dv
     cont = a_dv
     assert b_dv.layout == cont.layout
     assert uniform_layout(cont.layout), \
@@ -204,6 +219,15 @@ def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
     shards (n divisible by nshards * segment alignment).  Returns ``dv``
     stepped ``steps`` times.
     """
+    p = _plan_active()
+    if p is not None:
+        p.record_opaque(
+            "stencil_iterate_blocked",
+            lambda: stencil_iterate_blocked(dv, weights, steps,
+                                            time_block=time_block,
+                                            chunk=chunk,
+                                            interpret=interpret))
+        return dv
     cont = dv
     hb = cont.halo_bounds
     r = (len(weights) - 1) // 2
@@ -256,6 +280,13 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
     four lane columns each side by default (DR_TPU_MM_BAND_COLS moves
     the cap).  Returns ``dv`` stepped ``steps`` times.
     """
+    p = _plan_active()
+    if p is not None:
+        p.record_opaque(
+            "stencil_iterate_matmul",
+            lambda: stencil_iterate_matmul(dv, weights, steps,
+                                           k_block=k_block))
+        return dv
     from ..ops import stencil_matmul
     cont = dv
     hb = cont.halo_bounds

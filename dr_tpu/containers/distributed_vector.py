@@ -41,6 +41,15 @@ from ..utils.spmd_guard import TappedCache
 __all__ = ["distributed_vector", "halo"]
 
 
+def _plan_flush(reason: str) -> None:
+    """Host-visible reads/writes of container state are deferred-plan
+    flush points (dr_tpu/plan.py): pending recorded ops must land
+    before ``_data`` is observed or externally rebound.  Lazy import —
+    the plan module builds on the algorithm layer above this one."""
+    from ..plan import flush_reads
+    flush_reads(reason)
+
+
 def _normalize_dtype(dtype):
     if dtype is None:
         return jnp.float32
@@ -178,6 +187,7 @@ class distributed_vector:
     # ----------------------------------------------------------- value APIs
     def to_array(self) -> jax.Array:
         """Current logical value as a 1-D jax array of length n."""
+        _plan_flush("to_array")
         if self._dist_entry is not None:
             return _extract_uneven(self._rt.mesh, self.layout,
                                    self._dtype)(self._data)
@@ -187,6 +197,7 @@ class distributed_vector:
 
     def assign_array(self, values) -> None:
         """Rebind the whole logical value (ghost cells reset to zero)."""
+        _plan_flush("assign_array")
         values = jnp.asarray(values, self._dtype)
         assert values.shape == (self._n,)
         if self._dist_entry is not None:
@@ -212,6 +223,7 @@ class distributed_vector:
         return to_host(self.to_array()[begin:end])
 
     def _local_values(self, rank: int, begin: int, end: int):
+        _plan_flush("local segment read")
         lo = self._rank_window(rank)[0]
         prev = self._hb.prev
         for sh in self._data.addressable_shards:
@@ -251,12 +263,14 @@ class distributed_vector:
     def get(self, indices):
         """Batched remote read (replaces per-element MPI_Rget,
         dv.hpp:109-116)."""
+        _plan_flush("get")
         r, c = self._locate(self._check_indices(indices))
         return self._data[r, c]
 
     def put(self, indices, values) -> None:
         """Batched remote write (replaces per-element MPI_Put,
         dv.hpp:118-122)."""
+        _plan_flush("put")
         r, c = self._locate(self._check_indices(indices))
         self._data = self._data.at[r, c].set(
             jnp.asarray(values, self._dtype))
@@ -272,6 +286,7 @@ class distributed_vector:
             i += self._n
         if not 0 <= i < self._n:
             raise IndexError(i)
+        _plan_flush("__getitem__")
         if self._starts is not None:
             r = int(np.searchsorted(self._starts, i, side="right")) - 1
             return self._data[r,
@@ -301,6 +316,7 @@ class distributed_vector:
         return to_host(self.to_array())
 
     def block_until_ready(self) -> "distributed_vector":
+        _plan_flush("block_until_ready")
         jax.block_until_ready(self._data)
         return self
 

@@ -199,11 +199,10 @@ def _exchange_program(mesh, axis, nshards, seg, prev, nxt, periodic, n):
     return jax.jit(shmapped, donate_argnums=0)
 
 
-def _exchange_n_program(mesh, axis, nshards, seg, prev, nxt, periodic, n,
-                        iters):
-    """``iters`` exchanges fused into ONE program (lax.fori_loop): no host
-    dispatch between rounds — the device-side latency of a single ring
-    exchange is this program's time / iters.
+def _exchange_n_body(axis, nshards, seg, prev, nxt, periodic, n, iters):
+    """Un-jitted shard-row body of ``iters`` fused exchanges — shared by
+    :func:`_exchange_n_program` and the deferred-plan emitter
+    (dr_tpu/plan.py), so the two paths cannot drift.
 
     The loop carries ONLY the ghost regions: an exchange never writes
     owned cells, so each round reads the same owned edges from the
@@ -242,13 +241,24 @@ def _exchange_n_program(mesh, axis, nshards, seg, prev, nxt, periodic, n,
                                     next(fin) if prev else None,
                                     next(fin) if nxt else None)
 
+    return loop
+
+
+def _exchange_n_program(mesh, axis, nshards, seg, prev, nxt, periodic, n,
+                        iters):
+    """``iters`` exchanges fused into ONE program (lax.fori_loop): no host
+    dispatch between rounds — the device-side latency of a single ring
+    exchange is this program's time / iters."""
+    loop = _exchange_n_body(axis, nshards, seg, prev, nxt, periodic, n,
+                            iters)
     shmapped = jax.shard_map(
         loop, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
     return jax.jit(shmapped, donate_argnums=0)
 
 
-def _reduce_program(mesh, axis, nshards, seg, prev, nxt, periodic, op, n):
-    """Reverse path: fold ghost contributions back into their owners."""
+def _reduce_body(axis, nshards, seg, prev, nxt, periodic, op, n):
+    """Un-jitted shard-row body of the ghost->owner fold — shared by
+    :func:`_reduce_program` and the deferred-plan emitter."""
     fwd, bwd = _ring_perms(nshards, periodic)
     tail = n - (nshards - 1) * seg
     uniform = _uniform_valid(nshards, seg, n)
@@ -286,6 +296,12 @@ def _reduce_program(mesh, axis, nshards, seg, prev, nxt, periodic, op, n):
                 jnp.where(got, _combine(op, owned, recv), owned))
         return new
 
+    return body
+
+
+def _reduce_program(mesh, axis, nshards, seg, prev, nxt, periodic, op, n):
+    """Reverse path: fold ghost contributions back into their owners."""
+    body = _reduce_body(axis, nshards, seg, prev, nxt, periodic, op, n)
     shmapped = jax.shard_map(
         body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
     return jax.jit(shmapped, donate_argnums=0)
@@ -368,6 +384,13 @@ class span_halo:
         hb = dv.halo_bounds
         if hb.width == 0 or dv.nshards == 0:
             return
+        from ..plan import active as _plan_active
+        p = _plan_active()
+        if p is not None:
+            # deferred region: the exchange/reduce body fuses into the
+            # plan's run (the flush dispatches under the plan.flush site)
+            p.record_halo(dv, kind, op)
+            return
         # injection sites fire BEFORE the dispatch: a faulted exchange
         # never enqueues, so the container's value stays consistent
         _faults.fire("halo.reduce" if kind == "reduce"
@@ -389,6 +412,11 @@ class span_halo:
         hb = dv.halo_bounds
         if hb.width == 0 or dv.nshards == 0 or iters <= 0:
             return
+        from ..plan import active as _plan_active
+        p = _plan_active()
+        if p is not None:
+            p.record_halo(dv, "exchange_n", None, iters)
+            return
         _faults.fire("halo.exchange")
         prog = _cached("exchange_n", dv.runtime.mesh, dv.runtime.axis,
                        dv.nshards, dv.segment_size, hb.prev, hb.next,
@@ -400,6 +428,8 @@ class span_halo:
         self._run("exchange")
 
     def exchange_finalize(self) -> None:
+        from ..plan import flush_reads
+        flush_reads("exchange_finalize")
         jax.block_until_ready(self._dv._data)
 
     # -- reduce: ghosts -> owner fold (halo.hpp:73-110) ---------------------
@@ -410,6 +440,8 @@ class span_halo:
         self._run("reduce", op)
 
     def reduce_finalize(self) -> None:
+        from ..plan import flush_reads
+        flush_reads("reduce_finalize")
         jax.block_until_ready(self._dv._data)
 
     def reduce_plus(self):

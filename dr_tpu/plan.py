@@ -1,0 +1,773 @@
+"""Deferred execution plans: cross-algorithm dispatch fusion.
+
+Every eager algorithm call is ONE dispatch through the tunneled relay —
+a drifting tens-of-milliseconds constant that dominates small/medium
+ops by up to 10x (docs/PERF.md round-2 lesson).  The bench-only ``*_n``
+fused loops prove that chaining N ops into one program + one sync
+erases that cost; this module makes the same shape reachable from the
+public API::
+
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 0.5)
+        dr_tpu.for_each(a, scale, 1.5)
+        dr_tpu.halo(a).exchange()
+        dr_tpu.transform(a, b, shift, 2.0)
+        total = dr_tpu.reduce(b)        # -> lazy PlanScalar
+    print(float(total), p.explain())
+
+Inside the region, calls to fill / iota / copy / for_each / transform /
+reduce / transform_reduce / dot / inclusive_scan / exclusive_scan /
+halo exchange+reduce / stencil_transform on segment-aligned containers
+are RECORDED instead of dispatched.  The planner groups maximal
+fusible runs (split on mesh changes and on opaque ops); each run
+compiles into ONE jitted program cached in a :class:`TappedCache`
+keyed by ``_traced_op_key``-style structural identity — BoundOp
+scalars, fill values, and host splice arrays are fed as traced
+operands, so re-recording the same structure with new values reuses
+the compiled program (zero recompile, stable spmd_guard digest).
+
+Flush points (executing the queue in record order):
+
+* **region exit** — the normal path;
+* **host materialization** — ``to_array`` / ``materialize`` / ``get`` /
+  ``put`` / indexing / ``fence`` on a container, or resolving a
+  :class:`PlanScalar`;
+* **non-fusible ops** (sort, gemv, unaligned fallback routes) — the
+  plan flushes, announces the cliff via ``warn_fallback("plan", ...)``
+  (registry-routed, chaos-countable), and the op runs eagerly;
+* explicit :meth:`Plan.flush`.
+
+Mid-chain reductions ride the carry as device scalars: a recorded
+reduce returns a :class:`PlanScalar` whose value is an output of the
+fused program; a later recorded op in the SAME run that consumes it
+references the in-program value directly (no dispatch, no sync), so an
+N-op region costs one dispatch + one sync.
+
+Semantics: a flush applies the queue in record order, so results are
+bit-identical to the eager sequence (each recorded op reads the
+threaded state its predecessors produced — exactly eager data flow).
+Cross-op float contraction is PINNED: every value crossing an op
+boundary is sealed (a runtime *1.0 plus lax.optimization_barrier), so
+the backend cannot fuse one op's multiply into the next op's add as an
+FMA the eager sequence never performed.  WITHIN one op the backend
+keeps its usual contraction freedom — an op whose own body is a
+multiply-add tree (stencil weight ops) may round a last ULP
+differently between the eager and fused compilations of the same
+math.  Ghost cells keep the same contract as eager where it is
+specified; where eager leaves them unspecified the two paths may
+differ.
+
+Failure model: ``plan.flush`` is a registered fault site
+(utils/faults — transient, program).  A fault at the flush boundary
+drops the not-yet-executed suffix of the queue (containers keep their
+pre-flush values for it; already-executed prefix runs stay applied) and
+raises the classified error — never a hang, never silent corruption.
+Unresolved :class:`PlanScalar` handles from a discarded queue raise on
+resolution instead of returning stale numbers.
+
+Observability: :meth:`Plan.explain` / :meth:`Plan.stats` report fused
+runs, flush reasons, program-cache hits, and per-flush dispatch counts
+from the spmd_guard tap (``utils.spmd_guard.dispatch_count``).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .algorithms._common import owned_window_mask
+from .algorithms.elementwise import (_apply_chain_ops, _chain_scalars,
+                                     _op_key, _traced_op_key)
+from .algorithms.reduce import _MONOIDS, _identity_for
+from .core.pinning import pinned_id
+from .utils import faults as _faults
+from .utils import spmd_guard as _guard
+from .utils.spmd_guard import TappedCache
+from .views import views as _v
+
+__all__ = ["Plan", "PlanScalar", "deferred", "active", "flush_reads",
+           "barrier"]
+
+#: Fused-run program cache.  A TappedCache so (1) every flush lookup is
+#: one counted dispatch on the spmd_guard trace, (2) the ``dispatch.cache``
+#: fault site covers deferred dispatch too, and (3) pin eviction purges
+#: entries whose keys reference dead op identities.
+_plan_cache: dict = TappedCache()
+
+_active: Optional["Plan"] = None
+
+
+def active() -> Optional["Plan"]:
+    """The currently-recording plan, or None.  Returns None while a
+    flush is executing so opaque thunks (and post-flush eager fallbacks)
+    run eagerly instead of re-recording themselves."""
+    p = _active
+    if p is None or p._flushing:
+        return None
+    return p
+
+
+def flush_reads(reason: str = "host materialization") -> None:
+    """Flush the active plan (if any) before host-visible state is
+    read or externally mutated — the container/runtime hooks call this."""
+    p = _active
+    if p is not None and not p._flushing and p._queue:
+        p.flush(reason)
+
+
+def barrier(what: str) -> None:
+    """Non-fusible-op boundary: flush the active plan (if any) with a
+    ``warn_fallback`` announcement before ``what`` dispatches eagerly."""
+    p = active()
+    if p is not None:
+        p.nonfusible(what)
+
+
+class PlanScalar:
+    """Lazy scalar from a reduction recorded in a deferred region.
+
+    Resolving it (``item()`` / ``float()`` / ``int()`` / ``bool()`` /
+    ``device()``) flushes the owning plan if needed — host
+    materialization is a flush point.  While still pending it can be
+    passed as a scalar argument to later recorded ops: within the same
+    fused run it rides the carry as an in-program device value; across
+    runs it travels as a device-scalar operand — either way, no host
+    round trip."""
+
+    __slots__ = ("_plan", "_run", "_idx", "_val", "_post", "_broken")
+
+    def __init__(self, plan: "Plan", run, idx: int):
+        self._plan = plan
+        self._run = run
+        self._idx = idx
+        self._val = None
+        self._post = None
+        self._broken = False
+
+    def with_post(self, post) -> "PlanScalar":
+        """Attach a host-side post-transform applied by :meth:`item`
+        (``reduce(r, init=...)``'s init fold)."""
+        self._post = post
+        return self
+
+    def device(self):
+        """The RAW device scalar (flushes the plan if still pending).
+        A handle carrying a host-side post (``reduce(r, init=...)``'s
+        init fold) refuses this accessor — returning the raw reduction
+        would silently drop the fold; resolve via :meth:`item`."""
+        if self._post is not None:
+            raise ValueError(
+                "this deferred scalar carries a host-side init fold; "
+                "resolve it with item()/float() instead of device()")
+        if self._val is None and not self._broken:
+            self._plan.flush("scalar read")
+        if self._val is None:
+            raise RuntimeError(
+                "deferred scalar was discarded before it resolved "
+                "(faulted flush or abandoned region)")
+        return self._val
+
+    def _raw(self):
+        """Resolved raw device scalar (internal; post NOT applied)."""
+        if self._val is None and not self._broken:
+            self._plan.flush("scalar read")
+        if self._val is None:
+            raise RuntimeError(
+                "deferred scalar was discarded before it resolved "
+                "(faulted flush or abandoned region)")
+        return self._val
+
+    def item(self):
+        v = self._raw().item()
+        return self._post(v) if self._post is not None else v
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __eq__(self, other):
+        # without this, `reduce(a) == expected` inside a region would
+        # silently compare object identity (always False) instead of
+        # resolving — the one comparison that would not raise loudly
+        if isinstance(other, PlanScalar):
+            other = other.item()
+        return self.item() == other
+
+    # resolving inside hash() would be a hidden flush; unhashable keeps
+    # the misuse loud (defining __eq__ clears the default anyway)
+    __hash__ = None
+
+    def __repr__(self):
+        state = ("broken" if self._broken
+                 else "pending" if self._val is None else repr(self._val))
+        return f"PlanScalar({state})"
+
+
+class _FusedOp:
+    """One recorded fusible op: structural cache ``key``, trace-time
+    ``emit(state, svals, souts)``, scalar ``spec`` ("t" = traced
+    operand, ("r", i) = same-run scalar output i), and this recording's
+    traced ``vals`` (parallel to the "t" entries)."""
+
+    __slots__ = ("name", "key", "emit", "spec", "vals")
+
+    def __init__(self, name, key, emit, spec=(), vals=()):
+        self.name = name
+        self.key = key
+        self.emit = emit
+        self.spec = spec
+        self.vals = list(vals)
+
+
+class _Run:
+    """A maximal fusible run: ops over one mesh, containers in
+    first-use slot order, reduction handles in scalar-output order."""
+
+    def __init__(self, mesh, axis):
+        self.mesh = mesh
+        self.axis = axis
+        self.ops: List[_FusedOp] = []
+        self.conts = []
+        self._cont_ids = {}
+        self.handles: List[PlanScalar] = []
+
+    def slot(self, cont) -> int:
+        s = self._cont_ids.get(id(cont))
+        if s is None:
+            s = len(self.conts)
+            self.conts.append(cont)
+            self._cont_ids[id(cont)] = s
+        return s
+
+
+class _Opaque:
+    """A recorded-but-not-fused op (inclusive_scan, stencil_iterate):
+    deferred until flush, executed through its eager path there — it
+    splits the fusible runs around it but keeps record order."""
+
+    __slots__ = ("name", "thunk")
+
+    def __init__(self, name, thunk):
+        self.name = name
+        self.thunk = thunk
+
+
+class Plan:
+    """A deferred execution plan: record algorithm calls, fuse maximal
+    runs, flush as few dispatches as possible.  Use via
+    :func:`deferred` or explicitly::
+
+        p = dr_tpu.plan.Plan()
+        with p.record():
+            ...
+        print(p.explain())
+    """
+
+    def __init__(self):
+        self._queue: list = []
+        self._flushing = False
+        #: structured flush log consumed by explain()/stats()
+        self.log: list = []
+
+    # ------------------------------------------------------------ region
+    @contextmanager
+    def record(self):
+        """Activate this plan for the enclosed block; flushes on clean
+        exit, discards pending (unexecuted) ops if the block raises."""
+        global _active
+        if _active is self:
+            yield self
+            return
+        if _active is not None:
+            raise RuntimeError("another deferred plan is already recording")
+        _active = self
+        try:
+            yield self
+        except BaseException:
+            self.discard("region error")
+            raise
+        else:
+            self.flush("region exit")
+        finally:
+            _active = None
+
+    # --------------------------------------------------------- recording
+    def _fusible_run(self, cont, values=()) -> _Run:
+        """The open run for this container's mesh.  A mesh change ends
+        the previous run (equal shard counts over different device sets
+        cannot share one program) — and so does consuming a pending
+        scalar of the open run that carries a HOST-side post
+        (``reduce(r, init=...)``'s init fold): the fold cannot ride the
+        in-program carry, so the producer run must execute first and
+        the consumer reads the posted host value as an operand."""
+        mesh = cont.runtime.mesh
+        q = self._queue
+        if q and isinstance(q[-1], _Run) and q[-1].mesh is mesh \
+                and not any(isinstance(v, PlanScalar)
+                            and v._run is q[-1] and v._val is None
+                            and v._post is not None for v in values):
+            return q[-1]
+        run = _Run(mesh, cont.runtime.axis)
+        q.append(run)
+        return run
+
+    def _scalar_spec(self, run: _Run, values):
+        """Split scalar operands into the structural spec and this
+        recording's traced values.  A still-pending PlanScalar of the
+        SAME run becomes an in-program reference ("r", idx); everything
+        else — plain values, resolved handles, pending handles of
+        EARLIER runs — is a traced operand fetched at flush time."""
+        spec, vals = [], []
+        for v in values:
+            if isinstance(v, PlanScalar) and v._run is run \
+                    and v._val is None and v._post is None:
+                spec.append(("r", v._idx))
+            else:
+                spec.append("t")
+                vals.append(v)
+        return tuple(spec), vals
+
+    def record_generator(self, out_chain, gkind: str, value) -> bool:
+        """fill / iota over an aligned output window; the scalar is a
+        traced operand (streaming values reuse one program)."""
+        cont = out_chain.cont
+        if gkind == "fill" and not isinstance(value, PlanScalar):
+            value = jnp.asarray(value, cont.dtype)  # eager fill's cast
+        run = self._fusible_run(cont, [value])
+        slot = run.slot(cont)
+        layout, off, n = cont.layout, out_chain.off, out_chain.n
+        spec, vals = self._scalar_spec(run, [value])
+        key = ("gen", gkind, slot, layout, off, n, str(cont.dtype), spec)
+
+        def emit(state, svals, souts):
+            out_data = state[slot]
+            mask, gid = owned_window_mask(layout, off, n)
+            if gkind == "fill":
+                v = jnp.broadcast_to(svals[0], out_data.shape)
+            else:
+                v = gid + svals[0]
+            state[slot] = jnp.where(mask, v.astype(out_data.dtype),
+                                    out_data)
+
+        run.ops.append(_FusedOp(gkind, key, emit, spec, vals))
+        return True
+
+    def record_transform(self, ins, out_chain, op, scalars,
+                         with_index=False, name="transform") -> bool:
+        """Aligned transform/for_each (the ``_window_program`` shape):
+        view-chain BoundOp scalars and trailing op scalars ride as
+        traced operands."""
+        cont = out_chain.cont
+        chain_sc = _chain_scalars(ins)
+        all_sc = list(chain_sc) + list(scalars)
+        run = self._fusible_run(cont, all_sc)
+        out_slot = run.slot(cont)
+        in_slots = tuple(run.slot(c.cont) for c in ins)
+        in_ops = tuple(c.ops for c in ins)
+        nchain = len(chain_sc)
+        spec, vals = self._scalar_spec(run, all_sc)
+        layout, off, n = cont.layout, out_chain.off, out_chain.n
+        key = ("ew", out_slot, in_slots, layout, off, n,
+               tuple(tuple(_traced_op_key(o) for o in ops)
+                     for ops in in_ops),
+               _op_key(op), with_index, str(cont.dtype), spec)
+
+        def emit(state, svals, souts):
+            sc_iter = iter(svals[:nchain])
+            op_scalars = svals[nchain:]
+            vals_in = [_apply_chain_ops(state[s], ops, sc_iter)
+                       for s, ops in zip(in_slots, in_ops)]
+            out_data = state[out_slot]
+            mask, gid = owned_window_mask(layout, off, n)
+            args = list(vals_in) + list(op_scalars)
+            if with_index:
+                v = op(gid, *args) if args else op(gid)
+            else:
+                v = op(*args) if args else op()
+            v = jnp.broadcast_to(v, out_data.shape).astype(out_data.dtype)
+            state[out_slot] = jnp.where(mask, v, out_data)
+
+        run.ops.append(_FusedOp(name, key, emit, spec, vals))
+        return True
+
+    def record_zip_foreach(self, ins, outs, fn, scalars) -> bool:
+        """Aligned for_each over a zip (the ``_zip_foreach_program``
+        shape).  Zip components are outputs, so their chains carry no
+        ops (the invariant the eager program asserts)."""
+        conts = [oc.cont for oc in outs]
+        run = self._fusible_run(conts[0], list(scalars))
+        out_slots = tuple(run.slot(c) for c in conts)
+        in_slots = tuple(run.slot(ch.cont) for ch in ins)
+        spec, vals = self._scalar_spec(run, list(scalars))
+        cont = conts[0]
+        layout, off, n = cont.layout, outs[0].off, outs[0].n
+        key = ("zfe", out_slots, in_slots, layout, off, n,
+               tuple(str(c.dtype) for c in conts), _op_key(fn), spec)
+
+        def emit(state, svals, souts):
+            vals_in = [state[s] for s in in_slots]
+            new_vals = fn(*vals_in, *svals)
+            mask, _gid = owned_window_mask(layout, off, n)
+            for s, nv in zip(out_slots, new_vals):
+                state[s] = jnp.where(mask, nv.astype(state[s].dtype),
+                                     state[s])
+
+        run.ops.append(_FusedOp("for_each(zip)", key, emit, spec, vals))
+        return True
+
+    def record_reduce(self, chains, kind: str, zip_op=None) -> PlanScalar:
+        """Classified-monoid reduce (single chain or the dot-pipeline
+        transform-over-zip shape): the scalar result becomes a program
+        output riding the carry — no mid-chain sync."""
+        c0 = chains[0]
+        cont = c0.cont
+        chain_sc = _chain_scalars(chains)
+        zsc = list(zip_op.scalars) if isinstance(zip_op, _v.BoundOp) else []
+        all_sc = list(chain_sc) + zsc
+        run = self._fusible_run(cont, all_sc)
+        slots = tuple(run.slot(c.cont) for c in chains)
+        all_ops = tuple(c.ops for c in chains)
+        nchain = len(chain_sc)
+        spec, vals = self._scalar_spec(run, all_sc)
+        layout, off, n = cont.layout, c0.off, c0.n
+        key = ("red", slots, layout, off, n, kind,
+               tuple(tuple(_traced_op_key(o) for o in ops)
+                     for ops in all_ops),
+               _traced_op_key(zip_op) if zip_op is not None else None,
+               spec)
+        vec_reduce = _MONOIDS[kind][0]
+
+        def emit(state, svals, souts):
+            sc_iter = iter(svals[:nchain])
+            zip_scalars = svals[nchain:]
+            vs = [_apply_chain_ops(state[s], ops, sc_iter)
+                  for s, ops in zip(slots, all_ops)]
+            if zip_op is None:
+                v = vs[0]
+            elif isinstance(zip_op, _v.BoundOp):
+                v = zip_op.op(*vs, *zip_scalars)
+            else:
+                v = zip_op(*vs)
+            mask, _gid = owned_window_mask(layout, off, n)
+            souts.append(vec_reduce(
+                jnp.where(mask, v, _identity_for(kind, v.dtype))))
+
+        handle = PlanScalar(self, run, len(run.handles))
+        run.handles.append(handle)
+        run.ops.append(_FusedOp("reduce", key, emit, spec, vals))
+        return handle
+
+    def record_splice(self, out_chain, values) -> bool:
+        """Host array -> container window copy; the array is a traced
+        operand (key carries shape+dtype only).  Mirrors the eager
+        ``_write_window``/``assign_array`` route bit-for-bit, ghost
+        zeroing included."""
+        cont = out_chain.cont
+        layout, off, n = cont.layout, out_chain.off, out_chain.n
+        shp = tuple(getattr(values, "shape", ()))
+        if shp != (n,):
+            # the eager route raises from _write_window's windowed set;
+            # the clipped gather below would silently corrupt instead
+            raise ValueError(
+                f"copy: source shape {shp} does not match the "
+                f"destination window ({n},)")
+        run = self._fusible_run(cont, [values])
+        slot = run.slot(cont)
+        total = len(cont)
+        spec, vals = self._scalar_spec(run, [values])
+        key = ("splice", slot, layout, off, n, str(cont.dtype),
+               tuple(getattr(values, "shape", ())), spec)
+
+        def emit(state, svals, souts):
+            out_data = state[slot]
+            dtype = out_data.dtype
+            mask, gid = owned_window_mask(layout, off, n)
+            if n > 0:
+                take = jnp.take(svals[0], jnp.clip(gid - off, 0, n - 1))
+                new = jnp.where(mask, take.astype(dtype), out_data)
+            else:
+                new = out_data
+            owned, _ = owned_window_mask(layout, 0, total)
+            state[slot] = jnp.where(owned, new, jnp.zeros((), dtype))
+
+        run.ops.append(_FusedOp("copy(host)", key, emit, spec, vals))
+        return True
+
+    def record_halo(self, dv, kind: str, op=None, iters: int = 1) -> bool:
+        """Halo exchange / exchange_n / ghost->owner reduce: the same
+        shard_map bodies as the eager programs, inlined into the run."""
+        run = self._fusible_run(dv)
+        slot = run.slot(dv)
+        hb = dv.halo_bounds
+        knobs = (os.environ.get("DR_TPU_HALO_NCARRY", "ghost"),
+                 os.environ.get("DR_TPU_HALO_DYNAMIC", ""))
+        key = ("halo", kind, slot, dv.layout, hb.periodic, op, iters,
+               knobs)
+        nshards, seg = dv.nshards, dv.segment_size
+        prev, nxt, periodic, n = hb.prev, hb.next, hb.periodic, len(dv)
+        axis, mesh = dv.runtime.axis, dv.runtime.mesh
+
+        def emit(state, svals, souts):
+            from .parallel import halo as _halo
+            if kind == "exchange":
+                body = _halo._exchange_body(axis, nshards, seg, prev,
+                                            nxt, periodic, n)
+            elif kind == "exchange_n":
+                body = _halo._exchange_n_body(axis, nshards, seg, prev,
+                                              nxt, periodic, n, iters)
+            else:
+                body = _halo._reduce_body(axis, nshards, seg, prev, nxt,
+                                          periodic, op, n)
+            shm = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                                out_specs=P(axis, None))
+            state[slot] = shm(state[slot])
+
+        run.ops.append(_FusedOp(f"halo.{kind}", key, emit))
+        return True
+
+    def record_stencil(self, in_cont, out_cont, layout, periodic,
+                       prev, nxt, key_op, body_op, axis, mesh) -> bool:
+        """One fused exchange+transform stencil step (the
+        ``build_stencil_step`` body), inlined into the run."""
+        run = self._fusible_run(out_cont)
+        si, so = run.slot(in_cont), run.slot(out_cont)
+        key = ("stencil", si, so, layout, periodic, prev, nxt, key_op,
+               str(out_cont.dtype))
+
+        def emit(state, svals, souts):
+            from .algorithms.stencil import build_stencil_step
+            step = build_stencil_step(layout, periodic, body_op, prev,
+                                      nxt, axis)
+            shm = jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(P(axis, None), P(axis, None)),
+                out_specs=P(axis, None))
+            state[so] = shm(state[si], state[so])
+
+        run.ops.append(_FusedOp("stencil", key, emit))
+        return True
+
+    def record_opaque(self, name: str, thunk) -> bool:
+        """Record a deferred-but-not-fused op (its eager path runs at
+        flush, in record order); it closes the current fusible run."""
+        self._queue.append(_Opaque(name, thunk))
+        return True
+
+    def nonfusible(self, what: str) -> None:
+        """A non-fusible op is about to dispatch eagerly: flush pending
+        work (order!) and announce the perf cliff through the fallback
+        registry — silent flushes in deferred mode would hide exactly
+        the dispatch cost the region was opened to avoid."""
+        if not self._queue:
+            return
+        from .utils.fallback import warn_fallback
+        warn_fallback("plan", f"non-fusible {what} forced a flush")
+        self.flush(f"non-fusible: {what}")
+
+    # ----------------------------------------------------------- flushing
+    def flush(self, reason: str = "explicit") -> None:
+        """Execute the recorded queue: one dispatch per fused run, the
+        eager path for opaque ops, in record order.  On an error the
+        unexecuted suffix is dropped (containers keep their pre-flush
+        values for it) and pending handles break — never a hang."""
+        if self._flushing or not self._queue:
+            return
+        queue, self._queue = self._queue, []
+        self._flushing = True
+        entry = {"reason": reason, "items": []}
+        self.log.append(entry)
+        d0 = _guard.dispatch_count()
+        try:
+            # the injection site fires BEFORE any dispatch: a faulted
+            # flush executes nothing and containers stay consistent
+            _faults.fire("plan.flush")
+            for item in queue:
+                di = _guard.dispatch_count()
+                if isinstance(item, _Opaque):
+                    item.thunk()
+                    entry["items"].append(
+                        {"kind": "opaque", "name": item.name,
+                         "dispatches": _guard.dispatch_count() - di})
+                else:
+                    hit = self._exec_run(item)
+                    entry["items"].append(
+                        {"kind": "fused",
+                         "ops": [o.name for o in item.ops],
+                         "containers": len(item.conts),
+                         "cache_hit": hit,
+                         "dispatches": _guard.dispatch_count() - di})
+        except BaseException:
+            for item in queue:
+                if isinstance(item, _Run):
+                    for h in item.handles:
+                        if h._val is None:
+                            h._broken = True
+                            h._run = None
+            entry["error"] = True
+            raise
+        finally:
+            entry["dispatches"] = _guard.dispatch_count() - d0
+            self._flushing = False
+
+    def _exec_run(self, run: _Run) -> bool:
+        key = ("plan", pinned_id(run.mesh), run.axis,
+               tuple((c.layout, str(c.dtype)) for c in run.conts),
+               tuple(o.key for o in run.ops))
+        prog = _plan_cache.get(key)
+        hit = prog is not None
+        if prog is None:
+            ops = tuple(run.ops)
+            nslots = len(run.conts)
+
+            def seal(x, one):
+                # Op boundaries are PROGRAM boundaries eagerly, but the
+                # CPU backend contracts a producer op's multiply into a
+                # consumer op's add as an FMA even across
+                # lax.optimization_barrier — a last-ULP divergence from
+                # the eager sequence.  Routing every inexact value that
+                # crosses an op boundary through a multiply by a RUNTIME
+                # 1.0 operand (a parameter, so nothing folds it) makes
+                # any downstream contraction absorb the exact *1 instead
+                # of the upstream multiply: results equal the eagerly-
+                # rounded chain bit-for-bit, while WITHIN-op contraction
+                # (which eager programs also perform) is untouched.
+                if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+                    return x * one.astype(x.dtype)
+                return x
+
+            def body(*args):
+                state = list(args[:nslots])
+                one = args[nslots]
+                tail = iter(args[nslots + 1:])
+                souts = []
+                for o in ops:
+                    svals = [souts[s[1]] if isinstance(s, tuple)
+                             else next(tail) for s in o.spec]
+                    before = list(state)
+                    nsout = len(souts)
+                    o.emit(state, svals, souts)
+                    for i in range(nslots):
+                        if state[i] is not before[i]:
+                            state[i] = seal(state[i], one)
+                    for j in range(nsout, len(souts)):
+                        souts[j] = seal(souts[j], one)
+                    # and pin HLO-level motion/fusion across the boundary
+                    sealed = jax.lax.optimization_barrier(
+                        tuple(state) + tuple(souts))
+                    state = list(sealed[:nslots])
+                    souts = list(sealed[nslots:])
+                return tuple(state) + tuple(souts)
+
+            prog = jax.jit(body, donate_argnums=tuple(range(nslots)))
+            _plan_cache[key] = prog
+        tail = []
+        for o in run.ops:
+            for v in o.vals:
+                if isinstance(v, PlanScalar):
+                    # posted handles resolve through item() so the
+                    # host-side init fold is APPLIED, not dropped (the
+                    # producer run has already executed — record order)
+                    v = v.item() if v._post is not None else v._raw()
+                tail.append(v)
+        outs = prog(*[c._data for c in run.conts], jnp.float32(1.0),
+                    *tail)
+        # the cached program's closure pins this run's _FusedOp objects;
+        # drop their operand values (a host splice array can be
+        # container-sized) — only spec/emit are needed for later hits
+        for o in run.ops:
+            o.vals = []
+        nslots = len(run.conts)
+        for c, nd in zip(run.conts, outs[:nslots]):
+            c._data = nd
+        for h, val in zip(run.handles, outs[nslots:]):
+            h._val = val
+            h._run = None
+        return hit
+
+    def discard(self, reason: str = "discard") -> None:
+        """Drop every pending item without executing it; pending
+        handles break (resolving them raises instead of lying)."""
+        queue, self._queue = self._queue, []
+        for item in queue:
+            if isinstance(item, _Run):
+                for h in item.handles:
+                    h._broken = True
+                    h._run = None
+        if queue:
+            self.log.append({"reason": reason, "items": [],
+                             "discarded": len(queue), "dispatches": 0})
+
+    # ------------------------------------------------------ observability
+    @property
+    def dispatches(self) -> int:
+        """Total tap dispatches across this plan's flushes."""
+        return sum(e.get("dispatches", 0) for e in self.log)
+
+    def stats(self) -> dict:
+        items = [i for e in self.log for i in e.get("items", [])]
+        fused = [i for i in items if i["kind"] == "fused"]
+        return {
+            "flushes": len(self.log),
+            "fused_runs": len(fused),
+            "fused_ops": sum(len(i["ops"]) for i in fused),
+            "opaque_ops": sum(1 for i in items if i["kind"] == "opaque"),
+            "cache_hits": sum(1 for i in fused if i["cache_hit"]),
+            "dispatches": self.dispatches,
+        }
+
+    def explain(self) -> str:
+        """Human-readable plan report: fused runs, flush reasons, and
+        per-flush dispatch counts from the spmd_guard tap."""
+        s = self.stats()
+        lines = [
+            f"plan: {s['flushes']} flush(es), {s['fused_runs']} fused "
+            f"run(s) over {s['fused_ops']} op(s), {s['opaque_ops']} "
+            f"opaque op(s), {s['dispatches']} dispatch(es), "
+            f"{s['cache_hits']} program-cache hit(s)"]
+        for e in self.log:
+            tag = " [ERROR]" if e.get("error") else ""
+            lines.append(f"  flush ({e['reason']}){tag}: "
+                         f"{e.get('dispatches', 0)} dispatch(es)")
+            for it in e.get("items", []):
+                if it["kind"] == "fused":
+                    lines.append(
+                        f"    fused run [{len(it['ops'])} ops, "
+                        f"{it['containers']} container(s), "
+                        f"{'hit' if it['cache_hit'] else 'compile'}]: "
+                        + " -> ".join(it["ops"]))
+                else:
+                    lines.append(
+                        f"    opaque {it['name']} "
+                        f"({it['dispatches']} dispatch(es))")
+            if e.get("discarded"):
+                lines.append(
+                    f"    discarded {e['discarded']} pending item(s)")
+        return "\n".join(lines)
+
+
+@contextmanager
+def deferred():
+    """Deferred-execution region: algorithm calls on segment-aligned
+    containers record into a :class:`Plan` and flush (fused, usually
+    ONE dispatch) at region exit or any host materialization.  Nesting
+    re-enters the active plan.  Yields the plan for
+    :meth:`Plan.explain` / :meth:`Plan.stats`."""
+    if _active is not None:
+        yield _active
+        return
+    p = Plan()
+    with p.record():
+        yield p

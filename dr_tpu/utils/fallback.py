@@ -5,8 +5,11 @@ run through a materialized logical array instead (device-side gather →
 global op → re-scatter).  After the round-5 burn-down, no
 SINGLE-component distributed shape materializes; the warned routes
 left are the scan catch-all (multi-component or host, non-distributed,
-inputs) and reduce's multi-component custom-op range (a transform over
-a zip with an unclassified op — round 6).
+inputs), reduce's multi-component custom-op range (a transform over
+a zip with an unclassified op — round 6), and the deferred-plan
+``"plan"`` route (round 8): a non-fusible op (sort, gemv, a
+materialize-route transform) forcing a recorded region to flush — the
+dispatch-fusion cliff made audible.
 Each is correct but collective-suboptimal, and VERDICT r3 item 5 calls
 the silent version a perf cliff: this module makes every such fallback
 announce itself ONCE per (operation, reason) pair so users see the

@@ -26,6 +26,11 @@ halo.reduce          span_halo.reduce               transient, oom, program
 checkpoint.write     checkpoint.save (pre-replace)  transient, truncate,
                                                     program
 checkpoint.read      checkpoint.load                transient, program
+plan.flush           deferred-plan flush boundary   transient, program
+                     (dr_tpu/plan.py — fires
+                     before any queued dispatch;
+                     a faulted flush drops the
+                     unexecuted queue cleanly)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -80,6 +85,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "halo.reduce": ("transient", "oom", "program"),
     "checkpoint.write": ("transient", "truncate", "program"),
     "checkpoint.read": ("transient", "program"),
+    "plan.flush": ("transient", "program"),
     "fallback.warn": (),
 }
 

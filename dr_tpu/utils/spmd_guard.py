@@ -40,7 +40,7 @@ from ..core.pinning import PinnedId, _pins
 from . import faults
 
 __all__ = ["guard", "active", "DivergenceError", "TappedCache",
-           "first_divergence"]
+           "first_divergence", "dispatch_count"]
 
 
 class DivergenceError(RuntimeError):
@@ -250,6 +250,17 @@ class SpmdGuard:
 
 _active: Optional[SpmdGuard] = None
 
+#: process-lifetime dispatch counter: every TappedCache lookup (= every
+#: algorithm/plan dispatch) increments it, guard active or not.  One
+#: int add on the hot path; bench.py's ``detail.dispatch_counts`` and
+#: plan.explain()'s per-run figures are diffs of this counter.
+_dispatches: int = 0
+
+
+def dispatch_count() -> int:
+    """Monotonic count of tapped dispatches in this process."""
+    return _dispatches
+
 
 def active() -> Optional[SpmdGuard]:
     return _active
@@ -257,6 +268,8 @@ def active() -> Optional[SpmdGuard]:
 
 def record(key) -> None:
     """Called by the shared program cache on every dispatch lookup."""
+    global _dispatches
+    _dispatches += 1
     if _active is not None:
         _active.record(key)
 

@@ -62,3 +62,17 @@ def test_bench_json_contract():
             assert "sort_phase_dominant" in d
             assert all(vv >= 0
                        for vv in d["sort_phases_gbps"].values())
+    # round 8: the deferred-pipeline config and the tap dispatch counts
+    # ride every artifact (ISSUE 3 acceptance: the CPU-fallback bench
+    # emits pipeline_gbps + dispatch_counts)
+    assert "pipeline_gbps" in d or "pipeline_error" in d, \
+        "missing detail.pipeline_gbps"
+    assert "dispatch_counts" in d
+    dc = d["dispatch_counts"]
+    assert dc.get("headline_timed_run", 0) >= 1
+    if "pipeline_gbps" in d:
+        assert set(d["pipeline_gbps"]) == {"eager", "deferred"}
+        assert all(v > 0 for v in d["pipeline_gbps"].values())
+        # the whole point: a deferred chain costs (far) fewer dispatches
+        assert dc["pipeline_chain_deferred"] < dc["pipeline_chain_eager"]
+        assert dc["pipeline_chain_deferred"] <= 2

@@ -164,3 +164,26 @@ def test_dead_relay_skips_probe_entirely(monkeypatch, bench):
     env = ei.value.env
     assert env["_DR_TPU_BENCH_CPU_FALLBACK"] == "1"
     assert "probe skipped" in env["_DR_TPU_BENCH_DEGRADED"]
+
+
+@pytest.mark.parametrize("flag", ["--phases", "--pipeline"])
+def test_cli_flags_survive_both_re_execs(monkeypatch, bench, flag):
+    """--phases/--pipeline must ride sys.argv through BOTH exec legs
+    (retry-in-fresh-process and CPU fallback), or a degraded run would
+    silently drop the ladder the operator asked for (round 6 lesson,
+    extended to the round-8 pipeline flag)."""
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", flag])
+    # leg 1: first failure -> retry exec
+    monkeypatch.delenv("_DR_TPU_BENCH_RETRY", raising=False)
+    monkeypatch.delenv("_DR_TPU_BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.setattr(bench, "_relay_listening", lambda: True)
+    _arm(monkeypatch, bench, (None, "UNAVAILABLE: boom"))
+    with pytest.raises(_Exec) as ei:
+        bench._devices_or_die(1.0)
+    assert flag in ei.value.argv
+    # leg 2: retry failure -> CPU-fallback exec
+    monkeypatch.setenv("_DR_TPU_BENCH_RETRY", "1")
+    with pytest.raises(_Exec) as ei:
+        bench._devices_or_die(1.0)
+    assert ei.value.env["_DR_TPU_BENCH_CPU_FALLBACK"] == "1"
+    assert flag in ei.value.argv

@@ -23,12 +23,16 @@ ROUNDS = int(os.environ.get("DR_TPU_CHAOS_ROUNDS", "1"))
 DEADLINE = float(os.environ.get("DR_TPU_CHAOS_DEADLINE", "180"))
 
 
+def _half(x):
+    return x * 0.5
+
+
 def _battery(tmpdir: str, tag: str) -> None:
     """One pass through the programs the resilience layer protects,
     visiting EVERY registered injection site (asserted by
     test_battery_reaches_every_site): probe -> init -> dispatch cache ->
     halo exchange/reduce -> collectives shift/alltoall -> sort -> scan
-    -> checkpoint write/read -> fallback.warn."""
+    -> deferred-plan flush -> checkpoint write/read -> fallback.warn."""
     from dr_tpu.parallel.runtime import probe_devices
     devs, err = probe_devices(30.0)
     if err is not None:
@@ -59,6 +63,16 @@ def _battery(tmpdir: str, tag: str) -> None:
     np.testing.assert_allclose(dr_tpu.to_numpy(out),
                                np.cumsum(src, dtype=np.float32),
                                rtol=1e-4, atol=1e-5)
+
+    # deferred-plan flush (round 8): the plan.flush site fires at the
+    # region-exit flush boundary; a fault there must surface classified
+    # with the container untouched — never a hang
+    pv = dr_tpu.distributed_vector.from_array(src)
+    with dr_tpu.deferred():
+        dr_tpu.fill(pv, 2.0)
+        dr_tpu.for_each(pv, _half)
+        tot = dr_tpu.reduce(pv)
+    assert abs(float(tot) - n) < 1e-3
 
     ck = os.path.join(tmpdir, f"chaos_{tag}.npz")
     dr_tpu.checkpoint.save(ck, dr_tpu.distributed_vector.from_array(src))

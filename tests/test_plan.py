@@ -1,0 +1,499 @@
+"""Deferred execution plans (ISSUE 3, dr_tpu/plan.py).
+
+Acceptance pins: a recorded 8-op chain (fill -> for_each -> exchange ->
+transform -> reduce ...) executes in <= 2 tap dispatches, BIT-identical
+to the eager sequence; re-recording with new scalar values compiles
+ZERO new programs and keeps the spmd_guard dispatch digest stable.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import dr_tpu
+from dr_tpu import plan as dr_plan
+from dr_tpu import views
+from dr_tpu.utils import fallback, faults, resilience, spmd_guard
+from dr_tpu.algorithms.elementwise import _prog_cache
+
+
+# module-level ops: program-cache keys pin callable identity, so tests
+# must not mint fresh lambdas per call
+def _scale(x, c):
+    return x * c
+
+
+def _shift(x, c):
+    return x + c
+
+
+def _mul2(x, y):
+    return x * y
+
+
+def _swap_sum(x, y):
+    return (x + y, x - y)
+
+
+def _pair(n, hb=None, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    src = rng.standard_normal(n).astype(dtype)
+    return (dr_tpu.distributed_vector.from_array(src, halo=hb),
+            dr_tpu.distributed_vector.from_array(src, halo=hb))
+
+
+def test_deferred_8op_chain_dispatches_and_bit_identity():
+    """The ISSUE 3 acceptance chain: <= 2 dispatches, bit-identical."""
+    P = dr_tpu.nprocs()
+    n = 24 * P
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    ea, da = _pair(n, hb, seed=1)
+    eb, db = _pair(n, hb, seed=2)
+
+    def chain(a, b):
+        dr_tpu.fill(a, 0.25)                             # 1
+        dr_tpu.iota(b, 3)                                # 2
+        dr_tpu.for_each(a, _scale, 1.5)                  # 3
+        dr_tpu.halo(a).exchange()                        # 4
+        dr_tpu.transform(views.zip(a, b), b, _mul2)      # 5
+        dr_tpu.for_each(b, _shift, 2.0)                  # 6
+        dr_tpu.halo(a).reduce_plus()                     # 7
+        return dr_tpu.reduce(b)                          # 8
+
+    want = chain(ea, eb)
+    d0 = spmd_guard.dispatch_count()
+    with dr_tpu.deferred() as p:
+        got = chain(da, db)
+    used = spmd_guard.dispatch_count() - d0
+    assert used <= 2, p.explain()
+    assert isinstance(got, dr_plan.PlanScalar)
+    assert float(got) == want
+    np.testing.assert_array_equal(dr_tpu.to_numpy(da), dr_tpu.to_numpy(ea))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(db), dr_tpu.to_numpy(eb))
+    st = p.stats()
+    assert st["fused_runs"] == 1 and st["fused_ops"] == 8
+    assert st["dispatches"] == used
+
+
+def test_zero_recompile_and_stable_digest():
+    """Re-recording with new fill values / op coefficients must hit the
+    compiled program: zero new cache entries, identical guard digest."""
+    P = dr_tpu.nprocs()
+    n = 16 * P
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    a = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+    b = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+
+    def region(fv, cv):
+        with dr_tpu.deferred():
+            dr_tpu.fill(a, fv)
+            dr_tpu.for_each(a, _scale, cv)
+            dr_tpu.halo(a).exchange()
+            dr_tpu.transform(a, b, _shift, cv)
+            s = dr_tpu.reduce(b)
+        return float(s)
+
+    v1 = region(2.0, 1.5)
+    n_plan, n_ew = len(dr_plan._plan_cache), len(_prog_cache)
+    with spmd_guard.guard() as g1:
+        v2 = region(3.0, 2.5)
+    assert len(dr_plan._plan_cache) == n_plan, "plan cache grew"
+    assert len(_prog_cache) == n_ew, "eager program cache grew"
+    with spmd_guard.guard() as g2:
+        v3 = region(-1.0, 0.5)
+    assert g1.digest() == g2.digest(), "dispatch digest drifted"
+    # the values must still respond to the scalars (not baked in)
+    assert v1 == n * (2.0 * 1.5 + 1.5)
+    assert v2 == n * (3.0 * 2.5 + 2.5)
+    assert v3 == n * 0.0
+
+
+def test_reduction_rides_the_carry():
+    """A mid-chain reduce feeds a later op in the SAME region without
+    leaving the device: still exactly one dispatch."""
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    d0 = spmd_guard.dispatch_count()
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 2.0)
+        tot = dr_tpu.reduce(a)           # 2n, pending
+        dr_tpu.fill(b, tot)              # in-program scalar ref
+        tot2 = dr_tpu.reduce(b)
+    assert spmd_guard.dispatch_count() - d0 == 1, p.explain()
+    assert float(tot) == 2.0 * n
+    assert float(tot2) == 2.0 * n * n
+
+
+def test_posted_scalar_feeding_later_op_keeps_init_fold():
+    """reduce(r, init=...) carries a HOST-side fold: consuming the
+    handle in a later recorded op must apply it (the producer run
+    splits off and the consumer reads the posted value), not drop it
+    for the raw in-program carry."""
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    ea = dr_tpu.distributed_vector(n, np.float32)
+    eb = dr_tpu.distributed_vector(n, np.float32)
+    dr_tpu.fill(ea, 2.0)
+    es = dr_tpu.reduce(ea, 10.0)
+    dr_tpu.fill(eb, es)
+    want = dr_tpu.to_numpy(eb)
+
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 2.0)
+        s = dr_tpu.reduce(a, 10.0)
+        dr_tpu.fill(b, s)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(b), want)
+    assert float(s) == 10.0 + 2.0 * n
+    assert p.stats()["fused_runs"] == 2  # producer/consumer split
+    # the raw-device accessor refuses posted handles instead of lying
+    with pytest.raises(ValueError):
+        s.device()
+
+
+def test_plan_scalar_equality_resolves():
+    """`reduce(a) == expected` inside a region must resolve (flush)
+    rather than silently compare object identity."""
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred():
+        dr_tpu.fill(a, 2.0)
+        s = dr_tpu.reduce(a)
+        assert s == 2.0 * n
+        assert s != 2.0 * n + 1
+        assert s == dr_tpu.reduce(a)  # PlanScalar vs PlanScalar
+    with pytest.raises(TypeError):
+        hash(s)  # hashing would be a hidden flush: loudly unhashable
+
+
+def test_scalar_read_flushes_mid_region():
+    """Resolving a PlanScalar inside the region is a host-materialization
+    flush point; recording continues afterwards in a fresh run."""
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 3.0)
+        tot = dr_tpu.reduce(a)
+        assert float(tot) == 3.0 * n      # forces a flush
+        dr_tpu.for_each(a, _shift, 1.0)   # records into a second run
+    assert dr_tpu.to_numpy(a)[0] == 4.0
+    assert p.stats()["flushes"] == 2
+    assert "scalar read" in p.explain()
+
+
+def test_reduce_init_and_transform_reduce_deferred():
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    ea = dr_tpu.distributed_vector(n, np.float32)
+    dr_tpu.fill(ea, 2.0)
+    want = dr_tpu.reduce(ea, 10.0)
+    want_tr = dr_tpu.transform_reduce(ea, transform_op=_scale,
+                                      transform_args=(3.0,))
+    want_dot = dr_tpu.dot(ea, ea, init=1.0)
+    with dr_tpu.deferred():
+        dr_tpu.fill(a, 2.0)
+        got = dr_tpu.reduce(a, 10.0)
+        got_tr = dr_tpu.transform_reduce(a, transform_op=_scale,
+                                         transform_args=(3.0,))
+        got_dot = dr_tpu.dot(a, a, init=1.0)
+    assert float(got) == want
+    assert float(got_tr) == want_tr
+    assert float(got_dot) == want_dot
+
+
+def test_host_materialization_flushes():
+    """to_numpy / indexing / get() inside the region observe the
+    recorded writes (the container hooks flush first)."""
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 7.0)
+        assert a[0] == 7.0               # __getitem__ flush
+        dr_tpu.for_each(a, _shift, 1.0)
+        np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                      np.full(n, 8.0, np.float32))
+    assert p.stats()["flushes"] >= 2
+
+
+def test_nonfusible_sort_flushes_and_warns(monkeypatch):
+    """sort inside a region forces a flush, announced through the
+    fallback registry (warn_fallback("plan", ...)) — and the recorded
+    prefix lands BEFORE the sort, preserving program order."""
+    monkeypatch.delenv("DR_TPU_SILENCE_FALLBACKS", raising=False)
+    fallback.reset()
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    rng = np.random.default_rng(5)
+    src = rng.standard_normal(n).astype(np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with dr_tpu.deferred() as p:
+            dr_tpu.for_each(a, _scale, -1.0)
+            dr_tpu.sort(a)
+    hits = [x for x in w
+            if issubclass(x.category, fallback.MaterializeFallbackWarning)
+            and "dr_tpu.plan" in str(x.message)]
+    assert hits, [str(x.message) for x in w]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a), np.sort(-src))
+    assert any("non-fusible" in e["reason"] for e in p.log)
+
+
+def test_opaque_scan_keeps_order():
+    P = dr_tpu.nprocs()
+    n = 16 * P
+    src = np.arange(n, dtype=np.float32)
+    e_in, d_in = _pair(n, seed=3)
+    e_out = dr_tpu.distributed_vector(n, np.float32)
+    d_out = dr_tpu.distributed_vector(n, np.float32)
+    e_in.assign_array(src)
+    d_in.assign_array(src)
+
+    dr_tpu.fill(e_in, 1.0)
+    dr_tpu.inclusive_scan(e_in, e_out)
+    dr_tpu.for_each(e_out, _scale, 2.0)
+    want = dr_tpu.to_numpy(e_out)
+
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(d_in, 1.0)
+        dr_tpu.inclusive_scan(d_in, d_out)
+        dr_tpu.for_each(d_out, _scale, 2.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(d_out), want)
+    st = p.stats()
+    assert st["opaque_ops"] == 1 and st["fused_runs"] == 2
+
+
+def test_deferred_zip_foreach_and_subranges():
+    P = dr_tpu.nprocs()
+    n = 24 * P
+    ea, da = _pair(n, seed=7)
+    eb, db = _pair(n, seed=8)
+
+    def chain(a, b):
+        dr_tpu.for_each(views.zip(a, b), _swap_sum)
+        dr_tpu.fill(a[2:n - 3], -1.0)
+        dr_tpu.transform(a[1:n - 1], b[1:n - 1], _shift, 0.5)
+        return dr_tpu.reduce(b[3:n], op=max)
+
+    want = chain(ea, eb)
+    with dr_tpu.deferred():
+        got = chain(da, db)
+    assert float(got) == want
+    np.testing.assert_array_equal(dr_tpu.to_numpy(da), dr_tpu.to_numpy(ea))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(db), dr_tpu.to_numpy(eb))
+
+
+def test_deferred_host_copy_splice():
+    P = dr_tpu.nprocs()
+    n = 16 * P
+    src = np.linspace(-1, 1, n).astype(np.float32)
+    ea, da = _pair(n, seed=9)
+    dr_tpu.copy(src, ea)
+    dr_tpu.for_each(ea, _scale, 2.0)
+    with dr_tpu.deferred():
+        dr_tpu.copy(src, da)
+        dr_tpu.for_each(da, _scale, 2.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(da), dr_tpu.to_numpy(ea))
+
+
+def test_fused_loop_helpers_flush_pending_writes():
+    """The bench *_n fused loops read container buffers directly; a
+    deferred region's pending writes must land first (review finding:
+    dot_n on a just-recorded fill returned the stale zeros)."""
+    from dr_tpu.algorithms.reduce import dot_n
+    from dr_tpu.algorithms.scan import inclusive_scan_n
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    s = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred():
+        dr_tpu.fill(a, 2.0)
+        dr_tpu.fill(b, 1.0)
+        assert float(dot_n(a, b, 1)) == 2.0 * n
+        dr_tpu.fill(a, 3.0)
+        inclusive_scan_n(a, s, 1)
+    np.testing.assert_allclose(dr_tpu.to_numpy(s),
+                               np.cumsum(np.full(n, 3.0, np.float32)),
+                               rtol=1e-6)
+
+
+def test_deferred_mismatched_copy_raises_like_eager():
+    """A wrong-length host copy raises eagerly (_write_window's shape
+    check); the recorded splice must reject it too, not silently write
+    a clipped prefix plus garbage."""
+    P = dr_tpu.nprocs()
+    n = 16 * P
+    d = dr_tpu.distributed_vector(n, np.float32)
+    src = np.arange(n // 2, dtype=np.float32)
+    with pytest.raises(Exception):
+        dr_tpu.copy(src, d)  # eager raises
+    with pytest.raises(ValueError):
+        with dr_tpu.deferred():
+            dr_tpu.copy(src, d)  # recorded path must raise too
+
+
+def test_deferred_stencil_transform_bit_identical():
+    P = dr_tpu.nprocs()
+    n = 32 * P
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    w = [0.25, 0.5, 0.25]
+    ea, da = _pair(n, hb, seed=11)
+    eb, db = _pair(n, hb, seed=12)
+
+    def chain(a, b):
+        dr_tpu.halo(a).exchange()
+        dr_tpu.stencil_transform(a, b, w)
+        return dr_tpu.reduce(b)
+
+    want = chain(ea, eb)
+    d0 = spmd_guard.dispatch_count()
+    with dr_tpu.deferred():
+        got = chain(da, db)
+    assert spmd_guard.dispatch_count() - d0 == 1
+    assert float(got) == want
+    np.testing.assert_array_equal(dr_tpu.to_numpy(db), dr_tpu.to_numpy(eb))
+
+
+def test_faulted_flush_is_clean():
+    """A classified fault at the flush boundary: the region raises the
+    classified error, containers keep their pre-region values, pending
+    scalars break loudly, and the plan stays usable afterwards."""
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    src = np.full(n, 5.0, np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    with faults.injected("plan.flush", "program", times=1):
+        with pytest.raises(resilience.ProgramError):
+            with dr_tpu.deferred():
+                dr_tpu.fill(a, 1.0)
+                s = dr_tpu.reduce(a)
+    # nothing executed: the container still holds its pre-region value
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a), src)
+    with pytest.raises(RuntimeError):
+        float(s)
+    # the layer recovers: a fresh region works
+    with dr_tpu.deferred():
+        dr_tpu.fill(a, 2.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 2.0, np.float32))
+
+
+def test_region_exception_discards_pending():
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    src = np.full(n, 3.0, np.float32)
+    a = dr_tpu.distributed_vector.from_array(src)
+    with pytest.raises(ValueError):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 9.0)
+            raise ValueError("user error inside the region")
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a), src)
+    assert dr_plan.active() is None
+    assert any(e["reason"] == "region error" for e in p.log)
+
+
+def test_explain_reports_runs_and_reasons():
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 1.0)
+        dr_tpu.for_each(a, _shift, 1.0)
+    txt = p.explain()
+    assert "region exit" in txt and "fused run" in txt
+    assert "fill" in txt and "transform" in txt
+    st = p.stats()
+    assert st == {"flushes": 1, "fused_runs": 1, "fused_ops": 2,
+                  "opaque_ops": 0, "cache_hits": st["cache_hits"],
+                  "dispatches": 1}
+
+
+def test_nested_deferred_reenters():
+    P = dr_tpu.nprocs()
+    n = 8 * P
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as outer:
+        dr_tpu.fill(a, 1.0)
+        with dr_tpu.deferred() as inner:
+            dr_tpu.for_each(a, _shift, 1.0)
+        assert inner is outer
+        # inner exit must NOT flush: still one pending fused run
+        assert outer.stats()["flushes"] == 0
+    assert outer.stats()["flushes"] == 1
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 2.0, np.float32))
+
+
+def test_mesh_change_splits_runs():
+    """Containers on different meshes cannot share one program: the
+    planner splits the run at the mesh change (round-5 review rule)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    rt_small = dr_tpu.init(jax.devices()[:2])
+    small = dr_tpu.distributed_vector(8, np.float32, runtime=rt_small)
+    rt_big = dr_tpu.init(jax.devices()[:4])
+    big = dr_tpu.distributed_vector(16, np.float32, runtime=rt_big)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(small, 1.0)
+        dr_tpu.fill(big, 2.0)
+    assert p.stats()["fused_runs"] == 2
+    np.testing.assert_array_equal(dr_tpu.to_numpy(small),
+                                  np.full(8, 1.0, np.float32))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(big),
+                                  np.full(16, 2.0, np.float32))
+
+
+def test_plan_cache_is_tapped_for_guard():
+    """Plan flush dispatches ride the spmd_guard trace like every other
+    dispatch (the cache is a TappedCache)."""
+    P = dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(8 * P, np.float32)
+    with spmd_guard.guard() as g:
+        with dr_tpu.deferred():
+            dr_tpu.fill(a, 1.0)
+    assert len(g.trace) == 1 and g.trace[0].startswith("(")
+
+
+def test_persistent_compile_cache_wiring(tmp_path, monkeypatch):
+    """DR_TPU_COMPILE_CACHE_DIR wires jax's persistent compilation
+    cache at init (round 8): the config points at the directory and
+    the min-compile-time threshold drops to zero so tunneled sessions
+    stop re-paying compiles across processes."""
+    from dr_tpu.parallel import runtime as rt
+    prior_dir = jax.config.jax_compilation_cache_dir
+    prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    path = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("DR_TPU_COMPILE_CACHE_DIR", path)
+    monkeypatch.setattr(rt, "_compile_cache_wired", False)
+    try:
+        wired = rt.setup_compile_cache()
+        assert wired == path
+        assert jax.config.jax_compilation_cache_dir == path
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+        import os
+        assert os.path.isdir(path)
+        # idempotent: a second init call does not re-wire
+        assert rt.setup_compile_cache() == path
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prior_min)
+        monkeypatch.setattr(rt, "_compile_cache_wired", False)
+
+
+def test_compile_cache_unset_is_noop(monkeypatch):
+    from dr_tpu.parallel import runtime as rt
+    monkeypatch.delenv("DR_TPU_COMPILE_CACHE_DIR", raising=False)
+    monkeypatch.setattr(rt, "_compile_cache_wired", False)
+    assert rt.setup_compile_cache() is None

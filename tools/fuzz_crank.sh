@@ -23,6 +23,11 @@
 # (utils/faults + utils/resilience).  It collects alongside the fuzz
 # arms (filter `chaos` to crank it alone); DR_TPU_CHAOS_ROUNDS scales
 # its per-combo repetitions off the iteration budget.
+#
+# PLAN arm (round 8): test_fuzz_plan_chains cranks seeded random
+# fusible op chains through `dr_tpu.deferred()` (dr_tpu/plan.py) and
+# bit-compares the deferred flush against the eager sequence (filter
+# `plan_chains`).  The chaos sweep covers the plan.flush fault site.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}

@@ -5,7 +5,8 @@ Measures everything by the marginal method with a hard scalar-read sync
 (docs/PERF.md "measurement lesson"): block_until_ready can be a no-op
 on tunneled backends, so each timed call returns one device scalar.
 
-Usage:  python tools/tune_tpu.py [stencil|scan|dot|spmv|heat|attn|halo|all]
+Usage:  python tools/tune_tpu.py
+        [stencil|scan|dot|spmv|heat|attn|halo|sort|pipeline|all]
 
 Prints one line per configuration; safe to re-run (all programs cached
 per process).  This is a developer tool, not part of the bench contract.
@@ -467,6 +468,52 @@ def tune_sort():
             v = kd = pd = None
 
 
+def tune_pipeline():
+    """Chain-length ladder for the deferred execution plan (round 8,
+    dr_tpu/plan.py): per-chain time of the 5-op pipeline chain
+    (fill -> for_each -> halo exchange -> transform -> reduce), eager
+    vs deferred, at growing chain lengths.  Eager pays the tunneled
+    per-dispatch constant 5x per chain plus one sync; a deferred
+    region of r chains is ONE dispatch + ONE sync however long the
+    chain — the ladder shows where the amortization saturates, the
+    datapoint for docs/PERF.md's pipeline rows on the next chip
+    session."""
+    import dr_tpu
+    from bench import _pipeline_runners
+
+    dr_tpu.init()
+    P = dr_tpu.nprocs()
+    on_cpu = dr_tpu.devices()[0].platform == "cpu"
+    n = (2 ** 20 if on_cpu else 2 ** 24) // P * P
+    hb = dr_tpu.halo_bounds(2, 2, periodic=True)
+    a = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+    b = dr_tpu.distributed_vector(n, np.float32, halo=hb)
+    # the SAME runner pair as bench's pipeline config: the on-chip
+    # ladder must time the identical workload the PERF.md rows record
+    run_eager, run_deferred = _pipeline_runners(a, b)
+
+    from dr_tpu.utils.spmd_guard import dispatch_count
+    for r in (1, 2, 4, 8, 16, 32):
+        for tag, run in (("eager", run_eager), ("deferred", run_deferred)):
+            try:
+                run(r)  # warm/compile (each deferred r is a new program)
+                ts = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    run(r)
+                    ts.append(time.perf_counter() - t0)
+                d0 = dispatch_count()
+                run(r)
+                disp = dispatch_count() - d0
+                per = float(np.median(ts)) / r
+                print(f"pipeline r={r:<2d} [{tag:8s}]: "
+                      f"{per * 1e3:8.3f} ms/chain  "
+                      f"{disp} dispatch(es)/region", flush=True)
+            except Exception as e:
+                print(f"pipeline r={r} [{tag}]: FAIL {_errline(e)}",
+                      flush=True)
+
+
 if __name__ == "__main__":
     # Guarded first backend touch through the SAME degradation router
     # as bench.py and entry() (utils/resilience): a dead relay degrades
@@ -498,6 +545,8 @@ if __name__ == "__main__":
             tune_scan()
         if what in ("sort", "all"):
             tune_sort()
+        if what in ("pipeline", "all"):
+            tune_pipeline()
         for nm in ("dot", "heat", "attn", "halo", "spmv"):
             if what in (nm, "all"):
                 tune_container(nm)
