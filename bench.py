@@ -38,6 +38,13 @@ Round 8: ``detail.pipeline_gbps`` (eager-vs-deferred 5-op chain through
 chain per arm) are always on; ``--pipeline`` (or
 DR_TPU_BENCH_PIPELINE=1 — survives the CPU-fallback re-execs) adds the
 deferred chain-length ladder.
+
+Round 9: the sparse family gets the sort treatment —
+``detail.spmv_format``/``spmm_format`` (the layout the measurement
+actually dispatched: autoselect or env override, fallback-resolved) and ``detail.spmv_phases_gflops`` (ring-schedule truncation
+ladder: local_compute / rotate / combine) are always on; ``--spmv``
+(or DR_TPU_BENCH_SPMV=1, surviving both re-exec legs) adds the
+per-format gemv_n ladder.
 """
 
 import json
@@ -371,7 +378,8 @@ def _pipeline_metrics(on_cpu: bool, ladder: bool = False) -> dict:
 
 
 def _secondary_metrics(on_cpu: bool, on_tpu: bool,
-                       phases: bool = False) -> dict:
+                       phases: bool = False,
+                       spmv_ladder: bool = False) -> dict:
     """The remaining BASELINE.json configs, each as one number in detail:
     transform_reduce dot (GB/s), inclusive_scan (GB/s), halo-exchange
     p50 latency (us), 2-D heat stencil (GB/s), CSR SpMV (GFLOP/s).
@@ -630,7 +638,11 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
     finally:
         q = kk = vv = None
 
-    # config 5: CSR SpMV (gemv_example.cpp:18-41), fused-loop (gemv_n)
+    # config 5: CSR SpMV (gemv_example.cpp:18-41), fused-loop (gemv_n).
+    # Round 9: the artifact carries the container's chosen-format tag,
+    # the ring-schedule PHASE breakdown (gemv_phases_n truncations over
+    # SPMV_PHASES — the sort round's profiling discipline), and, under
+    # --spmv, a format ladder (gemv_n per forced format).
     try:
         m = 2 ** 14 if on_cpu else 2 ** 17
         k = 32  # nnz per row
@@ -643,13 +655,67 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
         bv = dr_tpu.distributed_vector(m, np.float32)
         dr_tpu.fill(bv, 1.0)
         dr_tpu.fill(c, 0.0)
-        from dr_tpu.algorithms.gemv import gemv_n
+        from dr_tpu.algorithms.gemv import (SPMV_PHASES, gemv_n,
+                                            gemv_phases_n,
+                                            resolved_format)
 
         def run_spmv(r):
             gemv_n(c, A, bv, r)
             _sync(c)
         dt = _marginal(run_spmv, r1=2, r2=18)
         out["spmv_gflops"] = round(2.0 * m * k / dt / 1e9, 2)
+        # the format the measurement actually DISPATCHED: a session-
+        # pinned DR_TPU_SPMV_FORMAT routes the number, so it must route
+        # the label too (A.format alone would tag a forced-csr run ell)
+        out["spmv_format"] = resolved_format(A)
+        flops = 2.0 * m * k
+        spread = 0.1 if on_cpu else 0.3
+        try:
+            if P == 1 or not A.ensure_ring():
+                # no ring to cut: the whole SpMV is the local
+                # contraction — the honest collapse, like the p=1 sort
+                out["spmv_phases_gflops"] = {
+                    "local_compute": out["spmv_gflops"]}
+                out["spmv_phase_dominant"] = "local_compute"
+                out["spmv_phases_note"] = \
+                    "p=1 or ring-ineligible: no ring phases; SpMV IS " \
+                    "the local contraction"
+            else:
+                from dr_tpu.utils.profiling import profile_phases
+
+                def mk_spmv(i):
+                    def run(r):
+                        gemv_phases_n(c, A, bv, SPMV_PHASES[i], r)
+                        _sync(c)
+                    return run
+                bd = profile_phases(mk_spmv, SPMV_PHASES, r1=2, r2=10,
+                                    samples=3, min_spread=spread)
+                out["spmv_phases_gflops"] = bd.detail(flops)
+                out["spmv_phase_dominant"] = bd.dominant
+        except Exception as e:  # pragma: no cover - defensive
+            out["spmv_phases_error"] = repr(e)[:160]
+        if spmv_ladder:
+            lad = {}
+            # a forced-but-ineligible format silently falls back down
+            # the dispatch chain (SPEC §12.2) — tag those rungs instead
+            # of recording the fallback arm's number under the forced
+            # label (two rungs could secretly be the same program)
+            from dr_tpu.algorithms.gemv import viable_formats
+            from dr_tpu.utils.env import env_override
+            viable = viable_formats(A)
+            with env_override(DR_TPU_SPMV_FORMAT=None):
+                for fmt in ("csr", "ell", "bcsr", "ring"):
+                    if not viable[fmt]:
+                        lad[fmt] = "ineligible (would fall back)"
+                        continue
+                    os.environ["DR_TPU_SPMV_FORMAT"] = fmt
+                    try:
+                        dtf = _marginal(run_spmv, r1=2, r2=10,
+                                        samples=3, min_spread=spread)
+                        lad[fmt] = round(flops / dtf / 1e9, 2)
+                    except Exception as e:
+                        lad[fmt] = repr(e)[:80]
+            out["spmv_format_ladder_gflops"] = lad
     except Exception as e:  # pragma: no cover - defensive
         out["spmv_error"] = repr(e)[:160]
     finally:
@@ -675,6 +741,10 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
             float(y[0, 0])
         dt = _marginal(run_spmm, r1=2, r2=18)
         out["spmm8_gflops"] = round(2.0 * m * k * nv / dt / 1e9, 2)
+        # the arm spmm_n actually RAN (only the grouped ELL/BCSR
+        # programs exist; forced csr/ring resolve to ELL)
+        from dr_tpu.algorithms.gemv import resolved_spmm_format
+        out["spmm_format"] = resolved_spmm_format(A)
     except Exception as e:  # pragma: no cover - defensive
         out["spmm_error"] = repr(e)[:160]
     finally:
@@ -909,7 +979,13 @@ def main():
         # phase ladder on top of the always-on keys-only breakdown
         phases = ("--phases" in sys.argv[1:]
                   or os.environ.get("DR_TPU_BENCH_PHASES", "") == "1")
-        secondary = _secondary_metrics(on_cpu, on_tpu, phases=phases)
+        # --spmv (or DR_TPU_BENCH_SPMV=1 — both survive the two
+        # CPU-fallback re-execs, like --pipeline): add the spmv format
+        # ladder on top of the always-on phase breakdown + format tag
+        spmv_ladder = ("--spmv" in sys.argv[1:]
+                       or os.environ.get("DR_TPU_BENCH_SPMV", "") == "1")
+        secondary = _secondary_metrics(on_cpu, on_tpu, phases=phases,
+                                       spmv_ladder=spmv_ladder)
         # pipeline config (round 8): eager-vs-deferred 5-op chain.
         # Always on; --pipeline (or DR_TPU_BENCH_PIPELINE=1 — the flag
         # survives both CPU-fallback re-execs like --phases) adds the

@@ -15,6 +15,25 @@ is well-defined (segment_sum, not racy +=).
 jit over sharded operands; GSPMD emits the SUMMA-style collectives and the
 MXU does the FLOPs.  (The reference has no dense gemm — natural on TPU, so
 it ships.)
+
+Round 9 — the sparse hot-path overhaul:
+
+* **Format dispatch** honors the container's build-time AUTOSELECT
+  (sparse_matrix._decide_format: csr / ell / bcsr from the row-length
+  distribution) with a ``DR_TPU_SPMV_FORMAT`` dispatch-time override
+  (``ring`` opts into the rotating-b schedule).
+* **Ring programs** (``_gemv_ring_program``): b is block-sharded and
+  rotates around the mesh ring (parallel/pipeline.ring_pipeline,
+  software-pipelined by default) while each shard contracts its
+  per-step ELL bucket (sparse_matrix.ensure_ring) against the held
+  window — compute for step t overlaps the transfer for step t+1.
+  ``stop_after`` truncations (:data:`SPMV_PHASES`) drive the sparse
+  phase ladder (``gemv_phases_n``), the sort round's profiling
+  discipline applied here.
+* **Gather mode**: the grouped contractions pick per-element gathers
+  off-TPU and the W-slice one-hot trick on TPU (``_gather_mode``).
+* Inside ``dr_tpu.deferred()`` regions ``gemv`` records as an ordered
+  OPAQUE op (like inclusive_scan) instead of forcing a plan flush.
 """
 
 from __future__ import annotations
@@ -30,8 +49,93 @@ from ..core.pinning import pinned_id
 from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix
 from ..containers.sparse_matrix import sparse_matrix
+from ..parallel import pipeline as _pl
 
-__all__ = ["gemv", "gemv_n", "flat_gemv", "gemm", "spmm"]
+__all__ = ["gemv", "gemv_n", "gemv_phases_n", "flat_gemv", "gemm",
+           "spmm", "SPMV_PHASES"]
+
+#: ring-SpMV phase ladder (profiling truncations; see
+#: :func:`_gemv_ring_program` and utils/profiling.profile_phases):
+#: "local_compute" = every bucket contraction, no transfers;
+#: "rotate" = + the ring ppermutes; "combine" = + the full-window
+#: accumulate into c (= the full program).
+SPMV_PHASES = ("local_compute", "rotate", "combine")
+
+
+def _pick_format(a) -> str:
+    """Dispatch-time SpMV layout choice: ``DR_TPU_SPMV_FORMAT``
+    (csr / ell / bcsr / ring) overrides the container's build-time
+    autoselect (``sparse_matrix.format``).  Read per call so in-process
+    sweeps work; every program the choice routes to has its own cache
+    key, so switching formats never reuses a stale program."""
+    import os
+    env = os.environ.get("DR_TPU_SPMV_FORMAT", "").strip().lower()
+    if env in ("csr", "ell", "bcsr", "ring"):
+        return env
+    return a._format
+
+
+def viable_formats(a) -> dict:
+    """Which SpMV layouts a forced ``DR_TPU_SPMV_FORMAT`` would
+    actually run for ``a``: an ineligible forced format falls back
+    down the dispatch chain (SPEC §12.2), so the bench / tune format
+    ladders use this map to TAG forced-but-ineligible rungs instead of
+    recording the fallback arm's number under the forced label."""
+    return {"csr": True, "ell": a.ensure_ell(),
+            "bcsr": a.ensure_bcsr(), "ring": a.ensure_ring()}
+
+
+def resolved_format(a) -> str:
+    """The arm the 1-D gemv/gemv_n dispatch will ACTUALLY run for
+    ``a`` right now: :func:`_pick_format` (env override or autoselect)
+    resolved down the fallback chain exactly as the dispatchers do —
+    the honest value for an artifact's chosen-format tag (a pinned but
+    ineligible format must not label the fallback arm's number)."""
+    fmt = _pick_format(a)
+    if fmt == "ring" and a.ensure_ring():
+        return "ring"
+    if fmt == "bcsr" and a.ensure_bcsr():
+        return "bcsr"
+    if fmt != "csr" and a.ensure_ell():
+        return "ell"
+    return "csr"
+
+
+def resolved_spmm_format(a) -> str:
+    """:func:`resolved_format` for the spmm_n dispatch, which has only
+    the grouped arms: a forced/autoselected csr or ring resolves to the
+    ELL path (see spmm_n's docstring) — the honest value for the
+    ``spmm_format`` artifact tag, owned here so the label can never
+    drift from the dispatch."""
+    fmt = _pick_format(a)
+    return "bcsr" if fmt == "bcsr" and a.ensure_bcsr() else "ell"
+
+
+def _gather_mode(rt) -> str:
+    """Gather strategy for the grouped (ELL/ring) contractions:
+    ``slice`` = W-wide slice + one-hot select (amortizes the TPU's
+    serialized per-element gather issue ~2.5x, docs/PERF.md roofline);
+    ``direct`` = plain per-element gather — the right call off-TPU,
+    where gathers are cheap and the one-hot trick just multiplies the
+    FLOPs by W.  ``DR_TPU_GATHER_MODE`` in {auto, slice, direct}
+    overrides; auto resolves from the runtime's platform.  Keyed into
+    every program cache that threads it."""
+    import os
+    m = os.environ.get("DR_TPU_GATHER_MODE", "auto").strip().lower()
+    if m in ("slice", "direct"):
+        return m
+    from . import _common
+    return "slice" if _common.on_tpu(rt) else "direct"
+
+
+def _combine_mode() -> str:
+    """Cross-tile partial combine for the 2-D grid programs:
+    ``psum`` (default — XLA's all-reduce, the measured winner) or
+    ``ring`` (pipeline.ring_combine — the rotate-collect arm for the
+    DR_TPU_SPMV_COMBINE A/B on chip)."""
+    import os
+    m = os.environ.get("DR_TPU_SPMV_COMBINE", "").strip().lower()
+    return m if m in ("psum", "ring") else "psum"
 
 
 def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
@@ -61,22 +165,29 @@ def _gemv_program(mesh, axis, nshards, th, K, m, seg_out, width_out, prev_out):
 
 def _gather_w() -> int:
     """b-slice width per gather (measured TPU sweet spot).  Read per
-    call so DR_TPU_GATHER_W sweeps work in-process — but note the ELL
-    program caches do NOT key on it; clear caches (fresh process) or
-    vary the layout between sweep points."""
+    call so DR_TPU_GATHER_W sweeps work in-process; the slice-mode
+    program caches key on it (round 9), so sweep points rebuild
+    instead of reusing the first-traced width."""
     from ..utils.env import env_int
     return env_int("DR_TPU_GATHER_W", 16)
 _ELL_CHUNK = 2 ** 13  # tile rows per lax.map chunk (bounds intermediates)
 
 
-def _ell_local(vals0, cols0, b, th, kmax):
+def _ell_local(vals0, cols0, b, th, kmax, mode="slice"):
     """One shard's ELL contraction: (th,) row sums of vals * b[cols].
 
     TPU scatter-adds (segment_sum) and per-element gathers both serialize
     (~4 ns/element); gathering W-wide slices of b and selecting the lane
     with a one-hot compare amortizes the per-gather cost ~2.5x, and the
     fixed (th, kmax) ELL shape makes the multiply + row-sum dense VPU
-    work.  b is padded to a multiple of W so every slice is in range."""
+    work.  b is padded to a multiple of W so every slice is in range.
+
+    ``mode="direct"`` (:func:`_gather_mode` — the off-TPU resolution)
+    skips the slice trick: one plain gather per entry, no W-fold FLOP
+    multiplication.  Bit-identical to the slice path (the one-hot
+    select adds exact zeros)."""
+    if mode == "direct":
+        return (vals0 * jnp.take(b, cols0)).sum(-1)
     W = _gather_w()
     pad = (-b.shape[0]) % W
     bp = jnp.concatenate([b, jnp.zeros((pad,), b.dtype)]) if pad else b
@@ -147,17 +258,19 @@ def _gemv_bcsr_program(mesh, axis, nshards, nbr, kb, seg_out, prev_out):
     return prog
 
 
-def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
+def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out,
+                      mode):
     """Scatter-free SpMV over the row-grouped (ELL) layout
     (see :func:`_ell_local`)."""
-    key = ("gemv_ell", pinned_id(mesh), axis, nshards, th, kmax, seg_out, prev_out)
+    key = ("gemv_ell", pinned_id(mesh), axis, nshards, th, kmax, seg_out,
+           prev_out, mode, _gather_w() if mode == "slice" else 0)
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
 
     def body(c_blk, vals, cols, b):
         # one shard: vals/cols (1, th, kmax), b (n,) replicated
-        local = _ell_local(vals[0], cols[0], b, th, kmax)
+        local = _ell_local(vals[0], cols[0], b, th, kmax, mode=mode)
         upd = c_blk[0, prev_out:prev_out + seg_out] + local.astype(c_blk.dtype)
         return c_blk.at[0, prev_out:prev_out + seg_out].set(upd)
 
@@ -171,14 +284,110 @@ def _gemv_ell_program(mesh, axis, nshards, th, kmax, seg_out, prev_out):
     return prog
 
 
-def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
-    """``iters`` chained SpMVs in ONE jitted program (the exchange_n /
-    dot_n measurement analog): each round perturbs b by a scalar of the
-    running output (times 1e-38) so XLA can neither hoist the
-    contraction nor skip re-reading b.  Accumulates into ``c`` like
-    ``iters`` gemv calls (up to the negligible perturbation)."""
-    from ..plan import flush_reads
-    flush_reads("gemv_n")  # reads c._data directly: pending writes first
+def _gemv_ring_program(rt, nshards, th, kr, bw, seg_out, prev_out, mode,
+                       schedule, stop_after, iters):
+    """Ring-scheduled SpMV (round 9): b is BLOCK-sharded over the mesh
+    and rotates around the ring (``parallel/pipeline.ring_pipeline`` —
+    double-buffered pipelined schedule by default, ``serial`` for the
+    A/B) while each shard contracts its per-step ELL bucket
+    (``sparse_matrix.ensure_ring``) against the held window.  Compute
+    for step t overlaps the ICI transfer for step t+1 — the overlap the
+    replicated-b programs cannot express (they pay one XLA broadcast of
+    ALL of b up front).  The two schedules run the same dataflow in the
+    same reduction order, so their results are bit-identical
+    (fuzz-pinned, tests/test_pipeline.py).
+
+    ``stop_after`` (profiling — the sort round's truncation
+    discipline): a :data:`SPMV_PHASES` name cuts the program after that
+    phase.  ``local_compute`` contracts every bucket against the
+    shard's OWN window (full FLOPs, zero transfers); ``rotate`` runs
+    the full ring loop but writes only a reduced scalar (skipping the
+    full-window combine while keeping every contraction live);
+    ``combine`` (= the full program) adds the window accumulate into
+    c.  ``iters`` > 1 chains rounds under
+    ``fori_loop`` with the gemv_n perturbation so XLA can neither hoist
+    nor skip; ``iters == 1`` is the exact eager program (no
+    perturbation)."""
+    axis = rt.axis
+    if stop_after == SPMV_PHASES[-1]:
+        stop_after = None  # the full program IS the last phase
+    key = ("gemv_ring", pinned_id(rt.mesh), axis, nshards, th, kr, bw,
+           seg_out, prev_out, mode, schedule, stop_after, int(iters),
+           _gather_w() if mode == "slice" else 0)
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    restore = iters > 1  # fused loops must restart from the origin
+
+    def body(c_blk, rvals, rcols, b2):
+        # one shard: c_blk (1, width), rvals/rcols (1, P, th, kr),
+        # b2 (1, bw) — the shard's own b window at step 0
+        def round_(cb, bb):
+            def contract(t, carry, blk):
+                local = _ell_local(rvals[0, t], rcols[0, t], blk[0],
+                                   th, kr, mode=mode)
+                return carry + local
+
+            # seed VARYING over the mesh axis (zeros alone are
+            # replicated and shard_map's vma check rejects the carry)
+            y0 = jnp.zeros((th,), jnp.float32) + 0.0 * bb[0, 0]
+            if stop_after == "local_compute":
+                y = y0
+                for t in range(nshards):
+                    y = contract(t, y, bb)
+                bb_out = bb
+            elif restore:
+                y, bb_out = _pl.ring_pipeline(
+                    axis, nshards, y0, bb, contract,
+                    schedule=schedule, restore_blocks=True)
+            else:
+                y = _pl.ring_pipeline(axis, nshards, y0, bb, contract,
+                                      schedule=schedule)
+                bb_out = bb
+            if stop_after == "rotate":
+                # full ring math, scalar write: y.sum() keeps EVERY
+                # row's contraction live (a y[0]-only write would let
+                # XLA dead-code most of the compute and the ladder
+                # would misattribute it to the next phase); the
+                # full-window accumulate is the NEXT phase's marginal
+                upd0 = cb[0, prev_out] + y.sum().astype(cb.dtype)
+                return cb.at[0, prev_out].set(upd0), bb_out
+            upd = cb[0, prev_out:prev_out + seg_out] + \
+                y[:seg_out].astype(cb.dtype)
+            return cb.at[0, prev_out:prev_out + seg_out].set(upd), bb_out
+
+        if iters == 1:
+            out, _ = round_(c_blk, b2)
+            return out
+
+        def it(_, carry):
+            cb, bb = carry
+            s = cb[0, prev_out] * jnp.asarray(1e-38, b2.dtype)
+            return round_(cb, bb + s)
+
+        out, _ = jax.lax.fori_loop(0, iters, it, (c_blk, b2))
+        return out
+
+    shmapped = jax.shard_map(
+        body, mesh=rt.mesh,
+        in_specs=(P(axis, None), P(axis, None, None, None),
+                  P(axis, None, None, None), P(axis, None)),
+        out_specs=P(axis, None))
+
+    def run(c_data, rvals, rcols, b):
+        pad = nshards * bw - b.shape[0]
+        bp = jnp.pad(b, (0, pad)) if pad else b
+        return shmapped(c_data, rvals, rcols, bp.reshape(nshards, bw))
+
+    prog = jax.jit(run, donate_argnums=0)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _ring_fast_args(c, a, b):
+    """Shared validation for the ring dispatchers: the aligned fast
+    path (shard r of c holds tile r's rows) plus a built ring layout.
+    Returns ``(rt, b_arr, seg_out, prev_out)``."""
     assert isinstance(a, sparse_matrix) and a.grid_shape[1] == 1
     m, n = a.shape
     b_arr = b.to_array() if hasattr(b, "to_array") else jnp.asarray(b)
@@ -187,15 +396,88 @@ def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
     assert (isinstance(c, distributed_vector)
             and uniform_layout(c.layout)
             and c.nshards == a.nshards and c.segment_size == a.tile_rows
-            and c.runtime is rt), "gemv_n needs the aligned fast path"
+            and c.runtime is rt), "fused gemv needs the aligned fast path"
+    return rt, b_arr, c.segment_size, c.halo_bounds.prev
+
+
+def gemv_phases_n(c: distributed_vector, a: sparse_matrix, b,
+                  stop_after: str, iters: int):
+    """``iters`` fused rounds of the ring SpMV truncated after
+    ``stop_after`` (:data:`SPMV_PHASES`) — the profiling aid behind
+    bench's ``detail.spmv_phases_gflops`` and the tune_tpu.py spmv
+    ladder (utils/profiling.profile_phases differences consecutive
+    truncations; the per-dispatch constant and shared prefix work
+    cancel).  Requires the ring layout (``a.ensure_ring()``)."""
+    from ..plan import flush_reads
+    flush_reads("gemv_phases_n")  # reads c._data directly
+    assert stop_after in SPMV_PHASES, (stop_after, SPMV_PHASES)
+    have_ring = a.ensure_ring()  # side effects survive python -O
+    assert have_ring, \
+        "gemv_phases_n profiles the ring schedule (ensure_ring)"
+    rt, b_arr, seg_out, prev_out = _ring_fast_args(c, a, b)
+    _pl.fire_ppermute(op="gemv_phases_n")
+    prog = _gemv_ring_program(rt, a.nshards, a.tile_rows, a._ring_kr,
+                              a._ring_bw, seg_out, prev_out,
+                              _gather_mode(rt), _pl.schedule_mode(),
+                              stop_after, int(iters))
+    c._data = prog(c._data, a._ring_vals, a._ring_cols, b_arr)
+    return c
+
+
+def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
+    """``iters`` chained SpMVs in ONE jitted program (the exchange_n /
+    dot_n measurement analog): each round perturbs b by a scalar of the
+    running output (times 1e-38) so XLA can neither hoist the
+    contraction nor skip re-reading b.  Accumulates into ``c`` like
+    ``iters`` gemv calls (up to the negligible perturbation)."""
+    from ..plan import flush_reads
+    flush_reads("gemv_n")  # reads c._data directly: pending writes first
+    rt, b_arr, seg_out, prev_out = _ring_fast_args(c, a, b)
     th = a.tile_rows
-    seg_out, prev_out = c.segment_size, c.halo_bounds.prev
-    bcsr = a.ensure_bcsr()      # same layout priority as gemv
-    have_ell = bcsr or a.ensure_ell()  # side effects survive python -O
-    assert have_ell, "gemv_n needs a grouped (BCSR/ELL) fast path"
+    fmt = _pick_format(a)
+    mode = _gather_mode(rt)
+    if fmt == "ring" and a.ensure_ring():
+        _pl.fire_ppermute(op="gemv_n")
+        prog = _gemv_ring_program(rt, a.nshards, th, a._ring_kr,
+                                  a._ring_bw, seg_out, prev_out, mode,
+                                  _pl.schedule_mode(), None, int(iters))
+        c._data = prog(c._data, a._ring_vals, a._ring_cols, b_arr)
+        return c
+    bcsr = fmt == "bcsr" and a.ensure_bcsr()
+    ell = (not bcsr) and fmt != "csr" and a.ensure_ell()
+    if not (bcsr or ell):
+        # csr (padded-COO segment-sum) fused loop — the format ladder
+        # needs every arm measurable, not just the grouped fast paths
+        assert a._vals is not None, "gemv_n needs a built matrix"
+        K = a._vals.shape[1]
+        key = ("gemv_n_csr", pinned_id(rt.mesh), rt.axis, a.nshards,
+               th, K, seg_out, prev_out, int(iters))
+        prog = _prog_cache.get(key)
+        if prog is None:
+            def body(c_blk, vals, rows, cols, b):
+                def it(_, cb):
+                    s = cb[0, prev_out] * jnp.asarray(1e-38, b.dtype)
+                    contrib = vals[0] * (b + s)[cols[0]]
+                    local = jax.ops.segment_sum(contrib, rows[0],
+                                                num_segments=th)
+                    upd = (cb[0, prev_out:prev_out + seg_out]
+                           + local.astype(cb.dtype))
+                    return cb.at[0, prev_out:prev_out + seg_out].set(upd)
+                return jax.lax.fori_loop(0, iters, it, c_blk)
+
+            shmapped = jax.shard_map(
+                body, mesh=rt.mesh,
+                in_specs=(P(rt.axis, None), P(rt.axis, None),
+                          P(rt.axis, None), P(rt.axis, None), P()),
+                out_specs=P(rt.axis, None))
+            prog = jax.jit(shmapped, donate_argnums=0)
+            _prog_cache[key] = prog
+        c._data = prog(c._data, a._vals, a._rows, a._cols, b_arr)
+        return c
     kdim = a._bcsr_kb if bcsr else a._ell_width
     key = ("gemv_n", pinned_id(rt.mesh), rt.axis, a.nshards, th,
-           kdim, bcsr, seg_out, prev_out, int(iters))
+           kdim, bcsr, seg_out, prev_out, int(iters), mode,
+           _gather_w() if (ell and mode == "slice") else 0)
     prog = _prog_cache.get(key)
     if prog is None:
         if bcsr:
@@ -207,7 +489,8 @@ def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
                         P(rt.axis, None, None), P())
         else:
             def local_of(vals, cols, b):
-                return _ell_local(vals[0], cols[0], b, th, kdim)
+                return _ell_local(vals[0], cols[0], b, th, kdim,
+                                  mode=mode)
 
             in_specs = (P(rt.axis, None), P(rt.axis, None, None),
                         P(rt.axis, None, None), P())
@@ -233,15 +516,43 @@ def gemv_n(c: distributed_vector, a: sparse_matrix, b, iters: int):
     return c
 
 
+def _combine2d(local, gq, combine, schedule):
+    """The 2-D grid programs' cross-column partial combine: ``psum``
+    (default) or the ring all-gather + canonical-order sum
+    (pipeline.ring_combine) — the rotate-collect arm whose serial vs
+    pipelined schedules are bit-identical."""
+    if combine == "ring":
+        return _pl.ring_combine("mc", gq, local, schedule=schedule)
+    return jax.lax.psum(local, "mc")
+
+
+def _shm2d(body, mesh2, in_specs, combine, nout):
+    """shard_map wrapper for the 2-D programs (``nout`` = the body
+    output's rank): the ring combine's output is bitwise-replicated
+    over the mesh columns but still VARIES there in shard_map's vma
+    typing, so its out_specs keep the ``mc`` axis (run() slices
+    column 0)."""
+    if combine == "ring":
+        return jax.shard_map(
+            lambda *a: body(*a)[None], mesh=mesh2, in_specs=in_specs,
+            out_specs=P("mr", "mc", *([None] * (nout - 1))))
+    return jax.shard_map(body, mesh=mesh2, in_specs=in_specs,
+                         out_specs=P("mr", *([None] * (nout - 1))))
+
+
 def _gemv2d_bcsr_program(rt, grid, th, tw, nbr, kb, m, n):
     """SpMV on a 2-D tile grid over the block-ELL (BCSR) layout: each
     tile runs the dense-tile MXU contraction (:func:`_bcsr_local`)
-    against its LOCAL b slice, then partials ``psum`` over the mesh
-    columns.  The layout the MXU likes, on the grid the reference's
-    ``grid_shape[1]==1`` assert forbids (gemv.hpp:21)."""
+    against its LOCAL b slice, then partials combine over the mesh
+    columns (``psum`` by default; ``DR_TPU_SPMV_COMBINE=ring`` takes
+    the pipelined ring arm).  The layout the MXU likes, on the grid the
+    reference's ``grid_shape[1]==1`` assert forbids (gemv.hpp:21)."""
     gp, gq = grid
     mesh2 = rt.mesh2d(grid)
-    key = ("gemv2d_bcsr", pinned_id(mesh2), grid, th, tw, nbr, kb, m, n)
+    combine = _combine_mode()
+    schedule = _pl.schedule_mode()
+    key = ("gemv2d_bcsr", pinned_id(mesh2), grid, th, tw, nbr, kb, m, n,
+           combine, schedule if combine == "ring" else "")
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -250,21 +561,23 @@ def _gemv2d_bcsr_program(rt, grid, th, tw, nbr, kb, m, n):
         # per device: bvals (1, 1, nbr, kb, 8, 128), bcols (1, 1, nbr, kb),
         # b2 (1, tw) — the tile's own column window (cols are tile-local)
         local = _bcsr_local(bvals[0, 0], bcols[0, 0], b2[0], th)
-        y = jax.lax.psum(local, "mc")
+        y = _combine2d(local, gq, combine, schedule)
         return y[None]                               # (1, th)
 
-    shm = jax.shard_map(
-        body, mesh=mesh2,
-        in_specs=(P("mr", "mc", None, None, None, None),
-                  P("mr", "mc", None, None), P("mc", None)),
-        out_specs=P("mr", None))
+    shm = _shm2d(body, mesh2,
+                 (P("mr", "mc", None, None, None, None),
+                  P("mr", "mc", None, None), P("mc", None)), combine,
+                 nout=2)
 
     def run(bvals, bcols, b):
         v6 = bvals.reshape(gp, gq, nbr, kb, *bvals.shape[-2:])
         c4 = bcols.reshape(gp, gq, nbr, kb)
         pad = gq * tw - b.shape[0]
         bp = jnp.pad(b, (0, pad)) if pad else b
-        return shm(v6, c4, bp.reshape(gq, tw)).reshape(-1)[:m]
+        out = shm(v6, c4, bp.reshape(gq, tw))
+        if combine == "ring":
+            out = out[:, 0]  # bitwise-identical across mesh columns
+        return out.reshape(-1)[:m]
 
     prog = jax.jit(run)
     _prog_cache[key] = prog
@@ -273,12 +586,15 @@ def _gemv2d_bcsr_program(rt, grid, th, tw, nbr, kb, m, n):
 
 def _gemv2d_ell_program(rt, grid, th, tw, kmax, m, n):
     """SpMV on a 2-D tile grid: per-tile dense ELL contraction against
-    the tile's LOCAL b slice, then a ``psum`` of partials over the mesh
-    columns — the collective the reference's ``grid_shape[1]==1`` assert
-    avoids (gemv.hpp:21)."""
+    the tile's LOCAL b slice, then partials combine over the mesh
+    columns (psum / ring, ``DR_TPU_SPMV_COMBINE``) — the collective the
+    reference's ``grid_shape[1]==1`` assert avoids (gemv.hpp:21)."""
     gp, gq = grid
     mesh2 = rt.mesh2d(grid)
-    key = ("gemv2d", pinned_id(mesh2), grid, th, tw, kmax, m, n)
+    combine = _combine_mode()
+    schedule = _pl.schedule_mode()
+    key = ("gemv2d", pinned_id(mesh2), grid, th, tw, kmax, m, n,
+           combine, schedule if combine == "ring" else "")
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -287,28 +603,29 @@ def _gemv2d_ell_program(rt, grid, th, tw, kmax, m, n):
         # per device: vals/cols (1, 1, th, kmax), b2 (1, tw)
         bloc = b2[0]
         contrib = vals[0, 0] * bloc[cols[0, 0]]      # (th, kmax)
-        y = jax.lax.psum(contrib.sum(-1), "mc")
+        y = _combine2d(contrib.sum(-1), gq, combine, schedule)
         return y[None]                               # (1, th)
 
-    shm = jax.shard_map(
-        body, mesh=mesh2,
-        in_specs=(P("mr", "mc", None, None), P("mr", "mc", None, None),
-                  P("mc", None)),
-        out_specs=P("mr", None))
+    shm = _shm2d(body, mesh2,
+                 (P("mr", "mc", None, None), P("mr", "mc", None, None),
+                  P("mc", None)), combine, nout=2)
 
     def run(ell_vals, ell_cols, b):
         v4 = ell_vals.reshape(gp, gq, th, kmax)
         c4 = ell_cols.reshape(gp, gq, th, kmax)
         pad = gq * tw - b.shape[0]
         bp = jnp.pad(b, (0, pad)) if pad else b
-        return shm(v4, c4, bp.reshape(gq, tw)).reshape(-1)[:m]
+        out = shm(v4, c4, bp.reshape(gq, tw))
+        if combine == "ring":
+            out = out[:, 0]  # bitwise-identical across mesh columns
+        return out.reshape(-1)[:m]
 
     prog = jax.jit(run)
     _prog_cache[key] = prog
     return prog
 
 
-def _ell_local_mm(vals0, cols0, B, th, kmax):
+def _ell_local_mm(vals0, cols0, B, th, kmax, mode="slice"):
     """One shard's ELL contraction against MULTIPLE vectors: (th, nv)
     row sums of vals * B[cols, :].  Same W-slice gather as
     :func:`_ell_local`, but each gathered slice now feeds ``nv`` MACs —
@@ -317,8 +634,12 @@ def _ell_local_mm(vals0, cols0, B, th, kmax):
     width shrinks with nv so BYTES per gathered slice stay near the
     single-vector sweet spot (the round-2 W sweep showed gather cost
     growing with slice bytes past ~64 B); DR_TPU_SPMM_W overrides for
-    on-chip sweeps."""
+    on-chip sweeps.  ``mode="direct"`` is the off-TPU plain-gather
+    resolution (see :func:`_ell_local`)."""
     nv = B.shape[1]
+    if mode == "direct":
+        return jnp.einsum("ekv,ek->ev", jnp.take(B, cols0, axis=0),
+                          vals0)
     from ..utils.env import env_int
     W = env_int("DR_TPU_SPMM_W", max(2, _gather_w() // max(1, nv // 2)))
     pad = (-B.shape[0]) % W
@@ -368,7 +689,7 @@ def _bcsr_local_mm(bvals0, bcols0, B, seg_out):
     return local.reshape(-1, nv)[:seg_out]
 
 
-def _local_mm_parts(rt, a, th, kdim, bcsr):
+def _local_mm_parts(rt, a, th, kdim, bcsr, mode):
     """(local_fn, in_specs, device_args) for one shard's multi-vector
     contraction — shared by spmm and spmm_n.  local_fn closes over the
     INT width, never the matrix: the process-lifetime program cache
@@ -381,7 +702,8 @@ def _local_mm_parts(rt, a, th, kdim, bcsr):
         args = (a._bcsr_vals, a._bcsr_cols)
     else:
         def local_of(vals, cols, B, kdim=kdim):
-            return _ell_local_mm(vals[0], cols[0], B, th, kdim)
+            return _ell_local_mm(vals[0], cols[0], B, th, kdim,
+                                 mode=mode)
         in_specs = (P(rt.axis, None, None),
                     P(rt.axis, None, None), P())
         args = (a._ell_vals, a._ell_cols)
@@ -397,15 +719,19 @@ def _spmm_w_key():
     return (os.environ.get("DR_TPU_SPMM_W", ""), _gather_w())
 
 
-def _spmm2d_program(rt, grid, th, tw, kdim, bcsr, m, n, nv):
+def _spmm2d_program(rt, grid, th, tw, kdim, bcsr, m, n, nv, mode):
     """SpMM on a 2-D tile grid: per-tile multi-vector contraction
     (:func:`_bcsr_local_mm` / :func:`_ell_local_mm`) against the tile's
-    LOCAL B row-window, then partials ``psum`` over the mesh columns —
-    the spmm analog of :func:`_gemv2d_bcsr_program`."""
+    LOCAL B row-window, then partials combine over the mesh columns
+    (psum / ring, ``DR_TPU_SPMV_COMBINE``) — the spmm analog of
+    :func:`_gemv2d_bcsr_program`."""
     gp, gq = grid
     mesh2 = rt.mesh2d(grid)
+    combine = _combine_mode()
+    schedule = _pl.schedule_mode()
     key = ("spmm2d", pinned_id(mesh2), grid, th, tw, kdim, bcsr, m, n,
-           nv, _spmm_w_key())
+           nv, _spmm_w_key(), mode, combine,
+           schedule if combine == "ring" else "")
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -418,17 +744,15 @@ def _spmm2d_program(rt, grid, th, tw, kdim, bcsr, m, n, nv):
     else:
         def local_of(vals, cols, B2, kdim=kdim):
             return _ell_local_mm(vals[0, 0], cols[0, 0], B2[0], th,
-                                 kdim)
+                                 kdim, mode=mode)
         vspec = cspec
 
     def body(vals, cols, B2):
-        y = jax.lax.psum(local_of(vals, cols, B2), "mc")
+        y = _combine2d(local_of(vals, cols, B2), gq, combine, schedule)
         return y[None]                               # (1, th, nv)
 
-    shm = jax.shard_map(
-        body, mesh=mesh2,
-        in_specs=(vspec, cspec, P("mc", None, None)),
-        out_specs=P("mr", None, None))
+    shm = _shm2d(body, mesh2, (vspec, cspec, P("mc", None, None)),
+                 combine, nout=3)
 
     def run(vals, cols, B):
         shape = vals.shape
@@ -436,8 +760,10 @@ def _spmm2d_program(rt, grid, th, tw, kdim, bcsr, m, n, nv):
         c4 = cols.reshape(gp, gq, *cols.shape[1:])
         pad = gq * tw - B.shape[0]
         Bp = jnp.pad(B, ((0, pad), (0, 0))) if pad else B
-        return shm(v, c4, Bp.reshape(gq, tw, -1)).reshape(
-            -1, B.shape[1])[:m]
+        out = shm(v, c4, Bp.reshape(gq, tw, -1))
+        if combine == "ring":
+            out = out[:, 0]  # bitwise-identical across mesh columns
+        return out.reshape(-1, B.shape[1])[:m]
 
     prog = jax.jit(run)
     _prog_cache[key] = prog
@@ -464,14 +790,17 @@ def spmm(a: sparse_matrix, b) -> jax.Array:
         return jnp.zeros((m, B.shape[1]), a.dtype)
     rt = a.runtime
     nv = B.shape[1]
-    bcsr = a.grid_shape[1] == 1 and a.ensure_bcsr()
-    if a.grid_shape[1] == 1 and (bcsr or a.ensure_ell()):
+    fmt = _pick_format(a)      # "ring" has no spmm form: grouped path
+    mode = _gather_mode(rt)
+    bcsr = a.grid_shape[1] == 1 and fmt == "bcsr" and a.ensure_bcsr()
+    if a.grid_shape[1] == 1 and fmt != "csr" and \
+            (bcsr or a.ensure_ell()):
         th = a.tile_rows
         kdim = a._bcsr_kb if bcsr else a._ell_width
         key = ("spmm", pinned_id(rt.mesh), rt.axis, a.nshards, th,
-               kdim, bcsr, nv, m, _spmm_w_key())
+               kdim, bcsr, nv, m, _spmm_w_key(), mode)
         local_of, in_specs, args = _local_mm_parts(rt, a, th, kdim,
-                                                   bcsr)
+                                                   bcsr, mode)
         prog = _prog_cache.get(key)
         if prog is None:
             shm = jax.shard_map(local_of, mesh=rt.mesh,
@@ -480,13 +809,15 @@ def spmm(a: sparse_matrix, b) -> jax.Array:
             prog = jax.jit(shm)
             _prog_cache[key] = prog
         return prog(*args, B)[:m]
-    if a.grid_shape[1] > 1:
-        bcsr2 = a.ensure_bcsr()
+    if a.grid_shape[1] > 1 and fmt != "csr":
+        bcsr2 = fmt == "bcsr" and a.ensure_bcsr()
         if bcsr2 or a.ensure_ell():
+            if _combine_mode() == "ring":
+                _pl.fire_ppermute(op="spmm")
             prog = _spmm2d_program(
                 rt, a.grid_shape, a.tile_rows, a.tile_cols,
                 a._bcsr_kb if bcsr2 else a._ell_width, bcsr2,
-                m, n, nv)
+                m, n, nv, mode)
             args = (a._bcsr_vals, a._bcsr_cols) if bcsr2 \
                 else (a._ell_vals, a._ell_cols)
             return prog(*args, B)
@@ -499,21 +830,30 @@ def spmm_n(a: sparse_matrix, b, iters: int) -> jax.Array:
     """``iters`` chained SpMMs in ONE jitted program (the gemv_n
     measurement analog): each round perturbs B by a scalar of the
     running product (times 1e-38) so XLA can neither hoist the
-    contraction nor skip re-reading B.  Returns the last product."""
+    contraction nor skip re-reading B.  Returns the last product.
+
+    NOTE: unlike gemv_n there is no csr (segment-sum) fused-loop arm —
+    a forced ``DR_TPU_SPMV_FORMAT=csr`` or ``ring`` runs the grouped
+    ELL/BCSR program here, so a ladder measuring through spmm_n must
+    gate its rungs on :func:`viable_formats` (csr/ring rungs would
+    secretly remeasure the grouped arm)."""
     assert isinstance(a, sparse_matrix) and a.grid_shape[1] == 1
     m, n = a.shape
     B = b.to_array() if hasattr(b, "to_array") else jnp.asarray(b)
     assert B.ndim == 2 and B.shape[0] == n
     rt = a.runtime
     nv = B.shape[1]
-    bcsr = a.ensure_bcsr()
+    fmt = _pick_format(a)
+    mode = _gather_mode(rt)
+    bcsr = fmt == "bcsr" and a.ensure_bcsr()
     have_ell = bcsr or a.ensure_ell()  # side effects survive python -O
     assert have_ell, "spmm_n needs a grouped (BCSR/ELL) fast path"
     th = a.tile_rows
     kdim = a._bcsr_kb if bcsr else a._ell_width
     key = ("spmm_n", pinned_id(rt.mesh), rt.axis, a.nshards, th, kdim,
-           bcsr, nv, m, int(iters), _spmm_w_key())
-    local_of, in_specs, args = _local_mm_parts(rt, a, th, kdim, bcsr)
+           bcsr, nv, m, int(iters), _spmm_w_key(), mode)
+    local_of, in_specs, args = _local_mm_parts(rt, a, th, kdim, bcsr,
+                                               mode)
     prog = _prog_cache.get(key)
     if prog is None:
         def body(vals, cols, B):
@@ -539,11 +879,19 @@ def spmm_n(a: sparse_matrix, b, iters: int) -> jax.Array:
 
 def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     """c += A·b (reference gemv semantics: accumulate into c,
-    gemv.hpp:45-66)."""
-    # gemv is NON-FUSIBLE in deferred regions (ISSUE 3): flush the
-    # recorded prefix (order!) before dispatching eagerly
-    from ..plan import barrier as _plan_barrier
-    _plan_barrier("gemv")
+    gemv.hpp:45-66).  Layout dispatch honors the container's
+    autoselected format with the ``DR_TPU_SPMV_FORMAT`` override
+    (:func:`_pick_format`); ``ring`` takes the pipelined rotating-b
+    schedule (:func:`_gemv_ring_program`)."""
+    # inside a deferred region gemv records as an ordered OPAQUE op
+    # (round 9; like inclusive_scan): it dispatches through its own
+    # program at flush, record order preserved — the surrounding
+    # fusible runs stay fused instead of paying a full plan flush
+    from ..plan import active as _plan_active
+    p = _plan_active()
+    if p is not None:
+        p.record_opaque("gemv", lambda: gemv(c, a, b))
+        return c
     assert isinstance(a, sparse_matrix)
     m, n = a.shape
     assert len(c) == m, "output length must equal matrix rows"
@@ -552,14 +900,20 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     if a._vals is None:
         return c  # empty matrix: nothing to add
     rt = a.runtime
+    fmt = _pick_format(a)
     if a.grid_shape[1] > 1:
-        # 2-D tile grid: partial SpMV per tile + psum over mesh columns
-        if a.ensure_bcsr():
+        # 2-D tile grid: partial SpMV per tile + a cross-column combine
+        ring_combine = _combine_mode() == "ring"
+        if fmt == "bcsr" and a.ensure_bcsr():
+            if ring_combine:
+                _pl.fire_ppermute(op="gemv2d")
             prog = _gemv2d_bcsr_program(rt, a.grid_shape, a.tile_rows,
                                         a.tile_cols, a._bcsr_nbr,
                                         a._bcsr_kb, m, n)
             y = prog(a._bcsr_vals, a._bcsr_cols, b_arr)
-        elif a.ensure_ell():
+        elif fmt != "csr" and a.ensure_ell():
+            if ring_combine:
+                _pl.fire_ppermute(op="gemv2d")
             prog = _gemv2d_ell_program(rt, a.grid_shape, a.tile_rows,
                                        a.tile_cols, a._ell_width, m, n)
             y = prog(a._ell_vals, a._ell_cols, b_arr)
@@ -575,7 +929,18 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
             and c.nshards == a.nshards and c.segment_size == a.tile_rows
             and c.runtime is rt)
     if fast:
-        if a.ensure_bcsr():
+        if fmt == "ring" and a.ensure_ring():
+            # rotating-b ring schedule: compute overlaps the transfers
+            _pl.fire_ppermute(op="gemv")
+            prog = _gemv_ring_program(rt, a.nshards, a.tile_rows,
+                                      a._ring_kr, a._ring_bw,
+                                      c.segment_size,
+                                      c.halo_bounds.prev,
+                                      _gather_mode(rt),
+                                      _pl.schedule_mode(), None, 1)
+            c._data = prog(c._data, a._ring_vals, a._ring_cols, b_arr)
+            return c
+        if fmt == "bcsr" and a.ensure_bcsr():
             # block-structured: dense-tile MXU path, one gather per tile
             prog = _gemv_bcsr_program(rt.mesh, rt.axis, a.nshards,
                                       a._bcsr_nbr,
@@ -583,10 +948,11 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
                                       c.halo_bounds.prev)
             c._data = prog(c._data, a._bcsr_vals, a._bcsr_cols, b_arr)
             return c
-        if a.ensure_ell():
+        if fmt != "csr" and a.ensure_ell():
             prog = _gemv_ell_program(rt.mesh, rt.axis, a.nshards,
                                      a.tile_rows, a._ell_width,
-                                     c.segment_size, c.halo_bounds.prev)
+                                     c.segment_size, c.halo_bounds.prev,
+                                     _gather_mode(rt))
             c._data = prog(c._data, a._ell_vals, a._ell_cols, b_arr)
             return c
         prog = _gemv_program(rt.mesh, rt.axis, a.nshards, a.tile_rows,

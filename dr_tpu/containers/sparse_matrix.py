@@ -131,6 +131,14 @@ class sparse_matrix:
         self._bcsr_kb = 0
         self._bcsr_nbr = 0
         self._bcsr_state = "maybe"
+        self._ring_vals = None
+        self._ring_cols = None
+        self._ring_kr = 0
+        self._ring_bw = 0
+        self._ring_state = "maybe"
+        self._format = "csr"     # autoselect (round 9) refines at build
+        self._row_kmax = None    # per-tile-row max nnz (ELL width hint)
+        self._bcsr_scan_cached = None  # build-time pass-1 handoff
         self._tile_nnz = np.zeros(P, dtype=np.int64)
         self._nnz = 0
 
@@ -169,11 +177,120 @@ class sparse_matrix:
         self._cols = jax.device_put(jnp.asarray(cols_h), sh)
         self._tile_nnz = counts.astype(np.int64)
         self._nnz = int(len(rows))
+        self._decide_format(counts, rows_h, cols_h)
         self._rt.register(self)
         return self
 
     # padding blowup bound for the ELL layout: rows*kmax <= factor * K
     _ELL_FACTOR = 4
+
+    def _decide_format(self, counts, rows_h, cols_h) -> None:
+        """Measured format AUTOSELECT (round 9): pick the SpMV layout
+        from the row-length distribution at build time, so an
+        adversarial long-row matrix never pays the ELL ``kmax`` padding
+        blowup (the scan that would discover the skew lazily is itself
+        O(nnz) — deciding here reuses the host triples from_coo already
+        holds).  The choice is advisory: the algorithm layer honors it
+        (``DR_TPU_SPMV_FORMAT`` overrides at dispatch) and the lazy
+        ``ensure_*`` gates remain the hard viability checks.
+
+        Rule: block-structured sparsity (the ``ensure_bcsr`` gates —
+        corrected occupiable-cell fill >= ``_BCSR_MIN_FILL``, bounded
+        block-row skew — evaluated on the host triples) -> ``bcsr``
+        (an ELL-skewed matrix with one dense BLOCK-row still keeps the
+        MXU path); else ELL blowup (``th * kmax > _ELL_FACTOR * K``)
+        -> ``csr`` (the padded-COO segment-sum path); else ``ell``.
+        The ``ring`` (rotating-b) layout is opt-in via the env
+        override / tuning ladder — its bucket padding trades compute
+        for overlapped ICI, a trade only the chip can judge
+        (docs/PERF.md round 9)."""
+        P, th = self._nshards, self._th
+        K = max(int(counts.max()), 1) if self._nnz else 1
+        kmax = 1
+        for t in range(P):
+            c = int(counts[t])
+            if c:
+                kmax = max(kmax, int(np.bincount(
+                    rows_h[t, :c], minlength=th).max()))
+        self._row_kmax = kmax
+        if self._nnz == 0:
+            self._format = "csr"
+            return
+        scan = self._bcsr_scan(counts, rows_h, cols_h)
+        bcsr_ok = scan[-1]
+        if bcsr_ok:
+            # hand the pass-1 result to the first ensure_bcsr build so
+            # it never repeats this O(nnz log nnz) host scan; viable
+            # matrices keep the tile keys small by construction
+            # (fill >= 1/16 bounds tiles <= nnz/64)
+            self._bcsr_scan_cached = scan
+        else:
+            self._bcsr_state = "no"  # the hard gate would re-reject
+        if th * kmax > self._ELL_FACTOR * K:
+            # remember the skew now: dispatch must not re-scan
+            self._ell_width = -1
+            self._ring_state = "no"
+            self._format = "bcsr" if bcsr_ok else "csr"
+            return
+        self._format = "bcsr" if bcsr_ok else "ell"
+
+    def _bcsr_scan(self, counts, rows_h, cols_h):
+        """Pass 1 of the BCSR build — ONE home for the gate math:
+        per-shard sorted tile keys (``per``), the block-ELL width
+        ``kb``, block-rows per tile ``nbr``, and the viability verdict
+        (occupiable-cell-corrected fill >= ``_BCSR_MIN_FILL`` AND
+        block-row skew within ``_BCSR_FACTOR``).  Shared by
+        :meth:`ensure_bcsr` (which builds the layout from ``per``) and
+        the build-time autoselect (:meth:`_decide_format`), so the
+        advisory choice and the hard gate can never drift apart."""
+        P, th = self._nshards, self._th
+        bh, bw = self._BCSR_BH, self._BCSR_BW
+        nbr = -(-th // bh)
+        gq = self._grid[1]
+        per = []                            # (shard) -> {(br, cb)} maps
+        kb = 1
+        total_tiles = 0
+        total_cells = 0
+        for t in range(P):
+            c = int(counts[t])
+            keys = np.unique(
+                (rows_h[t, :c] // bh).astype(np.int64) * (1 << 32)
+                | (cols_h[t, :c] // bw).astype(np.int64))
+            per.append(keys)
+            total_tiles += len(keys)
+            # occupiable cells only: a remainder block-row (unaligned
+            # tile height) holds fewer than bh real rows, and the last
+            # block-column of a narrow matrix fewer than bw real
+            # columns — padding must not deflate the fill gate.  The
+            # LAST tile's real height/width can be shorter than th/tw
+            # too; kcb is TILE-local, so the column bound is the tile's
+            # own width, not the full matrix width (round-2 advisor:
+            # shape[1] here overcounts cells on 2-D grids).
+            kbr = (keys >> 32).astype(np.int64)
+            kcb = (keys & 0xFFFFFFFF).astype(np.int64)
+            real_h = max(0, min(th, self._m - (t // gq) * th))
+            real_w = max(0, min(self._tw, self._n - (t % gq) * self._tw))
+            rows_in = np.maximum(np.minimum(bh, real_h - kbr * bh), 0)
+            cols_in = np.maximum(np.minimum(bw, real_w - kcb * bw), 0)
+            total_cells += int((rows_in * cols_in).sum())
+            if c:
+                kb = max(kb, int(np.bincount(kbr, minlength=nbr).max()))
+        fill = self._nnz / max(total_cells, 1)
+        # skew gate: the block-ELL width kb applies to EVERY block-row,
+        # so one dense block-row must not balloon the allocation —
+        # bound kb by the average occupancy (the _ELL_FACTOR analog).
+        # Mostly empty matrices are already rejected by the fill gate.
+        avg_kb = -(-total_tiles // max(P * nbr, 1))
+        viable = (fill >= self._BCSR_MIN_FILL
+                  and kb <= self._BCSR_FACTOR * max(avg_kb, 1))
+        return per, kb, nbr, viable
+
+    @property
+    def format(self) -> str:
+        """The autoselected SpMV layout (``csr``/``ell``/``bcsr``) —
+        the bench artifact's chosen-format tag.  Dispatch-time env
+        overrides (``DR_TPU_SPMV_FORMAT``) are not reflected here."""
+        return self._format
 
     def ensure_ell(self) -> bool:
         """Build the row-grouped padded (ELL) device layout lazily:
@@ -199,12 +316,10 @@ class sparse_matrix:
         K = self._vals.shape[1]
         rows_h = np.asarray(self._rows)
         P, th = self._nshards, self._th
-        kmax = 1
-        for t in range(P):
-            c = int(counts[t])
-            if c:
-                kmax = max(kmax, int(np.bincount(
-                    rows_h[t, :c], minlength=th).max()))
+        # the autoselect already scanned the row-length distribution at
+        # build time (every builder routes through from_coo, which runs
+        # _decide_format before _vals exists)
+        kmax = max(1, self._row_kmax)
         if th * kmax > self._ELL_FACTOR * max(K, 1):
             self._ell_width = -1  # remember the skew; don't retry
             return False
@@ -257,56 +372,24 @@ class sparse_matrix:
         if not self._vals.is_fully_addressable:
             return False
         bh, bw = self._BCSR_BH, self._BCSR_BW
-        th = self._th
         P = self._nshards
         counts = self._tile_nnz
         rows_h = np.asarray(self._rows)
         cols_h = np.asarray(self._cols)
-        # block-rows per shard tile; an unaligned tile height gets a
-        # zero-padded remainder block-row (_bcsr_local slices the local
-        # result back to seg_out)
-        nbr = -(-th // bh)
-        # pass 1: per-shard block-row tile lists (block col ids); the
-        # values stay on device until the gates below admit the layout
-        per = []                            # (shard) -> {(br, cb)} maps
-        kb = 1
-        total_tiles = 0
-        total_cells = 0
-        for t in range(P):
-            c = int(counts[t])
-            br = rows_h[t, :c] // bh
-            cb = cols_h[t, :c] // bw
-            keys = np.unique(br.astype(np.int64) * (1 << 32)
-                             | cb.astype(np.int64))
-            per.append(keys)
-            total_tiles += len(keys)
-            # occupiable cells only: a remainder block-row (unaligned
-            # tile height) holds fewer than bh real rows, and the last
-            # block-column of a narrow matrix fewer than bw real
-            # columns — padding must not deflate the fill gate
-            kbr = (keys >> 32).astype(np.int64)
-            kcb = (keys & 0xFFFFFFFF).astype(np.int64)
-            # the LAST tile's real height/width can be shorter than
-            # th/tw too; kcb is TILE-local, so the column bound is the
-            # tile's own width, not the full matrix width (round-2
-            # advisor: shape[1] here overcounts cells on 2-D grids)
-            gq = self._grid[1]
-            real_h = max(0, min(th, self._m - (t // gq) * th))
-            real_w = max(0, min(self._tw, self._n - (t % gq) * self._tw))
-            rows_in = np.maximum(np.minimum(bh, real_h - kbr * bh), 0)
-            cols_in = np.maximum(np.minimum(bw, real_w - kcb * bw), 0)
-            total_cells += int((rows_in * cols_in).sum())
-            if c:
-                kb = max(kb, int(np.bincount(
-                    kbr, minlength=nbr).max()))
-        fill = self._nnz / max(total_cells, 1)
-        # skew gate: the block-ELL width kb applies to EVERY block-row,
-        # so one dense block-row must not balloon the allocation — bound
-        # kb by the average occupancy (the _ELL_FACTOR analog).  Mostly
-        # empty matrices are already rejected by the fill gate.
-        avg_kb = -(-total_tiles // max(P * nbr, 1))
-        if (fill < self._BCSR_MIN_FILL
-                or kb > self._BCSR_FACTOR * max(avg_kb, 1)):
+        # pass 1 (shared gate math — :meth:`_bcsr_scan`): per-shard
+        # block-row tile lists + the viability verdict; the values stay
+        # on device until the gates admit the layout.  The build-time
+        # autoselect already ran this scan and handed it over — consume
+        # the cache (one build) instead of repeating the host sorts.
+        # nbr = block-rows per shard tile; an unaligned tile height
+        # gets a zero-padded remainder block-row (_bcsr_local slices
+        # back to seg_out).
+        scan = self._bcsr_scan_cached
+        self._bcsr_scan_cached = None
+        if scan is None:
+            scan = self._bcsr_scan(counts, rows_h, cols_h)
+        per, kb, nbr, viable = scan
+        if not viable:
             self._bcsr_state = "no"
             return False
         vals_h = np.asarray(self._vals)
@@ -340,6 +423,81 @@ class sparse_matrix:
         self._bcsr_kb = kb
         self._bcsr_nbr = nbr
         self._bcsr_state = "yes"
+        return True
+
+    # ring-bucket blowup bound: P * th * kr <= factor * K (the ELL
+    # discipline applied to the per-step buckets)
+    _RING_FACTOR = 4
+
+    def ensure_ring(self) -> bool:
+        """Build the RING-bucketed device layout lazily (round 9): the
+        rotating-b SpMV schedule (algorithms/gemv.py ring programs over
+        parallel/pipeline.py) needs each shard's entries grouped by the
+        b-block held at each ring step.  b is block-sharded into
+        ``nshards`` windows of ``bw = ceil(n / nshards)``; with the
+        forward ring permutation, shard d holds block ``(d - t) %
+        nshards`` at step t, so bucket ``[d, t]`` collects shard d's
+        entries whose column falls in that window (columns stored
+        BLOCK-local).  Buckets are per-row ELL-grouped — ``(P, P, th,
+        kr)`` arrays with kr = max per-(shard, step, row) count — so
+        each step's contraction is the same dense gather + row-sum as
+        the ELL path, just against the held (1/P-sized) window.
+
+        Viability gates: 1-D row-tiled grids with nshards > 1 only;
+        the bucket padding must stay under ``_RING_FACTOR`` x the COO
+        footprint (a banded matrix whose rows hit one block pays ~P x
+        padding — rejected and remembered, like the ELL skew gate).
+        Returns True when the layout is available."""
+        if self._ring_vals is not None:
+            return True
+        if (self._ring_state == "no" or self._vals is None
+                or self._nshards < 2 or self._grid[1] != 1):
+            return False
+        if not self._vals.is_fully_addressable:
+            return False
+        P, th = self._nshards, self._th
+        bw = max(1, -(-self._n // P))
+        counts = self._tile_nnz
+        K = self._vals.shape[1]
+        rows_h = np.asarray(self._rows)
+        cols_h = np.asarray(self._cols)
+        kr = 1
+        for t in range(P):
+            c = int(counts[t])
+            if not c:
+                continue
+            step = (t - cols_h[t, :c] // bw) % P
+            combo = step.astype(np.int64) * th + rows_h[t, :c]
+            kr = max(kr, int(np.bincount(combo,
+                                         minlength=P * th).max()))
+        if P * th * kr > self._RING_FACTOR * max(K, 1):
+            self._ring_state = "no"  # remember the skew; don't retry
+            return False
+        vals_h = np.asarray(self._vals)
+        ring_vals = np.zeros((P, P, th, kr), dtype=self._dtype)
+        ring_cols = np.zeros((P, P, th, kr), dtype=np.int32)
+        for t in range(P):
+            c = int(counts[t])
+            if not c:
+                continue
+            src = cols_h[t, :c] // bw
+            step = ((t - src) % P).astype(np.int64)
+            rows_t = rows_h[t, :c]
+            combo = step * th + rows_t
+            order = np.argsort(combo, kind="stable")
+            cs = combo[order]
+            pos = np.arange(c) - np.searchsorted(cs, cs)
+            ring_vals[t, step[order], rows_t[order], pos] = \
+                vals_h[t, :c][order]
+            ring_cols[t, step[order], rows_t[order], pos] = \
+                (cols_h[t, :c] - src * bw)[order]
+        sh = NamedSharding(self._rt.mesh,
+                           PartitionSpec(self._rt.axis, None, None, None))
+        self._ring_vals = jax.device_put(jnp.asarray(ring_vals), sh)
+        self._ring_cols = jax.device_put(jnp.asarray(ring_cols), sh)
+        self._ring_kr = kr
+        self._ring_bw = bw
+        self._ring_state = "yes"
         return True
 
     @classmethod

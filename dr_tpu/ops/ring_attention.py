@@ -14,6 +14,17 @@ Numerically-stable online softmax (flash-style running max/denominator)
 keeps memory at O(block) regardless of total sequence length; the causal
 variant masks by GLOBAL positions so results match single-device
 attention exactly.
+
+Round 9: the two hand-unrolled ring loops moved onto the SHARED
+software-pipelined schedule (``parallel/pipeline.ring_pipeline``): the
+``serial`` schedule reproduces the historical compute-then-rotate
+order exactly, the default ``pipelined`` schedule issues each
+rotation before the step's compute (double-buffered carry,
+``optimization_barrier``-paired) — the same dataflow in the same
+reduction order, so results are unchanged either way.  The resolved
+schedule keys the program cache (``DR_TPU_RING_SCHEDULE`` A/B sweeps
+rebuild), and dispatch routes through the ``collectives.ppermute``
+fault site.
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.pinning import pinned_id
+from ..parallel import pipeline as _pl
 from ..parallel import runtime as _rt
 from . import flash_attention as _fa
 from ..utils.spmd_guard import TappedCache
@@ -63,9 +75,11 @@ def _flash_viable(shape, dtype, rt) -> bool:
 
 
 def _build_flash(mesh, axis, nshards, shape, causal, dtype,
-                 interpret=False, hkv=None):
+                 interpret=False, hkv=None, schedule=None):
     """Ring schedule with the fused Pallas block kernel as the per-step
-    compute: K/V blocks rotate via ppermute, the (m, l, acc) online-
+    compute: K/V blocks rotate via ppermute on the SHARED ring pipeline
+    (parallel/pipeline.py — pipelined by default, overlapping each
+    step's kernel with the next transfer), the (m, l, acc) online-
     softmax state is the carry, normalization happens once at the end.
     ``interpret`` runs the kernel interpreted (CPU-mesh validation of
     the multi-shard ring carries).  ``hkv`` < h is grouped-query
@@ -75,7 +89,6 @@ def _build_flash(mesh, axis, nshards, shape, causal, dtype,
     hkv = h if hkv is None else hkv
     BH = B * h
     bq, bk = _fa.pick_blocks(s, s, d)
-    ring = [(i, (i + 1) % nshards) for i in range(nshards)]
 
     def body(q, k, v):
         my = lax.axis_index(axis)
@@ -88,14 +101,18 @@ def _build_flash(mesh, axis, nshards, shape, causal, dtype,
         l = jnp.zeros((BH, s, 1), jnp.float32)
         acc = jnp.zeros((BH, s, d), jnp.float32)
         q_off = my * s
-        for t in range(nshards):  # static unroll: overlaps compute + ICI
+
+        def step(t, carry, blocks):
+            m, l, acc = carry
+            kh, vh = blocks
             src = (my - t) % nshards
-            m, l, acc = _fa.flash_update(
+            return _fa.flash_update(
                 qh, kh, vh, m, l, acc, q_off, src * s,
                 causal=causal, bq=bq, bk=bk, interpret=interpret)
-            if t + 1 < nshards:
-                kh = lax.ppermute(kh, axis, ring)
-                vh = lax.ppermute(vh, axis, ring)
+
+        m, l, acc = _pl.ring_pipeline(
+            axis, nshards, (m, l, acc), (kh, vh), step,
+            schedule=schedule)
         safe_l = jnp.where(l > 0, l, 1.0)
         out = (acc / safe_l).astype(dtype)
         return jnp.einsum("bhsd->bshd",
@@ -129,11 +146,10 @@ def _pick_q_chunk(B, s, h, budget_bytes=512 * 2 ** 20):
 
 
 def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None,
-           hkv=None):
+           hkv=None, schedule=None):
     B, s, h, d = shape  # local block: (batch, seq_shard, heads, head_dim)
     group = 1 if hkv is None else h // hkv
     scale = 1.0 / math.sqrt(d)
-    ring = [(i, (i + 1) % nshards) for i in range(nshards)]
     qc = min(q_chunk or _pick_q_chunk(B, s, h), s)
     while s % qc:
         qc -= 1  # honor the bound: largest divisor of s <= requested
@@ -176,39 +192,30 @@ def _build(mesh, axis, nshards, shape, causal, dtype, q_chunk=None,
                 preferred_element_type=jnp.float32)
             return new_m, l_c, acc_c
 
-        def step(t, carry):
-            m, l, acc, kT, vT = carry
+        def step(t, carry, blocks):
+            m, l, acc = carry
             src = (my - t) % nshards  # whose block we hold this round
             k_pos = src * s + jnp.arange(s)
-            # GQA: the ring carries only the hkv shared heads; expand to
-            # the q head count just-in-time for this step's einsums
-            kT = _repeat_heads_hmajor(kT, group)
-            vT = _repeat_heads_hmajor(vT, group)
+            # GQA: the ring moves only the hkv shared heads (ppermute is
+            # layout-agnostic: the head-major blocks travel directly);
+            # expand to the q head count just-in-time for the einsums
+            kT = _repeat_heads_hmajor(blocks[0], group)
+            vT = _repeat_heads_hmajor(blocks[1], group)
             if nqc == 1:
                 m, l, acc = one_chunk(
                     (q_ch[0], q_pos[0], m[0], l[0], acc[0]),
                     kT, vT, k_pos)
-                m, l, acc = m[None], l[None], acc[None]
-            else:
-                # chunked q bounds the (B, h, qc, s) logits regardless of
-                # the local sequence length (long-context single chip)
-                m, l, acc = lax.map(
-                    lambda a: one_chunk(a, kT, vT, k_pos),
-                    (q_ch, q_pos, m, l, acc))
-            # rotate K/V around the ring for the next round (ppermute is
-            # layout-agnostic: the head-major blocks travel directly).
-            # The UN-expanded blocks travel: GQA moves only hkv heads.
-            kT, vT = carry[3], carry[4]
-            kT = lax.ppermute(kT, axis, ring)
-            vT = lax.ppermute(vT, axis, ring)
-            return m, l, acc, kT, vT
+                return m[None], l[None], acc[None]
+            # chunked q bounds the (B, h, qc, s) logits regardless of
+            # the local sequence length (long-context single chip)
+            return lax.map(lambda a: one_chunk(a, kT, vT, k_pos),
+                           (q_ch, q_pos, m, l, acc))
 
         # head-major ONCE; the ring carries the transposed blocks
-        carry = (m, l, acc, jnp.einsum("bkhd->bhkd", k),
-                 jnp.einsum("bkhd->bhkd", v))
-        for t in range(nshards):  # static unroll: overlaps compute + ICI
-            carry = step(t, carry)
-        m, l, acc, _, _ = carry
+        m, l, acc = _pl.ring_pipeline(
+            axis, nshards, (m, l, acc),
+            (jnp.einsum("bkhd->bhkd", k), jnp.einsum("bkhd->bhkd", v)),
+            step, schedule=schedule)
         safe_l = jnp.where(l > 0, l, 1.0)
         out = (acc / safe_l[..., None]).astype(dtype)   # (nqc, B, h, qc, d)
         out = jnp.moveaxis(out, 0, 2).reshape(B, h, s, d)
@@ -246,16 +253,18 @@ def ring_attention(q, k, v, *, causal: bool = False, runtime=None,
     # caps may change between calls (tools/tune_tpu.py sweeps them)
     blocks = _fa.pick_blocks(shape[1], shape[1], d) if flash else None
     stream = _fa.use_streaming(shape[1], d) if flash else None
+    sched = _pl.schedule_mode()
+    _pl.fire_ppermute(op="ring_attention")
     key = ("ringattn", pinned_id(rt.mesh), shape, hkv, causal,
-           str(q.dtype), q_chunk, flash, blocks, stream)
+           str(q.dtype), q_chunk, flash, blocks, stream, sched)
     prog = _cache.get(key)
     if prog is None:
         if flash:
             prog = _build_flash(rt.mesh, rt.axis, nshards, shape, causal,
-                                q.dtype, hkv=hkv)
+                                q.dtype, hkv=hkv, schedule=sched)
         else:
             prog = _build(rt.mesh, rt.axis, nshards, shape, causal,
-                          q.dtype, q_chunk, hkv=hkv)
+                          q.dtype, q_chunk, hkv=hkv, schedule=sched)
         _cache[key] = prog
     return prog(q, k, v)
 
@@ -279,12 +288,15 @@ def ring_attention_n(q, k, v, iters: int, *, causal: bool = False,
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     blocks = _fa.pick_blocks(shape[1], shape[1], d) if flash else None
     stream = _fa.use_streaming(shape[1], d) if flash else None
+    sched = _pl.schedule_mode()
+    _pl.fire_ppermute(op="ring_attention_n")
     key = ("ringattn_n", pinned_id(rt.mesh), shape, causal,
-           str(q.dtype), flash, blocks, stream, int(iters))
+           str(q.dtype), flash, blocks, stream, int(iters), sched)
     prog = _cache.get(key)
     if prog is None:
         build = _build_flash if flash else _build
-        one = build(rt.mesh, rt.axis, nshards, shape, causal, q.dtype)
+        one = build(rt.mesh, rt.axis, nshards, shape, causal, q.dtype,
+                    schedule=sched)
 
         def many(q, k, v):
             return lax.fori_loop(

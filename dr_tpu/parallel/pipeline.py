@@ -1,0 +1,156 @@
+"""Software-pipelined ring schedules — ONE home for the mesh ring loop.
+
+Every ring program in the package (ring attention's K/V rotation, the
+sparse gemv/spmm family's b-block rotation, the ring combine of 2-D
+tile partials) is the same shape: a statically-unrolled loop of
+``nshards`` steps where each step computes against the block the shard
+currently holds and blocks rotate one hop around the ring via
+``lax.ppermute`` between steps.  Before round 9 that loop was
+hand-written per module (ops/ring_attention.py carried two copies);
+this module is the shared schedule with TWO issue orders:
+
+* ``serial`` — compute step t, THEN issue the ppermute for step t+1
+  (the historical hand-unrolled order: the transfer cannot start until
+  the step's compute has been scheduled).
+* ``pipelined`` (default) — issue the ppermute for step t+1 FIRST,
+  compute step t against the HELD buffer (double-buffered carry), and
+  pair the in-flight blocks with the step's carry through
+  ``lax.optimization_barrier`` so XLA cannot re-serialize the transfer
+  behind the compute.  The classic communication/computation-overlap
+  discipline (Mesh-TensorFlow-style SPMD; "Memory-efficient array
+  redistribution through portable collective communication",
+  PAPERS.md): on TPU the ICI transfer for round t+1 proceeds while the
+  VPU/MXU runs round t.
+
+The two schedules execute the SAME dataflow graph — every value is
+computed from the same operands in the same reduction order — so their
+results are bit-identical; only the issue order (and therefore what the
+backend may overlap) differs.  ``DR_TPU_RING_SCHEDULE`` selects the
+default; programs key their caches on the resolved mode so in-process
+A/B sweeps rebuild instead of reusing the first-traced schedule.
+
+Fault injection: ``collectives.ppermute`` (utils/faults) is the ring
+data plane's site.  ``fire_ppermute`` is called by the dispatchers of
+every ring-scheduled program (gemv ring family, ring attention) at
+dispatch time — BEFORE the program cache lookup — so an armed fault
+drops the dispatch with containers untouched, exactly like the
+``collectives.shift`` site.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+from jax import lax
+
+from ..utils import faults as _faults
+
+__all__ = ["ring_perm", "schedule_mode", "ring_pipeline",
+           "ring_allgather", "ring_combine", "fire_ppermute"]
+
+
+def ring_perm(nshards: int) -> List[Tuple[int, int]]:
+    """The forward ring permutation (shard i's block moves to i+1)."""
+    return [(i, (i + 1) % nshards) for i in range(nshards)]
+
+
+def schedule_mode() -> str:
+    """The ring issue order: ``DR_TPU_RING_SCHEDULE`` in
+    {``pipelined``, ``serial``}; malformed values fall back to the
+    pipelined default (a typo in a tuning sweep must not brick every
+    ring program at trace time)."""
+    mode = os.environ.get("DR_TPU_RING_SCHEDULE", "").strip().lower()
+    return mode if mode in ("pipelined", "serial") else "pipelined"
+
+
+def fire_ppermute(**ctx) -> None:
+    """Dispatch-time hook for the ``collectives.ppermute`` fault site:
+    every ring-program dispatcher calls this before its program-cache
+    lookup, so an armed fault surfaces classified with no partial
+    dispatch behind it."""
+    _faults.fire("collectives.ppermute", **ctx)
+
+
+def ring_pipeline(axis: str, nshards: int, carry: Any, blocks: Any,
+                  compute: Callable[[int, Any, Any], Any], *,
+                  perm: Optional[List[Tuple[int, int]]] = None,
+                  schedule: Optional[str] = None,
+                  restore_blocks: bool = False):
+    """Statically-unrolled ring loop (trace-time; call inside a
+    ``shard_map`` body).
+
+    ``carry = compute(t, carry, blocks)`` runs once per step with
+    ``blocks`` (any pytree) holding the buffers that have been rotated
+    ``t`` hops: at step t a shard started at rank d holds rank
+    ``(d - t) % nshards``'s blocks.  Between steps the blocks rotate
+    one hop via ``lax.ppermute`` over ``axis``; the issue order follows
+    ``schedule`` (:func:`schedule_mode` when None).  The pipelined
+    schedule issues the rotation BEFORE the step's compute and pairs
+    the in-flight blocks with the carry through
+    ``lax.optimization_barrier`` — bit-identical to serial (same
+    dataflow, same reduction order), only the overlap differs.
+
+    ``restore_blocks=True`` adds the final nshards-th rotation so the
+    blocks return to their origin shard and returns ``(carry,
+    blocks)`` — the form a fused ``*_n`` measurement loop needs so
+    every iteration starts from the same placement.
+    """
+    sched = schedule or schedule_mode()
+    p = ring_perm(nshards) if perm is None else perm
+
+    def rotate(bs):
+        return jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis, p), bs)
+
+    for t in range(nshards):
+        rotate_after = (t + 1 < nshards) or restore_blocks
+        if sched == "pipelined" and rotate_after:
+            nxt = rotate(blocks)           # in flight during compute t
+            carry = compute(t, carry, blocks)
+            # pair transfer and compute: without the barrier XLA may
+            # sink the ppermute below the step's compute (re-serialize)
+            nxt, carry = lax.optimization_barrier((nxt, carry))
+            blocks = nxt
+        else:
+            carry = compute(t, carry, blocks)
+            if rotate_after:
+                blocks = rotate(blocks)
+    return (carry, blocks) if restore_blocks else carry
+
+
+def ring_allgather(axis: str, nshards: int, block, *,
+                   schedule: Optional[str] = None):
+    """Every shard's ``block`` stacked source-rank-first:
+    ``(nshards,) + block.shape``, built from nshards-1 ring rotations
+    (trace-time; call inside a ``shard_map`` body).  Slot ``s`` holds
+    rank s's block on EVERY shard, so any fold over axis 0 runs in the
+    same canonical order everywhere — the property :func:`ring_combine`
+    needs for cross-shard bitwise agreement."""
+    import jax.numpy as jnp
+    my = lax.axis_index(axis)
+    buf = jnp.zeros((nshards,) + block.shape, block.dtype)
+
+    def place(t, acc, blk):
+        src = (my - t) % nshards
+        return lax.dynamic_update_slice(
+            acc, blk[None], (src,) + (0,) * block.ndim)
+
+    return ring_pipeline(axis, nshards, buf, block, place,
+                         schedule=schedule)
+
+
+def ring_combine(axis: str, nshards: int, x, *,
+                 schedule: Optional[str] = None):
+    """Ring all-reduce (sum) of ``x`` over ``axis``: all-gather around
+    the ring, then ONE canonical-order sum over the stacked sources —
+    every shard folds ranks 0..nshards-1 in the same order, so the
+    result is bitwise identical across shards and across the
+    serial/pipelined schedules (a rotate-and-accumulate ring would sum
+    in a different order on every shard).  The ``psum`` alternative is
+    usually faster on TPU (the 2-D gemv/spmm programs default to it);
+    this is the ring arm for the DR_TPU_SPMV_COMBINE A/B."""
+    if nshards == 1:
+        return x
+    return ring_allgather(axis, nshards, x, schedule=schedule).sum(0)

@@ -32,10 +32,15 @@ Flush points (executing the queue in record order):
 * **host materialization** — ``to_array`` / ``materialize`` / ``get`` /
   ``put`` / indexing / ``fence`` on a container, or resolving a
   :class:`PlanScalar`;
-* **non-fusible ops** (sort, gemv, unaligned fallback routes) — the
-  plan flushes, announces the cliff via ``warn_fallback("plan", ...)``
+* **non-fusible ops** (sort, unaligned fallback routes) — the plan
+  flushes, announces the cliff via ``warn_fallback("plan", ...)``
   (registry-routed, chaos-countable), and the op runs eagerly;
 * explicit :meth:`Plan.flush`.
+
+``gemv`` records as an ordered OPAQUE op (round 9, like
+inclusive_scan): it dispatches through its own program at flush,
+record order preserved, and the fusible runs around it stay fused —
+no flush cliff, no warn_fallback.
 
 Mid-chain reductions ride the carry as device scalars: a recorded
 reduce returns a :class:`PlanScalar` whose value is an output of the

@@ -10,9 +10,35 @@ in a tuning sweep must not brick unrelated programs.
 
 from __future__ import annotations
 
+import contextlib
 import os
 
-__all__ = ["env_int", "env_pow2"]
+__all__ = ["env_int", "env_pow2", "env_override"]
+
+
+@contextlib.contextmanager
+def env_override(**vars_):
+    """Scoped env-var override with exact restore: sets each ``VAR=value``
+    (``None`` deletes the var for the scope) and puts every var back on
+    exit — to its prior value if it had one, else removed.  ONE home for
+    the save/force/finally-restore dance the format/schedule sweeps
+    (bench ladder, tune ladders, fuzz arms, chaos battery) all need; a
+    hand-copied restore that mixes up the None-vs-set cases leaks a
+    forced format into whatever measures next."""
+    prior = {v: os.environ.get(v) for v in vars_}
+    try:
+        for v, val in vars_.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+        yield
+    finally:
+        for v, val in prior.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
 
 
 def env_int(name: str, default: int, floor: int = 1) -> int:

@@ -21,6 +21,11 @@ dispatch.cache       every TappedCache lookup       transient, program
                      cache + all module caches)
 collectives.shift    communicator shift_*           transient, oom, program
 collectives.alltoall communicator.alltoall          transient, oom, program
+collectives.ppermute ring-pipeline dispatchers      transient, oom, program
+                     (parallel/pipeline.
+                     fire_ppermute — the gemv ring
+                     family, ring attention, the
+                     2-D ring combine)
 halo.exchange        span_halo exchange/exchange_n  transient, oom, program
 halo.reduce          span_halo.reduce               transient, oom, program
 checkpoint.write     checkpoint.save (pre-replace)  transient, truncate,
@@ -81,6 +86,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "dispatch.cache": ("transient", "program"),
     "collectives.shift": ("transient", "oom", "program"),
     "collectives.alltoall": ("transient", "oom", "program"),
+    "collectives.ppermute": ("transient", "oom", "program"),
     "halo.exchange": ("transient", "oom", "program"),
     "halo.reduce": ("transient", "oom", "program"),
     "checkpoint.write": ("transient", "truncate", "program"),

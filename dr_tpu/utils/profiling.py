@@ -175,9 +175,10 @@ class PhaseBreakdown:
                 for nm in self.names}
 
     def detail(self, bytes_per_op: float, digits: int = 3) -> dict:
-        """Bench-JSON form: per-phase effective GB/s for a program
-        moving ``bytes_per_op`` logical bytes per fused iteration
-        (phases that measured ~0 report 0.0, not inf)."""
+        """Bench-JSON form: per-phase effective giga-units/s for a
+        program moving ``bytes_per_op`` logical units per fused
+        iteration — bytes give GB/s, FLOPs give GFLOP/s (the round-9
+        spmv ladder) — phases that measured ~0 report 0.0, not inf."""
         out = {}
         for nm in self.names:
             s = self.seconds[nm]
@@ -185,15 +186,18 @@ class PhaseBreakdown:
                 else 0.0
         return out
 
-    def table(self, bytes_per_op: float = None) -> str:
-        """Human-readable per-phase table (tune_tpu.py output)."""
+    def table(self, bytes_per_op: float = None,
+              unit: str = "GB/s") -> str:
+        """Human-readable per-phase table (tune_tpu.py output);
+        ``unit`` labels the rate column (``bytes_per_op`` in FLOPs +
+        unit="GFLOP/s" for the spmv ladder)."""
         tot = sum(self.seconds.values()) or 1.0
         lines = []
         for nm in self.names:
             s = self.seconds[nm]
             line = f"  {nm:<12s} {s * 1e3:9.3f} ms  {s / tot:6.1%}"
             if bytes_per_op is not None and s > 0:
-                line += f"  {bytes_per_op / s / 1e9:8.2f} GB/s"
+                line += f"  {bytes_per_op / s / 1e9:8.2f} {unit}"
             lines.append(line)
         lines.append(f"  {'total':<12s} {self.total * 1e3:9.3f} ms")
         return "\n".join(lines)

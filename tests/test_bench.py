@@ -65,6 +65,20 @@ def test_bench_json_contract():
     # round 8: the deferred-pipeline config and the tap dispatch counts
     # ride every artifact (ISSUE 3 acceptance: the CPU-fallback bench
     # emits pipeline_gbps + dispatch_counts)
+    # round 9: the sparse family's phase breakdown + chosen-format tag
+    # ride every artifact (either the ring ladder, the honest
+    # p=1/ring-ineligible collapse, or a tagged error)
+    if "spmv_gflops" in d:
+        assert "spmv_format" in d and d["spmv_format"] in (
+            "csr", "ell", "bcsr", "ring"), "missing detail.spmv_format"
+        assert "spmv_phases_gflops" in d or "spmv_phases_error" in d, \
+            "missing detail.spmv_phases_gflops"
+        if "spmv_phases_gflops" in d:
+            assert "spmv_phase_dominant" in d
+            assert all(vv >= 0
+                       for vv in d["spmv_phases_gflops"].values())
+    if "spmm8_gflops" in d:
+        assert "spmm_format" in d
     assert "pipeline_gbps" in d or "pipeline_error" in d, \
         "missing detail.pipeline_gbps"
     assert "dispatch_counts" in d

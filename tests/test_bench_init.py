@@ -166,12 +166,12 @@ def test_dead_relay_skips_probe_entirely(monkeypatch, bench):
     assert "probe skipped" in env["_DR_TPU_BENCH_DEGRADED"]
 
 
-@pytest.mark.parametrize("flag", ["--phases", "--pipeline"])
+@pytest.mark.parametrize("flag", ["--phases", "--pipeline", "--spmv"])
 def test_cli_flags_survive_both_re_execs(monkeypatch, bench, flag):
-    """--phases/--pipeline must ride sys.argv through BOTH exec legs
-    (retry-in-fresh-process and CPU fallback), or a degraded run would
-    silently drop the ladder the operator asked for (round 6 lesson,
-    extended to the round-8 pipeline flag)."""
+    """--phases/--pipeline/--spmv must ride sys.argv through BOTH exec
+    legs (retry-in-fresh-process and CPU fallback), or a degraded run
+    would silently drop the ladder the operator asked for (round 6
+    lesson, extended to the round-8 pipeline and round-9 spmv flags)."""
     monkeypatch.setattr(bench.sys, "argv", ["bench.py", flag])
     # leg 1: first failure -> retry exec
     monkeypatch.delenv("_DR_TPU_BENCH_RETRY", raising=False)

@@ -53,6 +53,29 @@ def _battery(tmpdir: str, tag: str) -> None:
     comm.shift_forward(v._data, periodic=True)
     comm.alltoall(comm.scatter(np.zeros((P, P, 4), np.float32)))
 
+    # ring-scheduled SpMV (round 9): the collectives.ppermute site fires
+    # at the ring dispatcher.  Columns spread one-per-block so the ring
+    # bucket gate admits the layout; format forced so the autoselect
+    # cannot route around the site.
+    gm = 8 * P
+    gbw = -(-gm // P)
+    grows = np.repeat(np.arange(gm), 2)
+    gcols = np.minimum(np.tile(np.arange(2), gm) * gbw
+                       + rng.integers(0, gbw, 2 * gm), gm - 1)
+    gvals = rng.standard_normal(2 * gm).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo((gm, gm), grows, gcols, gvals)
+    gc = dr_tpu.distributed_vector(gm)
+    dr_tpu.fill(gc, 0.0)
+    from dr_tpu.utils.env import env_override
+    with env_override(DR_TPU_SPMV_FORMAT="ring"):
+        assert A.ensure_ring(), "battery ring matrix must be eligible"
+        dr_tpu.gemv(gc, A, np.ones(gm, np.float32))
+    ref = np.zeros((gm,), np.float64)
+    np.add.at(ref, grows, gvals.astype(np.float64)
+              * np.ones(gm)[gcols])
+    np.testing.assert_allclose(dr_tpu.to_numpy(gc), ref, rtol=1e-4,
+                               atol=1e-5)
+
     sv = dr_tpu.distributed_vector.from_array(src)
     dr_tpu.sort(sv)
     got = dr_tpu.to_numpy(sv)

@@ -1,0 +1,324 @@
+"""Ring-pipeline schedules + sparse format autoselect (round 9).
+
+The acceptance surface of the spmv overhaul: the pipelined ring
+schedule must be BIT-identical to the serial one (same dataflow, same
+reduction order — only the ppermute issue order differs), repeated
+calls with new b values must hit the program cache (zero recompiles,
+stable spmd_guard digest), the format autoselect must route the
+adversarial shapes away from the ELL padding blowup, and the
+``collectives.ppermute`` fault site must fire classified at the ring
+dispatchers with containers untouched.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu.algorithms.gemv import SPMV_PHASES, gemv_n, gemv_phases_n
+from dr_tpu.utils import faults, resilience
+from dr_tpu.utils.env import env_override
+
+
+def _ring_friendly(m, n, k, seed=0):
+    """Random matrix with each row's k entries in k distinct b-blocks:
+    ring bucket width 1, always under the blowup gate."""
+    P = dr_tpu.nprocs()
+    bw = max(1, -(-n // P))
+    rng = np.random.default_rng(seed)
+    rows = np.repeat(np.arange(m), k)
+    blocks = np.tile(np.arange(k) % P, m)
+    cols = np.minimum(blocks * bw + rng.integers(0, bw, m * k), n - 1)
+    vals = rng.standard_normal(m * k).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo((m, n), rows, cols, vals)
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    return A, dense
+
+
+@pytest.fixture
+def fmt_env(monkeypatch):
+    """Scoped DR_TPU_SPMV_FORMAT / DR_TPU_RING_SCHEDULE control."""
+    def set_(fmt=None, sched=None):
+        for var, val in (("DR_TPU_SPMV_FORMAT", fmt),
+                         ("DR_TPU_RING_SCHEDULE", sched)):
+            if val is None:
+                monkeypatch.delenv(var, raising=False)
+            else:
+                monkeypatch.setenv(var, val)
+    return set_
+
+
+def _gemv(A, b, m):
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 0.0)
+    dr_tpu.gemv(c, A, b)
+    return dr_tpu.to_numpy(c)
+
+
+def test_ring_gemv_matches_oracle_and_schedules_bitwise(fmt_env):
+    """The ring schedule's two issue orders are bit-identical and both
+    match the dense oracle (the tentpole's correctness bar)."""
+    P = dr_tpu.nprocs()
+    m, n, k = 16 * P, 12 * P, min(4, P)
+    A, dense = _ring_friendly(m, n, k)
+    assert A.ensure_ring(), "test matrix must be ring-eligible"
+    b = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+    fmt_env(fmt="ring", sched="serial")
+    serial = _gemv(A, b, m)
+    fmt_env(fmt="ring", sched="pipelined")
+    pipelined = _gemv(A, b, m)
+    np.testing.assert_array_equal(serial, pipelined)
+    np.testing.assert_allclose(serial, dense @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_gemv_zero_recompiles_new_b(fmt_env):
+    """Repeated ring gemv with STREAMING b values reuses one compiled
+    program: no cache growth, identical spmd_guard digests."""
+    from dr_tpu.algorithms.elementwise import _prog_cache
+    from dr_tpu.utils import spmd_guard
+
+    P = dr_tpu.nprocs()
+    m, n, k = 8 * P, 8 * P, min(3, P)
+    A, dense = _ring_friendly(m, n, k, seed=2)
+    assert A.ensure_ring()
+    rng = np.random.default_rng(3)
+    fmt_env(fmt="ring")
+    b0 = rng.standard_normal(n).astype(np.float32)
+    got0 = _gemv(A, b0, m)  # compile once
+    np.testing.assert_allclose(got0, dense @ b0, rtol=1e-4, atol=1e-5)
+    n0 = len(_prog_cache)
+    digests = []
+    for _ in range(3):
+        b = rng.standard_normal(n).astype(np.float32)
+        with spmd_guard.guard() as g:
+            got = _gemv(A, b, m)
+        digests.append(g.digest())
+        np.testing.assert_allclose(got, dense @ b, rtol=1e-4, atol=1e-5)
+    assert len(_prog_cache) == n0, "new b values recompiled a program"
+    assert len(set(digests)) == 1, "dispatch digest drifted across calls"
+
+
+def test_ring_gemv_n_and_phase_truncations(fmt_env):
+    """gemv_n's ring arm runs, and every SPMV_PHASES truncation
+    compiles and dispatches; the full-program truncation ("combine")
+    at iters=1 is exactly the eager ring gemv."""
+    P = dr_tpu.nprocs()
+    m = 8 * P
+    A, dense = _ring_friendly(m, m, min(3, P), seed=4)
+    assert A.ensure_ring()
+    b = np.ones(m, np.float32)
+    bv = dr_tpu.distributed_vector.from_array(b)
+    fmt_env(fmt="ring")
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 0.0)
+    gemv_n(c, A, bv, 3)
+    assert np.isfinite(dr_tpu.to_numpy(c)).all()
+    for ph in SPMV_PHASES:
+        c = dr_tpu.distributed_vector(m)
+        dr_tpu.fill(c, 0.0)
+        gemv_phases_n(c, A, bv, ph, 2)
+        assert np.isfinite(dr_tpu.to_numpy(c)).all(), ph
+    # the last phase IS the full program: iters=1 == eager ring gemv
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 0.0)
+    gemv_phases_n(c, A, bv, "combine", 1)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(c), _gemv(A, b, m))
+
+
+def test_ring_gate_rejects_block_skew(fmt_env):
+    """A banded-ish matrix whose rows hit ONE b-block pays ~P x bucket
+    padding: the ensure_ring gate must refuse (and remember), and the
+    ring format request must fall back to a correct path."""
+    P = dr_tpu.nprocs()
+    if P < 4:
+        pytest.skip("needs a wide mesh for the skew to exceed the gate")
+    m = 16 * P
+    bw = -(-m // P)
+    rng = np.random.default_rng(5)
+    k = 8
+    rows = np.repeat(np.arange(m), k)
+    # every entry of a row inside the row's OWN block: one bucket gets
+    # all k entries, the other P-1 get zero
+    cols = (rows // bw) * bw + rng.integers(0, bw, m * k)
+    vals = rng.standard_normal(m * k).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo((m, m), rows, cols, vals)
+    assert not A.ensure_ring()
+    assert A._ring_state == "no"  # remembered, no rescan
+    dense = np.zeros((m, m), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    b = rng.standard_normal(m).astype(np.float32)
+    fmt_env(fmt="ring")  # must fall back, not fail
+    np.testing.assert_allclose(_gemv(A, b, m), dense @ b, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_2d_ring_combine_matches_psum_and_schedules(fmt_env,
+                                                    monkeypatch):
+    """The 2-D grid programs' ring combine (all-gather + canonical-
+    order sum) agrees with the psum default and is bitwise stable
+    across schedules."""
+    gp, gq = dr_tpu.factor(dr_tpu.nprocs())
+    if gq < 2:
+        pytest.skip("needs a 2-D grid with >1 mesh column")
+    part = dr_tpu.block_cyclic(grid=(gp, gq))
+    rng = np.random.default_rng(6)
+    m, n = 40, 36
+    d = np.where(rng.random((m, n)) < 0.3,
+                 rng.standard_normal((m, n)), 0).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_dense(d, partition=part)
+    b = rng.standard_normal(n).astype(np.float32)
+    ref = _gemv(A, b, m)  # psum default
+    outs = {}
+    monkeypatch.setenv("DR_TPU_SPMV_COMBINE", "ring")
+    for sched in ("serial", "pipelined"):
+        fmt_env(sched=sched)
+        outs[sched] = _gemv(A, b, m)
+        np.testing.assert_allclose(outs[sched], d @ b, rtol=1e-4,
+                                   atol=1e-4)
+    np.testing.assert_array_equal(outs["serial"], outs["pipelined"])
+    np.testing.assert_allclose(ref, outs["serial"], rtol=1e-5,
+                               atol=1e-6)
+    # spmm rides the same combine
+    B = rng.standard_normal((n, 3)).astype(np.float32)
+    got = np.asarray(dr_tpu.spmm(A, B))
+    np.testing.assert_allclose(got, d @ B, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_schedule_ab():
+    """The refactored ring attention (shared pipeline helper) produces
+    the same output under both schedules — the satellite's no-numeric-
+    change bar."""
+    import jax.numpy as jnp
+    P = dr_tpu.nprocs()
+    B, S, h, d = 1, 8 * P, 2, 8
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, h, d))
+                           .astype(np.float32)) for _ in range(3))
+    outs = {}
+    with env_override(DR_TPU_RING_SCHEDULE=None):
+        for sched in ("serial", "pipelined"):
+            os.environ["DR_TPU_RING_SCHEDULE"] = sched
+            outs[sched] = np.asarray(
+                dr_tpu.ring_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(outs["serial"], outs["pipelined"],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_ppermute_fault_site_classified(fmt_env):
+    """An armed collectives.ppermute fault surfaces CLASSIFIED at the
+    ring dispatcher with the output container untouched (the dispatch
+    never reached the backend)."""
+    P = dr_tpu.nprocs()
+    m = 8 * P
+    A, _ = _ring_friendly(m, m, min(3, P), seed=8)
+    assert A.ensure_ring()
+    b = np.ones(m, np.float32)
+    fmt_env(fmt="ring")
+    c = dr_tpu.distributed_vector(m)
+    dr_tpu.fill(c, 1.5)
+    before = dr_tpu.to_numpy(c)
+    with faults.injected("collectives.ppermute", "transient",
+                         times=1) as sp:
+        with pytest.raises(resilience.TransientBackendError):
+            dr_tpu.gemv(c, A, b)
+        assert sp.fired == 1
+    np.testing.assert_array_equal(dr_tpu.to_numpy(c), before)
+    # disarmed: the same call goes through
+    dr_tpu.gemv(c, A, b)
+    assert np.isfinite(dr_tpu.to_numpy(c)).all()
+
+
+# ------------------------------------------------------- format autoselect
+
+def test_autoselect_long_row_adversary_picks_csr():
+    """One dense row: the ELL kmax blowup the autoselect exists to
+    dodge — format csr, the skew remembered so dispatch never rescans."""
+    m, n = 64, 64
+    rng = np.random.default_rng(9)
+    rows = np.concatenate([np.zeros(n, np.int64),
+                           rng.integers(0, m, 8)])
+    cols = np.concatenate([np.arange(n), rng.integers(0, n, 8)])
+    vals = rng.standard_normal(len(rows)).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo((m, n), rows, cols, vals)
+    assert A.format == "csr"
+    assert A._ell_width == -1  # skew recorded at build
+    assert not A.ensure_ell()
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    b = rng.standard_normal(n).astype(np.float32)
+    np.testing.assert_allclose(_gemv(A, b, m), dense @ b, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_autoselect_skewed_but_block_structured_keeps_bcsr():
+    """ELL-skewed matrices that still pass the BCSR gates keep the MXU
+    path: one dense row PER SHARD over n=512 blows the ELL kmax gate
+    (kmax = 512 against 8-row tiles) but fills the touched (8, 128)
+    tiles at 1/8 with uniform block-row skew.  Before the fix the
+    autoselect forced csr here and spmm_n (no csr arm) crashed where
+    the pre-autoselect code ran BCSR."""
+    from dr_tpu.algorithms.gemv import spmm_n
+    P = dr_tpu.nprocs()
+    m, n = 8 * P, 512
+    rows = np.repeat(np.arange(0, m, 8), n)
+    cols = np.tile(np.arange(n), P)
+    rng = np.random.default_rng(13)
+    vals = rng.standard_normal(len(rows)).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo((m, n), rows, cols, vals)
+    assert A.format == "bcsr"
+    assert A.ensure_bcsr()
+    assert A._ell_width == -1      # the ELL skew memo still stands
+    assert not A.ensure_ell()
+    dense = np.zeros((m, n), np.float64)
+    np.add.at(dense, (rows, cols), vals.astype(np.float64))
+    b = rng.standard_normal(n).astype(np.float32)
+    np.testing.assert_allclose(_gemv(A, b, m),
+                               dense @ b.astype(np.float64),
+                               rtol=1e-3, atol=1e-4)
+    B = rng.standard_normal((n, 3)).astype(np.float32)
+    spmm_n(A, B, 2)                # the pre-fix AssertionError path
+    np.testing.assert_allclose(np.asarray(dr_tpu.spmm(A, B)),
+                               dense @ B.astype(np.float64),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_autoselect_banded_picks_bcsr_random_picks_ell():
+    """Block-structured sparsity autoselects the MXU tile layout;
+    scattered fine-grained sparsity stays ELL."""
+    m = 1024
+    half = 16
+    ii = np.repeat(np.arange(m), 2 * half + 1)
+    jj = ii + np.tile(np.arange(-half, half + 1), m)
+    keep = (jj >= 0) & (jj < m)
+    rng = np.random.default_rng(10)
+    vv = rng.standard_normal(int(keep.sum())).astype(np.float32)
+    banded = dr_tpu.sparse_matrix.from_coo((m, m), ii[keep], jj[keep],
+                                           vv)
+    assert banded.format == "bcsr"
+    assert banded.ensure_bcsr()
+
+    k = 4
+    rows = np.repeat(np.arange(m), k)
+    cols = rng.integers(0, m, m * k)
+    vals = rng.standard_normal(m * k).astype(np.float32)
+    rand = dr_tpu.sparse_matrix.from_coo((m, m), rows, cols, vals)
+    assert rand.format == "ell"
+
+
+def test_format_env_override_routes_dispatch(fmt_env):
+    """DR_TPU_SPMV_FORMAT forces the layout at dispatch regardless of
+    the autoselect, and every forced arm matches the oracle."""
+    P = dr_tpu.nprocs()
+    m = 16 * P
+    A, dense = _ring_friendly(m, m, min(4, P), seed=11)
+    b = np.random.default_rng(12).standard_normal(m).astype(np.float32)
+    ref = dense @ b
+    for fmt in ("csr", "ell", "bcsr", "ring"):
+        fmt_env(fmt=fmt)
+        np.testing.assert_allclose(_gemv(A, b, m), ref, rtol=1e-4,
+                                   atol=1e-4, err_msg=fmt)
+    fmt_env()  # cleared: back to the autoselect
+    np.testing.assert_allclose(_gemv(A, b, m), ref, rtol=1e-4,
+                               atol=1e-4)

@@ -249,6 +249,52 @@ def test_nonfusible_sort_flushes_and_warns(monkeypatch):
     assert any("non-fusible" in e["reason"] for e in p.log)
 
 
+def test_gemv_records_opaque_keeps_runs(monkeypatch):
+    """Round 9: gemv inside a region records as an ordered OPAQUE op
+    (like inclusive_scan) — the surrounding fusible runs stay fused, no
+    warn_fallback("plan", ...) cliff, record order preserved, results
+    identical to the eager sequence."""
+    monkeypatch.delenv("DR_TPU_SILENCE_FALLBACKS", raising=False)
+    fallback.reset()
+    P = dr_tpu.nprocs()
+    m = 8 * P
+    rng = np.random.default_rng(11)
+    d = np.where(rng.random((m, m)) < 0.3,
+                 rng.standard_normal((m, m)), 0).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo(
+        (m, m), *np.nonzero(d), d[np.nonzero(d)])
+    bsrc = rng.standard_normal(m).astype(np.float32)
+
+    def chain(c, b):
+        dr_tpu.fill(c, 0.25)
+        dr_tpu.for_each(b, _scale, 2.0)
+        dr_tpu.gemv(c, A, b)
+        dr_tpu.for_each(c, _shift, 1.0)
+        return dr_tpu.reduce(c)
+
+    ec = dr_tpu.distributed_vector(m)
+    eb = dr_tpu.distributed_vector.from_array(bsrc)
+    want = chain(ec, eb)
+
+    dc = dr_tpu.distributed_vector(m)
+    db = dr_tpu.distributed_vector.from_array(bsrc)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with dr_tpu.deferred() as p:
+            got = chain(dc, db)
+    hits = [x for x in w
+            if issubclass(x.category, fallback.MaterializeFallbackWarning)
+            and "dr_tpu.plan" in str(x.message)]
+    assert not hits, [str(x.message) for x in hits]
+    assert float(got) == want
+    np.testing.assert_array_equal(dr_tpu.to_numpy(dc),
+                                  dr_tpu.to_numpy(ec))
+    st = p.stats()
+    assert st["opaque_ops"] == 1, st
+    assert st["fused_runs"] == 2, st  # runs SURVIVE around the gemv
+    assert not any("non-fusible" in e["reason"] for e in p.log)
+
+
 def test_opaque_scan_keeps_order():
     P = dr_tpu.nprocs()
     n = 16 * P

@@ -28,6 +28,13 @@
 # fusible op chains through `dr_tpu.deferred()` (dr_tpu/plan.py) and
 # bit-compares the deferred flush against the eager sequence (filter
 # `plan_chains`).  The chaos sweep covers the plan.flush fault site.
+#
+# SPARSE-FORMAT arm (round 9): test_fuzz_sparse_formats cranks every
+# SpMV layout (csr/ell/bcsr/ring) over random densities, 1-D and 2-D
+# grids, and the adversarial shapes (all-rows-empty, one-dense-row,
+# banded) against a dense float64 oracle, and bit-compares the ring
+# schedule's serial vs pipelined issue orders (filter
+# `sparse_formats`).  The chaos sweep covers collectives.ppermute.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}

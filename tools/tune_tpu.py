@@ -365,6 +365,109 @@ def tune_container(name):
             except Exception as e:
                 print(f"random spmm nv={nv}: FAIL {_errline(e)}",
                       flush=True)
+        tune_spmv_ladder()
+
+
+def tune_spmv_ladder():
+    """Round-9 spmv LADDER: format x density x n sweep through gemv_n
+    (every arm of the dispatch — csr segment-sum, ELL, BCSR, ring) plus
+    the ring-schedule A/B (DR_TPU_RING_SCHEDULE serial vs pipelined)
+    and the ring phase table (gemv_phases_n truncations) at each ring-
+    eligible point — the on-chip datapoints docs/PERF.md round 9 needs
+    before the autoselect thresholds can be called tuned."""
+    import dr_tpu
+    from dr_tpu.algorithms.gemv import (SPMV_PHASES, gemv_n,
+                                        gemv_phases_n, viable_formats)
+    from dr_tpu.utils import profiling
+
+    P = dr_tpu.nprocs()
+    rng = np.random.default_rng(2)
+
+    def _sync(cc):
+        return float(cc._data.addressable_shards[0].data.reshape(-1)[0])
+
+    # restore any operator-pinned values on exit (the sweep forces its
+    # own per-rung settings; a session-level pin must survive it)
+    from dr_tpu.utils.env import env_override
+    with env_override(
+            DR_TPU_SPMV_FORMAT=os.environ.get("DR_TPU_SPMV_FORMAT"),
+            DR_TPU_RING_SCHEDULE=os.environ.get("DR_TPU_RING_SCHEDULE")):
+        for logn in (14, 17):
+            for k in (4, 32):
+                m = 2 ** logn
+                rows = np.repeat(np.arange(m), k)
+                cols = rng.integers(0, m, size=m * k)
+                vals = rng.standard_normal(m * k).astype(np.float32)
+                A = dr_tpu.sparse_matrix.from_coo((m, m), rows, cols,
+                                                  vals)
+                c = dr_tpu.distributed_vector(m, np.float32)
+                bv = dr_tpu.distributed_vector(m, np.float32)
+                dr_tpu.fill(bv, 1.0)
+                dr_tpu.fill(c, 0.0)
+                flops = 2.0 * m * k
+                tag = f"n=2^{logn} k={k} auto={A.format}"
+
+                def run(r):
+                    gemv_n(c, A, bv, r)
+                    _sync(c)
+                # forced-but-ineligible formats fall back down the
+                # dispatch chain (SPEC §12.2): tag those rungs rather
+                # than printing the fallback arm's number under the
+                # forced label.  The ring arm is measured ONLY by the
+                # schedule A/B below — a [ring] rung here would repeat
+                # the [ring/pipelined] measurement verbatim.
+                viable = viable_formats(A)
+                for fmt in ("csr", "ell", "bcsr"):
+                    if not viable[fmt]:
+                        print(f"spmv {tag} [{fmt}]: ineligible "
+                              "(would fall back)", flush=True)
+                        continue
+                    os.environ["DR_TPU_SPMV_FORMAT"] = fmt
+                    try:
+                        dt = _marginal(run, 2, 18)
+                        print(f"spmv {tag} [{fmt}]: "
+                              f"{flops / dt / 1e9:.2f} GFLOP/s",
+                              flush=True)
+                    except Exception as e:
+                        print(f"spmv {tag} [{fmt}]: FAIL {_errline(e)}",
+                              flush=True)
+                os.environ["DR_TPU_SPMV_FORMAT"] = "ring"
+                try:
+                    if P > 1 and viable["ring"]:
+                        for sched in ("serial", "pipelined"):
+                            os.environ["DR_TPU_RING_SCHEDULE"] = sched
+                            try:
+                                dt = _marginal(run, 2, 18)
+                                print(f"spmv {tag} [ring/{sched}]: "
+                                      f"{flops / dt / 1e9:.2f} GFLOP/s",
+                                      flush=True)
+                            except Exception as e:
+                                print(f"spmv {tag} [ring/{sched}]: "
+                                      f"FAIL {_errline(e)}", flush=True)
+                        os.environ.pop("DR_TPU_RING_SCHEDULE", None)
+
+                        def mk(i):
+                            def runp(r):
+                                gemv_phases_n(c, A, bv, SPMV_PHASES[i],
+                                              r)
+                                _sync(c)
+                            return runp
+                        bd = profiling.profile_phases(mk, SPMV_PHASES,
+                                                      r1=2, r2=10)
+                        print(f"spmv {tag} phase ladder:\n"
+                              + bd.table(flops, unit="GFLOP/s"),
+                              flush=True)
+                    else:
+                        print(f"spmv {tag}: ring ineligible (p=1 or "
+                              "bucket-skew gate) — phases collapse",
+                              flush=True)
+                except Exception as e:
+                    print(f"spmv {tag} ring ladder: FAIL {_errline(e)}",
+                          flush=True)
+                finally:
+                    os.environ.pop("DR_TPU_SPMV_FORMAT", None)
+                    os.environ.pop("DR_TPU_RING_SCHEDULE", None)
+                A = c = bv = None
 
 
 def tune_sort():
