@@ -1,0 +1,21 @@
+# Repo-level entry points.  The native library keeps its own Makefile
+# (make -C native test / bridge-test).
+
+.PHONY: lint test sanitize-test native-test
+
+# static invariant gate (docs/SPEC.md §13): exits non-zero on any
+# non-baselined drlint finding
+lint:
+	python tools/drlint.py --check
+
+test:
+	python -m pytest tests/ -x -q
+
+# the tier-1 suite with the runtime sanitizer armed (recompile budget,
+# finite flush sweep, canon-portability of every dispatch key)
+sanitize-test:
+	DR_TPU_SANITIZE=1 python -m pytest tests/ -x -q
+
+native-test:
+	$(MAKE) -C native test
+	$(MAKE) -C native bridge-test

@@ -54,9 +54,12 @@ import time
 
 import numpy as np
 
-
 # the user's apply-implementation override, captured before any
-# fallback step mutates the variable
+# fallback step mutates the variable (None-vs-set matters: an explicit
+# empty value must not read as a pin).  Raw on purpose: the capture
+# must run at the top of module load, before the dr_tpu imports below
+# (and any env_override dance they enable) can touch the variable.
+# drlint: ok[R2] earliest-possible capture, before any package import
 _USER_MM_IMPL = os.environ.get("DR_TPU_MM_IMPL")
 
 # per-chip peak HBM bandwidth, GB/s (public spec sheets)
@@ -90,6 +93,7 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
     """Allocate, warm up, and time one implementation; returns a result
     dict.  Raises on any non-OOM failure (caller decides the fallback)."""
     import dr_tpu
+    from dr_tpu.utils.env import env_raw
     from dr_tpu.algorithms.stencil import (stencil_iterate,
                                            stencil_iterate_blocked,
                                            stencil_iterate_matmul)
@@ -123,7 +127,7 @@ def _measure(impl: str, n: int, steps: int, tblock: int):
         # VPU path: its per-step roll/select cost scales with tblock;
         # 64 was the measured knee — don't inherit the matmul default,
         # but honor an explicit user override
-        if "DR_TPU_BENCH_TBLOCK" not in os.environ:
+        if env_raw("DR_TPU_BENCH_TBLOCK") is None:
             tblock = min(tblock, 64)
         # Mosaic tile alignment: halo is whole (8, 128) f32 tiles
         ra = stencil_pallas.ROW_ALIGN
@@ -848,11 +852,12 @@ def _devices_or_die(timeout_s: float):
     the whole gap to expire before the fresh claim.
     """
     from dr_tpu.parallel.runtime import probe_devices
+    from dr_tpu.utils.env import env_float, env_raw, env_str
     from dr_tpu.utils.resilience import (degradation_story,
                                          route_first_touch)
 
-    retried = bool(os.environ.get("_DR_TPU_BENCH_RETRY"))
-    cpu_child = bool(os.environ.get("_DR_TPU_BENCH_CPU_FALLBACK"))
+    retried = bool(env_raw("_DR_TPU_BENCH_RETRY"))
+    cpu_child = bool(env_raw("_DR_TPU_BENCH_CPU_FALLBACK"))
     if cpu_child:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -862,17 +867,15 @@ def _devices_or_die(timeout_s: float):
         # (possibly mid-claim) client, and the server-side grant needs
         # the gap AFTER that death — sleeping in the parent before the
         # exec would give it zero post-death expiry time.
-        time.sleep(float(os.environ.get("DR_TPU_BENCH_RETRY_COOLDOWN",
-                                        "45")))
+        time.sleep(env_float("DR_TPU_BENCH_RETRY_COOLDOWN", 45.0))
         timeout_s = min(timeout_s,
-                        float(os.environ.get("DR_TPU_BENCH_RETRY_TIMEOUT",
-                                             "240")))
+                        env_float("DR_TPU_BENCH_RETRY_TIMEOUT", 240.0))
     ft = route_first_touch(timeout_s, retried=retried or cpu_child,
                            probe=probe_devices, is_dead=_dead_relay,
                            listening=_relay_listening)
     if ft.decision == "ok":
         return ft.devices
-    prior_s = float(os.environ.get("_DR_TPU_BENCH_PROBE_S", "0") or 0.0)
+    prior_s = env_float("_DR_TPU_BENCH_PROBE_S", 0.0)
     if ft.decision == "retry":
         print(f"device init failed ({ft.err}); retrying once in a "
               "fresh process after a cool-down", file=sys.stderr)
@@ -886,7 +889,7 @@ def _devices_or_die(timeout_s: float):
     if not cpu_child:
         err = ft.err
         if retried:
-            first = os.environ.get("_DR_TPU_BENCH_FIRST_ERR", "")
+            first = env_str("_DR_TPU_BENCH_FIRST_ERR")
             if first and first != err:
                 err = f"{err}; first attempt: {first}"
             err = f"retry failed: {err}"
@@ -910,12 +913,13 @@ def _devices_or_die(timeout_s: float):
 
 
 def main():
-    n = int(os.environ.get("DR_TPU_BENCH_N", str(2 ** 30)))
+    from dr_tpu.utils.env import (env_flag, env_float, env_int, env_raw,
+                                  env_str)
+    n = env_int("DR_TPU_BENCH_N", 2 ** 30)
 
     # healthy claims complete in seconds; a wedged relay otherwise eats
     # the driver's whole bench budget before the CPU fallback can run
-    _devices_or_die(float(os.environ.get("DR_TPU_BENCH_INIT_TIMEOUT",
-                                         "420")))
+    _devices_or_die(env_float("DR_TPU_BENCH_INIT_TIMEOUT", 420.0))
     import jax
     import dr_tpu
     from dr_tpu.ops import stencil_pallas
@@ -926,8 +930,8 @@ def main():
     # default chain on TPU: MXU composed-operator matmul path, then the
     # Pallas VMEM kernel, then plain XLA; elsewhere XLA only (interpret-
     # mode pallas is far too slow for a benchmark)
-    if "DR_TPU_BENCH_IMPL" in os.environ:
-        chain = [os.environ["DR_TPU_BENCH_IMPL"].strip().lower()]
+    if env_raw("DR_TPU_BENCH_IMPL") is not None:
+        chain = [env_str("DR_TPU_BENCH_IMPL").lower()]
     elif on_tpu:
         chain = ["matmul", "matmul_xla"] + \
             (["pallas"] if stencil_pallas.supported() else []) + ["xla"]
@@ -937,16 +941,16 @@ def main():
     # four lane columns each side at radius 2 — the round-3 measured
     # winner, tools/tune_stencil.log); the pallas VPU path clamps per
     # its own budget
-    tblock = int(os.environ.get("DR_TPU_BENCH_TBLOCK", "256"))
-    if on_cpu and "DR_TPU_BENCH_N" not in os.environ:
+    tblock = env_int("DR_TPU_BENCH_TBLOCK", 256)
+    if on_cpu and env_raw("DR_TPU_BENCH_N") is None:
         n = 2 ** 24  # keep CPU smoke runs fast
 
     dr_tpu.init(jax.devices())
     res = None
     for i, impl in enumerate(chain):
         blocked = impl in ("pallas", "matmul", "matmul_xla")
-        steps = int(os.environ.get("DR_TPU_BENCH_STEPS",
-                                   "512" if blocked else "16"))
+        steps = env_int("DR_TPU_BENCH_STEPS", 512 if blocked else 16,
+                        floor=0)
         try:
             res = _measure(impl, n, steps, tblock)
             break
@@ -974,16 +978,15 @@ def main():
     story = degradation_story()
 
     secondary = {}
-    if os.environ.get("DR_TPU_BENCH_SECONDARY", "1") != "0":
+    if env_str("DR_TPU_BENCH_SECONDARY", "1") != "0":
         # --phases (or DR_TPU_BENCH_PHASES=1): add the key-value sort
         # phase ladder on top of the always-on keys-only breakdown
-        phases = ("--phases" in sys.argv[1:]
-                  or os.environ.get("DR_TPU_BENCH_PHASES", "") == "1")
+        phases = "--phases" in sys.argv[1:] or env_flag("DR_TPU_BENCH_PHASES")
         # --spmv (or DR_TPU_BENCH_SPMV=1 — both survive the two
         # CPU-fallback re-execs, like --pipeline): add the spmv format
         # ladder on top of the always-on phase breakdown + format tag
         spmv_ladder = ("--spmv" in sys.argv[1:]
-                       or os.environ.get("DR_TPU_BENCH_SPMV", "") == "1")
+                       or env_flag("DR_TPU_BENCH_SPMV"))
         secondary = _secondary_metrics(on_cpu, on_tpu, phases=phases,
                                        spmv_ladder=spmv_ladder)
         # pipeline config (round 8): eager-vs-deferred 5-op chain.
@@ -991,7 +994,7 @@ def main():
         # survives both CPU-fallback re-execs like --phases) adds the
         # chain-length ladder for the next chip session.
         ladder = ("--pipeline" in sys.argv[1:]
-                  or os.environ.get("DR_TPU_BENCH_PIPELINE", "") == "1")
+                  or env_flag("DR_TPU_BENCH_PIPELINE"))
         secondary.update(_pipeline_metrics(on_cpu, ladder=ladder))
 
     # tap dispatch counts (round 8): the headline timed run's count

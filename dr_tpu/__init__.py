@@ -25,6 +25,8 @@ namespaces through one TPU backend, called ``thp``):
 """
 
 from .utils import jax_compat  # noqa: F401  (jax.shard_map shim, first)
+from .utils import sanitize as _sanitize
+_sanitize.install()  # no-op unless DR_TPU_SANITIZE=1 (docs/SPEC.md §13.4)
 from .parallel.runtime import (init, final, finalize, runtime, nprocs,
                                devices, mesh, barrier, fence,
                                get_duplicated_devices)

@@ -50,6 +50,7 @@ from ..containers.distributed_vector import distributed_vector
 from ..containers.dense_matrix import dense_matrix
 from ..containers.sparse_matrix import sparse_matrix
 from ..parallel import pipeline as _pl
+from ..utils.env import env_str
 
 __all__ = ["gemv", "gemv_n", "gemv_phases_n", "flat_gemv", "gemm",
            "spmm", "SPMV_PHASES"]
@@ -68,8 +69,7 @@ def _pick_format(a) -> str:
     autoselect (``sparse_matrix.format``).  Read per call so in-process
     sweeps work; every program the choice routes to has its own cache
     key, so switching formats never reuses a stale program."""
-    import os
-    env = os.environ.get("DR_TPU_SPMV_FORMAT", "").strip().lower()
+    env = env_str("DR_TPU_SPMV_FORMAT").lower()
     if env in ("csr", "ell", "bcsr", "ring"):
         return env
     return a._format
@@ -120,8 +120,7 @@ def _gather_mode(rt) -> str:
     FLOPs by W.  ``DR_TPU_GATHER_MODE`` in {auto, slice, direct}
     overrides; auto resolves from the runtime's platform.  Keyed into
     every program cache that threads it."""
-    import os
-    m = os.environ.get("DR_TPU_GATHER_MODE", "auto").strip().lower()
+    m = env_str("DR_TPU_GATHER_MODE", "auto").lower()
     if m in ("slice", "direct"):
         return m
     from . import _common
@@ -133,8 +132,7 @@ def _combine_mode() -> str:
     ``psum`` (default — XLA's all-reduce, the measured winner) or
     ``ring`` (pipeline.ring_combine — the rotate-collect arm for the
     DR_TPU_SPMV_COMBINE A/B on chip)."""
-    import os
-    m = os.environ.get("DR_TPU_SPMV_COMBINE", "").strip().lower()
+    m = env_str("DR_TPU_SPMV_COMBINE").lower()
     return m if m in ("psum", "ring") else "psum"
 
 
@@ -715,8 +713,7 @@ def _spmm_w_key():
     override (not env_int, whose floor collapses unset and '1') plus
     the DR_TPU_GATHER_W value the default derives from — in-process W
     sweeps must rebuild, not reuse the first-traced program."""
-    import os
-    return (os.environ.get("DR_TPU_SPMM_W", ""), _gather_w())
+    return (env_str("DR_TPU_SPMM_W"), _gather_w())
 
 
 def _spmm2d_program(rt, grid, th, tw, kdim, bcsr, m, n, nv, mode):

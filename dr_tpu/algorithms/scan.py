@@ -15,7 +15,7 @@ segment totals over the mesh axis, an exclusive fold of preceding totals
 from __future__ import annotations
 
 import operator
-import os
+from ..utils.env import env_str
 from typing import Callable
 
 import jax
@@ -102,7 +102,7 @@ def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
     accumulates in f32, so integer exactness and f64 precision must
     take the XLA path), TPU backend, lane-chunkable segment.
     ``DR_TPU_SCAN_IMPL=xla`` forces the XLA matmul-cumsum."""
-    if os.environ.get("DR_TPU_SCAN_IMPL", "").strip().lower() == "xla":
+    if env_str("DR_TPU_SCAN_IMPL").lower() == "xla":
         return False
     from ..ops import scan_pallas
     from ._common import f32_accumulable, on_tpu
@@ -123,8 +123,8 @@ def _kernel_variant():
     every program cache key so A/B sweeps rebuild instead of reusing
     the other configuration's cached program."""
     from ..ops import scan_pallas
-    return (os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower(),
-            os.environ.get("DR_TPU_SCAN_PIPE", "").strip().lower(),
+    return (env_str("DR_TPU_SCAN_KERNEL").lower(),
+            env_str("DR_TPU_SCAN_PIPE").lower(),
             scan_pallas.chunk_cap(), scan_pallas.scan_passes())
 
 

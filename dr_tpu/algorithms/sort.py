@@ -81,7 +81,7 @@ over one; transform views and other read-only ranges are rejected with
 
 from __future__ import annotations
 
-import os
+from ..utils.env import env_flag
 
 import jax
 import jax.numpy as jnp
@@ -130,7 +130,7 @@ def _stable_override() -> bool:
     """``DR_TPU_SORT_STABLE=1`` forces stable comparators on every
     ``lax.sort`` in the family (A/B knob for ``tune_tpu.py sort``);
     part of every program cache key so in-process sweeps rebuild."""
-    return os.environ.get("DR_TPU_SORT_STABLE", "").strip() == "1"
+    return env_flag("DR_TPU_SORT_STABLE")
 
 
 def _encode(x, distinct_zeros=False):

@@ -31,6 +31,8 @@ from .elementwise import (_op_key, _out_chain, _plan_active, _prog_cache,
                           _resolve)
 from ..core.pinning import pinned_id
 from ..parallel.halo import _ring_perms
+from ..utils import spmd_guard
+from ..utils.env import env_str
 
 __all__ = ["stencil_transform", "stencil_iterate", "build_stencil_step",
            "stencil_iterate_blocked", "stencil_iterate_matmul"]
@@ -258,8 +260,10 @@ def _blocked_drive(cont, key, steps, block, make_prog):
     nfull, rest = divmod(steps, block)
     if nfull and block not in progs:
         progs[block] = make_prog(block)
+        spmd_guard.note_compile(key + (block,))
     if rest and rest not in progs:
         progs[rest] = make_prog(rest)
+        spmd_guard.note_compile(key + (rest,))
     data = cont._data
     for _ in range(nfull):
         data = progs[block](data)
@@ -339,9 +343,8 @@ def _matmul_impl(cont) -> str:
     apply on TPU (one HBM read + write per composed block instead of
     the P-form's ~4x), the XLA P-form elsewhere or on request
     (DR_TPU_MM_IMPL=pallas|xla)."""
-    import os
     from ..ops import stencil_pallas
-    impl = os.environ.get("DR_TPU_MM_IMPL", "").strip().lower()
+    impl = env_str("DR_TPU_MM_IMPL").lower()
     if impl in ("pallas", "xla"):
         return impl
     return "pallas" if (

@@ -24,6 +24,7 @@ from jax import lax
 from ._common import double_buffered_loop
 from .elementwise import _prog_cache
 from ..core.pinning import pinned_id
+from ..utils import spmd_guard
 from ..containers.dense_matrix import dense_matrix
 
 __all__ = ["stencil2d_transform", "stencil2d_iterate",
@@ -134,11 +135,15 @@ def stencil2d_iterate_blocked(a: dense_matrix, weights, steps: int, *,
         progs["pad"] = jax.jit(
             lambda x: jnp.pad(x, ((pad, pad), (0, 0))))
         progs["unpad"] = jax.jit(lambda xp: xp[pad:pad + m, :])
+        spmd_guard.note_compile(key + ("pad",))
+        spmd_guard.note_compile(key + ("unpad",))
     nfull, rest = divmod(steps, time_block)
     if nfull and time_block not in progs:
         progs[time_block] = make(time_block)
+        spmd_guard.note_compile(key + (time_block,))
     if rest and rest not in progs:
         progs[rest] = make(rest)
+        spmd_guard.note_compile(key + (rest,))
     # pad ONCE and keep the padded layout across blocks: pad-row contents
     # are irrelevant (frozen edges stop the dependency cone), so chained
     # passes pay no re-pad traffic

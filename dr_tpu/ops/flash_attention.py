@@ -27,7 +27,7 @@ online softmax follows the flash/ring-attention literature (PAPERS.md).
 from __future__ import annotations
 
 import functools
-import os
+from ..utils.env import env_str
 
 import jax
 import jax.numpy as jnp
@@ -138,7 +138,7 @@ def use_streaming(skv: int, d: int) -> bool:
     """Kernel-variant selector (trace-time): streaming beyond the
     resident VMEM budget; DR_TPU_FLASH_STREAM=1/0 forces/forbids.
     Callers caching programs must key on this."""
-    env = os.environ.get("DR_TPU_FLASH_STREAM", "").strip()
+    env = env_str("DR_TPU_FLASH_STREAM")
     if env == "1":
         return True
     if env == "0":

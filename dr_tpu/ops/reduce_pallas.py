@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 
 from .scan_pallas import LANES, pick_chunk
 from .stencil_pallas import _HAS_PLTPU, pltpu
+from ..utils.env import env_str
 
 __all__ = ["chunked_dot", "supported", "use_dot_kernel"]
 
@@ -46,17 +47,16 @@ def use_dot_kernel() -> bool:
     shape — ~93% of the chip's 819 GB/s read bandwidth).
     ``DR_TPU_DOT_IMPL=xla`` opts out; read per call so tuning sweeps
     work in-process (callers key their program caches on it)."""
-    import os
-    val = os.environ.get("DR_TPU_DOT_IMPL", "").strip().lower()
+    val = env_str("DR_TPU_DOT_IMPL").lower()
     if val in ("", "pallas"):
         return True
     if val in ("xla", "off", "0", "none", "false"):
         return False
-    import warnings
-    warnings.warn(f"DR_TPU_DOT_IMPL={val!r} not recognized "
+    from ..utils.fallback import warn_fallback
+    warn_fallback("dot", f"DR_TPU_DOT_IMPL={val!r} not recognized "
                   "(expected 'pallas' or 'xla'); failing CLOSED to the "
                   "XLA path — anyone setting the variable is most "
-                  "likely opting out of the kernel", stacklevel=2)
+                  "likely opting out of the kernel")
     return False
 
 

@@ -30,7 +30,7 @@ fault site.
 from __future__ import annotations
 
 import math
-import os
+from ..utils.env import env_str
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +56,7 @@ def _flash_viable(shape, dtype, rt) -> bool:
     trading the input precision away would break the module's
     exact-match contract.  ``DR_TPU_RING_IMPL=flash`` opts f32 inputs
     into the kernel; ``DR_TPU_RING_IMPL=xla`` forces the XLA path."""
-    impl = os.environ.get("DR_TPU_RING_IMPL", "").strip().lower()
+    impl = env_str("DR_TPU_RING_IMPL").lower()
     if impl == "xla":
         return False
     if not _fa.supported():

@@ -46,6 +46,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 from .stencil_pallas import _HAS_PLTPU, pltpu
+from ..utils.env import env_str
 
 __all__ = ["chunked_cumsum", "pick_chunk", "prefix_matrix",
            "supported"]
@@ -325,18 +326,17 @@ def chunked_cumsum(x, *, carry=None, interpret: bool = False):
     callers fall back to the XLA matmul-cumsum otherwise.
     ``DR_TPU_SCAN_KERNEL=vpu`` selects the Hillis-Steele (vector-unit)
     variant of the in-chunk prefix; default is the MXU matmul form."""
-    import os
     n = x.shape[0]
     R = pick_chunk(n)
     assert R is not None, "no lane-aligned chunking for this length"
     rows = n // LANES
     G = R // LANES
-    vpu = os.environ.get("DR_TPU_SCAN_KERNEL", "").strip().lower() == "vpu"
+    vpu = env_str("DR_TPU_SCAN_KERNEL").lower() == "vpu"
     passes = scan_passes()
     # default is the manual double-buffered pipeline: it has compiled
     # and run on hardware; the auto-grid form is opt-in
     # (DR_TPU_SCAN_PIPE=grid) until a chip compile proves it out
-    grid = (os.environ.get("DR_TPU_SCAN_PIPE", "").strip().lower()
+    grid = (env_str("DR_TPU_SCAN_PIPE").lower()
             == "grid")
     build = _build_grid if grid else _build
     fn = build(rows, R, str(x.dtype), interpret, vpu, passes)

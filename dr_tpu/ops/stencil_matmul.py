@@ -33,14 +33,13 @@ block).  Reference workload: ``examples/mhp/stencil-1d.cpp:47-66``.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.env import env_int
+from ..utils.env import env_int, env_str
 
 __all__ = ["composed_taps", "matmul_stencil_row", "max_ksteps"]
 
@@ -111,7 +110,7 @@ _PRECISION = {
     "default": jax.lax.Precision.DEFAULT,
     "high": jax.lax.Precision.HIGH,
     "highest": jax.lax.Precision.HIGHEST,
-}[os.environ.get("DR_TPU_MM_PRECISION", "high").strip().lower()]
+}[env_str("DR_TPU_MM_PRECISION", "high").lower()]
 
 # Mosaic (the Pallas TPU compiler) accepts only DEFAULT and HIGHEST dot
 # precisions; HIGH exists only at the XLA level.  For f32 the kernel

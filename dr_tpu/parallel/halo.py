@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.pinning import pinned_id
 from ..utils import faults as _faults
 from ..utils.spmd_guard import TappedCache
+from ..utils.env import env_flag, env_str
 
 __all__ = ["halo_bounds", "span_halo", "halo_ops"]
 
@@ -101,8 +102,7 @@ def _uniform_valid(nshards, seg, n) -> bool:
     only a ragged tail pays per-shard dynamic offsets.
     ``DR_TPU_HALO_DYNAMIC=1`` forces the dynamic-offset path for A/B
     measurement (tools/tune_tpu.py halo)."""
-    import os
-    if os.environ.get("DR_TPU_HALO_DYNAMIC", "") == "1":
+    if env_flag("DR_TPU_HALO_DYNAMIC"):
         return False
     return n - (nshards - 1) * seg == seg
 
@@ -214,8 +214,7 @@ def _exchange_n_body(axis, nshards, seg, prev, nxt, periodic, n, iters):
     ghost traffic).  Ghost-carry matches the reference engine's cost
     model: it ships edge buffers, never the local array (halo.hpp:55-90).
     """
-    import os
-    if os.environ.get("DR_TPU_HALO_NCARRY", "ghost") == "row":
+    if env_str("DR_TPU_HALO_NCARRY", "ghost") == "row":
         body = _exchange_body(axis, nshards, seg, prev, nxt, periodic, n)
 
         def loop(blk):
@@ -312,12 +311,11 @@ _program_cache: dict = TappedCache()
 
 def _cached(kind, mesh, axis, nshards, seg, prev, nxt, periodic, n, op=None,
             iters=1):
-    import os
     # the tuning knobs select a different program body: key them so
     # in-process sweeps (tools/tune_tpu.py halo) don't reuse the other
     # arm's cached program
-    knobs = (os.environ.get("DR_TPU_HALO_NCARRY", "ghost"),
-             os.environ.get("DR_TPU_HALO_DYNAMIC", ""))
+    knobs = (env_str("DR_TPU_HALO_NCARRY", "ghost"),
+             env_str("DR_TPU_HALO_DYNAMIC"))
     key = (kind, pinned_id(mesh), axis, nshards, seg, prev, nxt, periodic, n, op,
            iters, knobs)
     prog = _program_cache.get(key)

@@ -39,7 +39,7 @@ drops the dispatch with containers untouched, exactly like the
 
 from __future__ import annotations
 
-import os
+from ..utils.env import env_str
 from typing import Any, Callable, List, Optional, Tuple
 
 import jax
@@ -61,7 +61,7 @@ def schedule_mode() -> str:
     {``pipelined``, ``serial``}; malformed values fall back to the
     pipelined default (a typo in a tuning sweep must not brick every
     ring program at trace time)."""
-    mode = os.environ.get("DR_TPU_RING_SCHEDULE", "").strip().lower()
+    mode = env_str("DR_TPU_RING_SCHEDULE").lower()
     return mode if mode in ("pipelined", "serial") else "pipelined"
 
 

@@ -19,6 +19,7 @@ tracks per-container MPI RMA windows and fences them globally
 from __future__ import annotations
 
 import os
+from ..utils.env import env_str
 import weakref
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -150,7 +151,7 @@ def setup_compile_cache() -> Optional[str]:
     the variable is unset or wiring failed (wiring failure warns and
     degrades to the in-memory default — never blocks init)."""
     global _compile_cache_wired
-    path = os.environ.get("DR_TPU_COMPILE_CACHE_DIR", "").strip()
+    path = env_str("DR_TPU_COMPILE_CACHE_DIR")
     if not path or _compile_cache_wired:
         return path or None
     try:
@@ -161,16 +162,18 @@ def setup_compile_cache() -> Optional[str]:
                 ("jax_persistent_cache_min_entry_size_bytes", -1)):
             try:
                 jax.config.update(opt, val)
+            # drlint: ok[R5] capability probe: older jax lacks the knob and the cache still works without it
             except Exception:  # pragma: no cover - older jax knob set
                 pass
         _compile_cache_wired = True
         return path
     except Exception as e:  # pragma: no cover - defensive
-        import warnings
-        warnings.warn(
+        from ..utils.fallback import warn_fallback
+        warn_fallback(
+            "runtime",
             f"DR_TPU_COMPILE_CACHE_DIR={path!r}: persistent compile "
             f"cache not wired ({e!r}); continuing with the in-memory "
-            "cache", stacklevel=2)
+            "cache")
         return None
 
 

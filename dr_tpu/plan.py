@@ -77,7 +77,8 @@ from the spmd_guard tap (``utils.spmd_guard.dispatch_count``).
 
 from __future__ import annotations
 
-import os
+from .utils.env import env_str
+from .utils import sanitize as _sanitize
 from contextlib import contextmanager
 from typing import List, Optional
 
@@ -517,8 +518,8 @@ class Plan:
         run = self._fusible_run(dv)
         slot = run.slot(dv)
         hb = dv.halo_bounds
-        knobs = (os.environ.get("DR_TPU_HALO_NCARRY", "ghost"),
-                 os.environ.get("DR_TPU_HALO_DYNAMIC", ""))
+        knobs = (env_str("DR_TPU_HALO_NCARRY", "ghost"),
+                 env_str("DR_TPU_HALO_DYNAMIC"))
         key = ("halo", kind, slot, dv.layout, hb.periodic, op, iters,
                knobs)
         nshards, seg = dv.nshards, dv.segment_size
@@ -607,6 +608,14 @@ class Plan:
                         {"kind": "opaque", "name": item.name,
                          "dispatches": _guard.dispatch_count() - di})
                 else:
+                    pre_ok = True
+                    if _sanitize.installed():
+                        # snapshot IMMEDIATELY before the run executes:
+                        # a NaN that pre-dates the run (input data, or
+                        # written by an earlier opaque op in this same
+                        # queue) must not be blamed on its program
+                        pre_ok = all(_sanitize.is_finite(c._data)
+                                     for c in item.conts)
                     hit = self._exec_run(item)
                     entry["items"].append(
                         {"kind": "fused",
@@ -614,6 +623,25 @@ class Plan:
                          "containers": len(item.conts),
                          "cache_hit": hit,
                          "dispatches": _guard.dispatch_count() - di})
+                    if _sanitize.installed() and pre_ok:
+                        # sanitizer finite sweep (SPEC §13.4) right
+                        # after THIS run, against ITS output state —
+                        # a later run overwriting the container must
+                        # neither hide this run's NaN nor be blamed
+                        # for its own on this run's ops.  A fused
+                        # chain has no NaN-sentinel semantics; a run
+                        # whose inputs were already non-finite is
+                        # exempt (nothing to attribute).
+                        ops = "+".join(o.name for o in item.ops)
+                        for c in item.conts:
+                            _sanitize.check_finite(
+                                c._data,
+                                f"container state (fused run {ops})")
+                        for h in item.handles:
+                            if h._val is not None:
+                                _sanitize.check_finite(
+                                    h._val,
+                                    f"posted scalar (fused run {ops})")
         except BaseException:
             for item in queue:
                 if isinstance(item, _Run):
@@ -626,7 +654,6 @@ class Plan:
         finally:
             entry["dispatches"] = _guard.dispatch_count() - d0
             self._flushing = False
-
     def _exec_run(self, run: _Run) -> bool:
         key = ("plan", pinned_id(run.mesh), run.axis,
                tuple((c.layout, str(c.dtype)) for c in run.conts),

@@ -32,6 +32,7 @@ def range_details(r, name: str = "range", file=None) -> str:
         if callable(devs):
             try:
                 dev = f" device={list(devs())[0]}"
+            # drlint: ok[R5] best-effort device tag in a debug printout — absence degrades nothing
             except Exception:
                 pass
         out.append(f"  segment {i}: rank={rank(s)} size={len(s)}"

@@ -1,4 +1,4 @@
-"""Tuning-knob env parsing shared by the kernels.
+"""Env-var registry: the ONE place dr_tpu code reads the environment.
 
 Every on-device tuning variable (``DR_TPU_MM_CHUNK_CAP``,
 ``DR_TPU_SCAN_CHUNK``, ``DR_TPU_FLASH_BQ/BK``) is a power-of-two cap
@@ -6,6 +6,12 @@ read per call (so sweeps work in-process) and keyed into the relevant
 program caches.  Parsing is TOLERANT: a malformed value falls back to
 the default instead of taking down every caller at trace time — a typo
 in a tuning sweep must not brick unrelated programs.
+
+Raw ``os.environ`` reads of ``DR_TPU_*`` vars anywhere else in the
+package are a lint error (tools/drlint.py rule R2): routing every read
+through these helpers keeps parsing tolerant everywhere and gives the
+SPEC.md env table one mechanical source of truth to drift-check
+against (docs/SPEC.md §13).
 """
 
 from __future__ import annotations
@@ -13,7 +19,8 @@ from __future__ import annotations
 import contextlib
 import os
 
-__all__ = ["env_int", "env_pow2", "env_override"]
+__all__ = ["env_int", "env_pow2", "env_float", "env_str", "env_flag",
+           "env_raw", "env_override"]
 
 
 @contextlib.contextmanager
@@ -50,6 +57,38 @@ def env_int(name: str, default: int, floor: int = 1) -> int:
     except ValueError:
         v = default
     return max(floor, v)
+
+
+def env_float(name: str, default: float) -> float:
+    """``float($name)``; ``default`` on a missing or malformed value."""
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def env_str(name: str, default: str = "") -> str:
+    """``$name`` stripped of surrounding whitespace; ``default`` when
+    unset.  Mode/choice knobs (``DR_TPU_SPMV_FORMAT`` etc.) lowercase
+    the result at the call site — the raw case is preserved here for
+    path-valued vars (``DR_TPU_COMPILE_CACHE_DIR``)."""
+    raw = os.environ.get(name)
+    return default if raw is None else raw.strip()
+
+
+def env_raw(name: str):
+    """``os.environ.get($name)`` — None when unset.  For the few call
+    sites where None-vs-set matters (save/restore of an operator pin,
+    re-exec relay markers); everything with a usable default belongs on
+    the typed helpers above."""
+    return os.environ.get(name)
+
+
+def env_flag(name: str) -> bool:
+    """True iff ``$name`` is set to ``1`` (whitespace-tolerant) — the
+    package-wide convention for boolean switches."""
+    return env_str(name) == "1"
 
 
 def env_pow2(name: str, default: int, floor: int = 1) -> int:

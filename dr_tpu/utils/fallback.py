@@ -19,7 +19,7 @@ disables the warnings (for tests and users who accepted the cost).
 
 from __future__ import annotations
 
-import os
+from .env import env_flag
 import warnings
 
 from . import faults as _faults
@@ -49,7 +49,7 @@ def warn_fallback(op: str, reason: str) -> None:
     key = (op, reason)
     if key in _seen:
         return
-    if os.environ.get("DR_TPU_SILENCE_FALLBACKS", "") == "1":
+    if env_flag("DR_TPU_SILENCE_FALLBACKS"):
         return  # silenced calls don't consume the once-per-site budget
     _seen.add(key)
     warnings.warn(

@@ -68,11 +68,12 @@ to be counted.  See docs/SPEC.md "Failure model & recovery".
 
 from __future__ import annotations
 
-import os
 import warnings
 from contextlib import contextmanager
 from fnmatch import fnmatchcase
 from typing import Dict, List, Optional, Tuple
+
+from .env import env_flag, env_str
 
 __all__ = ["fire", "inject", "injected", "clear", "sites", "stats",
            "parse_spec", "reload_env", "arm_counting", "pending",
@@ -283,9 +284,9 @@ def reload_env() -> int:
     NOTHING despite being nonempty also warns, so a typo'd chaos run
     cannot read as a clean sweep."""
     clear()
-    if os.environ.get("DR_TPU_FAULT_COUNT", "") == "1":
+    if env_flag("DR_TPU_FAULT_COUNT"):
         arm_counting()
-    text = os.environ.get("DR_TPU_FAULT_SPEC", "")
+    text = env_str("DR_TPU_FAULT_SPEC")
     if not text.strip():
         return 0
     installed = 0

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import inspect
 import os
+from .env import env_str
 from typing import Optional, TextIO
 
 __all__ = ["drlog", "Logger"]
@@ -21,7 +22,7 @@ __all__ = ["drlog", "Logger"]
 class Logger:
     def __init__(self):
         self._sink: Optional[TextIO] = None
-        self._enabled = bool(os.environ.get("DR_TPU_LOG"))
+        self._enabled = bool(env_str("DR_TPU_LOG"))
 
     def set_file(self, path: str) -> None:
         """Open the per-process sink (README.rst:101-107 usage shape);

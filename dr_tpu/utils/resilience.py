@@ -43,6 +43,7 @@ See docs/SPEC.md "Failure model & recovery".
 from __future__ import annotations
 
 import os
+from .env import env_int, env_str
 import random
 import sys
 import threading
@@ -276,7 +277,7 @@ def relay_listening() -> bool:
     listening so an unusual relay config never disables the retry.
     ``DR_TPU_RELAY_UNKNOWN=down`` flips that last policy for ops use."""
     import socket
-    port = int(os.environ.get("DR_TPU_RELAY_PROBE_PORT", "8082"))
+    port = env_int("DR_TPU_RELAY_PROBE_PORT", 8082)
     s = socket.socket()
     s.settimeout(3)
     try:
@@ -285,7 +286,7 @@ def relay_listening() -> bool:
     except (ConnectionRefusedError, socket.timeout, TimeoutError):
         return False
     except Exception:
-        return os.environ.get("DR_TPU_RELAY_UNKNOWN", "up") != "down"
+        return env_str("DR_TPU_RELAY_UNKNOWN", "up") != "down"
     finally:
         s.close()
 

@@ -1,0 +1,175 @@
+"""``DR_TPU_SANITIZE=1`` — the runtime half of drlint (SPEC.md §13.4).
+
+What ``tools/drlint.py`` proves statically, this module asserts
+dynamically while real programs run:
+
+* **Recompile detection** (rule R1's runtime complement).  Every
+  TappedCache insert is a compile; ``spmd_guard.compile_count()``
+  counts them unconditionally (one int add).  Armed, each inserted key
+  is canonicalized (``spmd_guard._canon`` — pin identities neutralized,
+  so two meshes with the same geometry collide, exactly like the
+  cross-rank digest) and a test epoch in which the SAME canonical
+  program compiles more than ``DR_TPU_SANITIZE_RECOMPILE_LIMIT``
+  (default 2) times fails: that is the value-keyed recompile storm.
+  :func:`zero_recompile` is the strict region form — no cache insert at
+  all may occur inside (the test_plan/test_pipeline pins ride it).
+
+* **Finite flush** (``check_finite``): immediately after each fused
+  run of a deferred-plan flush executes, every inexact container it
+  touched must be NaN/Inf-free — per run, not per flush, so a later
+  run overwriting a container can neither hide an earlier run's NaN
+  nor be blamed for its own on the earlier run's ops.  Plan path
+  ONLY: sort/attention tests legitimately push NaN sentinels through
+  eager ops, but a fused elementwise chain has no sentinel semantics
+  — a non-finite state there is an emitted-program bug (or a
+  deliberate overflow, which belongs on the eager path).  A run any
+  of whose containers was ALREADY non-finite immediately before it
+  executed is exempt: the eager chain would propagate the same NaN,
+  so there is nothing to attribute to the emitted program.
+
+* **Canon portability** (strict ``spmd_guard`` digest verification):
+  every dispatch key recorded under an active guard must canonicalize
+  WITHOUT a process-local ``0x…`` address — an address in the canon
+  means ``verify()`` would false-positive across ranks (the exact
+  canonicalization-bug class its phase-2 error message punts on).
+  Checked at every cache INSERT (each distinct key passes there first)
+  and again at record time under any active guard, so the sanitized
+  tier-1 suite sweeps every dispatch key it makes.
+
+Arming: :func:`install` is called at ``import dr_tpu`` (cheap env
+check, no-op unless ``DR_TPU_SANITIZE=1``); the conftest fixture then
+gives every test its own epoch (``reset_epoch`` / ``check_recompiles``).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from .env import env_flag, env_int
+
+__all__ = ["SanitizeError", "enabled", "install", "installed",
+           "reset_epoch", "check_recompiles", "zero_recompile",
+           "check_finite", "is_finite", "recompile_counts"]
+
+
+class SanitizeError(AssertionError):
+    """A runtime invariant the static rules mirror was violated."""
+
+
+def enabled() -> bool:
+    return env_flag("DR_TPU_SANITIZE")
+
+
+_installed = False
+_epoch: Counter = Counter()          # canonical key -> compiles this epoch
+
+#: canon strings are process-portable by construction; a hex address
+#: can only leak in through repr() of an unpinned rich object in a
+#: cache key — the divergence-false-positive class this check names.
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]{6,}")
+
+
+def _canon(key) -> str:
+    from . import spmd_guard
+    return spmd_guard._canon(key)
+
+
+def _on_compile(key) -> None:
+    canon = _canon(key)
+    _on_record(key, canon)   # every key is canonicalized here anyway
+    _epoch[canon] += 1
+
+
+def _on_record(key, canon: str) -> None:
+    m = _ADDR_RE.search(canon)
+    if m:
+        raise SanitizeError(
+            "dispatch key canonicalizes with a process-local address "
+            f"({m.group(0)}): {canon[:200]!r} — spmd_guard.verify() "
+            "would report a false divergence across ranks; pin the "
+            "object (core.pinning) or key on portable structure")
+
+
+def install() -> bool:
+    """Arm the hooks when ``DR_TPU_SANITIZE=1``; idempotent, returns
+    whether the sanitizer is armed."""
+    global _installed
+    if _installed or not enabled():
+        return _installed
+    from . import spmd_guard
+    spmd_guard._compile_hook = _on_compile
+    spmd_guard._canon_check_hook = _on_record
+    _installed = True
+    return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset_epoch() -> None:
+    """Start a fresh recompile-counting epoch (one per test)."""
+    _epoch.clear()
+
+
+def recompile_counts() -> Dict[str, int]:
+    """Canonical-key compile counts for the current epoch."""
+    return dict(_epoch)
+
+
+def check_recompiles(limit: Optional[int] = None) -> None:
+    """Fail the epoch if any canonical program compiled more than
+    ``limit`` times (default ``DR_TPU_SANITIZE_RECOMPILE_LIMIT``, 2 —
+    one benign duplicate allowed for two-mesh tests; a storm is
+    many)."""
+    if limit is None:
+        limit = env_int("DR_TPU_SANITIZE_RECOMPILE_LIMIT", 2)
+    bad = {k: c for k, c in _epoch.items() if c > limit}
+    if bad:
+        worst = sorted(bad.items(), key=lambda kv: -kv[1])[:3]
+        lines = "; ".join(f"{c}x {k[:160]}" for k, c in worst)
+        raise SanitizeError(
+            f"recompile storm: {len(bad)} canonical program(s) "
+            f"compiled more than {limit}x in one epoch — value-keyed "
+            "cache keys (rule R1); ride a traced operand instead.  "
+            f"Worst: {lines}")
+
+
+@contextmanager
+def zero_recompile(what: str = "region"):
+    """Assert that NO program-cache insert happens inside the region —
+    the strict re-record contract: a second pass over already-compiled
+    work must hit every cache.  Works unarmed too (the raw counter is
+    always on)."""
+    from . import spmd_guard
+    c0 = spmd_guard.compile_count()
+    yield
+    grew = spmd_guard.compile_count() - c0
+    if grew:
+        raise SanitizeError(
+            f"{what}: {grew} program compile(s) inside a "
+            "zero-recompile region — the re-record path misses its "
+            "cache (value-keyed key or drifting key structure)")
+
+
+def is_finite(arr) -> bool:
+    """True when ``arr`` has no NaN/Inf (non-inexact dtypes vacuously).
+    Forces a device sync — callers gate on :func:`installed`."""
+    import jax.numpy as jnp
+    if not jnp.issubdtype(jnp.result_type(arr), jnp.inexact):
+        return True
+    return bool(jnp.isfinite(arr).all())
+
+
+def check_finite(arr, what: str) -> None:
+    """Raise unless every element of ``arr`` is finite.  Callers gate
+    on :func:`installed` — this forces a device sync."""
+    if not is_finite(arr):
+        raise SanitizeError(
+            f"non-finite values in {what} after a plan-flush run — an "
+            "emitted-program bug, or an overflow/NaN the chain mints "
+            "from finite inputs; the deferred plan path has no "
+            "NaN-sentinel semantics (run such chains eagerly)")

@@ -40,7 +40,7 @@ from ..core.pinning import PinnedId, _pins
 from . import faults
 
 __all__ = ["guard", "active", "DivergenceError", "TappedCache",
-           "first_divergence", "dispatch_count"]
+           "first_divergence", "dispatch_count", "compile_count"]
 
 
 class DivergenceError(RuntimeError):
@@ -149,12 +149,22 @@ class TappedCache(OrderedDict):
     def setdefault(self, key, default=None):
         faults.fire("dispatch.cache")
         record(key)
-        val = super().setdefault(key, default)
+        # inline rather than super().setdefault(): OrderedDict routes
+        # that through the overridden __setitem__, double-counting the
+        # insert on the sanitizer's compile counter
+        if key in self:
+            val = super().__getitem__(key)
+        else:
+            _note_insert(key)
+            super().__setitem__(key, default)
+            val = default
         self.move_to_end(key)
         self._evict()
         return val
 
     def __setitem__(self, key, value):
+        if key not in self:
+            _note_insert(key)
         super().__setitem__(key, value)
         self.move_to_end(key)
         self._evict()
@@ -186,7 +196,10 @@ class SpmdGuard:
         self.trace: List[str] = []
 
     def record(self, key) -> None:
-        self.trace.append(_canon(key))
+        c = _canon(key)
+        if _canon_check_hook is not None:
+            _canon_check_hook(key, c)
+        self.trace.append(c)
 
     def digest(self) -> str:
         h = hashlib.sha1()
@@ -260,6 +273,43 @@ _dispatches: int = 0
 def dispatch_count() -> int:
     """Monotonic count of tapped dispatches in this process."""
     return _dispatches
+
+
+#: process-lifetime count of tapped-cache INSERTS.  A cache insert is
+#: the compile moment (every module stores its freshly-jitted program
+#: into its TappedCache), so this counter is the recompile detector's
+#: raw signal: ``utils.sanitize.zero_recompile`` diffs it, and the
+#: armed sanitizer's hook canonicalizes each inserted key to catch
+#: value-keyed recompile storms (docs/SPEC.md §13.4).
+_compiles: int = 0
+
+#: set by utils.sanitize.install() when DR_TPU_SANITIZE=1 — receives
+#: every inserted key.  None keeps the insert path one int add.
+_compile_hook = None
+
+#: set by utils.sanitize.install() — receives (key, canon) for every
+#: dispatch recorded under an active guard (canon-portability check).
+_canon_check_hook = None
+
+
+def compile_count() -> int:
+    """Monotonic count of tapped-cache inserts (= program compiles)."""
+    return _compiles
+
+
+def _note_insert(key) -> None:
+    global _compiles
+    _compiles += 1
+    if _compile_hook is not None:
+        _compile_hook(key)
+
+
+def note_compile(key) -> None:
+    """Report a compile the insert tap cannot see: a two-level cache
+    (stencil's per-step-count inner dicts) stores jitted programs in a
+    PLAIN inner dict under one tapped outer key — call this at each
+    inner store so the sanitizer's recompile budget covers them too."""
+    _note_insert(key)
 
 
 def active() -> Optional[SpmdGuard]:

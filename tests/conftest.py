@@ -44,6 +44,23 @@ def _fresh_runtime():
 
 
 @pytest.fixture(autouse=True)
+def _sanitize_epoch():
+    """DR_TPU_SANITIZE=1 (docs/SPEC.md §13.4): every test is its own
+    recompile-counting epoch — a canonical program compiling more than
+    the per-epoch budget inside one test is the value-keyed recompile
+    storm drlint's R1 flags statically.  Canon-portability of every
+    dispatch key is checked by the armed insert hook as the test runs;
+    unarmed, this fixture is a no-op."""
+    from dr_tpu.utils import sanitize
+    if not sanitize.installed():
+        yield
+        return
+    sanitize.reset_epoch()
+    yield
+    sanitize.check_recompiles()
+
+
+@pytest.fixture(autouse=True)
 def _disarm_faults():
     """A leaked fault injection (utils/faults) must not outlive its
     test: the next test's dr_tpu.init() would trip it.  reload_env()

@@ -1,0 +1,18 @@
+"""R1 bad twin: a runtime scalar value-keyed into a program cache and
+closed over by the jitted body — the recompile-storm shape."""
+import jax
+
+_prog_cache = {}
+
+
+def build(x):
+    v = x[0]
+    scale = v.item()            # runtime scalar pulled to host
+    key = ("prog", scale)       # value-keyed: every new value recompiles
+    prog = _prog_cache.get(key)
+    if prog is None:
+        def body(a):
+            return a * scale    # and baked into the compiled body
+        prog = jax.jit(body)
+        _prog_cache[key] = prog
+    return prog

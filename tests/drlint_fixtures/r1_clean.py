@@ -1,0 +1,17 @@
+"""R1 clean twin: the scalar rides as a traced operand — one program,
+any value."""
+import jax
+
+_prog_cache = {}
+
+
+def build(x):
+    v = x[0]
+    key = ("prog", "scaled")    # structural key only
+    prog = _prog_cache.get(key)
+    if prog is None:
+        def body(a, s):
+            return a * s        # scalar is a parameter, not a constant
+        prog = jax.jit(body)
+        _prog_cache[key] = prog
+    return prog, v

@@ -1,0 +1,11 @@
+"""R2 bad twin: raw environment read of an undocumented DR_TPU var,
+plus the raw membership-test shape (a read too)."""
+import os
+
+
+def knob():
+    return os.environ.get("DR_TPU_FIXTURE_ONLY_KNOB", "1")
+
+
+def pinned():
+    return "DR_TPU_SANITIZE" in os.environ

@@ -1,0 +1,11 @@
+"""R2 clean twin: a documented var through the registry helpers,
+None-vs-set through env_raw."""
+from dr_tpu.utils.env import env_raw, env_str
+
+
+def knob():
+    return env_str("DR_TPU_LOG")
+
+
+def pinned():
+    return env_raw("DR_TPU_SANITIZE") is not None

@@ -1,0 +1,7 @@
+"""R3 bad twin: a fault site name outside faults.SITES — a chaos sweep
+can never reach it."""
+from dr_tpu.utils import faults
+
+
+def risky():
+    faults.fire("fixture.unregistered.site")

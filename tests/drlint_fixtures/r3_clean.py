@@ -1,0 +1,6 @@
+"""R3 clean twin: a registered site."""
+from dr_tpu.utils import faults
+
+
+def risky():
+    faults.fire("halo.exchange")

@@ -1,0 +1,10 @@
+"""R4 bad twin: a collective under a data-dependent branch — ranks can
+diverge in dispatch order (the class spmd_guard only names at runtime,
+after the hang)."""
+from jax import lax
+
+
+def exchange(x, blk):
+    if x.sum() > 0:                              # reads runtime DATA
+        blk = lax.ppermute(blk, "i", [(0, 1)])   # divergent dispatch
+    return blk

@@ -1,0 +1,14 @@
+"""R5 bad twin: a silent degradation — bare warnings.warn plus a broad
+swallowed except."""
+# drlint: scope=package — R5 only applies inside dr_tpu/; judge this
+# fixture as package code under a direct CLI scan too
+import warnings
+
+
+def degrade(run):
+    try:
+        return run()
+    except Exception:
+        pass
+    warnings.warn("falling back to the slow path")
+    return None

@@ -1,0 +1,13 @@
+"""R5 clean twin: the degradation announces through the chaos-countable
+registry."""
+# drlint: scope=package — same scope as the bad twin, so cleanliness
+# is proven under the package-scoped rules
+from dr_tpu.utils.fallback import warn_fallback
+
+
+def degrade(run):
+    try:
+        return run()
+    except ValueError as e:
+        warn_fallback("fixture", f"slow path: {e}")
+    return None

@@ -1,0 +1,14 @@
+"""R6 bad twin (scanned with a faked dr_tpu/ relpath): a plain-dict
+program cache plus an immediately-invoked jit — compiles off the
+spmd_guard tap, one of them per call."""
+import jax
+
+_prog_cache = {}
+
+
+def run(f, x):
+    prog = _prog_cache.get(("run",))
+    if prog is None:
+        prog = jax.jit(f)
+        _prog_cache[("run",)] = prog
+    return jax.jit(f)(x)

@@ -1,0 +1,14 @@
+"""R6 clean twin: the cache is a TappedCache, programs compiled once."""
+import jax
+
+from dr_tpu.utils.spmd_guard import TappedCache
+
+_prog_cache = TappedCache()
+
+
+def run(f, x):
+    prog = _prog_cache.get(("run",))
+    if prog is None:
+        prog = jax.jit(f)
+        _prog_cache[("run",)] = prog
+    return prog(x)

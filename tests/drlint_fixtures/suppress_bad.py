@@ -1,0 +1,7 @@
+"""R0 twin: a suppression with NO reason is itself a finding, and does
+not waive the underlying one."""
+import os
+
+
+def knob():
+    return os.environ.get("DR_TPU_FIXTURE_ONLY_KNOB")  # drlint: ok[R2]

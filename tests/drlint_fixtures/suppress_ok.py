@@ -1,0 +1,17 @@
+"""Suppression twin: the R2 finding is waived WITH a reason — the
+same-line, line-above, and STACKED line-above forms."""
+import jax
+import os
+
+
+def knob():
+    # drlint: ok[R2] fixture exercising the line-above suppression form
+    a = os.environ.get("DR_TPU_FIXTURE_ONLY_KNOB")
+    b = os.environ.get("DR_TPU_FIXTURE_ONLY_KNOB")  # drlint: ok[R2] same-line form
+    return a, b
+
+
+def stacked():
+    # drlint: ok[R2] stacked waivers: the raw read is deliberate here
+    # drlint: ok[R6] stacked waivers: compile-per-call is deliberate too
+    return jax.jit(lambda: 0)(), os.environ["DR_TPU_FIXTURE_ONLY_KNOB"]

@@ -18,9 +18,10 @@ import pytest
 
 import dr_tpu
 from dr_tpu.utils import fallback, faults, resilience
+from dr_tpu.utils.env import env_float, env_int
 
-ROUNDS = int(os.environ.get("DR_TPU_CHAOS_ROUNDS", "1"))
-DEADLINE = float(os.environ.get("DR_TPU_CHAOS_DEADLINE", "180"))
+ROUNDS = env_int("DR_TPU_CHAOS_ROUNDS", 1, floor=0)  # 0 = skip the sweep
+DEADLINE = env_float("DR_TPU_CHAOS_DEADLINE", 180.0)
 
 
 def _half(x):

@@ -1,0 +1,378 @@
+"""drlint + DR_TPU_SANITIZE acceptance (docs/SPEC.md §13).
+
+Each rule fires on its known-bad fixture twin and stays silent on the
+clean one; suppressions need a reason; the baseline diffs; the repo
+itself is clean under ``--check``; and the runtime sanitizer arms,
+counts recompiles, and sweeps a real algorithm chain in a
+``DR_TPU_SANITIZE=1`` subprocess.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "drlint_fixtures")
+
+_spec = importlib.util.spec_from_file_location(
+    "drlint", os.path.join(REPO, "tools", "drlint.py"))
+drlint = importlib.util.module_from_spec(_spec)
+sys.modules["drlint"] = drlint    # dataclasses resolve the module here
+_spec.loader.exec_module(drlint)
+
+
+def _scan(*names, relpath=None):
+    """Run the Linter over fixture files; ``relpath`` fakes the
+    repo-relative path (the package-scoped rules R5/R6 only apply under
+    ``dr_tpu/``).  Returns the ACTIVE findings."""
+    files = []
+    for nm in names:
+        path = os.path.join(FIXTURES, nm)
+        files.append(drlint.FileInfo(path, relpath or
+                                     f"tests/drlint_fixtures/{nm}"))
+    lin = drlint.Linter(files, set(drlint.RULES), full_scan=False)
+    return [f for f in lin.run() if f.status == "active"]
+
+
+# ---------------------------------------------------------------------------
+# each rule: fires on the bad twin, silent on the clean twin
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule", ["R1", "R2", "R3", "R4"])
+def test_rule_fires_on_bad_silent_on_clean(rule):
+    low = rule.lower()
+    bad = _scan(f"{low}_bad.py")
+    assert any(f.rule == rule for f in bad), bad
+    assert _scan(f"{low}_clean.py") == []
+
+
+@pytest.mark.parametrize("rule", ["R5", "R6"])
+def test_package_scoped_rules(rule):
+    """R5/R6 apply inside dr_tpu/ — scan the twins under a faked
+    package relpath."""
+    low = rule.lower()
+    bad = _scan(f"{low}_bad.py", relpath=f"dr_tpu/_fx_{low}.py")
+    assert any(f.rule == rule for f in bad), bad
+    assert _scan(f"{low}_clean.py",
+                 relpath=f"dr_tpu/_fx_{low}c.py") == []
+
+
+def test_r5_catches_both_shapes():
+    bad = _scan("r5_bad.py", relpath="dr_tpu/_fx_r5.py")
+    msgs = " | ".join(f.msg for f in bad)
+    assert "warnings.warn" in msgs and "broad except" in msgs
+
+
+def test_r6_catches_both_shapes():
+    bad = _scan("r6_bad.py", relpath="dr_tpu/_fx_r6.py")
+    msgs = " | ".join(f.msg for f in bad)
+    assert "plain dict" in msgs and "immediately-invoked" in msgs
+
+
+def test_outside_package_r5_r6_module_rules_do_not_apply(tmp_path):
+    """The same snippets under a tests/ relpath — with the fixture's
+    scope=package pragma stripped — are NOT findings (the
+    immediately-invoked jit check still applies everywhere)."""
+    src = open(os.path.join(FIXTURES, "r5_bad.py")).read()
+    stripped = "\n".join(ln for ln in src.splitlines()
+                         if "drlint: scope=package" not in ln)
+    p = tmp_path / "r5_unscoped.py"
+    p.write_text(stripped + "\n")
+    fi = drlint.FileInfo(str(p), "tests/drlint_fixtures/r5_unscoped.py")
+    lin = drlint.Linter([fi], set(drlint.RULES), full_scan=False)
+    assert [f for f in lin.run() if f.status == "active"] == []
+    active = _scan("r6_bad.py")
+    assert all("immediately-invoked" in f.msg for f in active)
+
+
+def test_scope_pragma_fires_package_rules_from_cli():
+    """The acceptance bullet: a direct CLI scan of EVERY bad twin exits
+    non-zero — the R5 twins ride the scope=package pragma for it."""
+    for nm in sorted(os.listdir(FIXTURES)):
+        if nm.endswith("_bad.py"):
+            path = os.path.join(FIXTURES, nm)
+            assert drlint.main(["--no-baseline", path]) == 1, nm
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_waives():
+    """Same-line, line-above, AND stacked line-above waivers all apply
+    (the fixture's stacked pair covers two different rules on one
+    statement)."""
+    assert _scan("suppress_ok.py") == []
+
+
+def test_r2_membership_test_is_a_read():
+    """Review fix: `"DR_TPU_X" in os.environ` is a read R2 must see —
+    the clean twin's env_raw(...) is not None form stays silent."""
+    bad = _scan("r2_bad.py")
+    assert any("membership" in f.msg for f in bad), bad
+
+
+def test_pending_waiver_does_not_leak_past_inline_form(tmp_path):
+    """Review fix: a line-above waiver followed by a line carrying its
+    own inline waiver is consumed THERE — it must not fall through and
+    suppress an unrelated finding on the next statement."""
+    src = (
+        "import os\n"
+        "# drlint: ok[R2] above-line waiver\n"
+        'a = os.environ.get("DR_TPU_SANITIZE")  # drlint: ok[R2] inline\n'
+        'b = os.environ.get("DR_TPU_SANITIZE")\n')
+    p = tmp_path / "leak.py"
+    p.write_text(src)
+    fi = drlint.FileInfo(str(p), "tests/drlint_fixtures/leak.py")
+    lin = drlint.Linter([fi], set(drlint.RULES), full_scan=False)
+    active = [f for f in lin.run() if f.status == "active"]
+    assert any(f.rule == "R2" and f.line == 4 for f in active), active
+    assert not any(f.line == 3 for f in active), active
+
+
+def test_reasonless_waiver_cannot_disarm_another_rules_reasoned_one(
+        tmp_path):
+    """Review fix: reasons are tracked PER RULE — a bare ok[R5] on the
+    line above must not eat the reason of a valid inline ok[R2]."""
+    src = (
+        "import os\n"
+        "# drlint: ok[R5]\n"
+        'a = os.environ.get("DR_TPU_SANITIZE")  # drlint: ok[R2] fine\n')
+    p = tmp_path / "perrule.py"
+    p.write_text(src)
+    fi = drlint.FileInfo(str(p), "tests/drlint_fixtures/perrule.py")
+    lin = drlint.Linter([fi], set(drlint.RULES), full_scan=False)
+    active = [f for f in lin.run() if f.status == "active"]
+    # the bare waiver is its own R0 finding, but the R2 stays waived
+    assert {f.rule for f in active} == {"R0"}, active
+
+
+def test_unparseable_file_fails_the_gate(tmp_path):
+    """Review fix: a SyntaxError must be an ACTIVE finding, not a
+    silently skipped file — the CI gate exits non-zero."""
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    assert drlint.main(["--no-baseline", str(p)]) == 1
+
+
+def test_suppression_without_reason_is_a_finding():
+    active = _scan("suppress_bad.py")
+    rules = {f.rule for f in active}
+    assert "R0" in rules, active          # the bare waiver itself
+    assert "R2" in rules, active          # and it does NOT waive
+
+
+def test_rule_subset_scoping():
+    """--rules R4 must not report the R2 fixture."""
+    path = os.path.join(FIXTURES, "r2_bad.py")
+    fi = drlint.FileInfo(path, "tests/drlint_fixtures/r2_bad.py")
+    lin = drlint.Linter([fi], {"R0", "R4"}, full_scan=False)
+    assert [f for f in lin.run() if f.status == "active"] == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, JSON report, baseline diffing
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes():
+    bad = os.path.join(FIXTURES, "r2_bad.py")
+    clean = os.path.join(FIXTURES, "r2_clean.py")
+    assert drlint.main(["--no-baseline", bad]) == 1
+    assert drlint.main(["--no-baseline", clean]) == 0
+
+
+def test_json_report(tmp_path):
+    bad = os.path.join(FIXTURES, "r4_bad.py")
+    out = tmp_path / "report.json"
+    assert drlint.main(["--no-baseline", "--json", str(out), bad]) == 1
+    report = json.loads(out.read_text())
+    assert report["summary"]["active"] >= 1
+    assert any(f["rule"] == "R4" and f["status"] == "active"
+               for f in report["findings"])
+
+
+def test_baseline_burn_down(tmp_path):
+    """write-baseline accepts the current findings; --check then passes
+    until a NEW finding appears; fixing the finding leaves a stale
+    entry note, not a failure."""
+    base = tmp_path / "baseline.json"
+    bad = os.path.join(FIXTURES, "r2_bad.py")
+    bad2 = os.path.join(FIXTURES, "r4_bad.py")
+    assert drlint.main(["--baseline", str(base),
+                        "--write-baseline", bad]) == 0
+    recorded = json.loads(base.read_text())["findings"]
+    assert recorded and all(v >= 1 for v in recorded.values())
+    # same findings: baselined, exit 0
+    assert drlint.main(["--baseline", str(base), "--check", bad]) == 0
+    # a new file's findings are NOT covered: exit 1
+    assert drlint.main(["--baseline", str(base), "--check",
+                        bad, bad2]) == 1
+    # the finding set shrank: still exit 0 (stale entries just noted)
+    clean = os.path.join(FIXTURES, "r2_clean.py")
+    assert drlint.main(["--baseline", str(base), "--check", clean]) == 0
+
+
+def test_repo_is_clean_under_check():
+    """The acceptance gate: the default whole-repo scan has zero
+    non-baselined findings (and the shipped baseline is empty)."""
+    assert drlint.main(["--check"]) == 0
+    baseline = os.path.join(REPO, "tools", "drlint_baseline.json")
+    if os.path.exists(baseline):
+        assert json.loads(open(baseline).read()).get("findings") == {}
+
+
+# ---------------------------------------------------------------------------
+# DR_TPU_SANITIZE runtime half
+# ---------------------------------------------------------------------------
+
+def test_zero_recompile_region_catches_insert():
+    from dr_tpu.utils import sanitize
+    from dr_tpu.utils.spmd_guard import TappedCache
+    cache = TappedCache()
+    with sanitize.zero_recompile("warm region"):
+        cache.get(("k",))                    # lookups are fine
+    with pytest.raises(sanitize.SanitizeError, match="zero-recompile"):
+        with sanitize.zero_recompile("cold region"):
+            cache[("k",)] = "prog"           # an insert is a compile
+
+
+def test_recompile_storm_detection():
+    from dr_tpu.utils import sanitize
+    sanitize.reset_epoch()
+    try:
+        for _ in range(4):                   # same canonical key, 4x
+            sanitize._on_compile(("prog", 64, "float32"))
+        sanitize.check_recompiles(limit=4)   # at the budget: fine
+        with pytest.raises(sanitize.SanitizeError,
+                           match="recompile storm"):
+            sanitize.check_recompiles(limit=3)
+    finally:
+        sanitize.reset_epoch()
+
+
+def test_canon_portability_check():
+    from dr_tpu.utils import sanitize
+    # a pinned mesh canonicalizes to a placeholder: portable
+    sanitize._on_record(("k",), "(halo,ptr,8)")
+    with pytest.raises(sanitize.SanitizeError, match="process-local"):
+        sanitize._on_record(
+            ("k",), "(halo,<Mesh object at 0x7f2a91c04d30>,8)")
+
+
+def test_blocked_stencil_inner_compiles_are_counted():
+    """Review fix: the blocked stencils' two-level caches store jitted
+    programs in a plain inner dict the TappedCache insert tap cannot
+    see — _blocked_drive must report each inner store through
+    spmd_guard.note_compile, and a warm re-drive must stay cold."""
+    from dr_tpu.algorithms.stencil import _blocked_drive, _prog_cache
+    from dr_tpu.utils import sanitize, spmd_guard
+
+    class _Cont:
+        _data = 0.0
+
+    key = ("drlint_noteblk_fixture",)
+    try:
+        c0 = spmd_guard.compile_count()
+        _blocked_drive(_Cont(), key, steps=5, block=2,
+                       make_prog=lambda n: (lambda x: x))
+        # outer holder insert + inner block=2 + inner rest=1 (and the
+        # setdefault miss counts exactly ONCE — no __setitem__ double)
+        assert spmd_guard.compile_count() - c0 == 3
+        with sanitize.zero_recompile("warm blocked re-drive"):
+            _blocked_drive(_Cont(), key, steps=5, block=2,
+                           make_prog=lambda n: (lambda x: x))
+    finally:
+        _prog_cache.pop(key, None)
+
+
+def test_preexisting_nan_input_is_not_blamed_on_the_flush(monkeypatch):
+    """Review fix: the finite sweep must exempt a run whose containers
+    ENTERED the flush non-finite (the eager chain would propagate the
+    same NaN), while still catching a program that mints non-finite
+    values from finite inputs."""
+    import numpy as np
+    import dr_tpu
+    from dr_tpu.utils import sanitize
+
+    monkeypatch.setattr(sanitize, "_installed", True)
+    dr_tpu.init()
+    n = 8 * dr_tpu.nprocs()
+
+    def _mul(x, c):
+        return x * c
+
+    src = np.zeros(n, np.float32)
+    src[0] = float("nan")
+    a = dr_tpu.distributed_vector.from_array(src)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred():          # NaN predates the flush: no error
+        dr_tpu.transform(a, b, _mul, 2.0)
+    assert np.isnan(dr_tpu.to_numpy(b)[0])
+
+    c = dr_tpu.distributed_vector(n, np.float32)
+    d = dr_tpu.distributed_vector(n, np.float32)
+    with pytest.raises(sanitize.SanitizeError, match="non-finite"):
+        with dr_tpu.deferred():      # finite in, inf out: still caught
+            dr_tpu.fill(c, 1.0)
+            dr_tpu.transform(c, d, _mul, float("inf"))
+
+
+def test_check_finite():
+    import jax.numpy as jnp
+    from dr_tpu.utils import sanitize
+    sanitize.check_finite(jnp.asarray([1.0, 2.0]), "ok state")
+    sanitize.check_finite(jnp.asarray([1, 2]), "ints are exempt")
+    with pytest.raises(sanitize.SanitizeError, match="non-finite"):
+        sanitize.check_finite(jnp.asarray([1.0, float("nan")]), "bad")
+
+
+def test_sanitize_smoke_subprocess():
+    """DR_TPU_SANITIZE=1 end-to-end: a small deferred algorithm chain
+    runs sanitized (armed hooks, finite flush sweep, per-epoch
+    recompile check) in its own process."""
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import dr_tpu
+from dr_tpu.utils import sanitize, spmd_guard
+
+assert sanitize.installed(), "DR_TPU_SANITIZE=1 must arm at import"
+
+
+def _mul(x, c):
+    return x * c
+
+
+dr_tpu.init()
+n = 8 * dr_tpu.nprocs()
+a = dr_tpu.distributed_vector(n, np.float32)
+b = dr_tpu.distributed_vector(n, np.float32)
+sanitize.reset_epoch()
+with dr_tpu.deferred():
+    dr_tpu.fill(a, 2.0)
+    dr_tpu.transform(a, b, _mul, 3.0)
+    s = dr_tpu.reduce(b)
+assert float(s) == 6.0 * n
+# re-record with a new scalar: the strict region must stay cold
+with sanitize.zero_recompile("re-record"):
+    with dr_tpu.deferred():
+        dr_tpu.fill(a, 4.0)
+        dr_tpu.transform(a, b, _mul, 5.0)
+        s2 = dr_tpu.reduce(b)
+    assert float(s2) == 20.0 * n
+sanitize.check_recompiles()
+assert spmd_guard.compile_count() > 0
+print("SANITIZED-OK")
+"""
+    env = dict(os.environ)
+    env["DR_TPU_SANITIZE"] = "1"
+    env.pop("DR_TPU_FAULT_SPEC", None)
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SANITIZED-OK" in r.stdout

@@ -127,6 +127,11 @@ def test_env_knob_tolerant_parsing(monkeypatch):
     assert env_pow2("DR_TPU_TEST_KNOB", 512) == 2048
     monkeypatch.setenv("DR_TPU_TEST_KNOB", "-4")
     assert env_int("DR_TPU_TEST_KNOB", 7, floor=2) == 2
+    # floor=0 keeps an explicit 0 expressible (FUZZ_ITERS/CHAOS_ROUNDS
+    # use it to mean "skip the arms"); the default floor clamps to 1
+    monkeypatch.setenv("DR_TPU_TEST_KNOB", "0")
+    assert env_int("DR_TPU_TEST_KNOB", 7, floor=0) == 0
+    assert env_int("DR_TPU_TEST_KNOB", 7) == 1
 
     # the kernels survive a typo'd knob end-to-end
     from dr_tpu.ops import scan_pallas, stencil_matmul

@@ -58,8 +58,9 @@ def test_op_from_source_escape_hatch():
     assert fn is op_from_source(src, 1)  # identity-stable
     x = jnp.asarray([-2.0, 3.0], jnp.float32)
     np.testing.assert_allclose(np.asarray(fn(x)), [-0.02, 3.0])
-    # traceable under jit
-    np.testing.assert_allclose(np.asarray(jax.jit(fn)(x)), [-0.02, 3.0])
+    # traceable under jit (compile once, then call — R6 discipline)
+    jfn = jax.jit(fn)
+    np.testing.assert_allclose(np.asarray(jfn(x)), [-0.02, 3.0])
     # arity mismatch is a loud error
     with pytest.raises(ValueError):
         op_from_source("lambda x0, x1: x0 + x1", 1)

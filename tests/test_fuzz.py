@@ -15,18 +15,42 @@ import pytest
 
 import dr_tpu
 from dr_tpu import views
-from dr_tpu.utils.env import env_override
+from dr_tpu.utils.env import env_int, env_override, env_raw
 
 # CI default trimmed 40 -> 28 in round 8: the tier-1 suite had grown
 # to the edge of its 870 s budget on the throttled container, and the
 # fuzz arms are the compile-heaviest block.  Depth soaks stay with the
 # crank (tools/fuzz_crank.sh runs every arm at 300 in its own process).
-ITERS = int(os.environ.get("DR_TPU_FUZZ_ITERS", "28"))
+ITERS = env_int("DR_TPU_FUZZ_ITERS", 28, floor=0)  # 0 = skip the arms
 
 
 def _mk(rng, n):
     src = rng.standard_normal(n).astype(np.float32)
     return src, dr_tpu.distributed_vector.from_array(src)
+
+
+# module-level ops: program-cache keys pin callable identity, so fuzz
+# loops must not mint fresh lambdas per iteration — the DR_TPU_SANITIZE
+# run caught the old in-loop lambdas recompiling the same canonical
+# program every pass (recompile churn, rule R1's identity-keyed twin)
+def _twice_plus1(x):
+    return x * 2 + 1
+
+
+def _half_minus2(x):
+    return x * 0.5 - 2
+
+
+def _swap_sumdiff(x, y):
+    return (x + y, x - y)
+
+
+def _absdiff(x, y):
+    return jnp.abs(x - y)
+
+
+def _mul_plus1(x, y):
+    return x * y + 1
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -48,7 +72,7 @@ def test_fuzz_subrange_ops(seed):
                                        rtol=1e-5, atol=1e-6)
         elif alg == "transform":
             dst_src, dst = _mk(rng, n)
-            dr_tpu.transform(dv[b:e], dst[b:e], lambda x: x * 2 + 1)
+            dr_tpu.transform(dv[b:e], dst[b:e], _twice_plus1)
             ref = dst_src.copy()
             ref[b:e] = src[b:e] * 2 + 1
             np.testing.assert_allclose(dr_tpu.to_numpy(dst), ref,
@@ -114,17 +138,45 @@ def test_fuzz_zip_pipelines(seed):
             assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)
         elif mode == "for_each":
             z = views.zip_view(a, b)
-            dr_tpu.for_each(z, lambda x, y: (x + y, x - y))
+            dr_tpu.for_each(z, _swap_sumdiff)
             np.testing.assert_allclose(dr_tpu.to_numpy(a), a_src + b_src,
                                        rtol=1e-5, atol=1e-6)
             np.testing.assert_allclose(dr_tpu.to_numpy(b), a_src - b_src,
                                        rtol=1e-5, atol=1e-6)
         else:
             got = dr_tpu.transform_reduce(
-                views.transform(views.zip_view(a, b),
-                                lambda x, y: jnp.abs(x - y)))
+                views.transform(views.zip_view(a, b), _absdiff))
             ref = float(np.abs(a_src - b_src).astype(np.float64).sum())
             assert got == pytest.approx(ref, rel=1e-3, abs=1e-3)
+
+
+def test_zip_ops_identity_stable_no_recompile():
+    """Regression for the sanitizer's first true positive (round 10):
+    the zip fuzz loops minted fresh lambdas per iteration, so the SAME
+    canonical program recompiled every pass (identity-keyed recompile
+    churn — DR_TPU_SANITIZE flagged 3 compiles of one canonical key in
+    one epoch).  With module-level ops, a second pass over fresh
+    containers of the same geometry must be cache-warm."""
+    from dr_tpu.utils import sanitize
+    rng = np.random.default_rng(7)
+    n = 48
+    a_src, a = _mk(rng, n)
+    b_src, b = _mk(rng, n)
+    dr_tpu.for_each(views.zip_view(a, b), _swap_sumdiff)  # compile once
+    got = dr_tpu.transform_reduce(
+        views.transform(views.zip_view(a, b), _absdiff))
+    assert np.isfinite(got)
+    with sanitize.zero_recompile("second pass, fresh containers"):
+        c_src, c = _mk(rng, n)
+        d_src, d = _mk(rng, n)
+        dr_tpu.for_each(views.zip_view(c, d), _swap_sumdiff)
+        np.testing.assert_allclose(dr_tpu.to_numpy(c), c_src + d_src,
+                                   rtol=1e-5, atol=1e-6)
+        # after the in-place swap: c = c0+d0, d = c0-d0, so |c-d| = |2*d0|
+        got2 = dr_tpu.transform_reduce(
+            views.transform(views.zip_view(c, d), _absdiff))
+        ref = float(np.abs(2.0 * d_src).astype(np.float64).sum())
+        assert got2 == pytest.approx(ref, rel=1e-3, abs=1e-3)
 
 
 @pytest.mark.parametrize("seed", range(2))
@@ -151,7 +203,7 @@ def test_fuzz_distributions(seed):
         elif alg == "transform":
             out = dr_tpu.distributed_vector(n, np.float32,
                                             distribution=sizes)
-            dr_tpu.transform(dv, out, lambda x: x * 0.5 - 2)
+            dr_tpu.transform(dv, out, _half_minus2)
             np.testing.assert_allclose(dr_tpu.to_numpy(out),
                                        src * 0.5 - 2, rtol=1e-5,
                                        atol=1e-6)
@@ -544,7 +596,7 @@ def test_fuzz_misaligned_zip_fallback(seed):
         if da != db:
             assert not dr_tpu.aligned(a, b)
         out = dr_tpu.distributed_vector(n)  # uniform: misaligned w/ both
-        dr_tpu.transform(views.zip(a, b), out, lambda x, y: x * y + 1)
+        dr_tpu.transform(views.zip(a, b), out, _mul_plus1)
         np.testing.assert_allclose(dr_tpu.to_numpy(out),
                                    a_src * b_src + 1, rtol=1e-5,
                                    atol=1e-5)
@@ -782,7 +834,7 @@ def test_fuzz_sort_family(seed):
             return rng.integers(0, 5, n).astype(np.float32)
         return rng.integers(-40, 40, n).astype(np.int32)
 
-    iters = ITERS if "DR_TPU_FUZZ_ITERS" in os.environ else ITERS // 2
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None else ITERS // 2
     for it in range(iters):
         n = int(rng.integers(1, 170))
         desc = bool(rng.integers(0, 2))
@@ -1033,7 +1085,7 @@ def test_fuzz_plan_chains(seed):
     from dr_tpu.utils.spmd_guard import dispatch_count
 
     rng = np.random.default_rng(900 + seed)
-    iters = ITERS if "DR_TPU_FUZZ_ITERS" in os.environ else ITERS // 2
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None else ITERS // 2
     for it in range(max(4, iters // 4)):
         P = min(int(rng.integers(1, 9)), len(jax.devices()))
         dr_tpu.init(jax.devices()[:P])

@@ -214,6 +214,7 @@ def test_reload_env_installs_and_warns(monkeypatch):
 
 def test_unknown_site_and_kind_rejected():
     with pytest.raises(ValueError, match="matches no registered"):
+        # drlint: ok[R3] negative test: an unregistered site must be rejected loudly at arm time
         faults.inject("not.a.site", "transient")
     with pytest.raises(ValueError, match="unknown fault kind"):
         faults.inject("halo.exchange", "lightning")

@@ -1,0 +1,833 @@
+#!/usr/bin/env python
+"""drlint — dr_tpu-specific static invariant checker.
+
+Four rounds of PRs each re-fixed instances of the same bug classes;
+this pass encodes them as permanent rules over ``dr_tpu/``, ``tools/``,
+``tests/`` (+ ``bench.py``, ``__graft_entry__.py``):
+
+====  =====================================================================
+rule  invariant
+====  =====================================================================
+R1    traced-operand: a runtime scalar (``.item()`` result, ``float()`` of
+      a subscript/attribute) must not be baked into a jitted program body
+      via closure, nor keyed BY VALUE into a program cache — route it
+      through a traced operand (``_traced_op_key``/BoundOp).  Value-keyed
+      scalars are the recompile-storm class; every new value compiles a
+      new program.
+R2    env-registry: every ``DR_TPU_*`` / ``_DR_TPU_*`` value READ goes
+      through ``dr_tpu/utils/env`` (tolerant parsing, one registry), and
+      every ``DR_TPU_*`` var referenced anywhere must have a row in the
+      docs/SPEC.md §13 env table (both drift directions are checked;
+      writes — sweeps, ``env_override`` — are allowed raw).
+R3    fault-sites: every ``faults.fire``/``inject``/``injected`` site
+      literal must name (or glob onto) a ``faults.SITES`` entry, every
+      SITES entry must actually be fired somewhere in ``dr_tpu/``, and
+      the ``tests/test_chaos.py`` battery must sweep the registry.
+R4    collective-divergence: a collective (``ppermute``/``psum``/
+      ``all_gather``/``all_to_all``/shift/…) lexically under an ``if``/
+      ``while``/``for`` whose condition reads runtime DATA (subscripts,
+      ``.item()``, ``.any()``-family reductions) diverges dispatch order
+      across ranks — the class ``spmd_guard.first_divergence`` only
+      names at runtime, after the hang.  Mesh-static conditions (names,
+      ``.shape[…]``, literals) are fine.
+R5    fallback-warn: degradation paths announce themselves through
+      ``utils.fallback.warn_fallback`` (the chaos-countable registry) —
+      bare ``warnings.warn`` in package code and broad ``except: pass``
+      swallows are findings.
+R6    tapped-cache: ``jax.jit`` in ``dr_tpu/`` must live in a module on
+      the TappedCache discipline (so dispatches ride the spmd_guard
+      tap); immediately-invoked ``jax.jit(f)(…)`` (compile-per-call) and
+      plain-dict program caches are findings anywhere.
+====  =====================================================================
+
+Suppressions: ``# drlint: ok[R2] <reason>`` on the finding's line, or on
+a dedicated comment line directly above it.  Multiple rules:
+``ok[R2,R5]``.  Stacked comment-line waivers above one statement merge.
+The reason is REQUIRED — a bare ``ok[Rn]`` is itself a finding (rule
+R0).
+
+Scope pragma: ``# drlint: scope=package`` in a file's first lines makes
+the package-scoped rules (R5, the R6 module checks) apply to it even
+outside ``dr_tpu/`` — fixture twins declare it so a direct CLI scan
+judges them exactly as the faked-relpath test scan does.
+
+Baseline: ``tools/drlint_baseline.json`` holds accepted pre-existing
+findings (keyed file::rule::message, line-number free so they survive
+drift).  ``--check`` exits non-zero on any non-baselined finding;
+``--write-baseline`` records the current findings for burn-down.
+
+Usage::
+
+    python tools/drlint.py --check            # CI gate (make lint)
+    python tools/drlint.py --json report.json # machine-readable report
+    python tools/drlint.py --rules R4 path.py # one rule, some files
+
+The runtime companion is ``DR_TPU_SANITIZE=1``
+(``dr_tpu/utils/sanitize.py``): what these rules prove statically, the
+sanitizer asserts dynamically (recompile detection, NaN/Inf at plan
+flush, canon-portability of every dispatch key).  docs/SPEC.md §13.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULES = {
+    "R0": "malformed suppression (reason required) / unparseable file",
+    "R1": "runtime scalar baked into a program builder",
+    "R2": "env read outside utils/env or SPEC env-table drift",
+    "R3": "fault-site registry drift",
+    "R4": "collective under a data-dependent branch",
+    "R5": "degradation path outside the fallback registry",
+    "R6": "program compilation outside the TappedCache discipline",
+}
+
+DEFAULT_ROOTS = ("dr_tpu", "tools", "tests", "bench.py",
+                 "__graft_entry__.py")
+EXCLUDE_DIRS = {"__pycache__", "drlint_fixtures"}
+
+ENV_VAR_RE = re.compile(r"^_?DR_TPU_[A-Z0-9_]+$")
+ENV_HELPERS = {"env_int", "env_pow2", "env_float", "env_str", "env_flag",
+               "env_raw"}
+COLLECTIVES = {"ppermute", "psum", "psum_scatter", "all_gather",
+               "all_to_all", "pshuffle", "shift_left", "shift_right",
+               "alltoall"}
+#: reductions of runtime data that taint a branch condition (R4)
+DATA_REDUCERS = {"item", "any", "all", "sum", "min", "max", "mean",
+                 "nonzero", "tolist"}
+CACHE_NAME_RE = re.compile(r"^_\w*cache\w*$|^\w*_cache$")
+
+SUPPRESS_RE = re.compile(
+    r"#\s*drlint:\s*ok\[(R[0-9](?:\s*,\s*R[0-9])*)\]\s*(.*)")
+#: opts a file outside dr_tpu/ into the package-scoped rules (R5/R6
+#: module checks); must appear in the first few lines
+SCOPE_PACKAGE_RE = re.compile(r"#\s*drlint:\s*scope=package\b")
+
+
+@dataclass
+class Finding:
+    file: str          # repo-relative path
+    line: int
+    rule: str
+    msg: str
+    status: str = "active"      # active | suppressed | baselined
+    reason: str = ""            # suppression reason, when suppressed
+
+    @property
+    def key(self) -> str:
+        return f"{self.file}::{self.rule}::{self.msg}"
+
+    def __str__(self) -> str:
+        tag = "" if self.status == "active" else f" [{self.status}]"
+        return f"{self.file}:{self.line}: {self.rule}{tag} {self.msg}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted name of a call target ('jax.jit', 'os.environ.get', …);
+    '' when the target is not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    """String constants an expression can evaluate to: a Constant is
+    itself; an IfExp contributes both branches (halo fires
+    ``"halo.reduce" if kind == "reduce" else "halo.exchange"``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _const_strs(node.body) + _const_strs(node.orelse)
+    return []
+
+
+# ---------------------------------------------------------------------------
+# suppression handling
+# ---------------------------------------------------------------------------
+
+class Suppressions:
+    """Per-file map line -> {rule: reason}.  A suppression on a bare
+    comment line covers the next non-comment line; stacked comment
+    lines merge; reasons are tracked PER RULE, so a reasonless waiver
+    for one rule cannot disarm a reasoned waiver for another."""
+
+    def __init__(self, src_lines: List[str], relpath: str,
+                 findings: List[Finding]):
+        self.by_line: Dict[int, Dict[str, str]] = {}
+        pending: Optional[Dict[str, str]] = None
+        for i, text in enumerate(src_lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            stripped = text.strip()
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                reason = m.group(2).strip()
+                if not reason:
+                    findings.append(Finding(
+                        relpath, i, "R0",
+                        f"suppression ok[{','.join(sorted(rules))}] "
+                        "has no reason — say why the finding is fine"))
+                entry = {r: reason for r in rules}
+                if stripped.startswith("#"):
+                    if pending is None:       # stacked waivers merge
+                        pending = {}
+                    self._merge(pending, entry)
+                else:
+                    # an inline-suppressed line still CONSUMES a
+                    # pending line-above waiver — it must not leak
+                    # onto the next statement
+                    if pending is not None:
+                        self._merge(
+                            self.by_line.setdefault(i, {}), pending)
+                        pending = None
+                    self._merge(self.by_line.setdefault(i, {}), entry)
+                continue
+            if pending is not None and stripped and \
+                    not stripped.startswith("#"):
+                self._merge(self.by_line.setdefault(i, {}), pending)
+                pending = None
+
+    @staticmethod
+    def _merge(into: Dict[str, str], entry: Dict[str, str]) -> None:
+        for rule, reason in entry.items():
+            if rule not in into or (not into[rule] and reason):
+                into[rule] = reason
+
+    def apply(self, f: Finding) -> None:
+        hit = self.by_line.get(f.line)
+        if hit and hit.get(f.rule):
+            f.status = "suppressed"
+            f.reason = hit[f.rule]
+
+
+# ---------------------------------------------------------------------------
+# per-file analysis
+# ---------------------------------------------------------------------------
+
+class FileInfo:
+    """One parsed file plus the module-level context the rules need."""
+
+    def __init__(self, path: str, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        with open(path, encoding="utf-8") as fh:
+            self.src = fh.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=relpath)
+        self.in_pkg = relpath.startswith("dr_tpu/") or any(
+            SCOPE_PACKAGE_RE.search(ln) for ln in self.lines[:5])
+        # parent links for ancestor walks
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        # module context: tapped caches and imported cache names
+        self.tapped_caches: Set[str] = set()
+        self.dict_caches: Dict[str, int] = {}
+        self.imported_caches: Set[str] = set()
+        for node in self.tree.body:
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt, val = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                tgt, val = node.target.id, node.value
+            if tgt is None or not CACHE_NAME_RE.match(tgt):
+                continue
+            if isinstance(val, ast.Call) and \
+                    _dotted(val.func).endswith("TappedCache"):
+                self.tapped_caches.add(tgt)
+            elif isinstance(val, (ast.Dict,)) or (
+                    isinstance(val, ast.Call) and
+                    _dotted(val.func) == "dict"):
+                self.dict_caches[tgt] = node.lineno
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if CACHE_NAME_RE.match(name):
+                        self.imported_caches.add(name)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent.get(cur)
+
+
+# ---------------------------------------------------------------------------
+# the checker
+# ---------------------------------------------------------------------------
+
+class Linter:
+    def __init__(self, files: List[FileInfo], rules: Set[str],
+                 full_scan: bool):
+        self.files = files
+        self.rules = rules
+        #: cross-file checks (stale SPEC rows, unfired SITES, chaos
+        #: coverage) only make sense over the default whole-repo scan —
+        #: a fixture-scoped run must not report the world as stale.
+        self.full_scan = full_scan
+        self.findings: List[Finding] = []
+        self.env_refs: Dict[str, Tuple[str, int]] = {}
+        self._fired: Set[str] = set()
+        self._sites: Optional[Dict[str, int]] = None
+
+    def emit(self, rule: str, fi: FileInfo, node_or_line, msg: str):
+        if rule not in self.rules:
+            return
+        line = node_or_line if isinstance(node_or_line, int) \
+            else getattr(node_or_line, "lineno", 1)
+        self.findings.append(Finding(fi.relpath, line, rule, msg))
+
+    def run(self) -> List[Finding]:
+        for fi in self.files:
+            self.check_file(fi)
+        self.check_env_table()
+        self.check_fault_registry()
+        # suppressions apply last (and R0 findings ride along)
+        for fi in self.files:
+            sup = Suppressions(fi.lines, fi.relpath, self.findings)
+            for f in self.findings:
+                if f.file == fi.relpath and f.status == "active":
+                    sup.apply(f)
+        self.findings.sort(key=lambda f: (f.file, f.line, f.rule))
+        return self.findings
+
+    # ------------------------------------------------------------- per file
+    def check_file(self, fi: FileInfo) -> None:
+        is_env_py = fi.relpath == "dr_tpu/utils/env.py"
+        if fi.in_pkg and fi.dict_caches and any(
+                isinstance(n, ast.Call) and _dotted(n.func) == "jax.jit"
+                for n in ast.walk(fi.tree)):
+            cname, lineno = next(iter(fi.dict_caches.items()))
+            self.emit("R6", fi, lineno,
+                      f"program cache {cname!r} is a plain dict — use "
+                      "spmd_guard.TappedCache so dispatches ride the "
+                      "guard tap")
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call):
+                self.visit_call(fi, node, is_env_py)
+            elif isinstance(node, ast.Subscript):
+                self.visit_subscript(fi, node, is_env_py)
+            elif isinstance(node, ast.Compare):
+                self.visit_compare(fi, node, is_env_py)
+            elif isinstance(node, ast.ExceptHandler):
+                self.visit_except(fi, node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.check_builder(fi, node)
+
+    def note_env(self, var: str, fi: FileInfo, line: int) -> None:
+        self.env_refs.setdefault(var, (fi.relpath, line))
+
+    def visit_call(self, fi: FileInfo, node: ast.Call,
+                   is_env_py: bool) -> None:
+        name = _dotted(node.func)
+        short = name.rsplit(".", 1)[-1]
+        args0 = _const_strs(node.args[0]) if node.args else []
+
+        # ---- R2: env reads + reference inventory
+        if name in ("os.environ.get", "environ.get", "os.getenv",
+                    "getenv") and args0:
+            for var in args0:
+                if ENV_VAR_RE.match(var):
+                    self.note_env(var, fi, node.lineno)
+                    if not is_env_py:
+                        self.emit("R2", fi, node,
+                                  f"raw os.environ read of {var!r} — "
+                                  "route it through utils/env "
+                                  "(env_str/env_int/env_flag/…)")
+        elif short in ENV_HELPERS and args0:
+            for var in args0:
+                if ENV_VAR_RE.match(var):
+                    self.note_env(var, fi, node.lineno)
+        elif short == "env_override":
+            for kw in node.keywords:
+                if kw.arg and ENV_VAR_RE.match(kw.arg):
+                    self.note_env(kw.arg, fi, node.lineno)
+        elif name.endswith("environ.pop") or \
+                name.endswith("environ.setdefault") or \
+                short in ("setenv", "delenv"):
+            for var in args0:
+                if ENV_VAR_RE.match(var):
+                    self.note_env(var, fi, node.lineno)
+
+        # ---- R3: fire/inject site names
+        if short in ("fire", "inject", "injected") and (
+                name.split(".")[0] in ("faults", "_faults") or
+                name in ("fire", "inject", "injected")):
+            self.check_fault_call(fi, node, short)
+
+        # ---- R4: collectives under data-dependent control flow
+        if short in COLLECTIVES:
+            self.check_collective(fi, node, short)
+
+        # ---- R5: bare warnings.warn in package code
+        if name == "warnings.warn" and fi.in_pkg and \
+                not fi.relpath.startswith(
+                    ("dr_tpu/utils/fallback", "dr_tpu/utils/faults",
+                     "dr_tpu/utils/env")):
+            self.emit("R5", fi, node,
+                      "bare warnings.warn in package code — degradations "
+                      "go through utils.fallback.warn_fallback (the "
+                      "chaos-countable registry)")
+
+        # ---- R6: jit discipline
+        if name == "jax.jit" and fi.in_pkg and not (
+                fi.tapped_caches or fi.imported_caches):
+            self.emit("R6", fi, node,
+                      "jax.jit in a module with no TappedCache program "
+                      "cache — compiles are off the spmd_guard "
+                      "dispatch tap")
+        if isinstance(node.func, ast.Call) and \
+                _dotted(node.func.func) == "jax.jit":
+            self.emit("R6", fi, node,
+                      "immediately-invoked jax.jit(f)(…) compiles on "
+                      "every call — cache the program")
+
+    def visit_subscript(self, fi: FileInfo, node: ast.Subscript,
+                        is_env_py: bool) -> None:
+        if not isinstance(node.ctx, ast.Load):
+            return  # writes (sweep overrides) are allowed raw
+        if _dotted(node.value) not in ("os.environ", "environ"):
+            return
+        for var in _const_strs(node.slice):
+            if ENV_VAR_RE.match(var):
+                self.note_env(var, fi, node.lineno)
+                if not is_env_py:
+                    self.emit("R2", fi, node,
+                              f"raw os.environ[{var!r}] read — route "
+                              "it through utils/env")
+
+    def visit_compare(self, fi: FileInfo, node: ast.Compare,
+                      is_env_py: bool) -> None:
+        """R2: a membership test (``"DR_TPU_X" in os.environ``) is a
+        read too — the None-vs-set shape ``env_raw`` exists for."""
+        if len(node.ops) != 1 or \
+                not isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            return
+        if _dotted(node.comparators[0]) not in ("os.environ", "environ"):
+            return
+        for var in _const_strs(node.left):
+            if ENV_VAR_RE.match(var):
+                self.note_env(var, fi, node.lineno)
+                if not is_env_py:
+                    self.emit("R2", fi, node,
+                              f"raw membership test of {var!r} in "
+                              "os.environ — use utils/env "
+                              "(env_raw(...) is not None)")
+
+    def visit_except(self, fi: FileInfo, node: ast.ExceptHandler):
+        if not fi.in_pkg:
+            return
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name) and
+            node.type.id in ("Exception", "BaseException"))
+        if broad and len(node.body) == 1 and \
+                isinstance(node.body[0], ast.Pass):
+            self.emit("R5", fi, node,
+                      "broad except swallowed with pass — a silent "
+                      "degradation path must warn_fallback (or narrow "
+                      "the catch)")
+
+    # --------------------------------------------------------------- R3
+    def check_fault_call(self, fi: FileInfo, node: ast.Call,
+                         kind: str) -> None:
+        sites = self.fault_sites()
+        if sites is None or not node.args:
+            return
+        for site in _const_strs(node.args[0]):
+            if any(ch in site for ch in "*?["):
+                if not any(fnmatch.fnmatchcase(s, site) for s in sites):
+                    self.emit("R3", fi, node,
+                              f"fault-site glob {site!r} matches no "
+                              "faults.SITES entry")
+            elif site not in sites:
+                self.emit("R3", fi, node,
+                          f"fault site {site!r} is not registered in "
+                          "faults.SITES — a chaos sweep will never "
+                          "reach it")
+            elif kind == "fire" and fi.relpath.startswith("dr_tpu/"):
+                # only PACKAGE fires count toward registry coverage:
+                # a fire() in a test must not keep a dead SITES row
+                # looking reachable
+                self._fired.add(site)
+
+    def fault_sites(self) -> Optional[Dict[str, int]]:
+        """SITES names -> line, parsed from utils/faults.py (AST, no
+        package import — the linter must run without jax)."""
+        if self._sites is not None:
+            return self._sites
+        path = os.path.join(REPO, "dr_tpu", "utils", "faults.py")
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        out: Dict[str, int] = {}
+        for node in tree.body:
+            tgt = node.target if isinstance(node, ast.AnnAssign) else (
+                node.targets[0] if isinstance(node, ast.Assign) and
+                node.targets else None)
+            if isinstance(tgt, ast.Name) and tgt.id == "SITES" and \
+                    isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant):
+                        out[k.value] = k.lineno
+        self._sites = out
+        return out
+
+    def check_fault_registry(self) -> None:
+        """Whole-repo R3 closure: every SITES entry fired somewhere,
+        and the chaos battery sweeps the registry."""
+        if not self.full_scan or "R3" not in self.rules:
+            return
+        sites = self.fault_sites() or {}
+        faults_fi = next((f for f in self.files
+                          if f.relpath == "dr_tpu/utils/faults.py"), None)
+        for site, line in sites.items():
+            if site not in self._fired and faults_fi is not None:
+                self.emit("R3", faults_fi, line,
+                          f"SITES entry {site!r} is never fired in "
+                          "dr_tpu/ — dead registry row")
+        chaos = os.path.join(REPO, "tests", "test_chaos.py")
+        chaos_fi = next((f for f in self.files
+                         if f.relpath == "tests/test_chaos.py"), None)
+        if os.path.exists(chaos) and chaos_fi is not None:
+            src = chaos_fi.src
+            if not re.search(r"\bSITES\b|\bsites\(\)", src):
+                missing = [s for s in sites if s not in src]
+                if missing:
+                    self.emit("R3", chaos_fi, 1,
+                              "test_chaos does not sweep faults.SITES "
+                              f"and never names: {', '.join(missing)}")
+
+    # --------------------------------------------------------------- R4
+    def check_collective(self, fi: FileInfo, node: ast.Call,
+                         short: str) -> None:
+        for anc in fi.ancestors(node):
+            test = None
+            if isinstance(anc, (ast.If, ast.While, ast.IfExp)):
+                test = anc.test
+            elif isinstance(anc, ast.For):
+                test = anc.iter
+            if test is None or not self.data_tainted(test):
+                continue
+            what = "loop over" if isinstance(anc, ast.For) else "branch on"
+            self.emit("R4", fi, node,
+                      f"collective {short!r} under a data-dependent "
+                      f"{what} runtime values (line {anc.lineno}) — "
+                      "ranks can diverge in dispatch order; hoist the "
+                      "decision to mesh-static state (the static "
+                      "complement of spmd_guard.first_divergence)")
+            return  # one finding per call is enough
+
+    @staticmethod
+    def data_tainted(expr: ast.AST) -> bool:
+        """A branch condition is data-tainted when it READS runtime
+        array contents: subscripts (except static ``.shape[i]``),
+        ``.item()``-family reductions, or np/jnp reductions."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Subscript):
+                v = n.value
+                if not (isinstance(v, ast.Attribute) and
+                        v.attr == "shape"):
+                    return True
+            elif isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                short = d.rsplit(".", 1)[-1]
+                if short in DATA_REDUCERS:
+                    return True
+                if d.startswith(("np.", "jnp.", "numpy.",
+                                 "jax.numpy.")):
+                    return True
+        return False
+
+    # --------------------------------------------------------------- R1
+    def check_builder(self, fi: FileInfo, fn: ast.FunctionDef) -> None:
+        """R1 over one program-builder function (one that stores into a
+        ``*cache*`` or returns ``jax.jit(…)``)."""
+        if "R1" not in self.rules:
+            return
+        cache_stores = []
+        returns_jit = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Subscript) and \
+                    isinstance(n.ctx, ast.Store) and \
+                    CACHE_NAME_RE.match(_dotted(n.value) or ""):
+                cache_stores.append(n)
+            elif isinstance(n, ast.Return) and \
+                    isinstance(n.value, ast.Call) and \
+                    _dotted(n.value.func) == "jax.jit":
+                returns_jit = True
+        if not cache_stores and not returns_jit:
+            return
+
+        # taint: names bound to runtime-scalar pulls in THIS function
+        tainted: Dict[str, int] = {}
+        nested = [n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.Lambda))
+                  and n is not fn]
+
+        def scalar_pull(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call):
+                    d = _dotted(n.func)
+                    if d.endswith(".item"):
+                        return True
+                    if d == "float" and n.args and isinstance(
+                            n.args[0], (ast.Subscript, ast.Attribute)):
+                        return True
+            return False
+
+        in_nested = set()
+        for nf in nested:
+            in_nested.update(ast.walk(nf))
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and n not in in_nested and \
+                    scalar_pull(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        tainted[t.id] = n.lineno
+
+        # key expressions: RHS of assignments to the names used as the
+        # cache-store index
+        key_names = set()
+        for st in cache_stores:
+            for n in ast.walk(st.slice):
+                if isinstance(n, ast.Name):
+                    key_names.add(n.id)
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Assign) and n not in in_nested):
+                continue
+            is_key = any(isinstance(t, ast.Name) and t.id in key_names
+                         for t in n.targets)
+            if not is_key:
+                continue
+            if scalar_pull(n.value):
+                self.emit("R1", fi, n,
+                          "runtime scalar (.item()/float(…)) keyed BY "
+                          "VALUE into a program cache — every new value "
+                          "recompiles; pass it as a traced operand "
+                          "(_traced_op_key/BoundOp)")
+                continue
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    self.emit("R1", fi, n,
+                              f"runtime scalar {sub.id!r} (pulled at "
+                              f"line {tainted[sub.id]}) keyed BY VALUE "
+                              "into a program cache — recompile storm; "
+                              "ride a traced operand instead")
+                    break
+                if isinstance(sub, ast.JoinedStr) and any(
+                        isinstance(v, ast.FormattedValue)
+                        for v in sub.values):
+                    self.emit("R1", fi, n,
+                              "f-string interpolation in a program "
+                              "cache key — key on structure, trace "
+                              "values (_traced_op_key)")
+                    break
+
+        # closure capture of a tainted scalar inside the jitted body
+        for nf in nested:
+            params = {a.arg for a in nf.args.args}
+            for n in ast.walk(nf):
+                if isinstance(n, ast.Name) and n.id in tainted and \
+                        n.id not in params:
+                    self.emit("R1", fi, n,
+                              f"runtime scalar {n.id!r} (pulled at line "
+                              f"{tainted[n.id]}) closed over by the "
+                              "program body — it bakes into the "
+                              "compiled program; pass it as a traced "
+                              "operand")
+                    break
+
+    # --------------------------------------------------------------- R2b
+    def check_env_table(self) -> None:
+        """SPEC.md env-table drift, both directions."""
+        if "R2" not in self.rules:
+            return
+        spec_path = os.path.join(REPO, "docs", "SPEC.md")
+        if not os.path.exists(spec_path):
+            return
+        with open(spec_path, encoding="utf-8") as fh:
+            spec_lines = fh.read().splitlines()
+        table: Dict[str, int] = {}
+        for i, text in enumerate(spec_lines, start=1):
+            m = re.match(r"\|\s*`(_?DR_TPU_[A-Z0-9_]+)`", text)
+            if m:
+                table[m.group(1)] = i
+        for var, (relpath, line) in sorted(self.env_refs.items()):
+            if var.startswith("_DR_TPU_"):
+                continue  # process-internal relay markers: §13 exempts
+            if var not in table:
+                self.findings.append(Finding(
+                    relpath, line, "R2",
+                    f"{var} has no row in the docs/SPEC.md §13 env "
+                    "table — document it"))
+        if not self.full_scan:
+            return
+        # shell/tooling refs count for the reverse (stale-row) check
+        shell_refs: Set[str] = set()
+        for root, dirs, names in os.walk(os.path.join(REPO, "tools")):
+            for nm in names:
+                if nm.endswith(".sh"):
+                    with open(os.path.join(root, nm),
+                              encoding="utf-8", errors="replace") as fh:
+                        shell_refs.update(re.findall(
+                            r"_?DR_TPU_[A-Z0-9_]+", fh.read()))
+        for var, line in sorted(table.items()):
+            if var not in self.env_refs and var not in shell_refs:
+                self.findings.append(Finding(
+                    "docs/SPEC.md", line, "R2",
+                    f"env-table row {var} matches no reference in the "
+                    "code — stale documentation"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def collect_files(paths: List[str]) -> Tuple[List[FileInfo],
+                                             List[Finding]]:
+    """Parse the scan set.  An unparseable file is returned as an
+    ACTIVE finding, never silently dropped — a CI gate that skips a
+    broken file would report the world clean while scanning none of
+    it."""
+    out: List[FileInfo] = []
+    errors: List[Finding] = []
+    seen: Set[str] = set()
+
+    def add(p: str) -> None:
+        ap = os.path.abspath(p)
+        if ap in seen or not ap.endswith(".py"):
+            return
+        seen.add(ap)
+        rel = os.path.relpath(ap, REPO).replace(os.sep, "/")
+        try:
+            out.append(FileInfo(ap, rel))
+        except SyntaxError as e:
+            errors.append(Finding(
+                rel, e.lineno or 1, "R0",
+                f"cannot parse file ({e.msg}) — the scan is skipping "
+                "it entirely"))
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+                for nm in sorted(names):
+                    add(os.path.join(root, nm))
+        else:
+            add(p)
+    return out, errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dr_tpu static invariant checker (docs/SPEC.md §13)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the repo)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: exit non-zero on non-baselined "
+                    "findings (this is also the default behavior)")
+    ap.add_argument("--rules", default=",".join(sorted(RULES)),
+                    help="comma-separated rule subset, e.g. R2,R4")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable report ( - = stdout)")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "tools",
+                                         "drlint_baseline.json"))
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into the baseline")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file (report everything)")
+    args = ap.parse_args(argv)
+
+    full_scan = not args.paths
+    roots = args.paths or [os.path.join(REPO, r) for r in DEFAULT_ROOTS]
+    rules = {r.strip().upper() for r in args.rules.split(",")} | {"R0"}
+    unknown = rules - set(RULES)
+    if unknown:
+        ap.error(f"unknown rules: {', '.join(sorted(unknown))}")
+
+    files, parse_errors = collect_files(roots)
+    findings = Linter(files, rules, full_scan).run()
+    findings.extend(parse_errors)
+
+    baseline: Dict[str, int] = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        with open(args.baseline, encoding="utf-8") as fh:
+            baseline = json.load(fh).get("findings", {})
+    budget = dict(baseline)
+    for f in findings:
+        if f.status == "active" and budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            f.status = "baselined"
+
+    active = [f for f in findings if f.status == "active"]
+    if args.write_baseline:
+        counts: Dict[str, int] = {}
+        for f in findings:
+            if f.status in ("active", "baselined"):
+                counts[f.key] = counts.get(f.key, 0) + 1
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump({"findings": counts}, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"drlint: baseline written — {sum(counts.values())} "
+              f"finding(s) in {args.baseline}")
+        return 0
+
+    # with the JSON report on stdout, the human-readable text moves to
+    # stderr so `--json -` stays machine-parseable
+    out = sys.stderr if args.json == "-" else sys.stdout
+    for f in findings:
+        if f.status != "active":
+            continue
+        print(f, file=out)
+    n_sup = sum(1 for f in findings if f.status == "suppressed")
+    n_base = sum(1 for f in findings if f.status == "baselined")
+    stale = {k: v for k, v in budget.items() if v > 0}
+    summary = (f"drlint: {len(active)} finding(s) "
+               f"({n_base} baselined, {n_sup} suppressed) over "
+               f"{len(files)} file(s)")
+    print(summary, file=out)
+    if stale:
+        print(f"drlint: note — {sum(stale.values())} stale baseline "
+              "entr(ies) no longer fire; re-run --write-baseline",
+              file=out)
+
+    if args.json:
+        report = {
+            "summary": {"active": len(active), "baselined": n_base,
+                        "suppressed": n_sup, "files": len(files),
+                        "rules": sorted(rules - {"R0"}),
+                        "stale_baseline": stale},
+            "findings": [vars(f) for f in findings],
+        }
+        text = json.dumps(report, indent=1, sort_keys=True) + "\n"
+        if args.json == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(text)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,15 @@ cd "$(dirname "$0")/.."
 ITERS=${1:-300}
 FILTER=${2:-}
 CHAOS_ROUNDS=$(( ITERS / 60 + 1 ))
+rc=0
+# DRLINT arm (round 10): the static invariant gate runs FIRST — a rule
+# violation fails the crank before any compile time is spent
+# (docs/SPEC.md SS13; suppressions/baseline are the escape hatches)
+echo "=== drlint --check (static invariants) ==="
+if ! python tools/drlint.py --check; then
+  echo "FAILED: drlint --check"
+  rc=1
+fi
 # a broken collection (import/syntax error) must NOT read as a clean
 # crank — with TWO files collected, one broken file still leaves nodes
 # non-empty, so the pytest exit status is the guard, not just emptiness
@@ -60,7 +69,6 @@ if [ -n "$FILTER" ]; then
     exit 2
   fi
 fi
-rc=0
 for nd in $nodes; do
   echo "=== $nd (DR_TPU_FUZZ_ITERS=$ITERS DR_TPU_CHAOS_ROUNDS=$CHAOS_ROUNDS) ==="
   DR_TPU_FUZZ_ITERS=$ITERS DR_TPU_CHAOS_ROUNDS=$CHAOS_ROUNDS \
@@ -71,4 +79,19 @@ for nd in $nodes; do
     rc=1
   fi
 done
+# SANITIZE arm (round 10): one crank of the plan-chain arm with the
+# runtime sanitizer armed — recompile budget, finite flush sweep, and
+# canon-portability checked over every random chain (docs/SPEC.md
+# SS13.4).  Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  nd="tests/test_fuzz.py::test_fuzz_plan_chains"
+  echo "=== $nd (DR_TPU_SANITIZE=1 DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_SANITIZE=1 DR_TPU_FUZZ_ITERS=$ITERS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd under DR_TPU_SANITIZE=1"
+    rc=1
+  fi
+fi
 exit $rc

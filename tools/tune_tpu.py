@@ -388,10 +388,10 @@ def tune_spmv_ladder():
 
     # restore any operator-pinned values on exit (the sweep forces its
     # own per-rung settings; a session-level pin must survive it)
-    from dr_tpu.utils.env import env_override
+    from dr_tpu.utils.env import env_override, env_raw
     with env_override(
-            DR_TPU_SPMV_FORMAT=os.environ.get("DR_TPU_SPMV_FORMAT"),
-            DR_TPU_RING_SCHEDULE=os.environ.get("DR_TPU_RING_SCHEDULE")):
+            DR_TPU_SPMV_FORMAT=env_raw("DR_TPU_SPMV_FORMAT"),
+            DR_TPU_RING_SCHEDULE=env_raw("DR_TPU_RING_SCHEDULE")):
         for logn in (14, 17):
             for k in (4, 32):
                 m = 2 ** logn
@@ -509,20 +509,15 @@ def tune_sort():
             # on sorted/structured inputs; re-confirm on each chip).
             # Restore the operator's own setting afterwards — a sweep
             # run entirely under DR_TPU_SORT_STABLE=1 must stay stable.
-            prior = os.environ.get("DR_TPU_SORT_STABLE")
-            os.environ["DR_TPU_SORT_STABLE"] = "1"
-            try:
-                dt_s = _marginal(run, 2, 10)
-                print(f"sort n=2^{logn} [stable]: "
-                      f"{n / dt_s / 1e6:.1f} Mkeys/s", flush=True)
-            except Exception as e:
-                print(f"sort n=2^{logn} [stable]: FAIL {_errline(e)}",
-                      flush=True)
-            finally:
-                if prior is None:
-                    os.environ.pop("DR_TPU_SORT_STABLE", None)
-                else:
-                    os.environ["DR_TPU_SORT_STABLE"] = prior
+            from dr_tpu.utils.env import env_override
+            with env_override(DR_TPU_SORT_STABLE="1"):
+                try:
+                    dt_s = _marginal(run, 2, 10)
+                    print(f"sort n=2^{logn} [stable]: "
+                          f"{n / dt_s / 1e6:.1f} Mkeys/s", flush=True)
+                except Exception as e:
+                    print(f"sort n=2^{logn} [stable]: FAIL {_errline(e)}",
+                          flush=True)
 
             if P == 1:
                 # the single-chip deployment: no collective phases —
@@ -625,8 +620,9 @@ if __name__ == "__main__":
     # tool had no guard at all and a wedged relay ate the session.
     from dr_tpu.utils import resilience as _resilience
     try:
+        from dr_tpu.utils.env import env_float
         _devs, _degraded = _resilience.first_touch_or_cpu(
-            float(os.environ.get("DR_TPU_TUNE_INIT_TIMEOUT", "420")),
+            env_float("DR_TPU_TUNE_INIT_TIMEOUT", 420.0),
             tag="tune_tpu")
     except _resilience.ResilienceError as e:
         print(f"tune_tpu: device init failed "
