@@ -45,6 +45,16 @@ actually dispatched: autoselect or env override, fallback-resolved) and ``detail
 ladder: local_compute / rotate / combine) are always on; ``--spmv``
 (or DR_TPU_BENCH_SPMV=1, surviving both re-exec legs) adds the
 per-format gemv_n ladder.
+
+Round 11: ``--serve`` (or DR_TPU_BENCH_SERVE=1 — argv and env both
+survive the CPU-fallback re-execs) runs the closed-loop serving load
+generator: an in-process ``dr_tpu.serve`` daemon (one resident claim,
+request batching into deferred-plan flushes) driven by
+DR_TPU_BENCH_SERVE_CLIENTS concurrent client connections issuing
+back-to-back requests; ``detail.serve_latency_ms`` (p50/p95/p99),
+``detail.serve_rps``, and ``detail.serve_batch`` make "heavy traffic"
+a measured number.  A daemon that degraded mid-run reports through
+``detail.degraded.serve`` (resilience.degradation_story markers).
 """
 
 import json
@@ -785,6 +795,103 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
     return out
 
 
+def _serve_metrics(on_cpu: bool) -> dict:
+    """--serve / DR_TPU_BENCH_SERVE=1: closed-loop serving load
+    generator (round 11).  One in-process ``dr_tpu.serve`` daemon —
+    the resident-claim architecture — driven by N concurrent client
+    connections, each issuing back-to-back scale/reduce/dot requests
+    (closed loop: a client's next request waits for its reply).
+    Reports per-request latency percentiles, aggregate request
+    throughput, and the daemon's batching story (fused flushes,
+    batched-request count, batch high-water) — with batching ON, the
+    depth-N arrival window coalesces concurrent clients' ops into one
+    deferred-plan flush each."""
+    import tempfile
+    import threading
+
+    from dr_tpu import serve
+    from dr_tpu.utils.env import env_int
+    out = {}
+    nclients = env_int("DR_TPU_BENCH_SERVE_CLIENTS", 4)
+    nreqs = env_int("DR_TPU_BENCH_SERVE_REQS", 24)
+    n = 2 ** 12 if on_cpu else 2 ** 16
+    tmpdir = tempfile.mkdtemp(prefix="dr_tpu_bench_serve_")
+    sock = os.path.join(tmpdir, "daemon.sock")
+    srv = serve.Server(sock)
+    # client sockets must outlive the daemon's flush watchdog: the
+    # warm-up pays the first compiles, which on the tunneled backend
+    # can take minutes — a 40 s default timeout would kill the whole
+    # serve config before the daemon could answer
+    cto = srv.flush_deadline + 60.0
+    try:
+        srv.start()
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal(n).astype(np.float32)
+        y = rng.standard_normal(n).astype(np.float32)
+        with serve.Client(sock, timeout=cto) as c:  # compile once
+            c.scale(x, a=1.0)
+            c.reduce(x)
+            c.dot(x, y)
+        lat = [[] for _ in range(nclients)]
+        errs = []
+
+        def worker(i):
+            try:
+                with serve.Client(sock, timeout=cto,
+                                  tenant=f"client{i}") as c:
+                    for r in range(nreqs):
+                        op = ("scale", "reduce", "dot")[r % 3]
+                        t0 = time.perf_counter()
+                        if op == "scale":
+                            # streamed coefficient: one cached program
+                            c.scale(x, a=1.0 + r * 1e-6)
+                        elif op == "reduce":
+                            c.reduce(x)
+                        else:
+                            c.dot(x, y)
+                        lat[i].append(time.perf_counter() - t0)
+            except Exception as e:
+                errs.append(repr(e)[:120])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(nclients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        all_lat = np.sort(np.array([t for l in lat for t in l]))
+        if errs:
+            out["serve_errors"] = errs[:3]
+        if all_lat.size:
+            out["serve_latency_ms"] = {
+                p: round(float(np.percentile(all_lat, q)) * 1e3, 2)
+                for p, q in (("p50", 50), ("p95", 95), ("p99", 99))}
+            out["serve_clients"] = nclients
+            out["serve_requests"] = int(all_lat.size)
+            out["serve_rps"] = round(all_lat.size / wall, 1)
+        st = srv.stats()
+        out["serve_batch"] = {
+            "flushes": st["flushes"],
+            "batched_requests": st["batched_requests"],
+            "batch_hw": st["batch_hw"],
+            "queue_depth_hw": st["depth_hw"],
+            "shed": st["shed"], "rejected": st["rejected"]}
+        if st["degraded"]:
+            out["serve_degraded"] = st["degraded"]
+    except Exception as e:  # pragma: no cover - defensive
+        out["serve_error"] = repr(e)[:160]
+    finally:
+        try:
+            srv.stop()
+        except Exception:  # pragma: no cover - teardown best effort
+            out.setdefault("serve_error", "daemon stop failed")
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
+
+
 def _relay_listening() -> bool:
     """Claim-free reachability check of the loopback tunnel relay (ONE
     copy for the whole repo: utils/resilience.relay_listening — shared
@@ -971,12 +1078,6 @@ def main():
     peak = _peak_for(dev)
     target = 0.7 * peak
 
-    # tagged CPU fallback: the full degradation story (reason, original
-    # probe error, retry count, probe wall time) survives into the
-    # artifact, not only stderr
-    from dr_tpu.utils.resilience import degradation_story
-    story = degradation_story()
-
     secondary = {}
     if env_str("DR_TPU_BENCH_SECONDARY", "1") != "0":
         # --phases (or DR_TPU_BENCH_PHASES=1): add the key-value sort
@@ -996,6 +1097,21 @@ def main():
         ladder = ("--pipeline" in sys.argv[1:]
                   or env_flag("DR_TPU_BENCH_PIPELINE"))
         secondary.update(_pipeline_metrics(on_cpu, ladder=ladder))
+        # serving config (round 11): the closed-loop load generator
+        # is opt-in (--serve / DR_TPU_BENCH_SERVE=1 — argv and env
+        # both survive the CPU-fallback re-execs) and, like every
+        # other config here, honors DR_TPU_BENCH_SECONDARY=0; it
+        # spins a resident daemon and measures multi-client latency
+        # percentiles with batching on
+        if "--serve" in sys.argv[1:] or env_flag("DR_TPU_BENCH_SERVE"):
+            secondary.update(_serve_metrics(on_cpu))
+
+    # tagged CPU fallback: the full degradation story (reason, original
+    # probe error, retry count, probe wall time — and, AFTER the serve
+    # config above has run, the daemon's serve markers) survives into
+    # the artifact, not only stderr
+    from dr_tpu.utils.resilience import degradation_story
+    story = degradation_story()
 
     # tap dispatch counts (round 8): the headline timed run's count
     # joins the pipeline arms so dispatch regressions show in every

@@ -79,6 +79,7 @@ from __future__ import annotations
 
 from .utils.env import env_str
 from .utils import sanitize as _sanitize
+import threading as _threading
 from contextlib import contextmanager
 from typing import List, Optional
 
@@ -105,14 +106,29 @@ __all__ = ["Plan", "PlanScalar", "deferred", "active", "flush_reads",
 #: entries whose keys reference dead op identities.
 _plan_cache: dict = TappedCache()
 
-_active: Optional["Plan"] = None
+#: The recording plan is PER-THREAD state: the serving daemon
+#: (dr_tpu/serve) records batched requests into a plan on its dispatch
+#: thread while the host thread may be inside its own deferred region
+#: (bench's pipeline config next to a live in-process server).  A
+#: process-global here would splice one thread's recorded ops into the
+#: other's queue.  The program cache above stays shared — structural
+#: keys are thread-agnostic.
+_tls = _threading.local()
+
+
+def _get_active() -> Optional["Plan"]:
+    return getattr(_tls, "active", None)
+
+
+def _set_active(p: Optional["Plan"]) -> None:
+    _tls.active = p
 
 
 def active() -> Optional["Plan"]:
-    """The currently-recording plan, or None.  Returns None while a
-    flush is executing so opaque thunks (and post-flush eager fallbacks)
-    run eagerly instead of re-recording themselves."""
-    p = _active
+    """The plan currently recording ON THIS THREAD, or None.  Returns
+    None while a flush is executing so opaque thunks (and post-flush
+    eager fallbacks) run eagerly instead of re-recording themselves."""
+    p = _get_active()
     if p is None or p._flushing:
         return None
     return p
@@ -121,7 +137,7 @@ def active() -> Optional["Plan"]:
 def flush_reads(reason: str = "host materialization") -> None:
     """Flush the active plan (if any) before host-visible state is
     read or externally mutated — the container/runtime hooks call this."""
-    p = _active
+    p = _get_active()
     if p is not None and not p._flushing and p._queue:
         p.flush(reason)
 
@@ -291,15 +307,16 @@ class Plan:
     # ------------------------------------------------------------ region
     @contextmanager
     def record(self):
-        """Activate this plan for the enclosed block; flushes on clean
-        exit, discards pending (unexecuted) ops if the block raises."""
-        global _active
-        if _active is self:
+        """Activate this plan for the enclosed block (on this thread);
+        flushes on clean exit, discards pending (unexecuted) ops if the
+        block raises."""
+        if _get_active() is self:
             yield self
             return
-        if _active is not None:
-            raise RuntimeError("another deferred plan is already recording")
-        _active = self
+        if _get_active() is not None:
+            raise RuntimeError("another deferred plan is already "
+                               "recording on this thread")
+        _set_active(self)
         try:
             yield self
         except BaseException:
@@ -308,7 +325,7 @@ class Plan:
         else:
             self.flush("region exit")
         finally:
-            _active = None
+            _set_active(None)
 
     # --------------------------------------------------------- recording
     def _fusible_run(self, cont, values=()) -> _Run:
@@ -795,10 +812,12 @@ def deferred():
     """Deferred-execution region: algorithm calls on segment-aligned
     containers record into a :class:`Plan` and flush (fused, usually
     ONE dispatch) at region exit or any host materialization.  Nesting
-    re-enters the active plan.  Yields the plan for
-    :meth:`Plan.explain` / :meth:`Plan.stats`."""
-    if _active is not None:
-        yield _active
+    re-enters the active plan (per thread — the serving daemon records
+    on its dispatch thread independently of the host thread's region).
+    Yields the plan for :meth:`Plan.explain` / :meth:`Plan.stats`."""
+    p = _get_active()
+    if p is not None:
+        yield p
         return
     p = Plan()
     with p.record():

@@ -1,0 +1,27 @@
+"""dr_tpu.serve — one resident device claim, crash-safe multi-client
+serving (docs/SPEC.md §14).
+
+The tunnel relay allows exactly ONE TPU process; this package makes
+that process a long-lived daemon (:class:`Server`) that claims the
+backend once and multiplexes request streams from many thin
+:class:`Client` processes over a local Unix-domain socket —
+length-prefixed JSON/npy wire protocol (``protocol``), admission
+control + deadline-aware FIFO (``queue``), request batching into one
+deferred-plan flush, classified error serialization, and a watchdog
+that degrades the claim to the CPU route when the relay dies
+mid-session.  ``python -m dr_tpu.serve`` runs the daemon foreground.
+"""
+
+from .client import Client
+from .daemon import (OPS, Server, daemon_alive, default_socket_path,
+                     reset_state)
+from .queue import AdmissionQueue, Request
+
+__all__ = ["Server", "Client", "AdmissionQueue", "Request", "OPS",
+           "daemon_alive", "default_socket_path", "reset"]
+
+
+def reset() -> None:
+    """Stop any live in-process servers and clear the serve env
+    markers (the tests' between-test hygiene hook)."""
+    reset_state()

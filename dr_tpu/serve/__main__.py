@@ -1,0 +1,63 @@
+"""``python -m dr_tpu.serve`` — run the serving daemon foreground.
+
+Prints ONE JSON ready line (``{"serving": <socket>, "pid": ...}``) once
+the claim is held and the socket is listening, then serves until a
+client ``shutdown`` op or SIGTERM/SIGINT; a start failure (double
+daemon, failed claim) prints a classified error line and exits 1.
+
+``--cpu`` forces the CPU platform via ``jax.config`` BEFORE backend
+init — the env var alone is frozen by sitecustomize on this container
+(CLAUDE.md), so subprocess tests and the fuzz-crank serve arm pass the
+flag instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dr_tpu.serve",
+        description="dr_tpu serving daemon (one resident device claim)")
+    ap.add_argument("--socket", default=None,
+                    help="Unix-domain socket path "
+                         "(default: $DR_TPU_SERVE_SOCKET or the "
+                         "per-uid temp path)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform before backend init")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from ..utils import resilience
+    from .daemon import Server
+    srv = Server(args.socket)
+    try:
+        srv.start()
+    except Exception as e:
+        ce = resilience.classified(e)
+        print(json.dumps({"serving": None,
+                          "error": {"cls": type(ce).__name__,
+                                    "message": str(ce)}}), flush=True)
+        return 1
+    print(json.dumps({"serving": srv.path, "pid": os.getpid()}),
+          flush=True)
+
+    def _term(signum, frame):  # pragma: no cover - signal path
+        srv.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    srv.wait()
+    srv.stop()
+    print(json.dumps({"served": srv.stats()}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

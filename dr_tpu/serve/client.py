@@ -1,0 +1,171 @@
+"""Thin client for the serving daemon (dr_tpu/serve/daemon.py).
+
+A client owns NO device claim: it speaks the length-prefixed JSON/npy
+protocol over the daemon's Unix-domain socket, one request in flight
+per connection (concurrency = more connections — the bench's load
+generator runs one Client per worker thread).  Every failure surfaces
+as a CLASSIFIED taxonomy error:
+
+* nothing listening at the socket → ``RelayDownError`` (the daemon is
+  this client's relay);
+* the daemon dropped the connection / a torn reply frame / a socket
+  timeout → ``TransientBackendError`` (reconnect and resubmit);
+* a serialized daemon error → re-raised as the class the daemon
+  caught (``ServerOverloaded``, ``DeadlineExpired``, ``DeviceOOM``,
+  ``ProgramError``, …) via ``protocol.raise_error``.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+import numpy as np
+
+from ..utils import resilience
+from ..utils.env import env_float
+from . import protocol
+
+__all__ = ["Client"]
+
+
+class Client:
+    """Synchronous connection to a serving daemon.
+
+    ``timeout`` bounds every socket operation (default: the daemon's
+    default request deadline + slack) — a wedged daemon costs a
+    classified timeout, never an eternal hang."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 timeout: Optional[float] = None,
+                 tenant: str = "default"):
+        from .daemon import default_socket_path
+        self.path = path or default_socket_path()
+        self.tenant = tenant
+        self._next_id = 0
+        self._broken = None  # set to a reason once the conn desyncs
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(
+            env_float("DR_TPU_SERVE_DEADLINE", 30.0) + 10.0
+            if timeout is None else timeout)
+        try:
+            self._sock.connect(self.path)
+        except (ConnectionRefusedError, FileNotFoundError) as e:
+            self._sock.close()
+            raise resilience.RelayDownError(
+                f"serve: no daemon listening at {self.path} ({e!r})",
+                site="serve.request")
+        except OSError as e:
+            self._sock.close()
+            raise resilience.classified(
+                f"serve: cannot connect to {self.path}: {e!r}",
+                site="serve.request")
+
+    # ------------------------------------------------------------- plumbing
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover - already closed
+            pass
+
+    def _invalidate(self, reason: str) -> None:
+        self._broken = reason
+        self.close()
+
+    def request(self, op: str, arrays=(), params: Optional[dict] = None,
+                *, deadline_s: Optional[float] = None,
+                tenant: Optional[str] = None):
+        """One request/reply round trip.  Returns the scalar result,
+        the single result array, a list of arrays, or the raw reply
+        header (control ops); raises the daemon's classified error.
+
+        A timeout INVALIDATES the connection: the daemon's late reply
+        would otherwise desynchronize the stream (the next request
+        would read it as its own answer) — reconnect with a fresh
+        Client to resubmit."""
+        if self._broken:
+            raise resilience.TransientBackendError(
+                f"serve: connection invalidated ({self._broken}); open "
+                "a fresh Client", site="serve.request")
+        self._next_id += 1
+        rid = self._next_id
+        header = {"op": op, "params": params or {},
+                  "tenant": tenant or self.tenant, "id": rid}
+        if deadline_s is not None:
+            header["deadline_s"] = deadline_s
+        try:
+            protocol.send_frame(self._sock, header, arrays)
+            reply, rarrays = protocol.recv_frame(self._sock)
+        except resilience.ResilienceError:
+            # torn/oversized/malformed mid-exchange: the stream
+            # position is unknown (e.g. a rejected payload's bytes are
+            # still unread), so the connection cannot be trusted for
+            # another request
+            self._invalidate("classified protocol error mid-exchange")
+            raise
+        except socket.timeout:
+            self._invalidate(f"request {op!r} timed out")
+            raise resilience.TransientBackendError(
+                f"serve: request {op!r} timed out waiting for the "
+                "daemon", site="serve.request")
+        except OSError as e:
+            self._invalidate("socket error mid-request")
+            raise resilience.classified(
+                f"serve: connection to {self.path} failed mid-request: "
+                f"{e!r}", site="serve.request")
+        if reply is None:
+            raise resilience.TransientBackendError(
+                "serve: daemon closed the connection before a reply "
+                "(socket closed)", site="serve.request")
+        if reply.get("id") not in (None, rid):
+            # a reply for an EARLIER request (stream desync): refuse to
+            # hand one request's data back as another's answer
+            self._invalidate(
+                f"reply id {reply.get('id')} != request id {rid}")
+            raise resilience.TransientBackendError(
+                "serve: reply stream desynchronized (stale reply id) — "
+                "open a fresh Client", site="serve.request")
+        if not reply.get("ok", False):
+            protocol.raise_error(reply)
+        if "scalar" in reply:
+            return float(reply["scalar"])
+        if rarrays:
+            return rarrays[0] if len(rarrays) == 1 else rarrays
+        return reply
+
+    # ----------------------------------------------------------- op helpers
+    def ping(self) -> dict:
+        return self.request("ping")
+
+    def stats(self) -> dict:
+        return self.request("stats")["stats"]
+
+    def shutdown(self) -> dict:
+        return self.request("shutdown")
+
+    def fill(self, n: int, value: float = 0.0, **kw) -> np.ndarray:
+        return self.request("fill", params={"n": int(n),
+                                            "value": float(value)}, **kw)
+
+    def scale(self, x, a: float = 1.0, b: float = 0.0, **kw) -> np.ndarray:
+        return self.request("scale", [x], {"a": float(a),
+                                           "b": float(b)}, **kw)
+
+    def reduce(self, x, **kw) -> float:
+        return self.request("reduce", [x], **kw)
+
+    def dot(self, x, y, **kw) -> float:
+        return self.request("dot", [x, y], **kw)
+
+    def scan(self, x, **kw) -> np.ndarray:
+        return self.request("scan", [x], **kw)
+
+    def sort(self, x, descending: bool = False, **kw) -> np.ndarray:
+        return self.request("sort", [x],
+                            {"descending": bool(descending)}, **kw)

@@ -1,0 +1,748 @@
+"""Serving daemon: ONE resident device claim, crash-safe multi-client
+serving.
+
+The tunnel relay admits exactly one TPU process at a time, and a
+client killed mid-claim can wedge the host relay for the whole session
+(docs/ROUND2_NOTES.md).  This module turns that constraint into the
+architecture Mesh-TensorFlow argues for (arXiv:1811.02084): one
+long-lived process owns the claim — probed through
+``runtime.probe_devices`` under the ``resilience.with_deadline``
+watchdog, routed by the shared degradation router — and every other
+process is a THIN client speaking the length-prefixed JSON/npy
+protocol over a local Unix-domain socket.  Clients come and go (and
+crash); the claim never moves.
+
+Threads::
+
+    accept loop ──> per-connection reader ──> AdmissionQueue
+                                                   │ take_batch
+                                             dispatch thread (ONE:
+                                             all device work serializes
+                                             here — the resident claim
+                                             has a single owner)
+
+The dispatcher coalesces the batchable requests of each queue pop into
+ONE ``dr_tpu.deferred()`` region, so concurrent clients' ops flush as
+one fused dispatch (dr_tpu/plan.py); non-fusible ops (sort) run
+eagerly after the fused group, order preserved within the batch.
+Robustness contract (chaos-swept via the ``serve.accept`` /
+``serve.request`` / ``serve.flush`` fault sites):
+
+* request errors are classified and SERIALIZED back to the client —
+  they never kill the daemon;
+* a client disconnect mid-request cancels its work cleanly (no reply,
+  no poisoned claim);
+* overload is a typed ``ServerOverloaded`` rejection (queue.py);
+* expired requests are shed before dispatch;
+* a relay death mid-session (RelayDownError, or a batch overrunning
+  the flush watchdog) degrades the daemon to the CPU route through
+  ``resilience.route_first_touch`` and publishes the serve markers
+  ``degradation_story`` folds into ``detail.degraded``;
+* a stale socket file from a dead daemon is taken over at start; a
+  LIVE daemon makes a second ``start()`` fail with a classified error
+  before the newcomer can race the claim.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import tempfile
+import threading
+import weakref
+
+import numpy as np
+
+from ..utils import faults as _faults
+from ..utils import resilience
+from ..utils.env import env_float, env_int, env_str
+from ..utils.fallback import warn_fallback
+from . import protocol
+from .queue import AdmissionQueue, Request
+
+__all__ = ["Server", "default_socket_path", "daemon_alive",
+           "reset_state", "OPS"]
+
+
+def default_socket_path() -> str:
+    """``DR_TPU_SERVE_SOCKET``, or a per-uid path under the system
+    temp dir (Unix-domain socket paths are capped near 107 bytes, so
+    the default stays short)."""
+    return env_str("DR_TPU_SERVE_SOCKET") or os.path.join(
+        tempfile.gettempdir(), f"dr_tpu_serve_{os.getuid()}.sock")
+
+
+def daemon_alive(path: str, timeout: float = 2.0) -> bool:
+    """True when SOMETHING holds the socket at ``path`` — an answering
+    daemon, or one that has bound but is still claiming the backend (a
+    tunneled claim can take minutes; a connect succeeds against the
+    listen backlog before the accept loop runs).  Only a refused/failed
+    CONNECT reads as dead: treating a slow claimer as dead would let a
+    second daemon take over the socket and race the claim."""
+    from .client import Client
+    try:
+        c = Client(path, timeout=timeout)
+    except Exception:
+        return False  # nothing listening: stale socket file
+    try:
+        c.ping()
+    # drlint: ok[R5] liveness probe policy, not a degradation: a bound socket that cannot answer yet (daemon mid-claim) must still read as alive
+    except Exception:
+        pass  # bound but not serving yet: still alive
+    finally:
+        c.close()
+    return True
+
+
+# ---------------------------------------------------------------------------
+# op registry
+# ---------------------------------------------------------------------------
+# module-level ops: plan program-cache keys pin callable identity, so
+# per-request closures would recompile the same structure every batch
+
+def _op_scale(x, a, b):
+    return x * a + b
+
+
+class _OpSpec:
+    __slots__ = ("name", "narrays", "batchable", "handler", "validate")
+
+    def __init__(self, name, narrays, batchable, handler, validate=None):
+        self.name = name
+        self.narrays = narrays
+        self.batchable = batchable
+        self.handler = handler
+        #: intake-time request check (reader thread): a malformed
+        #: request is rejected to ITS client before it can join — and
+        #: poison — a fused batch
+        self.validate = validate
+
+
+def _vec(arr):
+    import dr_tpu
+    return dr_tpu.distributed_vector.from_array(
+        np.ascontiguousarray(np.asarray(arr, np.float32)))
+
+
+def _v_fill(req):
+    if int(req.params.get("n", 0)) <= 0:
+        raise resilience.ProgramError(
+            f"serve: fill needs params.n >= 1, got "
+            f"{req.params.get('n', 0)!r}", site="serve.request")
+
+
+def _h_fill(req):
+    import dr_tpu
+    v = dr_tpu.distributed_vector(int(req.params["n"]), np.float32)
+    dr_tpu.fill(v, float(req.params.get("value", 0.0)))
+    return lambda: ({}, [dr_tpu.to_numpy(v)])
+
+
+def _h_scale(req):
+    import dr_tpu
+    v = _vec(req.arrays[0])
+    dr_tpu.for_each(v, _op_scale, float(req.params.get("a", 1.0)),
+                    float(req.params.get("b", 0.0)))
+    return lambda: ({}, [dr_tpu.to_numpy(v)])
+
+
+def _h_reduce(req):
+    import dr_tpu
+    s = dr_tpu.reduce(_vec(req.arrays[0]))
+    return lambda: ({"scalar": float(s)}, [])
+
+
+def _v_vector(req):
+    for a in req.arrays:
+        if np.asarray(a).ndim != 1 or np.asarray(a).size == 0:
+            raise resilience.ProgramError(
+                f"serve: op {req.op!r} takes non-empty 1-D arrays, got "
+                f"shape {np.asarray(a).shape}", site="serve.request")
+
+
+def _v_dot(req):
+    _v_vector(req)
+    a, b = req.arrays
+    if np.asarray(a).shape != np.asarray(b).shape:
+        raise resilience.ProgramError(
+            "serve: dot operands must share a shape",
+            site="serve.request")
+
+
+def _h_dot(req):
+    import dr_tpu
+    a, b = req.arrays
+    s = dr_tpu.dot(_vec(a), _vec(b))
+    return lambda: ({"scalar": float(s)}, [])
+
+
+def _h_scan(req):
+    import dr_tpu
+    v = _vec(req.arrays[0])
+    out = dr_tpu.distributed_vector(len(v), np.float32)
+    dr_tpu.inclusive_scan(v, out)
+    return lambda: ({}, [dr_tpu.to_numpy(out)])
+
+
+def _h_sort(req):
+    import dr_tpu
+    v = _vec(req.arrays[0])
+    dr_tpu.sort(v, descending=bool(req.params.get("descending", False)))
+    return lambda: ({}, [dr_tpu.to_numpy(v)])
+
+
+#: op name -> (operand count, batchable into one deferred flush?).
+#: sort is NON-fusible (it would force the plan-flush cliff), so it
+#: dispatches eagerly, alone, after the batch's fused group.
+OPS = {
+    "fill": _OpSpec("fill", 0, True, _h_fill, _v_fill),
+    "scale": _OpSpec("scale", 1, True, _h_scale, _v_vector),
+    "reduce": _OpSpec("reduce", 1, True, _h_reduce, _v_vector),
+    "dot": _OpSpec("dot", 2, True, _h_dot, _v_dot),
+    "scan": _OpSpec("scan", 1, True, _h_scan, _v_vector),
+    "sort": _OpSpec("sort", 1, False, _h_sort, _v_vector),
+}
+
+
+class _Conn:
+    """Per-connection daemon-side state: the socket, a write lock (the
+    dispatcher and the reader both reply), and the pending-request set
+    cancelled wholesale on disconnect."""
+
+    __slots__ = ("sock", "lock", "pending", "closed", "__weakref__")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.pending = set()
+        self.closed = False
+
+
+#: live in-process servers (tests/bench); serve.reset() stops leaks
+_live_servers: "weakref.WeakSet" = weakref.WeakSet()
+
+#: the env markers the daemon publishes for degradation_story
+_MARKERS = ("_DR_TPU_SERVE_DEGRADED", "_DR_TPU_SERVE_QUEUE_DEPTH",
+            "_DR_TPU_SERVE_SHED", "_DR_TPU_SERVE_RESTARTS")
+
+
+def reset_state() -> None:
+    """Stop every live in-process server and clear the serve env
+    markers — the conftest autouse fixture calls this so one test's
+    daemon (or its degradation story) cannot leak into the next."""
+    for srv in list(_live_servers):
+        try:
+            srv.stop()
+        # drlint: ok[R5] between-test teardown of a leaked server: a failing stop must not mask the test that leaked it
+        except Exception:  # pragma: no cover - teardown best effort
+            pass
+    for m in _MARKERS:
+        os.environ.pop(m, None)
+
+
+class Server:
+    """The resident daemon.  ``start()`` refuses/takes over the socket,
+    claims the backend ONCE, and serves until ``stop()`` (or a client
+    ``shutdown`` op).  In-process use (tests, bench)::
+
+        srv = Server(path).start()
+        try:
+            with Client(path) as c:
+                c.scale(x, a=2.0)
+        finally:
+            srv.stop()
+    """
+
+    def __init__(self, socket_path=None, *, queue_depth=None,
+                 tenant_cap=None, batch_max=None, batch_window=None,
+                 init_timeout=None, flush_deadline=None):
+        self.path = socket_path or default_socket_path()
+        self.queue_depth = (env_int("DR_TPU_SERVE_QUEUE_DEPTH", 64)
+                            if queue_depth is None else int(queue_depth))
+        self.tenant_cap = (env_int("DR_TPU_SERVE_TENANT_CAP", 8)
+                           if tenant_cap is None else int(tenant_cap))
+        self.batch_max = (env_int("DR_TPU_SERVE_BATCH_MAX", 16)
+                          if batch_max is None else int(batch_max))
+        self.batch_window = (env_float("DR_TPU_SERVE_BATCH_WINDOW", 0.002)
+                             if batch_window is None
+                             else float(batch_window))
+        self.init_timeout = (env_float("DR_TPU_SERVE_INIT_TIMEOUT", 420.0)
+                             if init_timeout is None
+                             else float(init_timeout))
+        self.flush_deadline = (env_float("DR_TPU_SERVE_FLUSH_DEADLINE",
+                                         120.0)
+                               if flush_deadline is None
+                               else float(flush_deadline))
+        self.default_deadline = env_float("DR_TPU_SERVE_DEADLINE", 30.0)
+        self._queue = AdmissionQueue(self.queue_depth, self.tenant_cap)
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._hold = threading.Event()  # test/bench hook: park dispatch
+        self._sock = None
+        self._bound = False
+        self._threads = []
+        self._conns: "weakref.WeakSet" = weakref.WeakSet()
+        self._lock = threading.Lock()
+        self.degraded = None
+        self.devices = None
+        # counters
+        self._requests = 0
+        self._replies = 0
+        self._errors = 0
+        self._cancelled = 0
+        self._accept_drops = 0
+        self._flushes = 0
+        self._batched = 0
+        self._batch_hw = 0
+        self._restarts = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Server":
+        # socket refusal, then BIND, then claim: holding the socket
+        # before the (minutes-long on a tunneled backend) claim closes
+        # the window where a second daemon sees no socket and races
+        # the device claim; daemon_alive treats bound-but-claiming as
+        # alive, so the newcomer still refuses classified
+        self._refuse_or_takeover()
+        self._bind()
+        try:
+            self._claim()
+        except BaseException:
+            self.stop()  # a failed claim must release the socket
+            raise
+        self._stop.clear()
+        self._stopped.clear()
+        for name, fn in (("serve-accept", self._accept_loop),
+                         ("serve-dispatch", self._dispatch_loop)):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        _live_servers.add(self)
+        return self
+
+    def _refuse_or_takeover(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        if daemon_alive(self.path):
+            raise resilience.ProgramError(
+                f"serve: another daemon is already serving on "
+                f"{self.path} — refusing to race its device claim",
+                site="serve.accept")
+        # dead daemon's leftover: announce and take the socket over
+        warn_fallback("serve", "stale socket file taken over "
+                               "(previous daemon died uncleanly)")
+        os.unlink(self.path)
+
+    def _claim(self) -> None:
+        """Claim the backend ONCE: probe_devices under the deadline
+        watchdog, with the shared dead-relay → CPU degradation route."""
+        import dr_tpu
+        devs, degraded = resilience.first_touch_or_cpu(
+            self.init_timeout, tag="serve.claim")
+        dr_tpu.init(devs)
+        self.devices = devs
+        if degraded:
+            self._mark_degraded(f"serve: claimed on the CPU route "
+                                f"({degraded})")
+
+    def _bind(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.bind(self.path)
+        except OSError as e:
+            s.close()
+            raise resilience.classified(
+                f"serve: cannot bind {self.path}: {e!r}",
+                site="serve.accept")
+        s.listen(64)
+        s.settimeout(0.2)  # keep the accept loop responsive to stop()
+        self._sock = s
+        self._bound = True
+
+    def stop(self) -> None:
+        """Stop serving: drain nothing, break the loops, close the
+        socket, publish the serve markers.  Idempotent — the FIRST
+        caller performs the teardown, later callers block until it
+        completes (a `shutdown` op and the __main__ exit path both
+        call here; returning early mid-teardown would let the process
+        exit with the socket file still on disk)."""
+        if not self._stop_lock.acquire(blocking=False):
+            self._stopped.wait(timeout=10.0)
+            return
+        try:
+            if self._stopped.is_set():
+                return
+            self._do_stop()
+            self._stopped.set()
+        finally:
+            self._stop_lock.release()
+
+    def _do_stop(self) -> None:
+        self._stop.set()
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
+        # close accepted connections too: shutdown() first — a plain
+        # close() does not interrupt a reader thread already blocked
+        # in recv(); shutdown delivers the EOF into the in-flight read
+        for cs in list(self._conns):
+            cs.closed = True
+            try:
+                cs.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:  # pragma: no cover - already gone
+                pass
+            try:
+                cs.sock.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._threads = []
+        if self._bound:
+            # only the daemon that BOUND the socket may unlink it: a
+            # stop() after a refused start (the bench/tests
+            # try/finally shape) must not delete the LIVE incumbent's
+            # socket — that would re-open the claim race the refusal
+            # exists to prevent
+            self._bound = False
+            try:
+                if os.path.exists(self.path):
+                    os.unlink(self.path)
+            except OSError:  # pragma: no cover - teardown best effort
+                pass
+            self._publish_markers()
+        _live_servers.discard(self)
+
+    def wait(self, timeout=None) -> bool:
+        """Block until the daemon is asked to stop (shutdown op /
+        signal handler calling stop()); True when it was."""
+        return self._stop.wait(timeout)
+
+    def hold(self) -> None:
+        """Park the dispatcher (requests queue but nothing executes) —
+        the deterministic window the overload / shedding / batching
+        tests and the bench's batch probe need."""
+        self._hold.set()
+
+    def release(self) -> None:
+        self._hold.clear()
+
+    # ------------------------------------------------------------ accepting
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            sock = self._sock
+            if sock is None:
+                break
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listening socket closed under us: stopping
+            try:
+                _faults.fire("serve.accept")
+            except resilience.ResilienceError:
+                # classified accept fault: drop THIS connection, keep
+                # serving — the client sees a classified close
+                self._accept_drops += 1
+                conn.close()
+                continue
+            cs = _Conn(conn)
+            self._conns.add(cs)
+            t = threading.Thread(target=self._client_loop, args=(cs,),
+                                 name="serve-client", daemon=True)
+            t.start()
+
+    def _client_loop(self, cs: _Conn) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    header, arrays = protocol.recv_frame(cs.sock)
+                except resilience.ResilienceError as e:
+                    # the connection must close either way (framing is
+                    # desynced), but a MALFORMED frame is the client's
+                    # deterministic bug: serialize the ProgramError
+                    # back first so the client does not misread the
+                    # bare close as a retryable transient (§14.4)
+                    if isinstance(e, resilience.ProgramError):
+                        self._send(cs, protocol.error_header(e))
+                    break
+                except OSError:
+                    break  # socket closed under us (stop() teardown)
+                if header is None:
+                    break  # clean client disconnect
+                if not self._handle_frame(cs, header, arrays):
+                    break
+        finally:
+            cs.closed = True
+            with self._lock:
+                pending = list(cs.pending)
+            for req in pending:
+                # client crash mid-request: cancel cleanly — the
+                # dispatcher skips the work, the claim is untouched
+                req.cancelled = True
+            if pending:
+                self._cancelled += len(pending)
+            try:
+                cs.sock.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
+
+    def _handle_frame(self, cs: _Conn, header: dict, arrays) -> bool:
+        """One request frame; returns False to close the connection."""
+        op = str(header.get("op", ""))
+        rid = header.get("id")
+        if op == "ping":
+            self._send(cs, {"ok": True, "pong": True, "pid": os.getpid(),
+                            "id": rid})
+            return True
+        if op == "stats":
+            self._send(cs, {"ok": True, "stats": self.stats(),
+                            "id": rid})
+            return True
+        if op == "shutdown":
+            self._send(cs, {"ok": True, "stopping": True, "id": rid})
+            threading.Thread(target=self.stop, name="serve-stop",
+                             daemon=True).start()
+            return False
+        req = None
+        try:
+            _faults.fire("serve.request", op=op)
+            spec = OPS.get(op)
+            if spec is None:
+                raise resilience.ProgramError(
+                    f"serve: unknown op {op!r} (known: "
+                    f"{', '.join(sorted(OPS))})", site="serve.request")
+            if len(arrays) != spec.narrays:
+                raise resilience.ProgramError(
+                    f"serve: op {op!r} takes {spec.narrays} array(s), "
+                    f"got {len(arrays)}", site="serve.request")
+            deadline = header.get("deadline_s", self.default_deadline)
+            req = Request(op, header.get("params"), arrays,
+                          tenant=str(header.get("tenant", "default")),
+                          deadline_s=(None if deadline is None
+                                      else float(deadline)), rid=rid)
+            if spec.validate is not None:
+                spec.validate(req)
+            req.conn = cs
+            with self._lock:
+                cs.pending.add(req)
+            self._queue.submit(req)
+            self._requests += 1
+        except Exception as e:
+            # classified and serialized back — never kills the daemon
+            ce = resilience.classified(e, site="serve.request")
+            if req is not None:
+                with self._lock:
+                    cs.pending.discard(req)
+            self._errors += 1
+            self._send(cs, protocol.error_header(ce, id=rid))
+        return True
+
+    # ----------------------------------------------------------- dispatching
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            live = []
+            try:
+                live, dropped = self._queue.take_batch(
+                    self.batch_max, self.batch_window, stop=self._stop,
+                    paused=self._hold)
+                for req in dropped:
+                    if req.cancelled:
+                        self._queue.release(req)
+                        continue
+                    self._finish(req, error=resilience.DeadlineExpired(
+                        f"serve: request {req.op!r} expired after "
+                        "queueing — shed before dispatch",
+                        site="serve.request"))
+                if not live:
+                    continue
+                fusible = [r for r in live
+                           if OPS.get(r.op) and OPS[r.op].batchable]
+                solo = [[r] for r in live
+                        if not (OPS.get(r.op) and OPS[r.op].batchable)]
+                for group in ([fusible] if fusible else []) + solo:
+                    self._exec_group(group)
+            except Exception as e:  # the dispatcher must never die: a
+                # dead dispatch loop turns every later request into a
+                # silent hang — fail what we hold, classified, and
+                # keep serving
+                ce = resilience.classified(e, site="serve.flush")
+                for req in live:
+                    if req.result is None and req.error is None:
+                        self._finish(req, error=ce)
+
+    def _exec_group(self, group, already_degraded=False) -> None:
+        """Execute one compatible group: batchable ops coalesce into a
+        single deferred-plan flush; errors are classified per the
+        failure matrix (SPEC §14.4)."""
+        import dr_tpu
+        batchable = OPS[group[0].op].batchable
+
+        def run():
+            # the injection site fires INSIDE the retried body: a
+            # transient here recovers on the retry leg, in process
+            _faults.fire("serve.flush", ops=len(group))
+            finishers = []
+            if batchable:
+                with dr_tpu.deferred():
+                    for r in group:
+                        finishers.append(OPS[r.op].handler(r))
+                # region exited: the whole group flushed as ONE plan
+            else:
+                for r in group:
+                    finishers.append(OPS[r.op].handler(r))
+            return [f() for f in finishers]
+
+        try:
+            results = resilience.with_deadline(
+                lambda: resilience.retry(run, attempts=2, base=0.01,
+                                         seed=0),
+                self.flush_deadline, site="serve.flush", dump=False)
+            self._flushes += 1
+            if batchable:
+                self._batched += len(group)
+                self._batch_hw = max(self._batch_hw, len(group))
+            for req, res in zip(group, results):
+                self._finish(req, result=res)
+        except (resilience.RelayDownError, resilience.DeadlineExpired) \
+                as e:
+            if isinstance(e, resilience.DeadlineExpired) \
+                    and not resilience.dead_relay():
+                # the batch overran the watchdog but the relay is
+                # ALIVE (slow compile, not a dead backend): its
+                # abandoned worker thread may still be dispatching, so
+                # replaying — or re-initing the runtime under it —
+                # would race the one-dispatch-owner invariant.  Fail
+                # the batch classified instead.
+                ce = resilience.classified(e, site="serve.flush")
+                for req in group:
+                    self._finish(req, error=ce)
+                return
+            if already_degraded:
+                ce = resilience.classified(e, site="serve.flush")
+                for req in group:
+                    self._finish(req, error=ce)
+                return
+            try:
+                self._degrade(e)
+            except resilience.ResilienceError as de:
+                for req in group:
+                    self._finish(req, error=de)
+                return
+            # the watchdog re-routed the claim: replay the batch once
+            # on the degraded mesh (handlers rebuild their containers)
+            self._exec_group(group, already_degraded=True)
+        except Exception as e:
+            ce = resilience.classified(e, site="serve.flush")
+            if isinstance(ce, resilience.ProgramError) and len(group) > 1:
+                # poison-pill isolation: a deterministic error in ONE
+                # request must not fail its batchmates — re-run each
+                # request alone so only the culprit sees the error
+                for req in group:
+                    self._exec_group([req],
+                                     already_degraded=already_degraded)
+                return
+            for req in group:
+                self._finish(req, error=ce)
+
+    def _degrade(self, err) -> None:
+        """Relay died mid-session: degrade the resident claim to the
+        CPU route through the SHARED degradation router and keep
+        serving — the daemon outlives its backend."""
+        import jax
+        import dr_tpu
+        warn_fallback("serve", "relay died mid-session; daemon "
+                               "degrading to the CPU route")
+        jax.config.update("jax_platforms", "cpu")
+        ft = resilience.route_first_touch(self.init_timeout,
+                                          retried=True)
+        if ft.decision != "ok":
+            raise resilience.classified(
+                f"serve: CPU degrade failed after relay death "
+                f"({ft.err}); original: {err}", site="serve.flush")
+        dr_tpu.init(ft.devices)
+        self.devices = ft.devices
+        self._restarts += 1
+        self._mark_degraded(
+            f"serve: relay died mid-session ({type(err).__name__}: "
+            f"{err}); daemon restarted on the CPU route")
+
+    # ------------------------------------------------------------- replies
+    def _finish(self, req: Request, result=None, error=None) -> None:
+        self._queue.release(req)
+        req.finish(result=result, error=error)
+        if error is not None:
+            self._errors += 1
+        cs = req.conn
+        if cs is None:
+            return  # direct in-process submit: the waiter reads slots
+        with self._lock:
+            cs.pending.discard(req)
+        if req.cancelled or cs.closed:
+            return
+        if error is not None:
+            self._send(cs, protocol.error_header(error, id=req.rid))
+        else:
+            extra, arrays = result
+            self._send(cs, {"ok": True, "id": req.rid, **extra}, arrays)
+
+    def _send(self, cs: _Conn, header: dict, arrays=()) -> None:
+        try:
+            with cs.lock:
+                # bound the write: a live-but-not-reading client whose
+                # reply overflows the socket buffer must not pin the
+                # ONE dispatch thread forever (every other tenant
+                # would hang un-shed).  Per-operation timeout; restored
+                # so the reader thread's recv stays blocking.
+                cs.sock.settimeout(self.default_deadline)
+                try:
+                    protocol.send_frame(cs.sock, header, arrays)
+                finally:
+                    cs.sock.settimeout(None)
+            if header.get("ok"):
+                self._replies += 1
+        except OSError:
+            # client vanished (or stopped reading) between dispatch
+            # and reply: cancel cleanly; the resident claim is
+            # untouched.  socket.timeout is an OSError.  Close the
+            # socket too so the reader thread unblocks.
+            cs.closed = True
+            self._cancelled += 1
+            try:
+                cs.sock.close()
+            except OSError:  # pragma: no cover - already closed
+                pass
+
+    # ------------------------------------------------------------- stories
+    def stats(self) -> dict:
+        q = self._queue.stats()
+        return {"requests": self._requests, "replies": self._replies,
+                "errors": self._errors, "cancelled": self._cancelled,
+                "accept_drops": self._accept_drops,
+                "flushes": self._flushes,
+                "batched_requests": self._batched,
+                "batch_hw": self._batch_hw,
+                "restarts": self._restarts,
+                "degraded": self.degraded, **q}
+
+    def _mark_degraded(self, reason: str) -> None:
+        self.degraded = reason
+        self._publish_markers()
+
+    def _publish_markers(self) -> None:
+        """Publish the serve chapter of the degradation story as env
+        markers (resilience.degradation_story folds them into
+        detail.degraded; they survive a bench CPU-fallback re-exec the
+        same way the _DR_TPU_BENCH_* markers do)."""
+        if self.degraded:
+            os.environ["_DR_TPU_SERVE_DEGRADED"] = self.degraded
+        os.environ["_DR_TPU_SERVE_QUEUE_DEPTH"] = \
+            str(self._queue.depth_hw)
+        os.environ["_DR_TPU_SERVE_SHED"] = str(self._queue.shed)
+        os.environ["_DR_TPU_SERVE_RESTARTS"] = str(self._restarts)

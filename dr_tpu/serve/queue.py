@@ -1,0 +1,175 @@
+"""Admission control + deadline-aware FIFO for the serving daemon.
+
+The queue is the daemon's overload contract (docs/SPEC.md §14.2):
+
+* **bounded depth** — once ``depth`` requests are queued, submission
+  raises a classified :class:`ServerOverloaded` rejection, never a
+  hang or an unbounded backlog;
+* **per-tenant in-flight caps** — one chatty client cannot monopolize
+  the resident claim: a tenant at its cap is rejected while others
+  keep being admitted;
+* **deadline shedding** — every request carries an absolute expiry;
+  :meth:`AdmissionQueue.take_batch` returns expired (and cancelled)
+  requests separately so the dispatcher sheds them BEFORE paying a
+  device dispatch for work nobody is waiting on.
+
+Transport-free on purpose: a :class:`Request` is just the op + its
+operands + completion slots (an Event the submitter can wait on); the
+daemon attaches connections and replies, tests submit directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+from ..utils.resilience import ServerOverloaded
+
+__all__ = ["Request", "AdmissionQueue"]
+
+
+class Request:
+    """One admitted unit of work.
+
+    ``expiry`` is an absolute ``time.monotonic()`` deadline (None =
+    never sheds).  ``cancelled`` is set by the daemon when the
+    submitting client disconnects mid-request — the dispatcher skips
+    the work and the reply.  ``finish`` posts the result/error and
+    wakes any in-process waiter."""
+
+    __slots__ = ("op", "params", "arrays", "tenant", "expiry", "conn",
+                 "rid", "cancelled", "result", "error", "_done")
+
+    def __init__(self, op: str, params: Optional[dict], arrays,
+                 tenant: str = "default",
+                 deadline_s: Optional[float] = None, rid=None):
+        self.op = op
+        self.params = dict(params or {})
+        self.arrays = list(arrays or [])
+        self.tenant = tenant
+        self.expiry = (None if deadline_s is None
+                       else time.monotonic() + float(deadline_s))
+        self.conn = None
+        self.rid = rid
+        self.cancelled = False
+        self.result = None
+        self.error = None
+        self._done = threading.Event()
+
+    def expired(self) -> bool:
+        return self.expiry is not None and time.monotonic() > self.expiry
+
+    def finish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        state = ("done" if self._done.is_set()
+                 else "cancelled" if self.cancelled else "pending")
+        return f"Request({self.op!r}, tenant={self.tenant!r}, {state})"
+
+
+class AdmissionQueue:
+    """Bounded FIFO with per-tenant in-flight accounting.
+
+    A tenant's in-flight count covers queued AND executing requests;
+    :meth:`release` (called by the dispatcher as each request finishes)
+    returns the slot.  Counters (``depth_hw``, ``shed``, ``rejected``,
+    ``admitted``) feed the daemon's stats and the serve degradation
+    markers."""
+
+    def __init__(self, depth: int, tenant_cap: int):
+        self.depth = int(depth)
+        self.tenant_cap = int(tenant_cap)
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._inflight: dict = {}
+        self.depth_hw = 0
+        self.shed = 0
+        self.rejected = 0
+        self.admitted = 0
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def submit(self, req: Request) -> None:
+        """Admit ``req`` or raise :class:`ServerOverloaded` (classified,
+        site ``serve.request``) — overload is a typed rejection the
+        client can act on, never a hang."""
+        with self._cv:
+            if len(self._q) >= self.depth:
+                self.rejected += 1
+                raise ServerOverloaded(
+                    f"serve: queue depth cap {self.depth} reached — "
+                    "back off and resubmit", site="serve.request")
+            if self._inflight.get(req.tenant, 0) >= self.tenant_cap:
+                self.rejected += 1
+                raise ServerOverloaded(
+                    f"serve: tenant {req.tenant!r} is at its in-flight "
+                    f"cap ({self.tenant_cap})", site="serve.request")
+            self._q.append(req)
+            self._inflight[req.tenant] = \
+                self._inflight.get(req.tenant, 0) + 1
+            self.admitted += 1
+            self.depth_hw = max(self.depth_hw, len(self._q))
+            self._cv.notify()
+
+    def release(self, req: Request) -> None:
+        """Return ``req``'s tenant slot (request left execution)."""
+        with self._cv:
+            left = self._inflight.get(req.tenant, 0) - 1
+            if left > 0:
+                self._inflight[req.tenant] = left
+            else:
+                self._inflight.pop(req.tenant, None)
+
+    def take_batch(self, max_n: int, window_s: float,
+                   stop: Optional[threading.Event] = None,
+                   paused: Optional[threading.Event] = None,
+                   ) -> Tuple[List[Request], List[Request]]:
+        """Pop the next FIFO batch: blocks for the first request, then
+        coalesces up to ``max_n`` arrivals within ``window_s`` (the
+        batching window concurrent clients land in).  While ``paused``
+        is set nothing is popped (requests keep queueing — the
+        Server.hold() test/bench hook; the pause must live HERE, not in
+        the dispatch loop, or a dispatcher already blocked waiting
+        would pop a batch the moment one arrives, hold or no hold).
+        Returns ``(live, dropped)`` — ``dropped`` holds expired and
+        cancelled requests, already removed, for the dispatcher to
+        shed (their tenant slots are NOT yet released; the dispatcher
+        releases as it finishes/sheds each request)."""
+        with self._cv:
+            while not self._q or (paused is not None and paused.is_set()):
+                if stop is not None and stop.is_set():
+                    return [], []
+                self._cv.wait(0.1)
+            deadline = time.monotonic() + max(0.0, window_s)
+            while len(self._q) < max_n:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            batch = [self._q.popleft()
+                     for _ in range(min(max_n, len(self._q)))]
+        live, dropped = [], []
+        for r in batch:
+            if r.cancelled or r.expired():
+                dropped.append(r)
+                if not r.cancelled:
+                    self.shed += 1
+            else:
+                live.append(r)
+        return live, dropped
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"queued": len(self._q), "depth_hw": self.depth_hw,
+                    "shed": self.shed, "rejected": self.rejected,
+                    "admitted": self.admitted}

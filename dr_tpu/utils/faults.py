@@ -36,6 +36,23 @@ plan.flush           deferred-plan flush boundary   transient, program
                      before any queued dispatch;
                      a faulted flush drops the
                      unexecuted queue cleanly)
+serve.accept         serving-daemon accept loop     transient, program
+                     (dr_tpu/serve/daemon.py —
+                     fires per accepted client
+                     connection; a faulted accept
+                     drops that connection, the
+                     daemon keeps serving)
+serve.request        serving-daemon request intake  transient, oom, program
+                     (per decoded request frame,
+                     before admission; the error
+                     is serialized back to the
+                     client, never kills the
+                     daemon)
+serve.flush          serving-daemon batch dispatch  transient, relay_down,
+                     (inside the retried batch      program
+                     body, before the deferred
+                     flush; relay_down triggers
+                     the watchdog CPU degrade)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -93,6 +110,9 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "checkpoint.write": ("transient", "truncate", "program"),
     "checkpoint.read": ("transient", "program"),
     "plan.flush": ("transient", "program"),
+    "serve.accept": ("transient", "program"),
+    "serve.request": ("transient", "oom", "program"),
+    "serve.flush": ("transient", "relay_down", "program"),
     "fallback.warn": (),
 }
 

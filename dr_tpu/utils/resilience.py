@@ -54,7 +54,8 @@ from typing import Callable, Optional, Sequence
 __all__ = [
     "ResilienceError", "TransientBackendError", "RelayDownError",
     "DeviceOOM", "ProgramError", "CheckpointCorruptError",
-    "DeadlineExpired", "classify", "classified", "backoff_schedule",
+    "DeadlineExpired", "ServerOverloaded", "classify", "classified",
+    "backoff_schedule",
     "retry", "with_deadline", "dump_dispatch_trace", "relay_listening",
     "dead_relay", "route_first_touch", "first_touch_or_cpu",
     "FirstTouch", "degradation_story",
@@ -103,6 +104,13 @@ class DeadlineExpired(ResilienceError):
     """A watchdogged call overran its deadline (hung first touch /
     compile).  Raised by :func:`with_deadline` after the dispatch-trace
     dump; the hung worker thread is left behind (daemon)."""
+
+
+class ServerOverloaded(ResilienceError):
+    """The serving daemon's admission control rejected the request
+    (queue depth or per-tenant in-flight cap exceeded — dr_tpu/serve).
+    A classified rejection, never a hang: back off and resubmit, or
+    spread the load — retrying immediately just re-trips the cap."""
 
 
 # substring evidence for each class (matched case-insensitively),
@@ -394,17 +402,32 @@ def degradation_story(env=None) -> Optional[dict]:
     """Assemble the degradation story a tagged CPU fallback run must
     carry into its JSON artifact (fallback reason, ORIGINAL probe error,
     retry count, probe wall time) from the ``_DR_TPU_BENCH_*`` markers
-    the re-exec chain threads through the environment.  None when the
-    run is not degraded."""
+    the re-exec chain threads through the environment.  Served runs
+    (dr_tpu/serve) add their own ``_DR_TPU_SERVE_*`` markers — queue
+    depth high-water, shed count, daemon restarts — published by the
+    daemon when it degrades or stops, so ``detail.degraded`` tells the
+    FULL story of a served session, not just the first-touch leg.  None
+    when the run is not degraded."""
     env = os.environ if env is None else env
     reason = env.get("_DR_TPU_BENCH_DEGRADED")
-    if not reason:
+    serve_reason = env.get("_DR_TPU_SERVE_DEGRADED")
+    if not reason and not serve_reason:
         return None
-    story = {"reason": reason,
+    story = {"reason": reason or serve_reason,
              "retries": int(env.get("_DR_TPU_BENCH_RETRIES", "0") or 0),
              "probe_wall_s": float(env.get("_DR_TPU_BENCH_PROBE_S", "0")
                                    or 0.0)}
     first = env.get("_DR_TPU_BENCH_FIRST_ERR")
     if first:
         story["first_error"] = first
+    serve = {}
+    for key, marker in (("reason", "_DR_TPU_SERVE_DEGRADED"),
+                        ("queue_depth", "_DR_TPU_SERVE_QUEUE_DEPTH"),
+                        ("shed", "_DR_TPU_SERVE_SHED"),
+                        ("restarts", "_DR_TPU_SERVE_RESTARTS")):
+        raw = env.get(marker)
+        if raw not in (None, ""):
+            serve[key] = raw if key == "reason" else int(raw)
+    if serve:
+        story["serve"] = serve
     return story

@@ -65,10 +65,21 @@ def _disarm_faults():
     """A leaked fault injection (utils/faults) must not outlive its
     test: the next test's dr_tpu.init() would trip it.  reload_env()
     (not clear()) so a suite deliberately run under DR_TPU_FAULT_SPEC /
-    DR_TPU_FAULT_COUNT keeps its env-declared arming across tests."""
+    DR_TPU_FAULT_COUNT keeps its env-declared arming across tests.
+
+    The same hygiene covers serve state (round 11): a leaked in-process
+    serving daemon (tests/test_serve, the chaos battery's serve leg)
+    must not keep holding its socket — and its published degradation
+    markers must not bleed a 'degraded' story — into the next test.
+    Lazy via sys.modules: tests that never touched dr_tpu.serve pay
+    nothing."""
     yield
     from dr_tpu.utils import faults
     faults.reload_env()
+    import sys as _sys
+    serve = _sys.modules.get("dr_tpu.serve")
+    if serve is not None:
+        serve.reset()
 
 
 @pytest.fixture(autouse=True)

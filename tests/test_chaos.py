@@ -33,7 +33,8 @@ def _battery(tmpdir: str, tag: str) -> None:
     visiting EVERY registered injection site (asserted by
     test_battery_reaches_every_site): probe -> init -> dispatch cache ->
     halo exchange/reduce -> collectives shift/alltoall -> sort -> scan
-    -> deferred-plan flush -> checkpoint write/read -> fallback.warn."""
+    -> deferred-plan flush -> serving daemon (accept/request/flush) ->
+    checkpoint write/read -> fallback.warn."""
     from dr_tpu.parallel.runtime import probe_devices
     devs, err = probe_devices(30.0)
     if err is not None:
@@ -97,6 +98,27 @@ def _battery(tmpdir: str, tag: str) -> None:
         dr_tpu.for_each(pv, _half)
         tot = dr_tpu.reduce(pv)
     assert abs(float(tot) - n) < 1e-3
+
+    # serving daemon (round 11): serve.accept fires per accepted
+    # connection, serve.request per compute-request intake, serve.flush
+    # inside the retried batch body.  A fault must surface CLASSIFIED
+    # at the client (transients recover on the in-process retry leg,
+    # relay_down degrades the resident claim to the CPU route and the
+    # leg still SUCCEEDS) — the daemon itself never dies and never
+    # hangs the battery.
+    from dr_tpu import serve
+    ssrv = serve.Server(os.path.join(tmpdir, f"chaos_{tag}.sock"),
+                        batch_window=0.0)
+    try:
+        ssrv.start()
+        with serve.Client(ssrv.path, timeout=60.0) as sc:
+            sx = src[:8 * P].copy()
+            np.testing.assert_allclose(sc.scale(sx, a=2.0, b=1.0),
+                                       sx * 2.0 + 1.0, rtol=1e-6)
+            assert abs(sc.reduce(np.ones(4 * P, np.float32)) - 4 * P) \
+                < 1e-3
+    finally:
+        ssrv.stop()
 
     ck = os.path.join(tmpdir, f"chaos_{tag}.npz")
     dr_tpu.checkpoint.save(ck, dr_tpu.distributed_vector.from_array(src))

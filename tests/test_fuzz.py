@@ -1178,3 +1178,146 @@ def test_fuzz_plan_chains(seed):
                     dr_tpu.to_numpy(dv), dr_tpu.to_numpy(ev),
                     err_msg=tag)
         del p
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh fuzz (round 11 — VERDICT weak #5 / ROADMAP item 2 satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_cross_mesh(seed):
+    """Round-11 cross-mesh arm (tools/fuzz_crank.sh): random SECOND
+    runtimes over random device subsets drive the two-runtime reshard
+    routes — sort_by_key with keys and payload on DIFFERENT meshes
+    (mismatched shard counts AND equal counts over different device
+    sets, windows and uneven distributions included) and scans whose
+    input and output containers live on different meshes — against
+    numpy oracles, with the materialize fallback DISARMED: the round-5
+    reshard routes promise native collectives, so a
+    MaterializeFallbackWarning here is a regression, not a slow path.
+    The crank discipline that keeps catching real geometry bugs
+    (rounds 4/5/6), finally pointed at the two-runtime dispatch
+    (VERDICT weak #5)."""
+    import jax
+
+    from dr_tpu.parallel.runtime import Runtime
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("cross-mesh fuzz needs >= 2 devices")
+    rng = np.random.default_rng(1600 + seed)
+
+    def mk_runtime():
+        p = int(rng.integers(1, len(devs) + 1))
+        off = int(rng.integers(0, len(devs) - p + 1))
+        return Runtime(mesh=Mesh(np.asarray(devs[off:off + p]), ("x",)))
+
+    # a small pool per seed bounds the per-iteration compile load while
+    # distributions/windows keep randomizing the geometry underneath
+    pool = [None] + [mk_runtime() for _ in range(3)]  # None = default
+
+    def dist(n, rt):
+        P = rt.nprocs if rt is not None else dr_tpu.nprocs()
+        if P < 2 or not rng.integers(0, 2):
+            return None
+        cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+        b = np.concatenate(([0], cuts, [n]))
+        return tuple(int(y - x) for x, y in zip(b[:-1], b[1:]))
+
+    def mkvec(src, rt):
+        return dr_tpu.distributed_vector.from_array(
+            src, distribution=dist(len(src), rt), runtime=rt)
+
+    # CI default is ITERS // 4: every iteration sorts/scans on a FRESH
+    # runtime pair, so programs recompile per pass — the second-
+    # heaviest arm in the file; depth soaks belong to the crank
+    # (tools/fuzz_crank.sh sets DR_TPU_FUZZ_ITERS explicitly)
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else ITERS // 4
+    # the suite silences fallback warnings (conftest) — un-silence and
+    # clear the once-per-site memory HERE, or the no-materialize
+    # assertion below would be vacuous
+    from dr_tpu.utils import fallback
+    with env_override(DR_TPU_SILENCE_FALLBACKS=None):
+        fallback.reset()
+        try:
+            _cross_mesh_iters(rng, pool, mkvec, iters, seed)
+        finally:
+            fallback.reset()
+
+
+def _cross_mesh_iters(rng, pool, mkvec, iters, seed):
+    import warnings
+
+    from dr_tpu.utils.fallback import MaterializeFallbackWarning
+    for it in range(iters):
+        n = int(rng.integers(2, 150))
+        rt_a, rt_b = rng.choice(len(pool), size=2, replace=False)
+        rt_a, rt_b = pool[rt_a], pool[rt_b]
+        case = str(rng.choice(["kv", "kv_win", "scan", "scan_win"]))
+        desc = bool(rng.integers(0, 2))
+        tag = f"cross-mesh {case} n={n} it={it} seed={seed}"
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            if case in ("kv", "kv_win"):
+                k = rng.standard_normal(n).astype(np.float32)
+                pay = (np.arange(n, dtype=np.int32)
+                       if rng.integers(0, 2)
+                       else rng.standard_normal(n).astype(np.float32))
+                kd = mkvec(k, rt_a)
+                vd = mkvec(pay, rt_b)
+                if case == "kv":
+                    dr_tpu.sort_by_key(kd, vd, descending=desc)
+                    order = np.argsort(k, kind="stable")
+                    if desc:
+                        order = order[::-1]
+                    np.testing.assert_array_equal(
+                        dr_tpu.to_numpy(kd), k[order], err_msg=tag)
+                    np.testing.assert_array_equal(
+                        dr_tpu.to_numpy(vd), pay[order], err_msg=tag)
+                else:
+                    wn = int(rng.integers(1, n + 1))
+                    ka = int(rng.integers(0, n - wn + 1))
+                    va = int(rng.integers(0, n - wn + 1))
+                    dr_tpu.sort_by_key(kd[ka:ka + wn], vd[va:va + wn],
+                                       descending=desc)
+                    order = np.argsort(k[ka:ka + wn], kind="stable")
+                    if desc:
+                        order = order[::-1]
+                    kref, pref = k.copy(), pay.copy()
+                    kref[ka:ka + wn] = k[ka:ka + wn][order]
+                    pref[va:va + wn] = pay[va:va + wn][order]
+                    np.testing.assert_array_equal(
+                        dr_tpu.to_numpy(kd), kref, err_msg=tag)
+                    np.testing.assert_array_equal(
+                        dr_tpu.to_numpy(vd), pref, err_msg=tag)
+            else:
+                src = rng.standard_normal(n).astype(np.float32)
+                base = rng.standard_normal(n).astype(np.float32)
+                sv = mkvec(src, rt_a)
+                out = mkvec(base, rt_b)
+                if case == "scan":
+                    dr_tpu.inclusive_scan(sv, out)
+                    np.testing.assert_allclose(
+                        dr_tpu.to_numpy(out),
+                        np.cumsum(src, dtype=np.float32),
+                        rtol=1e-4, atol=1e-5, err_msg=tag)
+                else:
+                    wn = int(rng.integers(1, n + 1))
+                    sa = int(rng.integers(0, n - wn + 1))
+                    oa = int(rng.integers(0, n - wn + 1))
+                    dr_tpu.inclusive_scan(sv[sa:sa + wn],
+                                          out[oa:oa + wn])
+                    ref = base.copy()
+                    ref[oa:oa + wn] = np.cumsum(src[sa:sa + wn],
+                                                dtype=np.float32)
+                    np.testing.assert_allclose(
+                        dr_tpu.to_numpy(out), ref, rtol=1e-4,
+                        atol=1e-5, err_msg=tag)
+                # the INPUT is untouched by a cross-mesh scan
+                np.testing.assert_array_equal(dr_tpu.to_numpy(sv), src,
+                                              err_msg=tag)
+        bad = [str(r.message) for r in rec
+               if issubclass(r.category, MaterializeFallbackWarning)]
+        assert not bad, f"{tag}: materialize fallback regressed: {bad}"

@@ -391,3 +391,27 @@ def test_degradation_story_assembly():
                      "first_error": "UNAVAILABLE: first",
                      "retries": 1, "probe_wall_s": 12.5}
     assert resilience.degradation_story({}) is None
+
+
+def test_degradation_story_serve_markers():
+    """Round 11: served runs publish _DR_TPU_SERVE_* markers; the
+    story grows a `serve` chapter (queue depth, shed count, restarts)
+    so detail.degraded tells the full serving story."""
+    serve_env = {"_DR_TPU_SERVE_DEGRADED":
+                 "serve: relay died; restarted on the CPU route",
+                 "_DR_TPU_SERVE_QUEUE_DEPTH": "7",
+                 "_DR_TPU_SERVE_SHED": "2",
+                 "_DR_TPU_SERVE_RESTARTS": "1"}
+    story = resilience.degradation_story(serve_env)
+    assert story["reason"].startswith("serve: relay died")
+    assert story["serve"] == {"reason": serve_env["_DR_TPU_SERVE_DEGRADED"],
+                              "queue_depth": 7, "shed": 2, "restarts": 1}
+    # counters WITHOUT a degradation reason are not a degraded run
+    assert resilience.degradation_story(
+        {"_DR_TPU_SERVE_QUEUE_DEPTH": "3"}) is None
+    # a first-touch degradation keeps its own reason; the serve
+    # chapter rides alongside
+    both = dict(serve_env, _DR_TPU_BENCH_DEGRADED="relay not listening")
+    s2 = resilience.degradation_story(both)
+    assert s2["reason"] == "relay not listening"
+    assert s2["serve"]["shed"] == 2

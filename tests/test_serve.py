@@ -1,0 +1,579 @@
+"""Serving daemon (dr_tpu/serve): lifecycle edges, admission control,
+batching, and the classified failure matrix (docs/SPEC.md §14).
+
+Everything runs on the 8-device virtual CPU mesh.  In-process servers
+bind sockets under tmp_path (Unix-domain paths cap near 107 bytes —
+pytest tmp dirs stay short enough); the subprocess tests drive the
+``python -m dr_tpu.serve`` entry the fuzz-crank serve arm cranks.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import serve
+from dr_tpu.serve import protocol
+from dr_tpu.utils import faults, resilience
+from dr_tpu.utils.env import env_int
+
+X = np.arange(48, dtype=np.float32)
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = serve.Server(str(tmp_path / "d.sock"))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _client(srv, **kw):
+    kw.setdefault("timeout", 60.0)
+    return serve.Client(srv.path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def test_protocol_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        arrays = [np.arange(5, dtype=np.float32),
+                  np.ones((2, 3), np.int32)]
+        protocol.send_frame(a, {"op": "x", "params": {"k": 1}}, arrays)
+        hdr, got = protocol.recv_frame(b)
+        assert hdr["op"] == "x" and hdr["params"] == {"k": 1}
+        for want, have in zip(arrays, got):
+            np.testing.assert_array_equal(want, have)
+            assert want.dtype == have.dtype
+        # clean EOF between frames is a normal disconnect
+        a.close()
+        assert protocol.recv_frame(b) == (None, None)
+    finally:
+        b.close()
+
+
+def test_protocol_torn_frame_classified():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00\x10partial")  # 16-byte header, 7 sent
+        a.close()
+        with pytest.raises(resilience.TransientBackendError,
+                           match="torn"):
+            protocol.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_protocol_malformed_and_oversized_classified():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\xff\xff\xff\xff")  # absurd header length
+        with pytest.raises(resilience.ProgramError):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        bad = b"not json!"
+        import struct
+        a.sendall(struct.pack(">I", len(bad)) + bad)
+        with pytest.raises(resilience.ProgramError, match="malformed"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_error_header_roundtrip():
+    hdr = protocol.error_header(
+        resilience.ServerOverloaded("queue full", site="serve.request"))
+    assert hdr["ok"] is False
+    with pytest.raises(resilience.ServerOverloaded, match="queue full"):
+        protocol.raise_error(hdr)
+    # unknown class name degrades to the deterministic bucket
+    with pytest.raises(resilience.ProgramError):
+        protocol.raise_error({"error": {"cls": "NoSuchClass",
+                                        "message": "m"}})
+
+
+# ---------------------------------------------------------------------------
+# request/reply correctness
+# ---------------------------------------------------------------------------
+
+def test_serve_ops_roundtrip(server):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(33).astype(np.float32)
+    y = rng.standard_normal(33).astype(np.float32)
+    with _client(server) as c:
+        assert c.ping()["pong"] is True
+        np.testing.assert_allclose(c.scale(x, a=2.0, b=-1.0),
+                                   x * 2.0 - 1.0, rtol=1e-6)
+        assert abs(c.reduce(x) - x.astype(np.float64).sum()) < 1e-3
+        assert abs(c.dot(x, y) - (x.astype(np.float64)
+                                  * y).sum()) < 1e-2
+        np.testing.assert_allclose(c.scan(x),
+                                   np.cumsum(x, dtype=np.float32),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(c.sort(x), np.sort(x))
+        np.testing.assert_allclose(c.fill(16, 2.5),
+                                   np.full(16, 2.5, np.float32))
+        st = c.stats()
+        assert st["requests"] >= 6 and st["errors"] == 0
+
+
+def test_serve_request_errors_classified_daemon_survives(server):
+    with _client(server) as c:
+        with pytest.raises(resilience.ProgramError, match="unknown op"):
+            c.request("no_such_op")
+        with pytest.raises(resilience.ProgramError, match="array"):
+            c.request("reduce")  # missing operand
+        with pytest.raises(resilience.ProgramError, match="params.n"):
+            c.fill(0)
+        with pytest.raises(resilience.ProgramError, match="share a"):
+            c.dot(X, X[:5])
+        # the SAME connection keeps working after every rejection
+        assert abs(c.reduce(X) - X.sum()) < 1e-3
+    assert server.stats()["errors"] == 4
+
+
+# ---------------------------------------------------------------------------
+# batching
+# ---------------------------------------------------------------------------
+
+def test_serve_batching_coalesces_one_flush(server):
+    with _client(server) as c:
+        c.scale(X, a=1.0)  # compile the fused program
+    f0 = server.stats()["flushes"]
+    server.hold()
+    results = {}
+
+    def worker(i):
+        with _client(server) as c:
+            results[i] = c.scale(X, a=float(i + 1))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while len(server._queue) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(server._queue) == 4, "requests did not queue under hold"
+    server.release()
+    for t in threads:
+        t.join()
+    for i in range(4):
+        np.testing.assert_allclose(results[i], X * (i + 1), rtol=1e-6)
+    st = server.stats()
+    # all four concurrent requests coalesced into ONE fused-plan flush
+    assert st["flushes"] == f0 + 1
+    assert st["batch_hw"] == 4
+
+
+def test_serve_nonfusible_runs_solo_in_batch(server):
+    """sort is non-fusible: batched alongside fusible ops it executes
+    alone (after the fused group), and every result stays correct."""
+    server.hold()
+    rng = np.random.default_rng(3)
+    src = rng.standard_normal(40).astype(np.float32)
+    results = {}
+
+    def w_sort():
+        with _client(server) as c:
+            results["sort"] = c.sort(src)
+
+    def w_scale():
+        with _client(server) as c:
+            results["scale"] = c.scale(src, a=3.0)
+
+    threads = [threading.Thread(target=w) for w in (w_sort, w_scale)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while len(server._queue) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    server.release()
+    for t in threads:
+        t.join()
+    np.testing.assert_array_equal(results["sort"], np.sort(src))
+    np.testing.assert_allclose(results["scale"], src * 3.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_serve_overload_rejected_classified(tmp_path):
+    srv = serve.Server(str(tmp_path / "o.sock"), queue_depth=2,
+                       tenant_cap=8).start()
+    try:
+        srv.hold()
+        errs, oks = [], []
+
+        def worker(i):
+            try:
+                with serve.Client(srv.path, timeout=30.0,
+                                  tenant=f"t{i}") as c:
+                    oks.append(c.reduce(X))
+            except resilience.ResilienceError as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        while len(errs) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        srv.release()
+        for t in threads:
+            t.join()
+        # two requests queue, two are REJECTED (classified, immediate)
+        assert len(errs) == 2 and len(oks) == 2
+        assert all(isinstance(e, resilience.ServerOverloaded)
+                   for e in errs), errs
+        assert srv.stats()["rejected"] == 2
+    finally:
+        srv.stop()
+
+
+def test_serve_tenant_cap_isolates_tenants(tmp_path):
+    srv = serve.Server(str(tmp_path / "t.sock"), queue_depth=16,
+                       tenant_cap=1).start()
+    try:
+        srv.hold()
+        errs, oks = [], []
+
+        def worker(i, tenant):
+            try:
+                with serve.Client(srv.path, timeout=30.0,
+                                  tenant=tenant) as c:
+                    oks.append(c.reduce(X))
+            except resilience.ServerOverloaded as e:
+                errs.append(e)
+
+        # tenant "hog" submits twice (cap 1): exactly one is rejected;
+        # tenant "other" stays admitted regardless
+        threads = [threading.Thread(target=worker, args=(i, t))
+                   for i, t in ((0, "hog"), (1, "hog"), (2, "other"))]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        while len(errs) + len(server_queued(srv)) < 3 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        srv.release()
+        for t in threads:
+            t.join()
+        assert len(errs) == 1 and "hog" in str(errs[0])
+        assert len(oks) == 2
+    finally:
+        srv.stop()
+
+
+def server_queued(srv):
+    return range(len(srv._queue))
+
+
+def test_serve_deadline_expired_requests_shed(server):
+    server.hold()
+    box = {}
+
+    def worker():
+        try:
+            with _client(server, timeout=30.0) as c:
+                box["r"] = c.reduce(X, deadline_s=0.05)
+        except resilience.ResilienceError as e:
+            box["e"] = e
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.4)  # let the deadline lapse while queued
+    server.release()
+    t.join()
+    # shed BEFORE dispatch: classified DeadlineExpired, not a result
+    assert isinstance(box.get("e"), resilience.DeadlineExpired), box
+    assert "shed" in str(box["e"])
+    assert server.stats()["shed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# daemon lifecycle edges
+# ---------------------------------------------------------------------------
+
+def test_serve_stale_socket_takeover(tmp_path):
+    path = str(tmp_path / "stale.sock")
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(path)  # a daemon died here without unlinking
+    s.close()
+    assert os.path.exists(path)
+    srv = serve.Server(path).start()
+    try:
+        with serve.Client(path, timeout=30.0) as c:
+            assert c.ping()["pong"] is True
+    finally:
+        srv.stop()
+
+
+def test_serve_double_daemon_refused_classified(server):
+    newcomer = serve.Server(server.path)
+    with pytest.raises(resilience.ProgramError,
+                       match="already serving"):
+        newcomer.start()
+    # the bench/tests try/finally shape stops the refused newcomer —
+    # that stop must NOT unlink the LIVE incumbent's socket (review
+    # fix: only the daemon that bound the socket may delete it)
+    newcomer.stop()
+    assert os.path.exists(server.path)
+    with _client(server) as c:
+        assert abs(c.reduce(X) - X.sum()) < 1e-3
+
+
+def test_serve_client_crash_mid_request_cancels_cleanly(server):
+    server.hold()
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.connect(server.path)
+    protocol.send_frame(raw, {"op": "reduce", "params": {},
+                              "tenant": "crash"}, [X])
+    deadline = time.monotonic() + 10.0
+    while len(server._queue) < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    raw.close()  # crash before the reply
+    time.sleep(0.1)
+    server.release()
+    # the daemon sheds the dead client's work and keeps serving —
+    # the resident claim is not poisoned
+    with _client(server) as c:
+        assert abs(c.reduce(X) - X.sum()) < 1e-3
+    assert server.stats()["cancelled"] == 1
+
+
+def test_serve_truncated_frame_drops_connection_only(server):
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.connect(server.path)
+    raw.sendall(b"\x00\x00\x01\x00only-a-few-bytes")
+    raw.close()
+    time.sleep(0.2)
+    with _client(server) as c:  # the daemon survived the torn frame
+        assert c.ping()["pong"] is True
+
+
+# ---------------------------------------------------------------------------
+# failure injection (the serve.* sites)
+# ---------------------------------------------------------------------------
+
+def test_serve_flush_transient_recovers_in_process(server):
+    with faults.injected("serve.flush", "transient") as sp:
+        with _client(server) as c:
+            assert abs(c.reduce(X) - X.sum()) < 1e-3
+        assert sp.fired == 1  # retried in process, request succeeded
+
+
+def test_serve_flush_program_fault_isolated_per_request(server):
+    """A deterministic batch failure re-executes each request alone
+    (poison-pill isolation): with the fault exhausted by the batch
+    attempt, BOTH clients still get their results."""
+    with _client(server) as c:
+        c.scale(X, a=1.0)
+    server.hold()
+    results, errs = {}, []
+
+    def worker(i):
+        try:
+            with _client(server) as c:
+                results[i] = c.scale(X, a=float(i + 2))
+        except resilience.ResilienceError as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while len(server._queue) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with faults.injected("serve.flush", "program") as sp:
+        server.release()
+        for t in threads:
+            t.join()
+        assert sp.fired == 1
+    assert not errs, errs
+    for i in range(2):
+        np.testing.assert_allclose(results[i], X * (i + 2), rtol=1e-6)
+
+
+def test_serve_relay_death_degrades_to_cpu_route(server):
+    """relay_down at the flush boundary: the watchdog re-routes the
+    resident claim through route_first_touch onto the CPU mesh, the
+    batch replays there, and the request still SUCCEEDS — with the
+    serve chapter published into the degradation story."""
+    with faults.injected("serve.flush", "relay_down") as sp:
+        with _client(server) as c:
+            assert abs(c.reduce(X) - X.sum()) < 1e-3
+        assert sp.fired == 1
+    st = server.stats()
+    assert st["restarts"] == 1
+    assert "CPU route" in st["degraded"]
+    story = resilience.degradation_story()
+    assert story is not None and story["serve"]["restarts"] == 1
+    # conftest's autouse fixture resets this between tests; reset()
+    # here proves the hook clears the markers
+    serve.reset()
+    assert resilience.degradation_story() is None
+
+
+def test_serve_accept_fault_drops_connection_keeps_serving(server):
+    with faults.injected("serve.accept", "transient") as sp:
+        with pytest.raises(resilience.ResilienceError):
+            with _client(server) as c:
+                c.ping()
+        assert sp.fired == 1
+    assert server.stats()["accept_drops"] == 1
+    with _client(server) as c:  # the NEXT connection serves normally
+        assert c.ping()["pong"] is True
+
+
+def test_serve_request_fault_serialized_back(server):
+    with _client(server) as c:
+        with faults.injected("serve.request", "oom") as sp:
+            with pytest.raises(resilience.DeviceOOM):
+                c.reduce(X)
+            assert sp.fired == 1
+        # the classified reply did not kill the daemon OR the conn
+        assert abs(c.reduce(X) - X.sum()) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# concurrency with the host thread's own plans
+# ---------------------------------------------------------------------------
+
+def _tl_scale(x, c):
+    return x * c
+
+
+def test_serve_plans_are_thread_local(server):
+    """The daemon records batched requests into deferred plans on ITS
+    dispatch thread; a region OPEN on the host thread must neither
+    absorb the daemon's ops nor leak its own into the daemon's flush."""
+    src = np.arange(64, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    box = {}
+
+    def worker():
+        with _client(server) as c:
+            box["r"] = c.scale(X, a=5.0)
+
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(v, 2.0)
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()  # the daemon flushed ITS plan while ours is open
+        dr_tpu.for_each(v, _tl_scale, 3.0)
+        tot = dr_tpu.reduce(v)
+    assert float(tot) == pytest.approx(64 * 6.0)
+    np.testing.assert_allclose(box["r"], X * 5.0, rtol=1e-6)
+    # the host plan held exactly its own three ops, in one fused run
+    st = p.stats()
+    assert st["fused_ops"] == 3 and st["fused_runs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# subprocess daemon (the fuzz-crank serve arm cranks these)
+# ---------------------------------------------------------------------------
+
+def _spawn_daemon(path, fault_spec=None, timeout=120.0):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # frozen by sitecustomize: use --cpu
+    if fault_spec is not None:
+        env["DR_TPU_FAULT_SPEC"] = fault_spec
+    else:
+        env.pop("DR_TPU_FAULT_SPEC", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dr_tpu.serve", "--socket", path, "--cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    import json
+    line = proc.stdout.readline()
+    try:
+        ready = json.loads(line) if line.strip() else {}
+    except ValueError:
+        ready = {}
+    if ready.get("serving") != path:
+        proc.kill()
+        proc.wait(timeout=30)
+        raise AssertionError(f"daemon failed to start: {line!r}")
+    return proc
+
+
+def test_serve_subprocess_lifecycle(tmp_path):
+    path = str(tmp_path / "sub.sock")
+    proc = _spawn_daemon(path)
+    try:
+        with serve.Client(path, timeout=120.0) as c:
+            np.testing.assert_allclose(c.scale(X, a=2.0), X * 2.0,
+                                       rtol=1e-6)
+            c.shutdown()
+        assert proc.wait(timeout=60) == 0
+        assert not os.path.exists(path), "socket not cleaned up"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def _serve_chaos_combos():
+    """Tier-1 runs the two richest subprocess combos (a daemon start
+    costs a jax import); the fuzz-crank serve arm (DR_TPU_CHAOS_ROUNDS
+    > 1) sweeps every serve site x kind against a live daemon."""
+    if env_int("DR_TPU_CHAOS_ROUNDS", 1, floor=0) > 1:
+        return [(s, k) for s, kinds in sorted(faults.sites().items())
+                if s.startswith("serve.") for k in kinds]
+    return [("serve.flush", "relay_down"), ("serve.request", "program")]
+
+
+@pytest.mark.slow  # each combo pays a daemon-subprocess jax import;
+# tier-1 (-m 'not slow') keeps the IN-process serve.* sweep
+# (test_chaos) and the subprocess lifecycle test above — the
+# fuzz-crank serve arm runs this sweep unfiltered
+@pytest.mark.parametrize("site,kind", _serve_chaos_combos())
+def test_serve_subprocess_chaos(tmp_path, site, kind):
+    """Chaos against a LIVE daemon subprocess: with `site:kind` armed
+    in the daemon's environment, every client request must end in a
+    classified error or a correct result — the daemon never dies
+    uncleanly and never hangs the client past its timeout."""
+    path = str(tmp_path / "chaos.sock")
+    proc = _spawn_daemon(path, fault_spec=f"{site}:{kind}")
+    try:
+        outcomes = []
+        for attempt in range(3):
+            try:
+                with serve.Client(path, timeout=120.0) as c:
+                    got = c.scale(X, a=2.0)
+                    np.testing.assert_allclose(got, X * 2.0, rtol=1e-6)
+                    outcomes.append("ok")
+            except resilience.ResilienceError as e:
+                outcomes.append(type(e).__name__)
+            # (any OTHER exception propagates = unclassified = failure)
+        # the injection fires once; afterwards the daemon must serve
+        assert outcomes[-1] == "ok", outcomes
+        with serve.Client(path, timeout=120.0) as c:
+            if site == "serve.flush" and kind == "relay_down":
+                st = c.stats()
+                assert st["restarts"] == 1, st
+            c.shutdown()
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -35,6 +35,18 @@
 # banded) against a dense float64 oracle, and bit-compares the ring
 # schedule's serial vs pipelined issue orders (filter
 # `sparse_formats`).  The chaos sweep covers collectives.ppermute.
+#
+# CROSS-MESH arm (round 11): test_fuzz_cross_mesh drives random
+# second runtimes over random device subsets through the two-runtime
+# reshard routes (cross-mesh sort_by_key windows, cross-mesh scans)
+# vs numpy oracles with the materialize fallback disarmed (filter
+# `cross_mesh`).
+#
+# SERVE arm (round 11): tests/test_serve.py runs at the end against a
+# LIVE `python -m dr_tpu.serve` daemon subprocess — with the crank's
+# DR_TPU_CHAOS_ROUNDS > 1 it sweeps every serve.* site x kind combo
+# there (plus all the in-process lifecycle edges); the in-battery
+# serve leg rides the chaos arm above.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
@@ -91,6 +103,20 @@ if [ -z "$FILTER" ]; then
   st=${PIPESTATUS[0]}
   if [ "$st" -ne 0 ]; then
     echo "FAILED ($st): $nd under DR_TPU_SANITIZE=1"
+    rc=1
+  fi
+fi
+# SERVE arm (round 11): chaos against a live daemon subprocess —
+# DR_TPU_CHAOS_ROUNDS > 1 expands test_serve_subprocess_chaos to the
+# full serve.* site x kind sweep (plus every in-process lifecycle
+# edge).  Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  echo "=== tests/test_serve.py (serve arm, DR_TPU_CHAOS_ROUNDS=$CHAOS_ROUNDS) ==="
+  DR_TPU_CHAOS_ROUNDS=$CHAOS_ROUNDS \
+    python -m pytest tests/test_serve.py -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): tests/test_serve.py serve arm"
     rc=1
   fi
 fi
