@@ -55,6 +55,13 @@ back-to-back requests; ``detail.serve_latency_ms`` (p50/p95/p99),
 ``detail.serve_rps``, and ``detail.serve_batch`` make "heavy traffic"
 a measured number.  A daemon that degraded mid-run reports through
 ``detail.degraded.serve`` (resilience.degradation_story markers).
+
+Round 12: ``detail.obs`` (the dr_tpu/obs metrics snapshot — counters,
+the daemon-side serve latency histograms, dispatch/compile counts) is
+always on; ``--serve`` adds ``detail.serve_daemon_ms`` (queue-wait vs
+service vs batch-flush split) next to the client percentiles, and
+under ``DR_TPU_TRACE=1`` the run exports a Chrome trace
+(``detail.obs.trace_file``, Perfetto-openable; docs/SPEC.md §15).
 """
 
 import json
@@ -878,6 +885,23 @@ def _serve_metrics(on_cpu: bool) -> dict:
             "batch_hw": st["batch_hw"],
             "queue_depth_hw": st["depth_hw"],
             "shed": st["shed"], "rejected": st["rejected"]}
+        # daemon-side latency split (round 12, dr_tpu/obs): where each
+        # request's time went — queue-wait vs service vs the shared
+        # batch-flush — next to the client-side percentiles above.
+        # Sampled by the daemon's always-live histograms, so this
+        # works traced or not.
+        hists = (st.get("obs") or {}).get("histograms", {})
+        split = {}
+        for key, label in (("serve.queue_wait_ms", "queue_wait"),
+                           ("serve.service_ms", "service"),
+                           ("serve.flush_ms", "flush")):
+            h = hists.get(key)
+            if h and h.get("count"):
+                split[label] = {"p50": h.get("p50"),
+                                "p95": h.get("p95"),
+                                "count": h["count"]}
+        if split:
+            out["serve_daemon_ms"] = split
         if st["degraded"]:
             out["serve_degraded"] = st["degraded"]
     except Exception as e:  # pragma: no cover - defensive
@@ -1119,6 +1143,18 @@ def main():
     dispatch_counts = {"headline_timed_run": res.get("dispatches")}
     dispatch_counts.update(secondary.pop("dispatch_counts", {}))
 
+    # observability snapshot (round 12, dr_tpu/obs — SPEC §15): the
+    # compact metrics snapshot rides EVERY artifact as detail.obs;
+    # under DR_TPU_TRACE=1 the Chrome trace is exported and its path
+    # recorded so a bench run's trace is one click from its number
+    from dr_tpu import obs
+    obs_detail = obs.snapshot()
+    if obs.armed():
+        try:
+            obs_detail["trace_file"] = obs.export_chrome_trace()
+        except OSError as e:
+            obs_detail["trace_error"] = repr(e)[:120]
+
     print(json.dumps({
         "metric": "stencil1d_5pt_effective_bandwidth_per_chip",
         "value": round(res["gbps"] / nchips, 2),
@@ -1131,6 +1167,7 @@ def main():
             "phys_gbps": round(res["phys_gbps"] / nchips, 2),
             "target_gbps": round(target, 1),
             "dispatch_counts": dispatch_counts,
+            "obs": obs_detail,
             **({"degraded": story} if story else {}),
             **secondary,
         },

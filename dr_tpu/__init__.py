@@ -27,6 +27,8 @@ namespaces through one TPU backend, called ``thp``):
 from .utils import jax_compat  # noqa: F401  (jax.shard_map shim, first)
 from .utils import sanitize as _sanitize
 _sanitize.install()  # no-op unless DR_TPU_SANITIZE=1 (docs/SPEC.md §13.4)
+from . import obs
+obs.install()  # no-op unless DR_TPU_TRACE=1 (docs/SPEC.md §15)
 from .parallel.runtime import (init, final, finalize, runtime, nprocs,
                                devices, mesh, barrier, fence,
                                get_duplicated_devices)
@@ -100,6 +102,7 @@ __all__ = [
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
     "checkpoint", "profiling", "spmd_guard", "faults", "resilience",
+    "obs",
     "ring_attention", "ring_attention_n",
     "dot_n", "inclusive_scan_n", "gemv_n", "spmm_n", "stencil2d_n",
     "plan", "Plan", "PlanScalar", "deferred",

@@ -1,0 +1,84 @@
+"""``dr_tpu.obs`` — unified tracing & metrics (docs/SPEC.md §15).
+
+The repo had five disjoint observability signals (profiling phase
+breakdowns, the spmd_guard dispatch/compile counters, degradation-story
+markers, ``plan.explain()``, the serve ``stats`` op) and no way to see
+one request's life end-to-end.  This package is the one spine they all
+feed:
+
+* **spans & events** (``recorder``): a thread-aware in-process span
+  recorder over a bounded ring buffer, armed by ``DR_TPU_TRACE=1``.
+  Instrumentation rides the existing hook points — every TappedCache
+  dispatch/compile (``spmd_guard``), every fault-registry site visit
+  AND every injected fault (``utils/faults`` — a ``DR_TPU_FAULT_SPEC``
+  injection appears *in* the trace), plan record/flush, retry/deadline
+  attempts, fallback warns, serve request lifecycles, and ``drlog``
+  debug lines as instant events.
+* **metrics** (``metrics``): counters, gauges, bucketed histograms.
+  Handles are always live (the serve daemon samples queue-wait /
+  service / flush time per request on every run); the module-level
+  conveniences here (:func:`count` / :func:`gauge_set` /
+  :func:`observe`) are armed-gated for hotter paths.
+* **exporters** (``export``): Chrome trace-event JSON into
+  ``DR_TPU_TRACE_DIR`` (Perfetto-openable; auto-written at process
+  exit when env-armed) and the compact :func:`snapshot` that
+  ``bench.py`` embeds as ``detail.obs`` and the serve ``stats`` wire
+  op returns.
+
+Overhead contract: tracing off = one module-global check per entry
+point, zero per-event allocation (pinned by
+``recorder.events_recorded``), and ``None`` hot-path hooks.
+"""
+
+from __future__ import annotations
+
+from . import export, metrics, recorder
+from .export import chrome_trace, metrics_snapshot, trace_dir, write
+from .recorder import (arm, armed, begin, complete, current, end, event,
+                       events, events_recorded, flow, install, now,
+                       reset as _reset_ring, size, span, tail)
+
+__all__ = ["arm", "armed", "begin", "complete", "count", "current",
+           "end", "event", "events", "events_recorded", "export",
+           "export_chrome_trace", "flow", "gauge_set", "install",
+           "metrics", "now", "observe", "recorder", "reset", "size",
+           "snapshot", "span", "tail", "trace_dir", "chrome_trace",
+           "metrics_snapshot", "write"]
+
+
+# ------------------------------------------------------- armed-gated metrics
+
+def count(name: str, n: int = 1) -> None:
+    """Armed-gated counter bump (one check when tracing is off)."""
+    if recorder._armed:
+        metrics.counter(name).add(n)
+
+
+def gauge_set(name: str, v: float) -> None:
+    if recorder._armed:
+        metrics.gauge(name).set(v)
+
+
+def observe(name: str, v: float) -> None:
+    """Armed-gated histogram observation."""
+    if recorder._armed:
+        metrics.histogram(name).observe(v)
+
+
+def snapshot() -> dict:
+    """The compact observability snapshot (``detail.obs`` /
+    serve ``stats.obs``): metrics registry + dispatch/compile counts +
+    trace-ring accounting.  Always available — cheap when idle."""
+    return export.metrics_snapshot()
+
+
+def export_chrome_trace(path=None) -> str:
+    """Write the Chrome trace JSON (default into :func:`trace_dir`);
+    returns the written path."""
+    return export.write(path)
+
+
+def reset() -> None:
+    """Clear the trace ring AND the metrics registry (tests)."""
+    _reset_ring()
+    metrics.reset()

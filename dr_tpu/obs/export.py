@@ -1,0 +1,82 @@
+"""Exporters: Chrome trace-event JSON + the compact metrics snapshot.
+
+The trace file is the standard Chrome ``traceEvents`` object format —
+open it in ``chrome://tracing`` or https://ui.perfetto.dev.  Recorded
+events are already one dict per Chrome event (recorder.py), so export
+only adds the shared ``pid`` and thread-name metadata events.  Flow
+start/finish pairs (the Perfetto cross-thread arrows for the serve
+batch-flush → request linkage) are recorded at INSTRUMENTATION time
+via ``recorder.flow`` — they pass through here untouched, and the
+span-level ``args.links`` lists exist for tools/trace_view.py, which
+joins on them instead of the flow events.
+
+The metrics snapshot (:func:`metrics_snapshot`) is the ``detail.obs``
+block ``bench.py`` embeds in every artifact and the ``obs`` field of
+the serve ``stats`` wire op: the metrics registry plus the
+process-lifetime dispatch/compile counters and the recorder's
+ring-buffer accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import List, Optional
+
+from ..utils.env import env_str
+from . import metrics, recorder
+
+__all__ = ["trace_dir", "chrome_trace", "write", "metrics_snapshot"]
+
+
+def trace_dir() -> str:
+    """``DR_TPU_TRACE_DIR``, or the system temp dir (exports must land
+    somewhere writable without polluting the working tree)."""
+    return env_str("DR_TPU_TRACE_DIR") or tempfile.gettempdir()
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> dict:
+    """Render recorded events as a Chrome ``traceEvents`` object."""
+    if events is None:
+        events = recorder.events()
+    pid = os.getpid()
+    out = []
+    for tid, name in sorted(recorder.thread_names().items()):
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": name}})
+    for ev in events:
+        e = dict(ev)
+        e["pid"] = pid
+        out.append(e)
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"producer": "dr_tpu.obs",
+                          "events_recorded": recorder.events_recorded()}}
+
+
+def write(path: Optional[str] = None,
+          events: Optional[List[dict]] = None) -> str:
+    """Write the Chrome trace JSON; default path is
+    ``<trace_dir>/dr_tpu_trace_<pid>.json``.  Returns the path."""
+    if path is None:
+        path = os.path.join(trace_dir(),
+                            f"dr_tpu_trace_{os.getpid()}.json")
+    doc = chrome_trace(events)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+        fh.write("\n")
+    return path
+
+
+def metrics_snapshot() -> dict:
+    """The compact observability snapshot: metrics registry + the
+    always-on dispatch/compile counters + ring accounting."""
+    from ..utils import spmd_guard
+    snap = metrics.snapshot()
+    snap["dispatches"] = spmd_guard.dispatch_count()
+    snap["compiles"] = spmd_guard.compile_count()
+    snap["trace_armed"] = recorder.armed()
+    if recorder.armed():
+        snap["events_recorded"] = recorder.events_recorded()
+        snap["events_buffered"] = recorder.size()
+    return snap

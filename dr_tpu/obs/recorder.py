@@ -1,0 +1,383 @@
+"""In-process span/event recorder — the tracing half of ``dr_tpu.obs``.
+
+One bounded ring buffer of trace events (``collections.deque`` with an
+env-capped ``maxlen`` — memory stays bounded under a 300-iteration fuzz
+crank), monotonic clocks (``time.perf_counter_ns`` for every timestamp,
+so spans survive wall-clock steps), and thread-aware nesting: each
+thread carries its own span stack (implicit parents), while
+cross-thread structure — the serving daemon's batch-flush span linking
+back to each client request's span recorded on a reader thread — uses
+EXPLICIT span ids (``begin``/``end``/``complete`` with ``parent=``,
+plus Chrome flow events via :func:`flow`).
+
+Overhead contract (docs/SPEC.md §15): with tracing OFF (the default)
+every entry point is one module-global check and allocates NOTHING —
+``span()`` returns a shared null context manager, ``begin`` returns 0,
+``event``/``complete``/``end`` return immediately, and the hot-path
+hooks in ``spmd_guard``/``faults`` stay ``None`` so the per-dispatch
+cost is one ``is not None`` test.  :func:`events_recorded` is the
+pin for that contract: a dispatch-count-style monotonic counter that
+must not move while tracing is off.
+
+Arming: :func:`install` (called at ``import dr_tpu``) arms when
+``DR_TPU_TRACE=1`` and registers the process-exit Chrome-trace export
+into ``DR_TPU_TRACE_DIR``; :func:`arm` is the programmatic switch
+(tests, the serving daemon's stats sampling does NOT need it — the
+metrics registry is always live for explicit handles).
+"""
+
+from __future__ import annotations
+
+import atexit
+import sys
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from ..utils.env import env_flag, env_int
+
+__all__ = ["armed", "arm", "install", "span", "begin", "end", "complete",
+           "event", "flow", "now", "current", "tail", "events", "size",
+           "events_recorded", "reset", "thread_names"]
+
+#: THE module-level guard — every entry point checks it first.
+_armed = False
+_installed = False
+
+_lock = threading.Lock()
+#: the bounded event ring; maxlen re-read from DR_TPU_TRACE_BUF at arm()
+_ring: deque = deque(maxlen=65536)
+#: monotonic count of events ever recorded (ring may have dropped some)
+_recorded = 0
+_next_id = 1
+#: open cross-thread spans: id -> (name, cat, tid, t0_ns, parent, attrs)
+_open: dict = {}
+#: tid -> thread name, for the exporter's metadata events
+_tid_names: dict = {}
+
+_tls = threading.local()
+
+
+def armed() -> bool:
+    return _armed
+
+
+def now() -> int:
+    """Recorder clock (perf_counter ns) when armed, else 0 — callers
+    stash it to later emit a :func:`complete` span retroactively."""
+    return time.perf_counter_ns() if _armed else 0
+
+
+def events_recorded() -> int:
+    """Monotonic count of trace events recorded in this process — the
+    tracing-off no-op pin (must not move while tracing is off), in the
+    mold of ``spmd_guard.dispatch_count``."""
+    return _recorded
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current() -> int:
+    """Span id at the top of THIS thread's span stack (0 = none)."""
+    st = getattr(_tls, "stack", None)
+    return st[-1][0] if st else 0
+
+
+def _alloc_id() -> int:
+    global _next_id
+    with _lock:
+        sid = _next_id
+        _next_id += 1
+    return sid
+
+
+def _tid() -> int:
+    t = threading.get_ident()
+    if t not in _tid_names:
+        _tid_names[t] = threading.current_thread().name
+    return t
+
+
+def _record(ev: dict) -> None:
+    # the ONE choke point onto the ring, and the backstop for the
+    # tracing-off no-op pin: a span begun while armed whose end()/
+    # __exit__ lands after a disarm (an in-flight serve request across
+    # the test fixture teardown) must not move the counter or the ring
+    if not _armed:
+        return
+    global _recorded
+    with _lock:
+        _recorded += 1
+        _ring.append(ev)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op context manager handed out while tracing is off —
+    no per-call allocation on the disarmed path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class Span:
+    """An armed context-manager span: nested via the thread-local
+    stack (implicit parent), recorded as one completed ("X") event on
+    exit.  ``set(**attrs)`` adds attributes before the record."""
+
+    __slots__ = ("name", "cat", "attrs", "sid", "parent", "t0")
+
+    def __init__(self, name: str, cat: str, parent: int, attrs: dict):
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.parent = parent
+        self.sid = _alloc_id()
+        self.t0 = 0
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        if self.parent == 0:
+            self.parent = current()
+        _stack().append((self.sid, self))
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, etype, evalue, tb) -> bool:
+        t1 = time.perf_counter_ns()
+        st = _stack()
+        if st and st[-1][0] == self.sid:
+            st.pop()
+        if etype is not None:
+            self.attrs.setdefault("error", etype.__name__)
+        if self.parent:
+            self.attrs.setdefault("parent", self.parent)
+        _record({"ph": "X", "name": self.name, "cat": self.cat,
+                 "id": self.sid, "tid": _tid(),
+                 "ts": self.t0 // 1000, "dur": (t1 - self.t0) // 1000,
+                 "args": self.attrs})
+        return False
+
+
+def span(name: str, cat: str = "", parent: int = 0, **attrs):
+    """Context-manager span; returns a shared no-op when tracing is
+    off.  ``parent=0`` nests under this thread's current span."""
+    if not _armed:
+        return _NULL
+    return Span(name, cat, parent, attrs)
+
+
+def begin(name: str, cat: str = "", parent: int = 0, **attrs) -> int:
+    """Open a cross-thread span and return its id (0 when off).  The
+    span does NOT join the caller's thread stack — it is closed by
+    :func:`end` (any thread), which records the completed event."""
+    if not _armed:
+        return 0
+    sid = _alloc_id()
+    with _lock:
+        _open[sid] = (name, cat, _tid(), time.perf_counter_ns(),
+                      parent or current(), attrs)
+    return sid
+
+
+def end(sid: int, **attrs) -> None:
+    """Close a :func:`begin` span (no-op for id 0 / unknown ids — a
+    span begun before a disarm, or double-ended, must not raise)."""
+    if sid == 0:
+        return
+    with _lock:
+        entry = _open.pop(sid, None)
+    if entry is None:
+        return
+    name, cat, tid, t0, parent, a = entry
+    a.update(attrs)
+    if parent:
+        a.setdefault("parent", parent)
+    t1 = time.perf_counter_ns()
+    _record({"ph": "X", "name": name, "cat": cat, "id": sid, "tid": tid,
+             "ts": t0 // 1000, "dur": (t1 - t0) // 1000, "args": a})
+
+
+def complete(name: str, t0_ns: int, cat: str = "", parent: int = 0,
+             t1_ns: Optional[int] = None, **attrs) -> None:
+    """Record an already-elapsed span from a stashed :func:`now`
+    timestamp (the serve queue-wait shape: start time known at submit,
+    emitted at dispatch).  No-op when off or when ``t0_ns`` is 0 (the
+    value :func:`now` hands out while disarmed)."""
+    if not _armed or not t0_ns:
+        return
+    if parent:
+        attrs.setdefault("parent", parent)
+    t1 = t1_ns if t1_ns is not None else time.perf_counter_ns()
+    _record({"ph": "X", "name": name, "cat": cat, "id": _alloc_id(),
+             "tid": _tid(), "ts": t0_ns // 1000,
+             "dur": max(0, (t1 - t0_ns) // 1000), "args": attrs})
+
+
+def event(name: str, cat: str = "", **attrs) -> None:
+    """Instant event (Chrome "i" phase)."""
+    if not _armed:
+        return
+    _record({"ph": "i", "name": name, "cat": cat, "tid": _tid(),
+             "ts": time.perf_counter_ns() // 1000, "s": "t",
+             "args": attrs})
+
+
+def flow(fid: int, phase: str, name: str = "serve.request") -> None:
+    """Chrome flow event ("s" start / "f" finish) binding two slices —
+    e.g. a request span on a reader thread to the batch-flush span on
+    the dispatch thread.  ``fid`` is the linking id (use the source
+    span's id)."""
+    if not _armed or fid == 0 or phase not in ("s", "t", "f"):
+        return
+    ev = {"ph": phase, "name": name, "cat": "flow", "id": fid,
+          "tid": _tid(), "ts": time.perf_counter_ns() // 1000}
+    if phase == "f":
+        ev["bp"] = "e"  # bind to the enclosing slice
+    _record(ev)
+
+
+# ---------------------------------------------------------------------------
+# inspection
+# ---------------------------------------------------------------------------
+
+def events() -> List[dict]:
+    """Snapshot (shallow copy) of the ring's current contents."""
+    with _lock:
+        return list(_ring)
+
+
+def size() -> int:
+    """Current ring occupancy — O(1), no copy (snapshots want the
+    count without paying a full-ring materialization under the
+    lock)."""
+    with _lock:
+        return len(_ring)
+
+
+def tail(n: Optional[int] = None) -> List[dict]:
+    """The last ``n`` recorded events (default ``DR_TPU_TRACE_TAIL``,
+    40) — the postmortem classified errors attach.  islice from the
+    computed offset, NOT ``list(_ring)[-n:]``: every classified error
+    constructed while traced pays this under the recorder lock, and a
+    full-ring copy per retried transient would stall concurrent
+    event recording."""
+    if n is None:
+        n = env_int("DR_TPU_TRACE_TAIL", 40)
+    from itertools import islice
+    with _lock:
+        return list(islice(_ring, max(0, len(_ring) - n), None))
+
+
+def thread_names() -> dict:
+    return dict(_tid_names)
+
+
+def reset() -> None:
+    """Drop every recorded event and open span (tests; the monotonic
+    :func:`events_recorded` counter is NOT reset)."""
+    with _lock:
+        _ring.clear()
+        _open.clear()
+
+
+# ---------------------------------------------------------------------------
+# hooks into the hot-path modules (spmd_guard / faults)
+# ---------------------------------------------------------------------------
+
+def _key_label(key) -> str:
+    """Cheap, allocation-light label for a dispatch key: the leading
+    tag string of the conventional tuple keys, else the type name —
+    NOT repr (container-sized splice keys would be slow to format)."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return type(key).__name__
+
+
+def _on_dispatch(key) -> None:
+    event("dispatch", cat="dispatch", key=_key_label(key))
+
+
+def _on_compile(key) -> None:
+    event("compile", cat="dispatch", key=_key_label(key))
+
+
+def _on_site(site: str, ctx: dict) -> None:
+    # dispatch.cache visits are already on the trace through the
+    # spmd_guard dispatch hook — the site echo would double every entry
+    if site == "dispatch.cache":
+        return
+    event(site, cat="site",
+          **{k: str(v)[:80] for k, v in ctx.items()})
+
+
+def _on_fault(site: str, kind: str) -> None:
+    event("fault", cat="fault", site=site, kind=kind)
+
+
+def arm(on: bool = True) -> None:
+    """Flip the module guard and (un)install the spmd_guard/faults
+    hooks.  Arming re-reads ``DR_TPU_TRACE_BUF`` so tests can pin a
+    small ring; the existing contents are kept (tail-truncated)."""
+    global _armed, _ring
+    from ..utils import faults, spmd_guard
+    if on:
+        cap = env_int("DR_TPU_TRACE_BUF", 65536, floor=16)
+        with _lock:
+            if _ring.maxlen != cap:
+                _ring = deque(_ring, maxlen=cap)
+        _armed = True
+        spmd_guard._obs_dispatch_hook = _on_dispatch
+        spmd_guard._obs_compile_hook = _on_compile
+        faults._obs_site_hook = _on_site
+        faults._obs_fault_hook = _on_fault
+    else:
+        _armed = False
+        spmd_guard._obs_dispatch_hook = None
+        spmd_guard._obs_compile_hook = None
+        faults._obs_site_hook = None
+        faults._obs_fault_hook = None
+
+
+def _atexit_export() -> None:  # pragma: no cover - process teardown
+    from . import export
+    try:
+        path = export.write()
+        print(f"dr_tpu.obs: trace written to {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"dr_tpu.obs: trace export failed: {e!r}", file=sys.stderr)
+
+
+def install() -> bool:
+    """Arm from the environment (``DR_TPU_TRACE=1``) at import time and
+    register the process-exit Chrome-trace export; idempotent; returns
+    whether tracing is armed."""
+    global _installed
+    if _installed or not env_flag("DR_TPU_TRACE"):
+        return _armed
+    arm(True)
+    atexit.register(_atexit_export)
+    _installed = True
+    return True

@@ -72,7 +72,11 @@ resolution instead of returning stale numbers.
 
 Observability: :meth:`Plan.explain` / :meth:`Plan.stats` report fused
 runs, flush reasons, program-cache hits, and per-flush dispatch counts
-from the spmd_guard tap (``utils.spmd_guard.dispatch_count``).
+from the spmd_guard tap (``utils.spmd_guard.dispatch_count``).  Under
+``DR_TPU_TRACE=1`` every flush is additionally an obs span
+(``plan.flush`` with ``plan.run``/``plan.opaque`` child spans, flush
+reason and cache-hit attributes) and the plan counters land in the
+metrics registry (docs/SPEC.md §15).
 """
 
 from __future__ import annotations
@@ -92,6 +96,7 @@ from .algorithms.elementwise import (_apply_chain_ops, _chain_scalars,
                                      _op_key, _traced_op_key)
 from .algorithms.reduce import _MONOIDS, _identity_for
 from .core.pinning import pinned_id
+from . import obs as _obs
 from .utils import faults as _faults
 from .utils import spmd_guard as _guard
 from .utils.spmd_guard import TappedCache
@@ -610,6 +615,12 @@ class Plan:
             return
         queue, self._queue = self._queue, []
         self._flushing = True
+        # obs span over the whole flush (SPEC §15): begin/end rather
+        # than a context manager so the existing error bookkeeping
+        # stays untouched; sid is 0 (and every obs call a no-op) while
+        # tracing is off
+        sid = _obs.begin("plan.flush", cat="plan", reason=reason,
+                         items=len(queue))
         entry = {"reason": reason, "items": []}
         self.log.append(entry)
         d0 = _guard.dispatch_count()
@@ -619,8 +630,11 @@ class Plan:
             _faults.fire("plan.flush")
             for item in queue:
                 di = _guard.dispatch_count()
+                t0 = _obs.now()
                 if isinstance(item, _Opaque):
                     item.thunk()
+                    _obs.complete("plan.opaque", t0, cat="plan",
+                                  parent=sid, op=item.name)
                     entry["items"].append(
                         {"kind": "opaque", "name": item.name,
                          "dispatches": _guard.dispatch_count() - di})
@@ -634,6 +648,9 @@ class Plan:
                         pre_ok = all(_sanitize.is_finite(c._data)
                                      for c in item.conts)
                     hit = self._exec_run(item)
+                    _obs.complete("plan.run", t0, cat="plan",
+                                  parent=sid, ops=len(item.ops),
+                                  cache_hit=hit)
                     entry["items"].append(
                         {"kind": "fused",
                          "ops": [o.name for o in item.ops],
@@ -671,6 +688,16 @@ class Plan:
         finally:
             entry["dispatches"] = _guard.dispatch_count() - d0
             self._flushing = False
+            _obs.end(sid, dispatches=entry["dispatches"],
+                     error=bool(entry.get("error")))
+            if _obs.armed():
+                _obs.count("plan.flushes")
+                for it in entry["items"]:
+                    if it["kind"] == "fused":
+                        _obs.count("plan.fused_ops", len(it["ops"]))
+                    else:
+                        _obs.count("plan.opaque_ops")
+
     def _exec_run(self, run: _Run) -> bool:
         key = ("plan", pinned_id(run.mesh), run.axis,
                tuple((c.layout, str(c.dtype)) for c in run.conts),

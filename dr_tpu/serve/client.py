@@ -144,7 +144,17 @@ class Client:
         return self.request("ping")
 
     def stats(self) -> dict:
+        """The daemon's full stats block (one ``stats`` wire op):
+        request/reply/error counters, queue accounting, and the
+        ``obs`` metrics snapshot — the daemon-side queue-wait /
+        service / flush histograms (docs/SPEC.md §15)."""
         return self.request("stats")["stats"]
+
+    def metrics(self) -> dict:
+        """Just the parsed observability snapshot from the ``stats``
+        wire op (``stats()["obs"]``): counters, gauges, and the
+        per-request latency histograms the daemon samples."""
+        return self.stats().get("obs", {})
 
     def shutdown(self) -> dict:
         return self.request("shutdown")

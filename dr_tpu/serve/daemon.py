@@ -49,16 +49,28 @@ import os
 import socket
 import tempfile
 import threading
+import time
 import weakref
 
 import numpy as np
 
+from .. import obs as _obs
+from ..obs import metrics as _om
 from ..utils import faults as _faults
 from ..utils import resilience
 from ..utils.env import env_float, env_int, env_str
 from ..utils.fallback import warn_fallback
 from . import protocol
 from .queue import AdmissionQueue, Request
+
+#: always-live per-request latency split (dr_tpu/obs metrics, SPEC
+#: §15): queue-wait (submit → dispatch pop), service (dispatch pop →
+#: reply posted), and the shared batch-flush wall time.  Sampled on
+#: every run — the ``stats`` wire op and ``bench.py --serve`` report
+#: them next to the client-side percentiles, traced or not.
+_h_queue_wait = _om.histogram("serve.queue_wait_ms")
+_h_service = _om.histogram("serve.service_ms")
+_h_flush = _om.histogram("serve.flush_ms")
 
 __all__ = ["Server", "default_socket_path", "daemon_alive",
            "reset_state", "OPS"]
@@ -530,6 +542,13 @@ class Server:
             if spec.validate is not None:
                 spec.validate(req)
             req.conn = cs
+            # the request's obs span opens at intake (reader thread)
+            # and closes in _finish (dispatch thread); the flow start
+            # lets the exporter draw the arrow into the batch-flush
+            # span it lands in.  span stays 0 while tracing is off.
+            req.span = _obs.begin("serve.request", cat="serve", op=op,
+                                  tenant=req.tenant, rid=str(rid))
+            _obs.flow(req.span, "s")
             with self._lock:
                 cs.pending.add(req)
             self._queue.submit(req)
@@ -540,6 +559,8 @@ class Server:
             if req is not None:
                 with self._lock:
                     cs.pending.discard(req)
+                _obs.end(req.span, error=type(ce).__name__)
+                req.span = 0
             self._errors += 1
             self._send(cs, protocol.error_header(ce, id=rid))
         return True
@@ -555,6 +576,12 @@ class Server:
                 for req in dropped:
                     if req.cancelled:
                         self._queue.release(req)
+                        # no reply is owed, but the obs span opened at
+                        # intake must still close — a traced daemon
+                        # with client churn would otherwise grow the
+                        # open-span table without bound
+                        _obs.end(req.span, error="cancelled")
+                        req.span = 0
                         continue
                     self._finish(req, error=resilience.DeadlineExpired(
                         f"serve: request {req.op!r} expired after "
@@ -583,6 +610,18 @@ class Server:
         failure matrix (SPEC §14.4)."""
         import dr_tpu
         batchable = OPS[group[0].op].batchable
+        # first execution of each request: stamp the dispatch start
+        # and sample queue-wait (a degrade / poison-pill REPLAY keeps
+        # the original stamp and must not re-observe), emitting the
+        # retroactive queue-wait span under the request's span
+        t_exec = time.monotonic()
+        for req in group:
+            if req.t_exec is None:
+                req.t_exec = t_exec
+                _h_queue_wait.observe((t_exec - req.t_submit) * 1e3)
+                if req.span:
+                    _obs.complete("serve.queue_wait", req.t0_ns,
+                                  cat="serve", parent=req.span)
 
         def run():
             # the injection site fires INSIDE the retried body: a
@@ -599,11 +638,28 @@ class Server:
                     finishers.append(OPS[r.op].handler(r))
             return [f() for f in finishers]
 
+        # the shared batch-flush span: every member request's span is
+        # linked (args.links + flow finish events), so one client
+        # request's trace tree reaches the fused dispatch it rode
+        fid = _obs.begin("serve.batch_flush", cat="serve",
+                         requests=len(group), batchable=batchable,
+                         links=[r.span for r in group if r.span])
+        for r in group:
+            _obs.flow(r.span, "f")
+        t_flush = time.monotonic()
         try:
-            results = resilience.with_deadline(
-                lambda: resilience.retry(run, attempts=2, base=0.01,
-                                         seed=0),
-                self.flush_deadline, site="serve.flush", dump=False)
+            try:
+                results = resilience.with_deadline(
+                    lambda: resilience.retry(run, attempts=2, base=0.01,
+                                             seed=0),
+                    self.flush_deadline, site="serve.flush", dump=False)
+            finally:
+                # sample EVERY flush, failures and deadline overruns
+                # included — the slowest flushes are exactly the ones
+                # that fail, and excluding them would bias the
+                # reported percentiles low
+                _h_flush.observe((time.monotonic() - t_flush) * 1e3)
+                _obs.end(fid)
             self._flushes += 1
             if batchable:
                 self._batched += len(group)
@@ -677,6 +733,19 @@ class Server:
     def _finish(self, req: Request, result=None, error=None) -> None:
         self._queue.release(req)
         req.finish(result=result, error=error)
+        if req.t_exec is not None:
+            # service = dispatch start → reply posted (shed requests
+            # never executed, so they carry no service sample)
+            _h_service.observe((time.monotonic() - req.t_exec) * 1e3)
+        if req.span:
+            _obs.event("serve.reply", cat="serve", parent=req.span,
+                       rid=str(req.rid),
+                       outcome=(type(error).__name__ if error
+                                else "ok"))
+            _obs.end(req.span,
+                     **({"error": type(error).__name__} if error
+                        else {}))
+            req.span = 0
         if error is not None:
             self._errors += 1
         cs = req.conn
@@ -729,7 +798,12 @@ class Server:
                 "batched_requests": self._batched,
                 "batch_hw": self._batch_hw,
                 "restarts": self._restarts,
-                "degraded": self.degraded, **q}
+                "degraded": self.degraded,
+                # the obs metrics snapshot rides the stats wire op
+                # (SPEC §15): the daemon-side queue-wait / service /
+                # flush histograms, counters, and dispatch counts —
+                # JSON-serializable by construction
+                "obs": _obs.snapshot(), **q}
 
     def _mark_degraded(self, reason: str) -> None:
         self.degraded = reason

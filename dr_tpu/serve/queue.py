@@ -25,9 +25,17 @@ import time
 from collections import deque
 from typing import List, Optional, Tuple
 
+from ..obs import metrics as _metrics
+from ..obs import recorder as _rec
 from ..utils.resilience import ServerOverloaded
 
 __all__ = ["Request", "AdmissionQueue"]
+
+#: always-live overload/shed counters (dr_tpu/obs metrics registry) —
+#: these are request-rate events the serve ``stats`` op and
+#: ``bench.py --serve`` report on every run, traced or not
+_c_rejected = _metrics.counter("serve.rejected")
+_c_shed = _metrics.counter("serve.shed")
 
 
 class Request:
@@ -40,7 +48,8 @@ class Request:
     wakes any in-process waiter."""
 
     __slots__ = ("op", "params", "arrays", "tenant", "expiry", "conn",
-                 "rid", "cancelled", "result", "error", "_done")
+                 "rid", "cancelled", "result", "error", "_done",
+                 "t_submit", "t_exec", "t0_ns", "span")
 
     def __init__(self, op: str, params: Optional[dict], arrays,
                  tenant: str = "default",
@@ -57,6 +66,15 @@ class Request:
         self.result = None
         self.error = None
         self._done = threading.Event()
+        # observability (SPEC §15): queue-wait = dispatch start -
+        # t_submit; t_exec is set once by the dispatcher; span is the
+        # request's obs span id (0 untraced) and t0_ns the
+        # recorder-clock creation time for the retroactive
+        # queue-wait span
+        self.t_submit = time.monotonic()
+        self.t_exec = None
+        self.t0_ns = _rec.now()
+        self.span = 0
 
     def expired(self) -> bool:
         return self.expiry is not None and time.monotonic() > self.expiry
@@ -106,11 +124,13 @@ class AdmissionQueue:
         with self._cv:
             if len(self._q) >= self.depth:
                 self.rejected += 1
+                _c_rejected.add()
                 raise ServerOverloaded(
                     f"serve: queue depth cap {self.depth} reached — "
                     "back off and resubmit", site="serve.request")
             if self._inflight.get(req.tenant, 0) >= self.tenant_cap:
                 self.rejected += 1
+                _c_rejected.add()
                 raise ServerOverloaded(
                     f"serve: tenant {req.tenant!r} is at its in-flight "
                     f"cap ({self.tenant_cap})", site="serve.request")
@@ -164,6 +184,7 @@ class AdmissionQueue:
                 dropped.append(r)
                 if not r.cancelled:
                     self.shed += 1
+                    _c_shed.add()
             else:
                 live.append(r)
         return live, dropped

@@ -44,8 +44,12 @@ def warn_fallback(op: str, reason: str) -> None:
     Every call — silenced or repeated — routes through the
     ``fallback.warn`` fault-registry site first, so a chaos run counts
     materialize fallbacks (a degraded-but-correct outcome) instead of
-    losing them to the once-per-site budget."""
+    losing them to the once-per-site budget — and, when tracing is
+    armed, that fire lands each fallback as a ``site`` trace event
+    (dr_tpu/obs), with the ``fallback.warns`` counter alongside."""
     _faults.fire("fallback.warn", op=op, reason=reason)
+    from .. import obs as _obs
+    _obs.count("fallback.warns")
     key = (op, reason)
     if key in _seen:
         return

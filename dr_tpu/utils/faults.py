@@ -143,6 +143,14 @@ _counting = False
 #: hot-path gate: fire() returns immediately unless something is armed
 _armed = False
 
+#: set by dr_tpu.obs when DR_TPU_TRACE=1: every fire() visit becomes a
+#: trace event (site hook) and every TRIGGERED injection is stamped
+#: into the trace (fault hook) — an injected fault appears *in* the
+#: trace next to the dispatch it poisoned (SPEC §15).  None keeps the
+#: untraced fire() one extra ``is not None`` test.
+_obs_site_hook = None
+_obs_fault_hook = None
+
 
 def _rearm() -> None:
     global _armed
@@ -231,6 +239,8 @@ def fire(site: str, **ctx) -> Optional[str]:
     visit, and if an injection matches, raises its classified exception
     — or returns the behavioral kind string (e.g. ``"truncate"``) for
     the site to act on.  Returns None on a clean pass."""
+    if _obs_site_hook is not None:
+        _obs_site_hook(site, ctx)
     if not _armed:
         return None
     _counts[site] = _counts.get(site, 0) + 1
@@ -252,6 +262,8 @@ def fire(site: str, **ctx) -> Optional[str]:
 
 
 def _trigger(site: str, kind: str, ctx: dict) -> Optional[str]:
+    if _obs_fault_hook is not None:
+        _obs_fault_hook(site, kind)
     from . import resilience as R
     tag = f"injected fault '{kind}' at site {site}"
     if ctx:

@@ -11,8 +11,8 @@ enabled, and the comm layers guard calls on ``enabled()``).
 
 from __future__ import annotations
 
-import inspect
 import os
+import sys
 from .env import env_str
 from typing import Optional, TextIO
 
@@ -39,18 +39,34 @@ class Logger:
 
     def debug(self, fmt: str, *args, **kw) -> None:
         """debug(fmt, ...) with source-location prefix
-        (logger.hpp:13-28)."""
+        (logger.hpp:13-28).
+
+        When the tracing layer is armed (``DR_TPU_TRACE=1``), every
+        debug line ALSO lands in the obs trace as an instant event —
+        whether or not the file/stderr sink is enabled — so the two
+        debug channels cannot tell divergent stories about one run
+        (docs/SPEC.md §15)."""
+        from ..obs import recorder as _obs
+        traced = _obs._armed
+        if not self._enabled and not traced:
+            return
+        # sys._getframe beats inspect.stack(): the latter materializes
+        # FrameSummary objects (source reads included) for the WHOLE
+        # stack just to yield one filename:lineno — with tracing armed
+        # that cost would land on every debug call
+        frame = sys._getframe(1)
+        loc = (f"{os.path.basename(frame.f_code.co_filename)}:"
+               f"{frame.f_lineno}")
+        msg = fmt.format(*args, **kw) if (args or kw) else fmt
+        if traced:
+            _obs.event("log.debug", cat="log", loc=loc, msg=msg[:200])
         if not self._enabled:
             return
-        frame = inspect.stack()[1]
-        loc = f"{os.path.basename(frame.filename)}:{frame.lineno}"
-        msg = fmt.format(*args, **kw) if (args or kw) else fmt
         line = f"[{loc}] {msg}\n"
         if self._sink is not None:
             self._sink.write(line)
             self._sink.flush()
         else:
-            import sys
             sys.stderr.write("drlog " + line)
 
     def close(self) -> None:

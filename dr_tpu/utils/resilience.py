@@ -56,7 +56,8 @@ __all__ = [
     "DeviceOOM", "ProgramError", "CheckpointCorruptError",
     "DeadlineExpired", "ServerOverloaded", "classify", "classified",
     "backoff_schedule",
-    "retry", "with_deadline", "dump_dispatch_trace", "relay_listening",
+    "retry", "with_deadline", "dump_dispatch_trace", "dump_obs_tail",
+    "relay_listening",
     "dead_relay", "route_first_touch", "first_touch_or_cpu",
     "FirstTouch", "degradation_story",
 ]
@@ -66,14 +67,31 @@ __all__ = [
 # taxonomy
 # ---------------------------------------------------------------------------
 
+def _obs_tail():
+    """Last-N trace events when tracing (dr_tpu/obs) is armed — the
+    classified-error postmortem payload; None while tracing is off
+    (one module-global check, no allocation)."""
+    from ..obs import recorder as _rec
+    if not _rec._armed:
+        return None
+    return _rec.tail()
+
+
 class ResilienceError(RuntimeError):
     """Base of the classified failure taxonomy.  ``site`` names the
     injection/dispatch site that raised (empty when classified from a
-    raw backend error with no site context)."""
+    raw backend error with no site context).
+
+    ``trace_tail``: when the tracing layer is armed (``DR_TPU_TRACE=1``,
+    dr_tpu/obs) every classified error carries the last-N trace events
+    as a POSTMORTEM — the generalization of :func:`with_deadline`'s
+    dispatch-trace tail dump to every failure class (N =
+    ``DR_TPU_TRACE_TAIL``); None while tracing is off."""
 
     def __init__(self, message: str, *, site: str = ""):
         super().__init__(message)
         self.site = site
+        self.trace_tail = _obs_tail()
 
 
 class TransientBackendError(ResilienceError):
@@ -210,6 +228,11 @@ def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
                 raise ce from e
             if on_retry is not None:
                 on_retry(i, ce, delays[i])
+            from .. import obs as _obs
+            _obs.event("retry", cat="resilience", attempt=i,
+                       error=type(ce).__name__, site=ce.site,
+                       delay_s=round(delays[i], 4))
+            _obs.count("resilience.retries")
             sleep(delays[i])
 
 
@@ -239,6 +262,27 @@ def dump_dispatch_trace(file=None, limit: int = 40) -> int:
     return len(tail)
 
 
+def dump_obs_tail(file=None) -> int:
+    """Print the tail of the obs trace ring (when ``DR_TPU_TRACE=1``)
+    — the unified-trace sibling of :func:`dump_dispatch_trace`: spans,
+    site visits, and injected faults leading up to the failure.
+    Returns the number of events printed (0 while tracing is off)."""
+    tail = _obs_tail()
+    if not tail:
+        return 0
+    file = file or sys.stderr
+    print(f"resilience: last {len(tail)} obs trace event(s) before "
+          "the failure:", file=file)
+    for ev in tail:
+        args = ev.get("args") or {}
+        extra = " ".join(f"{k}={v}" for k, v in args.items())
+        dur = f" dur={ev['dur']}us" if "dur" in ev else ""
+        print(f"  [{ev.get('ts', 0)}] {ev.get('name')}"
+              f" ({ev.get('cat', '')}){dur} {extra}".rstrip(),
+              file=file)
+    return len(tail)
+
+
 def with_deadline(fn: Callable, timeout_s: float, *, site: str = "",
                   dump: bool = True, file=None):
     """Run ``fn()`` under a watchdog: its value (or its exception) when
@@ -258,8 +302,12 @@ def with_deadline(fn: Callable, timeout_s: float, *, site: str = "",
     t.start()
     t.join(timeout_s)
     if t.is_alive():
+        from .. import obs as _obs
+        _obs.event("deadline.expired", cat="resilience", site=site,
+                   timeout_s=timeout_s)
         if dump:
             dump_dispatch_trace(file)
+            dump_obs_tail(file)
         name = site or getattr(fn, "__name__", "call")
         raise DeadlineExpired(
             f"{name} exceeded its {timeout_s:.1f}s deadline "

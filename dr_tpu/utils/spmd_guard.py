@@ -291,6 +291,12 @@ _compile_hook = None
 #: dispatch recorded under an active guard (canon-portability check).
 _canon_check_hook = None
 
+#: set by dr_tpu.obs when DR_TPU_TRACE=1 — receive every tapped
+#: dispatch / cache insert (= compile) as a trace event.  None keeps
+#: the tracing-off hot path one ``is not None`` test (SPEC §15).
+_obs_dispatch_hook = None
+_obs_compile_hook = None
+
 
 def compile_count() -> int:
     """Monotonic count of tapped-cache inserts (= program compiles)."""
@@ -302,6 +308,8 @@ def _note_insert(key) -> None:
     _compiles += 1
     if _compile_hook is not None:
         _compile_hook(key)
+    if _obs_compile_hook is not None:
+        _obs_compile_hook(key)
 
 
 def note_compile(key) -> None:
@@ -320,6 +328,8 @@ def record(key) -> None:
     """Called by the shared program cache on every dispatch lookup."""
     global _dispatches
     _dispatches += 1
+    if _obs_dispatch_hook is not None:
+        _obs_dispatch_hook(key)
     if _active is not None:
         _active.record(key)
 

@@ -1,0 +1,442 @@
+"""Unified tracing & metrics layer (dr_tpu/obs — docs/SPEC.md §15).
+
+The contract under test, in order of importance:
+
+* tracing OFF is a true no-op: the hot-path hooks stay None and the
+  event counter (``obs.events_recorded`` — the dispatch-count-style
+  pin) does not move while real work dispatches;
+* span nesting is correct across the serve daemon's threads — a
+  client request's span tree links intake → queue-wait → the SHARED
+  batch-flush span → reply;
+* an injected fault (``DR_TPU_FAULT_SPEC`` included) appears IN the
+  trace with the right site, and classified errors carry the last-N
+  events as a postmortem;
+* the ring buffer caps memory under a long chain;
+* the Chrome exporter and tools/trace_view.py round-trip.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import obs, serve
+from dr_tpu.utils import faults, resilience, spmd_guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_view", os.path.join(REPO, "tools", "trace_view.py"))
+trace_view = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_view)
+
+
+@pytest.fixture
+def traced():
+    """Arm tracing for one test and leave the world disarmed+clean."""
+    obs.arm(True)
+    obs.reset()
+    yield obs
+    obs.arm(False)
+    obs.reset()
+
+
+def _vec(n=64):
+    v = dr_tpu.distributed_vector(n, np.float32)
+    dr_tpu.fill(v, 1.0)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# the overhead contract: off = no-op
+# ---------------------------------------------------------------------------
+
+def test_tracing_off_is_true_noop():
+    assert not obs.armed()
+    # the hot-path hooks must be None (one `is not None` per dispatch)
+    assert spmd_guard._obs_dispatch_hook is None
+    assert spmd_guard._obs_compile_hook is None
+    assert faults._obs_site_hook is None
+    assert faults._obs_fault_hook is None
+    e0 = obs.events_recorded()
+    v = _vec()
+    float(dr_tpu.reduce(v))
+    with dr_tpu.deferred():
+        dr_tpu.fill(v, 0.5)
+    # dispatches happened…
+    assert spmd_guard.dispatch_count() > 0
+    # …but the event counter did not move and nothing was buffered
+    assert obs.events_recorded() == e0
+    assert obs.events() == []
+    # the disarmed span is the shared null object — no per-call alloc
+    assert obs.span("x") is obs.span("y")
+    assert obs.begin("x") == 0
+    assert obs.now() == 0
+
+
+def test_span_ending_after_disarm_records_nothing():
+    """A span begun while armed whose end lands after a disarm (an
+    in-flight serve request across a fixture teardown) must not move
+    the counter or the ring — the no-op pin holds mid-flight too."""
+    obs.arm(True)
+    obs.reset()
+    sid = obs.begin("straggler")
+    with obs.span("cm-straggler") as sp:
+        obs.arm(False)
+        r0 = obs.events_recorded()
+    obs.end(sid)
+    assert obs.events_recorded() == r0
+    assert obs.events() == []
+    assert sp is not None  # it WAS an armed span when entered
+    obs.reset()
+
+
+def test_off_classified_errors_carry_no_tail():
+    err = resilience.TransientBackendError("x", site="s")
+    assert err.trace_tail is None
+
+
+# ---------------------------------------------------------------------------
+# recording basics
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_events(traced):
+    with obs.span("outer", cat="t") as sp:
+        assert obs.current() == sp.sid
+        with obs.span("inner", cat="t"):
+            obs.event("tick", cat="t", k=1)
+        sp.set(extra=2)
+    evs = obs.events()
+    names = [e["name"] for e in evs]
+    # inner closes (and records) before outer
+    assert names.index("inner") < names.index("outer")
+    outer = next(e for e in evs if e["name"] == "outer")
+    inner = next(e for e in evs if e["name"] == "inner")
+    assert inner["args"]["parent"] == outer["id"]
+    assert outer["args"]["extra"] == 2
+    tick = next(e for e in evs if e["name"] == "tick")
+    assert tick["ph"] == "i" and tick["args"]["k"] == 1
+    # spans nest in time
+    assert (outer["ts"] <= inner["ts"] and
+            inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"])
+
+
+def test_dispatch_and_compile_events_ride_the_tap(traced):
+    d0 = spmd_guard.dispatch_count()
+    v = _vec(128)
+    float(dr_tpu.reduce(v))
+    grew = spmd_guard.dispatch_count() - d0
+    assert grew > 0
+    evs = obs.events()
+    assert sum(1 for e in evs if e["name"] == "dispatch") == grew
+    # key labels are the structural tag, not a repr dump
+    labels = {e["args"]["key"] for e in evs if e["name"] == "dispatch"}
+    assert all(len(lbl) < 100 for lbl in labels)
+
+
+def test_plan_flush_span_with_runs(traced):
+    v = _vec()
+    with dr_tpu.deferred():
+        dr_tpu.fill(v, 0.5)
+        s = dr_tpu.reduce(v)
+    assert float(s) == pytest.approx(0.5 * len(v))
+    evs = obs.events()
+    # the span (ph=X) — distinct from the plan.flush SITE event the
+    # fault-registry hook also records (ph=i, cat=site)
+    flush = [e for e in evs if e["name"] == "plan.flush"
+             and e["ph"] == "X"]
+    assert flush and flush[0]["args"]["reason"] in ("region exit",
+                                                    "scalar read")
+    assert any(e["name"] == "plan.flush" and e["cat"] == "site"
+               for e in evs)
+    runs = [e for e in evs if e["name"] == "plan.run"]
+    assert runs and runs[0]["args"]["parent"] == flush[0]["id"]
+    assert runs[0]["args"]["ops"] == 2
+    snap = obs.snapshot()
+    assert snap["counters"]["plan.flushes"] >= 1
+    assert snap["counters"]["plan.fused_ops"] >= 2
+
+
+def test_log_debug_mirrors_into_trace(traced):
+    from dr_tpu.utils.logging import Logger
+    lg = Logger()  # sink disabled (no DR_TPU_LOG) — trace still gets it
+    lg.debug("hello {}", 41 + 1)
+    evs = obs.events()
+    hits = [e for e in evs if e["name"] == "log.debug"]
+    assert hits and "hello 42" in hits[0]["args"]["msg"]
+    assert hits[0]["args"]["loc"].startswith("test_obs.py:")
+
+
+# ---------------------------------------------------------------------------
+# faults in the trace + postmortems
+# ---------------------------------------------------------------------------
+
+def test_injected_fault_appears_in_trace_with_site(traced):
+    v = _vec(64)
+    with faults.injected("dispatch.cache", "transient"):
+        with pytest.raises(resilience.TransientBackendError) as ei:
+            dr_tpu.fill(v, 2.0)
+    evs = obs.events()
+    hit = [e for e in evs if e["name"] == "fault"]
+    assert hit and hit[0]["args"] == {"site": "dispatch.cache",
+                                      "kind": "transient"}
+    # the classified error carries the last-N events as a postmortem,
+    # and the injected fault is in it
+    tail = ei.value.trace_tail
+    assert tail and any(e["name"] == "fault" for e in tail)
+
+
+def test_fault_spec_env_injection_traced(traced, monkeypatch):
+    monkeypatch.setenv("DR_TPU_FAULT_SPEC", "halo.exchange:transient")
+    faults.reload_env()
+    try:
+        hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+        v = dr_tpu.distributed_vector(64, np.float32, halo=hb)
+        dr_tpu.fill(v, 1.0)
+        with pytest.raises(resilience.TransientBackendError):
+            v.halo().exchange()
+    finally:
+        monkeypatch.delenv("DR_TPU_FAULT_SPEC")
+        faults.reload_env()
+    evs = obs.events()
+    assert any(e["name"] == "fault" and
+               e["args"]["site"] == "halo.exchange" for e in evs)
+    # the clean site visits are on the trace too
+    assert any(e["name"] == "halo.exchange" and e["cat"] == "site"
+               for e in evs)
+
+
+def test_retry_events_and_counter(traced):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise resilience.TransientBackendError("UNAVAILABLE: x")
+        return 7
+
+    assert resilience.retry(flaky, attempts=3, base=0.0,
+                            sleep=lambda s: None) == 7
+    evs = obs.events()
+    retries = [e for e in evs if e["name"] == "retry"]
+    assert len(retries) == 2
+    assert retries[0]["args"]["error"] == "TransientBackendError"
+    assert obs.snapshot()["counters"]["resilience.retries"] == 2
+
+
+# ---------------------------------------------------------------------------
+# ring buffer bound
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_caps_memory(monkeypatch):
+    monkeypatch.setenv("DR_TPU_TRACE_BUF", "128")
+    obs.arm(True)  # re-reads the cap
+    try:
+        obs.reset()
+        r0 = obs.events_recorded()
+        for i in range(1000):
+            obs.event("spin", i=i)
+        assert obs.events_recorded() - r0 == 1000
+        evs = obs.events()
+        assert len(evs) == 128
+        # the ring keeps the TAIL (postmortems want the latest events)
+        assert evs[-1]["args"]["i"] == 999
+        assert obs.tail(5)[-1]["args"]["i"] == 999
+    finally:
+        obs.arm(False)
+        obs.reset()
+        monkeypatch.delenv("DR_TPU_TRACE_BUF")
+        obs.arm(True)  # restore the default cap in the module deque
+        obs.arm(False)
+
+
+# ---------------------------------------------------------------------------
+# serve: cross-thread span tree + stats wire op
+# ---------------------------------------------------------------------------
+
+def test_serve_span_tree_links_across_threads(traced, tmp_path):
+    srv = serve.Server(str(tmp_path / "d.sock"))
+    srv.start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            x = np.arange(48, dtype=np.float32)
+            np.testing.assert_allclose(c.scale(x, a=2.0), x * 2.0)
+            st = c.stats()
+    finally:
+        srv.stop()
+    evs = obs.events()
+    reqs = [e for e in evs if e["name"] == "serve.request"
+            and e["ph"] == "X"]
+    assert reqs, "request span missing"
+    rid = reqs[0]["id"]
+    # queue-wait child under the request span (recorded on the
+    # DISPATCH thread, parented across threads by explicit id)
+    qw = [e for e in evs if e["name"] == "serve.queue_wait"]
+    assert any(e["args"].get("parent") == rid for e in qw)
+    # the shared batch-flush span links back to the request
+    bf = [e for e in evs if e["name"] == "serve.batch_flush"]
+    assert any(rid in e["args"].get("links", ()) for e in bf)
+    # request/flush spans live on different threads (reader vs
+    # dispatcher), and flow start/finish events pair up per request
+    assert any(e["tid"] != reqs[0]["tid"] for e in bf)
+    assert any(e["ph"] == "s" and e["id"] == rid for e in evs)
+    assert any(e["ph"] == "f" and e["id"] == rid for e in evs)
+    # reply instant closes the tree
+    assert any(e["name"] == "serve.reply" and
+               e["args"].get("parent") == rid for e in evs)
+    # accept is on the trace through the fault-site hook
+    assert any(e["name"] == "serve.accept" and e["cat"] == "site"
+               for e in evs)
+    # the extended stats wire op carries the daemon-side histograms
+    hists = st["obs"]["histograms"]
+    for key in ("serve.queue_wait_ms", "serve.service_ms",
+                "serve.flush_ms"):
+        assert hists[key]["count"] >= 1
+        assert hists[key]["p50"] is not None
+
+
+def test_serve_cancelled_request_closes_its_span(traced, tmp_path):
+    """A client that vanishes before dispatch must not leak its open
+    request span — a traced daemon with client churn would otherwise
+    grow the open-span table without bound."""
+    from dr_tpu.obs import recorder
+    srv = serve.Server(str(tmp_path / "d.sock"))
+    srv.start()
+    srv.hold()  # park the dispatcher so the request queues
+    try:
+        c = serve.Client(srv.path, timeout=60.0)
+        c._sock.sendall(b"")  # ensure connected
+        import dr_tpu.serve.protocol as proto
+        proto.send_frame(c._sock, {"op": "fill", "params": {"n": 8},
+                                   "tenant": "ghost", "id": 1})
+        # wait until the daemon has admitted it (span opened at intake)
+        deadline = 50
+        while not recorder._open and deadline:
+            threading.Event().wait(0.02)
+            deadline -= 1
+        assert recorder._open, "request span never opened"
+        c.close()  # vanish before dispatch → cancelled
+        # give the reader thread a beat to mark it cancelled
+        threading.Event().wait(0.1)
+        srv.release()
+        deadline = 100
+        while recorder._open and deadline:
+            threading.Event().wait(0.02)
+            deadline -= 1
+    finally:
+        srv.stop()
+    assert recorder._open == {}, "cancelled request leaked its span"
+
+
+def test_serve_daemon_samples_untraced(tmp_path):
+    """The daemon-side latency histograms are ALWAYS live (bench
+    --serve reports them on every run) — tracing adds spans, not the
+    numbers."""
+    from dr_tpu.obs import metrics as om
+    h = om.histogram("serve.queue_wait_ms")
+    c0 = h.count
+    srv = serve.Server(str(tmp_path / "d.sock"))
+    srv.start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            c.fill(16, 1.0)
+            m = c.metrics()
+    finally:
+        srv.stop()
+    assert h.count > c0
+    assert m["histograms"]["serve.queue_wait_ms"]["count"] >= 1
+    assert not m["trace_armed"]
+    # …and no trace events leaked while disarmed
+    assert obs.events() == []
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry_shapes(traced):
+    from dr_tpu.obs import metrics as om
+    om.counter("t.c").add(3)
+    om.gauge("t.g").set(1.5)
+    h = om.histogram("t.h")
+    for v in (0.02, 0.2, 2.0, 20.0, 200.0):
+        h.observe(v)
+    snap = obs.snapshot()
+    assert snap["counters"]["t.c"] == 3
+    assert snap["gauges"]["t.g"] == 1.5
+    hs = snap["histograms"]["t.h"]
+    assert hs["count"] == 5 and hs["min"] == 0.02 and hs["max"] == 200.0
+    assert sum(hs["buckets"].values()) == 5
+    assert hs["p50"] == 2.0
+    # reset zeroes in place without orphaning module-held handles
+    obs.reset()
+    h.observe(1.0)
+    assert obs.snapshot()["histograms"]["t.h"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# exporter + trace_view
+# ---------------------------------------------------------------------------
+
+def test_chrome_export_and_trace_view_smoke(traced, tmp_path, capsys):
+    v = _vec()
+    with dr_tpu.deferred():
+        dr_tpu.fill(v, 0.25)
+        dr_tpu.reduce(v)
+    srv = serve.Server(str(tmp_path / "d.sock"))
+    srv.start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            c.dot(np.ones(8, np.float32), np.ones(8, np.float32))
+    finally:
+        srv.stop()
+    path = obs.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    evs = doc["traceEvents"]
+    assert any(e["ph"] == "X" for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in evs)
+    assert all("pid" in e for e in evs)
+    # the CLI summarizer parses it and prints every section
+    assert trace_view.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "spans by self-time" in out
+    assert "events by site" in out
+    assert "serve: 1 request(s)" in out
+    assert "queue-wait" in out
+
+
+def test_trace_view_rejects_garbage(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert trace_view.main([str(bad)]) == 2
+
+
+def test_trace_dir_env(monkeypatch, tmp_path, traced):
+    monkeypatch.setenv("DR_TPU_TRACE_DIR", str(tmp_path))
+    obs.event("x")
+    path = obs.export_chrome_trace()
+    assert os.path.dirname(path) == str(tmp_path)
+    assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# deadline postmortem generalization
+# ---------------------------------------------------------------------------
+
+def test_with_deadline_dumps_obs_tail(traced, capsys):
+    obs.event("marker", k="tail-me")
+    ev = threading.Event()
+    with pytest.raises(resilience.DeadlineExpired) as ei:
+        resilience.with_deadline(ev.wait, 0.05, site="test.hang")
+    ev.set()
+    err = capsys.readouterr().err
+    assert "obs trace event" in err
+    assert ei.value.trace_tail is not None
+    assert any(e["name"] == "deadline.expired"
+               for e in ei.value.trace_tail)

@@ -106,6 +106,33 @@ if [ -z "$FILTER" ]; then
     rc=1
   fi
 fi
+# TRACED arm (round 12): one crank of the plan-chain arm with the
+# unified tracing layer armed (DR_TPU_TRACE=1, docs/SPEC.md SS15) —
+# every dispatch/flush/fault rides the obs ring for the whole crank
+# (the ring-buffer cap is the memory guarantee under test), the
+# process-exit exporter writes a Chrome trace, and trace_view must
+# parse and summarize it.  Skipped when a filter narrowed the crank.
+if [ -z "$FILTER" ]; then
+  nd="tests/test_fuzz.py::test_fuzz_plan_chains"
+  TDIR=$(mktemp -d)
+  echo "=== $nd (DR_TPU_TRACE=1 DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_TRACE=1 DR_TPU_TRACE_DIR="$TDIR" DR_TPU_FUZZ_ITERS=$ITERS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd under DR_TPU_TRACE=1"
+    rc=1
+  fi
+  if ls "$TDIR"/dr_tpu_trace_*.json >/dev/null 2>&1 \
+      && python tools/trace_view.py "$TDIR"/dr_tpu_trace_*.json \
+         > /dev/null; then
+    echo "trace_view: traced-arm trace parsed OK"
+  else
+    echo "FAILED: traced arm produced no parseable trace"
+    rc=1
+  fi
+  rm -rf "$TDIR"
+fi
 # SERVE arm (round 11): chaos against a live daemon subprocess —
 # DR_TPU_CHAOS_ROUNDS > 1 expands test_serve_subprocess_chaos to the
 # full serve.* site x kind sweep (plus every in-process lifecycle

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""trace_view — terminal summarizer for dr_tpu Chrome trace files.
+
+A `DR_TPU_TRACE=1` run exports Chrome trace-event JSON (dr_tpu/obs,
+docs/SPEC.md §15).  Perfetto renders it beautifully, but a fuzz crank
+or CI log needs the story without a browser; this tool prints:
+
+* **top spans by self-time** — per span-name aggregate of duration
+  minus nested-child duration (same-thread time nesting, the Chrome
+  model), so a flush span's cost is not double-counted against the
+  runs inside it;
+* **events by site/category** — instant-event counts grouped by
+  category then name (fault-registry site visits, injected faults,
+  dispatches/compiles, log lines);
+* **per-request serve breakdown** — for each `serve.request` span,
+  queue-wait (its retroactive child span), the batch-flush span it
+  links into, and total latency, with aggregate mean/max.
+
+Usage::
+
+    python tools/trace_view.py TRACE.json [...]  [--top N]
+
+Exit status: 0 on a parseable trace (even an empty one prints a
+summary); 2 on unreadable/malformed input — the fuzz-crank traced arm
+uses this as its post-run sanity gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import List, Optional
+
+
+def load_events(path: str) -> List[dict]:
+    """Chrome trace events from ``path`` — accepts both the object
+    form (``{"traceEvents": [...]}`` — what dr_tpu/obs writes) and the
+    bare JSON-array form."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents")
+        if not isinstance(evs, list):
+            raise ValueError(f"{path}: no traceEvents array")
+        return evs
+    if isinstance(doc, list):
+        return doc
+    raise ValueError(f"{path}: not a Chrome trace document")
+
+
+def self_times(spans: List[dict]) -> dict:
+    """Per-name ``{"total": us, "self": us, "count": n}`` aggregates.
+    Self-time subtracts DIRECTLY nested same-thread child spans
+    (stack sweep over spans sorted by start, longest first on ties)."""
+    agg: dict = defaultdict(lambda: {"total": 0, "self": 0, "count": 0})
+    by_tid: dict = defaultdict(list)
+    for s in spans:
+        by_tid[s.get("tid", 0)].append(s)
+    for tid, group in by_tid.items():
+        group.sort(key=lambda s: (s.get("ts", 0), -s.get("dur", 0)))
+        stack: list = []  # (end_ts, span, child_time_accum)
+        for s in group:
+            ts, dur = s.get("ts", 0), s.get("dur", 0)
+            while stack and stack[-1][0] <= ts:
+                _close(stack, agg)
+            if stack:
+                stack[-1][2] += dur
+            stack.append([ts + dur, s, 0])
+        while stack:
+            _close(stack, agg)
+    return dict(agg)
+
+
+def _close(stack: list, agg: dict) -> None:
+    _, s, child = stack.pop()
+    a = agg[s.get("name", "?")]
+    dur = s.get("dur", 0)
+    a["total"] += dur
+    a["self"] += max(0, dur - child)
+    a["count"] += 1
+
+
+def fmt_us(us) -> str:
+    us = float(us)
+    if us >= 1e6:
+        return f"{us / 1e6:.3f} s"
+    if us >= 1e3:
+        return f"{us / 1e3:.3f} ms"
+    return f"{us:.0f} us"
+
+
+def summarize(events: List[dict], top: int = 15,
+              out=None) -> None:
+    out = out or sys.stdout
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    flows = [e for e in events if e.get("ph") in ("s", "f")]
+    print(f"trace: {len(events)} event(s) — {len(spans)} span(s), "
+          f"{len(instants)} instant(s), {len(flows)} flow(s)",
+          file=out)
+
+    # ---- top spans by self-time
+    agg = self_times(spans)
+    print(f"\ntop {min(top, len(agg))} spans by self-time:", file=out)
+    print(f"  {'name':<24} {'count':>6} {'total':>12} {'self':>12} "
+          f"{'mean':>12}", file=out)
+    for name, a in sorted(agg.items(),
+                          key=lambda kv: -kv[1]["self"])[:top]:
+        mean = a["total"] / a["count"] if a["count"] else 0
+        print(f"  {name:<24} {a['count']:>6} {fmt_us(a['total']):>12} "
+              f"{fmt_us(a['self']):>12} {fmt_us(mean):>12}", file=out)
+
+    # ---- instant events grouped by category / name
+    if instants:
+        groups: dict = defaultdict(int)
+        for e in instants:
+            groups[(e.get("cat", ""), e.get("name", "?"))] += 1
+        print("\nevents by site:", file=out)
+        for (cat, name), n in sorted(groups.items(),
+                                     key=lambda kv: (kv[0][0], -kv[1])):
+            print(f"  {cat or '-':<10} {name:<28} {n:>8}", file=out)
+
+    # ---- per-request serve latency breakdown
+    reqs = [s for s in spans if s.get("name") == "serve.request"]
+    if reqs:
+        qw_by_parent: dict = {}
+        for s in spans:
+            if s.get("name") == "serve.queue_wait":
+                p = (s.get("args") or {}).get("parent")
+                if p is not None:
+                    qw_by_parent[p] = s.get("dur", 0)
+        flush_of: dict = {}
+        for s in spans:
+            if s.get("name") == "serve.batch_flush":
+                for link in (s.get("args") or {}).get("links", []):
+                    flush_of[link] = s.get("dur", 0)
+        print(f"\nserve: {len(reqs)} request(s)", file=out)
+        print(f"  {'op':<8} {'tenant':<10} {'rid':>6} "
+              f"{'queue-wait':>12} {'flush':>12} {'total':>12} "
+              f"{'outcome':<10}", file=out)
+        tot = qws = 0
+        worst = 0
+        for s in sorted(reqs, key=lambda s: s.get("ts", 0)):
+            a = s.get("args") or {}
+            sid = s.get("id")
+            qw = qw_by_parent.get(sid, 0)
+            fl = flush_of.get(sid, 0)
+            dur = s.get("dur", 0)
+            tot += dur
+            qws += qw
+            worst = max(worst, dur)
+            print(f"  {a.get('op', '?'):<8} {a.get('tenant', '?'):<10} "
+                  f"{a.get('rid', '?'):>6} {fmt_us(qw):>12} "
+                  f"{fmt_us(fl):>12} {fmt_us(dur):>12} "
+                  f"{a.get('error', 'ok'):<10}", file=out)
+        n = len(reqs)
+        print(f"  mean total {fmt_us(tot / n)}, mean queue-wait "
+              f"{fmt_us(qws / n)}, worst {fmt_us(worst)}", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize dr_tpu Chrome trace files "
+                    "(docs/SPEC.md §15)")
+    ap.add_argument("traces", nargs="+", help="trace JSON file(s)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="span rows to show (default 15)")
+    args = ap.parse_args(argv)
+    rc = 0
+    for i, path in enumerate(args.traces):
+        if len(args.traces) > 1 or i:
+            print(f"\n=== {path} ===")
+        try:
+            events = load_events(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"trace_view: cannot read {path}: {e}",
+                  file=sys.stderr)
+            rc = 2
+            continue
+        summarize(events, top=args.top)
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `trace_view … | head` is normal usage
+        sys.exit(0)
