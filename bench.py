@@ -62,6 +62,13 @@ always on; ``--serve`` adds ``detail.serve_daemon_ms`` (queue-wait vs
 service vs batch-flush split) next to the client percentiles, and
 under ``DR_TPU_TRACE=1`` the run exports a Chrome trace
 (``detail.obs.trace_file``, Perfetto-openable; docs/SPEC.md §15).
+
+Round 13: a run whose mesh SHRANK mid-session (elastic degradation,
+docs/SPEC.md §16) is self-describing — the ``_DR_TPU_ELASTIC_*``
+markers the shrink publishes ride the re-exec environment like the
+``_DR_TPU_SERVE_*`` ones, so ``detail.degraded.shrink`` (lost ranks,
+rescued/restored/lost container counts, shrink wall time) lands in
+EVERY artifact the run emits, CPU-fallback re-exec legs included.
 """
 
 import json

@@ -22,6 +22,10 @@ namespaces through one TPU backend, called ``thp``):
 - halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
 - plans:      ``deferred`` / ``Plan`` — record algorithm chains, flush
   them as ONE fused dispatch (cross-algorithm dispatch fusion)
+- elastic:    ``redistribute`` / ``elastic.rescue_session`` — survive a
+  mid-session device loss by shrinking the mesh and rescuing live
+  state (docs/SPEC.md §16; ``DR_TPU_ELASTIC=1`` arms automatic
+  shrink-and-retry)
 """
 
 from .utils import jax_compat  # noqa: F401  (jax.shard_map shim, first)
@@ -53,6 +57,8 @@ from .containers.mdarray import (distributed_mdarray, distributed_mdspan,
 from .utils.logging import drlog
 from .utils.debug import print_range, print_matrix, range_details
 from .utils import checkpoint
+from .utils import elastic
+from .utils.elastic import redistribute
 from .utils import faults
 from .utils import profiling
 from .utils import resilience
@@ -102,7 +108,7 @@ __all__ = [
     "drlog", "print_range", "print_matrix", "range_details",
     "distributed_mdarray", "distributed_mdspan", "transpose",
     "checkpoint", "profiling", "spmd_guard", "faults", "resilience",
-    "obs",
+    "obs", "elastic", "redistribute",
     "ring_attention", "ring_attention_n",
     "dot_n", "inclusive_scan_n", "gemv_n", "spmm_n", "stencil2d_n",
     "plan", "Plan", "PlanScalar", "deferred",

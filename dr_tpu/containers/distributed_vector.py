@@ -65,12 +65,25 @@ class distributed_vector:
 
     def __init__(self, size: int, dtype=None, halo: Optional[halo_bounds] = None,
                  *, distribution=None, runtime=None, _data=None):
-        self._rt = runtime or _rt.runtime()
         self._n = int(size)
         self._dtype = _normalize_dtype(dtype)
         self._hb = halo or halo_bounds()
-        P = self._rt.nprocs
-        self._nshards = P
+        self._rebind(runtime or _rt.runtime(), distribution, _data=_data)
+
+    def _rebind(self, runtime, distribution, *, _data=None) -> None:
+        """(Re)plan the block layout onto ``runtime``'s mesh and
+        (re)allocate the sharded state.  ``__init__`` is one caller;
+        the other is the elastic layer (``utils/elastic.redistribute``
+        and the shrink rescue, docs/SPEC.md §16), which re-plans a LIVE
+        vector in place — logical size, dtype and halo bounds survive,
+        the physical layout is rebuilt for the target mesh, and the
+        value (if it should survive) is re-assigned by the caller.
+
+        Validation runs on LOCALS first and late failures (halo
+        min-size, allocation) roll the attributes back: a rejected
+        redistribute of a live vector must leave it exactly as it
+        was — a half-rebound vector would mix two layouts silently."""
+        P = runtime.nprocs
         if distribution is not None and not isinstance(distribution,
                                                        block_distribution):
             distribution = block_distribution(distribution)
@@ -83,32 +96,45 @@ class distributed_vector:
                 raise ValueError(
                     f"distribution sizes sum to {distribution.n}, "
                     f"vector size is {self._n}")
-        self._dist_entry = (distribution.layout_entry()
-                            if distribution is not None else None)
-        if isinstance(self._dist_entry, int):
-            self._dist_entry = None  # even sizes == default layout
-        if self._dist_entry is not None and self._hb.width:
+        dist_entry = (distribution.layout_entry()
+                      if distribution is not None else None)
+        if isinstance(dist_entry, int):
+            dist_entry = None  # even sizes == default layout
+        if dist_entry is not None and self._hb.width:
             raise ValueError("halo_bounds require the uniform block "
                              "distribution (the halo exchange ring assumes "
                              "equal shards)")
-        if self._dist_entry is not None:
-            sizes = np.asarray(self._dist_entry[1:], dtype=np.int64)
-            self._seg = max(int(sizes.max(initial=0)), self._hb.prev,
-                            self._hb.next, 1)
-            self._sizes = sizes
-            self._starts = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+        if dist_entry is not None:
+            sizes = np.asarray(dist_entry[1:], dtype=np.int64)
+            seg = max(int(sizes.max(initial=0)), self._hb.prev,
+                      self._hb.next, 1)
+            starts = np.concatenate(([0], np.cumsum(sizes)[:-1]))
         else:
             # segment_size = max(ceil(n/p), prev, next)   (dv.hpp:190-193)
-            self._seg = max(-(-self._n // P) if self._n else 1,
-                            self._hb.prev, self._hb.next, 1)
-            self._sizes = None
-            self._starts = None
-        if _data is not None:
-            self._data = _data
-        else:
-            self._data = _zeros(self._rt.mesh, self._rt.axis, P,
-                                self.block_width, self._dtype)
-        self._halo = span_halo(self) if self._hb.width else None
+            seg = max(-(-self._n // P) if self._n else 1,
+                      self._hb.prev, self._hb.next, 1)
+            sizes = None
+            starts = None
+        prior = {k: self.__dict__.get(k)
+                 for k in ("_rt", "_nshards", "_dist_entry", "_seg",
+                           "_sizes", "_starts", "_data", "_halo")}
+        try:
+            self._rt = runtime
+            self._nshards = P
+            self._dist_entry = dist_entry
+            self._seg = seg
+            self._sizes = sizes
+            self._starts = starts
+            if _data is not None:
+                self._data = _data
+            else:
+                self._data = _zeros(runtime.mesh, runtime.axis, P,
+                                    self.block_width, self._dtype)
+            self._halo = span_halo(self) if self._hb.width else None
+        except BaseException:
+            if prior["_rt"] is not None:  # live rebind, not __init__
+                self.__dict__.update(prior)
+            raise
         self._rt.register(self)
 
     # ------------------------------------------------------------------ meta

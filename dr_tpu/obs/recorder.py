@@ -325,9 +325,10 @@ def _on_compile(key) -> None:
 
 
 def _on_site(site: str, ctx: dict) -> None:
-    # dispatch.cache visits are already on the trace through the
-    # spmd_guard dispatch hook — the site echo would double every entry
-    if site == "dispatch.cache":
+    # dispatch.cache (and device.lost, which rides the same tap) visits
+    # are already on the trace through the spmd_guard dispatch hook —
+    # the site echo would double every entry
+    if site in ("dispatch.cache", "device.lost"):
         return
     event(site, cat="site",
           **{k: str(v)[:80] for k, v in ctx.items()})

@@ -106,6 +106,12 @@ class Runtime:
     def register(self, container) -> None:
         self._live.add(container)
 
+    def live_containers(self) -> list:
+        """Snapshot of the registered live containers — the population
+        the elastic shrink rescue walks (utils/elastic.py, SPEC §16).
+        Weak registration: only containers the user still holds appear."""
+        return list(self._live)
+
     def fence(self) -> None:
         """Block until every registered container's current value is ready.
 

@@ -98,6 +98,7 @@ from .algorithms.reduce import _MONOIDS, _identity_for
 from .core.pinning import pinned_id
 from . import obs as _obs
 from .utils import faults as _faults
+from .utils import resilience as _resilience
 from .utils import spmd_guard as _guard
 from .utils.spmd_guard import TappedCache
 from .views import views as _v
@@ -308,6 +309,28 @@ class Plan:
         self._flushing = False
         #: structured flush log consumed by explain()/stats()
         self.log: list = []
+        #: elastic replay log (SPEC §16): one (queue_item, re-record
+        #: thunk, reduce_handle|None) entry per recorded op, so a
+        #: device loss MID-FLUSH can re-record the unexecuted suffix
+        #: against the shrunken mesh — thunks re-invoke the record_*
+        #: method with the original arguments and re-read container
+        #: layouts at call time
+        self._replay: list = []
+        #: active only during an elastic replay: maps id(old pending
+        #: PlanScalar) -> its re-recorded handle, so replayed consumers
+        #: rewire onto the new run's in-program values
+        self._subst: dict = {}
+
+    def _note_replay(self, thunk, handle=None) -> None:
+        self._replay.append((self._queue[-1], thunk, handle))
+
+    def _subst_scalars(self, values):
+        """Map pending handles through the elastic replay substitution
+        (identity outside a replay)."""
+        if not self._subst:
+            return list(values)
+        return [self._subst.get(id(v), v) if isinstance(v, PlanScalar)
+                else v for v in values]
 
     # ------------------------------------------------------------ region
     @contextmanager
@@ -372,6 +395,7 @@ class Plan:
         """fill / iota over an aligned output window; the scalar is a
         traced operand (streaming values reuse one program)."""
         cont = out_chain.cont
+        value = self._subst_scalars([value])[0]
         if gkind == "fill" and not isinstance(value, PlanScalar):
             value = jnp.asarray(value, cont.dtype)  # eager fill's cast
         run = self._fusible_run(cont, [value])
@@ -391,6 +415,9 @@ class Plan:
                                     out_data)
 
         run.ops.append(_FusedOp(gkind, key, emit, spec, vals))
+        self._note_replay(
+            lambda oc=out_chain, g=gkind, v=value:
+            self.record_generator(oc, g, v))
         return True
 
     def record_transform(self, ins, out_chain, op, scalars,
@@ -399,8 +426,8 @@ class Plan:
         view-chain BoundOp scalars and trailing op scalars ride as
         traced operands."""
         cont = out_chain.cont
-        chain_sc = _chain_scalars(ins)
-        all_sc = list(chain_sc) + list(scalars)
+        chain_sc = self._subst_scalars(_chain_scalars(ins))
+        all_sc = list(chain_sc) + self._subst_scalars(scalars)
         run = self._fusible_run(cont, all_sc)
         out_slot = run.slot(cont)
         in_slots = tuple(run.slot(c.cont) for c in ins)
@@ -429,6 +456,10 @@ class Plan:
             state[out_slot] = jnp.where(mask, v, out_data)
 
         run.ops.append(_FusedOp(name, key, emit, spec, vals))
+        self._note_replay(
+            lambda i=ins, oc=out_chain, o=op, sc=tuple(scalars),
+            wi=with_index, nm=name:
+            self.record_transform(i, oc, o, sc, wi, nm))
         return True
 
     def record_zip_foreach(self, ins, outs, fn, scalars) -> bool:
@@ -436,7 +467,8 @@ class Plan:
         shape).  Zip components are outputs, so their chains carry no
         ops (the invariant the eager program asserts)."""
         conts = [oc.cont for oc in outs]
-        run = self._fusible_run(conts[0], list(scalars))
+        scalars = self._subst_scalars(scalars)
+        run = self._fusible_run(conts[0], scalars)
         out_slots = tuple(run.slot(c) for c in conts)
         in_slots = tuple(run.slot(ch.cont) for ch in ins)
         spec, vals = self._scalar_spec(run, list(scalars))
@@ -454,6 +486,9 @@ class Plan:
                                      state[s])
 
         run.ops.append(_FusedOp("for_each(zip)", key, emit, spec, vals))
+        self._note_replay(
+            lambda i=ins, o=outs, f=fn, sc=tuple(scalars):
+            self.record_zip_foreach(i, o, f, sc))
         return True
 
     def record_reduce(self, chains, kind: str, zip_op=None) -> PlanScalar:
@@ -462,8 +497,9 @@ class Plan:
         output riding the carry — no mid-chain sync."""
         c0 = chains[0]
         cont = c0.cont
-        chain_sc = _chain_scalars(chains)
-        zsc = list(zip_op.scalars) if isinstance(zip_op, _v.BoundOp) else []
+        chain_sc = self._subst_scalars(_chain_scalars(chains))
+        zsc = self._subst_scalars(zip_op.scalars) \
+            if isinstance(zip_op, _v.BoundOp) else []
         all_sc = list(chain_sc) + zsc
         run = self._fusible_run(cont, all_sc)
         slots = tuple(run.slot(c.cont) for c in chains)
@@ -496,6 +532,9 @@ class Plan:
         handle = PlanScalar(self, run, len(run.handles))
         run.handles.append(handle)
         run.ops.append(_FusedOp("reduce", key, emit, spec, vals))
+        self._note_replay(
+            lambda ch=chains, k=kind, z=zip_op:
+            self.record_reduce(ch, k, z), handle)
         return handle
 
     def record_splice(self, out_chain, values) -> bool:
@@ -532,6 +571,8 @@ class Plan:
             state[slot] = jnp.where(owned, new, jnp.zeros((), dtype))
 
         run.ops.append(_FusedOp("copy(host)", key, emit, spec, vals))
+        self._note_replay(
+            lambda oc=out_chain, v=values: self.record_splice(oc, v))
         return True
 
     def record_halo(self, dv, kind: str, op=None, iters: int = 1) -> bool:
@@ -564,6 +605,9 @@ class Plan:
             state[slot] = shm(state[slot])
 
         run.ops.append(_FusedOp(f"halo.{kind}", key, emit))
+        self._note_replay(
+            lambda d=dv, k=kind, o=op, it=iters:
+            self.record_halo(d, k, o, it))
         return True
 
     def record_stencil(self, in_cont, out_cont, layout, periodic,
@@ -586,12 +630,21 @@ class Plan:
             state[so] = shm(state[si], state[so])
 
         run.ops.append(_FusedOp("stencil", key, emit))
+        # the replay thunk re-derives layout/axis/mesh from the LIVE
+        # container (the recorded values would resurrect the dead mesh)
+        self._note_replay(
+            lambda ic=in_cont, oc=out_cont, per=periodic, pv=prev,
+            nx=nxt, ko=key_op, bo=body_op:
+            self.record_stencil(ic, oc, ic.layout, per, pv, nx, ko, bo,
+                                ic.runtime.axis, ic.runtime.mesh))
         return True
 
     def record_opaque(self, name: str, thunk) -> bool:
         """Record a deferred-but-not-fused op (its eager path runs at
         flush, in record order); it closes the current fusible run."""
         self._queue.append(_Opaque(name, thunk))
+        self._note_replay(
+            lambda n=name, t=thunk: self.record_opaque(n, t))
         return True
 
     def nonfusible(self, what: str) -> None:
@@ -614,6 +667,7 @@ class Plan:
         if self._flushing or not self._queue:
             return
         queue, self._queue = self._queue, []
+        replay, self._replay = self._replay, []
         self._flushing = True
         # obs span over the whole flush (SPEC §15): begin/end rather
         # than a context manager so the existing error bookkeeping
@@ -624,11 +678,12 @@ class Plan:
         entry = {"reason": reason, "items": []}
         self.log.append(entry)
         d0 = _guard.dispatch_count()
+        idx = 0
         try:
             # the injection site fires BEFORE any dispatch: a faulted
             # flush executes nothing and containers stay consistent
             _faults.fire("plan.flush")
-            for item in queue:
+            for idx, item in enumerate(queue):
                 di = _guard.dispatch_count()
                 t0 = _obs.now()
                 if isinstance(item, _Opaque):
@@ -676,13 +731,31 @@ class Plan:
                                 _sanitize.check_finite(
                                     h._val,
                                     f"posted scalar (fused run {ops})")
+        except _resilience.DeviceLostError as de:
+            # elastic recovery (SPEC §16): shrink, re-record the
+            # UNEXECUTED suffix against the rescued containers, flush
+            # again.  The failed item never rebound its containers
+            # (_exec_run rebinds only after the program returns; the
+            # fault sites fire before dispatch), so the suffix replays
+            # from consistent pre-fault state.
+            self._flushing = False
+            try:
+                recovered = self._elastic_recover(queue[idx:], replay,
+                                                  de, entry)
+            except BaseException:
+                # the replay itself died (a lost container under a
+                # replayed op, a second loss past the shrink floor):
+                # same cleanup as an unrecovered flush, new classified
+                # cause
+                self._break_handles(queue)
+                entry["error"] = True
+                raise
+            if not recovered:
+                self._break_handles(queue)
+                entry["error"] = True
+                raise
         except BaseException:
-            for item in queue:
-                if isinstance(item, _Run):
-                    for h in item.handles:
-                        if h._val is None:
-                            h._broken = True
-                            h._run = None
+            self._break_handles(queue)
             entry["error"] = True
             raise
         finally:
@@ -697,6 +770,54 @@ class Plan:
                         _obs.count("plan.fused_ops", len(it["ops"]))
                     else:
                         _obs.count("plan.opaque_ops")
+
+    @staticmethod
+    def _break_handles(queue) -> None:
+        """Break every still-pending handle of a dropped queue —
+        resolving one raises instead of returning a stale number."""
+        for item in queue:
+            if isinstance(item, _Run):
+                for h in item.handles:
+                    if h._val is None:
+                        h._broken = True
+                        h._run = None
+
+    def _elastic_recover(self, suffix, replay, err, entry) -> bool:
+        """Device loss MID-FLUSH (docs/SPEC.md §16): shrink the mesh
+        (``utils.elastic``), RE-RECORD the unexecuted queue suffix, and
+        flush again.  The replay thunks re-invoke the original record_*
+        calls against the rescued containers, re-reading layouts and
+        meshes at call time — the fresh mesh re-keys every program, so
+        spmd_guard sees a fresh canonical digest, and pending reduce
+        handles re-link onto the new recording's values.  False when no
+        rescue is possible (elastic off, shrink floor, nested loss):
+        the caller then drops the queue classified — exactly the
+        pre-elastic faulted-flush contract."""
+        from .utils import elastic as _elastic
+        if not (_elastic.enabled() and _elastic.try_rescue(err)):
+            return False
+        suffix_ids = {id(it) for it in suffix}
+        links = []
+        replayed = 0
+        self._subst = {}
+        try:
+            for item, thunk, old_h in replay:
+                if id(item) not in suffix_ids:
+                    continue
+                new = thunk()
+                replayed += 1
+                if old_h is not None and isinstance(new, PlanScalar):
+                    self._subst[id(old_h)] = new
+                    links.append((old_h, new))
+        finally:
+            self._subst = {}
+        entry["elastic_replayed"] = replayed
+        self.flush("elastic replay")
+        for old_h, new_h in links:
+            old_h._val = new_h._val
+            old_h._run = None
+            old_h._broken = new_h._val is None
+        return True
 
     def _exec_run(self, run: _Run) -> bool:
         key = ("plan", pinned_id(run.mesh), run.axis,
@@ -777,6 +898,7 @@ class Plan:
         """Drop every pending item without executing it; pending
         handles break (resolving them raises instead of lying)."""
         queue, self._queue = self._queue, []
+        self._replay = []
         for item in queue:
             if isinstance(item, _Run):
                 for h in item.handles:

@@ -13,6 +13,17 @@ as a CLASSIFIED taxonomy error:
 * a serialized daemon error → re-raised as the class the daemon
   caught (``ServerOverloaded``, ``DeadlineExpired``, ``DeviceOOM``,
   ``ProgramError``, …) via ``protocol.raise_error``.
+
+Retry policy (``retries`` / ``DR_TPU_SERVE_CLIENT_RETRIES``, SPEC
+§14.6): with more than one attempt armed, transient failures and
+``ServerOverloaded`` rejections resubmit through the shared
+seeded-backoff ``resilience.retry`` — bounded attempts, deadline-aware
+(a retry that would land past the request's ``deadline_s`` is not
+taken), reconnecting first when the failure invalidated the
+connection.  The default is ONE attempt: an overload rejection is
+information the caller may want to act on, so backoff is opt-in.
+``RelayDownError`` (nothing listening) never retries — that is the
+router's degrade signal, not a blip.
 """
 
 from __future__ import annotations
@@ -23,7 +34,7 @@ from typing import Optional
 import numpy as np
 
 from ..utils import resilience
-from ..utils.env import env_float
+from ..utils.env import env_float, env_int
 from . import protocol
 
 __all__ = ["Client"]
@@ -38,25 +49,37 @@ class Client:
 
     def __init__(self, path: Optional[str] = None, *,
                  timeout: Optional[float] = None,
-                 tenant: str = "default"):
+                 tenant: str = "default",
+                 retries: Optional[int] = None):
         from .daemon import default_socket_path
         self.path = path or default_socket_path()
         self.tenant = tenant
+        self.retries = max(1, env_int("DR_TPU_SERVE_CLIENT_RETRIES", 1)
+                           if retries is None else int(retries))
         self._next_id = 0
+        self._timeout = (env_float("DR_TPU_SERVE_DEADLINE", 30.0) + 10.0
+                         if timeout is None else timeout)
+        self._sock = None
+        self._connect()
+
+    def _connect(self) -> None:
+        """(Re)open the daemon connection; classified on failure.  A
+        refused/absent socket is ``RelayDownError`` — the daemon is
+        this client's relay, and retrying a dead one burns budget."""
         self._broken = None  # set to a reason once the conn desyncs
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(
-            env_float("DR_TPU_SERVE_DEADLINE", 30.0) + 10.0
-            if timeout is None else timeout)
+        self._sock.settimeout(self._timeout)
         try:
             self._sock.connect(self.path)
         except (ConnectionRefusedError, FileNotFoundError) as e:
             self._sock.close()
+            self._sock = None
             raise resilience.RelayDownError(
                 f"serve: no daemon listening at {self.path} ({e!r})",
                 site="serve.request")
         except OSError as e:
             self._sock.close()
+            self._sock = None
             raise resilience.classified(
                 f"serve: cannot connect to {self.path}: {e!r}",
                 site="serve.request")
@@ -69,6 +92,8 @@ class Client:
         self.close()
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:  # pragma: no cover - already closed
@@ -87,12 +112,35 @@ class Client:
 
         A timeout INVALIDATES the connection: the daemon's late reply
         would otherwise desynchronize the stream (the next request
-        would read it as its own answer) — reconnect with a fresh
-        Client to resubmit."""
+        would read it as its own answer).  With ``retries`` armed the
+        policy reconnects and resubmits through ``resilience.retry``
+        (seeded backoff, overloads included, deadline-aware); at the
+        default single attempt, reconnect with a fresh Client."""
+        if self.retries <= 1:
+            return self._request_once(op, arrays, params,
+                                      deadline_s=deadline_s,
+                                      tenant=tenant)
+
+        def attempt():
+            if self._broken or self._sock is None:
+                self._connect()  # RelayDownError here is final: no
+                # daemon means resubmission cannot land
+            return self._request_once(op, arrays, params,
+                                      deadline_s=deadline_s,
+                                      tenant=tenant)
+
+        return resilience.retry(
+            attempt, attempts=self.retries,
+            retry_on=(resilience.TransientBackendError,
+                      resilience.ServerOverloaded),
+            deadline_s=deadline_s)
+
+    def _request_once(self, op, arrays=(), params=None, *,
+                      deadline_s=None, tenant=None):
         if self._broken:
             raise resilience.TransientBackendError(
-                f"serve: connection invalidated ({self._broken}); open "
-                "a fresh Client", site="serve.request")
+                f"serve: connection invalidated ({self._broken}); "
+                "reconnect to resubmit", site="serve.request")
         self._next_id += 1
         rid = self._next_id
         header = {"op": op, "params": params or {},

@@ -38,6 +38,12 @@ Robustness contract (chaos-swept via the ``serve.accept`` /
   the flush watchdog) degrades the daemon to the CPU route through
   ``resilience.route_first_touch`` and publishes the serve markers
   ``degradation_story`` folds into ``detail.degraded``;
+* a DEVICE death mid-batch (DeviceLostError, ``DR_TPU_ELASTIC=1``)
+  shrinks the resident claim to the surviving mesh through the
+  elastic layer (utils/elastic.py, SPEC §16) — the retry leg replays
+  the batch on the shrunken mesh, handlers rebuild their containers,
+  and no client is dropped; the shrink lands in ``stats()["shrinks"]``
+  and the degradation story's ``shrink`` chapter;
 * a stale socket file from a dead daemon is taken over at start; a
   LIVE daemon makes a second ``start()`` fail with a classified error
   before the newcomer can race the claim.
@@ -56,6 +62,7 @@ import numpy as np
 
 from .. import obs as _obs
 from ..obs import metrics as _om
+from ..utils import elastic as _elastic
 from ..utils import faults as _faults
 from ..utils import resilience
 from ..utils.env import env_float, env_int, env_str
@@ -308,6 +315,7 @@ class Server:
         self._batched = 0
         self._batch_hw = 0
         self._restarts = 0
+        self._shrinks = 0
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Server":
@@ -647,6 +655,13 @@ class Server:
         for r in group:
             _obs.flow(r.span, "f")
         t_flush = time.monotonic()
+        # a DeviceLostError inside the retried body triggers the
+        # elastic shrink (resilience.retry, DR_TPU_ELASTIC=1; SPEC
+        # §16): the batch REPLAYS on the shrunken mesh — handlers
+        # rebuild their containers — and no client is dropped.  The
+        # counter diff below turns a mid-batch shrink into the serve
+        # chapter of the degradation story.
+        shrinks0 = _elastic.shrink_count()
         try:
             try:
                 results = resilience.with_deadline(
@@ -660,6 +675,21 @@ class Server:
                 # reported percentiles low
                 _h_flush.observe((time.monotonic() - t_flush) * 1e3)
                 _obs.end(fid)
+                # shrink detection lives HERE, not on the success
+                # path: a shrink whose REPLAY then fails (deadline,
+                # deterministic error) still changed the resident
+                # claim and must land in stats/markers — and the
+                # recursive replay paths below each re-sample, so a
+                # shrink is counted exactly once
+                shrunk = _elastic.shrink_count() - shrinks0
+                if shrunk:
+                    import dr_tpu
+                    self._shrinks += shrunk
+                    self.devices = dr_tpu.devices()
+                    self._mark_degraded(
+                        f"serve: device loss mid-batch; resident "
+                        f"claim degraded to the {dr_tpu.nprocs()}"
+                        "-device shrunken mesh")
             self._flushes += 1
             if batchable:
                 self._batched += len(group)
@@ -798,6 +828,7 @@ class Server:
                 "batched_requests": self._batched,
                 "batch_hw": self._batch_hw,
                 "restarts": self._restarts,
+                "shrinks": self._shrinks,
                 "degraded": self.degraded,
                 # the obs metrics snapshot rides the stats wire op
                 # (SPEC §15): the daemon-side queue-wait / service /

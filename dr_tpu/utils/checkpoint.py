@@ -35,7 +35,8 @@ import numpy as np
 from . import faults as _faults
 from .resilience import CheckpointCorruptError
 
-__all__ = ["save", "load", "FORMAT_VERSION"]
+__all__ = ["save", "load", "read", "snapshot", "rebuild",
+           "FORMAT_VERSION"]
 
 #: bump on any incompatible meta/arrays layout change; load() accepts
 #: anything <= this (absent = 0, the pre-versioned round-6 format).
@@ -96,8 +97,11 @@ def _write_atomic(final: str, meta: dict, arrays: dict) -> None:
         raise
 
 
-def save(path: str, container) -> None:
-    import jax
+def snapshot(container):
+    """Host-staged ``(meta, arrays)`` state capture — the shared front
+    half of :func:`save` and the elastic rescue path
+    (utils/elastic.py, docs/SPEC.md §16).  Materialization gathers, so
+    in multi-process runs every process must call it collectively."""
     from ..containers.distributed_vector import distributed_vector
     from ..containers.dense_matrix import dense_matrix
     from ..containers.sparse_matrix import sparse_matrix
@@ -135,6 +139,12 @@ def save(path: str, container) -> None:
     else:
         raise TypeError(f"cannot checkpoint {type(container).__name__}")
     meta["format_version"] = FORMAT_VERSION
+    return meta, arrays
+
+
+def save(path: str, container) -> None:
+    import jax
+    meta, arrays = snapshot(container)
 
     err = None
     if jax.process_index() == 0:
@@ -156,15 +166,77 @@ def save(path: str, container) -> None:
                 "checkpoint save failed on process 0; see its log")
     if err is not None:
         raise err
+    # a durable checkpoint is the elastic restore source (SPEC §16):
+    # a container whose segments die with a device restores from the
+    # last path saved here — registered on every process (load is
+    # collective, so every survivor can rebuild)
+    from . import elastic
+    elastic.note_checkpoint(container, _final_path(path))
 
 
-def load(path: str, *, runtime=None):
+#: archive members each kind carries beyond ``meta`` (pre-read by
+#: load() so member corruption classifies before rebuild runs)
+_ARRAY_MEMBERS = {
+    "vector": ("data",),
+    "dense_matrix": ("data",),
+    "mdarray": ("data",),
+    "sparse_matrix": ("rows", "cols", "vals"),
+}
+
+
+def rebuild(meta, arrays, *, runtime=None, reblock=False):
+    """Reconstruct a container from a ``(meta, arrays)`` snapshot —
+    the shared back half of :func:`load` and the elastic
+    rescue/restore path (utils/elastic.py, docs/SPEC.md §16).
+
+    ``reblock=True`` drops mesh-shape constraints (a vector's explicit
+    block distribution) so state restores onto a DIFFERENT-sized mesh
+    with the default even block layout — what a shrink rescue needs;
+    plain loads keep the strict-mismatch errors."""
     from ..containers.distributed_vector import distributed_vector
     from ..containers.dense_matrix import dense_matrix
     from ..containers.sparse_matrix import sparse_matrix
     from ..containers.mdarray import distributed_mdarray
     from ..parallel.halo import halo_bounds
 
+    kind = meta["kind"]
+    if kind == "vector":
+        prev, nxt, periodic = meta["halo"]
+        hb = halo_bounds(int(prev), int(nxt), bool(periodic)) \
+            if (prev or nxt) else None
+        sizes = None if reblock else meta.get("sizes")
+        if sizes is not None:
+            from ..parallel import runtime as _rt
+            P = (runtime or _rt.runtime()).nprocs
+            if len(sizes) != P:
+                raise ValueError(
+                    f"checkpointed block_distribution has "
+                    f"{len(sizes)} blocks but the current mesh "
+                    f"has {P} shards; re-save without an "
+                    "explicit distribution to re-block on load")
+        return distributed_vector.from_array(
+            arrays["data"], halo=hb, distribution=sizes,
+            runtime=runtime)
+    if kind == "dense_matrix":
+        part = _matrix_partition(meta, runtime, cyclic_ok=True)
+        return dense_matrix.from_array(arrays["data"], part,
+                                       runtime=runtime)
+    if kind == "mdarray":
+        return distributed_mdarray.from_array(arrays["data"],
+                                              runtime=runtime)
+    if kind == "sparse_matrix":
+        part = _matrix_partition(meta, runtime, cyclic_ok=False)
+        return sparse_matrix.from_coo(
+            tuple(meta["shape"]), arrays["rows"], arrays["cols"],
+            arrays["vals"], partition=part, runtime=runtime)
+    raise ValueError(f"unknown checkpoint kind: {kind}")
+
+
+def read(path: str):
+    """Read a checkpoint's raw ``(meta, arrays)`` snapshot WITHOUT
+    rebuilding a container — the elastic per-segment restore merges
+    checkpointed values for dead segments with live survivor state
+    (SPEC §16).  Same classification contract as :func:`load`."""
     fname = _final_path(path)
     _faults.fire("checkpoint.read", path=fname)
     try:
@@ -188,44 +260,20 @@ def load(path: str, *, runtime=None):
                 f"checkpoint {fname} written by a newer dr_tpu "
                 f"(format_version={version} > {FORMAT_VERSION}); "
                 "upgrade to load it", site="checkpoint.read")
-        try:
-            if kind == "vector":
-                prev, nxt, periodic = meta["halo"]
-                hb = halo_bounds(int(prev), int(nxt), bool(periodic)) \
-                    if (prev or nxt) else None
-                sizes = meta.get("sizes")
-                if sizes is not None:
-                    from ..parallel import runtime as _rt
-                    P = (runtime or _rt.runtime()).nprocs
-                    if len(sizes) != P:
-                        raise ValueError(
-                            f"checkpointed block_distribution has "
-                            f"{len(sizes)} blocks but the current mesh "
-                            f"has {P} shards; re-save without an "
-                            "explicit distribution to re-block on load")
-                return distributed_vector.from_array(
-                    _member(f, fname, "data"), halo=hb,
-                    distribution=sizes, runtime=runtime)
-            if kind == "dense_matrix":
-                part = _matrix_partition(meta, runtime, cyclic_ok=True)
-                return dense_matrix.from_array(
-                    _member(f, fname, "data"), part, runtime=runtime)
-            if kind == "mdarray":
-                return distributed_mdarray.from_array(
-                    _member(f, fname, "data"), runtime=runtime)
-            if kind == "sparse_matrix":
-                part = _matrix_partition(meta, runtime, cyclic_ok=False)
-                return sparse_matrix.from_coo(
-                    tuple(meta["shape"]), _member(f, fname, "rows"),
-                    _member(f, fname, "cols"), _member(f, fname, "vals"),
-                    partition=part, runtime=runtime)
-        except (zipfile.BadZipFile, zlib.error, EOFError) as e:
-            # the archive opened but a member is torn (a non-atomic
-            # writer's legacy, or the injected 'truncate' kind)
-            raise CheckpointCorruptError(
-                f"checkpoint {fname} is truncated/corrupt: {e}",
-                site="checkpoint.read") from e
-    raise ValueError(f"unknown checkpoint kind: {kind}")
+        if kind not in _ARRAY_MEMBERS:
+            raise ValueError(f"unknown checkpoint kind: {kind}")
+        # pre-read every member INSIDE the archive context: a torn
+        # member (a non-atomic writer's legacy, or the injected
+        # 'truncate' kind) classifies through _member before rebuild
+        # touches the mesh
+        arrays = {name: _member(f, fname, name)
+                  for name in _ARRAY_MEMBERS[kind]}
+    return meta, arrays
+
+
+def load(path: str, *, runtime=None, reblock=False):
+    meta, arrays = read(path)
+    return rebuild(meta, arrays, runtime=runtime, reblock=reblock)
 
 
 def _matrix_partition(meta, runtime, *, cyclic_ok):

@@ -1,0 +1,569 @@
+"""Elastic mesh degradation: survive device loss by SHRINKING the mesh
+and rescuing live state (docs/SPEC.md §16).
+
+The failure model (§10) classifies faults and routes the FIRST backend
+touch, but before this module a device or host dying mid-session still
+killed the job: every live container, deferred plan, and serve claim
+died with it.  ROADMAP item 5 names the goal — "the degradation router
+extended so a lost host downgrades the mesh instead of the job" — and
+the re-placement recipe comes from the array-redistribution literature
+(arXiv:2112.01075: any src→dst sharding change decomposes into
+portable collective steps) plus Mesh-TensorFlow's topology-aware
+layouts (arXiv:1811.02084).  This module is the session-level recovery
+manager:
+
+* **Detection** — a classified
+  :class:`~.resilience.DeviceLostError`: raised by the ``device.lost``
+  fault site (riding every TappedCache dispatch tap, so a device can
+  die mid-eager-op, mid-plan-flush, or mid-serve-batch), by
+  :func:`~.resilience.classify` on raw backend device-loss text, or by
+  :func:`attribute` pinning a collective failure on a mesh rank.
+* **Shrink** — :func:`rescue_session` computes the surviving-device
+  mesh, rebuilds the global :class:`~..parallel.runtime.Runtime` on
+  it, and walks the old runtime's live containers applying the
+  rescue/restore/lost matrix:
+
+  ========  =====================================================
+  fate      when / how
+  ========  =====================================================
+  rescued   no segment lived on a lost rank: state moves through
+            :func:`redistribute` (host-staged gather/scatter v1 —
+            the API is the contract; the collective lowering is
+            ROADMAP item 2's follow-on) onto the shrunken mesh,
+            bit-equal to the pre-fault value
+  restored  segments died with the device but the container has a
+            durable atomic checkpoint (utils/checkpoint.save
+            registers every successful write here): reloaded onto
+            the new mesh with ``reblock=True``
+  lost      segments died and no checkpoint exists: the container
+            is POISONED — any further use raises a classified
+            ``DeviceLostError`` naming the loss, never a silent
+            wrong answer
+  ========  =====================================================
+
+* **Automatic hooks** — armed by ``DR_TPU_ELASTIC=1``:
+  :func:`~.resilience.retry` turns a ``DeviceLostError`` into
+  shrink-and-retry (the serve daemon's batch dispatch already runs
+  under it, so a resident claim degrades to the shrunken mesh without
+  dropping clients); ``plan.flush`` re-records its unexecuted queue
+  against the new mesh and re-flushes (the fresh mesh re-keys every
+  program, so spmd_guard sees a fresh canonical digest).
+  :func:`rescue_session` itself always works when called explicitly —
+  the flag gates only the automatic recovery.
+
+Every shrink publishes ``_DR_TPU_ELASTIC_*`` env markers;
+``resilience.degradation_story`` folds them into the ``shrink``
+chapter of ``detail.degraded`` (they ride re-exec environments like
+the serve markers), and obs records a ``mesh.shrink`` span with the
+device-loss event inside it.  ``DR_TPU_ELASTIC_MIN_DEVICES`` floors
+the shrink — below it the rescue refuses classified.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import weakref
+
+import numpy as np
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from . import faults as _faults
+from . import resilience as _resilience
+from .env import env_flag, env_int
+from .fallback import warn_fallback
+
+__all__ = ["enabled", "redistribute", "rescue_session", "try_rescue",
+           "attribute", "ShrinkReport", "note_checkpoint",
+           "checkpoint_path", "shrink_count", "last_report", "is_lost",
+           "reset", "MARKERS"]
+
+#: env markers the shrink publishes for resilience.degradation_story
+MARKERS = ("_DR_TPU_ELASTIC_REASON", "_DR_TPU_ELASTIC_SHRINKS",
+           "_DR_TPU_ELASTIC_LOST_RANKS", "_DR_TPU_ELASTIC_RESCUED",
+           "_DR_TPU_ELASTIC_RESTORED", "_DR_TPU_ELASTIC_LOST",
+           "_DR_TPU_ELASTIC_NPROCS", "_DR_TPU_ELASTIC_WALL_S")
+
+#: id(container) -> (weakref, checkpoint path); ids are recycled, so
+#: the weakref is the liveness check (a dead ref invalidates the row)
+_ckpts: dict = {}
+
+_shrinks = 0
+_rescued = 0
+_restored = 0
+_lost = 0
+_wall_s = 0.0
+_last_report: Optional["ShrinkReport"] = None
+#: reentrancy latch: a device "dying" during an active rescue must not
+#: recurse into a second shrink under the first one's feet
+_rescuing = False
+
+
+def enabled() -> bool:
+    """True when ``DR_TPU_ELASTIC=1`` arms the AUTOMATIC recovery
+    hooks (retry / plan flush / serve batch).  Explicit
+    :func:`rescue_session` calls work either way."""
+    return env_flag("DR_TPU_ELASTIC")
+
+
+def shrink_count() -> int:
+    """Completed shrinks this process (the serve daemon diffs it to
+    notice a mid-batch shrink)."""
+    return _shrinks
+
+
+def last_report() -> Optional["ShrinkReport"]:
+    return _last_report
+
+
+@dataclass
+class ShrinkReport:
+    """One completed shrink: what died, what survived, what it cost."""
+
+    reason: str
+    lost_ranks: List[int]
+    nprocs_before: int
+    nprocs_after: int
+    rescued: int = 0
+    restored: int = 0
+    lost: int = 0
+    wall_s: float = 0.0
+    #: container fates for postmortems: (kind, repr, detail)
+    fates: list = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint registry (restore source)
+# ---------------------------------------------------------------------------
+
+def note_checkpoint(container, path: str) -> None:
+    """Record ``path`` as ``container``'s durable restore source —
+    ``utils.checkpoint.save`` calls this after every successful atomic
+    write, so a later shrink can restore segments that died with a
+    device.  A death callback prunes the row when the container is
+    collected (guarded against id reuse by a newer registration), so
+    a long-lived daemon checkpointing short-lived containers does not
+    grow the registry without bound."""
+    key = id(container)
+
+    def _drop(ref, _key=key):
+        row = _ckpts.get(_key)
+        if row is not None and row[0] is ref:
+            _ckpts.pop(_key, None)
+
+    _ckpts[key] = (weakref.ref(container, _drop), str(path))
+
+
+def checkpoint_path(container) -> Optional[str]:
+    """The last checkpoint registered for ``container`` (and still
+    on disk), or None."""
+    row = _ckpts.get(id(container))
+    if row is None:
+        return None
+    ref, path = row
+    if ref() is not container:
+        # the id was recycled by a different object: stale row
+        _ckpts.pop(id(container), None)
+        return None
+    return path if os.path.exists(path) else None
+
+
+# ---------------------------------------------------------------------------
+# rank attribution
+# ---------------------------------------------------------------------------
+
+def attribute(err, rank: int) -> _resilience.DeviceLostError:
+    """Attribute a collective/backend failure to a mesh rank: the
+    classified :class:`DeviceLostError` the rescue hooks act on.  The
+    multihost leg uses this when a peer process dies mid-collective —
+    the failure names no rank by itself, the survivor's topology
+    knowledge does."""
+    de = _resilience.DeviceLostError(
+        f"rank {rank} presumed lost: {type(err).__name__}: {err}",
+        site=getattr(err, "site", "") or "device.lost", rank=int(rank))
+    if isinstance(err, BaseException):
+        de.__cause__ = err
+    return de
+
+
+# ---------------------------------------------------------------------------
+# redistribute: public v1 (host-staged gather/scatter)
+# ---------------------------------------------------------------------------
+
+def redistribute(container, new_dist=None, *, runtime=None):
+    """Re-lay ``container`` out IN PLACE under ``new_dist`` on
+    ``runtime`` (default: the current global runtime) and return it.
+
+    v1 is host-staged: the logical value gathers to the host and
+    scatters through the target layout's pack program — the API is the
+    contract, the collective lowering (arXiv:2112.01075's
+    all-to-all/permute decomposition on the shared ring machinery) is
+    ROADMAP item 2's follow-on.  In-place on purpose: every existing
+    reference to the container (views, recorded plan ops, the elastic
+    rescue walking a live session) stays valid across the move.
+
+    ``new_dist`` (a ``block_distribution``, a sizes sequence, or None
+    for the default even layout) is a ``distributed_vector`` contract;
+    matrices re-block with their default partition on the target
+    runtime.  Pending deferred work on the container flushes first
+    (the gather is a host materialization)."""
+    from ..containers.distributed_vector import distributed_vector
+    from ..parallel import runtime as _rt
+
+    rt = runtime or _rt.runtime()
+    if isinstance(container, distributed_vector):
+        values = container.materialize()
+        container._rebind(rt, new_dist)
+        container.assign_array(values)
+        return container
+    if new_dist is not None:
+        raise ValueError(
+            "explicit block distributions are a distributed_vector "
+            "contract; matrices re-block with their default partition "
+            "on the target runtime")
+    from . import checkpoint as _ck
+    meta, arrays = _ck.snapshot(container)
+    fresh = _ck.rebuild(meta, arrays, runtime=rt, reblock=True)
+    _swap_state(container, fresh, rt)
+    return container
+
+
+def _swap_state(container, fresh, rt) -> None:
+    """Adopt ``fresh``'s state into ``container`` in place (same
+    logical value, new mesh/layout) and fix the self-references the
+    dict swap cannot carry (a vector's halo controller binds its
+    owner)."""
+    container.__dict__.clear()
+    container.__dict__.update(fresh.__dict__)
+    from ..containers.distributed_vector import distributed_vector
+    if isinstance(container, distributed_vector) and container._hb.width:
+        from ..parallel.halo import span_halo
+        container._halo = span_halo(container)
+    rt.register(container)
+
+
+# ---------------------------------------------------------------------------
+# poisoning (the 'lost' fate)
+# ---------------------------------------------------------------------------
+
+_poison_classes: dict = {}
+
+
+def _poison(container, why: str) -> None:
+    """Mark ``container`` LOST: its segments died with a device and no
+    checkpoint exists.  Any further attribute access raises the
+    classified ``DeviceLostError`` — a lost container must never feed
+    a silent wrong answer into a surviving computation."""
+    cls = type(container)
+    pc = _poison_classes.get(cls)
+    if pc is None:
+        def __getattribute__(self, name):
+            if name.startswith("__") or name == "_elastic_lost_reason":
+                return object.__getattribute__(self, name)
+            raise _resilience.DeviceLostError(
+                f"{cls.__name__} state was lost with the failed "
+                "device(s) "
+                f"({object.__getattribute__(self, '_elastic_lost_reason')}); "
+                "only a checkpoint that predates the loss can restore "
+                "it", site="device.lost")
+
+        pc = type("Lost" + cls.__name__, (cls,),
+                  {"__getattribute__": __getattribute__})
+        _poison_classes[cls] = pc
+    container._elastic_lost_reason = why
+    container.__class__ = pc
+
+
+def is_lost(container) -> bool:
+    """True when a shrink poisoned ``container`` (its class carries
+    the loss marker)."""
+    return type(container) in _poison_classes.values()
+
+
+# ---------------------------------------------------------------------------
+# the shrink itself
+# ---------------------------------------------------------------------------
+
+def _owned_ranks(container, P: int) -> set:
+    """Mesh ranks holding any of ``container``'s segments.  Vectors
+    read their block windows (a zero-size block owns nothing — the
+    'team' case survives a loss elsewhere untouched); matrices tile
+    over a grid PREFIX of the device list; unknown kinds
+    conservatively claim every rank."""
+    from ..containers.distributed_vector import distributed_vector
+
+    if isinstance(container, distributed_vector):
+        owned = set()
+        for r in range(container.nshards):
+            b, e = container._rank_window(r)
+            if b < e:
+                owned.add(r)
+        return owned
+    grid = getattr(container, "grid_shape", None) \
+        or getattr(container, "grid", None)
+    if grid is not None:
+        tiles = 1
+        for g in tuple(grid):
+            tiles *= int(g)
+        return set(range(min(P, tiles)))
+    return set(range(P))
+
+
+def _plan_fate(c, lost_set: set, P: int, reason: str):
+    """Decide one container's fate on the OLD mesh and capture the host
+    state the apply step needs:
+
+    * untouched by the loss → ``("rescue", (meta, arrays))`` — full
+      host snapshot, bit-equal to the pre-fault value;
+    * a vector with segments on dead ranks AND a checkpoint →
+      ``("restore", ("merge", values))`` — PER-SEGMENT hybrid: live
+      survivor segments read from the device, dead segments from the
+      last atomic checkpoint (the documented consistency contract:
+      dead segments rewind to the checkpoint, survivors do not);
+    * a matrix with a checkpoint → ``("restore", ("ckpt", path))`` —
+      whole-container reload (v1);
+    * no checkpoint → ``("lost", reason)``.
+    """
+    from ..containers.distributed_vector import distributed_vector
+
+    if not (_owned_ranks(c, P) & lost_set):
+        from . import checkpoint as _ck
+        return "rescue", _ck.snapshot(c)
+    path = checkpoint_path(c)
+    if path is None:
+        return "lost", reason
+    if isinstance(c, distributed_vector):
+        return "restore", ("merge", _merge_vector_values(c, lost_set,
+                                                         path))
+    return "restore", ("ckpt", path)
+
+
+def _merge_vector_values(c, lost_set: set, path: str):
+    """The per-segment hybrid value: start from the checkpoint's
+    logical array, overwrite every SURVIVING rank's window with its
+    live device values (read shard-local — nothing is read from a dead
+    rank)."""
+    from . import checkpoint as _ck
+
+    meta, arrays = _ck.read(path)
+    if meta.get("kind") != "vector":
+        raise ValueError(
+            f"checkpoint at {path} holds a {meta.get('kind')!r}, not "
+            "this vector")
+    base = np.array(arrays["data"])
+    if base.shape != (len(c),):
+        raise ValueError(
+            f"checkpoint length {base.shape} != live vector ({len(c)},)")
+    for r in range(c.nshards):
+        if r in lost_set:
+            continue
+        b, e = c._rank_window(r)
+        if b < e:
+            base[b:e] = np.asarray(c._local_values(r, b, e))
+    return base.astype(np.dtype(c.dtype), copy=False)
+
+
+def _apply_restore(c, payload, new_rt) -> None:
+    kind, data = payload
+    if kind == "merge":
+        c._rebind(new_rt, None)
+        c.assign_array(data)
+    else:
+        from . import checkpoint as _ck
+        _swap_state(c, _ck.load(data, runtime=new_rt, reblock=True),
+                    new_rt)
+
+
+def rescue_session(err=None, *, lost_ranks: Optional[Sequence[int]] = None,
+                   reason: str = "") -> ShrinkReport:
+    """Shrink the session onto the surviving devices and rescue live
+    state.  ``lost_ranks`` overrides the rank attribution carried by
+    ``err`` (an env-injected loss names no rank: the LAST rank is
+    presumed — deterministic, and on the tunneled topology the highest
+    rank is the farthest hop).  Raises classified on an impossible
+    rescue (below ``DR_TPU_ELASTIC_MIN_DEVICES``, reentrant loss, or a
+    ``mesh.shrink`` fault); on success the global runtime IS the
+    shrunken mesh and the report says what happened to every
+    container."""
+    global _shrinks, _rescued, _restored, _lost, _wall_s, _rescuing
+    global _last_report
+    from .. import obs as _obs
+    from ..parallel import runtime as _rt
+
+    if _rescuing:
+        raise _resilience.ProgramError(
+            "elastic: device loss during an active rescue — a nested "
+            "shrink cannot run under the first one", site="mesh.shrink")
+    if not _rt.is_initialized():
+        raise _resilience.ProgramError(
+            "elastic: no runtime to shrink (init() first)",
+            site="mesh.shrink")
+    rt = _rt.runtime()
+    P = rt.nprocs
+    if lost_ranks is not None:
+        ranks = sorted({int(r) for r in lost_ranks})
+    else:
+        rank = getattr(err, "rank", None)
+        # an unattributed loss presumes the LAST rank (deterministic;
+        # the farthest hop on the tunneled topology)
+        ranks = [int(rank)] if rank is not None else [P - 1]
+    if not ranks or any(not 0 <= r < P for r in ranks):
+        # a stale attribution (a rank id from the PRE-shrink topology)
+        # must fail loudly: silently remapping it would rescue the
+        # wrong rank's data and leave the dead device in the mesh
+        raise _resilience.ProgramError(
+            f"elastic: lost-rank attribution {ranks} is invalid for "
+            f"the current {P}-rank mesh (stale topology?)",
+            site="mesh.shrink")
+    reason = reason or (f"{type(err).__name__}: {err}" if err is not None
+                        else "requested shrink")
+    min_dev = env_int("DR_TPU_ELASTIC_MIN_DEVICES", 1)
+    survivors = [d for r, d in enumerate(rt.devices)
+                 if r not in set(ranks)]
+    t0 = time.perf_counter()
+    sid = _obs.begin("mesh.shrink", cat="elastic",
+                     lost=",".join(map(str, ranks)), nprocs=P)
+    _rescuing = True
+    report = ShrinkReport(reason=reason, lost_ranks=ranks,
+                          nprocs_before=P, nprocs_after=len(survivors))
+    try:
+        # the device-loss event sits INSIDE the shrink span: a trace
+        # reader sees what died and the rescue that answered, together
+        _obs.event("device.lost", cat="elastic",
+                   ranks=",".join(map(str, ranks)),
+                   error=type(err).__name__ if err is not None
+                   else "requested")
+        _faults.fire("mesh.shrink", lost=tuple(ranks))
+        if len(survivors) < max(1, min_dev):
+            raise _resilience.DeviceLostError(
+                f"elastic: cannot shrink below "
+                f"DR_TPU_ELASTIC_MIN_DEVICES={min_dev} "
+                f"({len(survivors)} survivor(s) of {P}); original "
+                f"loss: {reason}", site="mesh.shrink")
+        lost_set = set(ranks)
+        # fates + host snapshots are decided on the OLD mesh, before
+        # the runtime flips: a rescue gather reads only segments the
+        # survivors still hold (host-staged v1), and a partially-dead
+        # VECTOR merges its survivors' live segments with the
+        # checkpointed values of the dead ones (per-segment restore;
+        # matrices restore whole-container v1)
+        fates = []
+        for c in rt.live_containers():
+            try:
+                fates.append((c,) + _plan_fate(c, lost_set, P, reason))
+            except Exception as e:  # fate/gather failed (including a
+                # second classified fault riding the dispatches): the
+                # rescue of the REST of the session must not die with
+                # one container.  A registered checkpoint still
+                # restores it (whole-container — the live gather
+                # already failed); only a checkpoint-less container
+                # degrades to lost (§16.3's matrix).
+                path = checkpoint_path(c)
+                if path is not None:
+                    fates.append((c, "restore", ("ckpt", path)))
+                else:
+                    fates.append(
+                        (c, "lost",
+                         f"{reason}; rescue gather failed: {e!r}"))
+        new_rt = _rt.init(survivors)
+        for c, fate, payload in fates:
+            name = type(c).__name__
+            try:
+                if fate == "rescue":
+                    meta, arrays = payload
+                    from . import checkpoint as _ck
+                    _swap_state(c, _ck.rebuild(meta, arrays,
+                                               runtime=new_rt,
+                                               reblock=True), new_rt)
+                elif fate == "restore":
+                    _apply_restore(c, payload, new_rt)
+            except Exception as e:
+                # a container whose rebuild cannot land on the small
+                # mesh (halo radius > new segment, unfittable cyclic
+                # grid, corrupt checkpoint) degrades to LOST — the
+                # session survives, the container fails loudly
+                fate, payload = "lost", f"{reason}; {fate} failed: {e!r}"
+            if fate == "lost":
+                _poison(c, payload)
+                report.lost += 1
+                detail = payload
+            elif fate == "restore":
+                report.restored += 1
+                # postmortem tag only — never the merged array itself
+                detail = payload[0] if isinstance(payload, tuple) \
+                    else str(payload)
+            else:
+                report.rescued += 1
+                detail = ""
+            report.fates.append((fate, name, detail))
+        report.wall_s = round(time.perf_counter() - t0, 4)
+        _shrinks += 1
+        _rescued += report.rescued
+        _restored += report.restored
+        _lost += report.lost
+        _wall_s += report.wall_s
+        _last_report = report
+        _publish(report)
+        warn_fallback(
+            "elastic",
+            f"mesh shrank {P} -> {len(survivors)} device(s) (lost "
+            f"rank(s) {ranks}): {report.rescued} rescued, "
+            f"{report.restored} restored, {report.lost} lost; {reason}")
+        return report
+    except _resilience.ResilienceError as e:
+        # even a FAILED rescue leaves a chapter: the classified error
+        # the caller surfaces must be explainable from the artifact
+        os.environ["_DR_TPU_ELASTIC_REASON"] = \
+            f"shrink failed: {e}"[:200]
+        raise
+    finally:
+        _rescuing = False
+        _obs.end(sid, survivors=len(survivors), rescued=report.rescued,
+                 restored=report.restored, lost=report.lost)
+
+
+def try_rescue(err) -> bool:
+    """The guarded form the automatic hooks use (retry, plan flush):
+    attempt a shrink for ``err``; False when a rescue is impossible
+    (reentrant, no runtime, floor reached, or a fault inside the
+    shrink) — the caller then surfaces the ORIGINAL classified loss.
+    Never raises."""
+    try:
+        rescue_session(err)
+        return True
+    except _resilience.ResilienceError as e:
+        warn_fallback("elastic", f"rescue failed ({e}); surfacing the "
+                                 "original device loss")
+        return False
+
+
+def _publish(report: ShrinkReport) -> None:
+    """Publish the cumulative shrink chapter as env markers —
+    ``resilience.degradation_story`` folds them into
+    ``detail.degraded`` and they ride re-exec environments like the
+    serve markers."""
+    os.environ["_DR_TPU_ELASTIC_REASON"] = report.reason[:200]
+    os.environ["_DR_TPU_ELASTIC_SHRINKS"] = str(_shrinks)
+    os.environ["_DR_TPU_ELASTIC_LOST_RANKS"] = \
+        ",".join(map(str, report.lost_ranks))
+    os.environ["_DR_TPU_ELASTIC_RESCUED"] = str(_rescued)
+    os.environ["_DR_TPU_ELASTIC_RESTORED"] = str(_restored)
+    os.environ["_DR_TPU_ELASTIC_LOST"] = str(_lost)
+    os.environ["_DR_TPU_ELASTIC_NPROCS"] = str(report.nprocs_after)
+    os.environ["_DR_TPU_ELASTIC_WALL_S"] = f"{_wall_s:.4f}"
+
+
+def reset() -> None:
+    """Between-test hygiene (the conftest disarm fixture): clear the
+    markers, the checkpoint registry, and the counters so one test's
+    shrunken-mesh story cannot leak into the next."""
+    global _shrinks, _rescued, _restored, _lost, _wall_s, _last_report
+    global _rescuing
+    _shrinks = _rescued = _restored = _lost = 0
+    _wall_s = 0.0
+    _last_report = None
+    _rescuing = False
+    _ckpts.clear()
+    for m in MARKERS:
+        os.environ.pop(m, None)

@@ -53,6 +53,16 @@ serve.flush          serving-daemon batch dispatch  transient, relay_down,
                      body, before the deferred
                      flush; relay_down triggers
                      the watchdog CPU degrade)
+device.lost          every TappedCache dispatch     device_lost
+                     tap (a device can die mid-
+                     eager-op, mid-plan-flush, or
+                     mid-serve-batch; rank rides
+                     the fire ctx when known)
+mesh.shrink          utils/elastic.rescue_session   transient, program
+                     (the shrink boundary, before
+                     the runtime rebuild — a fault
+                     fails the rescue classified,
+                     containers untouched)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -60,7 +70,9 @@ Exception kinds map onto the taxonomy: ``transient`` ->
 TransientBackendError, ``relay_down`` -> RelayDownError, ``oom`` ->
 DeviceOOM (message carries RESOURCE_EXHAUSTED so string-matching
 backoff paths treat it like the real thing), ``program`` ->
-ProgramError.  ``truncate`` is behavioral: checkpoint.save truncates
+ProgramError, ``device_lost`` -> DeviceLostError (message carries
+DEVICE_LOST; the elastic layer shrinks the mesh on it, SPEC §16).
+``truncate`` is behavioral: checkpoint.save truncates
 the written file — the torn write a mid-stream kill leaves behind.
 
 Spec grammar (``DR_TPU_FAULT_SPEC``, parsed at import; call
@@ -113,10 +125,20 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "serve.accept": ("transient", "program"),
     "serve.request": ("transient", "oom", "program"),
     "serve.flush": ("transient", "relay_down", "program"),
+    # elastic degradation (docs/SPEC.md §16): device.lost rides EVERY
+    # tapped dispatch (spmd_guard.TappedCache — the same choke point as
+    # dispatch.cache, so a device can "die" mid-eager-op, mid-plan-
+    # flush, or mid-serve-batch); mesh.shrink fires inside
+    # utils/elastic.rescue_session at the shrink boundary, before the
+    # runtime is rebuilt — a fault there fails the rescue classified
+    # with the session's containers untouched.
+    "device.lost": ("device_lost",),
+    "mesh.shrink": ("transient", "program"),
     "fallback.warn": (),
 }
 
-EXCEPTION_KINDS = ("transient", "relay_down", "oom", "program")
+EXCEPTION_KINDS = ("transient", "relay_down", "oom", "program",
+                   "device_lost")
 BEHAVIORAL_KINDS = ("truncate",)
 _ALL_KINDS = EXCEPTION_KINDS + BEHAVIORAL_KINDS
 
@@ -274,6 +296,14 @@ def _trigger(site: str, kind: str, ctx: dict) -> Optional[str]:
         raise R.RelayDownError(f"relay not listening: {tag}", site=site)
     if kind == "oom":
         raise R.DeviceOOM(f"RESOURCE_EXHAUSTED: {tag}", site=site)
+    if kind == "device_lost":
+        # rank attribution rides the fire() ctx (DR_TPU_FAULT_SPEC has
+        # no rank field; env-injected losses leave rank None and the
+        # elastic rescue presumes the last rank)
+        rank = ctx.get("rank")
+        raise R.DeviceLostError(f"DEVICE_LOST: {tag}", site=site,
+                                rank=rank if isinstance(rank, int)
+                                else None)
     if kind == "program":
         raise R.ProgramError(tag, site=site)
     return kind  # behavioral: the site acts on it

@@ -54,7 +54,8 @@ from typing import Callable, Optional, Sequence
 __all__ = [
     "ResilienceError", "TransientBackendError", "RelayDownError",
     "DeviceOOM", "ProgramError", "CheckpointCorruptError",
-    "DeadlineExpired", "ServerOverloaded", "classify", "classified",
+    "DeadlineExpired", "ServerOverloaded", "DeviceLostError",
+    "classify", "classified",
     "backoff_schedule",
     "retry", "with_deadline", "dump_dispatch_trace", "dump_obs_tail",
     "relay_listening",
@@ -131,6 +132,23 @@ class ServerOverloaded(ResilienceError):
     spread the load — retrying immediately just re-trips the cap."""
 
 
+class DeviceLostError(ResilienceError):
+    """A device (or the host behind it) died MID-SESSION: retrying the
+    same program on the same mesh cannot succeed, and falling all the
+    way back to CPU throws away the surviving devices.  The policy is
+    ELASTIC DEGRADATION (utils/elastic.py, docs/SPEC.md §16): shrink
+    the mesh to the survivors, rescue live container state, and carry
+    on.  ``rank`` attributes the loss to a mesh rank when known (fault
+    injection and collective attribution set it; a raw backend error
+    classified here leaves it None and the rescue presumes the last
+    rank)."""
+
+    def __init__(self, message: str, *, site: str = "",
+                 rank: Optional[int] = None):
+        super().__init__(message, site=site)
+        self.rank = rank
+
+
 # substring evidence for each class (matched case-insensitively),
 # checked in order: OOM first (its messages often also contain
 # transient-looking words), then relay-down, then the transient bucket;
@@ -139,6 +157,12 @@ class ServerOverloaded(ResilienceError):
 # global classifier gating retry decisions, a transient error that
 # merely MENTIONS memory must stay retryable.
 _OOM_TOKENS = ("resource_exhausted", "out of memory")
+# device-loss evidence is checked BEFORE the transient bucket: the raw
+# backend text for a dead chip often also carries transient-looking
+# words ("unavailable"), and retrying on the dead mesh cannot land —
+# the policy is an elastic shrink, not backoff
+_DEVICE_LOST_TOKENS = ("device_lost", "device lost", "data_loss",
+                       "device failure", "hardware failure")
 _RELAY_TOKENS = ("relay not listening", "connection refused",
                  "econnrefused", "failed to connect")
 # no bare "exceeded": deterministic errors phrase limits that way too
@@ -157,6 +181,7 @@ def classify(err) -> type:
     text = (err if isinstance(err, str)
             else f"{type(err).__name__}: {err}").lower()
     for tokens, cls in ((_OOM_TOKENS, DeviceOOM),
+                        (_DEVICE_LOST_TOKENS, DeviceLostError),
                         (_RELAY_TOKENS, RelayDownError),
                         (_TRANSIENT_TOKENS, TransientBackendError)):
         if any(t in text for t in tokens):
@@ -202,7 +227,8 @@ def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
           factor: float = 2.0, max_delay: float = 30.0,
           jitter: float = 0.25, seed: int = 0,
           retry_on: Sequence[type] = (TransientBackendError,),
-          sleep: Callable = time.sleep, on_retry: Callable = None):
+          sleep: Callable = time.sleep, on_retry: Callable = None,
+          deadline_s: Optional[float] = None):
     """Run ``fn()`` with classified retries.
 
     Every raised error is classified first; only instances of
@@ -210,30 +236,59 @@ def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
     relay or an OOM must be routed, not hammered).  Delays come from
     :func:`backoff_schedule`, so the whole timing story is deterministic
     given ``seed``.  ``on_retry(attempt_index, error, delay)`` observes
-    each retry.  The final failure is re-raised CLASSIFIED."""
+    each retry.  The final failure is re-raised CLASSIFIED.
+
+    ``deadline_s`` makes the loop deadline-aware: a retry whose backoff
+    delay would land past the budget (measured from the first attempt)
+    is not taken — the classified error surfaces instead of a retry
+    nobody is still waiting on (the serve client's policy, SPEC §14.6).
+
+    Elastic degradation (docs/SPEC.md §16): when ``DR_TPU_ELASTIC=1``,
+    a :class:`DeviceLostError` raised by the protected call triggers a
+    mesh SHRINK (``utils.elastic.try_rescue`` — survivors re-meshed,
+    live containers rescued) and the call is retried on the shrunken
+    mesh — device loss becomes a degraded retry instead of a dead job.
+    With elastic off (or the rescue impossible) the loss surfaces
+    classified as before."""
     if attempts < 1:
         # a config-derived attempts=0 must fail loudly, not silently
         # skip the protected call and hand back None
         raise ValueError(f"retry needs attempts >= 1, got {attempts}")
     delays = backoff_schedule(attempts - 1, base=base, factor=factor,
                               max_delay=max_delay, jitter=jitter, seed=seed)
+    t0 = time.monotonic()
     for i in range(attempts):
         try:
             return fn()
         except Exception as e:
             ce = classified(e)
-            if i == attempts - 1 or not isinstance(ce, tuple(retry_on)):
+            recoverable = isinstance(ce, tuple(retry_on))
+            shrunk = False
+            if (not recoverable and isinstance(ce, DeviceLostError)
+                    and i < attempts - 1):
+                from . import elastic
+                shrunk = recoverable = (elastic.enabled()
+                                        and elastic.try_rescue(ce))
+            if i == attempts - 1 or not recoverable:
                 if ce is e:
                     raise  # already classified: keep its cause chain
+                raise ce from e
+            if deadline_s is not None and not shrunk and \
+                    time.monotonic() - t0 + delays[i] > deadline_s:
+                if ce is e:
+                    raise
                 raise ce from e
             if on_retry is not None:
                 on_retry(i, ce, delays[i])
             from .. import obs as _obs
             _obs.event("retry", cat="resilience", attempt=i,
                        error=type(ce).__name__, site=ce.site,
-                       delay_s=round(delays[i], 4))
+                       delay_s=round(delays[i], 4), shrunk=shrunk)
             _obs.count("resilience.retries")
-            sleep(delays[i])
+            if not shrunk:
+                # the shrink already paid the recovery cost; a backoff
+                # delay on top would just stall the rescued mesh
+                sleep(delays[i])
 
 
 # ---------------------------------------------------------------------------
@@ -454,14 +509,21 @@ def degradation_story(env=None) -> Optional[dict]:
     (dr_tpu/serve) add their own ``_DR_TPU_SERVE_*`` markers — queue
     depth high-water, shed count, daemon restarts — published by the
     daemon when it degrades or stops, so ``detail.degraded`` tells the
-    FULL story of a served session, not just the first-touch leg.  None
-    when the run is not degraded."""
+    FULL story of a served session, not just the first-touch leg.
+    Elastic shrinks (utils/elastic.py, SPEC §16) publish
+    ``_DR_TPU_ELASTIC_*`` markers the same way — a run whose mesh
+    shrank mid-session carries a ``shrink`` chapter (lost ranks,
+    rescued/restored/lost container counts, shrink wall time) in every
+    artifact, re-execs included (the markers ride the inherited
+    environment like the serve ones).  None when the run is not
+    degraded."""
     env = os.environ if env is None else env
     reason = env.get("_DR_TPU_BENCH_DEGRADED")
     serve_reason = env.get("_DR_TPU_SERVE_DEGRADED")
-    if not reason and not serve_reason:
+    shrink_reason = env.get("_DR_TPU_ELASTIC_REASON")
+    if not reason and not serve_reason and not shrink_reason:
         return None
-    story = {"reason": reason or serve_reason,
+    story = {"reason": reason or serve_reason or shrink_reason,
              "retries": int(env.get("_DR_TPU_BENCH_RETRIES", "0") or 0),
              "probe_wall_s": float(env.get("_DR_TPU_BENCH_PROBE_S", "0")
                                    or 0.0)}
@@ -478,4 +540,19 @@ def degradation_story(env=None) -> Optional[dict]:
             serve[key] = raw if key == "reason" else int(raw)
     if serve:
         story["serve"] = serve
+    shrink = {}
+    for key, marker, conv in (
+            ("reason", "_DR_TPU_ELASTIC_REASON", str),
+            ("shrinks", "_DR_TPU_ELASTIC_SHRINKS", int),
+            ("lost_ranks", "_DR_TPU_ELASTIC_LOST_RANKS", str),
+            ("rescued", "_DR_TPU_ELASTIC_RESCUED", int),
+            ("restored", "_DR_TPU_ELASTIC_RESTORED", int),
+            ("lost", "_DR_TPU_ELASTIC_LOST", int),
+            ("nprocs", "_DR_TPU_ELASTIC_NPROCS", int),
+            ("wall_s", "_DR_TPU_ELASTIC_WALL_S", float)):
+        raw = env.get(marker)
+        if raw not in (None, ""):
+            shrink[key] = conv(raw)
+    if shrink:
+        story["shrink"] = shrink
     return story

@@ -137,8 +137,12 @@ class TappedCache(OrderedDict):
         # one dispatch from the trace — the divergence class the guard
         # exists to catch.  fire() precedes record(): a faulted
         # dispatch never reached the backend, so it must not appear on
-        # the verified trace either.
+        # the verified trace either.  'device.lost' rides the same
+        # moment (SPEC §16): a device death surfaces at whatever
+        # dispatch touches the dead mesh next — mid-eager-op, mid-plan-
+        # flush, or mid-serve-batch alike.
         faults.fire("dispatch.cache")
+        faults.fire("device.lost")
         record(key)
         try:
             self.move_to_end(key)  # hit-refresh in ONE lookup
@@ -148,6 +152,7 @@ class TappedCache(OrderedDict):
 
     def setdefault(self, key, default=None):
         faults.fire("dispatch.cache")
+        faults.fire("device.lost")
         record(key)
         # inline rather than super().setdefault(): OrderedDict routes
         # that through the overridden __setitem__, double-counting the

@@ -72,10 +72,16 @@ def _disarm_faults():
     must not keep holding its socket — and its published degradation
     markers must not bleed a 'degraded' story — into the next test.
     Lazy via sys.modules: tests that never touched dr_tpu.serve pay
-    nothing."""
+    nothing.
+
+    Elastic shrink state (round 13) gets the same treatment: a test
+    that shrank the mesh must not leak its _DR_TPU_ELASTIC_* markers,
+    checkpoint registry, or shrink counters into the next test (the
+    _fresh_runtime fixture already restores the full 8-device mesh)."""
     yield
-    from dr_tpu.utils import faults
+    from dr_tpu.utils import elastic, faults
     faults.reload_env()
+    elastic.reset()
     import sys as _sys
     serve = _sys.modules.get("dr_tpu.serve")
     if serve is not None:

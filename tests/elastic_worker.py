@@ -1,0 +1,64 @@
+"""Worker for the elastic multihost leg (docs/SPEC.md §16.5): two
+processes join a jax.distributed mesh, worker 1 is killed mid-session,
+and worker 0 must downgrade the mesh instead of dying with the job —
+attribute the collective failure to the dead rank
+(``elastic.attribute``), shrink onto its local devices
+(``elastic.rescue_session``), restore the checkpointed vector, and
+finish.  Usage: python elastic_worker.py <pid> <nproc> <port> <ckpt>
+"""
+
+import os
+import sys
+
+pid, nproc, port, ck = (int(sys.argv[1]), int(sys.argv[2]),
+                        sys.argv[3], sys.argv[4])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import dr_tpu  # noqa: E402
+from dr_tpu.utils import elastic, resilience  # noqa: E402
+
+dr_tpu.init_distributed(f"localhost:{port}", nproc, pid)
+assert dr_tpu.nprocs() == nproc
+
+n = 4 * nproc
+dv = dr_tpu.distributed_vector(n, dtype=np.float32)
+dr_tpu.iota(dv, 1)
+total = float(dr_tpu.reduce(dv))
+assert total == n * (n + 1) / 2, total
+
+# checkpoint while every rank is alive (collective: materialization
+# gathers, rank 0 writes) — the restore source for the dead segment
+dr_tpu.checkpoint.save(ck, dv)
+dr_tpu.barrier()
+
+if pid != 0:
+    # simulate a host loss: die without a word, mid-session
+    os._exit(17)
+
+# worker 0: the next collective against the dead peer fails (or
+# hangs — the watchdog bounds it either way); attribute the failure to
+# the dead rank and SHRINK instead of dying with it
+try:
+    resilience.with_deadline(lambda: float(dr_tpu.reduce(dv)), 60.0,
+                             site="elastic.multihost", dump=False)
+    raise SystemExit("peer death went unnoticed by the collective")
+except resilience.ResilienceError as e:
+    loss = elastic.attribute(e, 1)
+
+report = elastic.rescue_session(loss)
+assert dr_tpu.nprocs() == 1, dr_tpu.nprocs()
+assert report.restored == 1, report
+
+# the rank-0 half is the survivors' live state, the dead rank's half
+# restored from the checkpoint — here both equal the iota
+np.testing.assert_allclose(dr_tpu.to_numpy(dv),
+                           np.arange(1, n + 1, dtype=np.float32))
+assert float(dr_tpu.reduce(dv)) == total
+
+print(f"ELASTIC-MULTIHOST-OK lost_rank={loss.rank} "
+      f"nprocs={dr_tpu.nprocs()}", flush=True)

@@ -34,7 +34,9 @@ def _battery(tmpdir: str, tag: str) -> None:
     test_battery_reaches_every_site): probe -> init -> dispatch cache ->
     halo exchange/reduce -> collectives shift/alltoall -> sort -> scan
     -> deferred-plan flush -> serving daemon (accept/request/flush) ->
-    checkpoint write/read -> fallback.warn."""
+    checkpoint write/read -> fallback.warn -> elastic shrink
+    (device.lost rides every dispatch tap; mesh.shrink fires inside
+    the rescue)."""
     from dr_tpu.parallel.runtime import probe_devices
     devs, err = probe_devices(30.0)
     if err is not None:
@@ -127,6 +129,29 @@ def _battery(tmpdir: str, tag: str) -> None:
                                rtol=1e-6)
 
     fallback.warn_fallback("chaos", "battery sweep")
+
+    # elastic leg (round 13, LAST — it shrinks the mesh): a simulated
+    # device loss must shrink the session and rescue live state
+    # (docs/SPEC.md §16).  mesh.shrink fires inside the rescue;
+    # device.lost rides every dispatch tap above, so both new sites
+    # are visited.  A team vector dodging the dead rank is RESCUED
+    # bit-equal; an uncheckpointed full-span vector is LOST and must
+    # raise classified, never answer wrong.
+    from dr_tpu.utils import elastic
+    esrc = src[:4 * P]
+    team = dr_tpu.distributed_vector.from_array(
+        esrc, distribution=[len(esrc)] + [0] * (P - 1))
+    gone = dr_tpu.distributed_vector.from_array(esrc)
+    er = elastic.rescue_session(
+        resilience.DeviceLostError("battery: simulated device loss",
+                                   rank=P - 1))
+    assert er.nprocs_after == P - 1 and dr_tpu.nprocs() == P - 1
+    np.testing.assert_array_equal(dr_tpu.to_numpy(team), esrc)
+    try:
+        dr_tpu.to_numpy(gone)
+        raise AssertionError("lost container must raise classified")
+    except resilience.DeviceLostError:
+        pass
 
 
 def _combos():

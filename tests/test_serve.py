@@ -577,3 +577,82 @@ def test_serve_subprocess_chaos(tmp_path, site, kind):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# client retry policy (round 13, SPEC §14.6)
+# ---------------------------------------------------------------------------
+
+def test_client_retry_recovers_transient_intake_fault(server):
+    """retries>1: a transient at request intake resubmits through the
+    seeded-backoff resilience.retry (reconnecting first — the failed
+    exchange invalidated the connection) and the request lands."""
+    with _client(server, retries=3) as c:
+        faults.inject("serve.request", "transient", times=1)
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_allclose(c.scale(x, a=2.0), x * 2.0,
+                                   rtol=1e-6)
+
+
+def test_client_retry_recovers_overload(tmp_path):
+    """retries>1: a ServerOverloaded rejection backs off and
+    resubmits; once the dispatcher drains the queue the retry lands —
+    the client-side remainder of ROADMAP item 1."""
+    srv = serve.Server(str(tmp_path / "r.sock"), queue_depth=1,
+                       batch_window=0.0).start()
+    try:
+        srv.hold()
+        filler_err = []
+
+        def filler():
+            try:
+                with serve.Client(srv.path, timeout=30.0,
+                                  tenant="filler") as c0:
+                    c0.reduce(X)
+            except resilience.ResilienceError as e:  # pragma: no cover
+                filler_err.append(e)
+
+        t = threading.Thread(target=filler)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while len(srv._queue) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        threading.Timer(0.3, srv.release).start()
+        # the queue is full: a single-attempt client is rejected, a
+        # retrying one outlasts the hold
+        with serve.Client(srv.path, timeout=30.0, retries=1) as c1:
+            with pytest.raises(resilience.ServerOverloaded):
+                c1.reduce(X)
+        with serve.Client(srv.path, timeout=30.0, retries=5) as c2:
+            assert abs(c2.reduce(np.ones(8, np.float32)) - 8.0) < 1e-4
+        t.join(timeout=10)
+        assert not filler_err
+        assert srv.stats()["rejected"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_client_retry_deadline_aware():
+    """A retry whose backoff delay would land past the request's
+    deadline_s is NOT taken — the classified error surfaces instead of
+    a resubmission nobody is waiting on."""
+    calls = []
+
+    def always_overloaded():
+        calls.append(1)
+        raise resilience.ServerOverloaded("full", site="serve.request")
+
+    with pytest.raises(resilience.ServerOverloaded):
+        resilience.retry(always_overloaded, attempts=5, base=10.0,
+                         retry_on=(resilience.ServerOverloaded,),
+                         deadline_s=0.5)
+    assert len(calls) == 1  # the 10 s backoff would blow the budget
+
+
+def test_client_default_single_attempt_unchanged(server):
+    """The default stays ONE attempt: an intake fault surfaces
+    classified immediately (overload rejections are information)."""
+    with _client(server) as c:
+        faults.inject("serve.request", "transient", times=1)
+        with pytest.raises(resilience.TransientBackendError):
+            c.scale(np.arange(4, dtype=np.float32))

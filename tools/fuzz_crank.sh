@@ -47,6 +47,17 @@
 # DR_TPU_CHAOS_ROUNDS > 1 it sweeps every serve.* site x kind combo
 # there (plus all the in-process lifecycle edges); the in-battery
 # serve leg rides the chaos arm above.
+#
+# REDISTRIBUTE arm (round 13): test_fuzz_redistribute cranks random
+# src->dst redistributions (explicit distributions x random target
+# runtimes over device subsets) against numpy oracles (filter
+# `redistribute`) — collected automatically with the fuzz arms.
+#
+# ELASTIC arm (round 13): test_elastic.py's kill-a-rank fuzz runs at
+# the end — random container populations, a random lost rank, one
+# elastic rescue per pass; every container must match its pre-fault
+# oracle or raise classified (docs/SPEC.md SS16).  The chaos arm above
+# sweeps the device.lost / mesh.shrink site rows.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
@@ -132,6 +143,21 @@ if [ -z "$FILTER" ]; then
     rc=1
   fi
   rm -rf "$TDIR"
+fi
+# ELASTIC arm (round 13): random kill-a-rank sweeps over random
+# container populations, crank-budgeted (each pass inits a fresh mesh,
+# loses a random rank, and audits the rescue/restore/lost matrix).
+# Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  nd="tests/test_elastic.py::test_fuzz_elastic_kill_a_rank"
+  echo "=== $nd (DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_FUZZ_ITERS=$ITERS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd elastic arm"
+    rc=1
+  fi
 fi
 # SERVE arm (round 11): chaos against a live daemon subprocess —
 # DR_TPU_CHAOS_ROUNDS > 1 expands test_serve_subprocess_chaos to the
