@@ -63,6 +63,14 @@ service vs batch-flush split) next to the client percentiles, and
 under ``DR_TPU_TRACE=1`` the run exports a Chrome trace
 (``detail.obs.trace_file``, Perfetto-openable; docs/SPEC.md §15).
 
+Round 14: ``--relational`` (or DR_TPU_BENCH_RELATIONAL=1 — argv and
+env both survive the CPU-fallback re-execs) runs the TPC-style
+relational pipeline (docs/SPEC.md §17): fact-table join -> groupby
+sum -> top_k, emitting ``detail.relational_rows``, per-stage
+``detail.relational_*_ms``, ``detail.relational_pipeline_krows_s``,
+and ``detail.relational_deferred_dispatches`` (the static-shape
+histogram/top_k pair fused into ONE plan flush).
+
 Round 13: a run whose mesh SHRANK mid-session (elastic degradation,
 docs/SPEC.md §16) is self-describing — the ``_DR_TPU_ELASTIC_*``
 markers the shrink publishes ride the re-exec environment like the
@@ -809,6 +817,103 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
     return out
 
 
+def _relational_runner(n_fact: int, ncard: int):
+    """Build the TPC-style relational pipeline workload — fact table
+    (``n_fact`` rows over ``ncard`` keys) joining a one-row-per-key
+    dimension table, groupby sum, top_k of the heaviest groups.  ONE
+    home shared with ``tools/tune_tpu.py relational`` (the
+    ``_pipeline_runners`` precedent: the on-chip ladder must time the
+    identical workload the bench rows record).  Returns ``(stage,
+    conts)``: ``stage()`` runs join -> groupby -> top_k and returns
+    ``(m, ng, per_stage_seconds)``; ``conts`` holds the live
+    containers (``jl`` feeds the deferred-fusion probe)."""
+    import dr_tpu
+    rng = np.random.default_rng(14)
+    fk = rng.integers(0, ncard, n_fact).astype(np.float32)
+    fv = rng.standard_normal(n_fact).astype(np.float32)
+    dk = rng.permutation(ncard).astype(np.float32)
+    dv = rng.standard_normal(ncard).astype(np.float32)
+    conts = {
+        "fkv": dr_tpu.distributed_vector.from_array(fk),
+        "fvv": dr_tpu.distributed_vector.from_array(fv),
+        "dkv": dr_tpu.distributed_vector.from_array(dk),
+        "dvv": dr_tpu.distributed_vector.from_array(dv),
+    }
+    cap = 2 * n_fact  # dim keys are unique: <= 1 match per fact row
+    for nm in ("jk", "jl", "jr", "gk", "gv"):
+        conts[nm] = dr_tpu.distributed_vector(cap, np.float32)
+    conts["tv"] = dr_tpu.distributed_vector(8, np.float32)
+    conts["ti"] = dr_tpu.distributed_vector(8, np.int32)
+
+    def stage():
+        c = conts
+        ts = {}
+        t0 = time.perf_counter()
+        m = int(dr_tpu.join(c["fkv"], c["fvv"], c["dkv"], c["dvv"],
+                            c["jk"], c["jl"], c["jr"]))
+        ts["join"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        # aggregate only the real joined rows (the capacity tail is
+        # zeros); m is deterministic, so the window program compiles
+        # once across the warm and timed runs
+        ng = int(dr_tpu.groupby_aggregate(c["jk"][0:m], c["jl"][0:m],
+                                          c["gk"], c["gv"],
+                                          agg="sum"))
+        ts["groupby"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        # only the LIVE groups: the capacity tail is zeros and must
+        # neither enter the candidate pool nor the timing
+        dr_tpu.top_k(c["gv"][0:ng], c["tv"], c["ti"])
+        _sync(c["tv"])
+        ts["topk"] = time.perf_counter() - t0
+        return m, ng, ts
+
+    return stage, conts
+
+
+def _relational_metrics(on_cpu: bool) -> dict:
+    """--relational / DR_TPU_BENCH_RELATIONAL=1 (round 14): a small
+    TPC-style end-to-end pipeline over the relational layer
+    (docs/SPEC.md §17) — a fact table joins a dimension table
+    (feature-join shape), the joined product aggregates per key
+    (groupby sum), and top_k takes the heaviest groups — the log
+    analytics / feature-join composite no single-primitive number can
+    fake.  Emits per-stage wall times, end-to-end row throughput, and
+    the deferred-fusion dispatch count of the static-shape ops."""
+    import dr_tpu
+    from dr_tpu.utils.spmd_guard import dispatch_count
+    out = {}
+    n_fact = 2 ** 14 if on_cpu else 2 ** 18
+    ncard = max(n_fact // 16, 4)  # key cardinality (fan-in ~16)
+    try:
+        stage, conts = _relational_runner(n_fact, ncard)
+        stage()  # warm the programs (compiles)
+        m, ng, ts = stage()
+        total = sum(ts.values())
+        out["relational_rows"] = {"fact": n_fact, "dim": ncard,
+                                  "joined": m, "groups": ng}
+        out["relational_join_ms"] = round(ts["join"] * 1e3, 2)
+        out["relational_groupby_ms"] = round(ts["groupby"] * 1e3, 2)
+        out["relational_topk_ms"] = round(ts["topk"] * 1e3, 2)
+        out["relational_pipeline_krows_s"] = round(
+            n_fact / total / 1e3, 1)
+        # deferred fusion of the static-shape ops: histogram + top_k
+        # over the joined values flush as ONE dispatch (dr_tpu/plan.py)
+        jl, tv, ti = conts["jl"], conts["tv"], conts["ti"]
+        hb = dr_tpu.distributed_vector(16, np.int32)
+        with dr_tpu.deferred():  # warm the fused program
+            dr_tpu.histogram(jl[0:m], hb, -3.0, 3.0)
+            dr_tpu.top_k(jl[0:m], tv, ti)
+        d0 = dispatch_count()
+        with dr_tpu.deferred():
+            dr_tpu.histogram(jl[0:m], hb, -3.0, 3.0)
+            dr_tpu.top_k(jl[0:m], tv, ti)
+        out["relational_deferred_dispatches"] = dispatch_count() - d0
+    except Exception as e:  # pragma: no cover - defensive
+        out["relational_error"] = repr(e)[:160]
+    return out
+
+
 def _serve_metrics(on_cpu: bool) -> dict:
     """--serve / DR_TPU_BENCH_SERVE=1: closed-loop serving load
     generator (round 11).  One in-process ``dr_tpu.serve`` daemon —
@@ -1136,6 +1241,13 @@ def main():
         # percentiles with batching on
         if "--serve" in sys.argv[1:] or env_flag("DR_TPU_BENCH_SERVE"):
             secondary.update(_serve_metrics(on_cpu))
+        # relational config (round 14): the TPC-style join -> groupby
+        # -> top_k pipeline is opt-in (--relational /
+        # DR_TPU_BENCH_RELATIONAL=1 — argv and env both survive the
+        # CPU-fallback re-execs) and honors DR_TPU_BENCH_SECONDARY=0
+        if "--relational" in sys.argv[1:] \
+                or env_flag("DR_TPU_BENCH_RELATIONAL"):
+            secondary.update(_relational_metrics(on_cpu))
 
     # tagged CPU fallback: the full degradation story (reason, original
     # probe error, retry count, probe wall time — and, AFTER the serve

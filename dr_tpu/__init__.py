@@ -19,6 +19,9 @@ namespaces through one TPU backend, called ``thp``):
 - algorithms: ``fill / iota / copy / for_each / transform / reduce /
   transform_reduce / inclusive_scan / exclusive_scan / sort /
   sort_by_key / argsort / is_sorted / dot / gemv``
+- relational: ``join / groupby_aggregate / unique / histogram /
+  top_k`` — the distributed dataframe tier on the sort/scan backbone
+  (docs/SPEC.md §17)
 - halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
 - plans:      ``deferred`` / ``Plan`` — record algorithm chains, flush
   them as ONE fused dispatch (cross-algorithm dispatch fusion)
@@ -74,6 +77,8 @@ from .algorithms.reduce import (reduce, transform_reduce, dot, dot_n,
 from .algorithms.scan import (inclusive_scan, exclusive_scan,
                               inclusive_scan_n)
 from .algorithms.sort import sort, sort_by_key, argsort, is_sorted
+from .algorithms.relational import (join, groupby_aggregate, unique,
+                                    histogram, top_k, DeferredCount)
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
                                    stencil2d_n, heat_step_weights)
@@ -97,6 +102,8 @@ __all__ = [
     "to_numpy", "reduce", "transform_reduce", "dot",
     "reduce_async", "transform_reduce_async", "dot_async",
     "inclusive_scan", "exclusive_scan",
+    "join", "groupby_aggregate", "unique", "histogram", "top_k",
+    "DeferredCount",
     "stencil_transform", "stencil_iterate",
     "stencil2d_transform", "stencil2d_iterate", "heat_step_weights",
     "gemv", "flat_gemv", "gemm", "spmm",

@@ -4,6 +4,8 @@ from .reduce import (reduce, transform_reduce, dot, reduce_async,
                      transform_reduce_async, dot_async)
 from .scan import inclusive_scan, exclusive_scan
 from .sort import sort, sort_by_key, argsort, is_sorted
+from .relational import (join, groupby_aggregate, unique, histogram,
+                         top_k)
 from .stencil import (stencil_transform, stencil_iterate,
                       stencil_iterate_blocked,
                       stencil_iterate_matmul)

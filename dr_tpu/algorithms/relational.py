@@ -1,0 +1,1064 @@
+"""Relational analytics on the sort/scan backbone: distributed
+``join`` / ``groupby_aggregate`` / ``unique`` / ``histogram`` /
+streaming ``top_k``.
+
+This is the first multi-op COMPOSITE tier built on the backbone rather
+than inside it (ROADMAP item 4, "Distributed Ranges" as an
+STL-of-distributed-data model — arXiv:2406.00158): the sample-sort
+single-exchange payload plan supplies the global order, boundary-flag
+scans find the group structure, and the segment-aware masked-sum
+assembly (the sort family's rebalance pattern) re-homes per-group
+partials into each output's own block distribution.  Each op is ONE
+cached jitted ``shard_map`` program per layout (dispatched through the
+tapped program cache, so ``dispatch.cache``/``device.lost`` ride every
+call), correct eager AND deferred-plan-recordable, classified through
+the existing error taxonomy, and traced as an ``obs`` span with
+per-phase attrs (``tools/trace_view.py`` shows where a join spends its
+time).  docs/SPEC.md §17 is the spec.
+
+Algorithm shapes
+----------------
+
+* ``groupby_aggregate(keys, values, out_keys, out_vals, agg)`` —
+  non-mutating: key/value chains copy into fresh uniform SCRATCH
+  containers and stable-sort by key (``sort_by_key``, the round-6
+  single-exchange plan).  One program then (1) boundary-flags the
+  sorted keys (one ``all_gather`` of p shard-boundary keys — a group
+  is a run, a run crossing a shard boundary continues segment 0), (2)
+  segmented-reduces each shard's runs (``jax.ops.segment_*`` over the
+  static ≤ seg+1 local segments — the bucketed scatter-add of the
+  reduce path), and (3) re-homes the per-run partials into the OUT
+  containers' own block distributions by one masked ``all_to_all`` +
+  per-column monoid combine per channel (a group split across shards
+  merges its partials there; the representative key rides a
+  min-combine channel — exact, every contributor holds the same key).
+  Group ``i`` of the sorted-distinct key order lands at OUT position
+  ``i``; positions ``>= ngroups`` are ZERO.  Returns ``ngroups``.
+* ``unique(r, out)`` — the groupby machinery, keys channel only.
+* ``join(lk, lv, rk, rv, out_keys, out_lv, out_rv, how=...)`` —
+  sort-merge join: both sides sort natively (scratch, non-mutating),
+  then one program ``all_gather``\\ s the SORTED sides (a broadcast
+  sorted-merge: per-device memory is O(n_l + n_r) — the
+  bounded-memory repartition exchange of arXiv:2112.01075 is the
+  ``redistribute()`` follow-up, ROADMAP item 2), counts each left
+  row's matches by two ``searchsorted``\\ s on the monotone key
+  encoding, prefix-sums the counts into output offsets (the scan
+  backbone's shape), and every OUT shard materializes exactly its own
+  window of the expanded rows.  ``how="left"``/``"right"`` ride
+  presence flags: unmatched rows emit ``fill`` on the missing side.
+  Output rows are ordered by (key, left position, right position);
+  positions ``>= count`` are ZERO.  Returns the row count.
+* ``histogram(r, out, lo, hi)`` — fixed ``bins = len(out)`` buckets:
+  per-shard bucketed scatter-add (``segment_sum``) + one ``psum``;
+  bucket ``i`` covers ``[lo + i*w, lo + (i+1)*w)`` with
+  ``w = (hi-lo)/bins`` and the right edge ``hi`` INCLUSIVE in the
+  last bucket (numpy's rule); out-of-range values are dropped.
+* ``top_k(r, out_vals, out_idx=None, largest=True, merge=False)`` —
+  ``k = len(out_vals)``: per-shard (value, index) 2-key sort over the
+  monotone encoding, ``all_gather`` of p*k candidates, one global
+  2-key sort.  Ties break toward the SMALLER index.  ``merge=True``
+  folds the CURRENT contents of ``out_vals``/``out_idx`` into the
+  candidate pool, so chaining calls over successive windows streams a
+  running top-k without re-reading old windows.  Unfilled slots hold
+  the dtype's finite worst value (``finfo/iinfo`` min for largest,
+  max for smallest — never inf, so the sanitizer's finite sweep keeps
+  meaning) and index ``INT32_MAX``.
+
+Deferred plans (docs/SPEC.md §11/§17.2): ``histogram`` and ``top_k``
+have STATIC output shapes and record FUSIBLE (they fuse into the
+surrounding run — ``plan.record_histogram``/``record_top_k``, elastic
+replay included); ``join``/``groupby_aggregate``/``unique`` have
+data-dependent result counts and record ORDERED OPAQUE (the gemv
+discipline: own dispatch at flush, record order preserved, no flush
+cliff, no warn) — their count returns a lazy :class:`DeferredCount`
+resolving on host materialization.
+
+Failure matrix: API misuse (wrong range kinds, mismatched dtypes or
+meshes, unknown ``agg``/``how``) raises ``TypeError``/``ValueError``
+at the call site, BEFORE anything records or dispatches; a result
+that overflows the caller's output capacity raises a classified
+``resilience.ProgramError`` AFTER the program ran (the first
+``capacity`` rows are valid, the message names the real size);
+backend faults ride the existing sites (``dispatch.cache`` /
+``device.lost`` on every program dispatch, ``plan.flush`` for
+deferred runs) and surface classified like every other algorithm.
+
+Key equality is the sort family's monotone total-order encoding:
+``-0.0 == +0.0``, every NaN is ONE key (NaN keys group together and
+JOIN each other — numpy's NaNs-last order, unlike pandas' NaN-drop),
+f64 keys are exact on x64-enabled meshes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._common import owned_window_mask, working_geometry
+from ..core.pinning import pinned_id
+from .elementwise import (_apply_chain_ops, _chain_scalars, _out_chain,
+                          _plan_active, _prog_cache, _resolve,
+                          _traced_op_key, copy as _copy)
+from .reduce import _identity_for
+from .sort import _decode, _encode
+from .. import obs as _obs
+from ..utils import resilience as _resilience
+from ..views import views as _v
+
+__all__ = ["join", "groupby_aggregate", "unique", "histogram", "top_k",
+           "DeferredCount", "AGGS", "JOIN_HOWS"]
+
+#: supported groupby aggregations (docs/SPEC.md §17.1)
+AGGS = ("sum", "min", "max", "count", "mean")
+#: supported join flavors (outer = ROADMAP follow-up)
+JOIN_HOWS = ("inner", "left", "right")
+
+_GMAX = np.int32(np.iinfo(np.int32).max)
+
+
+class DeferredCount:
+    """Lazy result count from a relational op recorded OPAQUE in a
+    deferred region (``join``/``groupby_aggregate``/``unique``).
+    Resolving it (``item()`` / ``int()`` / ``float()`` / ``bool()`` /
+    ``==``) flushes the owning plan if still pending — host
+    materialization is a flush point, the ``PlanScalar`` contract.  A
+    count whose flush was discarded (faulted flush, abandoned region)
+    raises instead of returning a stale number."""
+
+    __slots__ = ("_plan", "_box")
+
+    def __init__(self, plan, box):
+        self._plan = plan
+        self._box = box
+
+    def item(self) -> int:
+        if not self._box:
+            self._plan.flush("relational count read")
+        if not self._box:
+            raise RuntimeError(
+                "deferred relational count was discarded before it "
+                "resolved (faulted flush or abandoned region)")
+        return int(self._box[-1])
+
+    def __int__(self):
+        return self.item()
+
+    def __index__(self):
+        return self.item()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __eq__(self, other):
+        if isinstance(other, DeferredCount):
+            other = other.item()
+        return self.item() == other
+
+    # resolving inside hash() would be a hidden flush (PlanScalar rule)
+    __hash__ = None
+
+    def __repr__(self):
+        state = repr(self._box[-1]) if self._box else "pending"
+        return f"DeferredCount({state})"
+
+
+# ---------------------------------------------------------------------------
+# shared plumbing
+# ---------------------------------------------------------------------------
+
+class _InChain:
+    """A resolved input chain bundled with the ORIGINAL range object
+    (``view``) so the scratch copy fuses the whole view pipeline."""
+
+    __slots__ = ("cont", "off", "n", "ops", "view")
+
+    def __init__(self, chain, view):
+        self.cont = chain.cont
+        self.off = chain.off
+        self.n = chain.n
+        self.ops = chain.ops
+        self.view = view
+
+
+def _single_chain(r, what: str):
+    """Resolve ``r`` into ONE distributed container chain or raise."""
+    chains = _resolve(r) if not isinstance(r, _v.zip_view) else None
+    if chains is None or len(chains) != 1:
+        raise TypeError(
+            f"{what} takes a single distributed range (a "
+            "distributed_vector or a view chain over one)")
+    return chains[0]
+
+
+def _in_chain(r, what: str) -> _InChain:
+    return _InChain(_single_chain(r, what), r)
+
+
+def _whole_out(out, what: str):
+    """Output containers must be WHOLE non-empty distributed_vectors
+    (the relational programs rebuild the full padded rows)."""
+    chain = _out_chain(out)
+    if chain.off != 0 or chain.n != len(chain.cont):
+        raise TypeError(f"{what}: output must be a whole "
+                        "distributed_vector (windows are not supported)")
+    if chain.n == 0:
+        raise TypeError(f"{what}: output container must be non-empty")
+    return chain
+
+
+def _worst(dtype, largest: bool):
+    """The dtype's FINITE worst value in the requested order — the
+    top_k empty-slot sentinel (finite so the DR_TPU_SANITIZE plan-flush
+    sweep keeps meaning; a real value equal to it merely ties and
+    loses to any real index)."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.floating):
+        fi = jnp.finfo(dt)
+        return jnp.array(fi.min if largest else fi.max, dt)
+    ii = jnp.iinfo(dt)
+    return jnp.array(ii.min if largest else ii.max, dt)
+
+
+def _dest_geometry(layout):
+    """Static destination-side geometry for the output assembly:
+    ``(So, starts_c, sizes_c)`` — slot ``t`` of shard ``d`` holds
+    result position ``starts[d] + t`` while ``t < sizes[d]``."""
+    _, So, _, _, _, _, starts, sizes = working_geometry(layout)
+    return (So, jnp.asarray(np.asarray(starts), jnp.int32),
+            jnp.asarray(np.asarray(sizes), jnp.int32))
+
+
+def _pack_out_row(vals, live, layout, r):
+    """Place per-slot values (``(So,)`` in result coordinates for
+    shard ``r``) into a full padded shard row, zeroing pad/halo/tail
+    cells — the whole-container analog of the sort family's
+    ``_pack_row``.  ``live`` masks the slots actually written."""
+    _, So, cap, oprev, onxt, _, _, sizes = working_geometry(layout)
+    sizes_c = jnp.asarray(np.asarray(sizes), jnp.int32)
+    owidth = oprev + cap + onxt
+    col = jnp.arange(owidth) - oprev
+    colc = jnp.clip(col, 0, So - 1)
+    ok = (col >= 0) & (col < sizes_c[r]) & jnp.take(live, colc)
+    return jnp.where(ok, jnp.take(vals, colc),
+                     jnp.zeros((), vals.dtype))[None]
+
+
+def _sorted_scratch(chain: _InChain, vchain=None, *, sid=0,
+                    phase="sort"):
+    """Copy key (and value) chains into fresh UNIFORM scratch
+    containers on the key runtime and stable-sort by key — the
+    non-mutating backbone step every relational op starts from.
+    Returns ``(skeys, svals_or_None, n)``; for ``n == 0`` the scratch
+    is a masked-off single cell (the programs take the REAL count as a
+    static parameter)."""
+    from ..containers.distributed_vector import distributed_vector
+    from .sort import sort as _sort, sort_by_key as _sort_by_key
+    t0 = _obs.now()
+    n = chain.n
+    rt = chain.cont.runtime
+    cap = max(n, 1)
+    sk = distributed_vector(cap, dtype=chain.cont.dtype, runtime=rt)
+    sv = None
+    if vchain is not None:
+        sv = distributed_vector(cap, dtype=vchain.cont.dtype,
+                                runtime=rt)
+    if n:
+        _copy(chain.view, sk)
+        if sv is not None:
+            _copy(vchain.view, sv)
+            _sort_by_key(sk, sv)
+        else:
+            _sort(sk)
+    _obs.complete("relational.phase", t0, cat="relational", parent=sid,
+                  phase=phase, n=n)
+    return sk, sv, n
+
+
+def _raise_capacity(what: str, need: int, cap: int) -> None:
+    raise _resilience.ProgramError(
+        f"{what}: result has {need} rows but the output containers "
+        f"hold only {cap} — the first {cap} rows are valid; size the "
+        "outputs for the worst case or pre-aggregate")
+
+
+# ---------------------------------------------------------------------------
+# groupby_aggregate / unique
+# ---------------------------------------------------------------------------
+
+def _acc_dtype(vdtype):
+    """Aggregation accumulator dtype: low-precision floats accumulate
+    in f32 (the scan kernel's rule); everything else keeps its own."""
+    dt = jnp.dtype(vdtype)
+    if jnp.issubdtype(dt, jnp.inexact):
+        return jnp.promote_types(dt, jnp.float32)
+    return dt
+
+
+def _groupby_program(mesh, axis, klayout, kdtype, vlayout, vdtype,
+                     ok_layout, ok_dtype, ov_layout, ov_dtype, agg,
+                     nreal):
+    """One fused program: boundary flags -> local segmented reduce ->
+    masked all_to_all partial combine into each OUT distribution.
+    ``vlayout`` is None for ``values=None`` (count), ``ov_layout``
+    None for the keys-only form (``unique``).  ``nreal`` is the REAL
+    element count (the scratch capacity is max(n, 1))."""
+    key = ("relgb", pinned_id(mesh), axis, klayout, str(kdtype),
+           vlayout, str(vdtype) if vlayout is not None else None,
+           ok_layout, str(ok_dtype),
+           ov_layout, str(ov_dtype) if ov_layout is not None else None,
+           agg, int(nreal), bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    p, S, cap, prev, nxt, ncap, starts, sizes = \
+        working_geometry(klayout)
+    assert prev == 0 and nxt == 0 and cap == S, \
+        "groupby scratch must be a fresh halo-free uniform container"
+    has_vals = vlayout is not None
+    has_ov = ov_layout is not None
+    acc = _acc_dtype(vdtype) if has_vals else jnp.int32
+    nseg = S + 1
+
+    def body(kblk, *rest):
+        r = lax.axis_index(axis)
+        x = kblk[0]                                    # (S,)
+        kenc, big = _encode(x)
+        nvalid = jnp.clip(nreal - r * S, 0, S)
+        valid = jnp.arange(S) < nvalid
+        kenc = jnp.where(valid, kenc, big)
+        # boundary flags: uniform ceil layouts have only TRAILING
+        # short shards, so any nonempty shard's predecessor is FULL
+        # and its last real key sits at position S-1 — one p-wide
+        # all_gather finds every cross-shard group continuation
+        lasts = lax.all_gather(kenc[S - 1], axis)      # (p,)
+        prevk = lasts[jnp.maximum(r - 1, 0)]
+        first = jnp.where(r == 0, valid[0],
+                          valid[0] & (kenc[0] != prevk))
+        flags = jnp.concatenate(
+            [first[None].astype(jnp.int32),
+             (valid[1:] & (kenc[1:] != kenc[:-1])).astype(jnp.int32)])
+        segid = jnp.cumsum(flags)      # 0 = continuation of prev shard
+        m = segid[S - 1]               # my run count
+        counts = lax.all_gather(m, axis)               # (p,)
+        gid_off = jnp.sum(jnp.where(jnp.arange(p) < r, counts, 0))
+        ng = jnp.sum(counts)
+
+        # local segmented reduce over the static <= S+1 run segments —
+        # the bucketed scatter-add of the reduce path.  My segment j
+        # holds global group id gid_off - 1 + j (segment 0 continues
+        # the previous shard's open group).
+        pkey = jax.ops.segment_min(jnp.where(valid, kenc, big), segid,
+                                   num_segments=nseg)
+        pcnt = jax.ops.segment_sum(valid.astype(jnp.int32), segid,
+                                   num_segments=nseg)
+        if has_vals:
+            vacc = rest[0][0].astype(acc)
+            psum_ = jax.ops.segment_sum(
+                jnp.where(valid, vacc, jnp.zeros((), acc)), segid,
+                num_segments=nseg)
+            pmin = jax.ops.segment_min(
+                jnp.where(valid, vacc, _identity_for("min", acc)),
+                segid, num_segments=nseg)
+            pmax = jax.ops.segment_max(
+                jnp.where(valid, vacc, _identity_for("max", acc)),
+                segid, num_segments=nseg)
+
+        def assemble(layout, partial, ident, combine):
+            """Re-home per-run partials into ``layout``'s windows: one
+            masked all_to_all (the sort family's rebalance pattern) +
+            a per-column monoid combine — a group split across shard
+            boundaries merges its partials here.  Identity sends
+            (empty segment 0, empty shards) are absorbed exactly."""
+            So, starts_c, sizes_c = _dest_geometry(layout)
+            ogid = starts_c[:, None] + jnp.arange(So)[None, :]
+            slot_ok = jnp.arange(So)[None, :] < sizes_c[:, None]
+            idx = ogid - (gid_off - 1)
+            have = slot_ok & (idx >= 0) & (idx <= m)
+            send = jnp.where(have,
+                             jnp.take(partial,
+                                      jnp.clip(idx, 0, nseg - 1)),
+                             ident)
+            recv = lax.all_to_all(send, axis, 0, 0)  # row s = from s
+            return combine(recv, axis=0)             # (So,) my slots
+
+        def live_for(layout):
+            So, starts_c, _ = _dest_geometry(layout)
+            return (starts_c[r] + jnp.arange(So)) < ng
+
+        akey = assemble(ok_layout, pkey, big, jnp.min)
+        klive = live_for(ok_layout)
+        # decode through the KEY dtype (the encoding's inverse is
+        # dtype-directed), THEN cast to the out container's dtype —
+        # decoding a float encoding as int would emit garbage keys
+        keyvals = _decode(akey, kdtype).astype(ok_dtype)
+        keyvals = jnp.where(klive, keyvals, jnp.zeros((), ok_dtype))
+        okrow = _pack_out_row(keyvals, klive, ok_layout, r)
+        if not has_ov:
+            return okrow, ng
+        acnt = assemble(ov_layout, pcnt, jnp.zeros((), jnp.int32),
+                        jnp.sum)
+        if agg == "count":
+            av = acnt
+        elif agg == "min":
+            av = assemble(ov_layout, pmin, _identity_for("min", acc),
+                          jnp.min)
+        elif agg == "max":
+            av = assemble(ov_layout, pmax, _identity_for("max", acc),
+                          jnp.max)
+        else:  # sum / mean
+            av = assemble(ov_layout, psum_, jnp.zeros((), acc),
+                          jnp.sum)
+            if agg == "mean":
+                av = av / jnp.maximum(acnt, 1).astype(av.dtype)
+        vlive = live_for(ov_layout)
+        av = jnp.where(vlive, av.astype(ov_dtype),
+                       jnp.zeros((), ov_dtype))
+        return okrow, _pack_out_row(av, vlive, ov_layout, r), ng
+
+    nin = 2 if has_vals else 1
+    nout = 2 if has_ov else 1
+    # check_vma=False: ``ng`` folds the same all_gather'ed count
+    # vector identically on every shard, so the P() output IS
+    # replicated — the static checker cannot prove it (the
+    # _custom_reduce_program precedent)
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) * nin,
+                        out_specs=(P(axis, None),) * nout + (P(),),
+                        check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _check_groupby(keys, values, out_keys, out_values):
+    """The FULL groupby argument validation — run at the call site
+    (deferred regions included, §17.5) AND again by the eager body at
+    flush (replayed thunks re-resolve)."""
+    kc = _in_chain(keys, "groupby_aggregate")
+    vc = _in_chain(values, "groupby_aggregate") \
+        if values is not None else None
+    okc = _whole_out(out_keys, "groupby_aggregate")
+    ovc = _whole_out(out_values, "groupby_aggregate") \
+        if out_values is not None else None
+    if vc is not None and vc.n != kc.n:
+        raise ValueError(
+            f"groupby_aggregate: keys and values must have equal "
+            f"length ({kc.n} != {vc.n})")
+    if ovc is not None and ovc.n != okc.n:
+        # unequal capacities would let the smaller side silently drop
+        # rows the returned count claims exist (the join contract)
+        raise ValueError(
+            f"groupby_aggregate: out_keys and out_values must share "
+            f"one capacity ({okc.n} != {ovc.n})")
+    rt = kc.cont.runtime
+    for oc, nm in ((okc, "out_keys"), (ovc, "out_values")):
+        if oc is not None and oc.cont.runtime.mesh != rt.mesh:
+            raise TypeError(
+                f"groupby_aggregate: {nm} must live on the keys' mesh")
+    return kc, vc, okc, ovc
+
+
+def _groupby_eager(keys, values, out_keys, out_values, agg) -> int:
+    kc, vc, okc, ovc = _check_groupby(keys, values, out_keys,
+                                      out_values)
+    rt = kc.cont.runtime
+    what = "unique" if ovc is None else f"groupby[{agg}]"
+    sid = _obs.begin("relational.groupby", cat="relational", agg=agg,
+                     n=kc.n)
+    ng = -1
+    try:
+        sk, sv, n = _sorted_scratch(kc, vc, sid=sid)
+        t0 = _obs.now()
+        prog = _groupby_program(
+            rt.mesh, rt.axis, sk.layout, sk.dtype,
+            sv.layout if sv is not None else None,
+            sv.dtype if sv is not None else None,
+            okc.cont.layout, okc.cont.dtype,
+            ovc.cont.layout if ovc is not None else None,
+            ovc.cont.dtype if ovc is not None else None,
+            agg, n)
+        args = [sk._data] + ([sv._data] if sv is not None else [])
+        outs = prog(*args)
+        if ovc is not None:
+            okc.cont._data, ovc.cont._data, ngd = outs
+        else:
+            okc.cont._data, ngd = outs
+        ng = int(ngd)
+        _obs.complete("relational.phase", t0, cat="relational",
+                      parent=sid, phase="aggregate", groups=ng)
+        if ng > okc.n:
+            _raise_capacity(what, ng, okc.n)
+        return ng
+    finally:
+        _obs.end(sid, groups=ng)
+
+
+def groupby_aggregate(keys, values, out_keys, out_values,
+                      agg: str = "sum"):
+    """Distributed group-by: aggregate ``values`` per distinct key.
+
+    Non-mutating in ``keys``/``values``.  The distinct keys land in
+    ``out_keys[0:ngroups]`` in SORTED order with the aggregate at the
+    matching ``out_values`` position (both whole distributed_vectors —
+    the capacity; positions ``>= ngroups`` are zero); returns
+    ``ngroups`` (a lazy :class:`DeferredCount` inside
+    ``dr_tpu.deferred()``, where the op records ordered-opaque).
+    ``agg`` is one of ``sum`` / ``min`` / ``max`` / ``count`` /
+    ``mean`` (``count`` accepts ``values=None``).  A result larger
+    than the capacity raises a classified ``ProgramError`` after the
+    program ran (the first ``len(out_keys)`` groups are valid)."""
+    if agg not in AGGS:
+        raise ValueError(f"groupby_aggregate: unknown agg {agg!r} "
+                         f"(known: {', '.join(AGGS)})")
+    if values is None and agg != "count":
+        raise ValueError(
+            f"groupby_aggregate: agg {agg!r} needs values "
+            "(only 'count' accepts values=None)")
+    # validate NOW — API misuse must raise at the call site whether or
+    # not a plan is recording — then defer the dispatch when one is
+    # (out_values=None is only the internal unique form)
+    _check_groupby(keys, values, out_keys, out_values)
+    p = _plan_active()
+    if p is not None:
+        box: list = []
+        p.record_opaque(
+            "groupby_aggregate",
+            lambda k=keys, v=values, ok=out_keys, ov=out_values, a=agg:
+            box.append(_groupby_eager(k, v, ok, ov, a)))
+        return DeferredCount(p, box)
+    return _groupby_eager(keys, values, out_keys, out_values, agg)
+
+
+def unique(r, out):
+    """Sorted distinct values of ``r`` into ``out[0:count]`` (a whole
+    distributed_vector; positions ``>= count`` are zero).  Returns the
+    distinct count (lazy :class:`DeferredCount` in deferred regions).
+    Keys-only ``groupby_aggregate`` machinery — same sort backbone,
+    same capacity contract."""
+    _in_chain(r, "unique")
+    _whole_out(out, "unique")
+    p = _plan_active()
+    if p is not None:
+        box: list = []
+        p.record_opaque(
+            "unique",
+            lambda k=r, ok=out:
+            box.append(_groupby_eager(k, None, ok, None, "count")))
+        return DeferredCount(p, box)
+    return _groupby_eager(r, None, out, None, "count")
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def _join_program(mesh, axis, llayout, lkdtype, lvdtype, rlayout,
+                  rkdtype, rvdtype, ok_layout, ok_dtype, ol_layout,
+                  ol_dtype, or_layout, or_dtype, nl, nr, left_outer):
+    """Sorted-merge join program over the SORTED scratch sides.  Each
+    shard all_gathers the sorted (key, value) channels (broadcast
+    sorted-merge, memory O(nl + nr) per device — see the module
+    docstring), counts matches per left row with two searchsorteds on
+    the monotone encoding, prefix-sums the expansion offsets, and
+    materializes exactly its own window of the expanded rows per OUT
+    distribution."""
+    key = ("reljoin", pinned_id(mesh), axis, llayout, str(lkdtype),
+           str(lvdtype), rlayout, str(rkdtype), str(rvdtype),
+           ok_layout, str(ok_dtype), ol_layout, str(ol_dtype),
+           or_layout, str(or_dtype), int(nl), int(nr),
+           bool(left_outer), bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    p, Sl, *_ = working_geometry(llayout)
+    _, Sr, *_ = working_geometry(rlayout)
+    NL, NR = p * Sl, p * Sr
+
+    def body(lkb, lvb, rkb, rvb, fillv):
+        r = lax.axis_index(axis)
+        LK = lax.all_gather(lkb[0], axis).reshape(-1)   # (NL,)
+        LV = lax.all_gather(lvb[0], axis).reshape(-1)
+        RK = lax.all_gather(rkb[0], axis).reshape(-1)   # (NR,)
+        RV = lax.all_gather(rvb[0], axis).reshape(-1)
+        kl, bigl = _encode(LK)
+        kr, bigr = _encode(RK)
+        lvalid = jnp.arange(NL) < nl
+        kl = jnp.where(lvalid, kl, bigl)
+        kr = jnp.where(jnp.arange(NR) < nr, kr, bigr)
+        # match counts per left row: two searchsorteds on the monotone
+        # encoding (the pad sentinel strictly follows every real key,
+        # so a pad can only match pads — and lvalid masks those out)
+        lo = jnp.searchsorted(kr, kl, side="left")
+        hi = jnp.searchsorted(kr, kl, side="right")
+        cnt = jnp.where(lvalid, (hi - lo).astype(jnp.int32), 0)
+        if left_outer:
+            rows = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)
+        else:
+            rows = cnt
+        offs = jnp.cumsum(rows)                         # inclusive
+        M = offs[NL - 1]
+
+        def out_channel(layout, produce, dtype):
+            """My window of the expanded rows under ``layout``:
+            result row j expands left element i = first index whose
+            inclusive offset exceeds j, at in-group position
+            j - exclusive_offset(i)."""
+            So, starts_c, _sizes = _dest_geometry(layout)
+            j = starts_c[r] + jnp.arange(So)
+            live = j < M
+            i = jnp.clip(jnp.searchsorted(offs, j, side="right"), 0,
+                         NL - 1)
+            base = jnp.take(offs, i) - jnp.take(rows, i)
+            matched = jnp.take(cnt, i) > 0
+            rpos = jnp.clip(jnp.take(lo, i) + (j - base), 0, NR - 1)
+            vals = produce(i, rpos, matched)
+            vals = jnp.where(live, vals.astype(dtype),
+                             jnp.zeros((), dtype))
+            return _pack_out_row(vals, live, layout, r)
+
+        okrow = out_channel(ok_layout,
+                            lambda i, rp, mt: jnp.take(LK, i),
+                            ok_dtype)
+        olrow = out_channel(ol_layout,
+                            lambda i, rp, mt: jnp.take(LV, i),
+                            ol_dtype)
+        orrow = out_channel(
+            or_layout,
+            lambda i, rp, mt: jnp.where(
+                mt, jnp.take(RV, rp).astype(or_dtype),
+                fillv.astype(or_dtype)),
+            or_dtype)
+        return okrow, olrow, orrow, M
+
+    # check_vma=False: ``M`` derives from the same all_gather'ed
+    # channels on every shard (replicated, unprovable statically —
+    # the _custom_reduce_program precedent)
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) * 4 + (P(),),
+                        out_specs=(P(axis, None),) * 3 + (P(),),
+                        check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _check_join(lk, lv, rk, rv, out_keys, out_lv, out_rv):
+    """The FULL join argument validation — run at the call site
+    (deferred regions included, §17.5) AND again by the eager body at
+    flush.  Symmetric in the sides, so the right-join swap passes the
+    same checks."""
+    lkc = _in_chain(lk, "join")
+    lvc = _in_chain(lv, "join")
+    rkc = _in_chain(rk, "join")
+    rvc = _in_chain(rv, "join")
+    if lkc.n != lvc.n or rkc.n != rvc.n:
+        raise ValueError(
+            f"join: keys and values must have equal length per side "
+            f"({lkc.n} != {lvc.n} or {rkc.n} != {rvc.n})")
+    if jnp.dtype(lkc.cont.dtype) != jnp.dtype(rkc.cont.dtype):
+        raise TypeError(
+            f"join: key dtypes must match ({lkc.cont.dtype} != "
+            f"{rkc.cont.dtype})")
+    okc = _whole_out(out_keys, "join")
+    olc = _whole_out(out_lv, "join")
+    orc = _whole_out(out_rv, "join")
+    if olc.n != okc.n or orc.n != okc.n:
+        raise ValueError("join: the three output containers must "
+                         "share one capacity")
+    rt = lkc.cont.runtime
+    for c, nm in ((rkc, "right keys"), (okc, "out_keys"),
+                  (olc, "out_left"), (orc, "out_right")):
+        if c.cont.runtime.mesh != rt.mesh:
+            raise TypeError(f"join: {nm} must live on the left keys' "
+                            "mesh")
+    return lkc, lvc, rkc, rvc, okc, olc, orc
+
+
+def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
+                fill) -> int:
+    if how == "right":
+        # a right join IS the left join with the sides swapped: the
+        # output keys follow the right side's sorted order and the
+        # fill lands on the LEFT value column
+        return _join_eager(rk, rv, lk, lv, out_keys, out_rv, out_lv,
+                           "left", fill)
+    lkc, lvc, rkc, rvc, okc, olc, orc = _check_join(
+        lk, lv, rk, rv, out_keys, out_lv, out_rv)
+    cap = okc.n
+    rt = lkc.cont.runtime
+    sid = _obs.begin("relational.join", cat="relational", how=how,
+                     n_left=lkc.n, n_right=rkc.n)
+    m = -1
+    try:
+        if lkc.n == 0 or (how == "inner" and rkc.n == 0):
+            # no left rows (or inner against an empty right): zero
+            # rows — zero the outputs so the tail contract holds
+            from .elementwise import fill as _fill
+            t0 = _obs.now()
+            for oc in (out_keys, out_lv, out_rv):
+                _fill(oc, 0)
+            m = 0
+            _obs.complete("relational.phase", t0, cat="relational",
+                          parent=sid, phase="empty")
+            return 0
+        slk, slv, nl = _sorted_scratch(lkc, lvc, sid=sid,
+                                       phase="sort_left")
+        srk, srv, nr = _sorted_scratch(rkc, rvc, sid=sid,
+                                       phase="sort_right")
+        t0 = _obs.now()
+        prog = _join_program(
+            rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
+            srk.layout, srk.dtype, srv.dtype,
+            okc.cont.layout, okc.cont.dtype,
+            olc.cont.layout, olc.cont.dtype,
+            orc.cont.layout, orc.cont.dtype,
+            nl, nr, how == "left")
+        okc.cont._data, olc.cont._data, orc.cont._data, md = prog(
+            slk._data, slv._data, srk._data, srv._data,
+            jnp.asarray(fill, orc.cont.dtype))
+        m = int(md)
+        _obs.complete("relational.phase", t0, cat="relational",
+                      parent=sid, phase="merge", rows=m)
+        if m > cap:
+            _raise_capacity(f"join[{how}]", m, cap)
+        return m
+    finally:
+        _obs.end(sid, rows=m)
+
+
+def join(left_keys, left_values, right_keys, right_values, out_keys,
+         out_left, out_right, *, how: str = "inner", fill=0):
+    """Distributed sort-merge join (docs/SPEC.md §17.1).
+
+    Matches ``left_keys`` against ``right_keys`` (same key dtype, the
+    sort family's total-order equality) and writes one row per match
+    pair — ``out_keys[i]`` the key, ``out_left[i]`` /
+    ``out_right[i]`` the two sides' values — ordered by (key, left
+    position, right position).  Duplicate keys expand many-to-many,
+    exactly pandas ``merge`` row multiplicity.  ``how="left"`` /
+    ``"right"`` additionally emit every unmatched row of that side
+    with ``fill`` on the missing value column (presence flags);
+    ``how="inner"`` is the default.  Non-mutating in the inputs; the
+    three whole-container outputs share one capacity, positions
+    ``>= count`` are zero.  Returns the row count (lazy
+    :class:`DeferredCount` inside ``dr_tpu.deferred()``, where the op
+    records ordered-opaque); a result beyond the capacity raises a
+    classified ``ProgramError`` after the program ran."""
+    if how not in JOIN_HOWS:
+        raise ValueError(f"join: unknown how {how!r} "
+                         f"(known: {', '.join(JOIN_HOWS)})")
+    # validate NOW — API misuse must raise at the call site whether or
+    # not a plan is recording (§17.5)
+    _check_join(left_keys, left_values, right_keys, right_values,
+                out_keys, out_left, out_right)
+    p = _plan_active()
+    if p is not None:
+        box: list = []
+        p.record_opaque(
+            "join",
+            lambda a=left_keys, b=left_values, c=right_keys,
+            d=right_values, ok=out_keys, ol=out_left, orr=out_right,
+            h=how, f=fill:
+            box.append(_join_eager(a, b, c, d, ok, ol, orr, h, f)))
+        return DeferredCount(p, box)
+    return _join_eager(left_keys, left_values, right_keys,
+                       right_values, out_keys, out_left, out_right,
+                       how, fill)
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+def _histogram_body(axis, in_layout, off, n, ops, nsc, out_layout,
+                    bins, out_dtype):
+    """The histogram shard body — shared verbatim between the eager
+    program below and the deferred-plan fusible emit
+    (``plan.record_histogram``).  ``scalars`` = the view chain's
+    BoundOp values then (lo, hi), all TRACED (a streamed range reuses
+    one program)."""
+    So, starts_c, _sizes = _dest_geometry(out_layout)
+
+    def body(blk, *scalars):
+        r = lax.axis_index(axis)
+        sc_iter = iter(scalars[:nsc])
+        lo, hi = scalars[nsc], scalars[nsc + 1]
+        x = _apply_chain_ops(blk[0], ops, sc_iter)
+        mask, _gid = owned_window_mask(in_layout, off, n)
+        pt = jnp.promote_types(x.dtype, jnp.float32)
+        xv = x.astype(pt)
+        lov = lo.astype(pt)
+        hiv = hi.astype(pt)
+        # bucket = floor((x - lo) * bins / (hi - lo)), right edge
+        # INCLUSIVE in the last bucket (numpy's rule); out-of-range
+        # values drop out of the in-range mask
+        b = jnp.floor((xv - lov) * bins / (hiv - lov)) \
+            .astype(jnp.int32)
+        inr = mask[r] & (xv >= lov) & (xv <= hiv)
+        bc = jnp.clip(jnp.where(inr, b, 0), 0, bins - 1)
+        local = jax.ops.segment_sum(
+            jnp.where(inr, 1, 0).astype(jnp.int32), bc,
+            num_segments=bins)
+        total = lax.psum(local, axis)                  # (bins,)
+        t = starts_c[r] + jnp.arange(So)
+        live = t < bins
+        vals = jnp.where(live,
+                         jnp.take(total, jnp.clip(t, 0, bins - 1))
+                         .astype(out_dtype),
+                         jnp.zeros((), out_dtype))
+        return _pack_out_row(vals, live, out_layout, r)
+
+    return body
+
+
+def _histogram_program(mesh, axis, in_layout, off, n, in_dtype, ops,
+                       out_layout, out_dtype, bins):
+    nsc = sum(len(o.scalars) for o in ops if isinstance(o, _v.BoundOp))
+    key = ("relhist", pinned_id(mesh), axis, in_layout, off, n,
+           str(in_dtype), tuple(_traced_op_key(o) for o in ops),
+           out_layout, str(out_dtype), int(bins))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    body = _histogram_body(axis, in_layout, off, n, ops, nsc,
+                           out_layout, bins, out_dtype)
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) + (P(),) * (nsc + 2),
+                        out_specs=P(axis, None))
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def histogram(r, out, lo, hi):
+    """Fixed-bin histogram of a distributed range (docs/SPEC.md
+    §17.1): ``bins = len(out)`` equal buckets over ``[lo, hi]``
+    (right edge inclusive in the last bucket, numpy's rule;
+    out-of-range values are dropped), counts cast to ``out``'s dtype.
+    Input view chains fuse; ``lo``/``hi`` are traced operands, so a
+    streamed range reuses ONE compiled program.  STATIC output shape:
+    inside ``dr_tpu.deferred()`` the op records FUSIBLE into the
+    surrounding run.  Returns ``out``."""
+    if isinstance(lo, (int, float, np.number)) \
+            and isinstance(hi, (int, float, np.number)) \
+            and not (float(hi) > float(lo)):
+        raise ValueError(f"histogram: need hi > lo (got [{lo}, {hi}])")
+    chain = _single_chain(r, "histogram")
+    oc = _whole_out(out, "histogram")
+    if oc.cont.runtime.mesh != chain.cont.runtime.mesh:
+        raise TypeError("histogram: out must live on the input's mesh")
+    p = _plan_active()
+    if p is not None:
+        p.record_histogram(chain, oc, lo, hi)
+        return out
+    sid = _obs.begin("relational.histogram", cat="relational",
+                     n=chain.n, bins=oc.n)
+    try:
+        rt = chain.cont.runtime
+        prog = _histogram_program(
+            rt.mesh, rt.axis, chain.cont.layout, chain.off, chain.n,
+            chain.cont.dtype, tuple(chain.ops), oc.cont.layout,
+            oc.cont.dtype, oc.n)
+        svals = [jnp.asarray(s) for s in _chain_scalars([chain])]
+        oc.cont._data = prog(chain.cont._data, *svals,
+                             jnp.asarray(lo), jnp.asarray(hi))
+        return out
+    finally:
+        _obs.end(sid)
+
+
+# ---------------------------------------------------------------------------
+# top_k
+# ---------------------------------------------------------------------------
+
+def _top_k_body(axis, in_layout, off, n, ops, nsc, ov_layout, ov_dtype,
+                oi_layout, k, largest, merge):
+    """The top-k shard body — shared between the eager program and the
+    deferred-plan fusible emit (``plan.record_top_k``).  Signature:
+    ``body(in_row[, ov_row[, oi_row]], *chain_scalars)`` — the out
+    rows are inputs only under ``merge`` (their current contents join
+    the candidate pool)."""
+    has_idx = oi_layout is not None
+    p, S, *_ = working_geometry(in_layout)
+    sentinel = _worst(ov_dtype, largest)
+
+    def order_of(vals):
+        # ascending 'order' = best first: the monotone encoding,
+        # bit-inverted for largest (a monotone reversal for uints AND
+        # two's-complement ints alike)
+        enc, _big = _encode(vals)
+        return (~enc) if largest else enc
+
+    def body(blk, *rest):
+        r = lax.axis_index(axis)
+        nrows = ((3 if has_idx else 2) if merge else 1) - 1
+        sc_iter = iter(rest[nrows:])
+        x = _apply_chain_ops(blk[0], ops, sc_iter)
+        mask, gid = owned_window_mask(in_layout, off, n)
+        xv = jnp.where(mask[r], x.astype(ov_dtype), sentinel)
+        # indices are positions WITHIN the input range (window-local)
+        gv = jnp.where(mask[r], (gid[r] - off).astype(jnp.int32),
+                       _GMAX)
+        if merge:
+            ovb = rest[0]
+            omask, _og = owned_window_mask(ov_layout, 0, k)
+            mv = jnp.where(omask[r], ovb[0].astype(ov_dtype), sentinel)
+            if has_idx:
+                mg = jnp.where(omask[r], rest[1][0].astype(jnp.int32),
+                               _GMAX)
+            else:
+                mg = jnp.full(mv.shape, _GMAX, jnp.int32)
+            xv = jnp.concatenate([xv, mv])
+            gv = jnp.concatenate([gv, mg])
+        # per-shard 2-key sort (order, index): exact tie discipline —
+        # equal values keep the smaller index first; masked/pad cells
+        # are real sentinel values and sort last naturally
+        srt = lax.sort((order_of(xv), gv, xv), dimension=0, num_keys=2)
+        kk = min(k, xv.shape[0])
+        Go = lax.all_gather(srt[0][:kk], axis).reshape(-1)  # (p*kk,)
+        Gg = lax.all_gather(srt[1][:kk], axis).reshape(-1)
+        Gv = lax.all_gather(srt[2][:kk], axis).reshape(-1)
+        if p * kk < k:
+            pad = k - p * kk
+            Go = jnp.concatenate(
+                [Go, jnp.full((pad,), jnp.iinfo(Go.dtype).max,
+                              Go.dtype)])
+            Gg = jnp.concatenate(
+                [Gg, jnp.full((pad,), _GMAX, jnp.int32)])
+            Gv = jnp.concatenate(
+                [Gv, jnp.full((pad,), sentinel, ov_dtype)])
+        gs = lax.sort((Go, Gg, Gv), dimension=0, num_keys=2)
+        res_g, res_v = gs[1][:k], gs[2][:k]
+
+        Sov, ov_starts, _ = _dest_geometry(ov_layout)
+        t = ov_starts[r] + jnp.arange(Sov)
+        live = t < k
+        tc = jnp.clip(t, 0, k - 1)
+        ovrow = _pack_out_row(
+            jnp.where(live, jnp.take(res_v, tc), sentinel), live,
+            ov_layout, r)
+        if not has_idx:
+            return ovrow
+        Soi, oi_starts, _ = _dest_geometry(oi_layout)
+        ti = oi_starts[r] + jnp.arange(Soi)
+        ilive = ti < k
+        tic = jnp.clip(ti, 0, k - 1)
+        oirow = _pack_out_row(
+            jnp.where(ilive, jnp.take(res_g, tic), _GMAX), ilive,
+            oi_layout, r)
+        return ovrow, oirow
+
+    return body
+
+
+def _top_k_program(mesh, axis, in_layout, off, n, in_dtype, ops,
+                   ov_layout, ov_dtype, oi_layout, k, largest, merge):
+    nsc = sum(len(o.scalars) for o in ops if isinstance(o, _v.BoundOp))
+    key = ("reltopk", pinned_id(mesh), axis, in_layout, off, n,
+           str(in_dtype), tuple(_traced_op_key(o) for o in ops),
+           ov_layout, str(ov_dtype), oi_layout, int(k), bool(largest),
+           bool(merge), bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    body = _top_k_body(axis, in_layout, off, n, ops, nsc, ov_layout,
+                       ov_dtype, oi_layout, k, largest, merge)
+    has_idx = oi_layout is not None
+    nrows = (3 if has_idx else 2) if merge else 1
+    nout = 2 if has_idx else 1
+    shm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None),) * nrows + (P(),) * nsc,
+        out_specs=(P(axis, None),) * nout if nout > 1
+        else P(axis, None))
+    # under merge the out rows are rebuilt wholesale: donate them
+    donate = tuple(range(1, nrows)) if merge else ()
+    prog = jax.jit(shm, donate_argnums=donate)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _top_k_chains(r, out_vals, out_idx):
+    chain = _single_chain(r, "top_k")
+    ovc = _whole_out(out_vals, "top_k")
+    oic = _whole_out(out_idx, "top_k") if out_idx is not None else None
+    k = ovc.n
+    if oic is not None:
+        if oic.n != k:
+            raise ValueError(
+                f"top_k: out_idx length {oic.n} != k ({k})")
+        if jnp.dtype(oic.cont.dtype) != jnp.dtype(np.int32):
+            raise TypeError("top_k: out_idx must be int32")
+    mesh = chain.cont.runtime.mesh
+    for oc, nm in ((ovc, "out_vals"), (oic, "out_idx")):
+        if oc is not None and oc.cont.runtime.mesh != mesh:
+            raise TypeError(f"top_k: {nm} must live on the input's "
+                            "mesh")
+    return chain, ovc, oic
+
+
+def top_k(r, out_vals, out_idx=None, *, largest: bool = True,
+          merge: bool = False):
+    """The ``k = len(out_vals)`` best elements of a distributed range,
+    best-first (descending values for ``largest=True``; ties keep the
+    smaller index).  ``out_idx`` (optional, int32, length k) receives
+    each element's position WITHIN ``r`` (window-local for subranges).
+    When fewer than k elements exist, trailing slots hold the dtype's
+    finite worst value and index ``INT32_MAX``.
+
+    ``merge=True`` folds the CURRENT ``out_vals``/``out_idx`` contents
+    into the candidate pool — streaming top-k over windows::
+
+        top_k(v[0:w], vals, idx)                   # first window
+        top_k(v[w:2*w], vals, idx, merge=True)     # running top-k...
+
+    (window-local indices then mix across windows; ride an iota
+    payload through the values if global positions are needed).
+    STATIC output shape: inside ``dr_tpu.deferred()`` the op records
+    FUSIBLE into the surrounding run.  Returns ``out_vals``."""
+    chain, ovc, oic = _top_k_chains(r, out_vals, out_idx)
+    if merge and oic is not None \
+            and oic.cont.layout != ovc.cont.layout:
+        # the merged candidate pool pairs each CURRENT value with its
+        # index BY SLOT through one shared ownership mask — split
+        # layouts would mispair them (or crash on width mismatch)
+        raise TypeError(
+            "top_k: merge=True needs out_vals and out_idx on ONE "
+            "layout (their current contents pair by slot)")
+    p = _plan_active()
+    if p is not None:
+        p.record_top_k(chain, ovc, oic, largest, merge)
+        return out_vals
+    sid = _obs.begin("relational.top_k", cat="relational", n=chain.n,
+                     k=ovc.n, largest=largest, merge=merge)
+    try:
+        rt = chain.cont.runtime
+        prog = _top_k_program(
+            rt.mesh, rt.axis, chain.cont.layout, chain.off, chain.n,
+            chain.cont.dtype, tuple(chain.ops), ovc.cont.layout,
+            ovc.cont.dtype,
+            oic.cont.layout if oic is not None else None,
+            ovc.n, largest, merge)
+        svals = [jnp.asarray(s) for s in _chain_scalars([chain])]
+        rows = [chain.cont._data]
+        if merge:
+            rows.append(ovc.cont._data)
+            if oic is not None:
+                rows.append(oic.cont._data)
+        outs = prog(*rows, *svals)
+        if oic is not None:
+            ovc.cont._data, oic.cont._data = outs
+        else:
+            ovc.cont._data = outs
+        return out_vals
+    finally:
+        _obs.end(sid)

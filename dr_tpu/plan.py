@@ -40,7 +40,12 @@ Flush points (executing the queue in record order):
 ``gemv`` records as an ordered OPAQUE op (round 9, like
 inclusive_scan): it dispatches through its own program at flush,
 record order preserved, and the fusible runs around it stay fused —
-no flush cliff, no warn_fallback.
+no flush cliff, no warn_fallback.  The relational tier (round 14,
+docs/SPEC.md §17.2) splits the same way: ``histogram``/``top_k``
+have STATIC output shapes and record FUSIBLE
+(:meth:`Plan.record_histogram` / :meth:`Plan.record_top_k`), while
+``join``/``groupby_aggregate``/``unique`` record opaque and hand back
+lazy ``DeferredCount`` handles.
 
 Mid-chain reductions ride the carry as device scalars: a recorded
 reduce returns a :class:`PlanScalar` whose value is an output of the
@@ -637,6 +642,102 @@ class Plan:
             nx=nxt, ko=key_op, bo=body_op:
             self.record_stencil(ic, oc, ic.layout, per, pv, nx, ko, bo,
                                 ic.runtime.axis, ic.runtime.mesh))
+        return True
+
+    def record_histogram(self, in_chain, out_chain, lo, hi) -> bool:
+        """Fusible relational histogram (docs/SPEC.md §17.2): the
+        output shape is STATIC (bins = the out container), so the op
+        fuses into the surrounding run — the shared
+        ``relational._histogram_body`` shard-maps inside the fused
+        program, with the view chain's BoundOp scalars and (lo, hi)
+        as traced operands (a streamed range reuses one program)."""
+        in_cont, out_cont = in_chain.cont, out_chain.cont
+        all_sc = self._subst_scalars(
+            _chain_scalars([in_chain]) + [lo, hi])
+        run = self._fusible_run(out_cont, all_sc)
+        si, so = run.slot(in_cont), run.slot(out_cont)
+        spec, vals = self._scalar_spec(run, all_sc)
+        in_layout, off, n = in_cont.layout, in_chain.off, in_chain.n
+        out_layout, out_dtype = out_cont.layout, out_cont.dtype
+        bins = out_chain.n
+        ops = tuple(in_chain.ops)
+        nsc = len(all_sc) - 2
+        axis, mesh = out_cont.runtime.axis, out_cont.runtime.mesh
+        key = ("relhist", si, so, in_layout, off, n,
+               tuple(_traced_op_key(o) for o in ops), str(in_cont.dtype),
+               out_layout, str(out_dtype), bins, spec)
+
+        def emit(state, svals, souts):
+            from .algorithms import relational as _rel
+            body = _rel._histogram_body(axis, in_layout, off, n, ops,
+                                        nsc, out_layout, bins,
+                                        jnp.dtype(out_dtype))
+            shm = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(axis, None),) + (P(),) * (nsc + 2),
+                out_specs=P(axis, None))
+            state[so] = shm(state[si], *svals)
+
+        run.ops.append(_FusedOp("histogram", key, emit, spec, vals))
+        self._note_replay(
+            lambda ic=in_chain, oc=out_chain, l=lo, h=hi:
+            self.record_histogram(ic, oc, l, h))
+        return True
+
+    def record_top_k(self, in_chain, ov_chain, oi_chain, largest,
+                     merge) -> bool:
+        """Fusible relational top-k (docs/SPEC.md §17.2): k is the out
+        container's static length, so the op fuses into the
+        surrounding run via the shared ``relational._top_k_body``.
+        Under ``merge`` the out containers' CURRENT run state joins
+        the candidate pool — record order gives it exactly the eager
+        streaming semantics."""
+        in_cont, ov_cont = in_chain.cont, ov_chain.cont
+        oi_cont = oi_chain.cont if oi_chain is not None else None
+        all_sc = self._subst_scalars(_chain_scalars([in_chain]))
+        run = self._fusible_run(ov_cont, all_sc)
+        si, sov = run.slot(in_cont), run.slot(ov_cont)
+        soi = run.slot(oi_cont) if oi_cont is not None else None
+        spec, vals = self._scalar_spec(run, all_sc)
+        in_layout, off, n = in_cont.layout, in_chain.off, in_chain.n
+        ov_layout, ov_dtype = ov_cont.layout, ov_cont.dtype
+        oi_layout = oi_cont.layout if oi_cont is not None else None
+        k = ov_chain.n
+        ops = tuple(in_chain.ops)
+        nsc = len(all_sc)
+        axis, mesh = ov_cont.runtime.axis, ov_cont.runtime.mesh
+        key = ("reltopk", si, sov, soi, in_layout, off, n,
+               tuple(_traced_op_key(o) for o in ops),
+               str(in_cont.dtype), ov_layout, str(ov_dtype), oi_layout,
+               k, bool(largest), bool(merge), spec)
+
+        def emit(state, svals, souts):
+            from .algorithms import relational as _rel
+            body = _rel._top_k_body(axis, in_layout, off, n, ops, nsc,
+                                    ov_layout, jnp.dtype(ov_dtype),
+                                    oi_layout, k, largest, merge)
+            nrows = (3 if soi is not None else 2) if merge else 1
+            nout = 2 if soi is not None else 1
+            shm = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(axis, None),) * nrows + (P(),) * nsc,
+                out_specs=(P(axis, None),) * nout if nout > 1
+                else P(axis, None))
+            rows = [state[si]]
+            if merge:
+                rows.append(state[sov])
+                if soi is not None:
+                    rows.append(state[soi])
+            outs = shm(*rows, *svals)
+            if soi is not None:
+                state[sov], state[soi] = outs
+            else:
+                state[sov] = outs
+
+        run.ops.append(_FusedOp("top_k", key, emit, spec, vals))
+        self._note_replay(
+            lambda ic=in_chain, vc=ov_chain, xc=oi_chain, lg=largest,
+            mg=merge: self.record_top_k(ic, vc, xc, lg, mg))
         return True
 
     def record_opaque(self, name: str, thunk) -> bool:

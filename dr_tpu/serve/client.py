@@ -227,3 +227,40 @@ class Client:
     def sort(self, x, descending: bool = False, **kw) -> np.ndarray:
         return self.request("sort", [x],
                             {"descending": bool(descending)}, **kw)
+
+    # ------------------------------------------- relational layer (§17.3)
+    def join(self, lk, lv, rk, rv, how: str = "inner",
+             fill: float = 0.0, capacity=None, **kw):
+        """Sort-merge join on the daemon; returns the TRIMMED
+        ``[keys, left_values, right_values]`` row arrays.  A result
+        beyond ``capacity`` (default ``4 * (len(lk) + len(rk))``)
+        raises the daemon's classified capacity ``ProgramError``."""
+        params = {"how": str(how), "fill": float(fill)}
+        if capacity is not None:
+            params["capacity"] = int(capacity)
+        return self.request("join", [lk, lv, rk, rv], params, **kw)
+
+    def groupby(self, keys, values, agg: str = "sum", **kw):
+        """Group-by aggregate; returns trimmed
+        ``[group_keys, aggregates]``."""
+        return self.request("groupby", [keys, values],
+                            {"agg": str(agg)}, **kw)
+
+    def unique(self, x, **kw) -> np.ndarray:
+        """Sorted distinct values (trimmed)."""
+        return self.request("unique", [x], **kw)
+
+    def top_k(self, x, k: int, largest: bool = True, **kw):
+        """The k best elements; returns ``[values, indices]``
+        best-first.  Batches into the shared deferred flush."""
+        return self.request("topk", [x], {"k": int(k),
+                                          "largest": bool(largest)},
+                            **kw)
+
+    def histogram(self, x, bins: int, lo: float, hi: float, **kw) \
+            -> np.ndarray:
+        """Fixed-bin histogram counts over ``[lo, hi]``.  Batches
+        into the shared deferred flush."""
+        return self.request("histogram", [x],
+                            {"bins": int(bins), "lo": float(lo),
+                             "hi": float(hi)}, **kw)

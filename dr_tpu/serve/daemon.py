@@ -210,9 +210,140 @@ def _h_sort(req):
     return lambda: ({}, [dr_tpu.to_numpy(v)])
 
 
+# --- relational layer (docs/SPEC.md §17.3): join/groupby/unique have
+# data-dependent result sizes and run SOLO (they record opaque — solo
+# keeps one request's big expansion out of its batchmates' flush);
+# topk/histogram are static-shape FUSIBLE and batch into the shared
+# deferred flush with the elementwise ops.  Result arrays come back
+# trimmed to the real row count.
+
+def _v_groupby(req):
+    _v_vector(req)
+    from ..algorithms.relational import AGGS
+    a, b = req.arrays
+    if np.asarray(a).shape != np.asarray(b).shape:
+        raise resilience.ProgramError(
+            "serve: groupby keys and values must share a shape",
+            site="serve.request")
+    if str(req.params.get("agg", "sum")) not in AGGS:
+        raise resilience.ProgramError(
+            f"serve: unknown groupby agg {req.params.get('agg')!r} "
+            f"(known: {', '.join(AGGS)})", site="serve.request")
+
+
+def _h_groupby(req):
+    import dr_tpu
+    k, v = _vec(req.arrays[0]), _vec(req.arrays[1])
+    n = len(k)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    ng = dr_tpu.groupby_aggregate(k, v, ok, ov,
+                                  agg=str(req.params.get("agg", "sum")))
+
+    def fin():
+        m = int(ng)
+        return ({"count": m}, [dr_tpu.to_numpy(ok)[:m],
+                               dr_tpu.to_numpy(ov)[:m]])
+    return fin
+
+
+def _h_unique(req):
+    import dr_tpu
+    v = _vec(req.arrays[0])
+    out = dr_tpu.distributed_vector(len(v), np.float32)
+    nu = dr_tpu.unique(v, out)
+
+    def fin():
+        m = int(nu)
+        return ({"count": m}, [dr_tpu.to_numpy(out)[:m]])
+    return fin
+
+
+def _v_join(req):
+    _v_vector(req)
+    from ..algorithms.relational import JOIN_HOWS
+    lk, lv, rk, rv = (np.asarray(a) for a in req.arrays)
+    if lk.shape != lv.shape or rk.shape != rv.shape:
+        raise resilience.ProgramError(
+            "serve: join keys and values must share a shape per side",
+            site="serve.request")
+    if str(req.params.get("how", "inner")) not in JOIN_HOWS:
+        raise resilience.ProgramError(
+            f"serve: unknown join how {req.params.get('how')!r} "
+            f"(known: {', '.join(JOIN_HOWS)})", site="serve.request")
+
+
+def _h_join(req):
+    import dr_tpu
+    lk, lv = _vec(req.arrays[0]), _vec(req.arrays[1])
+    rk, rv = _vec(req.arrays[2]), _vec(req.arrays[3])
+    # default capacity covers the common feature-join shapes; a
+    # many-to-many expansion beyond it raises the classified
+    # capacity ProgramError back to THIS client (params.capacity
+    # overrides for heavier fan-outs)
+    cap = int(req.params.get("capacity",
+                             4 * (len(lk) + len(rk))))
+    ok = dr_tpu.distributed_vector(cap, np.float32)
+    ol = dr_tpu.distributed_vector(cap, np.float32)
+    orr = dr_tpu.distributed_vector(cap, np.float32)
+    m = dr_tpu.join(lk, lv, rk, rv, ok, ol, orr,
+                    how=str(req.params.get("how", "inner")),
+                    fill=float(req.params.get("fill", 0.0)))
+
+    def fin():
+        c = int(m)
+        return ({"count": c}, [dr_tpu.to_numpy(ok)[:c],
+                               dr_tpu.to_numpy(ol)[:c],
+                               dr_tpu.to_numpy(orr)[:c]])
+    return fin
+
+
+def _v_topk(req):
+    _v_vector(req)
+    if int(req.params.get("k", 0)) < 1:
+        raise resilience.ProgramError(
+            f"serve: topk needs params.k >= 1, got "
+            f"{req.params.get('k', 0)!r}", site="serve.request")
+
+
+def _h_topk(req):
+    import dr_tpu
+    v = _vec(req.arrays[0])
+    k = int(req.params["k"])
+    tv = dr_tpu.distributed_vector(k, np.float32)
+    ti = dr_tpu.distributed_vector(k, np.int32)
+    dr_tpu.top_k(v, tv, ti,
+                 largest=bool(req.params.get("largest", True)))
+    return lambda: ({}, [dr_tpu.to_numpy(tv), dr_tpu.to_numpy(ti)])
+
+
+def _v_histogram(req):
+    _v_vector(req)
+    bins = int(req.params.get("bins", 0))
+    lo = req.params.get("lo")
+    hi = req.params.get("hi")
+    if bins < 1 or lo is None or hi is None \
+            or not float(hi) > float(lo):
+        raise resilience.ProgramError(
+            f"serve: histogram needs params bins >= 1 and hi > lo "
+            f"(got bins={bins!r}, lo={lo!r}, hi={hi!r})",
+            site="serve.request")
+
+
+def _h_histogram(req):
+    import dr_tpu
+    v = _vec(req.arrays[0])
+    out = dr_tpu.distributed_vector(int(req.params["bins"]), np.int32)
+    dr_tpu.histogram(v, out, float(req.params["lo"]),
+                     float(req.params["hi"]))
+    return lambda: ({}, [dr_tpu.to_numpy(out)])
+
+
 #: op name -> (operand count, batchable into one deferred flush?).
-#: sort is NON-fusible (it would force the plan-flush cliff), so it
-#: dispatches eagerly, alone, after the batch's fused group.
+#: sort is NON-fusible (it would force the plan-flush cliff) and the
+#: relational join/groupby/unique record OPAQUE with data-dependent
+#: result sizes — all of these dispatch alone, after the batch's
+#: fused group; topk/histogram are static-shape fusible and batch.
 OPS = {
     "fill": _OpSpec("fill", 0, True, _h_fill, _v_fill),
     "scale": _OpSpec("scale", 1, True, _h_scale, _v_vector),
@@ -220,6 +351,12 @@ OPS = {
     "dot": _OpSpec("dot", 2, True, _h_dot, _v_dot),
     "scan": _OpSpec("scan", 1, True, _h_scan, _v_vector),
     "sort": _OpSpec("sort", 1, False, _h_sort, _v_vector),
+    "join": _OpSpec("join", 4, False, _h_join, _v_join),
+    "groupby": _OpSpec("groupby", 2, False, _h_groupby, _v_groupby),
+    "unique": _OpSpec("unique", 1, False, _h_unique, _v_vector),
+    "topk": _OpSpec("topk", 1, True, _h_topk, _v_topk),
+    "histogram": _OpSpec("histogram", 1, True, _h_histogram,
+                         _v_histogram),
 }
 
 

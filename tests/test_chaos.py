@@ -34,6 +34,7 @@ def _battery(tmpdir: str, tag: str) -> None:
     test_battery_reaches_every_site): probe -> init -> dispatch cache ->
     halo exchange/reduce -> collectives shift/alltoall -> sort -> scan
     -> deferred-plan flush -> serving daemon (accept/request/flush) ->
+    relational join/groupby/top_k/histogram (round 14) ->
     checkpoint write/read -> fallback.warn -> elastic shrink
     (device.lost rides every dispatch tap; mesh.shrink fires inside
     the rescue)."""
@@ -121,6 +122,42 @@ def _battery(tmpdir: str, tag: str) -> None:
                 < 1e-3
     finally:
         ssrv.stop()
+
+    # relational composite (round 14): join -> groupby -> top_k over a
+    # tiny table rides the same dispatch taps (dispatch.cache /
+    # device.lost fire on every cached program) — a fault anywhere in
+    # the sort-scratch, merge, or fused-flush path must surface
+    # classified or degrade clean, like every other leg
+    rn = 8 * P
+    rkeys = rng.integers(0, 4, rn).astype(np.float32)
+    rvals = rng.standard_normal(rn).astype(np.float32)
+    rkv = dr_tpu.distributed_vector.from_array(rkeys)
+    rvv = dr_tpu.distributed_vector.from_array(rvals)
+    jcap = rn * rn  # self-join worst case
+    jk = dr_tpu.distributed_vector(jcap)
+    jl = dr_tpu.distributed_vector(jcap)
+    jr = dr_tpu.distributed_vector(jcap)
+    jm = dr_tpu.join(rkv, rvv, rkv, rvv, jk, jl, jr)
+    import pandas as pd
+    jref = pd.merge(pd.DataFrame({"k": rkeys, "a": rvals}),
+                    pd.DataFrame({"k": rkeys, "b": rvals}), on="k")
+    assert jm == len(jref), (jm, len(jref))
+    gk = dr_tpu.distributed_vector(rn)
+    gv = dr_tpu.distributed_vector(rn)
+    ngr = dr_tpu.groupby_aggregate(rkv, rvv, gk, gv, agg="sum")
+    gref = pd.DataFrame({"k": rkeys, "v": rvals}).groupby("k")["v"] \
+        .sum()
+    assert ngr == len(gref)
+    np.testing.assert_allclose(dr_tpu.to_numpy(gv)[:ngr],
+                               gref.values.astype(np.float32),
+                               rtol=1e-4, atol=1e-5)
+    with dr_tpu.deferred():  # fusible leg through the plan.flush site
+        tk = dr_tpu.distributed_vector(3)
+        dr_tpu.top_k(rvv, tk)
+        hh = dr_tpu.distributed_vector(4, np.int32)
+        dr_tpu.histogram(rvv, hh, -2.0, 2.0)
+    np.testing.assert_allclose(dr_tpu.to_numpy(tk),
+                               np.sort(rvals)[::-1][:3])
 
     ck = os.path.join(tmpdir, f"chaos_{tag}.npz")
     dr_tpu.checkpoint.save(ck, dr_tpu.distributed_vector.from_array(src))

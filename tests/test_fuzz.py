@@ -1380,3 +1380,140 @@ def test_fuzz_redistribute(seed):
         want = float(src.astype(np.float64).sum())
         assert abs(got - want) <= 1e-3 * max(1.0, abs(want)), \
             f"it={it}: reduce {got} vs {want}"
+
+
+# ---------------------------------------------------------------------------
+# RELATIONAL arm (round 14, ISSUE 10): random key distributions
+# (uniform / skewed / all-equal / distinct / float) x uneven layouts
+# (zero-size team blocks included) through join / groupby / unique /
+# histogram / top_k vs pandas/numpy oracles — the composite tier's
+# crank discipline (docs/SPEC.md §17).
+# ---------------------------------------------------------------------------
+
+def _fuzz_rel_keys(rng, n, kind):
+    if kind == "all_equal":
+        return np.full(n, float(rng.integers(0, 5)), np.float32)
+    if kind == "distinct":
+        return rng.permutation(n).astype(np.float32)
+    if kind == "skewed":
+        # a heavy head + a long tail (zipf-ish): most rows share one
+        # key, the rest scatter
+        k = np.where(rng.random(n) < 0.7, 0.0,
+                     rng.integers(1, max(n // 4, 2), n))
+        return k.astype(np.float32)
+    if kind == "float":
+        return np.round(rng.standard_normal(n) * 2).astype(np.float32)
+    return rng.integers(0, max(n // 3, 2), n).astype(np.float32)
+
+
+def _fuzz_rel_dist(rng, n, P):
+    if rng.random() < 0.5:
+        return None  # default uniform ceil layout
+    cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+    bounds = np.concatenate(([0], cuts, [n]))
+    return tuple(int(b - a) for a, b in zip(bounds[:-1], bounds[1:]))
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_relational(seed):
+    import pandas as pd
+    rng = np.random.default_rng(1400 + seed)
+    P = dr_tpu.nprocs()
+    for it in range(ITERS):
+        n = int(rng.integers(1, 140))
+        kind = rng.choice(["uniform", "skewed", "all_equal",
+                           "distinct", "float"])
+        keys = _fuzz_rel_keys(rng, n, kind)
+        vals = rng.standard_normal(n).astype(np.float32)
+        kv = dr_tpu.distributed_vector.from_array(
+            keys, distribution=_fuzz_rel_dist(rng, n, P))
+        vv = dr_tpu.distributed_vector.from_array(
+            vals, distribution=_fuzz_rel_dist(rng, n, P))
+        alg = rng.choice(["groupby", "unique", "histogram", "top_k",
+                          "join"])
+        tag = f"it={it} alg={alg} kind={kind} n={n}"
+        if alg == "groupby":
+            agg = rng.choice(["sum", "min", "max", "count", "mean"])
+            ok = dr_tpu.distributed_vector(
+                n, np.float32, distribution=_fuzz_rel_dist(rng, n, P))
+            ov = dr_tpu.distributed_vector(n, np.float32)
+            ng = dr_tpu.groupby_aggregate(kv, vv, ok, ov, agg=agg)
+            ref = getattr(pd.DataFrame({"k": keys, "v": vals})
+                          .groupby("k")["v"], agg)()
+            assert ng == len(ref), tag
+            np.testing.assert_array_equal(
+                dr_tpu.to_numpy(ok)[:ng],
+                ref.index.values.astype(np.float32), err_msg=tag)
+            np.testing.assert_allclose(
+                dr_tpu.to_numpy(ov)[:ng],
+                ref.values.astype(np.float32), rtol=1e-4, atol=1e-5,
+                err_msg=tag)
+        elif alg == "unique":
+            out = dr_tpu.distributed_vector(n, np.float32)
+            nu = dr_tpu.unique(kv, out)
+            ref = np.unique(keys)
+            assert nu == len(ref), tag
+            np.testing.assert_array_equal(dr_tpu.to_numpy(out)[:nu],
+                                          ref, err_msg=tag)
+        elif alg == "histogram":
+            bins = int(rng.integers(1, 12))
+            lo, hi = -2.5, float(rng.uniform(0.5, 3.0))
+            out = dr_tpu.distributed_vector(
+                bins, np.int32,
+                distribution=_fuzz_rel_dist(rng, bins, P))
+            dr_tpu.histogram(vv, out, lo, hi)
+            x = vals.astype(np.float64)
+            inr = (x >= lo) & (x <= hi)
+            b = np.minimum(np.floor((x[inr] - lo) * bins / (hi - lo))
+                           .astype(np.int64), bins - 1)
+            np.testing.assert_array_equal(
+                dr_tpu.to_numpy(out), np.bincount(b, minlength=bins),
+                err_msg=tag)
+        elif alg == "top_k":
+            k = int(rng.integers(1, n + 4))
+            tv = dr_tpu.distributed_vector(k, np.float32)
+            ti = dr_tpu.distributed_vector(k, np.int32)
+            largest = bool(rng.integers(0, 2))
+            dr_tpu.top_k(vv, tv, ti, largest=largest)
+            gv = dr_tpu.to_numpy(tv)
+            gi = dr_tpu.to_numpy(ti)
+            kk = min(k, n)
+            ref = np.sort(vals)[::-1][:kk] if largest \
+                else np.sort(vals)[:kk]
+            np.testing.assert_allclose(gv[:kk], ref, err_msg=tag)
+            np.testing.assert_array_equal(vals[gi[:kk]], gv[:kk],
+                                          err_msg=tag)
+            assert len(set(gi[:kk].tolist())) == kk, tag
+        else:  # join
+            nr = int(rng.integers(1, 100))
+            rkeys = _fuzz_rel_keys(
+                rng, nr, rng.choice(["uniform", "all_equal",
+                                     "distinct"]))
+            rvals = rng.standard_normal(nr).astype(np.float32)
+            rkv = dr_tpu.distributed_vector.from_array(
+                rkeys, distribution=_fuzz_rel_dist(rng, nr, P))
+            rvv = dr_tpu.distributed_vector.from_array(rvals)
+            how = rng.choice(["inner", "left", "right"])
+            ref = pd.merge(pd.DataFrame({"k": keys, "lv": vals}),
+                           pd.DataFrame({"k": rkeys, "rv": rvals}),
+                           on="k", how=how).fillna(-7.0)
+            cap = max(len(ref), 1)
+            jk = dr_tpu.distributed_vector(
+                cap, np.float32,
+                distribution=_fuzz_rel_dist(rng, cap, P))
+            jl = dr_tpu.distributed_vector(cap, np.float32)
+            jr = dr_tpu.distributed_vector(cap, np.float32)
+            m = dr_tpu.join(kv, vv, rkv, rvv, jk, jl, jr, how=how,
+                            fill=-7.0)
+            assert m == len(ref), tag
+            got = pd.DataFrame({"k": dr_tpu.to_numpy(jk)[:m],
+                                "lv": dr_tpu.to_numpy(jl)[:m],
+                                "rv": dr_tpu.to_numpy(jr)[:m]})
+            a = got.sort_values(["k", "lv", "rv"]) \
+                .reset_index(drop=True)
+            b = ref.sort_values(["k", "lv", "rv"]) \
+                .reset_index(drop=True)
+            np.testing.assert_allclose(a.values,
+                                       b.values.astype(np.float32),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=tag)

@@ -1,0 +1,680 @@
+"""Relational analytics layer (docs/SPEC.md §17): join / groupby /
+unique / histogram / top_k vs pandas/numpy oracles — eager, deferred
+(fusible AND opaque), elastic replay, serve wire round trip, and the
+failure matrix."""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import dr_tpu
+from dr_tpu import views
+from dr_tpu.utils import faults, resilience, sanitize
+from dr_tpu.utils.env import env_override
+
+
+def _mk(rng, n, dtype=np.float32, lo=0, hi=8, ints=False):
+    if ints:
+        src = rng.integers(lo, hi, n).astype(dtype)
+    else:
+        src = rng.standard_normal(n).astype(dtype)
+    return src, dr_tpu.distributed_vector.from_array(src)
+
+
+def _hist_oracle(x, bins, lo, hi):
+    """The §17.1 bucket rule in numpy: floor((x-lo)*bins/(hi-lo)),
+    right edge inclusive in the last bucket, out-of-range dropped."""
+    x = np.asarray(x, np.float64)
+    inr = (x >= lo) & (x <= hi)
+    b = np.minimum(np.floor((x[inr] - lo) * bins / (hi - lo))
+                   .astype(np.int64), bins - 1)
+    return np.bincount(b, minlength=bins)
+
+
+# ---------------------------------------------------------------- groupby
+
+@pytest.mark.parametrize("agg", ["sum", "min", "max", "count", "mean"])
+def test_groupby_aggregate_vs_pandas(agg):
+    rng = np.random.default_rng(7)
+    n = 57
+    keys, kv = _mk(rng, n, ints=True, hi=9)
+    vals, vv = _mk(rng, n)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    ng = dr_tpu.groupby_aggregate(kv, vv, ok, ov, agg=agg)
+    ref = getattr(pd.DataFrame({"k": keys, "v": vals})
+                  .groupby("k")["v"], agg)()
+    assert ng == len(ref)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ok)[:ng],
+                                  ref.index.values.astype(np.float32))
+    np.testing.assert_allclose(dr_tpu.to_numpy(ov)[:ng],
+                               ref.values.astype(np.float32),
+                               rtol=1e-5, atol=1e-6)
+    # the tail contract: positions >= ngroups are ZERO
+    assert not dr_tpu.to_numpy(ok)[ng:].any()
+    assert not dr_tpu.to_numpy(ov)[ng:].any()
+
+
+def test_groupby_count_without_values():
+    rng = np.random.default_rng(8)
+    n = 33
+    keys, kv = _mk(rng, n, ints=True, hi=5)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.int32)
+    ng = dr_tpu.groupby_aggregate(kv, None, ok, ov, agg="count")
+    uk, uc = np.unique(keys, return_counts=True)
+    assert ng == len(uk)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ok)[:ng], uk)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ov)[:ng], uc)
+
+
+def test_groupby_all_equal_and_all_distinct_keys():
+    rng = np.random.default_rng(9)
+    n = 29
+    vals, vv = _mk(rng, n)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    # all-equal: one group spanning every shard boundary
+    keys = np.full(n, 3.5, np.float32)
+    kv = dr_tpu.distributed_vector.from_array(keys)
+    ng = dr_tpu.groupby_aggregate(kv, vv, ok, ov, agg="sum")
+    assert ng == 1
+    np.testing.assert_allclose(dr_tpu.to_numpy(ov)[0],
+                               vals.astype(np.float64).sum(),
+                               rtol=1e-5)
+    # all-distinct: every element its own group
+    keys2 = np.arange(n, dtype=np.float32)
+    kv2 = dr_tpu.distributed_vector.from_array(keys2)
+    ng = dr_tpu.groupby_aggregate(kv2, vv, ok, ov, agg="max")
+    assert ng == n
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ov), vals)
+
+
+def test_groupby_uneven_layouts_and_window_inputs():
+    rng = np.random.default_rng(10)
+    n = 41
+    keys = rng.integers(0, 6, n).astype(np.float32)
+    vals = rng.standard_normal(n).astype(np.float32)
+    dist = [5, 0, 12, 3, 0, 9, 7, 5]
+    kv = dr_tpu.distributed_vector.from_array(keys, distribution=dist)
+    vv = dr_tpu.distributed_vector.from_array(vals, distribution=dist)
+    ok = dr_tpu.distributed_vector(n, np.float32,
+                                   distribution=[10, 0, 11, 20, 0, 0,
+                                                 0, 0])
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    ng = dr_tpu.groupby_aggregate(kv[5:30], vv[5:30], ok, ov,
+                                  agg="mean")
+    ref = pd.DataFrame({"k": keys[5:30], "v": vals[5:30]}) \
+        .groupby("k")["v"].mean()
+    assert ng == len(ref)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ok)[:ng],
+                                  ref.index.values.astype(np.float32))
+    np.testing.assert_allclose(dr_tpu.to_numpy(ov)[:ng],
+                               ref.values.astype(np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unique_vs_numpy():
+    rng = np.random.default_rng(11)
+    n = 48
+    keys, kv = _mk(rng, n, ints=True, hi=11)
+    out = dr_tpu.distributed_vector(n, np.float32)
+    nu = dr_tpu.unique(kv, out)
+    ref = np.unique(keys)
+    assert nu == len(ref)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(out)[:nu], ref)
+    assert not dr_tpu.to_numpy(out)[nu:].any()
+
+
+# ------------------------------------------------------------------- join
+
+@pytest.mark.parametrize("how", ["inner", "left", "right"])
+def test_join_vs_pandas(how):
+    rng = np.random.default_rng(12)
+    nl, nr = 31, 23
+    lk = rng.integers(0, 7, nl).astype(np.float32)
+    lv = rng.standard_normal(nl).astype(np.float32)
+    rk = rng.integers(0, 7, nr).astype(np.float32)
+    rv = rng.standard_normal(nr).astype(np.float32)
+    cap = 512
+    jk = dr_tpu.distributed_vector(cap, np.float32)
+    jl = dr_tpu.distributed_vector(cap, np.float32)
+    jr = dr_tpu.distributed_vector(cap, np.float32)
+    m = dr_tpu.join(dr_tpu.distributed_vector.from_array(lk),
+                    dr_tpu.distributed_vector.from_array(lv),
+                    dr_tpu.distributed_vector.from_array(rk),
+                    dr_tpu.distributed_vector.from_array(rv),
+                    jk, jl, jr, how=how, fill=-9.0)
+    ref = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
+                   pd.DataFrame({"k": rk, "rv": rv}),
+                   on="k", how=how).fillna(-9.0)
+    assert m == len(ref)
+    got = pd.DataFrame({"k": dr_tpu.to_numpy(jk)[:m],
+                        "lv": dr_tpu.to_numpy(jl)[:m],
+                        "rv": dr_tpu.to_numpy(jr)[:m]})
+    a = got.sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+    b = ref.sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+    np.testing.assert_allclose(a.values,
+                               b.values.astype(np.float32),
+                               rtol=1e-6)
+    for o in (jk, jl, jr):
+        assert not dr_tpu.to_numpy(o)[m:].any()
+
+
+def test_join_many_to_many_duplicates():
+    # duplicate keys on BOTH sides must expand multiplicatively
+    lk = np.array([2, 2, 2, 5], np.int32)
+    lv = np.array([1, 2, 3, 4], np.float32)
+    rk = np.array([2, 2, 7], np.int32)
+    rv = np.array([10, 20, 30], np.float32)
+    jk = dr_tpu.distributed_vector(32, np.int32)
+    jl = dr_tpu.distributed_vector(32, np.float32)
+    jr = dr_tpu.distributed_vector(32, np.float32)
+    m = dr_tpu.join(dr_tpu.distributed_vector.from_array(lk),
+                    dr_tpu.distributed_vector.from_array(lv),
+                    dr_tpu.distributed_vector.from_array(rk),
+                    dr_tpu.distributed_vector.from_array(rv),
+                    jk, jl, jr)
+    assert m == 6  # 3 left twos x 2 right twos
+    # rows ordered by (key, left pos, right pos)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jl)[:m],
+                                  [1, 1, 2, 2, 3, 3])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jr)[:m],
+                                  [10, 20, 10, 20, 10, 20])
+
+
+def test_join_disjoint_and_empty_sides():
+    rng = np.random.default_rng(13)
+    lk = np.arange(10, dtype=np.float32)
+    lv = rng.standard_normal(10).astype(np.float32)
+    rk = np.arange(100, 105, dtype=np.float32)
+    rv = rng.standard_normal(5).astype(np.float32)
+    jk = dr_tpu.distributed_vector(16, np.float32)
+    jl = dr_tpu.distributed_vector(16, np.float32)
+    jr = dr_tpu.distributed_vector(16, np.float32)
+    lkv = dr_tpu.distributed_vector.from_array(lk)
+    lvv = dr_tpu.distributed_vector.from_array(lv)
+    rkv = dr_tpu.distributed_vector.from_array(rk)
+    rvv = dr_tpu.distributed_vector.from_array(rv)
+    assert dr_tpu.join(lkv, lvv, rkv, rvv, jk, jl, jr) == 0
+    assert not dr_tpu.to_numpy(jk).any()
+    # left join against a disjoint right: every left row, filled
+    m = dr_tpu.join(lkv, lvv, rkv, rvv, jk, jl, jr, how="left",
+                    fill=-1.0)
+    assert m == 10
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jr)[:m],
+                                  np.full(10, -1.0, np.float32))
+    # empty windows: zero rows, zeroed outputs
+    assert dr_tpu.join(lkv[3:3], lvv[3:3], rkv, rvv, jk, jl, jr) == 0
+    # left join against an EMPTY right side: every left row, filled
+    m = dr_tpu.join(lkv, lvv, rkv[0:0], rvv[0:0], jk, jl, jr,
+                    how="left", fill=-3.0)
+    assert m == 10
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jr)[:m],
+                                  np.full(10, -3.0, np.float32))
+
+
+# -------------------------------------------------------------- histogram
+
+def test_histogram_vs_numpy():
+    rng = np.random.default_rng(14)
+    n = 77
+    vals, vv = _mk(rng, n)
+    out = dr_tpu.distributed_vector(9, np.int32)
+    dr_tpu.histogram(vv, out, -2.5, 2.5)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(out),
+                                  _hist_oracle(vals, 9, -2.5, 2.5))
+    # integer-valued data sits away from bucket edges: the §17.1 rule
+    # and np.histogram agree exactly there
+    ints, iv = _mk(rng, n, ints=True, hi=10)
+    out2 = dr_tpu.distributed_vector(5, np.int32)
+    dr_tpu.histogram(iv, out2, -0.5, 9.5)
+    ref, _ = np.histogram(ints, bins=5, range=(-0.5, 9.5))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(out2), ref)
+
+
+def test_histogram_window_chain_and_program_reuse():
+    rng = np.random.default_rng(15)
+    n = 64
+    vals, vv = _mk(rng, n)
+    out = dr_tpu.distributed_vector(7, np.float32)
+    tview = views.transform(vv[8:40], _double)
+    dr_tpu.histogram(tview, out, -3.0, 3.0)
+    np.testing.assert_array_equal(
+        dr_tpu.to_numpy(out), _hist_oracle(vals[8:40] * 2, 7, -3, 3))
+    # lo/hi are traced operands: a streamed range must reuse ONE
+    # compiled program
+    with sanitize.zero_recompile("histogram lo/hi stream"):
+        for w in (1.0, 1.5, 2.0):
+            dr_tpu.histogram(tview, out, -w, w)
+    np.testing.assert_array_equal(
+        dr_tpu.to_numpy(out), _hist_oracle(vals[8:40] * 2, 7, -2, 2))
+
+
+def _double(x):
+    return x * 2
+
+
+# ------------------------------------------------------------------ top_k
+
+def test_top_k_vs_numpy():
+    rng = np.random.default_rng(16)
+    n = 53
+    vals, vv = _mk(rng, n)
+    k = 7
+    tv = dr_tpu.distributed_vector(k, np.float32)
+    ti = dr_tpu.distributed_vector(k, np.int32)
+    dr_tpu.top_k(vv, tv, ti)
+    gv, gi = dr_tpu.to_numpy(tv), dr_tpu.to_numpy(ti)
+    np.testing.assert_allclose(gv, np.sort(vals)[::-1][:k])
+    np.testing.assert_array_equal(vals[gi], gv)
+    assert len(set(gi.tolist())) == k
+    # smallest-first
+    dr_tpu.top_k(vv, tv, ti, largest=False)
+    np.testing.assert_allclose(dr_tpu.to_numpy(tv),
+                               np.sort(vals)[:k])
+
+
+def test_top_k_ties_and_k_beyond_n():
+    vals = np.array([1.0, 3.0, 3.0, 0.0, 3.0], np.float32)
+    vv = dr_tpu.distributed_vector.from_array(vals)
+    tv = dr_tpu.distributed_vector(8, np.float32)
+    ti = dr_tpu.distributed_vector(8, np.int32)
+    dr_tpu.top_k(vv, tv, ti)
+    gi = dr_tpu.to_numpy(ti)
+    # ties keep the smaller index first; k > n pads with the finite
+    # worst value and INT32_MAX indices
+    np.testing.assert_array_equal(gi[:5], [1, 2, 4, 0, 3])
+    assert (gi[5:] == np.iinfo(np.int32).max).all()
+    fin = dr_tpu.to_numpy(tv)
+    assert np.isfinite(fin).all()
+    assert (fin[5:] == np.finfo(np.float32).min).all()
+
+
+def test_top_k_streaming_windows_matches_global():
+    rng = np.random.default_rng(17)
+    n = 90
+    vals, vv = _mk(rng, n)
+    k = 6
+    tv = dr_tpu.distributed_vector(k, np.float32)
+    ti = dr_tpu.distributed_vector(k, np.int32)
+    dr_tpu.top_k(vv[0:30], tv, ti)
+    dr_tpu.top_k(views.subrange(vv, 30, 60), tv, ti, merge=True)
+    dr_tpu.top_k(views.subrange(vv, 60, n), tv, ti, merge=True)
+    np.testing.assert_allclose(np.sort(dr_tpu.to_numpy(tv))[::-1],
+                               np.sort(vals)[::-1][:k])
+
+
+# ------------------------------------------------------- deferred plans
+
+def test_deferred_fusible_histogram_top_k_bit_equal():
+    rng = np.random.default_rng(18)
+    n = 45
+    vals, vv = _mk(rng, n)
+    hb_e = dr_tpu.distributed_vector(6, np.int32)
+    tv_e = dr_tpu.distributed_vector(5, np.float32)
+    ti_e = dr_tpu.distributed_vector(5, np.int32)
+    dr_tpu.histogram(vv, hb_e, -2.0, 2.0)
+    dr_tpu.top_k(vv, tv_e, ti_e)
+
+    hb = dr_tpu.distributed_vector(6, np.int32)
+    tv = dr_tpu.distributed_vector(5, np.float32)
+    ti = dr_tpu.distributed_vector(5, np.int32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.histogram(vv, hb, -2.0, 2.0)
+        dr_tpu.top_k(vv, tv, ti)
+    st = p.stats()
+    assert st["fused_runs"] == 1 and st["fused_ops"] == 2 \
+        and st["opaque_ops"] == 0
+    np.testing.assert_array_equal(dr_tpu.to_numpy(hb),
+                                  dr_tpu.to_numpy(hb_e))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(tv),
+                                  dr_tpu.to_numpy(tv_e))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ti),
+                                  dr_tpu.to_numpy(ti_e))
+    # re-record with DIFFERENT lo/hi: traced operands, so the fused
+    # program is a cache hit (zero recompile)
+    with sanitize.zero_recompile("relational plan re-record"), \
+            dr_tpu.deferred() as p2:
+        dr_tpu.histogram(vv, hb, -1.0, 1.0)
+        dr_tpu.top_k(vv, tv, ti)
+    assert p2.stats()["cache_hits"] == 1
+    np.testing.assert_array_equal(dr_tpu.to_numpy(hb),
+                                  _hist_oracle(vals, 6, -1, 1))
+
+
+def test_deferred_opaque_groupby_join_order_and_counts():
+    rng = np.random.default_rng(19)
+    n = 40
+    keys, kv = _mk(rng, n, ints=True, hi=5)
+    vals, vv = _mk(rng, n)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    uo = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        # the fill BEFORE must land first (record order): groupby's
+        # scratch copy reads vv's post-fill state
+        dr_tpu.fill(vv, 1.0)
+        ng = dr_tpu.groupby_aggregate(kv, vv, ok, ov, agg="sum")
+        nu = dr_tpu.unique(kv, uo)
+        assert isinstance(ng, dr_tpu.DeferredCount)
+    uk, uc = np.unique(keys, return_counts=True)
+    assert int(ng) == len(uk) and nu == len(uk)
+    # values were all-ones at flush time -> per-group sums = counts
+    np.testing.assert_allclose(dr_tpu.to_numpy(ov)[:int(ng)],
+                               uc.astype(np.float32))
+    names = [o for e in p.log for i in e["items"]
+             for o in ([i["name"]] if i["kind"] == "opaque"
+                       else i["ops"])]
+    assert names == ["fill", "groupby_aggregate", "unique"]
+
+
+def test_deferred_faulted_flush_breaks_count():
+    rng = np.random.default_rng(20)
+    n = 24
+    _, kv = _mk(rng, n, ints=True, hi=4)
+    _, vv = _mk(rng, n)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    with faults.injected("plan.flush", "transient", times=1):
+        with pytest.raises(resilience.TransientBackendError):
+            with dr_tpu.deferred():
+                ng = dr_tpu.groupby_aggregate(kv, vv, ok, ov)
+    with pytest.raises(RuntimeError):
+        int(ng)
+
+
+def test_elastic_replay_relational(tmp_path):
+    """Device loss mid-flush with relational ops recorded: the plan
+    re-records the suffix on the shrunken mesh — fusible histogram /
+    top_k AND the opaque groupby replay, counts resolve, results match
+    the full-mesh oracles (ISSUE 10 acceptance)."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    rng = np.random.default_rng(21)
+    n = 4 * P
+    keys, kv = _mk(rng, n, ints=True, hi=4)
+    vals, vv = _mk(rng, n)
+    hb = dr_tpu.distributed_vector(4, np.int32)
+    tv = dr_tpu.distributed_vector(3, np.float32)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    for nm, c in (("kv", kv), ("vv", vv), ("hb", hb), ("tv", tv),
+                  ("ok", ok), ("ov", ov)):
+        dr_tpu.checkpoint.save(str(tmp_path / f"{nm}.npz"), c)
+    ref_h = _hist_oracle(vals, 4, -2.0, 2.0)
+    ref_t = np.sort(vals)[::-1][:3]
+    refg = pd.DataFrame({"k": keys, "v": vals}).groupby("k")["v"].sum()
+    with env_override(DR_TPU_ELASTIC="1"):
+        with faults.injected("device.lost", "device_lost", times=1):
+            with dr_tpu.deferred() as p:
+                dr_tpu.histogram(vv, hb, -2.0, 2.0)
+                dr_tpu.top_k(vv, tv)
+                ng = dr_tpu.groupby_aggregate(kv, vv, ok, ov,
+                                              agg="sum")
+    assert dr_tpu.nprocs() == P - 1
+    assert "elastic replay" in [e["reason"] for e in p.log]
+    assert int(ng) == len(refg)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(hb), ref_h)
+    np.testing.assert_allclose(dr_tpu.to_numpy(tv), ref_t)
+    np.testing.assert_allclose(dr_tpu.to_numpy(ov)[:int(ng)],
+                               refg.values.astype(np.float32),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------- failure matrix
+
+def test_relational_api_misuse_raises_at_call_site():
+    rng = np.random.default_rng(22)
+    n = 16
+    _, kv = _mk(rng, n)
+    _, vv = _mk(rng, n)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    with pytest.raises(ValueError, match="unknown agg"):
+        dr_tpu.groupby_aggregate(kv, vv, ok, ov, agg="median")
+    with pytest.raises(ValueError, match="needs values"):
+        dr_tpu.groupby_aggregate(kv, None, ok, ov, agg="sum")
+    with pytest.raises(ValueError, match="unknown how"):
+        dr_tpu.join(kv, vv, kv, vv, ok, ov, ov, how="outer")
+    with pytest.raises(TypeError, match="key dtypes"):
+        ik = dr_tpu.distributed_vector(n, np.int32)
+        dr_tpu.join(kv, vv, ik, vv, ok, ov, ov)
+    with pytest.raises(ValueError, match="equal length"):
+        dr_tpu.groupby_aggregate(kv[0:4], vv, ok, ov)
+    with pytest.raises(TypeError, match="whole"):
+        dr_tpu.unique(kv, ok[0:4])
+    with pytest.raises(ValueError, match="hi > lo"):
+        dr_tpu.histogram(kv, ok, 2.0, 2.0)
+    with pytest.raises(TypeError, match="int32"):
+        dr_tpu.top_k(kv, dr_tpu.distributed_vector(8, np.float32),
+                     dr_tpu.distributed_vector(8, np.float32))
+    # misuse inside a deferred region raises IMMEDIATELY (nothing
+    # recorded) and the region still flushes clean
+    with dr_tpu.deferred() as p:
+        with pytest.raises(ValueError, match="unknown agg"):
+            dr_tpu.groupby_aggregate(kv, vv, ok, ov, agg="nope")
+    assert p.stats()["fused_ops"] == 0
+
+
+def test_groupby_out_key_dtype_casts():
+    """Review regression: out_keys of a DIFFERENT dtype decode through
+    the KEY dtype and then cast (int out_keys used to receive raw
+    encoding bits; float out_keys of int keys decoded to NaN)."""
+    keys = np.array([3.0, 1.0, 3.0, 2.0, 1.0], np.float32)
+    vals = np.ones(5, np.float32)
+    kv = dr_tpu.distributed_vector.from_array(keys)
+    vv = dr_tpu.distributed_vector.from_array(vals)
+    oki = dr_tpu.distributed_vector(5, np.int32)
+    ov = dr_tpu.distributed_vector(5, np.float32)
+    ng = dr_tpu.groupby_aggregate(kv, vv, oki, ov)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(oki)[:ng],
+                                  [1, 2, 3])
+    ik = dr_tpu.distributed_vector.from_array(
+        keys.astype(np.int32))
+    okf = dr_tpu.distributed_vector(5, np.float32)
+    ng = dr_tpu.groupby_aggregate(ik, vv, okf, ov)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(okf)[:ng],
+                                  [1.0, 2.0, 3.0])
+
+
+def test_groupby_unequal_out_capacities_rejected():
+    """Review regression: a smaller out_values used to silently drop
+    aggregates while ng claimed them all."""
+    rng = np.random.default_rng(27)
+    _, kv = _mk(rng, 16, ints=True, hi=12)
+    _, vv = _mk(rng, 16)
+    ok = dr_tpu.distributed_vector(32, np.float32)
+    ov = dr_tpu.distributed_vector(8, np.float32)
+    with pytest.raises(ValueError, match="share one capacity"):
+        dr_tpu.groupby_aggregate(kv, vv, ok, ov)
+
+
+def test_top_k_merge_needs_one_out_layout():
+    """Review regression: merge pairs current values with indices BY
+    SLOT — split out layouts used to mispair (or crash unclassified)."""
+    rng = np.random.default_rng(28)
+    _, vv = _mk(rng, 24)
+    tv = dr_tpu.distributed_vector(4, np.float32)
+    ti = dr_tpu.distributed_vector(4, np.int32,
+                                   distribution=[4, 0, 0, 0, 0, 0, 0,
+                                                 0])
+    dr_tpu.top_k(vv, tv, ti)  # non-merge: independent layouts are fine
+    with pytest.raises(TypeError, match="ONE layout"):
+        dr_tpu.top_k(vv, tv, ti, merge=True)
+
+
+def test_deferred_misuse_raises_before_recording():
+    """Review regression (§17.5): join/groupby argument errors must
+    raise AT the call site inside a deferred region — nothing records,
+    the region flushes clean, no batchmate dies at flush."""
+    rng = np.random.default_rng(29)
+    _, kv = _mk(rng, 8)
+    _, vv = _mk(rng, 8)
+    _, short = _mk(rng, 6)
+    ok = dr_tpu.distributed_vector(8, np.float32)
+    ov = dr_tpu.distributed_vector(8, np.float32)
+    small = dr_tpu.distributed_vector(4, np.float32)
+    ik = dr_tpu.distributed_vector(8, np.int32)
+    with dr_tpu.deferred() as p:
+        with pytest.raises(ValueError, match="equal length"):
+            dr_tpu.join(kv, short, kv, vv, ok, ov, ov)
+        with pytest.raises(TypeError, match="key dtypes"):
+            dr_tpu.join(kv, vv, ik, vv, ok, ov, ov)
+        with pytest.raises(ValueError, match="share one capacity"):
+            dr_tpu.join(kv, vv, kv, vv, ok, ov, small)
+        with pytest.raises(ValueError, match="share one capacity"):
+            dr_tpu.groupby_aggregate(kv, vv, ok, small)
+        with pytest.raises(ValueError, match="equal length"):
+            dr_tpu.groupby_aggregate(kv, short, ok, ov)
+    assert p.stats()["fused_ops"] == 0 \
+        and p.stats()["opaque_ops"] == 0
+
+
+def test_relational_capacity_overflow_classified():
+    rng = np.random.default_rng(23)
+    n = 24
+    _, kv = _mk(rng, n, ints=True, hi=12)
+    _, vv = _mk(rng, n)
+    s1 = dr_tpu.distributed_vector(2, np.float32)
+    s2 = dr_tpu.distributed_vector(2, np.float32)
+    with pytest.raises(resilience.ProgramError, match="rows"):
+        dr_tpu.groupby_aggregate(kv, vv, s1, s2)
+    with pytest.raises(resilience.ProgramError, match="rows"):
+        dr_tpu.unique(kv, s1)
+    ones = dr_tpu.distributed_vector.from_array(np.ones(16, np.float32))
+    with pytest.raises(resilience.ProgramError, match="rows"):
+        dr_tpu.join(ones, ones, ones, ones, s1, s1, s2)
+
+
+# ----------------------------------------------------------------- serve
+
+def test_serve_relational_round_trip(tmp_path):
+    from dr_tpu import serve
+    rng = np.random.default_rng(24)
+    sock = os.path.join(str(tmp_path), "rel.sock")
+    srv = serve.Server(sock, batch_window=0.0)
+    srv.start()
+    try:
+        with serve.Client(sock, timeout=60.0) as c:
+            lk = rng.integers(0, 6, 24).astype(np.float32)
+            lv = rng.standard_normal(24).astype(np.float32)
+            rk = rng.integers(0, 6, 18).astype(np.float32)
+            rv = rng.standard_normal(18).astype(np.float32)
+            jk, jl, jr = c.join(lk, lv, rk, rv)
+            ref = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
+                           pd.DataFrame({"k": rk, "rv": rv}), on="k")
+            assert len(jk) == len(ref)
+            gk, gv = c.groupby(lk, lv, agg="mean")
+            refg = pd.DataFrame({"k": lk, "v": lv}) \
+                .groupby("k")["v"].mean()
+            np.testing.assert_allclose(gv,
+                                       refg.values.astype(np.float32),
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(c.unique(lk), np.unique(lk))
+            tv, ti = c.top_k(lv, 4)
+            np.testing.assert_allclose(tv, np.sort(lv)[::-1][:4])
+            np.testing.assert_array_equal(lv[ti.astype(np.int64)], tv)
+            h = c.histogram(lv, 6, -2.0, 2.0)
+            np.testing.assert_array_equal(h,
+                                          _hist_oracle(lv, 6, -2, 2))
+            # classified errors cross the wire as the SAME class
+            with pytest.raises(resilience.ProgramError):
+                c.groupby(lk, lv, agg="median")
+            with pytest.raises(resilience.ProgramError):
+                ones = np.ones(64, np.float32)
+                c.join(ones, ones, ones, ones, capacity=8)
+            # the daemon survived both rejections
+            assert c.ping()["pong"]
+    finally:
+        srv.stop()
+
+
+def test_serve_topk_histogram_batch_into_one_flush(tmp_path):
+    """The fusible relational ops join the shared deferred flush:
+    held-queue topk + histogram + scale from one client dispatch as
+    ONE batch (batched_requests counts them)."""
+    from dr_tpu import serve
+    import threading
+    rng = np.random.default_rng(25)
+    sock = os.path.join(str(tmp_path), "relb.sock")
+    srv = serve.Server(sock, batch_window=0.05, batch_max=8)
+    srv.start()
+    try:
+        x = rng.standard_normal(64).astype(np.float32)
+        with serve.Client(sock, timeout=60.0) as c:
+            c.top_k(x, 3)  # warm the programs outside the held batch
+            c.histogram(x, 4, -2.0, 2.0)
+        srv.hold()
+        results = {}
+
+        def go(name, fn):
+            results[name] = fn()
+
+        with serve.Client(sock, timeout=60.0) as c1, \
+                serve.Client(sock, timeout=60.0) as c2, \
+                serve.Client(sock, timeout=60.0) as c3:
+            ts = [threading.Thread(target=go, args=("t", lambda:
+                                                    c1.top_k(x, 3))),
+                  threading.Thread(target=go, args=("h", lambda:
+                                                    c2.histogram(
+                                                        x, 4, -2.0,
+                                                        2.0))),
+                  threading.Thread(target=go, args=("s", lambda:
+                                                    c3.scale(x,
+                                                             a=2.0)))]
+            for t in ts:
+                t.start()
+            import time
+            time.sleep(0.3)  # let all three requests queue
+            srv.release()
+            for t in ts:
+                t.join(timeout=30.0)
+        st = srv.stats()
+        assert st["batch_hw"] >= 3, st
+        np.testing.assert_allclose(results["t"][0],
+                                   np.sort(x)[::-1][:3])
+        np.testing.assert_array_equal(results["h"],
+                                      _hist_oracle(x, 4, -2, 2))
+        np.testing.assert_allclose(results["s"], x * 2.0, rtol=1e-6)
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------------- obs
+
+def test_relational_obs_spans():
+    from dr_tpu import obs
+    rng = np.random.default_rng(26)
+    n = 32
+    _, kv = _mk(rng, n, ints=True, hi=5)
+    _, vv = _mk(rng, n)
+    ok = dr_tpu.distributed_vector(n, np.float32)
+    ov = dr_tpu.distributed_vector(n, np.float32)
+    jk = dr_tpu.distributed_vector(256, np.float32)
+    obs.reset()
+    obs.arm(True)
+    try:
+        dr_tpu.groupby_aggregate(kv, vv, ok, ov)
+        dr_tpu.join(kv, vv, kv, vv, jk,
+                    dr_tpu.distributed_vector(256, np.float32),
+                    dr_tpu.distributed_vector(256, np.float32))
+        dr_tpu.histogram(vv, dr_tpu.distributed_vector(4, np.int32),
+                         -2.0, 2.0)
+        dr_tpu.top_k(vv, dr_tpu.distributed_vector(3, np.float32))
+        evs = obs.events()
+    finally:
+        obs.arm(False)
+        obs.reset()
+    names = {e.get("name") for e in evs}
+    assert {"relational.groupby", "relational.join",
+            "relational.histogram", "relational.top_k"} <= names
+    phases = {e.get("args", {}).get("phase") for e in evs
+              if e.get("name") == "relational.phase"}
+    # the join's time splits into visible phases
+    assert {"sort_left", "sort_right", "merge", "sort",
+            "aggregate"} <= phases

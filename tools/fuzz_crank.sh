@@ -58,6 +58,12 @@
 # elastic rescue per pass; every container must match its pre-fault
 # oracle or raise classified (docs/SPEC.md SS16).  The chaos arm above
 # sweeps the device.lost / mesh.shrink site rows.
+# RELATIONAL arm (round 14): test_fuzz_relational cranks random key
+# distributions (uniform / skewed / all-equal / distinct / float) x
+# uneven layouts through join / groupby / unique / histogram / top_k
+# vs pandas/numpy oracles (filter `relational`) — collected
+# automatically with the fuzz arms; the chaos battery grew a
+# join -> groupby -> deferred top_k/histogram leg (docs/SPEC.md SS17).
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}

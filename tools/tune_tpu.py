@@ -612,6 +612,42 @@ def tune_pipeline():
                       flush=True)
 
 
+def tune_relational():
+    """Relational-layer ladder (round 14, docs/SPEC.md §17) for the
+    queued silicon session: per-stage wall time of the TPC-style
+    pipeline (join -> groupby sum -> top_k) at growing fact-table
+    sizes x key cardinalities — the numbers that decide whether the
+    broadcast sorted-merge join needs the bounded-memory repartition
+    exchange (ROADMAP item 2) on real chips."""
+    import dr_tpu
+    # the SAME runner as bench's relational config: the on-chip
+    # ladder must time the identical workload the PERF.md rows record
+    from bench import _relational_runner
+
+    dr_tpu.init()
+    on_cpu = dr_tpu.devices()[0].platform == "cpu"
+    for logn in ((12, 14) if on_cpu else (16, 18, 20)):
+        n = 2 ** logn
+        for card in (max(n // 64, 4), max(n // 8, 4)):
+            stage = conts = None
+            try:
+                stage, conts = _relational_runner(n, card)
+                stage()  # warm/compile
+                _m, _ng, ts = stage()
+                total = sum(ts.values())
+                print(f"relational n=2^{logn} card={card:<7d}: "
+                      f"join {ts['join'] * 1e3:8.2f} ms  "
+                      f"groupby {ts['groupby'] * 1e3:8.2f} ms  "
+                      f"topk {ts['topk'] * 1e3:8.2f} ms  "
+                      f"({n / total / 1e3:8.1f} krows/s)",
+                      flush=True)
+            except Exception as e:
+                print(f"relational n=2^{logn} card={card}: FAIL "
+                      f"{_errline(e)}", flush=True)
+            finally:
+                stage = conts = None
+
+
 if __name__ == "__main__":
     # Guarded first backend touch through the SAME degradation router
     # as bench.py and entry() (utils/resilience): a dead relay degrades
@@ -646,6 +682,8 @@ if __name__ == "__main__":
             tune_sort()
         if what in ("pipeline", "all"):
             tune_pipeline()
+        if what in ("relational", "all"):
+            tune_relational()
         for nm in ("dot", "heat", "attn", "halo", "spmv"):
             if what in (nm, "all"):
                 tune_container(nm)
