@@ -77,6 +77,14 @@ markers the shrink publishes ride the re-exec environment like the
 ``_DR_TPU_SERVE_*`` ones, so ``detail.degraded.shrink`` (lost ranks,
 rescued/restored/lost container counts, shrink wall time) lands in
 EVERY artifact the run emits, CPU-fallback re-exec legs included.
+
+Round 15: the recovery half rides the same markers — a session that
+GREW BACK (elastic grow-back, docs/SPEC.md §16.6: a recovered device
+re-admitted, or the serve claim re-promoted from the CPU route to the
+device route after a relay returned) carries
+``detail.degraded.grow`` — grow count, moved/kept container counts,
+the re-admitted mesh size, grow wall time — next to the ``shrink``
+chapter, so one artifact tells the whole degrade-and-recover arc.
 """
 
 import json

@@ -25,10 +25,13 @@ namespaces through one TPU backend, called ``thp``):
 - halo:       ``halo_bounds``, ``span_halo``, ``halo(r)``, ``stencil``
 - plans:      ``deferred`` / ``Plan`` — record algorithm chains, flush
   them as ONE fused dispatch (cross-algorithm dispatch fusion)
-- elastic:    ``redistribute`` / ``elastic.rescue_session`` — survive a
-  mid-session device loss by shrinking the mesh and rescuing live
-  state (docs/SPEC.md §16; ``DR_TPU_ELASTIC=1`` arms automatic
-  shrink-and-retry)
+- elastic:    ``redistribute`` / ``elastic.rescue_session`` /
+  ``elastic.grow_session`` — survive a mid-session device loss by
+  shrinking the mesh and rescuing live state, then RE-ADMIT recovered
+  devices/relays and move live state back onto the grown layout
+  (docs/SPEC.md §16/§16.6; ``DR_TPU_ELASTIC=1`` arms automatic
+  shrink-and-retry, ``DR_TPU_ELASTIC_GROW=1`` the symmetric grow-back
+  polls)
 """
 
 from .utils import jax_compat  # noqa: F401  (jax.shard_map shim, first)

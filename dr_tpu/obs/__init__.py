@@ -12,8 +12,10 @@ feed:
   dispatch/compile (``spmd_guard``), every fault-registry site visit
   AND every injected fault (``utils/faults`` — a ``DR_TPU_FAULT_SPEC``
   injection appears *in* the trace), plan record/flush, retry/deadline
-  attempts, fallback warns, serve request lifecycles, and ``drlog``
-  debug lines as instant events.
+  attempts, fallback warns, serve request lifecycles, the elastic
+  re-layout spans (``mesh.shrink`` with the device-loss event inside
+  it, ``mesh.grow`` with the recovery event — docs/SPEC.md §16/§16.6),
+  and ``drlog`` debug lines as instant events.
 * **metrics** (``metrics``): counters, gauges, bucketed histograms.
   Handles are always live (the serve daemon samples queue-wait /
   service / flush time per request on every run); the module-level

@@ -43,6 +43,7 @@ __all__ = [
     "barrier",
     "fence",
     "probe_devices",
+    "probe_recovered",
     "setup_compile_cache",
     "Runtime",
     "get_duplicated_devices",
@@ -208,6 +209,30 @@ def probe_devices(timeout_s: float):
                       "(wedged tunnel relay?)")
     except Exception as e:  # pragma: no cover - backend specific
         return None, repr(e)[:200]
+
+
+def probe_recovered(timeout_s: float = 30.0):
+    """Devices the backend exposes BEYOND the current mesh — the
+    grow-back candidates (utils/elastic.grow_session, docs/SPEC.md
+    §16.6).  Fires the ``device.recover`` injection site, so a chaos
+    spec can fail any recovery probe classified; the device listing
+    runs under the deadline watchdog, so a half-returned relay costs at
+    most ``timeout_s``, never a hang.  Returns ``[]`` when the runtime
+    is uninitialized (nothing to grow back onto) or every visible
+    device is already meshed.
+
+    Claim-free relative to OTHER processes: this only re-lists the
+    devices the CURRENT process's backend client already owns — it
+    must be called from the claim holder between batches/flushes (the
+    one-TPU-process rule), which is exactly where the grow supervisor
+    polls it."""
+    _faults.fire("device.recover")
+    if not is_initialized():
+        return []
+    have = {d.id for d in _runtime.devices}
+    devs = _resilience.with_deadline(jax.devices, timeout_s,
+                                     site="device.recover", dump=False)
+    return [d for d in devs if d.id not in have]
 
 
 def get_duplicated_devices(n: int, devices: Optional[Sequence] = None):

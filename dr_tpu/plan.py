@@ -1072,3 +1072,13 @@ def deferred():
     p = Plan()
     with p.record():
         yield p
+    # elastic grow-back poll (docs/SPEC.md §16.6): the OUTERMOST region
+    # exit — after the flush, with nothing recorded and nothing in
+    # flight on this thread — is the sanctioned between-flushes moment
+    # for re-admitting recovered devices.  One env check when
+    # DR_TPU_ELASTIC_GROW is off or the session never shrank; never
+    # raises (a failed probe/grow leaves the session on the small
+    # mesh).  Skipped when the region body raised: the discard path
+    # must surface the user's error, not a recovery side quest.
+    from .utils import elastic as _elastic
+    _elastic.maybe_grow()

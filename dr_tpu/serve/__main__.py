@@ -29,14 +29,18 @@ def main(argv=None) -> int:
                          "(default: $DR_TPU_SERVE_SOCKET or the "
                          "per-uid temp path)")
     ap.add_argument("--cpu", action="store_true",
-                    help="force the CPU platform before backend init")
+                    help="force the CPU platform before backend init; "
+                         "pins the REQUESTED route, so the grow "
+                         "supervisor never probes this daemon for a "
+                         "device-route re-promotion (docs/SPEC.md "
+                         "§16.6)")
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
     from ..utils import resilience
     from .daemon import Server
-    srv = Server(args.socket)
+    srv = Server(args.socket, cpu=args.cpu)
     try:
         srv.start()
     except Exception as e:

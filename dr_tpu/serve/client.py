@@ -204,6 +204,17 @@ class Client:
         per-request latency histograms the daemon samples."""
         return self.stats().get("obs", {})
 
+    def route(self) -> dict:
+        """The daemon's serving route,
+        ``{"requested": "cpu"|"device", "current": ...}`` (docs/SPEC.md
+        §16.6): a claim degraded to the CPU route by relay death
+        re-promotes to the device route between batches when the grow
+        supervisor is armed (``DR_TPU_ELASTIC_GROW=1``) — unless the
+        CPU route was REQUESTED (``--cpu``), which pins it.
+        ``stats()["grows"]`` counts completed re-promotions and mesh
+        grow-backs."""
+        return self.stats()["route"]
+
     def shutdown(self) -> dict:
         return self.request("shutdown")
 

@@ -44,6 +44,19 @@ Robustness contract (chaos-swept via the ``serve.accept`` /
   the batch on the shrunken mesh, handlers rebuild their containers,
   and no client is dropped; the shrink lands in ``stats()["shrinks"]``
   and the degradation story's ``shrink`` chapter;
+* with ``DR_TPU_ELASTIC_GROW=1`` the degradation is SYMMETRIC (SPEC
+  §16.6): a claim degraded to the CPU route re-probes the REQUESTED
+  route with bounded seeded backoff BETWEEN batches — on the dispatch
+  thread, the only moment the claim owner provably has nothing in
+  flight — and re-promotes to the device route without dropping
+  clients (``stats()["grows"]``, the story's ``grow`` chapter, fault
+  sites ``device.recover``/``mesh.grow``).  A daemon STARTED on the
+  CPU route by request (``--cpu`` / ``Server(cpu=True)``) is never
+  probed: the requested route is pinned next to the degraded route,
+  so the supervisor is a structural no-op there.  A shrunken mesh
+  grows back the same way (the elastic module supervisor polls at
+  each batch's deferred-region exit, and the dispatch loop diffs
+  ``elastic.grow_count()`` exactly like shrinks);
 * a stale socket file from a dead daemon is taken over at start; a
   LIVE daemon makes a second ``start()`` fail with a classified error
   before the newcomer can race the claim.
@@ -411,8 +424,23 @@ class Server:
 
     def __init__(self, socket_path=None, *, queue_depth=None,
                  tenant_cap=None, batch_max=None, batch_window=None,
-                 init_timeout=None, flush_deadline=None):
+                 init_timeout=None, flush_deadline=None, cpu=False):
         self.path = socket_path or default_socket_path()
+        #: the REQUESTED route, pinned at construction and persisted
+        #: next to the degraded route (SPEC §16.6): a daemon started
+        #: with --cpu asked for the CPU claim — the grow supervisor
+        #: must never probe it for a device-route re-promotion
+        self.cpu_requested = bool(cpu)
+        self.requested_route = "cpu" if cpu else "device"
+        self._route = None
+        self._orig_platforms = None
+        self._grow_sup = None
+        #: mesh size before the FIRST shrink of the current degraded
+        #: episode: a grow-back clears the degraded flag only once the
+        #: claim is back to this size — a PARTIAL recovery must not
+        #: report a healthy claim (the module supervisor keeps probing
+        #: for the stragglers)
+        self._pre_shrink_nprocs = None
         self.queue_depth = (env_int("DR_TPU_SERVE_QUEUE_DEPTH", 64)
                             if queue_depth is None else int(queue_depth))
         self.tenant_cap = (env_int("DR_TPU_SERVE_TENANT_CAP", 8)
@@ -453,6 +481,7 @@ class Server:
         self._batch_hw = 0
         self._restarts = 0
         self._shrinks = 0
+        self._grows = 0
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Server":
@@ -493,12 +522,21 @@ class Server:
 
     def _claim(self) -> None:
         """Claim the backend ONCE: probe_devices under the deadline
-        watchdog, with the shared dead-relay → CPU degradation route."""
+        watchdog, with the shared dead-relay → CPU degradation route.
+        The pre-claim platform is remembered so a later route
+        re-promotion (SPEC §16.6) knows which platform to re-probe."""
+        import jax
         import dr_tpu
+        self._orig_platforms = \
+            str(getattr(jax.config, "jax_platforms", "") or "")
+        if self.cpu_requested:
+            jax.config.update("jax_platforms", "cpu")
         devs, degraded = resilience.first_touch_or_cpu(
             self.init_timeout, tag="serve.claim")
         dr_tpu.init(devs)
         self.devices = devs
+        self._route = "cpu" if (self.cpu_requested or degraded) \
+            else "device"
         if degraded:
             self._mark_degraded(f"serve: claimed on the CPU route "
                                 f"({degraded})")
@@ -740,6 +778,12 @@ class Server:
                         if not (OPS.get(r.op) and OPS[r.op].batchable)]
                 for group in ([fusible] if fusible else []) + solo:
                     self._exec_group(group)
+                # BETWEEN batches — the only moment the dispatch
+                # thread provably owns no in-flight device work — poll
+                # the grow supervisors (SPEC §16.6): route
+                # re-promotion for a CPU-degraded claim, mesh grow-back
+                # for a shrunken one.  Never raises, cheap when off.
+                self._maybe_promote()
             except Exception as e:  # the dispatcher must never die: a
                 # dead dispatch loop turns every later request into a
                 # silent hang — fail what we hold, classified, and
@@ -799,6 +843,8 @@ class Server:
         # counter diff below turns a mid-batch shrink into the serve
         # chapter of the degradation story.
         shrinks0 = _elastic.shrink_count()
+        grows0 = _elastic.grow_count()
+        nprocs0 = dr_tpu.nprocs()
         try:
             try:
                 results = resilience.with_deadline(
@@ -823,10 +869,21 @@ class Server:
                     import dr_tpu
                     self._shrinks += shrunk
                     self.devices = dr_tpu.devices()
+                    if self._pre_shrink_nprocs is None:
+                        self._pre_shrink_nprocs = nprocs0
                     self._mark_degraded(
                         f"serve: device loss mid-batch; resident "
                         f"claim degraded to the {dr_tpu.nprocs()}"
                         "-device shrunken mesh")
+                # the symmetric diff (SPEC §16.6): a grow-back riding
+                # this batch's deferred-region exit (the elastic module
+                # supervisor) changed the resident claim too
+                grown = _elastic.grow_count() - grows0
+                if grown:
+                    import dr_tpu
+                    self._grows += grown
+                    self.devices = dr_tpu.devices()
+                    self._note_grown()
             self._flushes += 1
             if batchable:
                 self._batched += len(group)
@@ -892,9 +949,106 @@ class Server:
         dr_tpu.init(ft.devices)
         self.devices = ft.devices
         self._restarts += 1
+        self._route = "cpu"
+        # each fresh degradation re-arms the full re-promotion probe
+        # budget (the supervisor is passive — polled between batches)
+        self._grow_sup = None
         self._mark_degraded(
             f"serve: relay died mid-session ({type(err).__name__}: "
             f"{err}); daemon restarted on the CPU route")
+
+    def _maybe_promote(self) -> None:
+        """Grow-back supervisor poll, BETWEEN batches on the dispatch
+        thread (docs/SPEC.md §16.6).  Two recoveries ride here:
+
+        * **mesh grow-back** — a session the elastic layer shrank
+          polls the module supervisor for returned devices
+          (``elastic.maybe_grow``, also reached at each batch's
+          deferred-region exit);
+        * **route re-promotion** — a claim degraded to the CPU route
+          by relay death re-probes the REQUESTED route through this
+          daemon's own bounded-backoff supervisor and re-promotes
+          without dropping clients.
+
+        Structural no-op for a CPU-REQUESTED daemon (``--cpu``): the
+        requested route is pinned at construction, so a claim the
+        operator asked to keep on CPU is never probed.  Never raises
+        — a failed probe/grow leaves the session exactly where it was
+        (classified, warned, backed off)."""
+        rep = _elastic.maybe_grow()
+        if rep is not None:
+            import dr_tpu
+            self._grows += 1
+            self.devices = dr_tpu.devices()
+            self._note_grown()
+        if (self.cpu_requested or self._route != "cpu"
+                or not _elastic.grow_enabled()
+                # an unknown pre-claim platform (unset/auto) cannot be
+                # re-probed honestly: route_first_touch would probe
+                # whatever platform is current — the CPU mesh we just
+                # degraded to — and report a false re-promotion
+                or not self._orig_platforms):
+            return
+        if self._grow_sup is None:
+            self._grow_sup = _elastic.GrowSupervisor()
+        rep = self._grow_sup.poll(self._promote_attempt)
+        if rep is not None:
+            import dr_tpu
+            self._grows += 1
+            self._route = "device"
+            self.devices = dr_tpu.devices()
+            self.degraded = None
+            self._pre_shrink_nprocs = None
+            warn_fallback(
+                "serve",
+                f"relay recovered; resident claim re-promoted to the "
+                f"{dr_tpu.nprocs()}-device route "
+                f"(probe {self._grow_sup.probes}/"
+                f"{self._grow_sup.budget})")
+
+    def _promote_attempt(self):
+        """One re-promotion probe of the REQUESTED route (the
+        supervisor's attempt callable).  Fires ``device.recover``;
+        restores the pre-claim platform and routes the first touch
+        again — a still-dead relay is the cheap TCP fast path (None:
+        not recovered yet, back off); a live one re-claims through
+        ``elastic.grow_session`` (fault site ``mesh.grow``, container
+        moves, grow markers).  On ANY failure the platform flips back
+        to the CPU route before the classified error reaches the
+        supervisor — the session keeps serving where it was."""
+        import jax
+        _faults.fire("device.recover", route="serve")
+        jax.config.update("jax_platforms", self._orig_platforms or "cpu")
+        ok = False
+        try:
+            ft = resilience.route_first_touch(self.init_timeout)
+            if ft.decision != "ok":
+                return None  # requested route still down: back off
+            rep = _elastic.grow_session(
+                devices=ft.devices, require_growth=False,
+                reason="serve: relay recovered; resident claim "
+                       "re-promoted to the device route")
+            ok = True
+            return rep
+        finally:
+            if not ok:
+                jax.config.update("jax_platforms", "cpu")
+
+    def _note_grown(self) -> None:
+        """A mesh grow-back landed: clear the degraded flag only once
+        the claim is back to its PRE-SHRINK size — a partial recovery
+        (one of two lost devices returned) must keep reporting
+        degraded while the module supervisor probes for the
+        stragglers.  A claim still on the CPU route stays degraded
+        regardless (the route promotion path owns that flag)."""
+        import dr_tpu
+        if self._route == "cpu":
+            return
+        if self._pre_shrink_nprocs is not None and \
+                dr_tpu.nprocs() < self._pre_shrink_nprocs:
+            return
+        self._pre_shrink_nprocs = None
+        self.degraded = None
 
     # ------------------------------------------------------------- replies
     def _finish(self, req: Request, result=None, error=None) -> None:
@@ -966,6 +1120,9 @@ class Server:
                 "batch_hw": self._batch_hw,
                 "restarts": self._restarts,
                 "shrinks": self._shrinks,
+                "grows": self._grows,
+                "route": {"requested": self.requested_route,
+                          "current": self._route},
                 "degraded": self.degraded,
                 # the obs metrics snapshot rides the stats wire op
                 # (SPEC §15): the daemon-side queue-wait / service /

@@ -57,11 +57,48 @@ chapter of ``detail.degraded`` (they ride re-exec environments like
 the serve markers), and obs records a ``mesh.shrink`` span with the
 device-loss event inside it.  ``DR_TPU_ELASTIC_MIN_DEVICES`` floors
 the shrink — below it the rescue refuses classified.
+
+**Grow-back (round 15, docs/SPEC.md §16.6)** makes elasticity
+symmetric: shrink was the availability story, :func:`grow_session` is
+the capacity story.  A recovered device (or a relay that comes back —
+the serve daemon's route re-promotion, dr_tpu/serve) is RE-ADMITTED:
+the runtime re-inits on the larger mesh and every live container
+moves through ``redistribute()`` onto the grown layout between
+batches/flushes.
+Detection is a bounded, seeded-backoff recovery probe
+(:class:`GrowSupervisor` riding ``resilience.backoff_schedule``;
+PASSIVE — owners poll it between batches, never concurrent with a
+live claim) over ``runtime.probe_recovered`` (fault site
+``device.recover``).  The grow itself fires ``mesh.grow`` before the
+runtime flips, so an injected fault fails the re-admission CLASSIFIED
+with the session still serving correctly on the small mesh — a grow
+must never make things worse.  Re-admission fates:
+
+  ========  =====================================================
+  fate      when / how
+  ========  =====================================================
+  moved     the container redistributes onto the grown mesh
+            (in place, bit-equal — fresh dispatch keys, zero
+            value-keyed recompiles under ``DR_TPU_SANITIZE=1``)
+  kept      the move failed (a second fault mid-redistribute):
+            the container STAYS on the old, still-live small
+            mesh, value intact — never worse than not growing
+  poisoned  a container the preceding shrink LOST stays poisoned
+            — a grow never resurrects dead state as a silent
+            wrong answer
+  ========  =====================================================
+
+``DR_TPU_ELASTIC_GROW=1`` arms the automatic polls (plan region exit,
+serve dispatch loop); explicit :func:`grow_session` calls work either
+way.  Every grow publishes ``_DR_TPU_ELASTIC_GROW_*`` markers —
+``degradation_story`` folds them into a ``grow`` chapter — and obs
+records a ``mesh.grow`` span.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
 import weakref
 
@@ -71,19 +108,28 @@ from typing import List, Optional, Sequence
 
 from . import faults as _faults
 from . import resilience as _resilience
-from .env import env_flag, env_int
+from .env import env_flag, env_float, env_int
 from .fallback import warn_fallback
 
 __all__ = ["enabled", "redistribute", "rescue_session", "try_rescue",
            "attribute", "ShrinkReport", "note_checkpoint",
            "checkpoint_path", "shrink_count", "last_report", "is_lost",
-           "reset", "MARKERS"]
+           "reset", "MARKERS", "grow_enabled", "grow_session",
+           "maybe_grow", "grow_count", "last_grow_report", "GrowReport",
+           "GrowSupervisor", "GROW_MARKERS"]
 
 #: env markers the shrink publishes for resilience.degradation_story
 MARKERS = ("_DR_TPU_ELASTIC_REASON", "_DR_TPU_ELASTIC_SHRINKS",
            "_DR_TPU_ELASTIC_LOST_RANKS", "_DR_TPU_ELASTIC_RESCUED",
            "_DR_TPU_ELASTIC_RESTORED", "_DR_TPU_ELASTIC_LOST",
            "_DR_TPU_ELASTIC_NPROCS", "_DR_TPU_ELASTIC_WALL_S")
+
+#: env markers a grow-back publishes (the ``grow`` story chapter)
+GROW_MARKERS = ("_DR_TPU_ELASTIC_GROW_REASON", "_DR_TPU_ELASTIC_GROWS",
+                "_DR_TPU_ELASTIC_GROW_NPROCS",
+                "_DR_TPU_ELASTIC_GROW_MOVED",
+                "_DR_TPU_ELASTIC_GROW_KEPT",
+                "_DR_TPU_ELASTIC_GROW_WALL_S")
 
 #: id(container) -> (weakref, checkpoint path); ids are recycled, so
 #: the weakref is the liveness check (a dead ref invalidates the row)
@@ -95,9 +141,25 @@ _restored = 0
 _lost = 0
 _wall_s = 0.0
 _last_report: Optional["ShrinkReport"] = None
-#: reentrancy latch: a device "dying" during an active rescue must not
-#: recurse into a second shrink under the first one's feet
+#: reentrancy latch: a device "dying" during an active rescue — or a
+#: recovery probe landing mid-rescue — must not recurse into a second
+#: re-layout under the first one's feet (shrink and grow share it)
 _rescuing = False
+
+# grow-back state (docs/SPEC.md §16.6)
+_grows = 0
+_moved = 0
+_kept = 0
+_grow_wall_s = 0.0
+_last_grow: Optional["GrowReport"] = None
+#: the automatic-poll supervisor (plan region exit / serve dispatch
+#: loop share it through maybe_grow); re-armed per shrink epoch
+_grow_sup: Optional["GrowSupervisor"] = None
+_grow_sup_epoch = -1
+#: two polling threads (a serve dispatch thread next to the host
+#: thread's deferred regions) must not race one grow — the loser of
+#: the non-blocking acquire just skips its poll
+_grow_lock = threading.Lock()
 
 
 def enabled() -> bool:
@@ -107,14 +169,31 @@ def enabled() -> bool:
     return env_flag("DR_TPU_ELASTIC")
 
 
+def grow_enabled() -> bool:
+    """True when ``DR_TPU_ELASTIC_GROW=1`` arms the AUTOMATIC grow-back
+    polls (plan region exit, serve dispatch loop / route re-promotion).
+    Explicit :func:`grow_session` calls work either way."""
+    return env_flag("DR_TPU_ELASTIC_GROW")
+
+
 def shrink_count() -> int:
     """Completed shrinks this process (the serve daemon diffs it to
     notice a mid-batch shrink)."""
     return _shrinks
 
 
+def grow_count() -> int:
+    """Completed grows this process (the serve daemon diffs it to
+    notice a mid-batch grow-back, mirroring :func:`shrink_count`)."""
+    return _grows
+
+
 def last_report() -> Optional["ShrinkReport"]:
     return _last_report
+
+
+def last_grow_report() -> Optional["GrowReport"]:
+    return _last_grow
 
 
 @dataclass
@@ -128,6 +207,21 @@ class ShrinkReport:
     rescued: int = 0
     restored: int = 0
     lost: int = 0
+    wall_s: float = 0.0
+    #: container fates for postmortems: (kind, repr, detail)
+    fates: list = field(default_factory=list)
+
+
+@dataclass
+class GrowReport:
+    """One completed grow-back: what was re-admitted, what moved."""
+
+    reason: str
+    nprocs_before: int
+    nprocs_after: int
+    moved: int = 0
+    kept: int = 0
+    poisoned: int = 0
     wall_s: float = 0.0
     #: container fates for postmortems: (kind, repr, detail)
     fates: list = field(default_factory=list)
@@ -321,11 +415,17 @@ def _plan_fate(c, lost_set: set, P: int, reason: str):
       survivor segments read from the device, dead segments from the
       last atomic checkpoint (the documented consistency contract:
       dead segments rewind to the checkpoint, survivors do not);
-    * a matrix with a checkpoint → ``("restore", ("ckpt", path))`` —
+    * a dense/sparse matrix with a checkpoint →
+      ``("restore", ("snap", (meta, arrays)))`` — PER-TILE hybrid
+      (the vector contract extended, round 15): survivor tiles keep
+      their live values, only tiles on dead ranks rewind;
+    * any other checkpointed kind → ``("restore", ("ckpt", path))`` —
       whole-container reload (v1);
     * no checkpoint → ``("lost", reason)``.
     """
     from ..containers.distributed_vector import distributed_vector
+    from ..containers.dense_matrix import dense_matrix
+    from ..containers.sparse_matrix import sparse_matrix
 
     if not (_owned_ranks(c, P) & lost_set):
         from . import checkpoint as _ck
@@ -336,6 +436,9 @@ def _plan_fate(c, lost_set: set, P: int, reason: str):
     if isinstance(c, distributed_vector):
         return "restore", ("merge", _merge_vector_values(c, lost_set,
                                                          path))
+    if isinstance(c, (dense_matrix, sparse_matrix)):
+        return "restore", ("snap", _merge_matrix_snapshot(c, lost_set,
+                                                          path))
     return "restore", ("ckpt", path)
 
 
@@ -364,11 +467,86 @@ def _merge_vector_values(c, lost_set: set, path: str):
     return base.astype(np.dtype(c.dtype), copy=False)
 
 
+def _merge_matrix_snapshot(c, lost_set: set, path: str):
+    """The per-tile hybrid ``(meta, arrays)`` snapshot for tiled
+    matrices (the vector per-segment contract, §16.3, extended): start
+    from the checkpoint's logical state, overwrite every tile owned by
+    a SURVIVING rank with its live values (tile segments read
+    shard-local — nothing is read from a dead rank); tiles on dead
+    ranks rewind to the checkpoint."""
+    from . import checkpoint as _ck
+    from ..containers.dense_matrix import dense_matrix
+
+    meta, arrays = _ck.read(path)
+    want = "dense_matrix" if isinstance(c, dense_matrix) \
+        else "sparse_matrix"
+    if meta.get("kind") != want:
+        raise ValueError(
+            f"checkpoint at {path} holds a {meta.get('kind')!r}, not "
+            f"this {want}")
+    if tuple(int(s) for s in meta.get("shape", c.shape)) \
+            != tuple(c.shape):
+        raise ValueError(
+            f"checkpoint shape {meta.get('shape')} != live matrix "
+            f"{tuple(c.shape)}")
+    if isinstance(c, dense_matrix):
+        base = np.array(arrays["data"])
+        if base.shape != tuple(c.shape):
+            raise ValueError(
+                f"checkpoint shape {base.shape} != live matrix "
+                f"{tuple(c.shape)}")
+        for seg in c.__dr_segments__():
+            if seg.__dr_rank__() in lost_set:
+                continue
+            # __dr_local__ (not materialize): the shard-local tile
+            # read — materialize() unfolds the WHOLE matrix, which
+            # both reads through the dead rank and pays P-1 full
+            # gathers; the contract is "nothing is read from a dead
+            # rank", same as the vector's _local_values
+            base[seg.rb:seg.re, seg.cb:seg.ce] = \
+                np.asarray(seg.__dr_local__())
+        meta = dict(meta)
+        return meta, {"data": base.astype(np.dtype(c.dtype), copy=False)}
+    # sparse: survivors contribute their live tile triples; the
+    # checkpoint contributes only the entries inside DEAD tiles'
+    # row/col windows (entries nowhere near a dead tile are exactly the
+    # survivors' — live wins everywhere it can)
+    ck_rows = np.asarray(arrays["rows"])
+    ck_cols = np.asarray(arrays["cols"])
+    ck_vals = np.asarray(arrays["vals"])
+    dead = np.zeros(ck_rows.shape, bool)
+    rows, cols, vals = [], [], []
+    for seg in c.__dr_segments__():
+        inside = ((ck_rows >= seg.rb) & (ck_rows < seg.re)
+                  & (ck_cols >= seg.cb) & (ck_cols < seg.ce))
+        if seg.__dr_rank__() in lost_set:
+            dead |= inside
+        else:
+            r, cc, v = seg.triples()
+            rows.append(np.asarray(r))
+            cols.append(np.asarray(cc))
+            vals.append(np.asarray(v))
+    rows.append(ck_rows[dead])
+    cols.append(ck_cols[dead])
+    vals.append(ck_vals[dead])
+    meta = dict(meta)
+    return meta, {
+        "rows": np.concatenate(rows) if rows else np.zeros(0, np.int64),
+        "cols": np.concatenate(cols) if cols else np.zeros(0, np.int64),
+        "vals": np.concatenate(vals) if vals else np.zeros(0),
+    }
+
+
 def _apply_restore(c, payload, new_rt) -> None:
     kind, data = payload
     if kind == "merge":
         c._rebind(new_rt, None)
         c.assign_array(data)
+    elif kind == "snap":
+        from . import checkpoint as _ck
+        meta, arrays = data
+        _swap_state(c, _ck.rebuild(meta, arrays, runtime=new_rt,
+                                   reblock=True), new_rt)
     else:
         from . import checkpoint as _ck
         _swap_state(c, _ck.load(data, runtime=new_rt, reblock=True),
@@ -554,16 +732,301 @@ def _publish(report: ShrinkReport) -> None:
     os.environ["_DR_TPU_ELASTIC_WALL_S"] = f"{_wall_s:.4f}"
 
 
+# ---------------------------------------------------------------------------
+# grow-back: re-admit recovered devices (docs/SPEC.md §16.6)
+# ---------------------------------------------------------------------------
+
+def grow_session(devices=None, *, reason: str = "",
+                 require_growth: bool = True) -> "GrowReport":
+    """Re-admit recovered capacity: re-init the runtime on ``devices``
+    (default: the current mesh plus whatever ``runtime.probe_recovered``
+    finds — the fault-injectable recovery probe) and
+    ``redistribute()`` every live container onto the grown layout, in
+    place.  The symmetric half of :func:`rescue_session`.
+
+    ``require_growth=False`` admits a SAME-SIZE target — the serve
+    daemon's route re-promotion (a claim degraded to the CPU route
+    re-claiming the device route) is a capacity change the device
+    COUNT cannot see.
+
+    Failure contract ("grow must never make things worse"):
+
+    * the ``mesh.grow`` fault site fires BEFORE the runtime rebuild —
+      a fault there raises classified with the session untouched,
+      still serving on the small mesh;
+    * a per-container move failure degrades that container to
+      ``kept`` — it stays on the old (still-live) runtime, value
+      intact, announced through the fallback registry;
+    * containers the preceding shrink POISONED stay poisoned — a grow
+      never resurrects lost state as a silent wrong answer.
+
+    On success the global runtime IS the grown mesh, the cumulative
+    ``_DR_TPU_ELASTIC_GROW_*`` markers are published (the ``grow``
+    chapter of ``resilience.degradation_story``), and obs records a
+    ``mesh.grow`` span."""
+    global _grows, _moved, _kept, _grow_wall_s, _rescuing, _last_grow
+    from .. import obs as _obs
+    from ..parallel import runtime as _rt
+
+    if _rescuing:
+        raise _resilience.ProgramError(
+            "elastic: grow during an active rescue/grow — a second "
+            "re-layout cannot run under the first one", site="mesh.grow")
+    if not _rt.is_initialized():
+        raise _resilience.ProgramError(
+            "elastic: no runtime to grow (init() first)",
+            site="mesh.grow")
+    rt = _rt.runtime()
+    P = rt.nprocs
+    if devices is None:
+        recovered = _rt.probe_recovered()
+        if not recovered:
+            raise _resilience.ProgramError(
+                "elastic: recovery probe found no devices beyond the "
+                f"current {P}-rank mesh — nothing to re-admit",
+                site="mesh.grow")
+        devices = rt.devices + list(recovered)
+    devices = list(devices)
+    if require_growth and len(devices) <= P:
+        raise _resilience.ProgramError(
+            f"elastic: grow target has {len(devices)} device(s), no "
+            f"more than the current {P}-rank mesh — nothing to "
+            "re-admit", site="mesh.grow")
+    reason = reason or (f"re-admitting {len(devices) - P} recovered "
+                        "device(s)")
+    t0 = time.perf_counter()
+    sid = _obs.begin("mesh.grow", cat="elastic", nprocs=P,
+                     target=len(devices))
+    _rescuing = True
+    report = GrowReport(reason=reason, nprocs_before=P,
+                        nprocs_after=len(devices))
+    try:
+        # the recovery event sits INSIDE the grow span, mirroring the
+        # device-loss event inside mesh.shrink
+        _obs.event("device.recover", cat="elastic",
+                   admitted=len(devices) - P)
+        _faults.fire("mesh.grow", target=len(devices))
+        _validate_admitted(devices, rt)
+        live = rt.live_containers()
+        new_rt = _rt.init(devices)
+        for c in live:
+            name = type(c).__name__
+            if is_lost(c):
+                # the shrink's loss verdict survives the grow: only a
+                # checkpoint that predates the loss can restore it
+                report.poisoned += 1
+                report.fates.append(("poisoned", name, ""))
+                continue
+            try:
+                redistribute(c, None, runtime=new_rt)
+                report.moved += 1
+                report.fates.append(("moved", name, ""))
+            except Exception as e:
+                # never worse than not growing: the container stays on
+                # the old (still-live) small runtime, value intact
+                report.kept += 1
+                report.fates.append(("kept", name, repr(e)))
+                warn_fallback(
+                    "elastic",
+                    f"grow: {name} stays on the {P}-device mesh "
+                    f"(move failed: {e!r})")
+        report.wall_s = round(time.perf_counter() - t0, 4)
+        _grows += 1
+        _moved += report.moved
+        _kept += report.kept
+        _grow_wall_s += report.wall_s
+        _last_grow = report
+        _publish_grow(report)
+        warn_fallback(
+            "elastic",
+            f"mesh grew {P} -> {len(devices)} device(s): "
+            f"{report.moved} moved, {report.kept} kept, "
+            f"{report.poisoned} left poisoned; {reason}")
+        return report
+    finally:
+        _rescuing = False
+        _obs.end(sid, nprocs=report.nprocs_after, moved=report.moved,
+                 kept=report.kept, poisoned=report.poisoned)
+
+
+def _validate_admitted(devices, rt) -> None:
+    """A device LISTED is not a device ALIVE: PJRT enumeration is
+    fixed at client init, so after a real mid-session loss the dead
+    chip is still in ``jax.devices()`` — re-admitting it untested
+    would oscillate shrink→grow→shrink, rewinding checkpointed
+    segments every cycle.  Touch every device being ADMITTED — not
+    already in the current mesh, keyed by (platform, id) so a serve
+    route promotion validates its whole target — with a scalar round
+    trip under the deadline watchdog.  A dead or wedged device fails
+    the grow CLASSIFIED here, before the runtime flips and before
+    anything moves (the supervisor then backs off; the session stays
+    on the small mesh)."""
+    import jax
+
+    have = {(getattr(d, "platform", ""), d.id) for d in rt.devices}
+    fresh = [d for d in devices
+             if (getattr(d, "platform", ""), d.id) not in have]
+    if not fresh:
+        return
+
+    def touch():
+        for d in fresh:
+            np.asarray(jax.device_put(np.float32(1.0), d))
+
+    try:
+        _resilience.with_deadline(touch, 30.0, site="mesh.grow",
+                                  dump=False)
+    except _resilience.ResilienceError:
+        raise
+    except Exception as e:
+        raise _resilience.classified(
+            f"elastic: re-admission validation failed — a listed "
+            f"device did not answer the scalar touch ({e!r})",
+            site="mesh.grow") from e
+
+
+def _publish_grow(report: "GrowReport") -> None:
+    """Publish the cumulative grow chapter as env markers —
+    ``resilience.degradation_story`` folds them into
+    ``detail.degraded.grow`` and they ride re-exec environments like
+    the shrink markers."""
+    os.environ["_DR_TPU_ELASTIC_GROW_REASON"] = report.reason[:200]
+    os.environ["_DR_TPU_ELASTIC_GROWS"] = str(_grows)
+    os.environ["_DR_TPU_ELASTIC_GROW_NPROCS"] = str(report.nprocs_after)
+    os.environ["_DR_TPU_ELASTIC_GROW_MOVED"] = str(_moved)
+    os.environ["_DR_TPU_ELASTIC_GROW_KEPT"] = str(_kept)
+    os.environ["_DR_TPU_ELASTIC_GROW_WALL_S"] = f"{_grow_wall_s:.4f}"
+
+
+class GrowSupervisor:
+    """Bounded, seeded-backoff recovery supervisor (SPEC §16.6).
+
+    PASSIVE on purpose — it owns no thread: the claim holder polls it
+    between batches/plan flushes (the one-TPU-process rule: a recovery
+    probe must never run concurrent with a live claim, and the moment
+    between batches is the only time the dispatch thread provably owns
+    nothing in flight).  Probe delays ride
+    ``resilience.backoff_schedule`` — deterministic seeded jitter, so
+    tests reproduce every probe time — starting at
+    ``DR_TPU_ELASTIC_GROW_PROBE_S``, doubling to the
+    ``DR_TPU_ELASTIC_GROW_PROBE_CAP_S`` cap, and BOUNDED at
+    ``DR_TPU_ELASTIC_GROW_PROBES`` total probes: a capacity that never
+    comes back must not be probed forever."""
+
+    def __init__(self, *, seed: int = 0):
+        base = env_float("DR_TPU_ELASTIC_GROW_PROBE_S", 1.0)
+        cap = env_float("DR_TPU_ELASTIC_GROW_PROBE_CAP_S", 60.0)
+        self.budget = env_int("DR_TPU_ELASTIC_GROW_PROBES", 64)
+        self._delays = _resilience.backoff_schedule(
+            self.budget, base=max(0.0, base), factor=2.0,
+            max_delay=max(0.0, cap), seed=seed)
+        self.probes = 0
+        self.failures = 0
+        self.grows = 0
+        self._next = time.monotonic() + (self._delays[0]
+                                         if self._delays else 0.0)
+
+    def exhausted(self) -> bool:
+        return self.probes >= self.budget
+
+    def due(self, now: Optional[float] = None) -> bool:
+        return not self.exhausted() and \
+            (time.monotonic() if now is None else now) >= self._next
+
+    def poll(self, attempt) -> Optional["GrowReport"]:
+        """Run ``attempt()`` if a probe is due.  ``attempt`` returns a
+        :class:`GrowReport` on a completed grow, None when nothing has
+        recovered yet; a CLASSIFIED failure (an injected
+        ``device.recover``/``mesh.grow`` fault, a wedged probe) is
+        caught, warned, and counted — the session stays exactly where
+        it was and the backoff continues.  Never raises."""
+        now = time.monotonic()
+        if not self.due(now):
+            return None
+        self.probes += 1
+        if self.probes < self.budget:
+            self._next = now + self._delays[self.probes]
+        try:
+            rep = attempt()
+        except Exception as e:
+            self.failures += 1
+            warn_fallback(
+                "elastic",
+                f"grow probe {self.probes}/{self.budget} failed "
+                f"({_resilience.classified(e)}); staying on the "
+                "current mesh/route")
+            return None
+        if rep is not None:
+            self.grows += 1
+        return rep
+
+
+def _probe_and_grow() -> Optional["GrowReport"]:
+    """The default supervisor attempt: probe for returned devices
+    (fault site ``device.recover``) and re-admit them."""
+    from ..parallel import runtime as _rt
+    recovered = _rt.probe_recovered()
+    if not recovered:
+        return None
+    rt = _rt.runtime()
+    return grow_session(
+        devices=rt.devices + list(recovered),
+        reason=f"recovery probe: {len(recovered)} device(s) returned")
+
+
+def maybe_grow() -> Optional["GrowReport"]:
+    """The between-flushes polling hook (plan region exit, serve
+    dispatch loop): with ``DR_TPU_ELASTIC_GROW=1`` and a SHRUNKEN
+    session, poll the bounded-backoff supervisor for returned devices
+    and grow back when one is found.  One env check when disarmed; a
+    full mesh (no shrink yet, or already grown back) never probes.
+    Never raises — a failed probe/grow is warned and the session stays
+    where it was."""
+    global _grow_sup, _grow_sup_epoch
+    if not grow_enabled() or _rescuing or _shrinks == 0:
+        return None
+    from ..parallel import runtime as _rt
+    if not _rt.is_initialized():
+        return None
+    if not _grow_lock.acquire(blocking=False):
+        return None  # another thread's poll is already in flight
+    try:
+        if _grow_sup is None or _grow_sup_epoch != _shrinks:
+            # a NEW shrink re-arms the full probe budget
+            _grow_sup = GrowSupervisor()
+            _grow_sup_epoch = _shrinks
+        rep = _grow_sup.poll(_probe_and_grow)
+        if rep is not None:
+            # a grow landed: RESET the backoff, don't exhaust — a
+            # PARTIAL recovery (one of two lost devices returned)
+            # must keep probing for the stragglers.  A fully
+            # re-admitted mesh just runs the fresh budget dry
+            # (probe_recovered returns []), still bounded.
+            _grow_sup = GrowSupervisor()
+            _grow_sup_epoch = _shrinks
+        return rep
+    finally:
+        _grow_lock.release()
+
+
 def reset() -> None:
     """Between-test hygiene (the conftest disarm fixture): clear the
-    markers, the checkpoint registry, and the counters so one test's
-    shrunken-mesh story cannot leak into the next."""
+    markers, the checkpoint registry, the counters, and the grow
+    supervisor so one test's shrunken-mesh story (or its pending probe
+    schedule) cannot leak into the next.  The supervisor is passive —
+    polled, never a thread — so disarming it is just dropping it."""
     global _shrinks, _rescued, _restored, _lost, _wall_s, _last_report
-    global _rescuing
+    global _rescuing, _grows, _moved, _kept, _grow_wall_s, _last_grow
+    global _grow_sup, _grow_sup_epoch
     _shrinks = _rescued = _restored = _lost = 0
     _wall_s = 0.0
     _last_report = None
     _rescuing = False
+    _grows = _moved = _kept = 0
+    _grow_wall_s = 0.0
+    _last_grow = None
+    _grow_sup = None
+    _grow_sup_epoch = -1
     _ckpts.clear()
-    for m in MARKERS:
+    for m in MARKERS + GROW_MARKERS:
         os.environ.pop(m, None)

@@ -63,6 +63,18 @@ mesh.shrink          utils/elastic.rescue_session   transient, program
                      the runtime rebuild — a fault
                      fails the rescue classified,
                      containers untouched)
+device.recover       every grow-back recovery       transient, program
+                     probe (runtime.
+                     probe_recovered and the serve
+                     route re-promotion probe —
+                     one failed probe, supervisor
+                     backs off, session unchanged)
+mesh.grow            utils/elastic.grow_session     transient, program
+                     (the grow boundary, before
+                     the larger runtime is built —
+                     a fault fails the re-admission
+                     classified; the session keeps
+                     serving on the small mesh)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -134,6 +146,16 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # with the session's containers untouched.
     "device.lost": ("device_lost",),
     "mesh.shrink": ("transient", "program"),
+    # elastic grow-back (docs/SPEC.md §16.6): device.recover fires at
+    # every recovery probe (runtime.probe_recovered and the serve
+    # daemon's route re-promotion probe) — a fault there fails ONE
+    # probe classified and the supervisor backs off, session unchanged;
+    # mesh.grow fires inside utils/elastic.grow_session at the grow
+    # boundary, before the larger runtime is built — a fault there
+    # fails the re-admission classified with the session still serving
+    # correctly on the small mesh (grow must never make things worse).
+    "device.recover": ("transient", "program"),
+    "mesh.grow": ("transient", "program"),
     "fallback.warn": (),
 }
 

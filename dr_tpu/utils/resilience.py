@@ -515,15 +515,21 @@ def degradation_story(env=None) -> Optional[dict]:
     shrank mid-session carries a ``shrink`` chapter (lost ranks,
     rescued/restored/lost container counts, shrink wall time) in every
     artifact, re-execs included (the markers ride the inherited
-    environment like the serve ones).  None when the run is not
-    degraded."""
+    environment like the serve ones).  Grow-backs (SPEC §16.6) add a
+    ``grow`` chapter from the ``_DR_TPU_ELASTIC_GROW_*`` markers —
+    grow count, moved/kept container counts, the re-admitted mesh size
+    — so an artifact whose session shrank AND recovered tells the
+    whole arc.  None when the run is not degraded."""
     env = os.environ if env is None else env
     reason = env.get("_DR_TPU_BENCH_DEGRADED")
     serve_reason = env.get("_DR_TPU_SERVE_DEGRADED")
     shrink_reason = env.get("_DR_TPU_ELASTIC_REASON")
-    if not reason and not serve_reason and not shrink_reason:
+    grow_reason = env.get("_DR_TPU_ELASTIC_GROW_REASON")
+    if not reason and not serve_reason and not shrink_reason \
+            and not grow_reason:
         return None
-    story = {"reason": reason or serve_reason or shrink_reason,
+    story = {"reason": reason or serve_reason or shrink_reason
+             or grow_reason,
              "retries": int(env.get("_DR_TPU_BENCH_RETRIES", "0") or 0),
              "probe_wall_s": float(env.get("_DR_TPU_BENCH_PROBE_S", "0")
                                    or 0.0)}
@@ -555,4 +561,17 @@ def degradation_story(env=None) -> Optional[dict]:
             shrink[key] = conv(raw)
     if shrink:
         story["shrink"] = shrink
+    grow = {}
+    for key, marker, conv in (
+            ("reason", "_DR_TPU_ELASTIC_GROW_REASON", str),
+            ("grows", "_DR_TPU_ELASTIC_GROWS", int),
+            ("moved", "_DR_TPU_ELASTIC_GROW_MOVED", int),
+            ("kept", "_DR_TPU_ELASTIC_GROW_KEPT", int),
+            ("nprocs", "_DR_TPU_ELASTIC_GROW_NPROCS", int),
+            ("wall_s", "_DR_TPU_ELASTIC_GROW_WALL_S", float)):
+        raw = env.get(marker)
+        if raw not in (None, ""):
+            grow[key] = conv(raw)
+    if grow:
+        story["grow"] = grow
     return story

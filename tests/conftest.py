@@ -77,7 +77,15 @@ def _disarm_faults():
     Elastic shrink state (round 13) gets the same treatment: a test
     that shrank the mesh must not leak its _DR_TPU_ELASTIC_* markers,
     checkpoint registry, or shrink counters into the next test (the
-    _fresh_runtime fixture already restores the full 8-device mesh)."""
+    _fresh_runtime fixture already restores the full 8-device mesh).
+
+    Grow-back state (round 15) rides the same elastic.reset(): the
+    _DR_TPU_ELASTIC_GROW_* markers, grow counters, and the recovery
+    SUPERVISOR are all dropped — the supervisor is passive (polled
+    between batches, never a thread), so disarming it here guarantees
+    no probe schedule (let alone a probe thread) leaks between tests;
+    serve.reset() stops any daemon whose own route supervisor could
+    otherwise still be polled by a live dispatch loop."""
     yield
     from dr_tpu.utils import elastic, faults
     faults.reload_env()

@@ -37,7 +37,8 @@ def _battery(tmpdir: str, tag: str) -> None:
     relational join/groupby/top_k/histogram (round 14) ->
     checkpoint write/read -> fallback.warn -> elastic shrink
     (device.lost rides every dispatch tap; mesh.shrink fires inside
-    the rescue)."""
+    the rescue) -> elastic grow-back (round 15: device.recover fires
+    at the recovery probe, mesh.grow inside the re-admission)."""
     from dr_tpu.parallel.runtime import probe_devices
     devs, err = probe_devices(30.0)
     if err is not None:
@@ -187,6 +188,22 @@ def _battery(tmpdir: str, tag: str) -> None:
     try:
         dr_tpu.to_numpy(gone)
         raise AssertionError("lost container must raise classified")
+    except resilience.DeviceLostError:
+        pass
+
+    # grow-back leg (round 15, docs/SPEC.md §16.6): the lost rank
+    # "returns" — device.recover fires at the recovery probe,
+    # mesh.grow inside grow_session.  Rescued state must ride the
+    # re-admission bit-equal, and the poisoned container must STAY
+    # classified — a grow never resurrects lost state as a silent
+    # wrong answer.
+    gr = elastic.grow_session(reason="battery: lost rank returned")
+    assert gr.nprocs_after == P and dr_tpu.nprocs() == P
+    np.testing.assert_array_equal(dr_tpu.to_numpy(team), esrc)
+    try:
+        dr_tpu.to_numpy(gone)
+        raise AssertionError("poisoned container must stay classified "
+                             "across a grow")
     except resilience.DeviceLostError:
         pass
 

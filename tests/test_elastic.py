@@ -488,10 +488,11 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def test_sanitize_shrink_subprocess():
-    """DR_TPU_SANITIZE=1 over the shrink path: the rebuilt mesh's
-    dispatch keys are fresh and canon-portable, and re-running the
-    same chain on the shrunken mesh stays within the recompile budget
-    (a shrink must not start a value-keyed recompile storm)."""
+    """DR_TPU_SANITIZE=1 over the shrink AND grow-back paths: the
+    rebuilt meshes' dispatch keys are fresh and canon-portable, and
+    re-running the same chain on the shrunken (then re-grown) mesh
+    stays within the recompile budget (neither a shrink nor a grow may
+    start a value-keyed recompile storm)."""
     code = """
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -522,6 +523,20 @@ dr_tpu.transform(a, a, _mul, 3.0)
 assert float(dr_tpu.reduce(a)) == 6.0 * n
 # the same chain again on the SHRUNKEN mesh must be cache-warm
 with sanitize.zero_recompile("post-shrink re-run"):
+    dr_tpu.fill(a, 4.0)
+    dr_tpu.transform(a, a, _mul, 5.0)
+    assert float(dr_tpu.reduce(a)) == 20.0 * n
+sanitize.check_recompiles()
+# grow back (SPEC SS16.6): fresh keys on the grown mesh, then the same
+# chain re-run must be cache-warm there too
+sanitize.reset_epoch()
+gr = elastic.grow_session(reason="sanitize grow smoke")
+assert gr.nprocs_after == P and dr_tpu.nprocs() == P
+np.testing.assert_array_equal(dr_tpu.to_numpy(v), src)
+dr_tpu.fill(a, 2.0)
+dr_tpu.transform(a, a, _mul, 3.0)
+assert float(dr_tpu.reduce(a)) == 6.0 * n
+with sanitize.zero_recompile("post-grow re-run"):
     dr_tpu.fill(a, 4.0)
     dr_tpu.transform(a, a, _mul, 5.0)
     assert float(dr_tpu.reduce(a)) == 20.0 * n
@@ -674,6 +689,459 @@ def test_checkpoint_registry_prunes_dead_containers(tmp_path):
     del v
     gc.collect()
     assert len(elastic._ckpts) == before
+
+
+# ---------------------------------------------------------------------------
+# grow-back: re-admit recovered devices and relays (round 15, SPEC §16.6)
+# ---------------------------------------------------------------------------
+
+def test_grow_sites_registered():
+    """The two new sites are in the registry with their kinds, so the
+    chaos sweep parametrizes over them automatically."""
+    sites = faults.sites()
+    assert set(sites["device.recover"]) == {"transient", "program"}
+    assert set(sites["mesh.grow"]) == {"transient", "program"}
+
+
+def test_grow_session_roundtrip(tmp_path):
+    """shrink → grow: rescued/restored state rides the re-admission
+    bit-equal, the mesh is whole again, the degradation story carries
+    BOTH chapters, and a container the shrink poisoned stays poisoned
+    — a grow never resurrects lost state as a silent wrong answer."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    team = dr_tpu.distributed_vector.from_array(
+        src, distribution=[n] + [0] * (P - 1))
+    ck = dr_tpu.distributed_vector.from_array(src * 2)
+    dr_tpu.checkpoint.save(str(tmp_path / "g.npz"), ck)
+    gone = dr_tpu.distributed_vector.from_array(src * 3)
+    elastic.rescue_session(
+        resilience.DeviceLostError("loss", rank=P - 1))
+    assert dr_tpu.nprocs() == P - 1
+
+    rep = elastic.grow_session(reason="rank returned")
+    assert isinstance(rep, elastic.GrowReport)
+    assert rep.nprocs_before == P - 1 and rep.nprocs_after == P
+    assert dr_tpu.nprocs() == P
+    assert rep.moved == 2 and rep.kept == 0
+    np.testing.assert_array_equal(dr_tpu.to_numpy(team), src)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(ck), src * 2)
+    with pytest.raises(resilience.DeviceLostError):
+        dr_tpu.to_numpy(gone)
+    # the session computes on the grown mesh
+    assert abs(float(dr_tpu.reduce(team)) - src.sum()) < 1e-3
+    story = resilience.degradation_story()
+    assert story and story["shrink"]["shrinks"] == 1
+    assert story["grow"]["grows"] == 1
+    assert story["grow"]["moved"] == 2
+    assert story["grow"]["nprocs"] == P
+    # and reset clears the grow chapter too (conftest hygiene)
+    elastic.reset()
+    assert resilience.degradation_story() is None
+
+
+def test_grow_session_refuses_nothing_to_admit():
+    """A full mesh has nothing to re-admit: the probe-driven grow
+    refuses classified (and ``require_growth`` rejects a same-size
+    target), session untouched."""
+    P = dr_tpu.nprocs()
+    with pytest.raises(resilience.ProgramError):
+        elastic.grow_session()
+    with pytest.raises(resilience.ProgramError):
+        elastic.grow_session(devices=dr_tpu.devices())
+    assert dr_tpu.nprocs() == P
+
+
+def test_mesh_grow_fault_never_makes_worse():
+    """A fault at the mesh.grow site fails the re-admission classified
+    with the session STILL SERVING on the small mesh — the chaos
+    contract for the new site (grow must never make things worse)."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    team = dr_tpu.distributed_vector.from_array(
+        src, distribution=[n] + [0] * (P - 1))
+    elastic.rescue_session(
+        resilience.DeviceLostError("loss", rank=P - 1))
+    with faults.injected("mesh.grow", "transient", times=1):
+        with pytest.raises(resilience.TransientBackendError):
+            elastic.grow_session()
+    assert dr_tpu.nprocs() == P - 1
+    np.testing.assert_array_equal(dr_tpu.to_numpy(team), src)
+    assert elastic.grow_count() == 0
+    # a later clean grow still works
+    rep = elastic.grow_session()
+    assert rep.nprocs_after == P and dr_tpu.nprocs() == P
+    np.testing.assert_array_equal(dr_tpu.to_numpy(team), src)
+
+
+def test_device_recover_fault_classified():
+    """An injected fault at the recovery probe surfaces classified
+    from the probe-driven grow, and the polled supervisor absorbs it
+    (warn + backoff, never a raise)."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    elastic.rescue_session(
+        resilience.DeviceLostError("loss", rank=P - 1))
+    with faults.injected("device.recover", "program", times=1):
+        with pytest.raises(resilience.ProgramError):
+            elastic.grow_session()
+    assert dr_tpu.nprocs() == P - 1
+    # the supervisor path never raises: poll absorbs the classified
+    # fault and the session stays put
+    with env_override(DR_TPU_ELASTIC_GROW="1",
+                      DR_TPU_ELASTIC_GROW_PROBE_S="0"):
+        with faults.injected("device.recover", "transient", times=1):
+            assert elastic.maybe_grow() is None
+        assert dr_tpu.nprocs() == P - 1
+        # next poll (fault exhausted) completes the grow-back
+        rep = elastic.maybe_grow()
+        assert rep is not None and dr_tpu.nprocs() == P
+
+
+def test_grow_supervisor_bounded_backoff():
+    """The supervisor is bounded and deterministic: delays ride the
+    seeded backoff schedule, the probe budget caps total probes, and a
+    classified attempt failure is absorbed (counted, warned)."""
+    with env_override(DR_TPU_ELASTIC_GROW_PROBE_S="0.05",
+                      DR_TPU_ELASTIC_GROW_PROBE_CAP_S="0.2",
+                      DR_TPU_ELASTIC_GROW_PROBES="3"):
+        sup = elastic.GrowSupervisor()
+        assert sup.budget == 3
+        assert not sup.due(now=0.0)  # first probe waits one base delay
+
+        def boom():
+            raise resilience.TransientBackendError("probe died")
+
+        import time as _t
+        deadline = _t.monotonic() + 10.0
+        while not sup.exhausted() and _t.monotonic() < deadline:
+            sup.poll(boom)
+            _t.sleep(0.005)
+        assert sup.exhausted() and sup.probes == 3
+        assert sup.failures == 3
+        # exhausted: no more probes, ever
+        assert sup.poll(boom) is None
+        assert sup.probes == 3
+
+
+def test_plan_region_exit_polls_growback(tmp_path):
+    """The between-flushes hook: a device loss mid-flush shrinks the
+    mesh (elastic replay), and the NEXT deferred-region exit polls the
+    grow supervisor and re-admits the returned device — results
+    bit-equal throughout, no explicit grow call anywhere."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.checkpoint.save(str(tmp_path / "v.npz"), v)
+    with env_override(DR_TPU_ELASTIC="1", DR_TPU_ELASTIC_GROW="1",
+                      DR_TPU_ELASTIC_GROW_PROBE_S="0"):
+        with faults.injected("device.lost", "device_lost", times=1):
+            with dr_tpu.deferred():
+                dr_tpu.fill(v, 2.0)
+                dr_tpu.for_each(v, _half)
+        # the loss shrank the mesh; the region-exit poll follows the
+        # shrink within the same exit (delay 0) or the next region
+        assert dr_tpu.nprocs() in (P - 1, P)
+        with dr_tpu.deferred():
+            dr_tpu.for_each(v, _half)
+    assert dr_tpu.nprocs() == P
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v),
+                                  np.full(n, 0.5, np.float32))
+    story = resilience.degradation_story()
+    assert story and story["grow"]["grows"] == 1
+
+
+def test_serve_requested_cpu_route_is_pinned(tmp_path):
+    """Satellite regression: a daemon started with --cpu (requested
+    CPU route) is NEVER probed for re-promotion — the grow supervisor
+    is a structural no-op, even armed, even degraded."""
+    from dr_tpu import serve
+
+    with env_override(DR_TPU_ELASTIC_GROW="1",
+                      DR_TPU_ELASTIC_GROW_PROBE_S="0"):
+        srv = serve.Server(str(tmp_path / "cp.sock"), batch_window=0.0,
+                           cpu=True).start()
+        try:
+            with serve.Client(srv.path, timeout=60.0) as c:
+                x = np.arange(8, dtype=np.float32)
+                faults.inject("serve.flush", "relay_down", times=1)
+                np.testing.assert_allclose(c.scale(x, a=2.0), x * 2.0,
+                                           rtol=1e-6)
+                faults.clear()
+                st = c.stats()
+                assert st["route"] == {"requested": "cpu",
+                                       "current": "cpu"}
+                # a few more batches: still pinned, never probed
+                for _ in range(3):
+                    np.testing.assert_allclose(c.scale(x, a=3.0),
+                                               x * 3.0, rtol=1e-6)
+                st = c.stats()
+                assert st["grows"] == 0
+                assert st["route"]["current"] == "cpu"
+                assert srv._grow_sup is None
+        finally:
+            faults.clear()
+            srv.stop()
+
+
+def test_serve_repromotion_end_to_end(tmp_path):
+    """THE acceptance scenario (SPEC §16.6): a live daemon degraded to
+    the CPU route by an injected relay death (DR_TPU_FAULT_SPEC)
+    re-claims the device route after the injected fault clears and
+    serves the SAME clients bit-equal results — stats()['grows'] == 1,
+    route back to 'device', and the 'grow' chapter in the story every
+    bench artifact embeds."""
+    import time as _t
+    from dr_tpu import serve
+
+    with env_override(DR_TPU_ELASTIC_GROW="1",
+                      DR_TPU_ELASTIC_GROW_PROBE_S="0.01",
+                      DR_TPU_FAULT_SPEC="serve.flush:relay_down"):
+        faults.reload_env()
+        srv = serve.Server(str(tmp_path / "rp.sock"),
+                           batch_window=0.0).start()
+        try:
+            with serve.Client(srv.path, timeout=60.0) as c:
+                x = np.arange(16, dtype=np.float32)
+                # batch 1: the injected relay death degrades the claim
+                # to the CPU route; the replay answers correctly
+                np.testing.assert_allclose(c.scale(x, a=2.0), x * 2.0,
+                                           rtol=1e-6)
+                st = c.stats()
+                assert st["route"]["current"] == "cpu"
+                assert st["restarts"] == 1 and st["degraded"]
+                # the fault has cleared (times=1): the same client's
+                # later batches ride the re-promotion, no reconnect
+                deadline = _t.monotonic() + 60.0
+                while _t.monotonic() < deadline:
+                    np.testing.assert_allclose(c.scale(x, a=3.0),
+                                               x * 3.0, rtol=1e-6)
+                    st = c.stats()
+                    if st["grows"]:
+                        break
+                    _t.sleep(0.02)
+                assert st["grows"] == 1, st
+                assert st["route"] == {"requested": "device",
+                                       "current": "device"}
+                assert st["degraded"] is None
+                assert c.route()["current"] == "device"
+                # still bit-correct after the promotion
+                np.testing.assert_allclose(c.scale(x, a=4.0), x * 4.0,
+                                           rtol=1e-6)
+        finally:
+            srv.stop()
+            faults.reload_env()
+        story = resilience.degradation_story()
+        assert story and story["grow"]["grows"] >= 1
+        assert "re-promoted" in story["grow"]["reason"]
+
+
+def test_serve_promotion_grow_fault_stays_on_cpu_route(tmp_path):
+    """A fault injected at mesh.grow mid-promotion leaves the session
+    SERVING CORRECTLY on the CPU route (classified, absorbed by the
+    supervisor, backed off) — grow must never make things worse."""
+    from dr_tpu import serve
+
+    with env_override(DR_TPU_ELASTIC_GROW="1",
+                      DR_TPU_ELASTIC_GROW_PROBE_S="0"):
+        srv = serve.Server(str(tmp_path / "gf.sock"),
+                           batch_window=0.0).start()
+        try:
+            with serve.Client(srv.path, timeout=60.0) as c:
+                x = np.arange(8, dtype=np.float32)
+                # both armed up front: the relay dies once, and EVERY
+                # later promotion attempt dies at the grow boundary
+                # (arming after the degrade would race the first
+                # zero-delay probe)
+                faults.inject("serve.flush", "relay_down", times=1)
+                faults.inject("mesh.grow", "transient", times=None)
+                np.testing.assert_allclose(c.scale(x, a=2.0), x * 2.0,
+                                           rtol=1e-6)
+                for a in (3.0, 4.0, 5.0):
+                    np.testing.assert_allclose(c.scale(x, a=a), x * a,
+                                               rtol=1e-6)
+                st = c.stats()
+                assert st["grows"] == 0
+                assert st["route"]["current"] == "cpu"
+                assert srv._grow_sup is not None
+                assert srv._grow_sup.failures >= 1
+                faults.clear()
+        finally:
+            faults.clear()
+            srv.stop()
+
+
+def test_serve_mesh_growback_between_batches(tmp_path):
+    """The shrunken resident claim grows back between batches: a
+    device loss mid-batch shrinks the mesh (round 13); with the grow
+    hook armed the module supervisor re-admits the returned device a
+    few batches later — same clients, bit-equal answers, the grow in
+    stats()."""
+    import time as _t
+    from dr_tpu import serve
+
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    with env_override(DR_TPU_ELASTIC="1", DR_TPU_ELASTIC_GROW="1",
+                      DR_TPU_ELASTIC_GROW_PROBE_S="0.01"):
+        srv = serve.Server(str(tmp_path / "gb.sock"),
+                           batch_window=0.0).start()
+        try:
+            with serve.Client(srv.path, timeout=60.0) as c:
+                x = np.arange(16, dtype=np.float32)
+                faults.inject("device.lost", "device_lost", times=1)
+                np.testing.assert_allclose(c.scale(x, a=3.0), x * 3.0,
+                                           rtol=1e-6)
+                st = c.stats()
+                assert st["shrinks"] == 1
+                deadline = _t.monotonic() + 60.0
+                while _t.monotonic() < deadline:
+                    np.testing.assert_allclose(c.scale(x, a=4.0),
+                                               x * 4.0, rtol=1e-6)
+                    st = c.stats()
+                    if st["grows"]:
+                        break
+                    _t.sleep(0.02)
+                assert st["grows"] == 1, st
+                assert st["degraded"] is None
+        finally:
+            faults.clear()
+            srv.stop()
+    assert dr_tpu.nprocs() == P
+    story = resilience.degradation_story()
+    assert story and story["shrink"]["shrinks"] == 1
+    assert story["grow"]["grows"] == 1
+
+
+# ---------------------------------------------------------------------------
+# per-tile matrix restore (round 15 satellite): survivors keep live
+# values, only dead tiles rewind to the checkpoint
+# ---------------------------------------------------------------------------
+
+def test_dense_matrix_restores_per_tile(tmp_path):
+    """A checkpointed dense matrix restores PER-TILE (like vectors do
+    per-segment): the survivor tile keeps its post-checkpoint write,
+    only the dead rank's tile rewinds."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    src = np.arange(4 * P * 3, dtype=np.float32).reshape(4 * P, 3)
+    m = dr_tpu.dense_matrix.from_array(src, dr_tpu.row_tiles())
+    dr_tpu.checkpoint.save(str(tmp_path / "pt.npz"), m)
+    m[0, 0] = 99.0           # rank-0 tile: survivor, must stay live
+    m[4 * P - 1, 2] = -77.0  # rank-(P-1) tile: dies, must rewind
+    rep = elastic.rescue_session(
+        resilience.DeviceLostError("loss", rank=P - 1))
+    assert rep.restored == 1 and rep.lost == 0
+    assert ("restore", "dense_matrix", "snap") in rep.fates
+    expect = src.copy()
+    expect[0, 0] = 99.0  # survivor keeps its post-checkpoint write
+    np.testing.assert_array_equal(m.materialize(), expect)
+
+
+def test_sparse_matrix_restores_per_tile(tmp_path):
+    """Same per-tile contract for sparse: survivor tiles contribute
+    their LIVE triples, dead tiles rewind to the checkpoint's entries
+    in their row window."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    n = 2 * P
+    rows = np.arange(n)
+    cols = np.tile(np.arange(2), P)
+    vals = np.arange(n, dtype=np.float32)
+    sm = dr_tpu.sparse_matrix.from_coo((n, 4), rows, cols, vals)
+    dr_tpu.checkpoint.save(str(tmp_path / "sp.npz"), sm)
+    rep = elastic.rescue_session(
+        resilience.DeviceLostError("loss", rank=0))
+    assert rep.restored == 1 and rep.lost == 0
+    assert ("restore", "sparse_matrix", "snap") in rep.fates
+    dense = np.zeros((n, 4), np.float32)
+    for seg in sm.__dr_segments__():
+        r, c, v = seg.triples()
+        dense[r, c] = v
+    expect = np.zeros((n, 4), np.float32)
+    expect[rows, cols] = vals
+    np.testing.assert_array_equal(dense, expect)
+    # the restored matrix still multiplies correctly
+    y = dr_tpu.distributed_vector(n)
+    dr_tpu.fill(y, 0.0)
+    dr_tpu.gemv(y, sm, np.ones(4, np.float32))
+    np.testing.assert_allclose(dr_tpu.to_numpy(y),
+                               expect @ np.ones(4, np.float32),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# soak: shrink → grow → shrink vs the never-failed oracle
+# ---------------------------------------------------------------------------
+
+def test_fuzz_elastic_shrink_grow_shrink(tmp_path):
+    """fuzz_crank.sh grow arm (and the tier-1 slice): random
+    kill/revive sequences — checkpoint, kill a rank, revive it, kill
+    another — asserting BIT-EQUAL container state vs the never-failed
+    oracle at every step, for vectors and a per-tile-restored dense
+    matrix, and that the session keeps computing at the end."""
+    import jax
+
+    all_devs = jax.devices()
+    if len(all_devs) < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    from dr_tpu.utils import sanitize
+
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else max(2, ITERS // 14)
+    rng = np.random.default_rng(1900)
+    for it in range(iters):
+        P = int(rng.integers(2, len(all_devs) + 1))
+        dr_tpu.init(all_devs[:P])
+        elastic.reset()
+        n = int(rng.integers(8, 64))
+        oracle = rng.standard_normal(n).astype(np.float32)
+        v = dr_tpu.distributed_vector.from_array(oracle)
+        msrc = rng.standard_normal((2 * P, 3)).astype(np.float32)
+        m = dr_tpu.dense_matrix.from_array(msrc, dr_tpu.row_tiles())
+        for step in range(int(rng.integers(2, 5))):
+            if sanitize.installed():
+                # every kill/revive re-layouts onto a FRESH mesh and
+                # legitimately recompiles the same canonical programs
+                # (a re-grown mesh is a new Mesh object) — one
+                # sanitize epoch per re-layout, the subprocess test's
+                # documented pattern, or the soak reads as a
+                # recompile storm it is not
+                sanitize.reset_epoch()
+            cur = dr_tpu.nprocs()
+            grown_out = dr_tpu.nprocs() >= len(all_devs)
+            if cur > 1 and (grown_out or rng.integers(0, 2)):
+                # kill: checkpoint first, so the per-segment/per-tile
+                # restore merges to exactly the live (oracle) value
+                dr_tpu.checkpoint.save(
+                    str(tmp_path / f"s{it}_{step}v.npz"), v)
+                dr_tpu.checkpoint.save(
+                    str(tmp_path / f"s{it}_{step}m.npz"), m)
+                lost = int(rng.integers(0, cur))
+                elastic.rescue_session(resilience.DeviceLostError(
+                    f"soak kill {it}/{step}", rank=lost))
+            else:
+                elastic.grow_session(reason=f"soak revive {it}/{step}")
+            np.testing.assert_array_equal(dr_tpu.to_numpy(v), oracle,
+                                          err_msg=f"it={it} step={step}")
+            np.testing.assert_array_equal(m.materialize(), msrc,
+                                          err_msg=f"it={it} step={step}")
+        got = float(dr_tpu.reduce(v))
+        want = float(oracle.astype(np.float64).sum())
+        assert abs(got - want) <= 1e-3 * max(1.0, abs(want))
 
 
 def test_serve_shrink_recorded_even_when_replay_fails(tmp_path):

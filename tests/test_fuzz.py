@@ -1517,3 +1517,99 @@ def test_fuzz_relational(seed):
                                        b.values.astype(np.float32),
                                        rtol=1e-5, atol=1e-6,
                                        err_msg=tag)
+
+
+# ---------------------------------------------------------------------------
+# KILL-AND-REVIVE arm (round 15, ISSUE 11): random elastic
+# shrink → grow-back sequences over random container populations —
+# the symmetric-elasticity crank discipline (docs/SPEC.md §16.6).
+# Collected by tools/fuzz_crank.sh with the fuzz arms.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_elastic_kill_and_revive(seed, tmp_path):
+    """Kill a random rank, then REVIVE it through ``grow_session``:
+    every rescued/restored container must ride the re-admission
+    bit-equal to its pre-fault oracle, a container the shrink LOST
+    must stay classified across the grow (never resurrected as a
+    silent wrong answer), and the re-grown session must keep
+    computing.  Random populations: team vectors dodging (or not) the
+    dead rank, uneven cuts, checkpointed defaults, a per-tile-restored
+    dense matrix."""
+    import jax
+
+    from dr_tpu.utils import elastic, resilience, sanitize
+
+    all_devs = jax.devices()
+    if len(all_devs) < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    rng = np.random.default_rng(1850 + seed)
+    # fresh + shrunken + grown meshes recompile per pass: CI runs a
+    # slice, the crank sets DR_TPU_FUZZ_ITERS explicitly
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else max(2, ITERS // 14)
+    for it in range(iters):
+        P = int(rng.integers(2, len(all_devs) + 1))
+        dr_tpu.init(all_devs[:P])
+        elastic.reset()
+        if sanitize.installed():
+            # each pass re-layouts the same canonical programs onto
+            # fresh meshes (init → shrink → grow) — one sanitize
+            # epoch per pass, or the legitimate re-layout recompiles
+            # read as a storm
+            sanitize.reset_epoch()
+        lost = int(rng.integers(0, P))
+        pop = []  # (container, oracle, may_be_lost)
+        for k in range(int(rng.integers(1, 4))):
+            n = int(rng.integers(1, 48))
+            src = rng.standard_normal(n).astype(np.float32)
+            shape = rng.integers(0, 3)
+            if shape == 0:  # team on one random rank
+                sizes = [0] * P
+                home = int(rng.integers(0, P))
+                sizes[home] = n
+                c = dr_tpu.distributed_vector.from_array(
+                    src, distribution=sizes)
+                pop.append((c, src, home == lost))
+            elif shape == 1:  # checkpointed default: always restorable
+                c = dr_tpu.distributed_vector.from_array(src)
+                dr_tpu.checkpoint.save(
+                    str(tmp_path / f"kr{seed}_{it}_{k}.npz"), c)
+                pop.append((c, src, False))
+            else:  # bare default: lost iff it owns the dead rank
+                c = dr_tpu.distributed_vector.from_array(src)
+                b, e = c._rank_window(lost)
+                pop.append((c, src, b < e))
+        msrc = rng.standard_normal((2 * P, 2)).astype(np.float32)
+        mat = dr_tpu.dense_matrix.from_array(msrc, dr_tpu.row_tiles())
+        dr_tpu.checkpoint.save(str(tmp_path / f"kr{seed}_{it}_m.npz"),
+                               mat)
+
+        rep = elastic.rescue_session(resilience.DeviceLostError(
+            f"fuzz kill {it}", rank=lost))
+        assert rep.nprocs_after == P - 1
+        grown = elastic.grow_session(reason=f"fuzz revive {it}")
+        assert grown.nprocs_after >= P
+        assert dr_tpu.nprocs() >= P
+        assert grown.kept == 0, grown.fates
+
+        survived = 0
+        for c, oracle, may_lose in pop:
+            try:
+                got = dr_tpu.to_numpy(c)
+            except resilience.DeviceLostError:
+                assert may_lose, \
+                    f"it={it}: a rescuable container was lost"
+                continue
+            survived += 1
+            np.testing.assert_allclose(got, oracle, rtol=1e-6,
+                                       err_msg=f"it={it}")
+        # +1: the checkpointed matrix always lands in restored (its
+        # tile grid spans every rank, so the dead rank always hits)
+        assert survived + 1 == rep.rescued + rep.restored
+        np.testing.assert_array_equal(mat.materialize(), msrc,
+                                      err_msg=f"it={it}")
+        # the re-grown session still computes correctly
+        w = dr_tpu.distributed_vector.from_array(
+            np.ones(2 * dr_tpu.nprocs(), np.float32))
+        assert abs(float(dr_tpu.reduce(w)) - len(w)) < 1e-4

@@ -64,6 +64,12 @@
 # vs pandas/numpy oracles (filter `relational`) — collected
 # automatically with the fuzz arms; the chaos battery grew a
 # join -> groupby -> deferred top_k/histogram leg (docs/SPEC.md SS17).
+#
+# GROW arm (round 15): test_fuzz_elastic_kill_and_revive (collected
+# with the fuzz arms — random kill -> grow_session revive vs pre-fault
+# oracles) plus the shrink->grow->shrink soak cranked below; the chaos
+# battery grew a grow-back leg sweeping the device.recover / mesh.grow
+# site rows (docs/SPEC.md SS16.6).
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
@@ -162,6 +168,23 @@ if [ -z "$FILTER" ]; then
   st=${PIPESTATUS[0]}
   if [ "$st" -ne 0 ]; then
     echo "FAILED ($st): $nd elastic arm"
+    rc=1
+  fi
+fi
+# GROW arm (round 15): the shrink->grow->shrink roundtrip soak,
+# crank-budgeted — kill a rank, revive it through grow_session, kill
+# another, asserting bit-equal container state vs the never-failed
+# oracle at every step (docs/SPEC.md SS16.6; the kill-and-revive fuzz
+# in test_fuzz.py is collected with the fuzz arms above).  Skipped
+# when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  nd="tests/test_elastic.py::test_fuzz_elastic_shrink_grow_shrink"
+  echo "=== $nd (DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_FUZZ_ITERS=$ITERS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd grow arm"
     rc=1
   fi
 fi
