@@ -85,6 +85,16 @@ device route after a relay returned) carries
 ``detail.degraded.grow`` — grow count, moved/kept container counts,
 the re-admitted mesh size, grow wall time — next to the ``shrink``
 chapter, so one artifact tells the whole degrade-and-recover arc.
+
+Round 16: ``--redistribute`` (or DR_TPU_BENCH_REDISTRIBUTE=1 — argv
+and env both survive the CPU-fallback re-execs) races the two
+re-layout impls (docs/SPEC.md §18) over a layout ping-pong, emitting
+``detail.redistribute_gbps`` (host-staged vs collective, marginal
+method); the always-on relational config additionally records
+``detail.relational_join_route`` — the merge route the join took
+(broadcast vs repartition) with its per-device gathered-channel rows,
+the peak-memory proxy — and ``--relational`` adds the forced
+repartition timing next to the broadcast one.
 """
 
 import json
@@ -894,12 +904,31 @@ def _relational_metrics(on_cpu: bool) -> dict:
     n_fact = 2 ** 14 if on_cpu else 2 ** 18
     ncard = max(n_fact // 16, 4)  # key cardinality (fan-in ~16)
     try:
+        from dr_tpu.algorithms import relational as _rel
+        from dr_tpu.utils.env import env_override
         stage, conts = _relational_runner(n_fact, ncard)
         stage()  # warm the programs (compiles)
         m, ng, ts = stage()
         total = sum(ts.values())
         out["relational_rows"] = {"fact": n_fact, "dim": ncard,
                                   "joined": m, "groups": ng}
+        # the merge route the join took + its per-device
+        # gathered-channel rows — the peak-memory proxy that decides
+        # broadcast vs repartition on real row counts (SPEC §18.4)
+        out["relational_join_route"] = _rel.last_join_route()
+        # forced-repartition A/B: the same join through the
+        # bounded-memory exchange (threshold 0), so the artifact
+        # carries the small/large-side routing gap
+        try:
+            with env_override(DR_TPU_JOIN_BROADCAST_MAX="0"):
+                stage()  # warm the partition programs
+                _m2, _ng2, ts2 = stage()
+            out["relational_join_partition_ms"] = round(
+                ts2["join"] * 1e3, 2)
+            out["relational_join_partition_route"] = \
+                _rel.last_join_route()
+        except Exception as e:  # pragma: no cover - defensive
+            out["relational_join_partition_error"] = repr(e)[:120]
         out["relational_join_ms"] = round(ts["join"] * 1e3, 2)
         out["relational_groupby_ms"] = round(ts["groupby"] * 1e3, 2)
         out["relational_topk_ms"] = round(ts["topk"] * 1e3, 2)
@@ -919,6 +948,48 @@ def _relational_metrics(on_cpu: bool) -> dict:
         out["relational_deferred_dispatches"] = dispatch_count() - d0
     except Exception as e:  # pragma: no cover - defensive
         out["relational_error"] = repr(e)[:160]
+    return out
+
+
+def _redistribute_metrics(on_cpu: bool) -> dict:
+    """--redistribute / DR_TPU_BENCH_REDISTRIBUTE=1 (round 16,
+    docs/SPEC.md §18): per-hop GB/s of a layout ping-pong (default
+    even <-> uneven rotated cut — every shard's window moves) through
+    BOTH impls forced via the override, marginal method.  The
+    host-vs-collective gap is the number that justifies the engine."""
+    import dr_tpu
+    from dr_tpu.utils.env import env_override
+    out = {}
+    P = dr_tpu.nprocs()
+    n = max((2 ** 20 if on_cpu else 2 ** 24) // P * P, P)
+    try:
+        src = np.arange(n, dtype=np.float32)
+        v = dr_tpu.distributed_vector.from_array(src)
+        base = n // P
+        rot = [base] * P
+        rot[0] = base // 2
+        rot[-1] = n - sum(rot[:-1])  # uneven: first half-shard, fat tail
+
+        def mk_run(impl):
+            def run(r):
+                with env_override(DR_TPU_REDISTRIBUTE=impl):
+                    for _ in range(r):
+                        dr_tpu.redistribute(v, rot)
+                        dr_tpu.redistribute(v, None)
+                _sync(v)
+            return run
+
+        gbps = {}
+        for impl in ("host", "collective"):
+            dt = _marginal(mk_run(impl), r1=1, r2=5, samples=3,
+                           min_spread=0.0)
+            # 2 hops per iteration, n float32 elements each
+            gbps[impl] = round(2 * n * 4 / dt / 1e9, 3)
+        out["redistribute_gbps"] = gbps
+        out["redistribute_shape"] = {"n": n, "hops_per_iter": 2,
+                                     "dtype": "float32"}
+    except Exception as e:  # pragma: no cover - defensive
+        out["redistribute_error"] = repr(e)[:160]
     return out
 
 
@@ -1256,6 +1327,13 @@ def main():
         if "--relational" in sys.argv[1:] \
                 or env_flag("DR_TPU_BENCH_RELATIONAL"):
             secondary.update(_relational_metrics(on_cpu))
+        # redistribute config (round 16): host vs collective re-layout
+        # ladder, opt-in (--redistribute / DR_TPU_BENCH_REDISTRIBUTE=1
+        # — argv and env both survive the CPU-fallback re-execs) and
+        # honoring DR_TPU_BENCH_SECONDARY=0 like every config here
+        if "--redistribute" in sys.argv[1:] \
+                or env_flag("DR_TPU_BENCH_REDISTRIBUTE"):
+            secondary.update(_redistribute_metrics(on_cpu))
 
     # tagged CPU fallback: the full degradation story (reason, original
     # probe error, retry count, probe wall time — and, AFTER the serve

@@ -36,15 +36,25 @@ Algorithm shapes
   ``i``; positions ``>= ngroups`` are ZERO.  Returns ``ngroups``.
 * ``unique(r, out)`` — the groupby machinery, keys channel only.
 * ``join(lk, lv, rk, rv, out_keys, out_lv, out_rv, how=...)`` —
-  sort-merge join: both sides sort natively (scratch, non-mutating),
-  then one program ``all_gather``\\ s the SORTED sides (a broadcast
-  sorted-merge: per-device memory is O(n_l + n_r) — the
-  bounded-memory repartition exchange of arXiv:2112.01075 is the
-  ``redistribute()`` follow-up, ROADMAP item 2), counts each left
-  row's matches by two ``searchsorted``\\ s on the monotone key
-  encoding, prefix-sums the counts into output offsets (the scan
-  backbone's shape), and every OUT shard materializes exactly its own
-  window of the expanded rows.  ``how="left"``/``"right"`` ride
+  sort-merge join, TWO merge routes behind one contract (bit-identical
+  rows, docs/SPEC.md §18.4).  Both sides sort natively (scratch,
+  non-mutating).  Small combined sides (``nl + nr`` at or under
+  ``DR_TPU_JOIN_BROADCAST_MAX``) take the BROADCAST merge: one program
+  ``all_gather``\\ s the sorted sides (per-device memory O(n_l +
+  n_r)), counts each left row's matches by two ``searchsorted``\\ s on
+  the monotone key encoding, prefix-sums the counts into output
+  offsets (the scan backbone's shape), and every OUT shard
+  materializes exactly its own window of the expanded rows.  Above
+  the threshold the merge re-homes on the bounded-memory REPARTITION
+  exchange (arXiv:2112.01075's recipe on the shared ring machinery —
+  ROADMAP item 1 landed): the sorted left side is already
+  position-partitioned, each shard's key range is its own block's
+  [first, last] keys, a one-dispatch probe sizes the per-shard
+  contiguous right partition (pow2-quantized ``rcap``), the right
+  blocks rotate once around the ring with each shard scattering only
+  its own key range (ONE block in flight — never a full-side
+  replica), and producer-side masked ``all_to_all`` assembly lands
+  every out window bit-exactly.  ``how="left"``/``"right"`` ride
   presence flags: unmatched rows emit ``fill`` on the missing side.
   Output rows are ordered by (key, left position, right position);
   positions ``>= count`` are ZERO.  Returns the row count.
@@ -106,11 +116,13 @@ from .elementwise import (_apply_chain_ops, _chain_scalars, _out_chain,
 from .reduce import _identity_for
 from .sort import _decode, _encode
 from .. import obs as _obs
+from ..parallel.pipeline import fire_ppermute, ring_pipeline
 from ..utils import resilience as _resilience
+from ..utils.env import env_int
 from ..views import views as _v
 
 __all__ = ["join", "groupby_aggregate", "unique", "histogram", "top_k",
-           "DeferredCount", "AGGS", "JOIN_HOWS"]
+           "DeferredCount", "AGGS", "JOIN_HOWS", "last_join_route"]
 
 #: supported groupby aggregations (docs/SPEC.md §17.1)
 AGGS = ("sum", "min", "max", "count", "mean")
@@ -595,10 +607,14 @@ def _join_program(mesh, axis, llayout, lkdtype, lvdtype, rlayout,
         kl = jnp.where(lvalid, kl, bigl)
         kr = jnp.where(jnp.arange(NR) < nr, kr, bigr)
         # match counts per left row: two searchsorteds on the monotone
-        # encoding (the pad sentinel strictly follows every real key,
-        # so a pad can only match pads — and lvalid masks those out)
-        lo = jnp.searchsorted(kr, kl, side="left")
-        hi = jnp.searchsorted(kr, kl, side="right")
+        # encoding.  Real rows occupy positions [0, nr) of the sorted
+        # channel, pads [nr, NR) — clamping the window to nr keeps an
+        # INTEGER key equal to the pad sentinel (iinfo.max — the
+        # encoding cannot put pads strictly after it) from counting
+        # the pad rows as matches (round-16 fix; float encodings order
+        # pads strictly last and are unaffected)
+        lo = jnp.minimum(jnp.searchsorted(kr, kl, side="left"), nr)
+        hi = jnp.minimum(jnp.searchsorted(kr, kl, side="right"), nr)
         cnt = jnp.where(lvalid, (hi - lo).astype(jnp.int32), 0)
         if left_outer:
             rows = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)
@@ -642,6 +658,254 @@ def _join_program(mesh, axis, llayout, lkdtype, lvdtype, rlayout,
     # check_vma=False: ``M`` derives from the same all_gather'ed
     # channels on every shard (replicated, unprovable statically —
     # the _custom_reduce_program precedent)
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) * 4 + (P(),),
+                        out_specs=(P(axis, None),) * 3 + (P(),),
+                        check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _broadcast_max() -> int:
+    """``DR_TPU_JOIN_BROADCAST_MAX`` (docs/SPEC.md §18.4): combined
+    sorted-side row count up to which ``join`` keeps the broadcast
+    sorted-merge (per-device memory O(nl + nr), one program, the
+    small-side fast path).  Above it — with more than one shard and
+    both sides non-empty — the merge re-homes on the bounded-memory
+    repartition exchange.  ``0`` forces the repartition path (the
+    fuzz/regression arms' switch)."""
+    return env_int("DR_TPU_JOIN_BROADCAST_MAX", 1 << 18, floor=0)
+
+
+#: how the LAST eager join routed — bench/regression introspection
+#: (docs/SPEC.md §18.4); read through :func:`last_join_route`
+_LAST_JOIN_ROUTE: dict = {}
+
+
+def last_join_route() -> dict:
+    """Copy of the last eager join's routing record: ``impl``
+    (``broadcast`` / ``partition``), side sizes, and the per-device
+    gathered-channel rows — ``broadcast`` gathers both full sides
+    (``nl + nr`` rows per device), ``partition`` holds only the local
+    left block plus the ``rcap``-bounded right partition.  The
+    acceptance regression asserts the partition program's gathered
+    channel stays under the full side."""
+    return dict(_LAST_JOIN_ROUTE)
+
+
+def _set_join_route(**kw) -> None:
+    _LAST_JOIN_ROUTE.clear()
+    _LAST_JOIN_ROUTE.update(kw)
+
+
+def _partition_bounds(axis, r, kl, krow, nvr, p):
+    """Trace-time key-range partition plan, shared by the probe and
+    merge programs (docs/SPEC.md §18.4): shard ``d``'s key range is
+    ``[firsts[d], lasts[d]]`` — its own sorted left block's first and
+    last REAL encoded keys (pads already masked to the big sentinel in
+    ``kl``, so an empty left shard owns the empty range).  A right row
+    belongs to every shard whose range covers its key (a boundary key
+    spanning two left shards replicates to both); since both sides are
+    sorted, each shard's right partition is the CONTIGUOUS global
+    slice ``[starts[d], ends[d])``, found by two searchsorteds per
+    shard plus one psum — O(p log S) per device, no data moves."""
+    Sl = kl.shape[0]
+    firsts = lax.all_gather(kl[0], axis)               # (p,)
+    lasts = lax.all_gather(kl[Sl - 1], axis)
+    # pads sort to the big sentinel, so a partially-valid shard's last
+    # REAL key is the minimum of the row suffix... the row is sorted
+    # ascending with pads big-masked at the tail: the last real key is
+    # kl[nvalid-1]; all_gather of a dynamic index is fine trace-side
+    below = jnp.minimum(
+        jnp.searchsorted(krow, firsts, side="left"), nvr)
+    thru = jnp.minimum(
+        jnp.searchsorted(krow, lasts, side="right"), nvr)
+    starts = lax.psum(below, axis)                     # (p,) global
+    ends = lax.psum(thru, axis)
+    return firsts, lasts, starts, ends
+
+
+def _mask_sorted_keys(kb, n, S, r):
+    """Encode one sorted scratch key row and mask its pad tail to the
+    big sentinel: ``(masked_enc, big, nvalid)``."""
+    enc, big = _encode(kb[0])
+    nvalid = jnp.clip(n - r * S, 0, S)
+    return jnp.where(jnp.arange(S) < nvalid, enc, big), big, nvalid
+
+
+def _last_real(kl, nvl, S):
+    """The last REAL key of a masked sorted row (big when empty)."""
+    return kl[jnp.clip(nvl - 1, 0, S - 1)]
+
+
+def _join_partition_probe_program(mesh, axis, llayout, lkdtype,
+                                  rlayout, rkdtype, nl, nr):
+    """The repartition planner's ONE device round trip: per-shard
+    right-partition windows ``(starts, ends)`` under the left key
+    ranges — the host reads ``max(ends - starts)`` and keys the merge
+    program on the pow2-quantized partition capacity (bounded
+    recompiles across key distributions)."""
+    key = ("reljoinplan", pinned_id(mesh), axis, llayout, str(lkdtype),
+           rlayout, str(rkdtype), int(nl), int(nr),
+           bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    p, Sl, *_ = working_geometry(llayout)
+    _, Sr, *_ = working_geometry(rlayout)
+
+    def body(lkb, rkb):
+        r = lax.axis_index(axis)
+        kl, _bigl, nvl = _mask_sorted_keys(lkb, nl, Sl, r)
+        kl = kl.at[Sl - 1].set(_last_real(kl, nvl, Sl))
+        krow, _bigr, nvr = _mask_sorted_keys(rkb, nr, Sr, r)
+        _f, _l, starts, ends = _partition_bounds(axis, r, kl, krow,
+                                                 nvr, p)
+        return starts, ends
+
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) * 2,
+                        out_specs=(P(), P()), check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _join_partition_program(mesh, axis, llayout, lkdtype, lvdtype,
+                            rlayout, rkdtype, rvdtype, ok_layout,
+                            ok_dtype, ol_layout, ol_dtype, or_layout,
+                            or_dtype, nl, nr, left_outer, rcap):
+    """Bounded-memory repartition sorted-merge (docs/SPEC.md §18.4,
+    arXiv:2112.01075's recipe spent on the join's memory wall).  The
+    broadcast program all_gathers BOTH sorted sides onto every device
+    — O(nl + nr) per device, the wall at production row counts.  Here
+    the LEFT side is already position-partitioned (the sorted scratch
+    IS the uniform global order), each shard's key range is its own
+    left block's [first, last] keys, and the RIGHT side's matching
+    contiguous slice — at most ``rcap`` rows, probed beforehand —
+    arrives over ``ring_pipeline`` (one right block in flight per hop,
+    never an accumulated replica).  Each shard merges ONLY its own
+    partition (two searchsorteds + local offsets), the global offsets
+    come from one p-wide all_gather, and every out shard's window is
+    assembled producer-side through one masked all_to_all per channel
+    with bit-exact producer SELECTION (no arithmetic combine).  Row
+    order, values, and the returned count are bit-identical to the
+    broadcast program."""
+    key = ("reljoinpart", pinned_id(mesh), axis, llayout, str(lkdtype),
+           str(lvdtype), rlayout, str(rkdtype), str(rvdtype),
+           ok_layout, str(ok_dtype), ol_layout, str(ol_dtype),
+           or_layout, str(or_dtype), int(nl), int(nr),
+           bool(left_outer), int(rcap),
+           bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+
+    p, Sl, *_ = working_geometry(llayout)
+    _, Sr, *_ = working_geometry(rlayout)
+
+    def body(lkb, lvb, rkb, rvb, fillv):
+        r = lax.axis_index(axis)
+        lkraw = lkb[0]
+        lv = lvb[0]
+        kl, _bigl, nvl = _mask_sorted_keys(lkb, nl, Sl, r)
+        # a partially-valid shard's range must end at its last REAL
+        # key, not the pad sentinel (which would claim every larger
+        # right key for this shard — correct but memory-unbounded)
+        kl = kl.at[Sl - 1].set(_last_real(kl, nvl, Sl))
+        krow, bigr, nvr = _mask_sorted_keys(rkb, nr, Sr, r)
+        firsts, lasts, starts, ends = _partition_bounds(
+            axis, r, kl, krow, nvr, p)
+        start_me = starts[r]
+
+        # --- repartition exchange: rotate the right (key, value)
+        # blocks around the ring; each shard scatters the rows inside
+        # ITS key range at their global-order offset into the
+        # rcap-bounded partition buffers (positions are unique and
+        # order-independent → bit-identical across ring schedules)
+        rbk0 = jnp.full((rcap,), bigr, krow.dtype)
+        rbv0 = jnp.zeros((rcap,), rvb.dtype)
+
+        def scatter(t, carry, blocks):
+            bk, bv = blocks
+            s = (r - t) % p
+            g = s * Sr + jnp.arange(Sr)
+            inr = (g < nr) & (bk >= firsts[r]) & (bk <= lasts[r])
+            idx = jnp.where(inr, g - start_me, rcap)
+            return (carry[0].at[idx].set(bk, mode="drop"),
+                    carry[1].at[idx].set(bv, mode="drop"))
+
+        rbk, rbv = ring_pipeline(axis, p, (rbk0, rbv0),
+                                 (krow, rvb[0]), scatter)
+
+        # --- local merge on my partition (the broadcast body's
+        # searchsorted/offsets shape, partition-local).  The clamp to
+        # my REAL partition size keeps an integer key equal to the pad
+        # sentinel from matching the buffer's big-sentinel tail (the
+        # broadcast body's nr clamp, partition-local).
+        size_me = ends[r] - start_me
+        lvalid = jnp.arange(Sl) < nvl
+        lo = jnp.minimum(jnp.searchsorted(rbk, kl, side="left"),
+                         size_me)
+        hi = jnp.minimum(jnp.searchsorted(rbk, kl, side="right"),
+                         size_me)
+        cnt = jnp.where(lvalid, (hi - lo).astype(jnp.int32), 0)
+        if left_outer:
+            rows = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)
+        else:
+            rows = cnt
+        offs = jnp.cumsum(rows)                       # local inclusive
+        my_total = offs[Sl - 1]
+        totals = lax.all_gather(my_total, axis)       # (p,)
+        ctot = jnp.cumsum(totals)
+        base_me = ctot[r] - my_total
+        M = ctot[p - 1]
+
+        def out_channel(layout, produce, dtype):
+            """Producer-side window assembly: for every destination
+            shard's out slot I produced, compute the row value from my
+            local data into the masked all_to_all send buffer; the
+            receiver SELECTS each slot's unique producer row (cumsum
+            of totals names it) — a bit-exact move, no sum combine."""
+            So, starts_c, _sizes = _dest_geometry(layout)
+            j = starts_c[:, None] + jnp.arange(So)[None, :]
+            mine = (j >= base_me) & (j < base_me + my_total)
+            jl = j - base_me
+            i = jnp.clip(jnp.searchsorted(offs, jl, side="right"),
+                         0, Sl - 1)
+            base_i = jnp.take(offs, i) - jnp.take(rows, i)
+            matched = jnp.take(cnt, i) > 0
+            rpos = jnp.clip(jnp.take(lo, i) + (jl - base_i), 0,
+                            rcap - 1)
+            vals = produce(i, rpos, matched)
+            send = jnp.where(mine, vals.astype(dtype),
+                             jnp.zeros((), dtype))
+            recv = lax.all_to_all(send, axis, 0, 0)   # row s = from s
+            jt = starts_c[r] + jnp.arange(So)
+            ps = jnp.clip(jnp.searchsorted(ctot, jt, side="right"),
+                          0, p - 1)
+            got = jnp.take_along_axis(recv, ps[None, :], axis=0)[0]
+            live = jt < M
+            got = jnp.where(live, got, jnp.zeros((), dtype))
+            return _pack_out_row(got, live, layout, r)
+
+        okrow = out_channel(ok_layout,
+                            lambda i, rp, mt: jnp.take(lkraw, i),
+                            ok_dtype)
+        olrow = out_channel(ol_layout,
+                            lambda i, rp, mt: jnp.take(lv, i),
+                            ol_dtype)
+        orrow = out_channel(
+            or_layout,
+            lambda i, rp, mt: jnp.where(
+                mt, jnp.take(rbv, rp).astype(or_dtype),
+                fillv.astype(or_dtype)),
+            or_dtype)
+        return okrow, olrow, orrow, M
+
+    # check_vma=False: ``M`` folds the same all_gather'ed totals
+    # identically on every shard (the broadcast program's precedent)
     shm = jax.shard_map(body, mesh=mesh,
                         in_specs=(P(axis, None),) * 4 + (P(),),
                         out_specs=(P(axis, None),) * 3 + (P(),),
@@ -714,20 +978,61 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
                                        phase="sort_left")
         srk, srv, nr = _sorted_scratch(rkc, rvc, sid=sid,
                                        phase="sort_right")
-        t0 = _obs.now()
-        prog = _join_program(
-            rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
-            srk.layout, srk.dtype, srv.dtype,
-            okc.cont.layout, okc.cont.dtype,
-            olc.cont.layout, olc.cont.dtype,
-            orc.cont.layout, orc.cont.dtype,
-            nl, nr, how == "left")
+        p_sh, Sl, *_ = working_geometry(slk.layout)
+        _, Sr, *_ = working_geometry(srk.layout)
+        # routing (docs/SPEC.md §18.4): small combined sides keep the
+        # broadcast sorted-merge (one program, O(nl+nr) per device);
+        # above the threshold the merge re-homes on the bounded-memory
+        # repartition exchange — each device merges only its own left
+        # block against the probed, rcap-bounded right partition
+        use_partition = (p_sh > 1 and nl > 0 and nr > 0
+                         and nl + nr > _broadcast_max())
+        if use_partition:
+            t0 = _obs.now()
+            fire_ppermute(what="join.partition")
+            probe = _join_partition_probe_program(
+                rt.mesh, rt.axis, slk.layout, slk.dtype,
+                srk.layout, srk.dtype, nl, nr)
+            starts, ends = probe(slk._data, srk._data)
+            part = np.asarray(ends) - np.asarray(starts)
+            mx = max(int(part.max(initial=0)), 1)
+            # pow2-quantized partition capacity: bounded recompiles
+            # across key distributions, never beyond the full side
+            rcap = min(1 << (mx - 1).bit_length(), p_sh * Sr)
+            _obs.complete("relational.phase", t0, cat="relational",
+                          parent=sid, phase="partition_plan",
+                          rcap=rcap)
+            t0 = _obs.now()
+            prog = _join_partition_program(
+                rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
+                srk.layout, srk.dtype, srv.dtype,
+                okc.cont.layout, okc.cont.dtype,
+                olc.cont.layout, olc.cont.dtype,
+                orc.cont.layout, orc.cont.dtype,
+                nl, nr, how == "left", rcap)
+            _set_join_route(impl="partition", nl=nl, nr=nr,
+                            nshards=p_sh, rcap=rcap,
+                            gathered_rows_per_device=Sl + rcap)
+        else:
+            t0 = _obs.now()
+            prog = _join_program(
+                rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
+                srk.layout, srk.dtype, srv.dtype,
+                okc.cont.layout, okc.cont.dtype,
+                olc.cont.layout, olc.cont.dtype,
+                orc.cont.layout, orc.cont.dtype,
+                nl, nr, how == "left")
+            _set_join_route(impl="broadcast", nl=nl, nr=nr,
+                            nshards=p_sh,
+                            gathered_rows_per_device=p_sh * (Sl + Sr))
         okc.cont._data, olc.cont._data, orc.cont._data, md = prog(
             slk._data, slv._data, srk._data, srv._data,
             jnp.asarray(fill, orc.cont.dtype))
         m = int(md)
         _obs.complete("relational.phase", t0, cat="relational",
-                      parent=sid, phase="merge", rows=m)
+                      parent=sid, phase="merge", rows=m,
+                      route="partition" if use_partition
+                      else "broadcast")
         if m > cap:
             _raise_capacity(f"join[{how}]", m, cap)
         return m

@@ -477,15 +477,18 @@ def _scan(in_r, out, op, init, exclusive):
         scanned = None
     elif single:
         # DIFFERENT MESHES: scan natively on the input's runtime, then
-        # reshard the result into the destination window (the same
-        # XLA-resharding transport class as the elementwise fallback —
-        # the scan collectives stay native; round 5)
+        # reshard the result into the destination window through the
+        # redistribution engine's cross-mesh transport
+        # (parallel/redistribute.reshard_copy, docs/SPEC.md §18 — the
+        # same XLA-resharding class as before, now with the engine's
+        # fault site/span/bytes counter; the scan collectives stay
+        # native; round 5)
         from ..containers.distributed_vector import distributed_vector
-        from .elementwise import copy as _copy
+        from ..parallel.redistribute import reshard_copy
         scratch = distributed_vector(c.n, dtype=out_chain.cont.dtype,
                                      runtime=c.cont.runtime)
         _scan(in_r, scratch, op, None, exclusive)
-        _copy(scratch, out)
+        reshard_copy(scratch, out)
         scanned = None
     else:
         from ..utils.fallback import warn_fallback

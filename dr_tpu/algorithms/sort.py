@@ -643,18 +643,21 @@ def sort_by_key(keys, values, *, descending: bool = False):
     # DIFFERENT MESHES (mismatched shard counts, or equal counts over
     # different device sets) take the reshard route (round 5 — this
     # used to be the argsort materialize): the payload reshards onto
-    # the key runtime (two collective copies, the same XLA-resharding
-    # class the elementwise fallback uses), the sample-sort runs
-    # NATIVELY there with the keys never leaving their shards, and the
-    # reordered payload reshards back into its own windows.  This is
-    # the LAST remaining route — every same-mesh shape is native.
+    # the key runtime through the redistribution engine's cross-mesh
+    # transport (parallel/redistribute.reshard_copy — same fault
+    # site, span, and bytes counter as every re-layout, docs/SPEC.md
+    # §18; the move itself stays the XLA-resharding class the
+    # elementwise fallback uses), the sample-sort runs NATIVELY there
+    # with the keys never leaving their shards, and the reordered
+    # payload reshards back into its own windows.  This is the LAST
+    # remaining route — every same-mesh shape is native.
     from ..containers.distributed_vector import distributed_vector
-    from .elementwise import copy as _copy
+    from ..parallel.redistribute import reshard_copy
     scratch = distributed_vector(vc.n, dtype=vcont.dtype,
                                  runtime=kcont.runtime)
-    _copy(values, scratch)
+    reshard_copy(values, scratch)
     sort_by_key(keys, scratch, descending=descending)
-    _copy(scratch, values)
+    reshard_copy(scratch, values)
     return keys, values
 
 

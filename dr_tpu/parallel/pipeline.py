@@ -47,13 +47,21 @@ from jax import lax
 
 from ..utils import faults as _faults
 
-__all__ = ["ring_perm", "schedule_mode", "ring_pipeline",
-           "ring_allgather", "ring_combine", "fire_ppermute"]
+__all__ = ["ring_perm", "shift_perm", "schedule_mode", "ring_pipeline",
+           "ring_allgather", "ring_combine", "ring_exchange",
+           "fire_ppermute"]
 
 
 def ring_perm(nshards: int) -> List[Tuple[int, int]]:
     """The forward ring permutation (shard i's block moves to i+1)."""
     return [(i, (i + 1) % nshards) for i in range(nshards)]
+
+
+def shift_perm(nshards: int, t: int) -> List[Tuple[int, int]]:
+    """The offset-``t`` collective permutation (shard i's bucket moves
+    DIRECTLY to shard i+t) — one hop distance of the
+    :func:`ring_exchange` decomposition."""
+    return [(i, (i + t) % nshards) for i in range(nshards)]
 
 
 def schedule_mode() -> str:
@@ -118,6 +126,59 @@ def ring_pipeline(axis: str, nshards: int, carry: Any, blocks: Any,
             if rotate_after:
                 blocks = rotate(blocks)
     return (carry, blocks) if restore_blocks else carry
+
+
+def ring_exchange(axis: str, nshards: int, carry, make_bucket,
+                  consume, *, steps: Optional[List[int]] = None,
+                  schedule: Optional[str] = None):
+    """Offset-permute exchange (trace-time; call inside a
+    ``shard_map`` body) — the collective decomposition of
+    arXiv:2112.01075 on this mesh's ring: for each hop distance ``t``
+    in ``steps`` (default ``1..nshards-1``), every shard sends ONE
+    statically-shaped bucket (``make_bucket(t)``, any pytree) DIRECTLY
+    to the shard ``t`` hops ahead via :func:`shift_perm`, and folds the
+    bucket arriving from ``t`` hops behind into the carry:
+    ``carry = consume(t, carry, bucket)``.
+
+    Unlike :func:`ring_pipeline` (which FORWARDS one rotating block
+    around the ring), nothing is relayed: each step's bucket goes
+    point-to-point, so peak extra memory is ONE in-flight bucket — the
+    largest transfer bucket, never an accumulated replica.  Callers
+    drop zero-length hops from ``steps`` (a src→dst layout diff that
+    moves nothing at distance t costs nothing — the minimal-sequence
+    property).
+
+    The issue orders mirror :func:`ring_pipeline`: ``serial`` sends
+    and consumes hop t before issuing hop t+1; ``pipelined`` (default)
+    issues hop t+1's ppermute BEFORE consuming hop t's arrival and
+    pairs them through ``lax.optimization_barrier`` so the ICI
+    transfer overlaps the scatter.  Each consume reads only its own
+    arrival and the threaded carry — the same dataflow either way, so
+    the two schedules are bit-identical.
+    """
+    sched = schedule or schedule_mode()
+    hops = list(range(1, nshards)) if steps is None else list(steps)
+
+    def send(t):
+        p = shift_perm(nshards, t)
+        return jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis, p), make_bucket(t))
+
+    if sched == "pipelined" and hops:
+        inflight = send(hops[0])
+        for i, t in enumerate(hops):
+            nxt = send(hops[i + 1]) if i + 1 < len(hops) else None
+            carry = consume(t, carry, inflight)
+            if nxt is not None:
+                # pair transfer and scatter: without the barrier XLA
+                # may sink the next hop's ppermute below this hop's
+                # consume (re-serialize)
+                nxt, carry = lax.optimization_barrier((nxt, carry))
+            inflight = nxt
+        return carry
+    for t in hops:
+        carry = consume(t, carry, send(t))
+    return carry
 
 
 def ring_allgather(axis: str, nshards: int, block, *,

@@ -1,0 +1,323 @@
+"""Collective redistribution engine: device-side re-layout on the ring
+(docs/SPEC.md §18).
+
+``dr_tpu.redistribute()`` v1 (round 13, utils/elastic.py) was
+host-staged: every re-layout gathered the whole logical array to the
+host and scattered it back through the target layout's pack program —
+correct, mesh-agnostic, and the elastic rescue's workhorse, but a full
+host round trip for what is physically a shard-to-shard shuffle.  This
+module is the collective lowering (ROADMAP item 1): the recipe from
+"Memory-efficient array redistribution through portable collective
+communication" (arXiv:2112.01075) on the shared ring machinery of
+:mod:`.pipeline`.
+
+* **Planner** (:func:`plan_moves`) — a STATIC diff of the src→dst
+  block layouts: for each hop distance ``t`` the (contiguous)
+  intersection of src shard ``r``'s owned window with dst shard
+  ``(r+t) % p``'s window gives a per-rank send window; hops that move
+  nothing are dropped (the minimal-sequence property) and the bucket
+  width ``B_t`` is the largest window at that distance.
+* **Exchange program** (:func:`_exchange_program`) — ONE jitted
+  ``shard_map`` over the container's padded row: hop 0 is the local
+  src∩dst copy, every other hop one masked
+  :func:`~.pipeline.ring_exchange` bucket (``lax.ppermute`` with the
+  offset-``t`` permutation, statically shaped, serial/pipelined issue
+  orders bit-identical).  Peak extra device memory is ONE in-flight
+  bucket — bounded by the largest transfer window, never a full
+  replica.  The dst row is rebuilt from zeros, so pad/halo/tail cells
+  land exactly as the host-staged pack program leaves them: the two
+  impls are BIT-identical physical rows.
+* **Dispatcher** (:func:`redistribute_vector`) — autoselects the
+  collective program when src and dst share a mesh; everything else
+  (cross-runtime hops, matrices) keeps the host-staged v1 route.
+  ``DR_TPU_REDISTRIBUTE`` ∈ {``auto``, ``collective``, ``host``}
+  overrides; a forced ``collective`` on an ineligible move falls back
+  announced (``warn_fallback``), never silently wrong.  Inside
+  ``dr_tpu.deferred()`` an eligible re-layout records FUSED into the
+  surrounding run (``plan.record_redistribute`` — the container's
+  layout metadata flips at record time, the data moves at flush, so
+  later recorded ops key on the new geometry); the host-staged route
+  stays a flush point (announced non-fusible cliff).
+* **Failure model** — fault site ``redistribute.exchange`` fires at
+  every engine dispatch BEFORE the program-cache lookup (plus
+  ``collectives.ppermute``, the ring data plane's site): a faulted
+  exchange surfaces classified with the container exactly as it was
+  (the metadata rebind rolls back).  Obs records a ``redistribute``
+  span with plan/exchange/rebind phases and a
+  ``redistribute.bytes_moved`` counter; classified errors carry the
+  trace tail like every resilience path.
+
+The cross-mesh sort/scan reshard scratch moves
+(:func:`reshard_copy`) route through the engine's cross-mesh arm —
+same fault site, same span, same bytes counter — so the cross-mesh
+fuzz arm exercises the engine for free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.pinning import pinned_id
+from ..utils import faults as _faults
+from ..utils.env import env_str
+from ..utils.spmd_guard import TappedCache
+from .pipeline import fire_ppermute, ring_exchange, schedule_mode
+
+__all__ = ["impl_mode", "plan_moves", "fire_exchange",
+           "redistribute_vector", "reshard_copy"]
+
+#: exchange-program cache (TappedCache: dispatch.cache / device.lost
+#: ride every lookup, pin eviction purges dead-mesh entries)
+_prog_cache: dict = TappedCache()
+
+
+def impl_mode() -> str:
+    """``DR_TPU_REDISTRIBUTE`` in {``auto``, ``collective``, ``host``};
+    malformed values fall back to ``auto`` (a typo in a sweep must not
+    brick every re-layout)."""
+    mode = env_str("DR_TPU_REDISTRIBUTE").lower()
+    return mode if mode in ("auto", "collective", "host") else "auto"
+
+
+def fire_exchange(**ctx) -> None:
+    """Dispatch-time hook for the ``redistribute.exchange`` fault
+    site: every engine dispatcher (collective exchange, cross-mesh
+    reshard transport, the deferred-plan pre-dispatch hook) calls this
+    before its program-cache lookup, so an armed fault surfaces
+    classified with the container untouched."""
+    _faults.fire("redistribute.exchange", **ctx)
+
+
+def _geometry(layout):
+    from ..algorithms._common import layout_geometry
+    return layout_geometry(layout)
+
+
+def plan_moves(src_layout, dst_layout):
+    """Static src→dst diff: ``(steps, bytes_factor)`` where ``steps``
+    is a list of ``(t, B_t, send_lo, send_len)`` hops — at distance
+    ``t`` rank ``r`` sends logical window ``[send_lo[r], send_lo[r] +
+    send_len[r])`` (the src∩dst overlap with rank ``(r+t) % p``) in a
+    bucket of static width ``B_t = max(send_len)``; zero-width hops
+    are dropped.  ``bytes_factor`` is the total off-shard element
+    count (the bytes-moved counter scales it by the dtype size)."""
+    p, s_cap, s_prev, s_nxt, n, s_starts, s_sizes = _geometry(src_layout)
+    dp, d_cap, d_prev, d_nxt, dn, d_starts, d_sizes = \
+        _geometry(dst_layout)
+    assert p == dp and n == dn, "redistribute: src/dst shard counts " \
+        "and logical sizes must match on one mesh"
+    steps = []
+    moved = 0
+    for t in range(1, p):
+        lo = np.empty(p, np.int64)
+        ln = np.empty(p, np.int64)
+        for r in range(p):
+            d = (r + t) % p
+            a = max(int(s_starts[r]), int(d_starts[d]))
+            b = min(int(s_starts[r]) + int(s_sizes[r]),
+                    int(d_starts[d]) + int(d_sizes[d]))
+            lo[r] = a
+            ln[r] = max(0, b - a)
+        bt = int(ln.max(initial=0))
+        if bt > 0:
+            steps.append((t, bt, lo, ln))
+            moved += int(ln.sum())
+    return steps, moved
+
+
+def _exchange_body(axis, src_layout, dst_layout, dtype):
+    """The shard_map exchange body (src padded row -> dst padded row)
+    — shared verbatim between the eager program below and the
+    deferred-plan fused emit (``plan.record_redistribute``)."""
+    p, s_cap, s_prev, s_nxt, n, s_starts, s_sizes = _geometry(src_layout)
+    _, d_cap, d_prev, d_nxt, _, d_starts, d_sizes = _geometry(dst_layout)
+    src_width = s_prev + s_cap + s_nxt
+    dst_width = d_prev + d_cap + d_nxt
+    steps, _moved = plan_moves(src_layout, dst_layout)
+    s_starts_c = jnp.asarray(np.asarray(s_starts))
+    s_sizes_c = jnp.asarray(np.asarray(s_sizes))
+    d_starts_c = jnp.asarray(np.asarray(d_starts))
+    d_sizes_c = jnp.asarray(np.asarray(d_sizes))
+    hops = [t for t, _, _, _ in steps]
+    widths = {t: bt for t, bt, _, _ in steps}
+    los = {t: jnp.asarray(lo) for t, _, lo, _ in steps}
+    lens = {t: jnp.asarray(ln) for t, _, _, ln in steps}
+
+    def body(row):
+        r = lax.axis_index(axis)
+        x = row[0]                                     # (src_width,)
+        col = jnp.arange(dst_width) - d_prev
+        g = d_starts_c[r] + col                        # dst global ids
+        owned = (col >= 0) & (col < d_sizes_c[r])
+        # hop 0: the local src∩dst copy (no collective)
+        have0 = owned & (g >= s_starts_c[r]) \
+            & (g < s_starts_c[r] + s_sizes_c[r])
+        idx0 = jnp.clip(s_prev + g - s_starts_c[r], 0, src_width - 1)
+        carry = jnp.where(have0, jnp.take(x, idx0),
+                          jnp.zeros((), dtype))
+
+        def make_bucket(t):
+            # my send window for hop t, gathered from my src row
+            lo = los[t][r]
+            k = jnp.arange(widths[t])
+            sidx = jnp.clip(s_prev + (lo + k) - s_starts_c[r], 0,
+                            src_width - 1)
+            return jnp.where(k < lens[t][r], jnp.take(x, sidx),
+                             jnp.zeros((), dtype))
+
+        def consume(t, carry, bucket):
+            # arrival from rank s = r - t: globals [lo[s], lo[s]+ln[s])
+            s = (r - t) % p
+            lo = los[t][s]
+            have = owned & (g >= lo) & (g < lo + lens[t][s])
+            bidx = jnp.clip(g - lo, 0, widths[t] - 1)
+            return jnp.where(have, jnp.take(bucket, bidx), carry)
+
+        carry = ring_exchange(axis, p, carry, make_bucket, consume,
+                              steps=hops)
+        return carry[None]
+
+    return body
+
+
+def _exchange_program(mesh, axis, src_layout, dst_layout, dtype):
+    key = ("rdx", pinned_id(mesh), axis, src_layout, dst_layout,
+           str(dtype), schedule_mode())
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    body = _exchange_body(axis, src_layout, dst_layout, jnp.dtype(dtype))
+    shm = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                        out_specs=P(axis, None))
+    # no donation: a mid-dispatch classified fault rolls the container
+    # back onto this buffer (the rebind-rollback contract below)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _host_staged(cont, new_dist, rt):
+    """The v1 route (cross-runtime hops, forced ``host`` impl, the
+    elastic rescue/grow fallback): gather the logical value to the
+    host, re-plan the layout, scatter through the target pack program.
+    The bit-identity contract the collective program is fuzzed
+    against."""
+    from .. import obs as _obs
+    t0 = _obs.now()
+    values = cont.materialize()
+    cont._rebind(rt, new_dist)
+    cont.assign_array(values)
+    _obs.complete("redistribute.phase", t0, cat="redistribute",
+                  phase="host_staged", n=len(cont))
+    return cont
+
+
+def _collective(cont, new_dist, rt):
+    """The eager collective dispatcher: metadata rebind first (kept
+    data — validated, self-rolling-back), then ONE exchange-program
+    dispatch, then the data rebind.  Any failure past the metadata
+    flip (an injected ``redistribute.exchange`` fault, a backend
+    error) rolls the rebind back — the container is exactly as it
+    was, the classified error carries the trace tail."""
+    from .. import obs as _obs
+    src_rt = cont.runtime
+    src_dist = cont.distribution
+    src_layout = cont.layout
+    old = cont._data
+    t0 = _obs.now()
+    cont._rebind(rt, new_dist, _data=old)
+    dst_layout = cont.layout
+    try:
+        fire_exchange(src=str(src_layout), dst=str(dst_layout))
+        fire_ppermute(what="redistribute")
+        prog = _exchange_program(rt.mesh, rt.axis, src_layout,
+                                 dst_layout, cont.dtype)
+        _obs.complete("redistribute.phase", t0, cat="redistribute",
+                      phase="plan")
+        t1 = _obs.now()
+        new = prog(old)
+        _obs.complete("redistribute.phase", t1, cat="redistribute",
+                      phase="exchange")
+        t2 = _obs.now()
+        cont._data = new
+        _obs.complete("redistribute.phase", t2, cat="redistribute",
+                      phase="rebind")
+        _, moved = plan_moves(src_layout, dst_layout)
+        _obs.count("redistribute.bytes_moved",
+                   moved * jnp.dtype(cont.dtype).itemsize)
+        return cont
+    except BaseException:
+        cont._rebind(src_rt, src_dist, _data=old)
+        raise
+
+
+def redistribute_vector(cont, new_dist, rt):
+    """Route one ``distributed_vector`` re-layout (the
+    ``dr_tpu.redistribute`` vector arm): collective device-side
+    exchange when src and dst share a mesh (unless ``host`` is
+    forced), host-staged v1 otherwise.  Inside a deferred region an
+    eligible move RECORDS into the plan (fusing with its consuming
+    chain); the host route flushes announced."""
+    from .. import obs as _obs
+    from ..utils.fallback import warn_fallback
+
+    impl = impl_mode()
+    eligible = cont.runtime.mesh == rt.mesh
+    collective = eligible and impl != "host"
+    from .. import plan as _plan
+    p = _plan.active()
+    if p is not None:
+        if collective:
+            p.record_redistribute(cont, new_dist, rt)
+            return cont
+        p.nonfusible("redistribute (host-staged route)")
+    if collective:
+        sid = _obs.begin("redistribute", cat="redistribute",
+                         impl="collective", n=len(cont),
+                         nshards=rt.nprocs)
+        try:
+            return _collective(cont, new_dist, rt)
+        finally:
+            _obs.end(sid)
+    if impl == "collective" and not eligible:
+        warn_fallback(
+            "redistribute",
+            "collective impl forced but src and dst do not share a "
+            "mesh — taking the host-staged route")
+    sid = _obs.begin("redistribute", cat="redistribute", impl="host",
+                     n=len(cont), nshards=rt.nprocs)
+    try:
+        fire_exchange(impl="host", n=len(cont))
+        return _host_staged(cont, new_dist, rt)
+    finally:
+        _obs.end(sid)
+
+
+def reshard_copy(src, dst) -> None:
+    """Cross-mesh scratch move for the sort/scan reshard routes: the
+    engine's cross-mesh transport arm (XLA resharding through the
+    elementwise copy — the collectives stay native on each side), with
+    the engine's fault site, span, and bytes counter, so the
+    cross-mesh fuzz arm exercises the same failure surface as every
+    other re-layout."""
+    from .. import obs as _obs
+    n = len(src)
+    fire_exchange(impl="reshard", n=n)
+    sid = _obs.begin("redistribute", cat="redistribute", impl="reshard",
+                     n=n)
+    try:
+        from ..algorithms.elementwise import copy as _copy
+        _copy(src, dst)
+        base = dst
+        while base is not None and not hasattr(base, "dtype"):
+            base = getattr(base, "base", None)
+        if base is not None:
+            _obs.count("redistribute.bytes_moved",
+                       n * jnp.dtype(base.dtype).itemsize)
+    finally:
+        _obs.end(sid)

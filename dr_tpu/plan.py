@@ -45,7 +45,14 @@ docs/SPEC.md §17.2) splits the same way: ``histogram``/``top_k``
 have STATIC output shapes and record FUSIBLE
 (:meth:`Plan.record_histogram` / :meth:`Plan.record_top_k`), while
 ``join``/``groupby_aggregate``/``unique`` record opaque and hand back
-lazy ``DeferredCount`` handles.
+lazy ``DeferredCount`` handles.  A collective-eligible
+``dr_tpu.redistribute`` records FUSED (round 16,
+:meth:`Plan.record_redistribute`, docs/SPEC.md §18.3): the
+container's layout metadata flips at record time so later recorded
+ops key on the dst geometry, the data moves inside the fused run at
+flush, and an UNDO log restores the metadata if the queue is dropped
+before the move executed; the host-staged route stays an announced
+flush point.
 
 Mid-chain reductions ride the carry as device scalars: a recorded
 reduce returns a :class:`PlanScalar` whose value is an output of the
@@ -252,17 +259,21 @@ class PlanScalar:
 class _FusedOp:
     """One recorded fusible op: structural cache ``key``, trace-time
     ``emit(state, svals, souts)``, scalar ``spec`` ("t" = traced
-    operand, ("r", i) = same-run scalar output i), and this recording's
-    traced ``vals`` (parallel to the "t" entries)."""
+    operand, ("r", i) = same-run scalar output i), this recording's
+    traced ``vals`` (parallel to the "t" entries), and an optional
+    ``pre`` dispatch-time hook (fired by ``_exec_run`` before the
+    program-cache lookup — the fused analog of the eager dispatchers'
+    fault-site fires, e.g. ``redistribute.exchange``)."""
 
-    __slots__ = ("name", "key", "emit", "spec", "vals")
+    __slots__ = ("name", "key", "emit", "spec", "vals", "pre")
 
-    def __init__(self, name, key, emit, spec=(), vals=()):
+    def __init__(self, name, key, emit, spec=(), vals=(), pre=None):
         self.name = name
         self.key = key
         self.emit = emit
         self.spec = spec
         self.vals = list(vals)
+        self.pre = pre
 
 
 class _Run:
@@ -325,9 +336,38 @@ class Plan:
         #: PlanScalar) -> its re-recorded handle, so replayed consumers
         #: rewire onto the new run's in-program values
         self._subst: dict = {}
+        #: undo log (SPEC §18.3): a recorded redistribute flips its
+        #: container's LAYOUT METADATA at record time (so later
+        #: recorded ops key on the new geometry) while the data moves
+        #: at flush — one (queue_item, undo_thunk) entry per such op,
+        #: run in reverse for every item a dropped queue never
+        #: executed, restoring the pre-record metadata over the
+        #: still-src-shaped data (the faulted-flush "containers keep
+        #: their pre-flush values" contract)
+        self._undo: list = []
 
     def _note_replay(self, thunk, handle=None) -> None:
         self._replay.append((self._queue[-1], thunk, handle))
+
+    def _note_undo(self, thunk) -> None:
+        self._undo.append((self._queue[-1], thunk))
+
+    @staticmethod
+    def _undo_items(undos, items) -> None:
+        """Run the undo thunks of every UNEXECUTED queue item, newest
+        first (two pending re-layouts of one container unwind in
+        reverse record order).  Never raises — a failed undo is warned
+        and the rest still unwind."""
+        ids = {id(it) for it in items}
+        for item, thunk in reversed(undos):
+            if id(item) not in ids:
+                continue
+            try:
+                thunk()
+            except Exception as e:  # pragma: no cover - defensive
+                from .utils.fallback import warn_fallback
+                warn_fallback("plan", f"redistribute undo failed "
+                                      f"({e!r})")
 
     def _subst_scalars(self, values):
         """Map pending handles through the elastic replay substitution
@@ -644,6 +684,53 @@ class Plan:
                                 ic.runtime.axis, ic.runtime.mesh))
         return True
 
+    def record_redistribute(self, cont, new_dist, rt=None) -> bool:
+        """Fused collective re-layout (docs/SPEC.md §18.3): the
+        container's layout METADATA flips now — every op recorded
+        after this one keys on the dst geometry — while its data keeps
+        the src shape until the fused run executes the exchange body
+        (``parallel/redistribute._exchange_body``) in record order.
+        The undo log restores the src metadata if the queue is dropped
+        before the move ran; the elastic replay thunk re-records
+        against the CURRENT global runtime (re-reading the rescued
+        container's layout at call time, the stencil discipline)."""
+        from .parallel import runtime as _rtmod
+        target = rt or _rtmod.runtime()
+        src_rt = cont.runtime
+        src_dist = cont.distribution
+        src_layout = cont.layout
+        cont._rebind(target, new_dist, _data=cont._data)
+        dst_layout = cont.layout
+        run = self._fusible_run(cont)
+        slot = run.slot(cont)
+        dtype = cont.dtype
+        axis, mesh = target.axis, target.mesh
+        key = ("rdx", slot, src_layout, dst_layout, str(dtype))
+
+        def emit(state, svals, souts):
+            from .parallel import redistribute as _rdx
+            body = _rdx._exchange_body(axis, src_layout, dst_layout,
+                                       jnp.dtype(dtype))
+            shm = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
+                                out_specs=P(axis, None))
+            state[slot] = shm(state[slot])
+
+        def pre():
+            from .parallel import redistribute as _rdx
+            _rdx.fire_exchange(src=str(src_layout), dst=str(dst_layout))
+            _rdx.fire_ppermute(what="redistribute")
+            _, moved = _rdx.plan_moves(src_layout, dst_layout)
+            _obs.count("redistribute.bytes_moved",
+                       moved * jnp.dtype(dtype).itemsize)
+
+        run.ops.append(_FusedOp("redistribute", key, emit, pre=pre))
+        self._note_undo(
+            lambda c=cont, r=src_rt, d=src_dist:
+            c._rebind(r, d, _data=c._data))
+        self._note_replay(
+            lambda c=cont, d=new_dist: self.record_redistribute(c, d))
+        return True
+
     def record_histogram(self, in_chain, out_chain, lo, hi) -> bool:
         """Fusible relational histogram (docs/SPEC.md §17.2): the
         output shape is STATIC (bins = the out container), so the op
@@ -769,6 +856,7 @@ class Plan:
             return
         queue, self._queue = self._queue, []
         replay, self._replay = self._replay, []
+        undos, self._undo = self._undo, []
         self._flushing = True
         # obs span over the whole flush (SPEC §15): begin/end rather
         # than a context manager so the existing error bookkeeping
@@ -838,7 +926,12 @@ class Plan:
             # again.  The failed item never rebound its containers
             # (_exec_run rebinds only after the program returns; the
             # fault sites fire before dispatch), so the suffix replays
-            # from consistent pre-fault state.
+            # from consistent pre-fault state.  Pending redistributes
+            # in the suffix UNDO first (metadata back over the
+            # still-src-shaped data) so the rescue's host gathers read
+            # a consistent container; the replay thunks re-record them
+            # against the shrunken mesh.
+            self._undo_items(undos, queue[idx:])
             self._flushing = False
             try:
                 recovered = self._elastic_recover(queue[idx:], replay,
@@ -856,6 +949,7 @@ class Plan:
                 entry["error"] = True
                 raise
         except BaseException:
+            self._undo_items(undos, queue[idx:])
             self._break_handles(queue)
             entry["error"] = True
             raise
@@ -921,6 +1015,12 @@ class Plan:
         return True
 
     def _exec_run(self, run: _Run) -> bool:
+        # dispatch-time pre hooks (fault sites, counters) fire BEFORE
+        # the program-cache lookup — the eager dispatchers' discipline:
+        # an armed fault drops the whole run with containers untouched
+        for o in run.ops:
+            if o.pre is not None:
+                o.pre()
         key = ("plan", pinned_id(run.mesh), run.axis,
                tuple((c.layout, str(c.dtype)) for c in run.conts),
                tuple(o.key for o in run.ops))
@@ -997,9 +1097,12 @@ class Plan:
 
     def discard(self, reason: str = "discard") -> None:
         """Drop every pending item without executing it; pending
-        handles break (resolving them raises instead of lying)."""
+        handles break (resolving them raises instead of lying) and
+        pending re-layouts undo their metadata flip."""
         queue, self._queue = self._queue, []
         self._replay = []
+        undos, self._undo = self._undo, []
+        self._undo_items(undos, queue)
         for item in queue:
             if isinstance(item, _Run):
                 for h in item.handles:

@@ -27,10 +27,11 @@ manager:
   fate      when / how
   ========  =====================================================
   rescued   no segment lived on a lost rank: state moves through
-            :func:`redistribute` (host-staged gather/scatter v1 —
-            the API is the contract; the collective lowering is
-            ROADMAP item 2's follow-on) onto the shrunken mesh,
-            bit-equal to the pre-fault value
+            the host-staged gather/scatter route (the cross-mesh
+            arm of :func:`redistribute` — the collective lowering,
+            docs/SPEC.md §18, needs src and dst on ONE mesh, which
+            a shrink never has) onto the shrunken mesh, bit-equal
+            to the pre-fault value
   restored  segments died with the device but the container has a
             durable atomic checkpoint (utils/checkpoint.save
             registers every successful write here): reloaded onto
@@ -282,35 +283,40 @@ def attribute(err, rank: int) -> _resilience.DeviceLostError:
 
 
 # ---------------------------------------------------------------------------
-# redistribute: public v1 (host-staged gather/scatter)
+# redistribute: the public re-layout API (docs/SPEC.md §18)
 # ---------------------------------------------------------------------------
 
 def redistribute(container, new_dist=None, *, runtime=None):
     """Re-lay ``container`` out IN PLACE under ``new_dist`` on
     ``runtime`` (default: the current global runtime) and return it.
 
-    v1 is host-staged: the logical value gathers to the host and
-    scatters through the target layout's pack program — the API is the
-    contract, the collective lowering (arXiv:2112.01075's
-    all-to-all/permute decomposition on the shared ring machinery) is
-    ROADMAP item 2's follow-on.  In-place on purpose: every existing
-    reference to the container (views, recorded plan ops, the elastic
-    rescue walking a live session) stays valid across the move.
+    Vectors route through the collective redistribution engine
+    (``parallel/redistribute``, docs/SPEC.md §18): when src and dst
+    share a mesh the re-layout is ONE device-side exchange program
+    (masked ppermute sequence on the shared ring machinery, peak
+    extra memory bounded by the largest transfer bucket) that
+    RECORDS into deferred plans; cross-runtime hops — and matrices —
+    keep the host-staged v1 route (gather to the host, scatter
+    through the target pack program), which is also the elastic
+    rescue/grow fallback.  ``DR_TPU_REDISTRIBUTE`` overrides the
+    autoselect; the two impls are bit-identical (the fuzz arm's
+    contract).  In-place on purpose: every existing reference to the
+    container (views, recorded plan ops, the elastic rescue walking a
+    live session) stays valid across the move.
 
     ``new_dist`` (a ``block_distribution``, a sizes sequence, or None
     for the default even layout) is a ``distributed_vector`` contract;
     matrices re-block with their default partition on the target
     runtime.  Pending deferred work on the container flushes first
-    (the gather is a host materialization)."""
+    (host-staged routes materialize; the collective route records or
+    runs after the plan's queue in record order)."""
     from ..containers.distributed_vector import distributed_vector
     from ..parallel import runtime as _rt
 
     rt = runtime or _rt.runtime()
     if isinstance(container, distributed_vector):
-        values = container.materialize()
-        container._rebind(rt, new_dist)
-        container.assign_array(values)
-        return container
+        from ..parallel import redistribute as _rdx
+        return _rdx.redistribute_vector(container, new_dist, rt)
     if new_dist is not None:
         raise ValueError(
             "explicit block distributions are a distributed_vector "

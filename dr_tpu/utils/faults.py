@@ -75,6 +75,14 @@ mesh.grow            utils/elastic.grow_session     transient, program
                      a fault fails the re-admission
                      classified; the session keeps
                      serving on the small mesh)
+redistribute.        every redistribution-engine    transient, oom, program
+exchange             dispatch (parallel/
+                     redistribute — collective
+                     exchange, host-staged and
+                     cross-mesh reshard transports,
+                     the deferred-plan pre hook;
+                     fires before the program-cache
+                     lookup, container untouched)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -156,6 +164,13 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # correctly on the small mesh (grow must never make things worse).
     "device.recover": ("transient", "program"),
     "mesh.grow": ("transient", "program"),
+    # collective redistribution (docs/SPEC.md §18): fires at every
+    # engine dispatch (the collective exchange, the host-staged and
+    # cross-mesh reshard transports, the deferred-plan pre-dispatch
+    # hook), BEFORE the program-cache lookup — a faulted re-layout
+    # surfaces classified with the container exactly as it was (the
+    # metadata rebind rolls back).
+    "redistribute.exchange": ("transient", "oom", "program"),
     "fallback.warn": (),
 }
 

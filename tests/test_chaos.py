@@ -35,7 +35,9 @@ def _battery(tmpdir: str, tag: str) -> None:
     halo exchange/reduce -> collectives shift/alltoall -> sort -> scan
     -> deferred-plan flush -> serving daemon (accept/request/flush) ->
     relational join/groupby/top_k/histogram (round 14) ->
-    checkpoint write/read -> fallback.warn -> elastic shrink
+    collective redistribute (round 16: redistribute.exchange fires at
+    the engine dispatch) -> checkpoint write/read -> fallback.warn ->
+    elastic shrink
     (device.lost rides every dispatch tap; mesh.shrink fires inside
     the rescue) -> elastic grow-back (round 15: device.recover fires
     at the recovery probe, mesh.grow inside the re-admission)."""
@@ -159,6 +161,19 @@ def _battery(tmpdir: str, tag: str) -> None:
         dr_tpu.histogram(rvv, hh, -2.0, 2.0)
     np.testing.assert_allclose(dr_tpu.to_numpy(tk),
                                np.sort(rvals)[::-1][:3])
+
+    # redistribute leg (round 16, docs/SPEC.md §18): the collective
+    # re-layout engine — same mesh, so the autoselect takes the
+    # device-side exchange program and redistribute.exchange fires at
+    # its dispatch (before the program-cache lookup: a fault here must
+    # surface classified with the vector EXACTLY as it was).  Team ->
+    # uneven -> even hops so the offset-permute planner emits real
+    # buckets, value bit-equal throughout.
+    rdv = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.redistribute(rdv, [n] + [0] * (P - 1))
+    dr_tpu.redistribute(rdv, [1] * (P - 1) + [n - (P - 1)])
+    dr_tpu.redistribute(rdv, None)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(rdv), src)
 
     ck = os.path.join(tmpdir, f"chaos_{tag}.npz")
     dr_tpu.checkpoint.save(ck, dr_tpu.distributed_vector.from_array(src))

@@ -1178,3 +1178,100 @@ def test_serve_shrink_recorded_even_when_replay_fails(tmp_path):
         finally:
             faults.clear()
             srv.stop()
+
+
+# ------------------------------------------- collective engine (§18)
+
+def test_redistribute_collective_forced_vs_host_bit_identical():
+    """The two impls forced via DR_TPU_REDISTRIBUTE must leave the
+    IDENTICAL physical padded row — the §18 bit-identity contract the
+    fuzz arm cranks, pinned here at one deterministic shape."""
+    P = dr_tpu.nprocs()
+    n = 4 * P + 3
+    src = np.arange(n, dtype=np.float32)
+    hops = [None, [n] + [0] * (P - 1),
+            [1] * (P - 1) + [n - (P - 1)], None]
+    va = dr_tpu.distributed_vector.from_array(src)
+    vb = dr_tpu.distributed_vector.from_array(src)
+    for d in hops:
+        with env_override(DR_TPU_REDISTRIBUTE="collective"):
+            dr_tpu.redistribute(va, d)
+        with env_override(DR_TPU_REDISTRIBUTE="host"):
+            dr_tpu.redistribute(vb, d)
+        np.testing.assert_array_equal(np.asarray(va._data),
+                                      np.asarray(vb._data))
+        np.testing.assert_array_equal(dr_tpu.to_numpy(va), src)
+
+
+def test_redistribute_exchange_fault_leaves_vector_intact():
+    """An injected redistribute.exchange fault surfaces CLASSIFIED
+    with the vector exactly as it was — the metadata rebind rolls
+    back (§18.2's failure row)."""
+    P = dr_tpu.nprocs()
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    with faults.injected("redistribute.exchange", "transient",
+                         times=1):
+        with pytest.raises(resilience.TransientBackendError):
+            dr_tpu.redistribute(v, [n] + [0] * (P - 1))
+    assert v.distribution is None
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), src)
+
+
+def test_redistribute_forced_collective_cross_mesh_falls_back():
+    """DR_TPU_REDISTRIBUTE=collective on a cross-runtime hop cannot
+    run device-side (no shared mesh): the move takes the host-staged
+    route ANNOUNCED (warn_fallback), value preserved — never an error,
+    never silent."""
+    import jax
+    from jax.sharding import Mesh
+    from dr_tpu.parallel.runtime import Runtime
+
+    devs = jax.devices()
+    if len(devs) < 3:
+        pytest.skip("needs >= 3 devices")
+    small = Runtime(mesh=Mesh(np.asarray(devs[1:3]), ("x",)))
+    src = np.arange(10, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    from dr_tpu.utils import fallback
+    import warnings
+    with env_override(DR_TPU_REDISTRIBUTE="collective",
+                      DR_TPU_SILENCE_FALLBACKS=None):
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            dr_tpu.redistribute(v, [4, 6], runtime=small)
+    assert v.runtime is small
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), src)
+    msgs = [str(r.message) for r in rec
+            if issubclass(r.category,
+                          fallback.MaterializeFallbackWarning)]
+    assert any("host-staged" in m for m in msgs), msgs
+
+
+def test_plan_flush_replay_with_redistribute(tmp_path):
+    """Mid-plan-flush device loss with a RECORDED re-layout in the
+    queue: the pending redistribute UNDOes its metadata flip (so the
+    rescue reads a consistent container), the suffix re-records
+    against the shrunken mesh — redistribute included — and the final
+    value matches the eager chain (§18.3's elastic-replay contract)."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.checkpoint.save(str(tmp_path / "v.npz"), v)
+    with env_override(DR_TPU_ELASTIC="1"):
+        with faults.injected("device.lost", "device_lost", times=1):
+            with dr_tpu.deferred() as p:
+                dr_tpu.fill(v, 2.0)
+                dr_tpu.redistribute(v, None)
+                dr_tpu.for_each(v, _half)
+                tot = dr_tpu.reduce(v)
+    assert float(tot) == n
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v),
+                                  np.ones(n, np.float32))
+    assert dr_tpu.nprocs() == P - 1
+    reasons = [e["reason"] for e in p.log]
+    assert "elastic replay" in reasons

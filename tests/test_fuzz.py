@@ -1382,6 +1382,134 @@ def test_fuzz_redistribute(seed):
             f"it={it}: reduce {got} vs {want}"
 
 
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_redistribute_impls(seed):
+    """Round-16 collective-vs-host BIT-equality arm (tools/fuzz_crank.sh;
+    ISSUE 12): random same-mesh src -> dst re-layouts — uneven cuts,
+    zero-size team blocks, halo vectors, several dtypes — forced
+    through BOTH impls via the ``DR_TPU_REDISTRIBUTE`` override.  The
+    physical padded rows (not just the logical values) must match
+    bit-for-bit: the collective exchange program's contract is 'the
+    host-staged v1, without the host'."""
+    rng = np.random.default_rng(1900 + seed)
+    P = dr_tpu.nprocs()
+    dtypes = [np.float32, np.int32, np.float16, np.uint8]
+
+    def dist(n):
+        roll = int(rng.integers(0, 3))
+        if P < 2 or roll == 0:
+            return None
+        if roll == 1:  # team: everything on one random rank
+            sizes = [0] * P
+            sizes[int(rng.integers(0, P))] = n
+            return tuple(sizes)
+        cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+        b = np.concatenate(([0], cuts, [n]))
+        return tuple(int(y - x) for x, y in zip(b[:-1], b[1:]))
+
+    # fresh layout pairs compile an exchange program each (and the
+    # single-core CI container prices every XLA compile in wall
+    # time): CI runs a thin slice, the crank sets DR_TPU_FUZZ_ITERS
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else max(ITERS // 8, 3)
+    for it in range(iters):
+        n = int(rng.integers(1, 200))
+        dt = dtypes[int(rng.integers(0, len(dtypes)))]
+        src = (rng.standard_normal(n) * 50).astype(dt)
+        hb = None
+        d0 = dist(n)
+        if d0 is None and rng.random() < 0.3:
+            hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+        va = dr_tpu.distributed_vector.from_array(src, halo=hb,
+                                                  distribution=d0)
+        vb = dr_tpu.distributed_vector.from_array(src, halo=hb,
+                                                  distribution=d0)
+        for hop in range(int(rng.integers(1, 4))):
+            # halo vectors keep the uniform-layout constructor contract
+            d = None if hb is not None else dist(n)
+            with env_override(DR_TPU_REDISTRIBUTE="collective"):
+                dr_tpu.redistribute(va, d)
+            with env_override(DR_TPU_REDISTRIBUTE="host"):
+                dr_tpu.redistribute(vb, d)
+            tag = f"it={it} hop={hop} dt={np.dtype(dt)} d={d}"
+            np.testing.assert_array_equal(
+                np.asarray(va._data), np.asarray(vb._data),
+                err_msg=f"{tag}: physical rows diverged")
+            np.testing.assert_array_equal(dr_tpu.to_numpy(va), src,
+                                          err_msg=tag)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_join_partition(seed):
+    """Round-16 repartition-join arm (ISSUE 12, docs/SPEC.md §18.4):
+    random key distributions (uniform / skewed / all-equal / distinct /
+    float, NaNs included) x uneven input layouts through BOTH join
+    merge routes — the broadcast sorted-merge and the bounded-memory
+    repartition exchange forced via ``DR_TPU_JOIN_BROADCAST_MAX=0`` —
+    must agree BIT-for-bit on every output channel and the row count,
+    for inner/left/right alike; the partition route must also report a
+    gathered channel bounded by the full right side."""
+    from dr_tpu.algorithms import relational as _rel
+    rng = np.random.default_rng(2100 + seed)
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("the repartition route needs >= 2 shards")
+    # every iteration compiles fresh probe + partition + broadcast
+    # programs (single-core CI container): CI runs a thin slice, the
+    # crank sets DR_TPU_FUZZ_ITERS explicitly
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else max(ITERS // 8, 3)
+    for it in range(iters):
+        nl = int(rng.integers(1, 100))
+        nr = int(rng.integers(1, 100))
+        kind = rng.choice(["uniform", "skewed", "all_equal",
+                           "distinct", "float"])
+        kl = _fuzz_rel_keys(rng, nl, kind)
+        kr = _fuzz_rel_keys(rng, nr, kind)
+        if kind == "float" and rng.random() < 0.5:
+            kl[::5] = np.nan
+            kr[::7] = np.nan
+        vl = rng.standard_normal(nl).astype(np.float32)
+        vr = rng.standard_normal(nr).astype(np.float32)
+        how = ("inner", "left", "right")[it % 3]
+        cap = nl * nr + nl + nr + 1
+        tag = f"it={it} how={how} kind={kind} nl={nl} nr={nr}"
+
+        def run(thresh):
+            a = dr_tpu.distributed_vector.from_array(
+                kl, distribution=_fuzz_rel_dist(rng, nl, P))
+            b = dr_tpu.distributed_vector.from_array(vl)
+            c = dr_tpu.distributed_vector.from_array(
+                kr, distribution=_fuzz_rel_dist(rng, nr, P))
+            d = dr_tpu.distributed_vector.from_array(vr)
+            ok = dr_tpu.distributed_vector(cap)
+            ol = dr_tpu.distributed_vector(cap)
+            orr = dr_tpu.distributed_vector(cap)
+            with env_override(DR_TPU_JOIN_BROADCAST_MAX=thresh):
+                m = dr_tpu.join(a, b, c, d, ok, ol, orr, how=how,
+                                fill=-7.5)
+            return (int(m), dr_tpu.to_numpy(ok), dr_tpu.to_numpy(ol),
+                    dr_tpu.to_numpy(orr))
+
+        mb, okb, olb, orb = run("999999999")
+        assert _rel.last_join_route()["impl"] == "broadcast", tag
+        mp, okp, olp, orp = run("0")
+        route = _rel.last_join_route()
+        assert route["impl"] == "partition", tag
+        # the gathered channel is the rcap-bounded partition, never
+        # more than the padded full right side (uniform keys shrink it
+        # well below — the dedicated regression asserts that).  Use
+        # the ROUTE's own side sizes: a right join swaps the sides,
+        # so the partitioned 'right' is the caller's left.
+        NR = route["nshards"] \
+            * -(-max(route["nr"], 1) // route["nshards"])
+        assert route["rcap"] <= NR, (tag, route)
+        assert mb == mp, f"{tag}: rows {mb} != {mp}"
+        np.testing.assert_array_equal(okb, okp, err_msg=f"{tag} keys")
+        np.testing.assert_array_equal(olb, olp, err_msg=f"{tag} left")
+        np.testing.assert_array_equal(orb, orp, err_msg=f"{tag} right")
+
+
 # ---------------------------------------------------------------------------
 # RELATIONAL arm (round 14, ISSUE 10): random key distributions
 # (uniform / skewed / all-equal / distinct / float) x uneven layouts

@@ -440,3 +440,39 @@ def test_with_deadline_dumps_obs_tail(traced, capsys):
     assert ei.value.trace_tail is not None
     assert any(e["name"] == "deadline.expired"
                for e in ei.value.trace_tail)
+
+
+# ---------------------------------------------------------------------------
+# collective redistribution spans (round 16, docs/SPEC.md §18)
+# ---------------------------------------------------------------------------
+
+def test_redistribute_span_phases_and_bytes_counter(traced):
+    """The engine's obs contract: a ``redistribute`` span with
+    plan/exchange/rebind phase children, a bytes-moved counter that
+    actually counts off-shard traffic, and a classified mid-exchange
+    error carrying the trace tail like every resilience path."""
+    P = dr_tpu.nprocs()
+    n = 4 * P
+    v = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    dr_tpu.redistribute(v, [n] + [0] * (P - 1))   # collective, moves
+    evs = obs.events()
+    names = [e.get("name") for e in evs]
+    assert "redistribute" in names
+    spans = [e for e in evs if e.get("name") == "redistribute"]
+    assert any(s.get("args", {}).get("impl") == "collective"
+               for s in spans)
+    phases = {e.get("args", {}).get("phase") for e in evs
+              if e.get("name") == "redistribute.phase"}
+    assert {"plan", "exchange", "rebind"} <= phases, phases
+    if P > 1:
+        moved = obs.metrics.counter("redistribute.bytes_moved").value
+        # everything but rank 0's original block crossed shards
+        assert moved >= (n - -(-n // P)) * 4, moved
+    # classified mid-exchange errors carry the §15.4 trace tail
+    with faults.injected("redistribute.exchange", "program", times=1):
+        try:
+            dr_tpu.redistribute(v, None)
+            raise AssertionError("injected fault did not surface")
+        except resilience.ProgramError as e:
+            assert e.trace_tail, "no trace tail on the classified error"

@@ -544,3 +544,116 @@ def test_compile_cache_unset_is_noop(monkeypatch):
     monkeypatch.delenv("DR_TPU_COMPILE_CACHE_DIR", raising=False)
     monkeypatch.setattr(rt, "_compile_cache_wired", False)
     assert rt.setup_compile_cache() is None
+
+
+# ----------------------------------------------- redistribute fusion (§18.3)
+
+def _half(x):
+    return x * 0.5
+
+
+def test_deferred_redistribute_fuses_without_flush():
+    """ISSUE 12 acceptance: a collective-eligible redistribute RECORDS
+    into the deferred plan — one fused run, ONE dispatch, no
+    non-fusible flush cliff, no fallback warn — and the final physical
+    layout is bit-identical to the eager sequence."""
+    P = dr_tpu.nprocs()
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    team = [n] + [0] * (P - 1)
+
+    ve = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.fill(ve, 2.0)
+    dr_tpu.redistribute(ve, team)
+    dr_tpu.for_each(ve, _half)
+    want = float(dr_tpu.reduce(ve))
+
+    vd = dr_tpu.distributed_vector.from_array(src)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", fallback.MaterializeFallbackWarning)
+        d0 = spmd_guard.dispatch_count()
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(vd, 2.0)
+            dr_tpu.redistribute(vd, team)
+            dr_tpu.for_each(vd, _half)
+            tot = dr_tpu.reduce(vd)
+        used = spmd_guard.dispatch_count() - d0
+    assert used <= 1, p.explain()
+    assert float(tot) == want == n
+    st = p.stats()
+    assert st["fused_runs"] == 1 and st["fused_ops"] == 4, p.explain()
+    assert vd.distribution is not None \
+        and vd.distribution.sizes[0] == n
+    np.testing.assert_array_equal(np.asarray(vd._data),
+                                  np.asarray(ve._data))
+
+
+def test_deferred_redistribute_layout_visible_to_later_records():
+    """Ops recorded AFTER an in-plan redistribute key on the DST
+    geometry (the metadata flips at record time): a subsequent
+    host-array copy into the re-laid-out vector lands exactly as the
+    eager sequence's."""
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("needs >= 2 shards for a layout change")
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    fresh = (np.arange(n, dtype=np.float32) * 3 + 1)
+    uneven = [1] * (P - 1) + [n - (P - 1)]
+
+    ve = dr_tpu.distributed_vector.from_array(src)
+    dr_tpu.redistribute(ve, uneven)
+    dr_tpu.copy(fresh, ve)
+
+    vd = dr_tpu.distributed_vector.from_array(src)
+    with dr_tpu.deferred():
+        dr_tpu.redistribute(vd, uneven)
+        dr_tpu.copy(fresh, vd)
+    np.testing.assert_array_equal(np.asarray(vd._data),
+                                  np.asarray(ve._data))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(vd), fresh)
+
+
+def test_deferred_redistribute_faulted_flush_rolls_back_metadata():
+    """A fault at the flush boundary drops the queue — including the
+    recorded re-layout's METADATA flip, which must undo so the
+    container keeps its pre-flush layout AND value (the faulted-flush
+    contract extended to §18.3's deferred rebind)."""
+    P = dr_tpu.nprocs()
+    n = 4 * P
+    src = np.arange(n, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    with faults.injected("plan.flush", "program", times=1):
+        with pytest.raises(resilience.ProgramError):
+            with dr_tpu.deferred():
+                dr_tpu.fill(v, 5.0)
+                dr_tpu.redistribute(v, [n] + [0] * (P - 1))
+    assert v.distribution is None
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v), src)
+
+
+def test_deferred_redistribute_host_route_flushes_announced():
+    """A cross-runtime (host-staged) redistribute inside a region is a
+    NON-FUSIBLE cliff: the plan flushes announced (warn_fallback) and
+    the move runs eagerly — layout bookkeeping stays consistent."""
+    import jax as _jax
+    from jax.sharding import Mesh
+    from dr_tpu.parallel.runtime import Runtime
+
+    devs = _jax.devices()
+    if len(devs) < 3:
+        pytest.skip("needs >= 3 devices for a distinct sub-mesh")
+    small = Runtime(mesh=Mesh(np.asarray(devs[1:3]), ("x",)))
+    n = 12
+    src = np.arange(n, dtype=np.float32)
+    v = dr_tpu.distributed_vector.from_array(src)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(v, 1.5)
+            dr_tpu.redistribute(v, None, runtime=small)
+    assert v.runtime is small
+    np.testing.assert_array_equal(dr_tpu.to_numpy(v),
+                                  np.full(n, 1.5, np.float32))
+    reasons = [e["reason"] for e in p.log]
+    assert any("non-fusible" in r for r in reasons), reasons

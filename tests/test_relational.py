@@ -678,3 +678,103 @@ def test_relational_obs_spans():
     # the join's time splits into visible phases
     assert {"sort_left", "sort_right", "merge", "sort",
             "aggregate"} <= phases
+
+
+# ------------------------------------------------- join repartition (§18.4)
+
+def test_join_partition_bounds_memory_and_matches_broadcast():
+    """ISSUE 12 acceptance: above the threshold the join merge runs
+    the repartition exchange — the merge program's gathered channel is
+    the rcap-bounded right partition, NOT a full-side all_gather — and
+    its rows are bit-identical to the broadcast route and pandas."""
+    from dr_tpu.algorithms import relational as rel
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("the repartition route needs >= 2 shards")
+    rng = np.random.default_rng(33)
+    nl, nr = 96, 64
+    kl = rng.integers(0, 24, nl).astype(np.float32)   # uniform keys
+    kr = rng.integers(0, 24, nr).astype(np.float32)
+    vl = rng.standard_normal(nl).astype(np.float32)
+    vr = rng.standard_normal(nr).astype(np.float32)
+    cap = 4 * (nl + nr)
+
+    def run(thresh):
+        a = dr_tpu.distributed_vector.from_array(kl)
+        b = dr_tpu.distributed_vector.from_array(vl)
+        c = dr_tpu.distributed_vector.from_array(kr)
+        d = dr_tpu.distributed_vector.from_array(vr)
+        ok = dr_tpu.distributed_vector(cap)
+        ol = dr_tpu.distributed_vector(cap)
+        orr = dr_tpu.distributed_vector(cap)
+        with env_override(DR_TPU_JOIN_BROADCAST_MAX=thresh):
+            m = dr_tpu.join(a, b, c, d, ok, ol, orr)
+        return (int(m), dr_tpu.to_numpy(ok), dr_tpu.to_numpy(ol),
+                dr_tpu.to_numpy(orr), rel.last_join_route())
+
+    mb, okb, olb, orb, rb = run("999999999")
+    assert rb["impl"] == "broadcast"
+    mp, okp, olp, orp, rp = run("0")
+    assert rp["impl"] == "partition"
+    # the ACCEPTANCE assertion: the merge program's gathered channel
+    # (the right partition) stays under the full side, and the
+    # per-device working set under the broadcast route's
+    NR = rp["nshards"] * -(-nr // rp["nshards"])
+    assert rp["rcap"] < NR, rp
+    assert rp["gathered_rows_per_device"] \
+        < rb["gathered_rows_per_device"], (rp, rb)
+    assert mb == mp
+    np.testing.assert_array_equal(okb, okp)
+    np.testing.assert_array_equal(olb, olp)
+    np.testing.assert_array_equal(orb, orp)
+    ref = pd.merge(pd.DataFrame({"k": kl, "a": vl}),
+                   pd.DataFrame({"k": kr, "b": vr}), on="k")
+    assert mp == len(ref)
+
+
+def test_join_partition_default_threshold_routes_small_broadcast():
+    """The default DR_TPU_JOIN_BROADCAST_MAX keeps small joins on the
+    broadcast fast path — the routing knob, not the data, decides."""
+    from dr_tpu.algorithms import relational as rel
+    rng = np.random.default_rng(34)
+    n = 24
+    keys, kv = _mk(rng, n, ints=True, hi=6)
+    vals, vv = _mk(rng, n)
+    cap = n * n
+    ok = dr_tpu.distributed_vector(cap)
+    ol = dr_tpu.distributed_vector(cap)
+    orr = dr_tpu.distributed_vector(cap)
+    dr_tpu.join(kv, vv, kv, vv, ok, ol, orr)
+    assert rel.last_join_route()["impl"] == "broadcast"
+
+
+def test_join_int_pad_sentinel_keys_match_pandas():
+    """Round-16 fix: an INTEGER key equal to the dtype's max (the sort
+    pad sentinel) must not count the pad rows as matches — both merge
+    routes, vs pandas."""
+    from dr_tpu.algorithms import relational as rel
+    ik = np.array([0, 5, 2**31 - 1, 7, 2**31 - 1, -2**31], np.int32)
+    jk = np.array([2**31 - 1, 5, -2**31, 9], np.int32)
+    iv = np.arange(len(ik), dtype=np.int32)
+    jv = np.arange(len(jk), dtype=np.int32)
+    ref = pd.merge(pd.DataFrame({"k": ik, "a": iv}),
+                   pd.DataFrame({"k": jk, "b": jv}), on="k")
+    for thresh in ("999999999", "0"):
+        if thresh == "0" and dr_tpu.nprocs() < 2:
+            continue
+        a = dr_tpu.distributed_vector.from_array(ik)
+        b = dr_tpu.distributed_vector.from_array(iv)
+        c = dr_tpu.distributed_vector.from_array(jk)
+        d = dr_tpu.distributed_vector.from_array(jv)
+        ok = dr_tpu.distributed_vector(32, np.int32)
+        ol = dr_tpu.distributed_vector(32, np.int32)
+        orr = dr_tpu.distributed_vector(32, np.int32)
+        with env_override(DR_TPU_JOIN_BROADCAST_MAX=thresh):
+            m = dr_tpu.join(a, b, c, d, ok, ol, orr)
+        assert int(m) == len(ref), (thresh, int(m), len(ref))
+        got = sorted(zip(dr_tpu.to_numpy(ok)[:m].tolist(),
+                         dr_tpu.to_numpy(ol)[:m].tolist(),
+                         dr_tpu.to_numpy(orr)[:m].tolist()))
+        want = sorted(zip(ref["k"].tolist(), ref["a"].tolist(),
+                          ref["b"].tolist()))
+        assert got == want, thresh

@@ -65,6 +65,18 @@
 # automatically with the fuzz arms; the chaos battery grew a
 # join -> groupby -> deferred top_k/histogram leg (docs/SPEC.md SS17).
 #
+# COLLECTIVE-REDISTRIBUTE arm (round 16): test_fuzz_redistribute_impls
+# cranks random same-mesh src->dst re-layouts (uneven cuts, zero-size
+# team blocks, halo vectors, several dtypes) through BOTH impls forced
+# via DR_TPU_REDISTRIBUTE and bit-compares the physical padded rows
+# (filter `redistribute_impls`); test_fuzz_join_partition cranks the
+# join's broadcast vs bounded-memory repartition merge routes
+# (DR_TPU_JOIN_BROADCAST_MAX=0 forces the exchange) over random key
+# distributions x layouts, bit-equal on every channel (filter
+# `join_partition`).  Both collect automatically with the fuzz arms;
+# the chaos battery grew a redistribute leg sweeping the
+# redistribute.exchange site rows (docs/SPEC.md SS18).
+#
 # GROW arm (round 15): test_fuzz_elastic_kill_and_revive (collected
 # with the fuzz arms — random kill -> grow_session revive vs pre-fault
 # oracles) plus the shrink->grow->shrink soak cranked below; the chaos

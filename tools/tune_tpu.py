@@ -6,7 +6,8 @@ Measures everything by the marginal method with a hard scalar-read sync
 on tunneled backends, so each timed call returns one device scalar.
 
 Usage:  python tools/tune_tpu.py
-        [stencil|scan|dot|spmv|heat|attn|halo|sort|pipeline|all]
+        [stencil|scan|dot|spmv|heat|attn|halo|sort|pipeline|
+         relational|redistribute|all]
 
 Prints one line per configuration; safe to re-run (all programs cached
 per process).  This is a developer tool, not part of the bench contract.
@@ -648,6 +649,54 @@ def tune_relational():
                 stage = conts = None
 
 
+def tune_redistribute():
+    """Round-16 re-layout ladder (docs/SPEC.md §18) for the queued
+    silicon session: per-hop GB/s of host-staged vs collective
+    ``redistribute()`` at growing n over the layout kinds that shape
+    the exchange plan — ``rotate`` (every shard's window shifts: p-1
+    short hops) and ``team`` (gather-to-one/scatter-from-one: the
+    largest single buckets).  The host-vs-collective gap on real ICI
+    is the number that retires the host-staged default everywhere the
+    meshes align."""
+    import dr_tpu
+    from dr_tpu.utils.env import env_override
+
+    dr_tpu.init()
+    P = dr_tpu.nprocs()
+    on_cpu = dr_tpu.devices()[0].platform == "cpu"
+    for logn in ((16, 18) if on_cpu else (20, 22, 24)):
+        n = max((1 << logn) // P * P, P)
+        for kind in ("rotate", "team"):
+            if kind == "team":
+                alt = [n] + [0] * (P - 1)
+            else:
+                base = n // P
+                alt = [base] * P
+                alt[0] = base // 2
+                alt[-1] = n - sum(alt[:-1])
+            v = None
+            try:
+                v = dr_tpu.distributed_vector.from_array(
+                    np.arange(n, dtype=np.float32))
+                for impl in ("host", "collective"):
+                    def run(r, impl=impl, alt=alt, v=v):
+                        with env_override(DR_TPU_REDISTRIBUTE=impl):
+                            for _ in range(r):
+                                dr_tpu.redistribute(v, alt)
+                                dr_tpu.redistribute(v, None)
+                        float(np.asarray(v._data)[0, 0])  # sync
+
+                    dt = _marginal(run, r1=1, r2=5, samples=3)
+                    print(f"redistribute n=2^{logn} [{kind:6s}] "
+                          f"{impl:10s}: {2 * n * 4 / dt / 1e9:8.3f} "
+                          "GB/s/hop-pair", flush=True)
+            except Exception as e:
+                print(f"redistribute n=2^{logn} [{kind}]: FAIL "
+                      f"{_errline(e)}", flush=True)
+            finally:
+                v = None
+
+
 if __name__ == "__main__":
     # Guarded first backend touch through the SAME degradation router
     # as bench.py and entry() (utils/resilience): a dead relay degrades
@@ -684,6 +733,8 @@ if __name__ == "__main__":
             tune_pipeline()
         if what in ("relational", "all"):
             tune_relational()
+        if what in ("redistribute", "all"):
+            tune_redistribute()
         for nm in ("dot", "heat", "attn", "halo", "spmv"):
             if what in (nm, "all"):
                 tune_container(nm)
