@@ -86,6 +86,15 @@ device route after a relay returned) carries
 the re-admitted mesh size, grow wall time — next to the ``shrink``
 chapter, so one artifact tells the whole degrade-and-recover arc.
 
+Round 17 (the serving data plane, docs/SPEC.md §19): ``--serve``
+additionally measures the plane itself — ``detail.serve_arena_ms``
+(arena vs inline-wire p50 A/B at a ≥ 1 MiB payload: the zero-copy
+acceptance number), ``detail.serve_router`` (closed-loop rps at
+replica counts 1 and 2 behind the consistent-hash front, CPU-route
+replicas on this host), and ``detail.serve_tenants`` (per-tenant
+queue-wait/service p50/p95 under a skewed heavy/light load — the
+weighted-fair no-starvation evidence).
+
 Round 16: ``--redistribute`` (or DR_TPU_BENCH_REDISTRIBUTE=1 — argv
 and env both survive the CPU-fallback re-execs) races the two
 re-layout impls (docs/SPEC.md §18) over a layout ping-pong, emitting
@@ -1095,6 +1104,126 @@ def _serve_metrics(on_cpu: bool) -> dict:
             out["serve_daemon_ms"] = split
         if st["degraded"]:
             out["serve_degraded"] = st["degraded"]
+
+        # ---- round 17: the serving data plane (docs/SPEC.md §19)
+        # arena vs inline-wire p50 A/B at a >= 1 MiB payload — the
+        # zero-copy acceptance number (same op, same daemon, one
+        # closed-loop client; only the transport differs)
+        nbig = 2 ** 18  # 1 MiB of f32 — the acceptance floor
+        xb = rng.standard_normal(nbig).astype(np.float32)
+        ab = {"payload_mib": round(nbig * 4 / 2 ** 20, 2)}
+        for label, use_arena in (("inline", False), ("arena", True)):
+            lat2 = []
+            with serve.Client(sock, timeout=cto,
+                              arena=use_arena) as c:
+                c.scale(xb, a=1.0)  # warm: compile + arena attach
+                for r in range(12):
+                    t0 = time.perf_counter()
+                    c.scale(xb, a=1.0 + r * 1e-6)
+                    lat2.append(time.perf_counter() - t0)
+            ab[f"{label}_p50"] = round(
+                float(np.percentile(lat2, 50)) * 1e3, 3)
+        if ab["arena_p50"] > 0:
+            ab["speedup"] = round(ab["inline_p50"] / ab["arena_p50"],
+                                  3)
+        out["serve_arena_ms"] = ab
+
+        # skewed heavy/light load: per-tenant latency breakdown — the
+        # weighted-fair no-starvation evidence (client-side per-tenant
+        # percentiles next to the daemon's per-tenant queue-wait)
+        xs = rng.standard_normal(2 ** 12).astype(np.float32)
+        tlat = {"heavy": [], "light": []}
+
+        def tenant_worker(tenant, reqs):
+            try:
+                with serve.Client(sock, timeout=cto,
+                                  tenant=tenant) as c:
+                    for r in range(reqs):
+                        t0 = time.perf_counter()
+                        c.scale(xs, a=1.0 + r * 1e-6)
+                        tlat[tenant].append(time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover - defensive
+                out.setdefault("serve_tenant_errors", []) \
+                    .append(repr(e)[:120])
+
+        tthreads = [threading.Thread(target=tenant_worker,
+                                     args=("heavy", 16))
+                    for _ in range(3)]
+        tthreads.append(threading.Thread(target=tenant_worker,
+                                         args=("light", 8)))
+        for t in tthreads:
+            t.start()
+        for t in tthreads:
+            t.join()
+        tenants = {}
+        hists2 = (srv.stats().get("obs") or {}).get("histograms", {})
+        for tenant, lats in tlat.items():
+            if not lats:
+                continue
+            arr = np.sort(np.array(lats))
+            row = {"requests": int(arr.size),
+                   "p50_ms": round(float(np.percentile(arr, 50))
+                                   * 1e3, 2),
+                   "p95_ms": round(float(np.percentile(arr, 95))
+                                   * 1e3, 2)}
+            qw = hists2.get(f"serve.queue_wait_ms.t.{tenant}")
+            if qw:
+                row["queue_wait_p95_ms"] = qw.get("p95")
+            tenants[tenant] = row
+        if tenants:
+            out["serve_tenants"] = tenants
+
+        # replica scale-out: closed-loop rps at 1 vs 2 replicas
+        # behind the consistent-hash front.  CPU-route replicas only
+        # (the primary daemon holds the one claim on this host), so
+        # the leg runs on CPU sessions and is skipped on silicon —
+        # tune_tpu.py serve ladders it for the queued chip session.
+        if on_cpu:
+            router = {}
+            for nrep in (1, 2):
+                fleet = serve.Router(
+                    os.path.join(tmpdir, f"fleet{nrep}"),
+                    replicas=nrep, cpu=True, batch_window=0.0)
+                try:
+                    fleet.start()
+                    rlat = [[] for _ in range(4)]
+
+                    def rworker(i):
+                        try:
+                            with serve.RouterClient(
+                                    fleet.paths(), tenant=f"rt{i}",
+                                    timeout=cto) as rc:
+                                rc.scale(xs, a=1.0)  # warm
+                                for r in range(12):
+                                    t0 = time.perf_counter()
+                                    rc.scale(xs, a=1.0 + r * 1e-6)
+                                    rlat[i].append(
+                                        time.perf_counter() - t0)
+                        except Exception as e:  # pragma: no cover
+                            out.setdefault("serve_router_errors", []) \
+                                .append(repr(e)[:120])
+
+                    rthreads = [threading.Thread(target=rworker,
+                                                 args=(i,))
+                                for i in range(4)]
+                    t0 = time.perf_counter()
+                    for t in rthreads:
+                        t.start()
+                    for t in rthreads:
+                        t.join()
+                    wall2 = time.perf_counter() - t0
+                    alat = np.sort(np.array(
+                        [v for l in rlat for v in l]))
+                    if alat.size:
+                        router[f"replicas_{nrep}"] = {
+                            "rps": round(alat.size / wall2, 1),
+                            "p50_ms": round(
+                                float(np.percentile(alat, 50)) * 1e3,
+                                2)}
+                finally:
+                    fleet.stop()
+            if router:
+                out["serve_router"] = router
     except Exception as e:  # pragma: no cover - defensive
         out["serve_error"] = repr(e)[:160]
     finally:

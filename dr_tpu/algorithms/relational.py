@@ -126,8 +126,9 @@ __all__ = ["join", "groupby_aggregate", "unique", "histogram", "top_k",
 
 #: supported groupby aggregations (docs/SPEC.md §17.1)
 AGGS = ("sum", "min", "max", "count", "mean")
-#: supported join flavors (outer = ROADMAP follow-up)
-JOIN_HOWS = ("inner", "left", "right")
+#: supported join flavors (docs/SPEC.md §17.1; ``outer`` landed with
+#: the data-plane round — presence-flag UNION on both merge routes)
+JOIN_HOWS = ("inner", "left", "right", "outer")
 
 _GMAX = np.int32(np.iinfo(np.int32).max)
 
@@ -574,19 +575,27 @@ def unique(r, out):
 
 def _join_program(mesh, axis, llayout, lkdtype, lvdtype, rlayout,
                   rkdtype, rvdtype, ok_layout, ok_dtype, ol_layout,
-                  ol_dtype, or_layout, or_dtype, nl, nr, left_outer):
+                  ol_dtype, or_layout, or_dtype, nl, nr, left_outer,
+                  right_outer=False):
     """Sorted-merge join program over the SORTED scratch sides.  Each
     shard all_gathers the sorted (key, value) channels (broadcast
     sorted-merge, memory O(nl + nr) per device — see the module
     docstring), counts matches per left row with two searchsorteds on
     the monotone encoding, prefix-sums the expansion offsets, and
     materializes exactly its own window of the expanded rows per OUT
-    distribution."""
+    distribution.  ``right_outer`` (the ``how="outer"`` union,
+    docs/SPEC.md §17.1) adds the UNMATCHED right rows as a second
+    emitter stream: a 3-key sort of the combined (key, source,
+    position) emitter list interleaves them into the key order — a
+    key present on both sides never has unmatched rows, so the
+    (key, left position, right position) contract extends to (key,
+    source, position) without ambiguity."""
     key = ("reljoin", pinned_id(mesh), axis, llayout, str(lkdtype),
            str(lvdtype), rlayout, str(rkdtype), str(rvdtype),
            ok_layout, str(ok_dtype), ol_layout, str(ol_dtype),
            or_layout, str(or_dtype), int(nl), int(nr),
-           bool(left_outer), bool(jax.config.jax_enable_x64))
+           bool(left_outer), bool(right_outer),
+           bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
@@ -604,8 +613,9 @@ def _join_program(mesh, axis, llayout, lkdtype, lvdtype, rlayout,
         kl, bigl = _encode(LK)
         kr, bigr = _encode(RK)
         lvalid = jnp.arange(NL) < nl
+        rvalid = jnp.arange(NR) < nr
         kl = jnp.where(lvalid, kl, bigl)
-        kr = jnp.where(jnp.arange(NR) < nr, kr, bigr)
+        kr = jnp.where(rvalid, kr, bigr)
         # match counts per left row: two searchsorteds on the monotone
         # encoding.  Real rows occupy positions [0, nr) of the sorted
         # channel, pads [nr, NR) — clamping the window to nr keeps an
@@ -620,39 +630,99 @@ def _join_program(mesh, axis, llayout, lkdtype, lvdtype, rlayout,
             rows = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)
         else:
             rows = cnt
-        offs = jnp.cumsum(rows)                         # inclusive
-        M = offs[NL - 1]
 
-        def out_channel(layout, produce, dtype):
-            """My window of the expanded rows under ``layout``:
-            result row j expands left element i = first index whose
-            inclusive offset exceeds j, at in-group position
-            j - exclusive_offset(i)."""
+        if not right_outer:
+            offs = jnp.cumsum(rows)                     # inclusive
+            M = offs[NL - 1]
+
+            def out_channel(layout, produce, dtype):
+                """My window of the expanded rows under ``layout``:
+                result row j expands left element i = first index
+                whose inclusive offset exceeds j, at in-group
+                position j - exclusive_offset(i)."""
+                So, starts_c, _sizes = _dest_geometry(layout)
+                j = starts_c[r] + jnp.arange(So)
+                live = j < M
+                i = jnp.clip(jnp.searchsorted(offs, j, side="right"),
+                             0, NL - 1)
+                base = jnp.take(offs, i) - jnp.take(rows, i)
+                matched = jnp.take(cnt, i) > 0
+                rpos = jnp.clip(jnp.take(lo, i) + (j - base), 0,
+                                NR - 1)
+                vals = produce(i, rpos, matched)
+                vals = jnp.where(live, vals.astype(dtype),
+                                 jnp.zeros((), dtype))
+                return _pack_out_row(vals, live, layout, r)
+
+            okrow = out_channel(ok_layout,
+                                lambda i, rp, mt: jnp.take(LK, i),
+                                ok_dtype)
+            olrow = out_channel(ol_layout,
+                                lambda i, rp, mt: jnp.take(LV, i),
+                                ol_dtype)
+            orrow = out_channel(
+                or_layout,
+                lambda i, rp, mt: jnp.where(
+                    mt, jnp.take(RV, rp).astype(or_dtype),
+                    fillv.astype(or_dtype)),
+                or_dtype)
+            return okrow, olrow, orrow, M
+
+        # ---- right_outer: the presence-flag UNION.  A right row is
+        # unmatched when no left key equals it (clamped searchsorteds
+        # on the sorted LEFT channel — the mirror of the count above).
+        lo_l = jnp.minimum(jnp.searchsorted(kl, kr, side="left"), nl)
+        hi_l = jnp.minimum(jnp.searchsorted(kl, kr, side="right"), nl)
+        rrows = jnp.where(rvalid & (hi_l == lo_l), 1, 0) \
+            .astype(jnp.int32)
+        # combined emitter list, sorted by (key, source, position):
+        # source 0 = a left row (emitting its match expansion, or the
+        # left-outer fill row), source 1 = an unmatched right row.
+        # Pads carry zero emit counts and sort harmlessly last.
+        K = jnp.concatenate([kl, kr])
+        SRC = jnp.concatenate([jnp.zeros(NL, jnp.int32),
+                               jnp.ones(NR, jnp.int32)])
+        PIDX = jnp.concatenate([jnp.arange(NL, dtype=jnp.int32),
+                                jnp.arange(NR, dtype=jnp.int32)])
+        EC = jnp.concatenate([rows.astype(jnp.int32), rrows])
+        _ks, ssrc, spidx, sec = lax.sort((K, SRC, PIDX, EC),
+                                         dimension=0, num_keys=3)
+        coffs = jnp.cumsum(sec)
+        NE = NL + NR
+        M = coffs[NE - 1]
+
+        def out_channel(layout, produce_left, produce_right, dtype):
             So, starts_c, _sizes = _dest_geometry(layout)
             j = starts_c[r] + jnp.arange(So)
             live = j < M
-            i = jnp.clip(jnp.searchsorted(offs, j, side="right"), 0,
-                         NL - 1)
-            base = jnp.take(offs, i) - jnp.take(rows, i)
+            e = jnp.clip(jnp.searchsorted(coffs, j, side="right"), 0,
+                         NE - 1)
+            src_e = jnp.take(ssrc, e)
+            pi = jnp.take(spidx, e)
+            q = j - (jnp.take(coffs, e) - jnp.take(sec, e))
+            i = jnp.clip(pi, 0, NL - 1)          # left emitter fields
+            rpos = jnp.clip(jnp.take(lo, i) + q, 0, NR - 1)
             matched = jnp.take(cnt, i) > 0
-            rpos = jnp.clip(jnp.take(lo, i) + (j - base), 0, NR - 1)
-            vals = produce(i, rpos, matched)
-            vals = jnp.where(live, vals.astype(dtype),
-                             jnp.zeros((), dtype))
+            lvals = produce_left(i, rpos, matched)
+            rvals = produce_right(jnp.clip(pi, 0, NR - 1))
+            vals = jnp.where(src_e == 0, lvals.astype(dtype),
+                             rvals.astype(dtype))
+            vals = jnp.where(live, vals, jnp.zeros((), dtype))
             return _pack_out_row(vals, live, layout, r)
 
         okrow = out_channel(ok_layout,
                             lambda i, rp, mt: jnp.take(LK, i),
-                            ok_dtype)
-        olrow = out_channel(ol_layout,
-                            lambda i, rp, mt: jnp.take(LV, i),
-                            ol_dtype)
+                            lambda jr_: jnp.take(RK, jr_), ok_dtype)
+        olrow = out_channel(
+            ol_layout, lambda i, rp, mt: jnp.take(LV, i),
+            lambda jr_: jnp.broadcast_to(fillv.astype(ol_dtype),
+                                         jr_.shape), ol_dtype)
         orrow = out_channel(
             or_layout,
             lambda i, rp, mt: jnp.where(
                 mt, jnp.take(RV, rp).astype(or_dtype),
                 fillv.astype(or_dtype)),
-            or_dtype)
+            lambda jr_: jnp.take(RV, jr_), or_dtype)
         return okrow, olrow, orrow, M
 
     # check_vma=False: ``M`` derives from the same all_gather'ed
@@ -726,6 +796,43 @@ def _partition_bounds(axis, r, kl, krow, nvr, p):
     return firsts, lasts, starts, ends
 
 
+def _outer_partition_bounds(axis, kl, krow, nvr, p, nl, Sl):
+    """The ``how="outer"`` repartition plan (docs/SPEC.md §17.1): the
+    inner plan's per-shard right windows EXTENDED so every real right
+    key has exactly ONE owning shard — the gap below shard ``d``'s
+    left range belongs to ``d`` (exclusive of ``lasts[d-1]``: a
+    boundary key spanning two left shards still replicates for
+    matching, but only its LOWER shard owns its unmatched emission —
+    vacuous, since a spanning key is matched), and everything above
+    the last real left key belongs to the LAST NONEMPTY left shard.
+    Empty left shards (always trailing — uniform ceil scratch) own
+    nothing and emit nothing.  The windows stay CONTIGUOUS global
+    slices, so the same ring scatter and rcap bound apply."""
+    firsts = lax.all_gather(kl[0], axis)               # (p,)
+    lasts = lax.all_gather(kl[Sl - 1], axis)
+    # static left geometry: per-shard valid counts and the last
+    # nonempty shard index (nl >= 1 on the partition route)
+    nvls = np.minimum(np.maximum(nl - np.arange(p) * Sl, 0), Sl)
+    last_ne = int(np.nonzero(nvls)[0].max())
+    ne = jnp.asarray(nvls > 0)
+    idx = jnp.arange(p)
+    lastprev = jnp.concatenate([lasts[:1], lasts[:-1]])
+    below_first = jnp.minimum(
+        jnp.searchsorted(krow, firsts, side="left"), nvr)
+    below_prev = jnp.minimum(
+        jnp.searchsorted(krow, lastprev, side="right"), nvr)
+    below = jnp.where(idx == 0, 0,
+                      jnp.minimum(below_first, below_prev))
+    thru = jnp.minimum(jnp.searchsorted(krow, lasts, side="right"),
+                       nvr)
+    thru = jnp.where(idx == last_ne, nvr, thru)
+    below = jnp.where(ne, below, 0)
+    thru = jnp.where(ne, thru, 0)
+    starts = lax.psum(below, axis)                     # (p,) global
+    ends = lax.psum(thru, axis)
+    return firsts, lasts, starts, ends, last_ne, ne
+
+
 def _mask_sorted_keys(kb, n, S, r):
     """Encode one sorted scratch key row and mask its pad tail to the
     big sentinel: ``(masked_enc, big, nvalid)``."""
@@ -740,14 +847,17 @@ def _last_real(kl, nvl, S):
 
 
 def _join_partition_probe_program(mesh, axis, llayout, lkdtype,
-                                  rlayout, rkdtype, nl, nr):
+                                  rlayout, rkdtype, nl, nr,
+                                  outer=False):
     """The repartition planner's ONE device round trip: per-shard
     right-partition windows ``(starts, ends)`` under the left key
     ranges — the host reads ``max(ends - starts)`` and keys the merge
     program on the pow2-quantized partition capacity (bounded
-    recompiles across key distributions)."""
+    recompiles across key distributions).  ``outer`` probes the
+    EXTENDED ownership windows (every real right key covered exactly
+    once — :func:`_outer_partition_bounds`)."""
     key = ("reljoinplan", pinned_id(mesh), axis, llayout, str(lkdtype),
-           rlayout, str(rkdtype), int(nl), int(nr),
+           rlayout, str(rkdtype), int(nl), int(nr), bool(outer),
            bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -760,8 +870,12 @@ def _join_partition_probe_program(mesh, axis, llayout, lkdtype,
         kl, _bigl, nvl = _mask_sorted_keys(lkb, nl, Sl, r)
         kl = kl.at[Sl - 1].set(_last_real(kl, nvl, Sl))
         krow, _bigr, nvr = _mask_sorted_keys(rkb, nr, Sr, r)
-        _f, _l, starts, ends = _partition_bounds(axis, r, kl, krow,
-                                                 nvr, p)
+        if outer:
+            _f, _l, starts, ends, _ln, _ne = _outer_partition_bounds(
+                axis, kl, krow, nvr, p, nl, Sl)
+        else:
+            _f, _l, starts, ends = _partition_bounds(axis, r, kl, krow,
+                                                     nvr, p)
         return starts, ends
 
     shm = jax.shard_map(body, mesh=mesh,
@@ -775,7 +889,8 @@ def _join_partition_probe_program(mesh, axis, llayout, lkdtype,
 def _join_partition_program(mesh, axis, llayout, lkdtype, lvdtype,
                             rlayout, rkdtype, rvdtype, ok_layout,
                             ok_dtype, ol_layout, ol_dtype, or_layout,
-                            or_dtype, nl, nr, left_outer, rcap):
+                            or_dtype, nl, nr, left_outer, rcap,
+                            right_outer=False):
     """Bounded-memory repartition sorted-merge (docs/SPEC.md §18.4,
     arXiv:2112.01075's recipe spent on the join's memory wall).  The
     broadcast program all_gathers BOTH sorted sides onto every device
@@ -796,7 +911,7 @@ def _join_partition_program(mesh, axis, llayout, lkdtype, lvdtype,
            str(lvdtype), rlayout, str(rkdtype), str(rvdtype),
            ok_layout, str(ok_dtype), ol_layout, str(ol_dtype),
            or_layout, str(or_dtype), int(nl), int(nr),
-           bool(left_outer), int(rcap),
+           bool(left_outer), bool(right_outer), int(rcap),
            bool(jax.config.jax_enable_x64))
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -804,6 +919,140 @@ def _join_partition_program(mesh, axis, llayout, lkdtype, lvdtype,
 
     p, Sl, *_ = working_geometry(llayout)
     _, Sr, *_ = working_geometry(rlayout)
+
+    def body_outer(lkb, lvb, rkb, rvb, fillv):
+        """The ``how="outer"`` repartition merge (§17.1): the inner
+        body's machinery with (a) the EXTENDED ownership windows
+        (:func:`_outer_partition_bounds` — every real right key lands
+        in exactly one shard's partition for unmatched emission, on
+        top of the match-range replication), (b) a raw right-key
+        channel riding the ring (unmatched rows emit their key
+        bit-exactly, no decode round trip), and (c) the combined
+        (key, source, position) emitter sort of the broadcast outer
+        body, partition-local — global order follows because shard
+        windows tile the key space in order."""
+        r = lax.axis_index(axis)
+        lkraw = lkb[0]
+        lv = lvb[0]
+        klq, _bigl, nvl = _mask_sorted_keys(lkb, nl, Sl, r)
+        # the RANGE row ends at the last REAL key (the §18.4 memory
+        # bound); the QUERY/match base keeps the sorted masked row
+        kl = klq.at[Sl - 1].set(_last_real(klq, nvl, Sl))
+        krow, bigr, nvr = _mask_sorted_keys(rkb, nr, Sr, r)
+        firsts, lasts, starts, ends, last_ne, ne = \
+            _outer_partition_bounds(axis, kl, krow, nvr, p, nl, Sl)
+        start_me = starts[r]
+        end_me = ends[r]
+        size_me = end_me - start_me
+
+        rbk0 = jnp.full((rcap,), bigr, krow.dtype)
+        rbraw0 = jnp.zeros((rcap,), rkb.dtype)
+        rbv0 = jnp.zeros((rcap,), rvb.dtype)
+
+        def scatter(t, carry, blocks):
+            bk, braw, bv = blocks
+            s = (r - t) % p
+            g = s * Sr + jnp.arange(Sr)
+            # POSITION-window membership: on sorted data the global
+            # slice [start_me, end_me) IS the extended key predicate
+            inw = (g < nr) & (g >= start_me) & (g < end_me)
+            idx = jnp.where(inw, g - start_me, rcap)
+            return (carry[0].at[idx].set(bk, mode="drop"),
+                    carry[1].at[idx].set(braw, mode="drop"),
+                    carry[2].at[idx].set(bv, mode="drop"))
+
+        rbk, rbraw, rbv = ring_pipeline(
+            axis, p, (rbk0, rbraw0, rbv0),
+            (krow, rkb[0], rvb[0]), scatter)
+
+        # --- left-row match counts on my partition (the inner body's
+        # shape; searchsorted finds the matching window by KEY, so the
+        # extra ownership rows at the partition's edges are inert)
+        lvalid = jnp.arange(Sl) < nvl
+        lo = jnp.minimum(jnp.searchsorted(rbk, kl, side="left"),
+                         size_me)
+        hi = jnp.minimum(jnp.searchsorted(rbk, kl, side="right"),
+                         size_me)
+        cnt = jnp.where(lvalid, (hi - lo).astype(jnp.int32), 0)
+        rows = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)  # left outer
+
+        # --- unmatched OWNED right rows in my partition: matched-ness
+        # is decidable locally (an owned key inside my left range is
+        # present in MY block iff it is present at all; an owned key
+        # outside it — gap below, tail above — matches nowhere)
+        tpos = jnp.arange(rcap)
+        in_part = tpos < size_me
+        lo_l = jnp.minimum(jnp.searchsorted(klq, rbk, side="left"),
+                           nvl)
+        hi_l = jnp.minimum(jnp.searchsorted(klq, rbk, side="right"),
+                           nvl)
+        own_lo = jnp.take(lasts, jnp.maximum(r - 1, 0))
+        owned = in_part & jnp.take(ne, r) \
+            & ((r == 0) | (rbk > own_lo)) \
+            & ((r == last_ne) | (rbk <= jnp.take(lasts, r)))
+        rrows = jnp.where(owned & (hi_l == lo_l), 1, 0) \
+            .astype(jnp.int32)
+
+        # --- combined emitter sort, partition-local (the broadcast
+        # outer body's (key, source, position) order)
+        NE = Sl + rcap
+        K = jnp.concatenate([klq, rbk])
+        SRC = jnp.concatenate([jnp.zeros(Sl, jnp.int32),
+                               jnp.ones(rcap, jnp.int32)])
+        PIDX = jnp.concatenate([jnp.arange(Sl, dtype=jnp.int32),
+                                jnp.arange(rcap, dtype=jnp.int32)])
+        EC = jnp.concatenate([rows.astype(jnp.int32), rrows])
+        _ks, ssrc, spidx, sec = lax.sort((K, SRC, PIDX, EC),
+                                         dimension=0, num_keys=3)
+        coffs = jnp.cumsum(sec)                       # local inclusive
+        my_total = coffs[NE - 1]
+        totals = lax.all_gather(my_total, axis)       # (p,)
+        ctot = jnp.cumsum(totals)
+        base_me = ctot[r] - my_total
+        M = ctot[p - 1]
+
+        def out_channel(layout, produce_left, produce_right, dtype):
+            So, starts_c, _sizes = _dest_geometry(layout)
+            j = starts_c[:, None] + jnp.arange(So)[None, :]
+            mine = (j >= base_me) & (j < base_me + my_total)
+            jl = j - base_me
+            e = jnp.clip(jnp.searchsorted(coffs, jl, side="right"),
+                         0, NE - 1)
+            src_e = jnp.take(ssrc, e)
+            pi = jnp.take(spidx, e)
+            q = jl - (jnp.take(coffs, e) - jnp.take(sec, e))
+            i = jnp.clip(pi, 0, Sl - 1)
+            rpos = jnp.clip(jnp.take(lo, i) + q, 0, rcap - 1)
+            matched = jnp.take(cnt, i) > 0
+            lvals = produce_left(i, rpos, matched)
+            rvals = produce_right(jnp.clip(pi, 0, rcap - 1))
+            vals = jnp.where(src_e == 0, lvals.astype(dtype),
+                             rvals.astype(dtype))
+            send = jnp.where(mine, vals, jnp.zeros((), dtype))
+            recv = lax.all_to_all(send, axis, 0, 0)   # row s = from s
+            jt = starts_c[r] + jnp.arange(So)
+            ps = jnp.clip(jnp.searchsorted(ctot, jt, side="right"),
+                          0, p - 1)
+            got = jnp.take_along_axis(recv, ps[None, :], axis=0)[0]
+            live = jt < M
+            got = jnp.where(live, got, jnp.zeros((), dtype))
+            return _pack_out_row(got, live, layout, r)
+
+        okrow = out_channel(ok_layout,
+                            lambda i, rp, mt: jnp.take(lkraw, i),
+                            lambda jr_: jnp.take(rbraw, jr_),
+                            ok_dtype)
+        olrow = out_channel(
+            ol_layout, lambda i, rp, mt: jnp.take(lv, i),
+            lambda jr_: jnp.broadcast_to(fillv.astype(ol_dtype),
+                                         jr_.shape), ol_dtype)
+        orrow = out_channel(
+            or_layout,
+            lambda i, rp, mt: jnp.where(
+                mt, jnp.take(rbv, rp).astype(or_dtype),
+                fillv.astype(or_dtype)),
+            lambda jr_: jnp.take(rbv, jr_), or_dtype)
+        return okrow, olrow, orrow, M
 
     def body(lkb, lvb, rkb, rvb, fillv):
         r = lax.axis_index(axis)
@@ -906,7 +1155,7 @@ def _join_partition_program(mesh, axis, llayout, lkdtype, lvdtype,
 
     # check_vma=False: ``M`` folds the same all_gather'ed totals
     # identically on every shard (the broadcast program's precedent)
-    shm = jax.shard_map(body, mesh=mesh,
+    shm = jax.shard_map(body_outer if right_outer else body, mesh=mesh,
                         in_specs=(P(axis, None),) * 4 + (P(),),
                         out_specs=(P(axis, None),) * 3 + (P(),),
                         check_vma=False)
@@ -963,9 +1212,12 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
                      n_left=lkc.n, n_right=rkc.n)
     m = -1
     try:
-        if lkc.n == 0 or (how == "inner" and rkc.n == 0):
+        if (lkc.n == 0 and not (how == "outer" and rkc.n > 0)) \
+                or (how == "inner" and rkc.n == 0):
             # no left rows (or inner against an empty right): zero
-            # rows — zero the outputs so the tail contract holds
+            # rows — zero the outputs so the tail contract holds.  An
+            # OUTER join with an empty left but a nonempty right falls
+            # through: the union program emits every right row filled
             from .elementwise import fill as _fill
             t0 = _obs.now()
             for oc in (out_keys, out_lv, out_rv):
@@ -985,6 +1237,8 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
         # above the threshold the merge re-homes on the bounded-memory
         # repartition exchange — each device merges only its own left
         # block against the probed, rcap-bounded right partition
+        left_outer = how in ("left", "outer")
+        right_outer = how == "outer"
         use_partition = (p_sh > 1 and nl > 0 and nr > 0
                          and nl + nr > _broadcast_max())
         if use_partition:
@@ -992,7 +1246,7 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
             fire_ppermute(what="join.partition")
             probe = _join_partition_probe_program(
                 rt.mesh, rt.axis, slk.layout, slk.dtype,
-                srk.layout, srk.dtype, nl, nr)
+                srk.layout, srk.dtype, nl, nr, outer=right_outer)
             starts, ends = probe(slk._data, srk._data)
             part = np.asarray(ends) - np.asarray(starts)
             mx = max(int(part.max(initial=0)), 1)
@@ -1009,7 +1263,7 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
                 okc.cont.layout, okc.cont.dtype,
                 olc.cont.layout, olc.cont.dtype,
                 orc.cont.layout, orc.cont.dtype,
-                nl, nr, how == "left", rcap)
+                nl, nr, left_outer, rcap, right_outer=right_outer)
             _set_join_route(impl="partition", nl=nl, nr=nr,
                             nshards=p_sh, rcap=rcap,
                             gathered_rows_per_device=Sl + rcap)
@@ -1021,7 +1275,7 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
                 okc.cont.layout, okc.cont.dtype,
                 olc.cont.layout, olc.cont.dtype,
                 orc.cont.layout, orc.cont.dtype,
-                nl, nr, how == "left")
+                nl, nr, left_outer, right_outer=right_outer)
             _set_join_route(impl="broadcast", nl=nl, nr=nr,
                             nshards=p_sh,
                             gathered_rows_per_device=p_sh * (Sl + Sr))
@@ -1052,7 +1306,11 @@ def join(left_keys, left_values, right_keys, right_values, out_keys,
     exactly pandas ``merge`` row multiplicity.  ``how="left"`` /
     ``"right"`` additionally emit every unmatched row of that side
     with ``fill`` on the missing value column (presence flags);
-    ``how="inner"`` is the default.  Non-mutating in the inputs; the
+    ``how="outer"`` emits the UNION — unmatched rows of BOTH sides,
+    ``fill`` on whichever value column is absent, interleaved in key
+    order (a key present on both sides has no unmatched rows, so the
+    ordering contract stays total); ``how="inner"`` is the default.
+    Non-mutating in the inputs; the
     three whole-container outputs share one capacity, positions
     ``>= count`` are zero.  Returns the row count (lazy
     :class:`DeferredCount` inside ``dr_tpu.deferred()``, where the op

@@ -1,24 +1,36 @@
 """dr_tpu.serve — one resident device claim, crash-safe multi-client
-serving (docs/SPEC.md §14).
+serving (docs/SPEC.md §14), on a zero-copy horizontally-scaled data
+plane (§19).
 
 The tunnel relay allows exactly ONE TPU process; this package makes
 that process a long-lived daemon (:class:`Server`) that claims the
 backend once and multiplexes request streams from many thin
 :class:`Client` processes over a local Unix-domain socket —
 length-prefixed JSON/npy wire protocol (``protocol``), admission
-control + deadline-aware FIFO (``queue``), request batching into one
-deferred-plan flush, classified error serialization, and a watchdog
-that degrades the claim to the CPU route when the relay dies
-mid-session.  ``python -m dr_tpu.serve`` runs the daemon foreground.
+control + weighted-fair tenant scheduling (``queue``), request
+batching into one deferred-plan flush, classified error
+serialization, and a watchdog that degrades the claim to the CPU
+route when the relay dies mid-session.  The data plane (§19) moves
+bulk tensors through a shared-memory arena (``arena`` — the frame
+carries metadata plus a handle, bytes move once), parks per-tenant
+resident containers on the daemon (``resident`` + :class:`Ref`, no
+per-request rebuild), and scales horizontally with N replicas behind
+a consistent-hash router (``router``).  ``python -m dr_tpu.serve``
+runs one daemon foreground.
 """
 
-from .client import Client
+from .arena import Arena, ClientArena
+from .client import Client, Ref
 from .daemon import (OPS, Server, daemon_alive, default_socket_path,
                      reset_state)
 from .queue import AdmissionQueue, Request
+from .resident import ResidentCache
+from .router import HashRing, Router, RouterClient
 
-__all__ = ["Server", "Client", "AdmissionQueue", "Request", "OPS",
-           "daemon_alive", "default_socket_path", "reset"]
+__all__ = ["Server", "Client", "Ref", "AdmissionQueue", "Request",
+           "OPS", "Arena", "ClientArena", "ResidentCache", "HashRing",
+           "Router", "RouterClient", "daemon_alive",
+           "default_socket_path", "reset"]
 
 
 def reset() -> None:

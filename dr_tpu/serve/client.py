@@ -35,9 +35,33 @@ import numpy as np
 
 from ..utils import resilience
 from ..utils.env import env_float, env_int
+from . import arena as _arena
 from . import protocol
 
-__all__ = ["Client"]
+__all__ = ["Client", "Ref"]
+
+#: control ops that never stage payloads through the arena (they have
+#: none, or they ARE the arena's own lease/release round trips)
+_CONTROL_OPS = frozenset(
+    ("ping", "stats", "shutdown", "arena_alloc", "arena_release"))
+
+
+class Ref:
+    """A resident-container reference (docs/SPEC.md §19.2): pass in
+    place of an array operand and the daemon substitutes the tenant's
+    cached container — no payload on the wire, no container rebuild::
+
+        c.put("features", x)
+        c.reduce(Ref("features"))     # zero-copy repeat op
+    """
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = str(name)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Ref({self.name!r})"
 
 
 class Client:
@@ -50,7 +74,8 @@ class Client:
     def __init__(self, path: Optional[str] = None, *,
                  timeout: Optional[float] = None,
                  tenant: str = "default",
-                 retries: Optional[int] = None):
+                 retries: Optional[int] = None,
+                 arena: Optional[bool] = None):
         from .daemon import default_socket_path
         self.path = path or default_socket_path()
         self.tenant = tenant
@@ -60,13 +85,37 @@ class Client:
         self._timeout = (env_float("DR_TPU_SERVE_DEADLINE", 30.0) + 10.0
                          if timeout is None else timeout)
         self._sock = None
+        # shared-memory arena (docs/SPEC.md §19.1): None = auto (use
+        # it when the daemon advertises one and a payload clears the
+        # min-bytes floor), False = inline wire always.  Attachment is
+        # lazy — a ping discovers the segment on first need.
+        self._arena_want = (env_int("DR_TPU_SERVE_ARENA", 1,
+                                    floor=0) != 0
+                            if arena is None else bool(arena))
+        self._arena_min = env_int("DR_TPU_SERVE_ARENA_MIN_BYTES",
+                                  1 << 16)
+        self._arena: Optional[_arena.ClientArena] = None
+        self._arena_state = "unknown"  # unknown | on | off
+        self._pending_release: list = []
         self._connect()
+        if arena:  # explicit opt-in attaches eagerly (big REPLIES
+            # can ride the arena even when no request payload does)
+            self._ensure_arena()
 
     def _connect(self) -> None:
         """(Re)open the daemon connection; classified on failure.  A
         refused/absent socket is ``RelayDownError`` — the daemon is
         this client's relay, and retrying a dead one burns budget."""
         self._broken = None  # set to a reason once the conn desyncs
+        # reply slots owed from the OLD connection free at the
+        # daemon's disconnect teardown — releasing them on a fresh
+        # connection would double-free a recycled slot
+        self._pending_release = []
+        # re-arm arena discovery: a reconnect after an invalidation
+        # (whose close() detached the segment) must not leave a
+        # long-lived retrying client on the inline wire forever
+        if self._arena is None:
+            self._arena_state = "unknown"
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.settimeout(self._timeout)
         try:
@@ -92,6 +141,10 @@ class Client:
         self.close()
 
     def close(self) -> None:
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None
+            self._arena_state = "off"
         if self._sock is None:
             return
         try:
@@ -135,16 +188,108 @@ class Client:
                       resilience.ServerOverloaded),
             deadline_s=deadline_s)
 
+    # ------------------------------------------------------- arena plumbing
+    def _ensure_arena(self) -> None:
+        """Discover + attach the daemon's arena once (lazy: the first
+        payload that clears the min-bytes floor pays the one ping).
+        Any failure turns the arena OFF for this client — inline wire,
+        full function, counted fallback."""
+        if self._arena_state != "unknown":
+            return
+        self._arena_state = "off"
+        try:
+            info = self._request_once("ping").get("arena")
+            if info:
+                self._arena = _arena.ClientArena(str(info["name"]),
+                                                 int(info["size"]))
+                self._arena_state = "on"
+        except resilience.ResilienceError:
+            raise  # connection-level failures are real errors
+        except Exception as e:
+            _arena.note_fallback(f"client attach failed ({e!r}); "
+                                 "inline wire")
+
+    def _stage_arena(self, op, arrays):
+        """Split a request's payloads between the arena and the inline
+        wire: big payloads lease slots (one small ``arena_alloc``
+        round trip), write their npy bytes ONCE into shared memory,
+        and ride the header as handles; everything else stays inline.
+        Any arena failure (exhaustion transient, overload) falls back
+        to fully-inline for THIS request."""
+        if (op in _CONTROL_OPS or not self._arena_want
+                or not arrays):
+            return arrays, None
+        sizes = [np.asarray(a).nbytes for a in arrays]
+        big = [i for i, nb in enumerate(sizes)
+               if nb >= self._arena_min]
+        if not big:
+            return arrays, None
+        self._ensure_arena()
+        if self._arena is None:
+            return arrays, None
+        payloads = {i: _arena.npy_bytes(arrays[i]) for i in big}
+        try:
+            slots = self._request_once(
+                "arena_alloc",
+                params={"nbytes": [len(payloads[i]) for i in big]}
+            )["slots"]
+        except (resilience.TransientBackendError,
+                resilience.ServerOverloaded) as e:
+            _arena.note_fallback(f"lease failed ({type(e).__name__}); "
+                                 "inline wire for this request")
+            return arrays, None
+        entries = [None] * len(arrays)
+        for i, handle in zip(big, slots):
+            entries[i] = self._arena.write(handle, payloads[i])
+        inline = [a for i, a in enumerate(arrays) if i not in set(big)]
+        return inline, entries
+
+    def _read_reply_arena(self, reply, rarrays):
+        """Merge a reply's inline payloads with its arena results; the
+        mapped handles queue for release (piggybacked on the next
+        frame — the daemon's disconnect teardown covers the rest)."""
+        entries = reply.get("arena_results")
+        if entries is None:
+            return rarrays
+        if self._arena is None:
+            raise resilience.ProgramError(
+                "serve: daemon sent arena results to a client without "
+                "an attached arena", site="arena.map")
+        it = iter(rarrays)
+        merged = []
+        for e in entries:
+            if e is None:
+                merged.append(next(it))
+            else:
+                merged.append(self._arena.read(e))
+                self._pending_release.append(
+                    {"slot": e["slot"], "generation": e["generation"]})
+        return merged
+
     def _request_once(self, op, arrays=(), params=None, *,
                       deadline_s=None, tenant=None):
         if self._broken:
             raise resilience.TransientBackendError(
                 f"serve: connection invalidated ({self._broken}); "
                 "reconnect to resubmit", site="serve.request")
+        header = {"op": op, "params": params or {},
+                  "tenant": tenant or self.tenant}
+        arrays = list(arrays)
+        if any(isinstance(a, Ref) for a in arrays):
+            header["refs"] = [a.name if isinstance(a, Ref) else None
+                              for a in arrays]
+            arrays = [a for a in arrays if not isinstance(a, Ref)]
+        arrays, entries = self._stage_arena(op, arrays)
+        if entries is not None:
+            header["arena"] = entries
+        if self._arena is not None and op not in _CONTROL_OPS:
+            header["arena_ok"] = True
+        if self._pending_release and op != "arena_alloc":
+            header["arena_release"] = self._pending_release
+            self._pending_release = []
         self._next_id += 1
         rid = self._next_id
-        header = {"op": op, "params": params or {},
-                  "tenant": tenant or self.tenant, "id": rid}
+        header["id"] = rid
         if deadline_s is not None:
             header["deadline_s"] = deadline_s
         try:
@@ -181,6 +326,7 @@ class Client:
                 "open a fresh Client", site="serve.request")
         if not reply.get("ok", False):
             protocol.raise_error(reply)
+        rarrays = self._read_reply_arena(reply, rarrays)
         if "scalar" in reply:
             return float(reply["scalar"])
         if rarrays:
@@ -217,6 +363,29 @@ class Client:
 
     def shutdown(self) -> dict:
         return self.request("shutdown")
+
+    # ------------------------------------- resident cache (§19.2)
+    def put(self, name: str, x, **kw) -> dict:
+        """Park ``x`` as this tenant's resident container ``name`` on
+        the daemon — built once, referenced by :class:`Ref` in later
+        ops (zero payload, no rebuild).  Returns ``{"handle", "tag",
+        "bytes", "cached"}``; ``cached`` True means identical content
+        was already resident."""
+        return self.request("put", [x], {"name": str(name)}, **kw)
+
+    def get(self, name: str, **kw) -> np.ndarray:
+        """Read a resident container back."""
+        return self.request("get", params={"name": str(name)}, **kw)
+
+    def drop(self, name: str, **kw) -> dict:
+        """Evict a resident container (idempotent — the reply says
+        whether anything was dropped)."""
+        return self.request("drop", params={"name": str(name)}, **kw)
+
+    def arena_active(self) -> bool:
+        """True once this client is attached to the daemon's
+        shared-memory arena (diagnostic)."""
+        return self._arena is not None
 
     def fill(self, n: int, value: float = 0.0, **kw) -> np.ndarray:
         return self.request("fill", params={"n": int(n),

@@ -80,8 +80,10 @@ from ..utils import faults as _faults
 from ..utils import resilience
 from ..utils.env import env_float, env_int, env_str
 from ..utils.fallback import warn_fallback
+from . import arena as _arena
 from . import protocol
 from .queue import AdmissionQueue, Request
+from .resident import ResidentCache, ResidentStub
 
 #: always-live per-request latency split (dr_tpu/obs metrics, SPEC
 #: §15): queue-wait (submit → dispatch pop), service (dispatch pop →
@@ -91,6 +93,28 @@ from .queue import AdmissionQueue, Request
 _h_queue_wait = _om.histogram("serve.queue_wait_ms")
 _h_service = _om.histogram("serve.service_ms")
 _h_flush = _om.histogram("serve.flush_ms")
+
+
+#: distinct tenants granted their own histogram pair; past the cap
+#: further names fold into one overflow bucket — tenant ids are
+#: client-supplied strings, and per-request ids must not grow the
+#: metrics registry (serialized on every stats op) without bound
+_TENANT_HIST_CAP = 64
+_tenant_hist_keys: set = set()
+
+
+def _h_tenant(kind: str, tenant: str):
+    """Per-tenant latency histogram (``serve.<kind>_ms.t.<tenant>``,
+    docs/SPEC.md §19.4): the numbers that make weighted-fair isolation
+    VISIBLE — a heavy tenant's queue-wait dilates, a light tenant's
+    stays flat.  Registry get-or-create is one dict lookup; names
+    beyond the first ``_TENANT_HIST_CAP`` distinct tenants share the
+    ``__other__`` bucket."""
+    if tenant not in _tenant_hist_keys:
+        if len(_tenant_hist_keys) >= _TENANT_HIST_CAP:
+            tenant = "__other__"
+        _tenant_hist_keys.add(tenant)
+    return _om.histogram(f"serve.{kind}_ms.t.{tenant}")
 
 __all__ = ["Server", "default_socket_path", "daemon_alive",
            "reset_state", "OPS"]
@@ -150,8 +174,21 @@ class _OpSpec:
         self.validate = validate
 
 
-def _vec(arr):
+def _vec(arr, mutate=False):
+    """Operand to container: a resident reference (intake substituted
+    a :class:`ResidentStub`) resolves to the CACHED container — no
+    rebuild, no host→device transfer; a handler that MUTATES its
+    operand gets a device-side scratch copy instead (the cache entry
+    must keep answering later requests unchanged).  Plain arrays build
+    fresh, as ever."""
     import dr_tpu
+    cont = getattr(arr, "_dr_resident", None)
+    if cont is not None:
+        if not mutate:
+            return cont
+        scratch = dr_tpu.distributed_vector(len(cont), cont.dtype)
+        dr_tpu.copy(cont, scratch)
+        return scratch
     return dr_tpu.distributed_vector.from_array(
         np.ascontiguousarray(np.asarray(arr, np.float32)))
 
@@ -172,7 +209,7 @@ def _h_fill(req):
 
 def _h_scale(req):
     import dr_tpu
-    v = _vec(req.arrays[0])
+    v = _vec(req.arrays[0], mutate=True)
     dr_tpu.for_each(v, _op_scale, float(req.params.get("a", 1.0)),
                     float(req.params.get("b", 0.0)))
     return lambda: ({}, [dr_tpu.to_numpy(v)])
@@ -218,7 +255,7 @@ def _h_scan(req):
 
 def _h_sort(req):
     import dr_tpu
-    v = _vec(req.arrays[0])
+    v = _vec(req.arrays[0], mutate=True)
     dr_tpu.sort(v, descending=bool(req.params.get("descending", False)))
     return lambda: ({}, [dr_tpu.to_numpy(v)])
 
@@ -352,6 +389,47 @@ def _h_histogram(req):
     return lambda: ({}, [dr_tpu.to_numpy(out)])
 
 
+# --- resident container cache (docs/SPEC.md §19.2): put builds the
+# tenant's container ONCE on the dispatch thread; later ops reference
+# it by name (header refs) and skip the rebuild; get/drop read back /
+# evict.  All three run solo — put/get move whole payloads and must
+# not dilate their batchmates' fused flush.
+
+def _name_of(req) -> str:
+    return str(req.params["name"])
+
+
+def _v_named(req):
+    if not str(req.params.get("name", "")):
+        raise resilience.ProgramError(
+            f"serve: op {req.op!r} needs a nonempty params.name",
+            site="serve.request")
+
+
+def _v_put(req):
+    _v_named(req)
+    _v_vector(req)
+
+
+def _h_put(req):
+    entry, cached = req.server._resident.put(req.tenant, _name_of(req),
+                                             req.arrays[0])
+    return lambda: ({"handle": _name_of(req), "tag": entry.tag,
+                     "bytes": entry.nbytes, "cached": cached}, [])
+
+
+def _h_get(req):
+    import dr_tpu
+    entry = req.server._resident.require(req.tenant, _name_of(req))
+    arr = dr_tpu.to_numpy(entry.cont)
+    return lambda: ({"tag": entry.tag}, [arr])
+
+
+def _h_drop(req):
+    dropped = req.server._resident.drop(req.tenant, _name_of(req))
+    return lambda: ({"dropped": dropped}, [])
+
+
 #: op name -> (operand count, batchable into one deferred flush?).
 #: sort is NON-fusible (it would force the plan-flush cliff) and the
 #: relational join/groupby/unique record OPAQUE with data-dependent
@@ -370,6 +448,9 @@ OPS = {
     "topk": _OpSpec("topk", 1, True, _h_topk, _v_topk),
     "histogram": _OpSpec("histogram", 1, True, _h_histogram,
                          _v_histogram),
+    "put": _OpSpec("put", 1, False, _h_put, _v_put),
+    "get": _OpSpec("get", 0, False, _h_get, _v_named),
+    "drop": _OpSpec("drop", 0, False, _h_drop, _v_named),
 }
 
 
@@ -390,9 +471,12 @@ class _Conn:
 #: live in-process servers (tests/bench); serve.reset() stops leaks
 _live_servers: "weakref.WeakSet" = weakref.WeakSet()
 
-#: the env markers the daemon publishes for degradation_story
+#: the env markers the daemon publishes for degradation_story (the
+#: router markers are published by serve/router.py — cleared here so
+#: one test's dead-replica story cannot leak into the next)
 _MARKERS = ("_DR_TPU_SERVE_DEGRADED", "_DR_TPU_SERVE_QUEUE_DEPTH",
-            "_DR_TPU_SERVE_SHED", "_DR_TPU_SERVE_RESTARTS")
+            "_DR_TPU_SERVE_SHED", "_DR_TPU_SERVE_RESTARTS",
+            "_DR_TPU_SERVE_ROUTER_DEAD", "_DR_TPU_SERVE_ROUTER_REASON")
 
 
 def reset_state() -> None:
@@ -458,6 +542,13 @@ class Server:
                                if flush_deadline is None
                                else float(flush_deadline))
         self.default_deadline = env_float("DR_TPU_SERVE_DEADLINE", 30.0)
+        # serving data plane (docs/SPEC.md §19): the shared-memory
+        # arena (created at start; None = inline-wire only) and the
+        # per-tenant resident container cache
+        self.arena_min = env_int("DR_TPU_SERVE_ARENA_MIN_BYTES",
+                                 1 << 16)
+        self._arena = None
+        self._resident = ResidentCache()
         self._queue = AdmissionQueue(self.queue_depth, self.tenant_cap)
         self._stop = threading.Event()
         self._stopped = threading.Event()
@@ -491,6 +582,18 @@ class Server:
         # the device claim; daemon_alive treats bound-but-claiming as
         # alive, so the newcomer still refuses classified
         self._refuse_or_takeover()
+        # the shared-memory arena (docs/SPEC.md §19.1) is pure host
+        # state: created before the claim, destroyed at stop.  A host
+        # without usable shared memory degrades to the inline wire —
+        # the arena is an optimization, never a dependency.
+        if env_int("DR_TPU_SERVE_ARENA", 1, floor=0):
+            try:
+                self._arena = _arena.Arena()
+            except Exception as e:
+                warn_fallback("serve", f"shared-memory arena "
+                                       f"unavailable ({e!r}); serving "
+                                       "on the inline wire only")
+                self._arena = None
         self._bind()
         try:
             self._claim()
@@ -598,6 +701,13 @@ class Server:
             if t is not threading.current_thread():
                 t.join(timeout=5.0)
         self._threads = []
+        # data-plane teardown: the arena segment is unlinked (a dead
+        # daemon must not leak /dev/shm) and the resident cache is
+        # dropped so its containers release device memory
+        if self._arena is not None:
+            self._arena.destroy()
+            self._arena = None
+        self._resident.clear()
         if self._bound:
             # only the daemon that BOUND the socket may unlink it: a
             # stop() after a refused start (the bench/tests
@@ -683,22 +793,127 @@ class Server:
                 req.cancelled = True
             if pending:
                 self._cancelled += len(pending)
+            if self._arena is not None:
+                # a crashed client's leases (request slots it never
+                # sent, reply slots it never released) free wholesale
+                self._arena.release_owner(cs)
             try:
                 cs.sock.close()
             except OSError:  # pragma: no cover - already closed
                 pass
 
+    def _arena_required(self):
+        if self._arena is None:
+            raise resilience.TransientBackendError(
+                "serve: this daemon runs without a shared-memory "
+                "arena — use the inline wire", site="arena.map")
+        return self._arena
+
+    def _merge_operands(self, cs: _Conn, header: dict, arrays,
+                        tenant: str):
+        """Assemble a request's logical operand list from the three
+        transports (docs/SPEC.md §19.1-.2): inline wire payloads,
+        arena handles (``header["arena"]`` — mapped, then released:
+        the bytes are copied out at intake), and resident references
+        (``header["refs"]`` — resolved to stubs carrying the cached
+        container, so the handler skips the rebuild)."""
+        entries = header.get("arena")
+        if entries is not None:
+            ar = self._arena_required()
+            it = iter(arrays)
+            wire = []
+            for e in entries:
+                if e is None:
+                    wire.append(next(it, None))
+                else:
+                    wire.append(ar.map(e))
+                    ar.release(e)
+            if any(w is None for w in wire):
+                raise resilience.ProgramError(
+                    "serve: frame carries fewer inline payloads than "
+                    "its arena map declares", site="arena.map")
+            arrays = wire
+        refs = header.get("refs")
+        if refs is not None:
+            it = iter(arrays)
+            out = []
+            for r in refs:
+                if r is None:
+                    out.append(next(it, None))
+                else:
+                    out.append(ResidentStub(
+                        self._resident.require(tenant, str(r))))
+            if any(a is None for a in out):
+                raise resilience.ProgramError(
+                    "serve: frame carries fewer payloads than its "
+                    "refs list declares", site="serve.request")
+            arrays = out
+        return arrays
+
     def _handle_frame(self, cs: _Conn, header: dict, arrays) -> bool:
         """One request frame; returns False to close the connection."""
         op = str(header.get("op", ""))
         rid = header.get("id")
+        rel = header.get("arena_release")
+        if rel:
+            # piggybacked releases from the client's last reply — a
+            # bad handle is the client's deterministic bug, serialized
+            # back before the op can run
+            try:
+                ar = self._arena_required()
+                for h in rel:
+                    ar.release(h)
+            except Exception as e:
+                self._errors += 1
+                self._send(cs, protocol.error_header(
+                    resilience.classified(e, site="arena.release"),
+                    id=rid))
+                return True
         if op == "ping":
-            self._send(cs, {"ok": True, "pong": True, "pid": os.getpid(),
-                            "id": rid})
+            hdr = {"ok": True, "pong": True, "pid": os.getpid(),
+                   "id": rid}
+            if self._arena is not None:
+                hdr["arena"] = {"name": self._arena.name,
+                                "size": self._arena.size}
+            self._send(cs, hdr)
             return True
         if op == "stats":
             self._send(cs, {"ok": True, "stats": self.stats(),
                             "id": rid})
+            return True
+        if op == "arena_alloc":
+            try:
+                ar = self._arena_required()
+                sizes = (header.get("params") or {}).get("nbytes", [])
+                slots = []
+                try:
+                    for nb in sizes:
+                        slots.append(ar.alloc(int(nb), owner=cs))
+                except BaseException:
+                    for h in slots:  # all-or-nothing lease
+                        ar.release(h)
+                    raise
+                self._send(cs, {"ok": True, "id": rid, "slots": slots})
+            except Exception as e:
+                self._errors += 1
+                self._send(cs, protocol.error_header(
+                    resilience.classified(e, site="arena.map"),
+                    id=rid))
+            return True
+        if op == "arena_release":
+            try:
+                ar = self._arena_required()
+                handles = (header.get("params") or {}).get("handles",
+                                                           [])
+                for h in handles:
+                    ar.release(h)
+                self._send(cs, {"ok": True, "id": rid,
+                                "released": len(handles)})
+            except Exception as e:
+                self._errors += 1
+                self._send(cs, protocol.error_header(
+                    resilience.classified(e, site="arena.release"),
+                    id=rid))
             return True
         if op == "shutdown":
             self._send(cs, {"ok": True, "stopping": True, "id": rid})
@@ -713,15 +928,20 @@ class Server:
                 raise resilience.ProgramError(
                     f"serve: unknown op {op!r} (known: "
                     f"{', '.join(sorted(OPS))})", site="serve.request")
+            tenant = str(header.get("tenant", "default"))
+            arrays = self._merge_operands(cs, header, arrays, tenant)
             if len(arrays) != spec.narrays:
                 raise resilience.ProgramError(
                     f"serve: op {op!r} takes {spec.narrays} array(s), "
                     f"got {len(arrays)}", site="serve.request")
             deadline = header.get("deadline_s", self.default_deadline)
             req = Request(op, header.get("params"), arrays,
-                          tenant=str(header.get("tenant", "default")),
+                          tenant=tenant,
                           deadline_s=(None if deadline is None
                                       else float(deadline)), rid=rid)
+            req.server = self
+            req.arena_ok = bool(header.get("arena_ok")) \
+                and self._arena is not None
             if spec.validate is not None:
                 spec.validate(req)
             req.conn = cs
@@ -807,7 +1027,9 @@ class Server:
         for req in group:
             if req.t_exec is None:
                 req.t_exec = t_exec
-                _h_queue_wait.observe((t_exec - req.t_submit) * 1e3)
+                qw_ms = (t_exec - req.t_submit) * 1e3
+                _h_queue_wait.observe(qw_ms)
+                _h_tenant("queue_wait", req.tenant).observe(qw_ms)
                 if req.span:
                     _obs.complete("serve.queue_wait", req.t0_ns,
                                   cat="serve", parent=req.span)
@@ -1057,7 +1279,9 @@ class Server:
         if req.t_exec is not None:
             # service = dispatch start → reply posted (shed requests
             # never executed, so they carry no service sample)
-            _h_service.observe((time.monotonic() - req.t_exec) * 1e3)
+            sv_ms = (time.monotonic() - req.t_exec) * 1e3
+            _h_service.observe(sv_ms)
+            _h_tenant("service", req.tenant).observe(sv_ms)
         if req.span:
             _obs.event("serve.reply", cat="serve", parent=req.span,
                        rid=str(req.rid),
@@ -1080,7 +1304,54 @@ class Server:
             self._send(cs, protocol.error_header(error, id=req.rid))
         else:
             extra, arrays = result
-            self._send(cs, {"ok": True, "id": req.rid, **extra}, arrays)
+            hdr = {"ok": True, "id": req.rid, **extra}
+            staged: list = []
+            arrays = self._stage_reply(req, hdr, arrays, staged)
+            self._send(cs, hdr, arrays)
+            if staged and cs.closed:
+                # the connection died between the closed-check above
+                # and the send: its disconnect teardown may have run
+                # release_owner BEFORE our put landed, so the staged
+                # slots would leak — release them here; whichever
+                # party ran second wins, the other reads "stale"
+                ar = self._arena
+                for h in staged:
+                    try:
+                        if ar is not None:
+                            ar.release(h)
+                    except resilience.ResilienceError:
+                        pass  # the teardown's release won the race
+
+    def _stage_reply(self, req: Request, hdr: dict, arrays,
+                     staged: list):
+        """Route reply payloads through the arena when the client
+        accepts it (``arena_ok``) and the payload clears the
+        ``DR_TPU_SERVE_ARENA_MIN_BYTES`` floor; small results and an
+        exhausted arena stay on the inline wire (graceful — §19.1).
+        Reply slots are owned by the client's connection: released by
+        its next frame's piggyback, or wholesale at disconnect."""
+        if not (req.arena_ok and self._arena is not None and arrays):
+            return arrays
+        entries, inline, used = [], [], False
+        for a in arrays:
+            a = np.asarray(a)
+            if a.nbytes >= self.arena_min:
+                try:
+                    h = self._arena.put(_arena.npy_bytes(a),
+                                        owner=req.conn)
+                    entries.append(h)
+                    staged.append(h)
+                    used = True
+                    continue
+                except resilience.TransientBackendError:
+                    _arena.note_fallback(
+                        "reply arena exhausted; inline wire")
+            entries.append(None)
+            inline.append(a)
+        if not used:
+            return arrays
+        hdr["arena_results"] = entries
+        return inline
 
     def _send(self, cs: _Conn, header: dict, arrays=()) -> None:
         try:
@@ -1112,7 +1383,12 @@ class Server:
     # ------------------------------------------------------------- stories
     def stats(self) -> dict:
         q = self._queue.stats()
+        extra = {}
+        if self._arena is not None:
+            extra["arena"] = self._arena.stats()
+        extra["resident"] = self._resident.stats()
         return {"requests": self._requests, "replies": self._replies,
+                **extra,
                 "errors": self._errors, "cancelled": self._cancelled,
                 "accept_drops": self._accept_drops,
                 "flushes": self._flushes,

@@ -1,6 +1,7 @@
-"""Admission control + deadline-aware FIFO for the serving daemon.
+"""Admission control + weighted-fair scheduling for the serving daemon.
 
-The queue is the daemon's overload contract (docs/SPEC.md §14.2):
+The queue is the daemon's overload contract (docs/SPEC.md §14.2), and
+— since the data-plane round (§19.4) — its ISOLATION contract too:
 
 * **bounded depth** — once ``depth`` requests are queued, submission
   raises a classified :class:`ServerOverloaded` rejection, never a
@@ -11,7 +12,16 @@ The queue is the daemon's overload contract (docs/SPEC.md §14.2):
 * **deadline shedding** — every request carries an absolute expiry;
   :meth:`AdmissionQueue.take_batch` returns expired (and cancelled)
   requests separately so the dispatcher sheds them BEFORE paying a
-  device dispatch for work nobody is waiting on.
+  device dispatch for work nobody is waiting on;
+* **weighted-fair pop (§19.4)** — requests queue per tenant and
+  :meth:`take_batch` drains them by deficit-weighted round-robin
+  (``DR_TPU_SERVE_TENANT_WEIGHTS``, e.g. ``"gold:4,free:1"``;
+  unlisted tenants weigh 1): each ring turn banks a tenant's weight
+  into its deficit and pops one request per whole unit, so a heavy
+  tenant's burst dilates its OWN queue-wait while a light tenant's
+  requests keep landing near the front of every batch.  Order stays
+  FIFO within a tenant; a tenant whose queue drains leaves the ring
+  (no banking while idle — standard DRR).
 
 Transport-free on purpose: a :class:`Request` is just the op + its
 operands + completion slots (an Event the submitter can wait on); the
@@ -27,9 +37,10 @@ from typing import List, Optional, Tuple
 
 from ..obs import metrics as _metrics
 from ..obs import recorder as _rec
+from ..utils.env import env_str
 from ..utils.resilience import ServerOverloaded
 
-__all__ = ["Request", "AdmissionQueue"]
+__all__ = ["Request", "AdmissionQueue", "parse_weights"]
 
 #: always-live overload/shed counters (dr_tpu/obs metrics registry) —
 #: these are request-rate events the serve ``stats`` op and
@@ -49,7 +60,8 @@ class Request:
 
     __slots__ = ("op", "params", "arrays", "tenant", "expiry", "conn",
                  "rid", "cancelled", "result", "error", "_done",
-                 "t_submit", "t_exec", "t0_ns", "span")
+                 "t_submit", "t_exec", "t0_ns", "span", "server",
+                 "arena_ok")
 
     def __init__(self, op: str, params: Optional[dict], arrays,
                  tenant: str = "default",
@@ -75,6 +87,11 @@ class Request:
         self.t_exec = None
         self.t0_ns = _rec.now()
         self.span = 0
+        # daemon-side attachments (None for direct test submits): the
+        # owning Server (resident-cache handlers reach their store
+        # through it) and whether the client accepts arena replies
+        self.server = None
+        self.arena_ok = False
 
     def expired(self) -> bool:
         return self.expiry is not None and time.monotonic() > self.expiry
@@ -93,8 +110,28 @@ class Request:
         return f"Request({self.op!r}, tenant={self.tenant!r}, {state})"
 
 
+def parse_weights(spec: str) -> dict:
+    """Parse ``DR_TPU_SERVE_TENANT_WEIGHTS`` (``"tenant:weight,..."``)
+    into ``{tenant: weight}``.  Tolerant like every env parse: a
+    malformed entry is skipped, weights floor at a small positive
+    value (a zero/negative weight would starve the tenant outright —
+    the opposite of what this queue exists to prevent)."""
+    out: dict = {}
+    for raw in (spec or "").replace(";", ",").split(","):
+        entry = raw.strip()
+        if not entry or ":" not in entry:
+            continue
+        tenant, w = entry.rsplit(":", 1)
+        try:
+            out[tenant.strip()] = max(float(w), 1e-3)
+        except ValueError:
+            continue
+    return out
+
+
 class AdmissionQueue:
-    """Bounded FIFO with per-tenant in-flight accounting.
+    """Bounded per-tenant queues behind a deficit-weighted round-robin
+    pop, with per-tenant in-flight accounting.
 
     A tenant's in-flight count covers queued AND executing requests;
     :meth:`release` (called by the dispatcher as each request finishes)
@@ -102,11 +139,18 @@ class AdmissionQueue:
     ``admitted``) feed the daemon's stats and the serve degradation
     markers."""
 
-    def __init__(self, depth: int, tenant_cap: int):
+    def __init__(self, depth: int, tenant_cap: int,
+                 weights: Optional[dict] = None):
         self.depth = int(depth)
         self.tenant_cap = int(tenant_cap)
+        self.weights = dict(parse_weights(
+            env_str("DR_TPU_SERVE_TENANT_WEIGHTS"))
+            if weights is None else weights)
         self._cv = threading.Condition()
-        self._q: deque = deque()
+        self._subq: dict = {}           # tenant -> deque (FIFO within)
+        self._ring: deque = deque()     # active tenants, DRR order
+        self._deficit: dict = {}        # tenant -> banked pop credit
+        self._qn = 0                    # total queued
         self._inflight: dict = {}
         self.depth_hw = 0
         self.shed = 0
@@ -115,14 +159,17 @@ class AdmissionQueue:
 
     def __len__(self) -> int:
         with self._cv:
-            return len(self._q)
+            return self._qn
+
+    def weight(self, tenant: str) -> float:
+        return self.weights.get(tenant, 1.0)
 
     def submit(self, req: Request) -> None:
         """Admit ``req`` or raise :class:`ServerOverloaded` (classified,
         site ``serve.request``) — overload is a typed rejection the
         client can act on, never a hang."""
         with self._cv:
-            if len(self._q) >= self.depth:
+            if self._qn >= self.depth:
                 self.rejected += 1
                 _c_rejected.add()
                 raise ServerOverloaded(
@@ -134,11 +181,20 @@ class AdmissionQueue:
                 raise ServerOverloaded(
                     f"serve: tenant {req.tenant!r} is at its in-flight "
                     f"cap ({self.tenant_cap})", site="serve.request")
-            self._q.append(req)
+            q = self._subq.get(req.tenant)
+            if q is None:
+                q = self._subq[req.tenant] = deque()
+            if not q:
+                # (re)joining the ring starts with a clean slate: an
+                # idle tenant banks no credit (standard DRR)
+                self._ring.append(req.tenant)
+                self._deficit[req.tenant] = 0.0
+            q.append(req)
+            self._qn += 1
             self._inflight[req.tenant] = \
                 self._inflight.get(req.tenant, 0) + 1
             self.admitted += 1
-            self.depth_hw = max(self.depth_hw, len(self._q))
+            self.depth_hw = max(self.depth_hw, self._qn)
             self._cv.notify()
 
     def release(self, req: Request) -> None:
@@ -150,34 +206,74 @@ class AdmissionQueue:
             else:
                 self._inflight.pop(req.tenant, None)
 
+    def _pop_drr(self, max_n: int) -> List[Request]:
+        """Drain up to ``max_n`` requests by deficit-weighted
+        round-robin over the active-tenant ring (caller holds the
+        lock).  Each ring turn banks the tenant's weight; one request
+        pops per whole credit, FIFO within the tenant.  A drained
+        tenant leaves the ring and forfeits its residue."""
+        batch: List[Request] = []
+        while len(batch) < max_n and self._qn > 0:
+            if not self._ring:  # pragma: no cover - _qn implies a ring
+                break
+            tenant = self._ring[0]
+            q = self._subq.get(tenant)
+            if not q:
+                self._ring.popleft()
+                self._deficit.pop(tenant, None)
+                self._subq.pop(tenant, None)
+                continue
+            # bank the tenant's weight; sub-unit weights accumulate
+            # across turns until a whole credit pops (weights floor at
+            # a positive value, so every tenant pops eventually)
+            self._deficit[tenant] = \
+                self._deficit.get(tenant, 0.0) + self.weight(tenant)
+            while q and len(batch) < max_n \
+                    and self._deficit[tenant] >= 1.0:
+                batch.append(q.popleft())
+                self._qn -= 1
+                self._deficit[tenant] -= 1.0
+            if not q:
+                # drained: leave the ring AND drop the empty deque —
+                # per-request tenant ids must not grow the table
+                # forever (the tenant re-creates both on next submit)
+                self._ring.popleft()
+                self._deficit.pop(tenant, None)
+                self._subq.pop(tenant, None)
+            else:
+                self._ring.rotate(-1)
+        return batch
+
     def take_batch(self, max_n: int, window_s: float,
                    stop: Optional[threading.Event] = None,
                    paused: Optional[threading.Event] = None,
                    ) -> Tuple[List[Request], List[Request]]:
-        """Pop the next FIFO batch: blocks for the first request, then
+        """Pop the next batch: blocks for the first request, then
         coalesces up to ``max_n`` arrivals within ``window_s`` (the
-        batching window concurrent clients land in).  While ``paused``
-        is set nothing is popped (requests keep queueing — the
-        Server.hold() test/bench hook; the pause must live HERE, not in
-        the dispatch loop, or a dispatcher already blocked waiting
-        would pop a batch the moment one arrives, hold or no hold).
-        Returns ``(live, dropped)`` — ``dropped`` holds expired and
-        cancelled requests, already removed, for the dispatcher to
-        shed (their tenant slots are NOT yet released; the dispatcher
-        releases as it finishes/sheds each request)."""
+        batching window concurrent clients land in) and drains them
+        weighted-fair (:meth:`_pop_drr` — FIFO within a tenant, DRR
+        across tenants).  While ``paused`` is set nothing is popped
+        (requests keep queueing — the Server.hold() test/bench hook;
+        the pause must live HERE, not in the dispatch loop, or a
+        dispatcher already blocked waiting would pop a batch the
+        moment one arrives, hold or no hold).  Returns ``(live,
+        dropped)`` — ``dropped`` holds expired and cancelled requests,
+        already removed, for the dispatcher to shed (their tenant
+        slots are NOT yet released; the dispatcher releases as it
+        finishes/sheds each request)."""
         with self._cv:
-            while not self._q or (paused is not None and paused.is_set()):
+            while self._qn == 0 or (paused is not None
+                                    and paused.is_set()):
                 if stop is not None and stop.is_set():
                     return [], []
                 self._cv.wait(0.1)
             deadline = time.monotonic() + max(0.0, window_s)
-            while len(self._q) < max_n:
+            while self._qn < max_n:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     break
                 self._cv.wait(left)
-            batch = [self._q.popleft()
-                     for _ in range(min(max_n, len(self._q)))]
+            batch = self._pop_drr(max_n)
         live, dropped = [], []
         for r in batch:
             if r.cancelled or r.expired():
@@ -191,6 +287,12 @@ class AdmissionQueue:
 
     def stats(self) -> dict:
         with self._cv:
-            return {"queued": len(self._q), "depth_hw": self.depth_hw,
-                    "shed": self.shed, "rejected": self.rejected,
-                    "admitted": self.admitted}
+            per_tenant = {t: len(q) for t, q in self._subq.items() if q}
+            out = {"queued": self._qn, "depth_hw": self.depth_hw,
+                   "shed": self.shed, "rejected": self.rejected,
+                   "admitted": self.admitted}
+            if per_tenant:
+                out["tenant_queued"] = per_tenant
+            if self.weights:
+                out["tenant_weights"] = dict(self.weights)
+            return out

@@ -1,0 +1,310 @@
+"""Replica router: N serving daemons behind a consistent-hash front
+(docs/SPEC.md §19.3).
+
+One daemon is one admission queue in front of one resident claim —
+fleet throughput needs N of them.  The router is deliberately TINY:
+a consistent-hash ring maps ``tenant → replica`` client-side (no
+broker process, no extra hop on the data path), every replica shares
+one ``DR_TPU_COMPILE_CACHE_DIR`` so the fleet warms each program
+once, and tenant affinity keeps each tenant's resident containers and
+arena traffic on one daemon.
+
+On the one-TPU host the fleet is still real: replica 0 may hold the
+device claim, replicas ≥ 1 are forced onto the CPU route (the relay
+admits ONE process — §14), so the router is the multi-process
+scale-out harness the real topology will reuse unchanged.
+
+Failure contract: ``router.route`` is a registered fault site (fires
+at every lookup, before any replica is touched); a DEAD replica
+(``RelayDownError`` — nothing listening) is removed from the ring,
+its tenants re-hash onto the survivors, and the event publishes the
+``_DR_TPU_SERVE_ROUTER_*`` story markers ``degradation_story`` folds
+into the serve chapter — re-homed tenants lose their resident cache
+(it lived in the dead process) and simply rebuild on first use.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..obs import metrics as _om
+from ..utils import faults as _faults
+from ..utils import resilience
+from ..utils.env import env_int
+from ..utils.fallback import warn_fallback
+from .client import Client
+
+__all__ = ["HashRing", "Router", "RouterClient"]
+
+_c_routes = _om.counter("serve.router.routes")
+_c_rehash = _om.counter("serve.router.rehashes")
+
+#: Client op methods the router forwards (everything tenant-scoped);
+#: control ops (stats/ping) have per-replica variants instead.
+_FORWARD = ("request", "fill", "scale", "reduce", "dot", "scan",
+            "sort", "join", "groupby", "unique", "top_k", "histogram",
+            "put", "get", "drop")
+
+
+def _digest(key: str) -> int:
+    """Stable placement hash (process-independent — Python's ``hash``
+    is salted per process, which would re-home every tenant on every
+    restart)."""
+    return int.from_bytes(
+        hashlib.sha1(key.encode("utf-8")).digest()[:8], "big")
+
+
+class HashRing:
+    """Classic consistent hashing: each replica owns ``vnodes``
+    points on a 64-bit ring; a tenant maps to the first point at or
+    after its own hash.  Removing a replica re-homes ONLY the tenants
+    that hashed to it — the property that makes a dead replica a
+    bounded event instead of a full reshuffle."""
+
+    def __init__(self, keys, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        self._keys: List[str] = []
+        for k in keys:
+            self.add(k)
+
+    def add(self, key: str) -> None:
+        if key in self._keys:
+            return
+        self._keys.append(key)
+        for v in range(self.vnodes):
+            h = _digest(f"{key}#{v}")
+            i = bisect.bisect(self._points, h)
+            self._points.insert(i, h)
+            self._owners.insert(i, key)
+
+    def remove(self, key: str) -> None:
+        if key not in self._keys:
+            return
+        self._keys.remove(key)
+        keep = [(p, o) for p, o in zip(self._points, self._owners)
+                if o != key]
+        self._points = [p for p, _ in keep]
+        self._owners = [o for _, o in keep]
+
+    def keys(self) -> List[str]:
+        return list(self._keys)
+
+    def lookup(self, tenant: str) -> str:
+        if not self._points:
+            raise resilience.RelayDownError(
+                "serve.router: no live replicas left on the ring",
+                site="router.route")
+        i = bisect.bisect(self._points, _digest(tenant)) \
+            % len(self._points)
+        return self._owners[i]
+
+
+class Router:
+    """Fleet harness: start N daemons on ``<base>.r<i>`` sockets.
+    Replica 0 honors the caller's route request; replicas ≥ 1 are
+    always CPU-route (one-TPU host rule).  ``spawn=True`` runs each
+    replica as a real ``python -m dr_tpu.serve`` subprocess (the
+    multi-process harness); default is in-process servers (tests,
+    bench)."""
+
+    def __init__(self, base_path: str, replicas: Optional[int] = None,
+                 *, cpu: bool = True, spawn: bool = False, **server_kw):
+        self.base = str(base_path)
+        self.replicas = (env_int("DR_TPU_SERVE_REPLICAS", 2)
+                         if replicas is None else int(replicas))
+        self.cpu = bool(cpu)
+        self.spawn = bool(spawn)
+        self._server_kw = server_kw
+        self._servers: list = []
+        self._procs: list = []
+        self._paths: List[str] = []
+
+    def start(self) -> "Router":
+        from .daemon import Server
+        try:
+            for i in range(self.replicas):
+                path = f"{self.base}.r{i}"
+                # one-TPU host: at most ONE replica may race for the
+                # device claim — every replica past the first is
+                # pinned to the CPU route regardless of the request
+                cpu = self.cpu or i > 0
+                if self.spawn:
+                    self._procs.append(self._spawn(path, cpu))
+                else:
+                    self._servers.append(
+                        Server(path, cpu=cpu,
+                               **self._server_kw).start())
+                self._paths.append(path)
+        except BaseException:
+            self.stop()
+            raise
+        return self
+
+    def _spawn(self, path: str, cpu: bool):
+        import json
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # frozen by sitecustomize
+        argv = [sys.executable, "-m", "dr_tpu.serve", "--socket", path]
+        if cpu:
+            argv.append("--cpu")
+        proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        line = proc.stdout.readline()
+        try:
+            ready = json.loads(line) if line.strip() else {}
+        except ValueError:
+            ready = {}
+        if ready.get("serving") != path:
+            proc.kill()
+            proc.wait(timeout=30)
+            raise resilience.classified(
+                f"serve.router: replica on {path} failed to start "
+                f"({line!r})", site="router.route")
+        return proc
+
+    def paths(self) -> List[str]:
+        return list(self._paths)
+
+    def stop(self) -> None:
+        for srv in self._servers:
+            try:
+                srv.stop()
+            # drlint: ok[R5] fleet teardown best effort: one replica's failing stop must not strand the rest
+            except Exception:  # pragma: no cover
+                pass
+        self._servers = []
+        for proc in self._procs:
+            try:
+                proc.terminate()  # the daemon's SIGTERM handler stops
+                proc.wait(timeout=30)  # cleanly (socket unlinked)
+            except Exception:  # pragma: no cover - teardown
+                proc.kill()
+        self._procs = []
+        self._paths = []
+
+
+class RouterClient:
+    """The tenant-facing front: holds one lazy :class:`Client` per
+    replica and forwards every op to the replica the ring names for
+    its tenant.  A dead replica re-hashes (classified story marker);
+    when the LAST replica dies the ``RelayDownError`` surfaces — the
+    caller's degrade signal, exactly like a single-daemon client."""
+
+    def __init__(self, paths, *, tenant: str = "default",
+                 vnodes: int = 64, **client_kw):
+        self.tenant = tenant
+        self._ring = HashRing(paths, vnodes=vnodes)
+        self._client_kw = dict(client_kw)
+        self._clients: Dict[str, Client] = {}
+        self._lock = threading.Lock()
+        self.rehashes = 0
+
+    # ------------------------------------------------------------ routing
+    def route(self, tenant: Optional[str] = None) -> str:
+        """The replica socket the ring names for ``tenant`` (fault
+        site ``router.route`` — fires before any replica is
+        touched)."""
+        t = tenant or self.tenant
+        _faults.fire("router.route", tenant=t)
+        _c_routes.add()
+        return self._ring.lookup(t)
+
+    def _client(self, path: str) -> Client:
+        with self._lock:
+            c = self._clients.get(path)
+        if c is not None:
+            return c
+        c = Client(path, tenant=self.tenant, **self._client_kw)
+        with self._lock:
+            have = self._clients.setdefault(path, c)
+        if have is not c:
+            c.close()
+        return have
+
+    def _mark_dead(self, path: str, err) -> None:
+        """Remove a dead replica from the ring and publish the story
+        marker — its tenants re-hash onto the survivors (bounded by
+        consistent hashing), losing only their resident cache."""
+        self._ring.remove(path)
+        self.rehashes += 1
+        _c_rehash.add()
+        with self._lock:
+            c = self._clients.pop(path, None)
+        if c is not None:
+            c.close()
+        os.environ["_DR_TPU_SERVE_ROUTER_DEAD"] = \
+            str(env_int("_DR_TPU_SERVE_ROUTER_DEAD", 0, floor=0) + 1)
+        os.environ["_DR_TPU_SERVE_ROUTER_REASON"] = \
+            (f"replica {path} unreachable "
+             f"({type(err).__name__}); tenants re-hashed onto "
+             f"{len(self._ring.keys())} survivor(s)")[:200]
+        warn_fallback("serve.router",
+                      f"replica {path} unreachable; re-hashing its "
+                      "tenants onto the survivors")
+
+    def _call(self, name: str, args, kw):
+        tenant = kw.get("tenant") or self.tenant
+        while True:
+            path = self.route(tenant)
+            try:
+                return getattr(self._client(path), name)(*args, **kw)
+            except resilience.RelayDownError as e:
+                # nothing listening: THIS replica is dead.  Re-hash
+                # and retry on the survivors; the last death re-raises
+                # (the ring lookup itself turns RelayDown).
+                self._mark_dead(path, e)
+            except resilience.ResilienceError as e:
+                # a replica that died mid-exchange surfaces as a torn
+                # frame / broken pipe on the CACHED connection, not a
+                # RelayDown.  Business rejections (overload, deadline,
+                # the daemon's own classified op errors) come from a
+                # LIVE replica and re-raise; only a replica that also
+                # fails the liveness probe re-hashes.
+                from .daemon import daemon_alive
+                if isinstance(e, (resilience.ServerOverloaded,
+                                  resilience.DeadlineExpired)) \
+                        or daemon_alive(path):
+                    raise
+                self._mark_dead(path, e)
+
+    def __getattr__(self, name: str):
+        if name in _FORWARD:
+            def fwd(*args, _n=name, **kw):
+                return self._call(_n, args, kw)
+            fwd.__name__ = name
+            return fwd
+        raise AttributeError(name)
+
+    # ------------------------------------------------------------- admin
+    def live_replicas(self) -> List[str]:
+        return self._ring.keys()
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-replica daemon stats (live replicas only)."""
+        out = {}
+        for path in self._ring.keys():
+            try:
+                out[path] = self._client(path).stats()
+            except resilience.ResilienceError as e:
+                out[path] = {"error": repr(e)[:120]}
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()
+
+    def __enter__(self) -> "RouterClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

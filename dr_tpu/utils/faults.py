@@ -83,6 +83,18 @@ exchange             dispatch (parallel/
                      the deferred-plan pre hook;
                      fires before the program-cache
                      lookup, container untouched)
+arena.map            serving-daemon shared-memory   transient, program
+                     arena map/alloc (dr_tpu/serve/
+                     arena.py — a bad handle is the
+                     client's deterministic bug; an
+                     exhausted arena is a transient
+                     the client absorbs by falling
+                     back to the inline wire)
+arena.release        arena slot refcount drop       transient, program
+router.route         replica-router lookup          transient, program
+                     (dr_tpu/serve/router.py —
+                     fires before any replica is
+                     touched)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -171,6 +183,17 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # surfaces classified with the container exactly as it was (the
     # metadata rebind rolls back).
     "redistribute.exchange": ("transient", "oom", "program"),
+    # serving data plane (docs/SPEC.md §19): arena.map fires at every
+    # shared-memory handle map/alloc on the daemon (a bad handle —
+    # stale generation, unknown slot — is a deterministic ProgramError;
+    # arena exhaustion is a transient the client absorbs by falling
+    # back to the inline wire); arena.release fires at every slot
+    # refcount drop; router.route fires at every replica-router lookup
+    # (a faulted route surfaces classified before any replica is
+    # touched).
+    "arena.map": ("transient", "program"),
+    "arena.release": ("transient", "program"),
+    "router.route": ("transient", "program"),
     "fallback.warn": (),
 }
 

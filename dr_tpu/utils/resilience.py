@@ -525,11 +525,15 @@ def degradation_story(env=None) -> Optional[dict]:
     serve_reason = env.get("_DR_TPU_SERVE_DEGRADED")
     shrink_reason = env.get("_DR_TPU_ELASTIC_REASON")
     grow_reason = env.get("_DR_TPU_ELASTIC_GROW_REASON")
+    # dead-replica rehash marker (serve/router.py, SPEC §19.3): a
+    # fleet that lost a replica is a degraded run even when every
+    # surviving daemon is healthy
+    router_reason = env.get("_DR_TPU_SERVE_ROUTER_REASON")
     if not reason and not serve_reason and not shrink_reason \
-            and not grow_reason:
+            and not grow_reason and not router_reason:
         return None
     story = {"reason": reason or serve_reason or shrink_reason
-             or grow_reason,
+             or grow_reason or router_reason,
              "retries": int(env.get("_DR_TPU_BENCH_RETRIES", "0") or 0),
              "probe_wall_s": float(env.get("_DR_TPU_BENCH_PROBE_S", "0")
                                    or 0.0)}
@@ -540,10 +544,14 @@ def degradation_story(env=None) -> Optional[dict]:
     for key, marker in (("reason", "_DR_TPU_SERVE_DEGRADED"),
                         ("queue_depth", "_DR_TPU_SERVE_QUEUE_DEPTH"),
                         ("shed", "_DR_TPU_SERVE_SHED"),
-                        ("restarts", "_DR_TPU_SERVE_RESTARTS")):
+                        ("restarts", "_DR_TPU_SERVE_RESTARTS"),
+                        ("router_dead", "_DR_TPU_SERVE_ROUTER_DEAD"),
+                        ("router_reason",
+                         "_DR_TPU_SERVE_ROUTER_REASON")):
         raw = env.get(marker)
         if raw not in (None, ""):
-            serve[key] = raw if key == "reason" else int(raw)
+            serve[key] = raw if key in ("reason", "router_reason") \
+                else int(raw)
     if serve:
         story["serve"] = serve
     shrink = {}

@@ -111,7 +111,12 @@ def _battery(tmpdir: str, tag: str) -> None:
     # at the client (transients recover on the in-process retry leg,
     # relay_down degrades the resident claim to the CPU route and the
     # leg still SUCCEEDS) — the daemon itself never dies and never
-    # hangs the battery.
+    # hangs the battery.  The data-plane legs (docs/SPEC.md §19) ride
+    # the same daemon: a payload above the arena floor drives
+    # arena.map (lease + map) and arena.release (the intake-side slot
+    # recycle) — an arena fault either serializes classified or the
+    # client falls back to the inline wire and the request still
+    # SUCCEEDS; a RouterClient lookup drives router.route.
     from dr_tpu import serve
     ssrv = serve.Server(os.path.join(tmpdir, f"chaos_{tag}.sock"),
                         batch_window=0.0)
@@ -122,6 +127,19 @@ def _battery(tmpdir: str, tag: str) -> None:
             np.testing.assert_allclose(sc.scale(sx, a=2.0, b=1.0),
                                        sx * 2.0 + 1.0, rtol=1e-6)
             assert abs(sc.reduce(np.ones(4 * P, np.float32)) - 4 * P) \
+                < 1e-3
+            # arena leg: a payload above DR_TPU_SERVE_ARENA_MIN_BYTES
+            # stages through shared memory (alloc+map+release fire);
+            # an exhaustion/fault falls back to the inline wire
+            ax = np.arange(
+                env_int("DR_TPU_SERVE_ARENA_MIN_BYTES", 1 << 16) // 4
+                + 8, dtype=np.float32)
+            np.testing.assert_allclose(sc.scale(ax, a=0.5),
+                                       ax * 0.5, rtol=1e-6)
+        with serve.RouterClient([ssrv.path], timeout=60.0) as rc:
+            # router leg: the consistent-hash lookup (router.route
+            # fires before the replica is touched)
+            assert abs(rc.reduce(np.ones(4 * P, np.float32)) - 4 * P) \
                 < 1e-3
     finally:
         ssrv.stop()

@@ -1447,8 +1447,8 @@ def test_fuzz_join_partition(seed):
     merge routes — the broadcast sorted-merge and the bounded-memory
     repartition exchange forced via ``DR_TPU_JOIN_BROADCAST_MAX=0`` —
     must agree BIT-for-bit on every output channel and the row count,
-    for inner/left/right alike; the partition route must also report a
-    gathered channel bounded by the full right side."""
+    for inner/left/right/outer alike; the partition route must also
+    report a gathered channel bounded by the full right side."""
     from dr_tpu.algorithms import relational as _rel
     rng = np.random.default_rng(2100 + seed)
     P = dr_tpu.nprocs()
@@ -1471,7 +1471,7 @@ def test_fuzz_join_partition(seed):
             kr[::7] = np.nan
         vl = rng.standard_normal(nl).astype(np.float32)
         vr = rng.standard_normal(nr).astype(np.float32)
-        how = ("inner", "left", "right")[it % 3]
+        how = ("inner", "left", "right", "outer")[it % 4]
         cap = nl * nr + nl + nr + 1
         tag = f"it={it} how={how} kind={kind} nl={nl} nr={nr}"
 
@@ -1621,7 +1621,7 @@ def test_fuzz_relational(seed):
             rkv = dr_tpu.distributed_vector.from_array(
                 rkeys, distribution=_fuzz_rel_dist(rng, nr, P))
             rvv = dr_tpu.distributed_vector.from_array(rvals)
-            how = rng.choice(["inner", "left", "right"])
+            how = rng.choice(["inner", "left", "right", "outer"])
             ref = pd.merge(pd.DataFrame({"k": keys, "lv": vals}),
                            pd.DataFrame({"k": rkeys, "rv": rvals}),
                            on="k", how=how).fillna(-7.0)

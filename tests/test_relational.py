@@ -131,7 +131,7 @@ def test_unique_vs_numpy():
 
 # ------------------------------------------------------------------- join
 
-@pytest.mark.parametrize("how", ["inner", "left", "right"])
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
 def test_join_vs_pandas(how):
     rng = np.random.default_rng(12)
     nl, nr = 31, 23
@@ -215,6 +215,44 @@ def test_join_disjoint_and_empty_sides():
     assert m == 10
     np.testing.assert_array_equal(dr_tpu.to_numpy(jr)[:m],
                                   np.full(10, -3.0, np.float32))
+
+
+def test_join_outer_union_interleaves_by_key():
+    """how="outer" (the data-plane round's satellite): unmatched rows
+    of BOTH sides emit — fill on whichever value column is absent —
+    interleaved in key order, matched keys expanding exactly as
+    inner."""
+    lk = np.array([1, 3, 3, 7], np.float32)
+    lv = np.array([10, 30, 31, 70], np.float32)
+    rk = np.array([0, 3, 5, 9], np.float32)
+    rv = np.array([-0.5, -3.0, -5.0, -9.0], np.float32)
+    jk = dr_tpu.distributed_vector(32, np.float32)
+    jl = dr_tpu.distributed_vector(32, np.float32)
+    jr = dr_tpu.distributed_vector(32, np.float32)
+    m = dr_tpu.join(dr_tpu.distributed_vector.from_array(lk),
+                    dr_tpu.distributed_vector.from_array(lv),
+                    dr_tpu.distributed_vector.from_array(rk),
+                    dr_tpu.distributed_vector.from_array(rv),
+                    jk, jl, jr, how="outer", fill=-1.0)
+    assert m == 7
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jk)[:m],
+                                  [0, 1, 3, 3, 5, 7, 9])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jl)[:m],
+                                  [-1, 10, 30, 31, -1, 70, -1])
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jr)[:m],
+                                  [-0.5, -1, -3, -3, -5, -1, -9])
+    # an outer join with an EMPTY left emits every right row, filled
+    lkv = dr_tpu.distributed_vector.from_array(lk)
+    lvv = dr_tpu.distributed_vector.from_array(lv)
+    m = dr_tpu.join(lkv[0:0], lvv[0:0],
+                    dr_tpu.distributed_vector.from_array(rk),
+                    dr_tpu.distributed_vector.from_array(rv),
+                    jk, jl, jr, how="outer", fill=-2.0)
+    assert m == 4
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jk)[:m], rk)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jl)[:m],
+                                  np.full(4, -2.0, np.float32))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(jr)[:m], rv)
 
 
 # -------------------------------------------------------------- histogram
@@ -440,7 +478,7 @@ def test_relational_api_misuse_raises_at_call_site():
     with pytest.raises(ValueError, match="needs values"):
         dr_tpu.groupby_aggregate(kv, None, ok, ov, agg="sum")
     with pytest.raises(ValueError, match="unknown how"):
-        dr_tpu.join(kv, vv, kv, vv, ok, ov, ov, how="outer")
+        dr_tpu.join(kv, vv, kv, vv, ok, ov, ov, how="cross")
     with pytest.raises(TypeError, match="key dtypes"):
         ik = dr_tpu.distributed_vector(n, np.int32)
         dr_tpu.join(kv, vv, ik, vv, ok, ov, ov)
@@ -569,6 +607,19 @@ def test_serve_relational_round_trip(tmp_path):
             ref = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
                            pd.DataFrame({"k": rk, "rv": rv}), on="k")
             assert len(jk) == len(ref)
+            # the outer union serves over the SAME wire op (§17.3)
+            ok_, ol_, or_ = c.join(lk, lv, rk, rv, how="outer",
+                                   fill=-5.0)
+            refo = pd.merge(pd.DataFrame({"k": lk, "lv": lv}),
+                            pd.DataFrame({"k": rk, "rv": rv}),
+                            on="k", how="outer").fillna(-5.0)
+            assert len(ok_) == len(refo)
+            got = pd.DataFrame({"k": ok_, "lv": ol_, "rv": or_}) \
+                .sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+            refo = refo.sort_values(["k", "lv", "rv"]) \
+                .reset_index(drop=True)
+            np.testing.assert_allclose(
+                got.values, refo.values.astype(np.float32), rtol=1e-5)
             gk, gv = c.groupby(lk, lv, agg="mean")
             refg = pd.DataFrame({"k": lk, "v": lv}) \
                 .groupby("k")["v"].mean()

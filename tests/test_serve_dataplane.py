@@ -1,0 +1,667 @@
+"""Serving data plane (docs/SPEC.md §19): shared-memory tensor arena,
+per-tenant resident containers, replica router, weighted-fair
+admission.
+
+Everything runs on the 8-device virtual CPU mesh with in-process
+daemons under tmp_path sockets (the test_serve.py conventions); the
+multi-tenant contention and arena concurrent-stress tests are the
+ISSUE 13 satellite regressions.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import serve
+from dr_tpu.serve import arena as arena_mod
+from dr_tpu.serve import protocol
+from dr_tpu.serve.queue import AdmissionQueue, Request, parse_weights
+from dr_tpu.utils import faults, resilience
+from dr_tpu.utils.env import env_override
+
+X = np.arange(48, dtype=np.float32)
+#: comfortably above the default DR_TPU_SERVE_ARENA_MIN_BYTES floor
+BIG = np.arange(1 << 15, dtype=np.float32)
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = serve.Server(str(tmp_path / "dp.sock"))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _client(srv, **kw):
+    kw.setdefault("timeout", 60.0)
+    return serve.Client(srv.path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# arena unit behavior (no daemon)
+# ---------------------------------------------------------------------------
+
+def test_arena_alloc_release_recycles_and_coalesces():
+    ar = serve.Arena(nbytes=1 << 16)
+    try:
+        a = ar.alloc(1000)
+        b = ar.alloc(2000)
+        c = ar.alloc(3000)
+        assert ar.stats()["slots"] == 3
+        ar.release(b)
+        ar.release(a)  # adjacent frees coalesce back into one range
+        d = ar.alloc(2900)  # fits the coalesced a+b hole
+        assert d["offset"] == a["offset"]
+        ar.release(c)
+        ar.release(d)
+        st = ar.stats()
+        assert st["in_use"] == 0 and st["slots"] == 0
+        # the whole segment is one hole again
+        e = ar.alloc((1 << 16) - arena_mod.ALIGN)
+        ar.release(e)
+    finally:
+        ar.destroy()
+
+
+def test_arena_generation_tag_rejects_stale_handles():
+    ar = serve.Arena(nbytes=1 << 16)
+    try:
+        h1 = ar.put(arena_mod.npy_bytes(X))
+        ar.release(h1)
+        # the slot id is gone; a recycled-id handle must NOT alias
+        h2 = ar.put(arena_mod.npy_bytes(X * 2))
+        with pytest.raises(resilience.ProgramError, match="stale"):
+            ar.map(h1)
+        with pytest.raises(resilience.ProgramError, match="stale"):
+            ar.release(h1)  # double release is the same classified bug
+        np.testing.assert_array_equal(ar.map(h2), X * 2)
+        # refcounts: retain keeps the slot live across one release
+        ar.retain(h2)
+        ar.release(h2)
+        np.testing.assert_array_equal(ar.map(h2), X * 2)
+        ar.release(h2)
+        assert ar.stats()["in_use"] == 0
+    finally:
+        ar.destroy()
+
+
+def test_arena_exhaustion_is_classified_transient():
+    ar = serve.Arena(nbytes=1 << 12)
+    try:
+        ar.alloc(3 << 10)
+        with pytest.raises(resilience.TransientBackendError,
+                           match="exhausted"):
+            ar.alloc(3 << 10)
+        assert ar.stats()["exhaustions"] == 1
+    finally:
+        ar.destroy()
+
+
+def test_arena_release_owner_frees_wholesale():
+    ar = serve.Arena(nbytes=1 << 16)
+    try:
+        owner = object()
+        for _ in range(4):
+            ar.alloc(512, owner=owner)
+        keep = ar.alloc(512, owner=object())
+        assert ar.release_owner(owner) == 4
+        st = ar.stats()
+        assert st["slots"] == 1
+        ar.release(keep)
+    finally:
+        ar.destroy()
+
+
+# ---------------------------------------------------------------------------
+# arena over the wire
+# ---------------------------------------------------------------------------
+
+def test_arena_wire_roundtrip_and_reply_path(server):
+    with _client(server) as c:
+        got = c.scale(BIG, a=2.0, b=-1.0)
+        np.testing.assert_allclose(got, BIG * 2.0 - 1.0, rtol=1e-6)
+        assert c.arena_active(), "big payload should attach the arena"
+        st = c.stats()
+        # the request payload AND the same-size reply both mapped
+        assert st["arena"]["allocs"] >= 2
+        assert st["obs"]["counters"]["serve.arena.maps"] >= 1
+        # multi-array op: both big operands ride the arena
+        s = c.dot(BIG, BIG)
+        assert abs(s - float((BIG.astype(np.float64) ** 2).sum())) \
+            < abs(s) * 1e-5 + 1.0
+        # mixed: small payloads stay inline on the same connection
+        np.testing.assert_allclose(c.scale(X, a=3.0), X * 3.0,
+                                   rtol=1e-6)
+    # reply slots the client still owed free at disconnect teardown
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if server._arena.stats()["in_use"] == 0:
+            break
+        time.sleep(0.02)
+    assert server._arena.stats()["in_use"] == 0
+
+
+def test_arena_disabled_daemon_serves_inline(tmp_path):
+    with env_override(DR_TPU_SERVE_ARENA="0"):
+        srv = serve.Server(str(tmp_path / "noar.sock")).start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            assert "arena" not in c.ping()
+            np.testing.assert_allclose(c.scale(BIG, a=2.0), BIG * 2.0,
+                                       rtol=1e-6)
+            assert not c.arena_active()
+    finally:
+        srv.stop()
+
+
+def test_arena_exhausted_falls_back_to_inline_wire(tmp_path):
+    """An arena too small for the payload: the client's lease fails
+    with the classified transient and the request silently takes the
+    inline wire — full function, counted fallback."""
+    with env_override(DR_TPU_SERVE_ARENA_BYTES=str(1 << 12)):
+        srv = serve.Server(str(tmp_path / "tiny.sock")).start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            np.testing.assert_allclose(c.scale(BIG, a=2.0), BIG * 2.0,
+                                       rtol=1e-6)
+            st = c.stats()
+            assert st["arena"]["exhaustions"] >= 1
+            assert st["obs"]["counters"].get("serve.arena.fallbacks",
+                                             0) >= 1
+    finally:
+        srv.stop()
+
+
+def test_arena_stale_wire_handle_classified(server):
+    """A handle the daemon never leased (or already recycled) is the
+    client's deterministic bug: classified ProgramError, site
+    arena.map, connection keeps serving."""
+    with _client(server) as c:
+        c.scale(BIG, a=1.0)  # attach + prove the arena works
+        raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        raw.connect(server.path)
+        try:
+            protocol.send_frame(
+                raw, {"op": "scale", "params": {"a": 1.0}, "id": 1,
+                      "arena": [{"slot": 999999, "generation": 3,
+                                 "len": 64}]})
+            hdr, _ = protocol.recv_frame(raw)
+            assert hdr["ok"] is False
+            assert hdr["error"]["cls"] == "ProgramError"
+            assert hdr["error"]["site"] == "arena.map"
+        finally:
+            raw.close()
+        assert abs(c.reduce(X) - X.sum()) < 1e-3
+
+
+def test_arena_fault_sites_drive_classified_or_fallback(server):
+    with _client(server) as c:
+        c.scale(BIG, a=1.0)  # attach
+        # a transient at the lease: the client falls back inline and
+        # the request still succeeds
+        with faults.injected("arena.map", "transient") as sp:
+            np.testing.assert_allclose(c.scale(BIG, a=2.0), BIG * 2.0,
+                                       rtol=1e-6)
+            assert sp.fired == 1
+        # a program fault surfaces classified (no fallback for
+        # deterministic bugs)
+        with faults.injected("arena.map", "program") as sp:
+            with pytest.raises(resilience.ProgramError):
+                c.scale(BIG, a=2.0)
+            assert sp.fired == 1
+        # the daemon survived both
+        np.testing.assert_allclose(c.scale(BIG, a=4.0), BIG * 4.0,
+                                   rtol=1e-6)
+
+
+def test_arena_concurrent_stress_slot_recycling(tmp_path):
+    """ISSUE 13 satellite: parallel clients hammer a SMALL arena —
+    slot recycling under contention, exhaustion fallbacks interleaved
+    with arena traffic, every result exact, and the arena drains to
+    zero once the clients disconnect."""
+    with env_override(DR_TPU_SERVE_ARENA_BYTES=str(1 << 20)):
+        srv = serve.Server(str(tmp_path / "stress.sock"),
+                           queue_depth=256, tenant_cap=64).start()
+    errs = []
+    try:
+        with serve.Client(srv.path, timeout=120.0) as c:
+            c.scale(BIG, a=1.0)  # compile once
+
+        def worker(i):
+            try:
+                rng = np.random.default_rng(i)
+                with serve.Client(srv.path, timeout=120.0,
+                                  tenant=f"w{i}") as c:
+                    for r in range(6):
+                        x = rng.standard_normal(1 << 15) \
+                            .astype(np.float32)
+                        got = c.scale(x, a=2.0, b=float(r))
+                        np.testing.assert_allclose(got, x * 2.0 + r,
+                                                   rtol=1e-6)
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not errs, errs[:3]
+        st = srv._arena.stats()
+        assert st["allocs"] >= 6  # arena traffic actually happened
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and srv._arena.stats()["in_use"]:
+            time.sleep(0.02)
+        assert srv._arena.stats()["in_use"] == 0, \
+            "slots leaked after client disconnects"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# resident container cache
+# ---------------------------------------------------------------------------
+
+def test_resident_put_ref_get_drop_roundtrip(server):
+    with _client(server) as c:
+        r = c.put("feat", X)
+        assert r["bytes"] == X.nbytes and r["cached"] is False
+        # repeated ops by reference: zero payload, no rebuild
+        assert abs(c.reduce(serve.Ref("feat")) - X.sum()) < 1e-3
+        np.testing.assert_allclose(c.scale(serve.Ref("feat"), a=2.0),
+                                   X * 2.0, rtol=1e-6)
+        # the mutating op ran on a scratch copy — the resident value
+        # is untouched
+        np.testing.assert_array_equal(c.get("feat"), X)
+        # ref + inline operand mix (dot takes one of each)
+        assert abs(c.dot(serve.Ref("feat"), X)
+                   - float((X.astype(np.float64) ** 2).sum())) < 1e-2
+        # identical re-put is a content-tag HIT (no rebuild)
+        assert c.put("feat", X)["cached"] is True
+        # different content replaces
+        r3 = c.put("feat", X * 3)
+        assert r3["cached"] is False
+        np.testing.assert_allclose(c.get("feat"), X * 3, rtol=1e-6)
+        assert c.drop("feat")["dropped"] is True
+        assert c.drop("feat")["dropped"] is False
+        with pytest.raises(resilience.ProgramError,
+                           match="no resident"):
+            c.reduce(serve.Ref("feat"))
+        st = c.stats()["resident"]
+        assert st["puts"] == 2 and st["put_hits"] == 1
+
+
+def test_resident_is_tenant_scoped(server):
+    with _client(server, tenant="alice") as a, \
+            _client(server, tenant="bob") as b:
+        a.put("secret", X)
+        with pytest.raises(resilience.ProgramError,
+                           match="no resident"):
+            b.get("secret")
+        # bob's same-name put shadows nothing of alice's
+        b.put("secret", X * 2)
+        np.testing.assert_array_equal(a.get("secret"), X)
+        np.testing.assert_allclose(b.get("secret"), X * 2, rtol=1e-6)
+
+
+def test_resident_lru_bytes_budget_evicts(tmp_path):
+    n = 1 << 10  # 4 KiB per value
+    with env_override(DR_TPU_SERVE_RESIDENT_BYTES=str(3 * n * 4)):
+        srv = serve.Server(str(tmp_path / "lru.sock")).start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            vals = {}
+            for i in range(4):
+                vals[i] = np.full(n, float(i), np.float32)
+                c.put(f"v{i}", vals[i])
+            # 4 puts against a 3-value budget: v0 (LRU) evicted
+            with pytest.raises(resilience.ProgramError,
+                               match="no resident"):
+                c.get("v0")
+            np.testing.assert_array_equal(c.get("v3"), vals[3])
+            st = c.stats()["resident"]
+            assert st["evictions"] == 1 and st["entries"] == 3
+            assert st["bytes"] <= 3 * n * 4
+            # touching v1 re-freshens it: the NEXT eviction takes v2
+            c.get("v1")
+            c.put("v4", np.full(n, 9.0, np.float32))
+            np.testing.assert_array_equal(c.get("v1"), vals[1])
+            with pytest.raises(resilience.ProgramError,
+                               match="no resident"):
+                c.get("v2")
+            # a single value past the whole budget is a classified
+            # rejection, not a cache wipe
+            with pytest.raises(resilience.ProgramError,
+                               match="budget"):
+                c.put("huge", np.zeros(4 * n, np.float32))
+            np.testing.assert_array_equal(c.get("v1"), vals[1])
+    finally:
+        srv.stop()
+
+
+def test_resident_rides_elastic_shrink_poison_classified(server):
+    """§19.2 x §16: a resident container the shrink cannot rescue is
+    POISONED — later uses raise the classified DeviceLostError to the
+    client (never a silent wrong answer) — and a re-put on the
+    shrunken mesh serves again.  The session grows back afterwards so
+    later tests see the full mesh."""
+    from dr_tpu.utils import elastic
+    P = dr_tpu.nprocs()
+    if P < 2:
+        pytest.skip("shrink needs >= 2 devices")
+    with _client(server) as c:
+        c.put("state", X)
+        assert abs(c.reduce(serve.Ref("state")) - X.sum()) < 1e-3
+        try:
+            elastic.rescue_session(
+                resilience.DeviceLostError(
+                    "dataplane: simulated device loss", rank=P - 1))
+            # the full-span uncheckpointed resident is LOST: poisoned,
+            # classified on use — for get and ref-ops alike
+            with pytest.raises(resilience.DeviceLostError):
+                c.get("state")
+            with pytest.raises(resilience.DeviceLostError):
+                c.reduce(serve.Ref("state"))
+            # a fresh put on the shrunken mesh serves again
+            c.put("state", X * 2)
+            assert abs(c.reduce(serve.Ref("state")) - 2 * X.sum()) \
+                < 1e-3
+        finally:
+            elastic.grow_session(reason="dataplane test: restore mesh")
+        # after grow-back the re-put value still answers (the §16.6
+        # container walk re-admitted it)
+        assert abs(c.reduce(serve.Ref("state")) - 2 * X.sum()) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair admission (DRR)
+# ---------------------------------------------------------------------------
+
+def _reqs(tenant, n):
+    return [Request("scale", {}, [X], tenant=tenant) for _ in range(n)]
+
+
+def test_drr_interleaves_tenants_fifo_within():
+    q = AdmissionQueue(64, 64, weights={})
+    heavy = _reqs("heavy", 6)
+    light = _reqs("light", 2)
+    for r in heavy + light:  # heavy's burst queues FIRST
+        q.submit(r)
+    live, dropped = q.take_batch(8, 0.0)
+    assert not dropped
+    order = [r.tenant for r in live]
+    # equal weights: strict alternation until light drains, FIFO
+    # within each tenant — light's requests land at positions 1 and 3
+    # instead of 6 and 7 (the FIFO starvation this queue replaces)
+    assert order[:4] == ["heavy", "light", "heavy", "light"]
+    assert order[4:] == ["heavy"] * 4
+    assert [r is h for r, h in zip(
+        [x for x in live if x.tenant == "heavy"], heavy)] == [True] * 6
+
+
+def test_drr_weights_shift_the_share():
+    q = AdmissionQueue(64, 64, weights={"gold": 3.0})
+    for r in _reqs("free", 6) + _reqs("gold", 6):
+        q.submit(r)
+    live, _ = q.take_batch(8, 0.0)
+    order = [r.tenant for r in live]
+    # free banked 1 credit/turn, gold 3: gold takes 3 of every 4
+    assert order.count("gold") == 6
+    assert order[:4].count("free") == 1
+    live2, _ = q.take_batch(8, 0.0)
+    assert [r.tenant for r in live2] == ["free"] * 4
+
+
+def test_drr_fractional_weights_bank_across_turns():
+    q = AdmissionQueue(64, 64, weights={"slow": 0.5})
+    for r in _reqs("slow", 2) + _reqs("fast", 2):
+        q.submit(r)
+    live, _ = q.take_batch(10, 0.0)
+    order = [r.tenant for r in live]
+    # slow's half-credit banks: it pops on every SECOND ring turn but
+    # still drains completely (no starvation, no infinite loop)
+    assert order.count("slow") == 2 and order.count("fast") == 2
+    assert order[0] == "fast" or order[1] == "fast"
+
+
+def test_parse_weights_tolerant():
+    assert parse_weights("a:2,b:0.5") == {"a": 2.0, "b": 0.5}
+    assert parse_weights(" gold : 4 ; free : 1 ") == \
+        {"gold": 4.0, "free": 1.0}
+    # malformed entries skip; zero/negative weights floor positive
+    w = parse_weights("bad,x:oops,ok:3,z:-1")
+    assert w["ok"] == 3.0 and w["z"] == pytest.approx(1e-3)
+    assert "bad" not in w and "x" not in w
+    assert parse_weights("") == {}
+
+
+def test_starvation_regression_light_tenant_bounded(tmp_path):
+    """ISSUE 13 acceptance: a heavy tenant's burst must not starve a
+    light tenant.  Heavy floods 10 requests before light's single
+    request even queues; with the DRR pop the light request rides the
+    FIRST batch, so its queue-wait stays near the minimum while
+    heavy's tail pays for its own burst."""
+    srv = serve.Server(str(tmp_path / "fair.sock"), batch_max=2,
+                       tenant_cap=16, batch_window=0.0).start()
+    try:
+        with serve.Client(srv.path, timeout=60.0) as c:
+            c.scale(X, a=1.0)  # compile once
+        srv.hold()
+        done = []
+
+        def worker(tenant):
+            with serve.Client(srv.path, timeout=60.0,
+                              tenant=tenant) as c:
+                c.scale(X, a=2.0)
+                done.append(tenant)
+
+        threads = [threading.Thread(target=worker, args=("heavy",))
+                   for _ in range(10)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        while len(srv._queue) < 10 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        lt = threading.Thread(target=worker, args=("light",))
+        lt.start()  # the light request queues LAST
+        while len(srv._queue) < 11 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        srv.release()
+        for t in threads + [lt]:
+            t.join(timeout=60.0)
+        assert len(done) == 11
+        hists = srv.stats()["obs"]["histograms"]
+        light = hists["serve.queue_wait_ms.t.light"]
+        heavy = hists["serve.queue_wait_ms.t.heavy"]
+        assert light["count"] == 1 and heavy["count"] == 10
+        # the light request popped in the first DRR round: its wait is
+        # bounded by the FIRST batch, not the heavy backlog's tail
+        assert light["max"] < heavy["max"], (light, heavy)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica router
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_stable_and_bounded_rehash():
+    paths = [f"/tmp/r{i}.sock" for i in range(4)]
+    ring1 = serve.HashRing(paths)
+    ring2 = serve.HashRing(list(paths))
+    tenants = [f"tenant{i}" for i in range(64)]
+    before = {t: ring1.lookup(t) for t in tenants}
+    # placement is process-independent (sha1, not salted hash())
+    assert before == {t: ring2.lookup(t) for t in tenants}
+    assert len(set(before.values())) > 1, "all tenants on one replica"
+    ring1.remove(paths[0])
+    moved = [t for t in tenants if ring1.lookup(t) != before[t]]
+    # ONLY the dead replica's tenants moved (consistent hashing)
+    assert all(before[t] == paths[0] for t in moved)
+    assert all(ring1.lookup(t) == before[t] for t in tenants
+               if before[t] != paths[0])
+
+
+def test_router_fleet_tenant_affinity_and_stats(tmp_path):
+    fleet = serve.Router(str(tmp_path / "f"), replicas=2, cpu=True,
+                         batch_window=0.0).start()
+    try:
+        with serve.RouterClient(fleet.paths(), tenant="alice",
+                                timeout=60.0) as rc:
+            assert abs(rc.reduce(np.ones(64, np.float32)) - 64.0) \
+                < 1e-3
+            # resident state follows tenant affinity: put and ref land
+            # on the SAME replica without the caller knowing which
+            rc.put("feat", X)
+            assert abs(rc.reduce(serve.Ref("feat")) - X.sum()) < 1e-3
+            # a second tenant routes independently (possibly the other
+            # replica) and its ops work through the same front
+            assert abs(rc.reduce(np.ones(8, np.float32),
+                                 tenant="bob") - 8.0) < 1e-3
+            st = rc.stats()
+            assert len(st) == 2
+            assert sum(s["requests"] for s in st.values()) >= 3
+    finally:
+        fleet.stop()
+
+
+def test_router_dead_replica_rehash_with_story_marker(tmp_path):
+    fleet = serve.Router(str(tmp_path / "k"), replicas=2, cpu=True,
+                         batch_window=0.0).start()
+    try:
+        with serve.RouterClient(fleet.paths(), tenant="alice",
+                                timeout=60.0) as rc:
+            assert abs(rc.reduce(np.ones(32, np.float32)) - 32.0) \
+                < 1e-3
+            victim = rc.route("alice")
+            next(s for s in fleet._servers if s.path == victim).stop()
+            # the next op re-hashes onto the survivor and SUCCEEDS
+            assert abs(rc.reduce(np.ones(16, np.float32)) - 16.0) \
+                < 1e-3
+            assert rc.rehashes == 1
+            assert rc.live_replicas() == \
+                [p for p in fleet.paths() if p != victim]
+            story = resilience.degradation_story()
+            assert story is not None
+            assert story["serve"]["router_dead"] == 1
+            assert "re-hashed" in story["serve"]["router_reason"]
+            # killing the LAST replica surfaces the degrade signal
+            next(s for s in fleet._servers
+                 if s.path != victim).stop()
+            with pytest.raises(resilience.RelayDownError):
+                rc.reduce(np.ones(8, np.float32))
+    finally:
+        fleet.stop()
+        serve.reset()
+
+
+def test_router_route_fault_site_classified(tmp_path):
+    fleet = serve.Router(str(tmp_path / "rf"), replicas=1, cpu=True,
+                         batch_window=0.0).start()
+    try:
+        with serve.RouterClient(fleet.paths(), timeout=60.0) as rc:
+            with faults.injected("router.route", "program") as sp:
+                with pytest.raises(resilience.ProgramError):
+                    rc.reduce(X)
+                assert sp.fired == 1
+            # a transient from a LIVE replica re-raises (no rehash)
+            with faults.injected("router.route", "transient") as sp:
+                with pytest.raises(resilience.TransientBackendError):
+                    rc.reduce(X)
+                assert sp.fired == 1
+            assert rc.rehashes == 0
+            assert abs(rc.reduce(np.ones(8, np.float32)) - 8.0) < 1e-3
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# trace_view per-tenant rollup
+# ---------------------------------------------------------------------------
+
+def test_trace_view_per_tenant_rollup(capsys):
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(repo, "tools", "trace_view.py"))
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    events = []
+    sid = 0
+    for tenant, qw_us, total_us, n in (("heavy", 5000, 9000, 3),
+                                       ("light", 100, 1200, 2)):
+        for i in range(n):
+            sid += 1
+            events.append({"ph": "X", "name": "serve.request",
+                           "id": sid, "ts": sid * 100000,
+                           "dur": total_us,
+                           "args": {"op": "reduce", "tenant": tenant,
+                                    "rid": str(sid)}})
+            events.append({"ph": "X", "name": "serve.queue_wait",
+                           "ts": sid * 100000, "dur": qw_us,
+                           "args": {"parent": sid}})
+    tv.summarize(events)
+    out = capsys.readouterr().out
+    assert "per-tenant rollup" in out
+    heavy = next(l for l in out.splitlines()
+                 if l.strip().startswith("heavy"))
+    light = next(l for l in out.splitlines()
+                 if l.strip().startswith("light"))
+    assert " 3 " in heavy and "5.000 ms" in heavy  # qw p50
+    assert " 2 " in light and "100 us" in light
+    # service = span remainder after queue-wait
+    assert "4.000 ms" in heavy and "1.100 ms" in light
+
+
+@pytest.mark.slow  # two daemon subprocesses = two jax imports; the
+# fuzz-crank arena arm runs this (client churn x arena exhaustion x
+# replica kill under DR_TPU_CHAOS_ROUNDS)
+def test_router_subprocess_fleet_churn_and_kill(tmp_path):
+    import subprocess  # noqa: F401  (documents the spawn mode)
+    fleet = serve.Router(str(tmp_path / "sub"), replicas=2, cpu=True,
+                         spawn=True).start()
+    try:
+        errs = []
+
+        def churn(i):
+            try:
+                rng = np.random.default_rng(i)
+                for r in range(4):
+                    with serve.RouterClient(
+                            fleet.paths(), tenant=f"t{i}",
+                            timeout=120.0) as rc:
+                        x = rng.standard_normal(1 << 15) \
+                            .astype(np.float32)
+                        got = rc.scale(x, a=2.0)
+                        np.testing.assert_allclose(got, x * 2.0,
+                                                   rtol=1e-6)
+            except resilience.ResilienceError:
+                pass  # classified is an acceptable churn outcome
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=churn, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240.0)
+        assert not errs, errs[:3]
+        # kill replica 0 mid-fleet: a fresh RouterClient re-hashes
+        # onto the survivor and still serves
+        fleet._procs[0].kill()
+        fleet._procs[0].wait(timeout=30)
+        with serve.RouterClient(fleet.paths(), tenant="after",
+                                timeout=120.0) as rc:
+            assert abs(rc.reduce(np.ones(64, np.float32)) - 64.0) \
+                < 1e-3
+    finally:
+        fleet.stop()
+        serve.reset()

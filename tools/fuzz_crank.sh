@@ -214,4 +214,25 @@ if [ -z "$FILTER" ]; then
     rc=1
   fi
 fi
+# ARENA arm (ISSUE 13, docs/SPEC.md SS19): the serving data plane
+# under churn — parallel-client arena stress against a SMALL segment
+# (slot recycling + exhaustion fallbacks), the full in-process
+# dataplane suite, and the subprocess fleet churn x replica-kill leg
+# (crank-budgeted via DR_TPU_CHAOS_ROUNDS rounds of the whole file).
+# Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  echo "=== tests/test_serve_dataplane.py (arena arm, rounds=$CHAOS_ROUNDS) ==="
+  r=0
+  while [ "$r" -lt "$CHAOS_ROUNDS" ]; do
+    DR_TPU_SERVE_ARENA_BYTES=$((1 << 20)) \
+      python -m pytest tests/test_serve_dataplane.py -q 2>&1 | tail -2
+    st=${PIPESTATUS[0]}
+    if [ "$st" -ne 0 ]; then
+      echo "FAILED ($st): tests/test_serve_dataplane.py arena arm (round $r)"
+      rc=1
+      break
+    fi
+    r=$((r + 1))
+  done
+fi
 exit $rc

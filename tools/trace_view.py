@@ -14,7 +14,11 @@ or CI log needs the story without a browser; this tool prints:
   dispatches/compiles, log lines);
 * **per-request serve breakdown** — for each `serve.request` span,
   queue-wait (its retroactive child span), the batch-flush span it
-  links into, and total latency, with aggregate mean/max.
+  links into, and total latency, with aggregate mean/max;
+* **per-tenant serve rollup** — p50/p95 queue-wait and service per
+  tenant, so weighted-fair isolation (docs/SPEC.md §19.4) is visible
+  straight from a trace: a heavy tenant's queue-wait dilates while a
+  light tenant's stays flat.
 
 Usage::
 
@@ -80,6 +84,15 @@ def _close(stack: list, agg: dict) -> None:
     a["total"] += dur
     a["self"] += max(0, dur - child)
     a["count"] += 1
+
+
+def _pct(vals, q) -> float:
+    """Nearest-rank percentile over a small sample list (0 when
+    empty) — no numpy dependency for a log-summarizer."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))]
 
 
 def fmt_us(us) -> str:
@@ -158,6 +171,29 @@ def summarize(events: List[dict], top: int = 15,
         n = len(reqs)
         print(f"  mean total {fmt_us(tot / n)}, mean queue-wait "
               f"{fmt_us(qws / n)}, worst {fmt_us(worst)}", file=out)
+
+        # ---- per-tenant rollup (weighted-fair isolation, SPEC §19.4)
+        by_tenant: dict = defaultdict(lambda: {"qw": [], "sv": []})
+        for s in reqs:
+            a = s.get("args") or {}
+            qw = qw_by_parent.get(s.get("id"), 0)
+            row = by_tenant[a.get("tenant", "?")]
+            row["qw"].append(qw)
+            # service = the span's remainder once queue-wait is out
+            row["sv"].append(max(0, s.get("dur", 0) - qw))
+        if len(by_tenant) >= 1:
+            print("\nserve per-tenant rollup (queue-wait / service):",
+                  file=out)
+            print(f"  {'tenant':<12} {'n':>5} {'qw p50':>12} "
+                  f"{'qw p95':>12} {'sv p50':>12} {'sv p95':>12}",
+                  file=out)
+            for tenant in sorted(by_tenant):
+                row = by_tenant[tenant]
+                print(f"  {tenant:<12} {len(row['qw']):>5} "
+                      f"{fmt_us(_pct(row['qw'], 50)):>12} "
+                      f"{fmt_us(_pct(row['qw'], 95)):>12} "
+                      f"{fmt_us(_pct(row['sv'], 50)):>12} "
+                      f"{fmt_us(_pct(row['sv'], 95)):>12}", file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

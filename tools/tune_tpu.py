@@ -7,7 +7,7 @@ on tunneled backends, so each timed call returns one device scalar.
 
 Usage:  python tools/tune_tpu.py
         [stencil|scan|dot|spmv|heat|attn|halo|sort|pipeline|
-         relational|redistribute|all]
+         relational|redistribute|serve|all]
 
 Prints one line per configuration; safe to re-run (all programs cached
 per process).  This is a developer tool, not part of the bench contract.
@@ -697,6 +697,111 @@ def tune_redistribute():
                 v = None
 
 
+def tune_serve():
+    """Serving data-plane ladder (ISSUE 13, docs/SPEC.md §19) for the
+    queued silicon session: closed-loop p50 / rps over batch window x
+    arena x replica count.  On a real-TPU session the PRIMARY daemon
+    holds the device claim and the replica rungs stay CPU-route (the
+    one-claim rule) — the numbers that matter on chip are the batch
+    window and the arena A/B against the device daemon."""
+    import tempfile
+    import threading
+
+    import dr_tpu
+    from dr_tpu import serve
+    from dr_tpu.utils.env import env_override
+
+    dr_tpu.init()
+    tmpdir = tempfile.mkdtemp(prefix="dr_tpu_tune_serve_")
+    rng = np.random.default_rng(19)
+    xb = rng.standard_normal(2 ** 18).astype(np.float32)  # 1 MiB
+    nreqs = 16
+
+    def closed_loop(path, arena, nclients=2):
+        lat = [[] for _ in range(nclients)]
+
+        def worker(i):
+            with serve.Client(path, timeout=240.0,
+                              tenant=f"t{i}", arena=arena) as c:
+                c.scale(xb, a=1.0)  # warm
+                for r in range(nreqs):
+                    t0 = time.perf_counter()
+                    c.scale(xb, a=1.0 + r * 1e-6)
+                    lat[i].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(nclients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        flat = sorted(v for l in lat for v in l)
+        p50 = flat[len(flat) // 2] if flat else float("nan")
+        return p50 * 1e3, (len(flat) / wall if wall else 0.0)
+
+    for window in (0.0, 0.002, 0.01):
+        for arena in (False, True):
+            srv = None
+            try:
+                srv = serve.Server(
+                    os.path.join(tmpdir,
+                                 f"s{int(window * 1e4)}_{arena}.sock"),
+                    batch_window=window).start()
+                p50, rps = closed_loop(srv.path, arena)
+                print(f"serve window={window * 1e3:5.1f} ms "
+                      f"arena={'on ' if arena else 'off'}: "
+                      f"p50 {p50:8.2f} ms  {rps:8.1f} req/s",
+                      flush=True)
+            except Exception as e:
+                print(f"serve window={window} arena={arena}: FAIL "
+                      f"{_errline(e)}", flush=True)
+            finally:
+                if srv is not None:
+                    srv.stop()
+
+    for nrep in (1, 2, 4):
+        fleet = None
+        try:
+            with env_override(DR_TPU_SERVE_ARENA="1"):
+                fleet = serve.Router(
+                    os.path.join(tmpdir, f"fleet{nrep}"),
+                    replicas=nrep, cpu=True,
+                    batch_window=0.0).start()
+            lat: list = []
+            nclients = 4
+
+            def rworker(i):
+                with serve.RouterClient(fleet.paths(),
+                                        tenant=f"rt{i}",
+                                        timeout=240.0) as rc:
+                    rc.scale(xb, a=1.0)
+                    for r in range(nreqs):
+                        t0 = time.perf_counter()
+                        rc.scale(xb, a=1.0 + r * 1e-6)
+                        lat.append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=rworker, args=(i,))
+                       for i in range(nclients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            flat = sorted(lat)
+            p50 = flat[len(flat) // 2] * 1e3 if flat else float("nan")
+            print(f"serve replicas={nrep}: p50 {p50:8.2f} ms  "
+                  f"{len(flat) / wall:8.1f} req/s", flush=True)
+        except Exception as e:
+            print(f"serve replicas={nrep}: FAIL {_errline(e)}",
+                  flush=True)
+        finally:
+            if fleet is not None:
+                fleet.stop()
+
+
 if __name__ == "__main__":
     # Guarded first backend touch through the SAME degradation router
     # as bench.py and entry() (utils/resilience): a dead relay degrades
@@ -735,6 +840,8 @@ if __name__ == "__main__":
             tune_relational()
         if what in ("redistribute", "all"):
             tune_redistribute()
+        if what in ("serve", "all"):
+            tune_serve()
         for nm in ("dot", "heat", "attn", "halo", "spmv"):
             if what in (nm, "all"):
                 tune_container(nm)
