@@ -95,6 +95,15 @@ replicas on this host), and ``detail.serve_tenants`` (per-tenant
 queue-wait/service p50/p95 under a skewed heavy/light load — the
 weighted-fair no-starvation evidence).
 
+Round 18 (the serving control plane, docs/SPEC.md §20): ``--serve``
+adds ``detail.serve_restart`` — the classified-error count and p99 a
+closed-loop client sees while the 2-replica fleet restarts, once
+through the graceful drain protocol (``rolling_restart``: zero
+errors expected — tenants re-hash BEFORE each replica dies) and once
+through an abrupt replica crash + respawn (the breaker re-hash
+absorbs it; the resident journal brings tenant state back).  Argv
+and env survive the CPU-fallback re-execs, as with every serve leg.
+
 Round 16: ``--redistribute`` (or DR_TPU_BENCH_REDISTRIBUTE=1 — argv
 and env both survive the CPU-fallback re-execs) races the two
 re-layout impls (docs/SPEC.md §18) over a layout ping-pong, emitting
@@ -1224,6 +1233,86 @@ def _serve_metrics(on_cpu: bool) -> dict:
                     fleet.stop()
             if router:
                 out["serve_router"] = router
+
+            # rolling-restart availability (ISSUE 14, SPEC §20.6):
+            # classified-error count + p99 seen by a closed-loop
+            # client while the 2-replica fleet restarts — once via
+            # the graceful drain protocol (rolling_restart: zero
+            # errors expected) and once via an abrupt replica crash +
+            # respawn (the breaker re-hash absorbs it; the journal
+            # brings resident state back).  CPU sessions only, like
+            # the router leg above.
+            from dr_tpu.utils.env import env_override
+            from dr_tpu.utils import resilience as _res
+            restart = {}
+            for label in ("drain", "crash"):
+                fleet = serve.Router(
+                    os.path.join(tmpdir, f"cp_{label}"), replicas=2,
+                    cpu=True, batch_window=0.0,
+                    state_dir=os.path.join(tmpdir, f"cps_{label}"))
+                errors, rlat2 = [], []
+                stop_evt = threading.Event()
+
+                def aworker(fleet=fleet, errors=errors, rlat2=rlat2,
+                            stop_evt=stop_evt):
+                    try:
+                        with serve.RouterClient(fleet.paths(),
+                                                tenant="avail",
+                                                timeout=cto) as rc:
+                            rc.scale(xs, a=1.0)  # warm
+                            while not stop_evt.is_set():
+                                t0 = time.perf_counter()
+                                try:
+                                    rc.scale(xs, a=1.0)
+                                    rlat2.append(
+                                        time.perf_counter() - t0)
+                                except _res.ResilienceError as e:
+                                    errors.append(
+                                        type(e).__name__)
+                    except Exception as e:  # pragma: no cover
+                        errors.append(repr(e)[:80])
+
+                try:
+                    fleet.start()
+                    # paced probes, NOT 0.0: zero delays let a tight
+                    # client loop burn the whole probe budget inside
+                    # one restart's downtime (the replica would never
+                    # re-admit)
+                    with env_override(DR_TPU_SERVE_PROBE_S="0.01"):
+                        t = threading.Thread(target=aworker)
+                        t.start()
+                        time.sleep(0.2)
+                        if label == "drain":
+                            fleet.rolling_restart()
+                        else:
+                            # abrupt stop = the crash; restart = the
+                            # supervisor's respawn step.  Kill the
+                            # replica the tenant actually hashes to —
+                            # killing the other one would measure an
+                            # undisturbed fleet.
+                            from dr_tpu.serve.router import HashRing
+                            victim = fleet.paths().index(
+                                HashRing(fleet.paths())
+                                .lookup("avail"))
+                            fleet._servers[victim].stop()
+                            time.sleep(0.1)
+                            fleet.restart_replica(victim)
+                        time.sleep(0.3)
+                        stop_evt.set()
+                        t.join(timeout=60.0)
+                    row = {"classified_errors": len(errors),
+                           "requests": len(rlat2)}
+                    if rlat2:
+                        row["p99_ms"] = round(float(
+                            np.percentile(np.array(rlat2), 99)) * 1e3,
+                            2)
+                    if errors:
+                        row["error_classes"] = sorted(set(errors))[:4]
+                    restart[label] = row
+                finally:
+                    stop_evt.set()
+                    fleet.stop()
+            out["serve_restart"] = restart
     except Exception as e:  # pragma: no cover - defensive
         out["serve_error"] = repr(e)[:160]
     finally:

@@ -330,8 +330,12 @@ def _on_site(site: str, ctx: dict) -> None:
     # the site echo would double every entry
     if site in ("dispatch.cache", "device.lost"):
         return
+    # ctx keys are site-chosen and may collide with event()'s own
+    # parameters (a site firing with name=... must not TypeError the
+    # traced hot path) — prefix the reserved ones
     event(site, cat="site",
-          **{k: str(v)[:80] for k, v in ctx.items()})
+          **{(f"ctx_{k}" if k in ("name", "cat") else k): str(v)[:80]
+             for k, v in ctx.items()})
 
 
 def _on_fault(site: str, kind: str) -> None:

@@ -20,20 +20,30 @@ runs one daemon foreground.
 """
 
 from .arena import Arena, ClientArena
-from .client import Client, Ref
+from .client import (Client, Ref, reset_retry_budget,
+                     shared_retry_budget)
 from .daemon import (OPS, Server, daemon_alive, default_socket_path,
                      reset_state)
+from .journal import Journal
 from .queue import AdmissionQueue, Request
+from .router import CircuitBreaker, HashRing, Router, RouterClient
 from .resident import ResidentCache
-from .router import HashRing, Router, RouterClient
 
 __all__ = ["Server", "Client", "Ref", "AdmissionQueue", "Request",
            "OPS", "Arena", "ClientArena", "ResidentCache", "HashRing",
-           "Router", "RouterClient", "daemon_alive",
-           "default_socket_path", "reset"]
+           "Router", "RouterClient", "CircuitBreaker", "Journal",
+           "daemon_alive", "default_socket_path",
+           "shared_retry_budget", "reset"]
 
 
 def reset() -> None:
-    """Stop any live in-process servers and clear the serve env
-    markers (the tests' between-test hygiene hook)."""
+    """Stop any live in-process servers AND spawned fleets, clear the
+    serve env markers, drop the shared retry budget, and unlink the
+    journal files this process touched (the tests' between-test
+    hygiene hook)."""
+    from . import journal as _journal
+    from . import router as _router
+    _router.reset_state()  # fleets first: their daemons die with them
     reset_state()
+    reset_retry_budget()
+    _journal.reset_state()

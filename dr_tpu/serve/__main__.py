@@ -34,13 +34,18 @@ def main(argv=None) -> int:
                          "supervisor never probes this daemon for a "
                          "device-route re-promotion (docs/SPEC.md "
                          "§16.6)")
+    ap.add_argument("--state-dir", default=None,
+                    help="crash-safe resident-state journal directory "
+                         "(docs/SPEC.md §20.4; default: "
+                         "$DR_TPU_SERVE_STATE_DIR, unset = resident "
+                         "state is process-memory only)")
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
     from ..utils import resilience
     from .daemon import Server
-    srv = Server(args.socket, cpu=args.cpu)
+    srv = Server(args.socket, cpu=args.cpu, state_dir=args.state_dir)
     try:
         srv.start()
     except Exception as e:
@@ -53,10 +58,26 @@ def main(argv=None) -> int:
           flush=True)
 
     def _term(signum, frame):  # pragma: no cover - signal path
-        srv.stop()
+        # SIGTERM is the GRACEFUL stop (SPEC §20.3): drain — stop
+        # admitting, finish in-flight batches, flush the journal —
+        # then exit.  On a helper thread: drain blocks up to the
+        # drain timeout, and a signal handler must not.
+        import threading
+
+        def _drain():
+            try:
+                srv.drain()
+            except resilience.ResilienceError:
+                srv.stop()  # faulted drain: hard stop still exits
+
+        threading.Thread(target=_drain, name="serve-sigterm-drain",
+                         daemon=True).start()
+
+    def _int(signum, frame):  # pragma: no cover - signal path
+        srv.stop()  # SIGINT (^C): immediate stop, as before
 
     signal.signal(signal.SIGTERM, _term)
-    signal.signal(signal.SIGINT, _term)
+    signal.signal(signal.SIGINT, _int)
     srv.wait()
     srv.stop()
     print(json.dumps({"served": srv.stats()}), flush=True)

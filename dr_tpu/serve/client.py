@@ -23,12 +23,16 @@ taken), reconnecting first when the failure invalidated the
 connection.  The default is ONE attempt: an overload rejection is
 information the caller may want to act on, so backoff is opt-in.
 ``RelayDownError`` (nothing listening) never retries — that is the
-router's degrade signal, not a blip.
+router's degrade signal, not a blip.  Every retry draws from the
+process-wide shared token budget (``DR_TPU_SERVE_RETRY_BUDGET``, SPEC
+§20.2): a fleet-wide failure drains the bucket once, fleet-wide, and
+then fails fast classified instead of feeding a retry storm.
 """
 
 from __future__ import annotations
 
 import socket
+import threading
 from typing import Optional
 
 import numpy as np
@@ -38,12 +42,45 @@ from ..utils.env import env_float, env_int
 from . import arena as _arena
 from . import protocol
 
-__all__ = ["Client", "Ref"]
+__all__ = ["Client", "Ref", "shared_retry_budget", "reset_retry_budget"]
 
 #: control ops that never stage payloads through the arena (they have
 #: none, or they ARE the arena's own lease/release round trips)
 _CONTROL_OPS = frozenset(
-    ("ping", "stats", "shutdown", "arena_alloc", "arena_release"))
+    ("ping", "stats", "shutdown", "drain", "arena_alloc",
+     "arena_release"))
+
+# ---------------------------------------------------------------------------
+# shared retry budget (docs/SPEC.md §20.2)
+# ---------------------------------------------------------------------------
+# ONE bucket per process, drawn on by every Client and RouterClient
+# retry: without it, per-request retries compose with the router's
+# replica re-hash into an unbounded fleet-level retry multiplier — the
+# storm that amplifies exactly the overload it is retrying through.
+
+_budget_lock = threading.Lock()
+_shared_budget: Optional[resilience.TokenBudget] = None
+
+
+def shared_retry_budget() -> resilience.TokenBudget:
+    """The process-wide retry :class:`~..utils.resilience.TokenBudget`
+    (capacity ``DR_TPU_SERVE_RETRY_BUDGET``, refilled by
+    ``DR_TPU_SERVE_RETRY_RATIO`` of a token per successful request)."""
+    global _shared_budget
+    with _budget_lock:
+        if _shared_budget is None:
+            _shared_budget = resilience.TokenBudget(
+                env_int("DR_TPU_SERVE_RETRY_BUDGET", 8, floor=0),
+                env_float("DR_TPU_SERVE_RETRY_RATIO", 0.1))
+        return _shared_budget
+
+
+def reset_retry_budget() -> None:
+    """Drop the shared bucket (refilled lazily from env) — the
+    between-test hygiene hook (serve.reset)."""
+    global _shared_budget
+    with _budget_lock:
+        _shared_budget = None
 
 
 class Ref:
@@ -75,12 +112,18 @@ class Client:
                  timeout: Optional[float] = None,
                  tenant: str = "default",
                  retries: Optional[int] = None,
-                 arena: Optional[bool] = None):
+                 arena: Optional[bool] = None,
+                 budget: Optional[resilience.TokenBudget] = None):
         from .daemon import default_socket_path
         self.path = path or default_socket_path()
         self.tenant = tenant
         self.retries = max(1, env_int("DR_TPU_SERVE_CLIENT_RETRIES", 1)
                            if retries is None else int(retries))
+        # every retry draws from ONE shared process-wide token bucket
+        # (SPEC §20.2) unless the caller threads its own — the fix for
+        # the per-request × per-replica retry multiplier
+        self._budget = (shared_retry_budget() if budget is None
+                        else budget)
         self._next_id = 0
         self._timeout = (env_float("DR_TPU_SERVE_DEADLINE", 30.0) + 10.0
                          if timeout is None else timeout)
@@ -170,9 +213,11 @@ class Client:
         (seeded backoff, overloads included, deadline-aware); at the
         default single attempt, reconnect with a fresh Client."""
         if self.retries <= 1:
-            return self._request_once(op, arrays, params,
-                                      deadline_s=deadline_s,
-                                      tenant=tenant)
+            out = self._request_once(op, arrays, params,
+                                     deadline_s=deadline_s,
+                                     tenant=tenant)
+            self._budget.note_success()
+            return out
 
         def attempt():
             if self._broken or self._sock is None:
@@ -182,11 +227,13 @@ class Client:
                                       deadline_s=deadline_s,
                                       tenant=tenant)
 
-        return resilience.retry(
+        out = resilience.retry(
             attempt, attempts=self.retries,
             retry_on=(resilience.TransientBackendError,
                       resilience.ServerOverloaded),
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, budget=self._budget)
+        self._budget.note_success()
+        return out
 
     # ------------------------------------------------------- arena plumbing
     def _ensure_arena(self) -> None:
@@ -308,8 +355,12 @@ class Client:
                 f"serve: request {op!r} timed out waiting for the "
                 "daemon", site="serve.request")
         except OSError as e:
+            # the connection died under the exchange (broken pipe /
+            # reset when the daemon stopped): the same retryable
+            # class as a torn wire frame — classified() would text-
+            # match "broken pipe" into the deterministic bucket
             self._invalidate("socket error mid-request")
-            raise resilience.classified(
+            raise resilience.TransientBackendError(
                 f"serve: connection to {self.path} failed mid-request: "
                 f"{e!r}", site="serve.request")
         if reply is None:
@@ -363,6 +414,13 @@ class Client:
 
     def shutdown(self) -> dict:
         return self.request("shutdown")
+
+    def drain(self) -> dict:
+        """Ask the daemon to drain gracefully (SPEC §20.3): it stops
+        admitting, finishes in-flight batches, flushes the resident
+        journal, and exits.  Returns the acknowledgement; the daemon
+        closes this connection once the drain is scheduled."""
+        return self.request("drain")
 
     # ------------------------------------- resident cache (§19.2)
     def put(self, name: str, x, **kw) -> dict:

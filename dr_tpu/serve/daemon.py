@@ -59,7 +59,17 @@ Robustness contract (chaos-swept via the ``serve.accept`` /
   ``elastic.grow_count()`` exactly like shrinks);
 * a stale socket file from a dead daemon is taken over at start; a
   LIVE daemon makes a second ``start()`` fail with a classified error
-  before the newcomer can race the claim.
+  before the newcomer can race the claim;
+* control plane (SPEC §20): ``drain()`` (the ``drain`` wire op and
+  the ``__main__`` SIGTERM handler) stops admitting — new compute
+  requests get a classified ``ServerDraining`` a routed client treats
+  as the re-hash-now signal — finishes the in-flight batches, flushes
+  the resident-state journal, and exits; with
+  ``DR_TPU_SERVE_STATE_DIR`` set, ``put``/``drop`` append to a
+  crash-safe journal (serve/journal.py) replayed at the next start,
+  so a drained or SIGKILLed replica comes back serving its tenants'
+  residents bit-equal — behind a generation fence that stops a stale
+  daemon which lost the socket takeover from ever serving again.
 """
 
 from __future__ import annotations
@@ -81,6 +91,7 @@ from ..utils import resilience
 from ..utils.env import env_float, env_int, env_str
 from ..utils.fallback import warn_fallback
 from . import arena as _arena
+from . import journal as _journal
 from . import protocol
 from .queue import AdmissionQueue, Request
 from .resident import ResidentCache, ResidentStub
@@ -414,6 +425,10 @@ def _v_put(req):
 def _h_put(req):
     entry, cached = req.server._resident.put(req.tenant, _name_of(req),
                                              req.arrays[0])
+    # crash-safe durability (SPEC §20.4): journal the put before the
+    # reply — once the client hears "ok" the entry survives a SIGKILL
+    req.server._journal_put(req.tenant, _name_of(req), entry,
+                            req.arrays[0])
     return lambda: ({"handle": _name_of(req), "tag": entry.tag,
                      "bytes": entry.nbytes, "cached": cached}, [])
 
@@ -427,6 +442,8 @@ def _h_get(req):
 
 def _h_drop(req):
     dropped = req.server._resident.drop(req.tenant, _name_of(req))
+    if dropped:
+        req.server._journal_drop(req.tenant, _name_of(req))
     return lambda: ({"dropped": dropped}, [])
 
 
@@ -476,7 +493,13 @@ _live_servers: "weakref.WeakSet" = weakref.WeakSet()
 #: one test's dead-replica story cannot leak into the next)
 _MARKERS = ("_DR_TPU_SERVE_DEGRADED", "_DR_TPU_SERVE_QUEUE_DEPTH",
             "_DR_TPU_SERVE_SHED", "_DR_TPU_SERVE_RESTARTS",
-            "_DR_TPU_SERVE_ROUTER_DEAD", "_DR_TPU_SERVE_ROUTER_REASON")
+            "_DR_TPU_SERVE_ROUTER_DEAD", "_DR_TPU_SERVE_ROUTER_REASON",
+            # control plane (SPEC §20): drain/respawn/breaker/journal
+            "_DR_TPU_SERVE_DRAINS", "_DR_TPU_SERVE_RESPAWNS",
+            "_DR_TPU_SERVE_ROUTER_DRAINED",
+            "_DR_TPU_SERVE_ROUTER_RECOVERED",
+            "_DR_TPU_SERVE_JOURNAL_RECOVERED",
+            "_DR_TPU_SERVE_JOURNAL_TRUNCATED")
 
 
 def reset_state() -> None:
@@ -508,8 +531,27 @@ class Server:
 
     def __init__(self, socket_path=None, *, queue_depth=None,
                  tenant_cap=None, batch_max=None, batch_window=None,
-                 init_timeout=None, flush_deadline=None, cpu=False):
+                 init_timeout=None, flush_deadline=None, cpu=False,
+                 state_dir=None):
         self.path = socket_path or default_socket_path()
+        # crash-safe resident-state journal (SPEC §20.4): armed by a
+        # state directory (kwarg or DR_TPU_SERVE_STATE_DIR); None =
+        # resident state stays process-memory-only, as before
+        self.state_dir = (env_str("DR_TPU_SERVE_STATE_DIR") or None
+                          if state_dir is None else str(state_dir))
+        self._journal = None
+        self._journal_errors = 0
+        # graceful drain (SPEC §20.3)
+        self._draining = threading.Event()
+        self.drain_timeout = env_float("DR_TPU_SERVE_DRAIN_TIMEOUT",
+                                       30.0)
+        self._drains = 0
+        self._drain_rejects = 0
+        #: replies mid-write (dispatch thread): the drain gate must
+        #: cover the reply send too — the queue slot releases BEFORE
+        #: the reply hits the wire, and a drain that stopped in that
+        #: window would tear the very reply it waited for
+        self._finishing = 0
         #: the REQUESTED route, pinned at construction and persisted
         #: next to the degraded route (SPEC §16.6): a daemon started
         #: with --cpu asked for the CPU claim — the grow supervisor
@@ -595,12 +637,31 @@ class Server:
                                        "on the inline wire only")
                 self._arena = None
         self._bind()
+        if self.state_dir:
+            # journal ownership rides socket ownership (SPEC §20.4):
+            # the generation bump happens right after the bind so a
+            # stale daemon that lost the takeover is fenced from the
+            # state the moment the new owner holds the socket
+            try:
+                self._journal = _journal.Journal(self.state_dir,
+                                                 self.path)
+                self._journal.claim()
+            except (OSError, resilience.ResilienceError) as e:
+                # an unwritable state dir degrades DURABILITY, never
+                # the daemon (SPEC §20.4)
+                self._journal = None
+                self._journal_errors += 1
+                warn_fallback("serve", f"resident journal unavailable "
+                                       f"({e}); serving without "
+                                       "resident durability")
         try:
             self._claim()
+            self._replay_journal()
         except BaseException:
             self.stop()  # a failed claim must release the socket
             raise
         self._stop.clear()
+        self._draining.clear()
         self._stopped.clear()
         for name, fn in (("serve-accept", self._accept_loop),
                          ("serve-dispatch", self._dispatch_loop)):
@@ -722,6 +783,150 @@ class Server:
                 pass
             self._publish_markers()
         _live_servers.discard(self)
+
+    def drain(self, timeout=None, *, _fire=True) -> None:
+        """Graceful drain (docs/SPEC.md §20.3; the ``drain`` wire op
+        and the ``__main__`` SIGTERM handler land here): stop
+        admitting — new compute requests are rejected with the
+        classified ``ServerDraining`` a routed client treats as its
+        re-hash-now signal — finish the in-flight batches, flush the
+        resident-state journal (appends are fsync'd, so there is
+        nothing left to lose), publish the markers, and stop.
+        Bounded by ``timeout`` (default ``DR_TPU_SERVE_DRAIN_TIMEOUT``):
+        a wedged batch must not pin the restart forever — on expiry
+        ``stop()`` cancels whatever remains.  Idempotent: a second
+        caller waits for the first drain to complete.  ``_fire=False``
+        skips the fault fire — for callers (the wire op) that already
+        fired it synchronously to deliver a classified rejection."""
+        if _fire:
+            _faults.fire("serve.drain", path=self.path)
+        if self._draining.is_set() or self._stopped.is_set():
+            self._stopped.wait(self.drain_timeout if timeout is None
+                               else float(timeout))
+            return
+        self._draining.set()
+        self._drains += 1
+        os.environ["_DR_TPU_SERVE_DRAINS"] = \
+            str(env_int("_DR_TPU_SERVE_DRAINS", 0, floor=0) + 1)
+        _obs.event("serve.drain", cat="serve", path=self.path)
+        deadline = time.monotonic() + (self.drain_timeout
+                                       if timeout is None
+                                       else float(timeout))
+        while time.monotonic() < deadline:
+            if self._queue.idle() and not self._finishing:
+                break
+            time.sleep(0.005)
+        self.stop()
+
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    # ------------------------------------------------- resident journal
+    def _replay_journal(self) -> None:
+        """Replay the resident-state journal into the cache (SPEC
+        §20.4): a drained or SIGKILLed replica comes back serving its
+        tenants' residents bit-equal, then the journal compacts to
+        the live set (atomic rewrite).  A torn tail truncates cleanly
+        inside ``Journal.replay`` (marker published); any classified
+        replay failure degrades to an EMPTY resident cache — a
+        corrupt journal must never brick the daemon."""
+        if self._journal is None:
+            return
+        try:
+            live = self._journal.replay()
+            for (tenant, name), (_tag, payload) in live.items():
+                arr = _journal.decode_payload(payload)
+                self._resident.put(tenant, name, arr)
+        except (OSError, resilience.ResilienceError) as e:
+            self._journal_errors += 1
+            # entries replayed BEFORE the failure must not linger: a
+            # partial resident set served as if whole is a silent
+            # wrong answer — empty is the honest state
+            self._resident.clear()
+            warn_fallback("serve", f"resident journal replay failed "
+                                   f"({e}); starting with an empty "
+                                   "resident cache")
+            return
+        try:
+            self._journal.compact(live)
+        except (OSError, resilience.ResilienceError) as e:
+            # compaction failed AFTER a complete replay: compact is
+            # atomic temp+replace, so the old journal is intact on
+            # disk and the replayed residents are whole — keep them
+            self._journal_errors += 1
+            warn_fallback("serve", f"resident journal compaction "
+                                   f"failed ({e}); replayed residents "
+                                   "kept, journal left as-is")
+        if self._journal.replayed:
+            os.environ["_DR_TPU_SERVE_JOURNAL_RECOVERED"] = \
+                str(self._journal.replayed)
+        if self._journal.truncated_bytes:
+            os.environ["_DR_TPU_SERVE_JOURNAL_TRUNCATED"] = \
+                str(self._journal.truncated_bytes)
+            warn_fallback("serve", "resident journal tail was torn "
+                                   f"({self._journal.truncated_bytes} "
+                                   "bytes truncated); every record "
+                                   "before the tear replayed")
+        _obs.event("serve.journal.replay", cat="serve",
+                   entries=self._journal.replayed,
+                   truncated=self._journal.truncated_bytes)
+
+    def _journal_put(self, tenant: str, name: str, entry, arr) -> None:
+        """Journal one resident put (SPEC §20.4).  A generation-fence
+        violation is fatal — the stale daemon stops serving and the
+        classified error reaches the requesting client; any other
+        journal failure degrades DURABILITY (warned, counted), never
+        the request."""
+        jr = self._journal
+        if jr is None or jr.has(tenant, name, entry.tag):
+            return
+        try:
+            jr.append("put", tenant, name, entry.tag,
+                      _arena.npy_bytes(np.ascontiguousarray(
+                          np.asarray(arr, np.float32))))
+        except (OSError, resilience.ResilienceError) as e:
+            self._journal_fail(e)
+
+    def _journal_drop(self, tenant: str, name: str) -> None:
+        jr = self._journal
+        if jr is None:
+            return
+        try:
+            jr.append("drop", tenant, name)
+        except (OSError, resilience.ResilienceError) as e:
+            self._journal_fail(e)
+
+    def _journal_fail(self, e) -> None:
+        self._journal_errors += 1
+        if self._journal is not None and self._journal.fenced:
+            # stale generation (SPEC §20.4): a newer daemon owns the
+            # state — this daemon can never serve again.  Mark, stop
+            # on a helper thread (we are ON the dispatch thread), and
+            # re-raise so the requesting client sees the classified
+            # error instead of a silently un-journaled put.
+            self._mark_degraded(
+                "serve: resident journal fenced (a newer daemon took "
+                "over the socket and the state); stale daemon "
+                "stopping")
+            threading.Thread(target=self._fence_stop,
+                             name="serve-fence-stop",
+                             daemon=True).start()
+            raise e
+        warn_fallback("serve", f"resident journal append failed ({e});"
+                               " durability degraded for this entry")
+
+    def _fence_stop(self) -> None:
+        """Stop a FENCED daemon — but only after the classified fence
+        error (and anything else in flight) has hit the wire: a stop
+        racing the reply write would hand the client a torn socket
+        instead of the ProgramError that explains the death."""
+        self._draining.set()  # a stale daemon must not admit more work
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if self._queue.idle() and not self._finishing:
+                break
+            time.sleep(0.005)
+        self.stop()
 
     def wait(self, timeout=None) -> bool:
         """Block until the daemon is asked to stop (shutdown op /
@@ -875,8 +1080,27 @@ class Server:
             if self._arena is not None:
                 hdr["arena"] = {"name": self._arena.name,
                                 "size": self._arena.size}
+            if self._draining.is_set():
+                # health checks must see a draining daemon as NOT
+                # ready (SPEC §20.3): a breaker probe that re-admitted
+                # a dying replica would defeat the drain announcement
+                hdr["draining"] = True
             self._send(cs, hdr)
             return True
+        if op == "drain":
+            # graceful drain (SPEC §20.3): the fault site fires HERE,
+            # before the ack — a faulted drain must reach the caller
+            # classified (§20.5), not die in the helper thread after
+            # a positive acknowledgement
+            try:
+                _faults.fire("serve.drain", path=self.path)
+            except resilience.ResilienceError as e:
+                self._send(cs, protocol.error_header(e, id=rid))
+                return True
+            self._send(cs, {"ok": True, "draining": True, "id": rid})
+            threading.Thread(target=lambda: self.drain(_fire=False),
+                             name="serve-drain", daemon=True).start()
+            return False
         if op == "stats":
             self._send(cs, {"ok": True, "stats": self.stats(),
                             "id": rid})
@@ -923,6 +1147,15 @@ class Server:
         req = None
         try:
             _faults.fire("serve.request", op=op)
+            if self._draining.is_set():
+                # admission is closed: reject with the typed drain
+                # signal — a routed client re-hashes the tenant onto
+                # a live replica BEFORE this daemon dies (§20.3)
+                self._drain_rejects += 1
+                raise resilience.ServerDraining(
+                    f"serve: daemon on {self.path} is draining — "
+                    "re-route this tenant to a live replica",
+                    site="serve.request")
             spec = OPS.get(op)
             if spec is None:
                 raise resilience.ProgramError(
@@ -1274,6 +1507,14 @@ class Server:
 
     # ------------------------------------------------------------- replies
     def _finish(self, req: Request, result=None, error=None) -> None:
+        self._finishing += 1
+        try:
+            self._finish_inner(req, result, error)
+        finally:
+            self._finishing -= 1
+
+    def _finish_inner(self, req: Request, result=None,
+                      error=None) -> None:
         self._queue.release(req)
         req.finish(result=result, error=error)
         if req.t_exec is not None:
@@ -1387,6 +1628,9 @@ class Server:
         if self._arena is not None:
             extra["arena"] = self._arena.stats()
         extra["resident"] = self._resident.stats()
+        if self._journal is not None:
+            extra["journal"] = {**self._journal.stats(),
+                                "errors": self._journal_errors}
         return {"requests": self._requests, "replies": self._replies,
                 **extra,
                 "errors": self._errors, "cancelled": self._cancelled,
@@ -1397,6 +1641,9 @@ class Server:
                 "restarts": self._restarts,
                 "shrinks": self._shrinks,
                 "grows": self._grows,
+                "drains": self._drains,
+                "draining": self._draining.is_set(),
+                "drain_rejects": self._drain_rejects,
                 "route": {"requested": self.requested_route,
                           "current": self._route},
                 "degraded": self.degraded,

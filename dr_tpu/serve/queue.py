@@ -197,6 +197,13 @@ class AdmissionQueue:
             self.depth_hw = max(self.depth_hw, self._qn)
             self._cv.notify()
 
+    def idle(self) -> bool:
+        """True when nothing is queued AND no admitted request is
+        still executing (in-flight covers queued + dispatched until
+        :meth:`release`) — the graceful-drain gate (SPEC §20.3)."""
+        with self._cv:
+            return self._qn == 0 and not self._inflight
+
     def release(self, req: Request) -> None:
         """Return ``req``'s tenant slot (request left execution)."""
         with self._cv:

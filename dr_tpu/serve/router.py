@@ -21,6 +21,21 @@ its tenants re-hash onto the survivors, and the event publishes the
 ``_DR_TPU_SERVE_ROUTER_*`` story markers ``degradation_story`` folds
 into the serve chapter — re-homed tenants lose their resident cache
 (it lived in the dead process) and simply rebuild on first use.
+
+Control plane (docs/SPEC.md §20): replica death is no longer
+permanent.  Each replica carries a client-side CIRCUIT BREAKER —
+closed while healthy, OPEN once it fails (tenants re-hash away),
+half-open probed on the seeded ``resilience.backoff_schedule`` (fault
+site ``router.probe``, bounded at ``DR_TPU_SERVE_PROBES``) — and a
+replica that answers its probe healthy re-joins the ring so its
+tenants re-hash BACK.  A replica that announces a DRAIN
+(``ServerDraining``) re-hashes the same way but BEFORE it dies.  In
+spawn mode the :class:`Router` doubles as a passive supervisor
+(polled, never a thread — the ``elastic.GrowSupervisor`` discipline):
+``poll()`` respawns dead replica processes with the same bounded
+backoff, and ``rolling_restart()`` drains + restarts the fleet one
+replica at a time with zero classified client errors on the happy
+path.
 """
 
 from __future__ import annotations
@@ -29,19 +44,96 @@ import bisect
 import hashlib
 import os
 import threading
+import time
+import weakref
 from typing import Dict, List, Optional
 
+from .. import obs as _obs
 from ..obs import metrics as _om
 from ..utils import faults as _faults
 from ..utils import resilience
-from ..utils.env import env_int
+from ..utils.env import env_float, env_int
 from ..utils.fallback import warn_fallback
-from .client import Client
+from .client import Client, shared_retry_budget
 
-__all__ = ["HashRing", "Router", "RouterClient"]
+__all__ = ["HashRing", "Router", "RouterClient", "CircuitBreaker"]
 
 _c_routes = _om.counter("serve.router.routes")
 _c_rehash = _om.counter("serve.router.rehashes")
+_c_probes = _om.counter("serve.router.probes")
+_c_recovered = _om.counter("serve.router.recovered")
+_c_respawns = _om.counter("serve.router.respawns")
+
+#: live Router fleets (serve.reset stops leaks between tests)
+_live_routers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _bump_marker(name: str) -> None:
+    os.environ[name] = str(env_int(name, 0, floor=0) + 1)
+
+
+def replica_ready(path: str, timeout: float = 2.0) -> bool:
+    """Health-check one replica: connectable AND answering pings AND
+    not draining — the breaker-probe predicate (a draining daemon
+    must read NOT ready, or a probe would re-admit a dying replica
+    right after its drain announcement)."""
+    try:
+        c = Client(path, timeout=timeout)
+    except resilience.ResilienceError:
+        return False
+    try:
+        return not c.ping().get("draining")
+    except resilience.ResilienceError:
+        return False
+    finally:
+        c.close()
+
+
+class _ProbeSchedule(resilience.ProbeTimer):
+    """:class:`resilience.ProbeTimer` with the serve-sized knobs
+    (SPEC §20.1): from ``DR_TPU_SERVE_PROBE_S`` doubling to
+    ``DR_TPU_SERVE_PROBE_CAP_S``, bounded at ``DR_TPU_SERVE_PROBES``
+    total — a replica that never comes back is not probed forever."""
+
+    def __init__(self, *, seed: int = 0):
+        super().__init__(env_float("DR_TPU_SERVE_PROBE_S", 0.5),
+                         env_float("DR_TPU_SERVE_PROBE_CAP_S", 30.0),
+                         env_int("DR_TPU_SERVE_PROBES", 16),
+                         seed=seed)
+
+
+class CircuitBreaker:
+    """Per-replica breaker (SPEC §20.1): ``closed`` while healthy;
+    ``trip()`` opens it (the replica leaves the ring); while open,
+    :meth:`due` paces half-open probes on a :class:`_ProbeSchedule`;
+    a healthy probe (:meth:`reset`) closes it — the replica re-joins
+    the ring and its tenants re-hash back."""
+
+    __slots__ = ("path", "state", "seed", "sched", "trips")
+
+    def __init__(self, path: str, *, seed: int = 0):
+        self.path = path
+        self.seed = seed
+        self.state = "closed"
+        self.sched: Optional[_ProbeSchedule] = None
+        self.trips = 0
+
+    def trip(self) -> None:
+        if self.state == "closed":
+            self.trips += 1
+        self.state = "open"
+        self.sched = _ProbeSchedule(seed=self.seed)
+
+    def due(self, now: Optional[float] = None) -> bool:
+        return self.state == "open" and self.sched is not None \
+            and self.sched.due(now)
+
+    def exhausted(self) -> bool:
+        return self.sched is not None and self.sched.exhausted()
+
+    def reset(self) -> None:
+        self.state = "closed"
+        self.sched = None
 
 #: Client op methods the router forwards (everything tenant-scoped);
 #: control ops (stats/ping) have per-replica variants instead.
@@ -124,6 +216,19 @@ class Router:
         self._servers: list = []
         self._procs: list = []
         self._paths: List[str] = []
+        # spawn-mode respawn supervisor state (SPEC §20.1): one
+        # bounded probe schedule per dead replica index, polled —
+        # never a thread
+        self._respawn_scheds: Dict[int, _ProbeSchedule] = {}
+        #: serializes proc mutation between the passive supervisor
+        #: poll (riding client traffic threads) and an explicit
+        #: restart_replica/rolling_restart — without it both can
+        #: respawn the SAME dead index, racing two daemons for one
+        #: socket and leaking whichever Popen handle loses the
+        #: assignment
+        self._spawn_lock = threading.Lock()
+        self.respawns = 0
+        self.restarts = 0
 
     def start(self) -> "Router":
         from .daemon import Server
@@ -144,6 +249,7 @@ class Router:
         except BaseException:
             self.stop()
             raise
+        _live_routers.add(self)
         return self
 
     def _spawn(self, path: str, cpu: bool):
@@ -155,6 +261,9 @@ class Router:
         argv = [sys.executable, "-m", "dr_tpu.serve", "--socket", path]
         if cpu:
             argv.append("--cpu")
+        state_dir = self._server_kw.get("state_dir")
+        if state_dir:
+            argv += ["--state-dir", str(state_dir)]
         proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, text=True)
         line = proc.stdout.readline()
@@ -173,6 +282,136 @@ class Router:
     def paths(self) -> List[str]:
         return list(self._paths)
 
+    # ------------------------------------------------- supervisor (§20.1)
+    def poll(self) -> List[str]:
+        """Passive spawn-mode supervisor poll: respawn dead replica
+        processes with a bounded seeded-backoff probe budget (the
+        ``elastic.GrowSupervisor`` discipline — owners poll between
+        requests, no thread).  Returns the paths respawned this poll;
+        never raises — a failed respawn is warned, counted, and
+        backed off.  In-process fleets have no processes to supervise
+        (:meth:`restart_replica` restarts those explicitly)."""
+        out: List[str] = []
+        if not self.spawn:
+            return out
+        if not self._spawn_lock.acquire(blocking=False):
+            return out  # an explicit restart owns the procs right now
+        try:
+            now = time.monotonic()
+            for i, proc in enumerate(self._procs):
+                if proc is None or proc.poll() is None:
+                    continue  # alive
+                sched = self._respawn_scheds.get(i)
+                if sched is None:
+                    sched = self._respawn_scheds[i] = \
+                        _ProbeSchedule(seed=i)
+                    warn_fallback(
+                        "serve.router",
+                        f"replica {self._paths[i]} died (exit "
+                        f"{proc.returncode}); respawn supervisor "
+                        "armed")
+                if not sched.due(now):
+                    continue
+                sched.advance(now)
+                try:
+                    self._procs[i] = self._spawn(self._paths[i],
+                                                 self.cpu or i > 0)
+                # drlint: ok[R5] poll() must NEVER raise into the client traffic it rides — a Popen OSError is a failed respawn like any classified one: warn and back off
+                except Exception as e:
+                    warn_fallback(
+                        "serve.router",
+                        f"respawn of {self._paths[i]} failed "
+                        f"({type(e).__name__}); backing off "
+                        f"({sched.probes}/{sched.budget})")
+                    continue
+                self._respawn_scheds.pop(i, None)
+                self.respawns += 1
+                _c_respawns.add()
+                _bump_marker("_DR_TPU_SERVE_RESPAWNS")
+                _obs.event("router.respawn", cat="serve",
+                           path=self._paths[i])
+                warn_fallback("serve.router",
+                              f"replica {self._paths[i]} respawned; "
+                              "its tenants re-hash back as breakers "
+                              "re-admit it")
+                out.append(self._paths[i])
+        finally:
+            self._spawn_lock.release()
+        return out
+
+    def restart_replica(self, i: int) -> str:
+        """Restart replica ``i`` in place: drain it if it is alive
+        (its routed tenants re-hash away BEFORE it dies), then start
+        a fresh daemon on the same socket — which replays its
+        resident-state journal when a state dir is armed.  The
+        rolling-restart step; also the bench crash leg's respawn."""
+        path = self._paths[i]
+        cpu = self.cpu or i > 0
+        if self.spawn:
+            with self._spawn_lock:  # the supervisor poll yields
+                proc = self._procs[i]
+                if proc.poll() is None:
+                    try:
+                        with Client(path, timeout=30.0) as c:
+                            c.drain()
+                    except resilience.ResilienceError:
+                        proc.terminate()  # SIGTERM drains (__main__)
+                    try:
+                        proc.wait(timeout=60)
+                    except Exception:  # pragma: no cover - wedged
+                        proc.kill()
+                        proc.wait(timeout=30)
+                self._procs[i] = self._spawn(path, cpu)
+        else:
+            from .daemon import Server
+            srv = self._servers[i]
+            try:
+                srv.drain()
+            except resilience.ResilienceError:
+                srv.stop()  # faulted drain: hard stop, still restart
+            self._servers[i] = Server(path, cpu=cpu,
+                                      **self._server_kw).start()
+        self._respawn_scheds.pop(i, None)
+        self.restarts += 1
+        return path
+
+    def rolling_restart(self, *, ready_timeout: float = 60.0) \
+            -> List[str]:
+        """Drain-and-restart every replica ONE at a time (SPEC
+        §20.3): each replica drains (routed clients re-hash its
+        tenants onto the survivors before it exits), restarts, and
+        must answer a health check before the next replica goes — so
+        at least N-1 replicas serve at every moment and the happy
+        path completes with ZERO classified client errors.  With a
+        state dir armed each restarted replica replays its journal,
+        so tenants' residents survive the whole roll."""
+        out: List[str] = []
+        for i in range(len(self._paths)):
+            path = self.restart_replica(i)
+            deadline = time.monotonic() + ready_timeout
+            while not replica_ready(path):
+                if time.monotonic() >= deadline:
+                    raise resilience.classified(
+                        f"serve.router: restarted replica {path} not "
+                        f"serving within {ready_timeout}s",
+                        site="router.probe")
+                time.sleep(0.01)
+            out.append(path)
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        """Fleet-supervisor counters (the per-daemon stats live on
+        :meth:`RouterClient.stats`)."""
+        if self.spawn:
+            alive = [p for p, proc in zip(self._paths, self._procs)
+                     if proc is not None and proc.poll() is None]
+        else:
+            alive = [s.path for s in self._servers
+                     if not s._stopped.is_set()]
+        return {"replicas": len(self._paths), "alive": alive,
+                "respawns": self.respawns, "restarts": self.restarts,
+                "pending_respawns": len(self._respawn_scheds)}
+
     def stop(self) -> None:
         for srv in self._servers:
             try:
@@ -183,12 +422,14 @@ class Router:
         self._servers = []
         for proc in self._procs:
             try:
-                proc.terminate()  # the daemon's SIGTERM handler stops
+                proc.terminate()  # the daemon's SIGTERM handler drains
                 proc.wait(timeout=30)  # cleanly (socket unlinked)
             except Exception:  # pragma: no cover - teardown
                 proc.kill()
         self._procs = []
         self._paths = []
+        self._respawn_scheds.clear()
+        _live_routers.discard(self)
 
 
 class RouterClient:
@@ -196,16 +437,35 @@ class RouterClient:
     replica and forwards every op to the replica the ring names for
     its tenant.  A dead replica re-hashes (classified story marker);
     when the LAST replica dies the ``RelayDownError`` surfaces — the
-    caller's degrade signal, exactly like a single-daemon client."""
+    caller's degrade signal, exactly like a single-daemon client.
+
+    Control plane (SPEC §20): each replica carries a
+    :class:`CircuitBreaker` — a death/drain opens it (tenants re-hash
+    away) and bounded seeded-backoff half-open probes (fault site
+    ``router.probe``) re-admit it to the ring once it answers healthy,
+    so its tenants re-hash BACK.  ``router=`` attaches a spawn-mode
+    :class:`Router` whose respawn supervisor is polled before each
+    call; every Client this front creates shares ONE process-wide
+    retry token budget (``budget=`` overrides)."""
 
     def __init__(self, paths, *, tenant: str = "default",
-                 vnodes: int = 64, **client_kw):
+                 vnodes: int = 64, router: Optional[Router] = None,
+                 budget=None, **client_kw):
         self.tenant = tenant
         self._ring = HashRing(paths, vnodes=vnodes)
+        self._router = router
+        self._budget = (shared_retry_budget() if budget is None
+                        else budget)
         self._client_kw = dict(client_kw)
+        self._client_kw.setdefault("budget", self._budget)
+        self._breakers: Dict[str, CircuitBreaker] = {
+            p: CircuitBreaker(p, seed=i)
+            for i, p in enumerate(self._ring.keys())}
         self._clients: Dict[str, Client] = {}
         self._lock = threading.Lock()
         self.rehashes = 0
+        self.recoveries = 0
+        self.drain_rehashes = 0
 
     # ------------------------------------------------------------ routing
     def route(self, tenant: Optional[str] = None) -> str:
@@ -229,19 +489,24 @@ class RouterClient:
             c.close()
         return have
 
-    def _mark_dead(self, path: str, err) -> None:
-        """Remove a dead replica from the ring and publish the story
-        marker — its tenants re-hash onto the survivors (bounded by
-        consistent hashing), losing only their resident cache."""
-        self._ring.remove(path)
-        self.rehashes += 1
-        _c_rehash.add()
+    def _drop_client(self, path: str) -> None:
         with self._lock:
             c = self._clients.pop(path, None)
         if c is not None:
             c.close()
-        os.environ["_DR_TPU_SERVE_ROUTER_DEAD"] = \
-            str(env_int("_DR_TPU_SERVE_ROUTER_DEAD", 0, floor=0) + 1)
+
+    def _mark_dead(self, path: str, err) -> None:
+        """Remove a dead replica from the ring, OPEN its breaker (the
+        probe schedule will re-admit it if it comes back — SPEC
+        §20.1), and publish the story marker — its tenants re-hash
+        onto the survivors (bounded by consistent hashing), losing
+        only their resident cache."""
+        self._ring.remove(path)
+        self._breakers.setdefault(path, CircuitBreaker(path)).trip()
+        self.rehashes += 1
+        _c_rehash.add()
+        self._drop_client(path)
+        _bump_marker("_DR_TPU_SERVE_ROUTER_DEAD")
         os.environ["_DR_TPU_SERVE_ROUTER_REASON"] = \
             (f"replica {path} unreachable "
              f"({type(err).__name__}); tenants re-hashed onto "
@@ -250,12 +515,98 @@ class RouterClient:
                       f"replica {path} unreachable; re-hashing its "
                       "tenants onto the survivors")
 
+    def _mark_draining(self, path: str, err) -> None:
+        """A replica ANNOUNCED its drain (SPEC §20.3): re-hash its
+        tenants NOW — before it dies, not after — and open its
+        breaker so the restarted daemon re-joins via the probe
+        schedule.  A planned handoff: no dead-replica marker, no
+        degradation reason."""
+        self._ring.remove(path)
+        self._breakers.setdefault(path, CircuitBreaker(path)).trip()
+        self.drain_rehashes += 1
+        _c_rehash.add()
+        self._drop_client(path)
+        _bump_marker("_DR_TPU_SERVE_ROUTER_DRAINED")
+        _obs.event("router.drain_rehash", cat="serve", path=path)
+
+    def _readmit(self, path: str) -> None:
+        br = self._breakers.get(path)
+        if br is not None:
+            br.reset()
+        self._ring.add(path)
+        self.recoveries += 1
+        _c_recovered.add()
+        _bump_marker("_DR_TPU_SERVE_ROUTER_RECOVERED")
+        warn_fallback("serve.router",
+                      f"replica {path} healthy again; its tenants "
+                      "re-hash back")
+
+    def _maybe_probe(self, *, force: bool = False) -> None:
+        """Half-open probes of OPEN replicas (SPEC §20.1): when a
+        breaker's seeded-backoff probe is due, fire ``router.probe``
+        and health-check the replica — a ready one re-joins the ring
+        (tenants re-hash back), a failed or FAULTED probe counts and
+        backs off, traffic stays on the survivors.  One dict scan
+        when every breaker is closed.  ``force=True`` (the EMPTY-ring
+        last resort — e.g. the instant mid-``rolling_restart`` when
+        the drained replica just left and the restarted one is not
+        re-admitted yet) probes every open breaker regardless of
+        pacing or exhaustion, without advancing the paced schedule —
+        a demand probe must not burn the budget."""
+        now = time.monotonic()
+        for path, br in list(self._breakers.items()):
+            if force:
+                if br.state != "open":
+                    continue
+            elif not br.due(now):
+                continue
+            else:
+                br.sched.advance(now)
+            ok = False
+            try:
+                _faults.fire("router.probe", path=path)
+                ok = replica_ready(path)
+            except resilience.ResilienceError as e:
+                warn_fallback(
+                    "serve.router",
+                    f"probe of {path} failed classified "
+                    f"({type(e).__name__}); backing off "
+                    f"({br.sched.probes}/{br.sched.budget})")
+            _c_probes.add()
+            _obs.event("router.probe", cat="serve", path=path, ok=ok)
+            if ok:
+                self._readmit(path)
+
     def _call(self, name: str, args, kw):
         tenant = kw.get("tenant") or self.tenant
+        if self._router is not None:
+            self._router.poll()  # passive respawn supervisor (§20.1)
+        self._maybe_probe()
+        reconnected: set = set()
+        forced_probe = False
         while True:
-            path = self.route(tenant)
+            try:
+                path = self.route(tenant)
+            except resilience.RelayDownError:
+                # EMPTY ring: every replica is open.  Before surfacing
+                # the fleet-wide death, demand-probe the open breakers
+                # once — mid-rolling-restart the next replica's drain
+                # can land before the previous restart's paced probe
+                # re-admitted it, and the happy path owes the caller
+                # zero classified errors (SPEC §20.3).
+                if forced_probe or self._ring.keys():
+                    raise
+                forced_probe = True
+                self._maybe_probe(force=True)
+                if not self._ring.keys():
+                    raise
+                continue
             try:
                 return getattr(self._client(path), name)(*args, **kw)
+            except resilience.ServerDraining as e:
+                # planned drain announcement: the tenant re-hashes
+                # BEFORE the replica dies — no client-visible error
+                self._mark_draining(path, e)
             except resilience.RelayDownError as e:
                 # nothing listening: THIS replica is dead.  Re-hash
                 # and retry on the survivors; the last death re-raises
@@ -270,10 +621,26 @@ class RouterClient:
                 # fails the liveness probe re-hashes.
                 from .daemon import daemon_alive
                 if isinstance(e, (resilience.ServerOverloaded,
-                                  resilience.DeadlineExpired)) \
-                        or daemon_alive(path):
+                                  resilience.DeadlineExpired)):
                     raise
-                self._mark_dead(path, e)
+                if not daemon_alive(path):
+                    self._mark_dead(path, e)
+                    continue
+                if isinstance(e, resilience.TransientBackendError) \
+                        and path not in reconnected \
+                        and self._budget.spend():
+                    # the daemon is ALIVE but the cached connection is
+                    # invalidated (a restarted replica on the same
+                    # socket, a reply lost to its stop): reconnect
+                    # once and resubmit — without this a rolling
+                    # restart leaves a permanently broken client in
+                    # front of a healthy replica.  The resubmission is
+                    # a RETRY and spends a budget token (§20.2): an
+                    # exhausted bucket surfaces the error instead.
+                    reconnected.add(path)
+                    self._drop_client(path)
+                    continue
+                raise
 
     def __getattr__(self, name: str):
         if name in _FORWARD:
@@ -286,6 +653,10 @@ class RouterClient:
     # ------------------------------------------------------------- admin
     def live_replicas(self) -> List[str]:
         return self._ring.keys()
+
+    def breaker_states(self) -> Dict[str, str]:
+        """Per-replica breaker state (``closed`` / ``open``)."""
+        return {p: br.state for p, br in self._breakers.items()}
 
     def stats(self) -> Dict[str, dict]:
         """Per-replica daemon stats (live replicas only)."""
@@ -308,3 +679,15 @@ class RouterClient:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def reset_state() -> None:
+    """Stop every live fleet (spawned replica subprocesses included) —
+    the between-test hygiene hook (serve.reset): a leaked spawn-mode
+    supervisor must not keep respawning daemons into the next test."""
+    for router in list(_live_routers):
+        try:
+            router.stop()
+        # drlint: ok[R5] between-test teardown of a leaked fleet: a failing stop must not mask the test that leaked it
+        except Exception:  # pragma: no cover - teardown best effort
+            pass

@@ -904,15 +904,15 @@ def _publish_grow(report: "GrowReport") -> None:
     os.environ["_DR_TPU_ELASTIC_GROW_WALL_S"] = f"{_grow_wall_s:.4f}"
 
 
-class GrowSupervisor:
+class GrowSupervisor(_resilience.ProbeTimer):
     """Bounded, seeded-backoff recovery supervisor (SPEC §16.6).
 
     PASSIVE on purpose — it owns no thread: the claim holder polls it
     between batches/plan flushes (the one-TPU-process rule: a recovery
     probe must never run concurrent with a live claim, and the moment
     between batches is the only time the dispatch thread provably owns
-    nothing in flight).  Probe delays ride
-    ``resilience.backoff_schedule`` — deterministic seeded jitter, so
+    nothing in flight).  The pacing is the shared
+    :class:`resilience.ProbeTimer` — deterministic seeded jitter, so
     tests reproduce every probe time — starting at
     ``DR_TPU_ELASTIC_GROW_PROBE_S``, doubling to the
     ``DR_TPU_ELASTIC_GROW_PROBE_CAP_S`` cap, and BOUNDED at
@@ -920,24 +920,12 @@ class GrowSupervisor:
     comes back must not be probed forever."""
 
     def __init__(self, *, seed: int = 0):
-        base = env_float("DR_TPU_ELASTIC_GROW_PROBE_S", 1.0)
-        cap = env_float("DR_TPU_ELASTIC_GROW_PROBE_CAP_S", 60.0)
-        self.budget = env_int("DR_TPU_ELASTIC_GROW_PROBES", 64)
-        self._delays = _resilience.backoff_schedule(
-            self.budget, base=max(0.0, base), factor=2.0,
-            max_delay=max(0.0, cap), seed=seed)
-        self.probes = 0
+        super().__init__(
+            env_float("DR_TPU_ELASTIC_GROW_PROBE_S", 1.0),
+            env_float("DR_TPU_ELASTIC_GROW_PROBE_CAP_S", 60.0),
+            env_int("DR_TPU_ELASTIC_GROW_PROBES", 64), seed=seed)
         self.failures = 0
         self.grows = 0
-        self._next = time.monotonic() + (self._delays[0]
-                                         if self._delays else 0.0)
-
-    def exhausted(self) -> bool:
-        return self.probes >= self.budget
-
-    def due(self, now: Optional[float] = None) -> bool:
-        return not self.exhausted() and \
-            (time.monotonic() if now is None else now) >= self._next
 
     def poll(self, attempt) -> Optional["GrowReport"]:
         """Run ``attempt()`` if a probe is due.  ``attempt`` returns a
@@ -949,9 +937,7 @@ class GrowSupervisor:
         now = time.monotonic()
         if not self.due(now):
             return None
-        self.probes += 1
-        if self.probes < self.budget:
-            self._next = now + self._delays[self.probes]
+        self.advance(now)
         try:
             rep = attempt()
         except Exception as e:

@@ -95,6 +95,25 @@ router.route         replica-router lookup          transient, program
                      (dr_tpu/serve/router.py —
                      fires before any replica is
                      touched)
+router.probe         circuit-breaker half-open      transient, program
+                     probe of an OPEN replica
+                     (serve/router.py — a faulted
+                     probe counts as failed, the
+                     breaker backs off, traffic
+                     stays on the survivors)
+serve.drain          graceful-drain entry           transient, program
+                     (serve/daemon.py Server.drain
+                     — before admission closes; a
+                     fault fails the drain
+                     classified with the daemon
+                     still serving)
+serve.journal        resident-state journal ops     transient, program
+                     (serve/journal.py — fires at
+                     replay/append/compact; an
+                     append fault degrades
+                     durability warned, never the
+                     request; a replay fault starts
+                     the daemon on an empty cache)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -194,6 +213,19 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "arena.map": ("transient", "program"),
     "arena.release": ("transient", "program"),
     "router.route": ("transient", "program"),
+    # serving control plane (docs/SPEC.md §20): router.probe fires at
+    # every circuit-breaker half-open probe of an open replica (a
+    # faulted probe counts as a failed probe — the breaker backs off
+    # and traffic stays on the survivors); serve.drain fires at
+    # Server.drain entry, before admission closes (a faulted drain
+    # surfaces classified with the daemon still serving normally);
+    # serve.journal fires at every resident-state journal operation
+    # (replay at start, append per put/drop, compact) — an append
+    # fault degrades durability (warned, counted), never the request,
+    # and a replay fault starts the daemon on an empty resident cache.
+    "router.probe": ("transient", "program"),
+    "serve.drain": ("transient", "program"),
+    "serve.journal": ("transient", "program"),
     "fallback.warn": (),
 }
 

@@ -54,9 +54,10 @@ from typing import Callable, Optional, Sequence
 __all__ = [
     "ResilienceError", "TransientBackendError", "RelayDownError",
     "DeviceOOM", "ProgramError", "CheckpointCorruptError",
-    "DeadlineExpired", "ServerOverloaded", "DeviceLostError",
+    "DeadlineExpired", "ServerOverloaded", "ServerDraining",
+    "DeviceLostError",
     "classify", "classified",
-    "backoff_schedule",
+    "backoff_schedule", "ProbeTimer", "TokenBudget",
     "retry", "with_deadline", "dump_dispatch_trace", "dump_obs_tail",
     "relay_listening",
     "dead_relay", "route_first_touch", "first_touch_or_cpu",
@@ -130,6 +131,16 @@ class ServerOverloaded(ResilienceError):
     (queue depth or per-tenant in-flight cap exceeded — dr_tpu/serve).
     A classified rejection, never a hang: back off and resubmit, or
     spread the load — retrying immediately just re-trips the cap."""
+
+
+class ServerDraining(ResilienceError):
+    """The serving daemon is DRAINING (docs/SPEC.md §20.3): it stops
+    admitting new work, finishes what it holds, flushes its journal,
+    and exits.  A planned handoff, not a failure: a routed client
+    re-hashes the tenant onto a live replica BEFORE the daemon dies
+    (the whole point of announcing the drain), a single-daemon caller
+    should reconnect elsewhere — retrying the draining daemon only
+    burns the drain window."""
 
 
 class DeviceLostError(ResilienceError):
@@ -223,12 +234,102 @@ def backoff_schedule(attempts: int, *, base: float = 0.05,
     return out
 
 
+class ProbeTimer:
+    """Bounded seeded-backoff probe timer — the pacing core shared by
+    the elastic recovery supervisor (§16.6) and the serve circuit
+    breakers / respawn supervisor (§20.1): delays ride
+    :func:`backoff_schedule` (deterministic jitter, so tests
+    reproduce every probe time) from ``base`` doubling to ``cap``,
+    BOUNDED at ``budget`` total probes — a capacity/replica that
+    never comes back is not probed forever."""
+
+    def __init__(self, base: float, cap: float, budget: int, *,
+                 seed: int = 0):
+        self.budget = int(budget)
+        self._delays = backoff_schedule(
+            self.budget, base=max(0.0, float(base)), factor=2.0,
+            max_delay=max(0.0, float(cap)), seed=seed)
+        self.probes = 0
+        self._next = time.monotonic() + (self._delays[0]
+                                         if self._delays else 0.0)
+
+    def exhausted(self) -> bool:
+        return self.probes >= self.budget
+
+    def due(self, now: Optional[float] = None) -> bool:
+        return not self.exhausted() and \
+            (time.monotonic() if now is None else now) >= self._next
+
+    def advance(self, now: Optional[float] = None) -> None:
+        """One probe taken: schedule the next."""
+        now = time.monotonic() if now is None else now
+        self.probes += 1
+        if self.probes < self.budget:
+            self._next = now + self._delays[self.probes]
+
+
+class TokenBudget:
+    """Shared retry token bucket (docs/SPEC.md §20.2).
+
+    Per-call retry loops compose multiplicatively: N clients x R
+    attempts each x M replicas re-hashed is N*R*M connection storms
+    against a fleet that is ALREADY failing — the retry amplification
+    the control plane exists to stop.  One bucket is shared by every
+    retry loop in the process: a retry SPENDS a token
+    (:meth:`spend`), a successful request REFILLS a fraction of one
+    (:meth:`note_success`, ``ratio`` per success, capped at
+    ``capacity``).  While the fleet is healthy the bucket stays full
+    and retries behave exactly as before; when everything is failing
+    the bucket drains in ``capacity`` retries total — fleet-wide —
+    and every later failure surfaces classified in one attempt, fast,
+    instead of a backoff storm amplifying the overload.
+
+    Thread-safe; ``capacity=0`` disarms retries outright.  Pass one
+    to :func:`retry` via ``budget=`` — an exhausted bucket makes the
+    loop re-raise the classified error instead of sleeping."""
+
+    def __init__(self, capacity: float, ratio: float = 0.1):
+        self.capacity = max(0.0, float(capacity))
+        self.ratio = max(0.0, float(ratio))
+        self._tokens = self.capacity
+        self._lock = threading.Lock()
+        self.spent = 0
+        self.denied = 0
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def spend(self, n: float = 1.0) -> bool:
+        """Take ``n`` tokens; False (and nothing taken) when the
+        bucket cannot cover them — the caller must NOT retry."""
+        with self._lock:
+            if self._tokens < n:
+                self.denied += 1
+                return False
+            self._tokens -= n
+            self.spent += 1
+            return True
+
+    def note_success(self) -> None:
+        """A request landed: bank ``ratio`` of a token (capped)."""
+        with self._lock:
+            self._tokens = min(self.capacity, self._tokens + self.ratio)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"tokens": round(self._tokens, 3),
+                    "capacity": self.capacity, "ratio": self.ratio,
+                    "spent": self.spent, "denied": self.denied}
+
+
 def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
           factor: float = 2.0, max_delay: float = 30.0,
           jitter: float = 0.25, seed: int = 0,
           retry_on: Sequence[type] = (TransientBackendError,),
           sleep: Callable = time.sleep, on_retry: Callable = None,
-          deadline_s: Optional[float] = None):
+          deadline_s: Optional[float] = None,
+          budget: Optional[TokenBudget] = None):
     """Run ``fn()`` with classified retries.
 
     Every raised error is classified first; only instances of
@@ -242,6 +343,12 @@ def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
     delay would land past the budget (measured from the first attempt)
     is not taken — the classified error surfaces instead of a retry
     nobody is still waiting on (the serve client's policy, SPEC §14.6).
+
+    ``budget`` threads a shared :class:`TokenBudget` through the loop
+    (SPEC §20.2): each retry spends one token first, and an exhausted
+    bucket re-raises the classified error immediately — no backoff
+    sleep, no attempt — so a fleet-wide failure degrades into fast
+    classified errors instead of a process-wide retry storm.
 
     Elastic degradation (docs/SPEC.md §16): when ``DR_TPU_ELASTIC=1``,
     a :class:`DeviceLostError` raised by the protected call triggers a
@@ -275,6 +382,16 @@ def retry(fn: Callable, *, attempts: int = 3, base: float = 0.05,
                 raise ce from e
             if deadline_s is not None and not shrunk and \
                     time.monotonic() - t0 + delays[i] > deadline_s:
+                if ce is e:
+                    raise
+                raise ce from e
+            if budget is not None and not shrunk \
+                    and not budget.spend():
+                # shared retry budget exhausted (SPEC §20.2): surface
+                # the classified error NOW — fast, no backoff — rather
+                # than join a fleet-wide retry storm
+                from .. import obs as _obs
+                _obs.count("resilience.retry_budget_denied")
                 if ce is e:
                     raise
                 raise ce from e
@@ -529,11 +646,20 @@ def degradation_story(env=None) -> Optional[dict]:
     # fleet that lost a replica is a degraded run even when every
     # surviving daemon is healthy
     router_reason = env.get("_DR_TPU_SERVE_ROUTER_REASON")
+    # control-plane markers (SPEC §20): a respawned replica or a
+    # truncated journal tail means a death/corruption happened this
+    # session — a story even when the fleet has fully recovered
+    # (drains alone are planned maintenance and only ride along)
+    respawns = env.get("_DR_TPU_SERVE_RESPAWNS")
+    journal_cut = env.get("_DR_TPU_SERVE_JOURNAL_TRUNCATED")
     if not reason and not serve_reason and not shrink_reason \
-            and not grow_reason and not router_reason:
+            and not grow_reason and not router_reason \
+            and not respawns and not journal_cut:
         return None
     story = {"reason": reason or serve_reason or shrink_reason
-             or grow_reason or router_reason,
+             or grow_reason or router_reason
+             or (respawns and f"{respawns} serve replica(s) respawned")
+             or f"journal tail truncated ({journal_cut} bytes)",
              "retries": int(env.get("_DR_TPU_BENCH_RETRIES", "0") or 0),
              "probe_wall_s": float(env.get("_DR_TPU_BENCH_PROBE_S", "0")
                                    or 0.0)}
@@ -547,7 +673,20 @@ def degradation_story(env=None) -> Optional[dict]:
                         ("restarts", "_DR_TPU_SERVE_RESTARTS"),
                         ("router_dead", "_DR_TPU_SERVE_ROUTER_DEAD"),
                         ("router_reason",
-                         "_DR_TPU_SERVE_ROUTER_REASON")):
+                         "_DR_TPU_SERVE_ROUTER_REASON"),
+                        # control plane (SPEC §20): planned drains,
+                        # supervisor respawns, breaker re-admissions,
+                        # and the journal-recovery counts
+                        ("drains", "_DR_TPU_SERVE_DRAINS"),
+                        ("drained_rehashes",
+                         "_DR_TPU_SERVE_ROUTER_DRAINED"),
+                        ("respawns", "_DR_TPU_SERVE_RESPAWNS"),
+                        ("router_recovered",
+                         "_DR_TPU_SERVE_ROUTER_RECOVERED"),
+                        ("journal_recovered",
+                         "_DR_TPU_SERVE_JOURNAL_RECOVERED"),
+                        ("journal_truncated",
+                         "_DR_TPU_SERVE_JOURNAL_TRUNCATED")):
         raw = env.get(marker)
         if raw not in (None, ""):
             serve[key] = raw if key in ("reason", "router_reason") \

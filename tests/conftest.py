@@ -85,7 +85,15 @@ def _disarm_faults():
     between batches, never a thread), so disarming it here guarantees
     no probe schedule (let alone a probe thread) leaks between tests;
     serve.reset() stops any daemon whose own route supervisor could
-    otherwise still be polled by a live dispatch loop."""
+    otherwise still be polled by a live dispatch loop.
+
+    Control-plane state (ISSUE 14, SPEC §20) rides serve.reset() too:
+    spawned Router fleets stop (a leaked respawn supervisor must not
+    keep resurrecting daemon subprocesses into the next test), the
+    shared retry token budget drops (re-read from env lazily), and
+    the resident-state journal files this process touched are
+    unlinked — one test's durable residents must not replay into the
+    next test's daemon."""
     yield
     from dr_tpu.utils import elastic, faults
     faults.reload_env()

@@ -119,9 +119,11 @@ def _battery(tmpdir: str, tag: str) -> None:
     # SUCCEEDS; a RouterClient lookup drives router.route.
     from dr_tpu import serve
     ssrv = serve.Server(os.path.join(tmpdir, f"chaos_{tag}.sock"),
-                        batch_window=0.0)
+                        batch_window=0.0,
+                        state_dir=os.path.join(tmpdir, f"state_{tag}"))
+    s2 = None
     try:
-        ssrv.start()
+        ssrv.start()  # serve.journal fires at the (empty) replay
         with serve.Client(ssrv.path, timeout=60.0) as sc:
             sx = src[:8 * P].copy()
             np.testing.assert_allclose(sc.scale(sx, a=2.0, b=1.0),
@@ -136,12 +138,41 @@ def _battery(tmpdir: str, tag: str) -> None:
                 + 8, dtype=np.float32)
             np.testing.assert_allclose(sc.scale(ax, a=0.5),
                                        ax * 0.5, rtol=1e-6)
-        with serve.RouterClient([ssrv.path], timeout=60.0) as rc:
-            # router leg: the consistent-hash lookup (router.route
-            # fires before the replica is touched)
-            assert abs(rc.reduce(np.ones(4 * P, np.float32)) - 4 * P) \
-                < 1e-3
+            # journal leg (SPEC §20.4): put/drop append durable
+            # records (serve.journal fires per append; a faulted
+            # append degrades durability warned, the request SUCCEEDS)
+            sc.put("chaos", sx)
+            assert abs(sc.reduce(serve.Ref("chaos")) - sx.sum()) < 1e-2
+            sc.drop("chaos")
+        # control-plane leg (SPEC §20): a second replica drains
+        # gracefully (serve.drain fires), its tenant re-hashes onto
+        # the survivor with no client-visible error, and — once a
+        # fresh daemon holds the socket again — the open breaker's
+        # half-open probe (router.probe fires) re-admits it.
+        s2 = serve.Server(os.path.join(tmpdir, f"chaos2_{tag}.sock"),
+                          batch_window=0.0).start()
+        with env_override(DR_TPU_SERVE_PROBE_S="0.0"):
+            with serve.RouterClient([ssrv.path, s2.path],
+                                    timeout=60.0) as rc:
+                # router leg: the consistent-hash lookup (router.route
+                # fires before the replica is touched)
+                assert abs(rc.reduce(np.ones(4 * P, np.float32))
+                           - 4 * P) < 1e-3
+                t2 = next(t for t in (f"t{i}" for i in range(64))
+                          if rc.route(t) == s2.path)
+                s2.drain()
+                # the drained replica's tenant re-hashes and SUCCEEDS
+                assert abs(rc.reduce(np.ones(2 * P, np.float32),
+                                     tenant=t2) - 2 * P) < 1e-3
+                # restart the replica; the due probe re-admits it
+                s2 = serve.Server(s2.path, batch_window=0.0).start()
+                assert abs(rc.reduce(np.ones(P, np.float32),
+                                     tenant=t2) - P) < 1e-3
+                assert s2.path in rc.live_replicas() or \
+                    rc.breaker_states().get(s2.path) == "open"
     finally:
+        if s2 is not None:
+            s2.stop()
         ssrv.stop()
 
     # relational composite (round 14): join -> groupby -> top_k over a

@@ -1,0 +1,733 @@
+"""Serving control plane (docs/SPEC.md §20): health-checked replica
+fleet (circuit breakers + respawn supervisor), shared retry budgets,
+graceful drain, and crash-safe resident-state recovery.
+
+In-process daemons under tmp_path sockets carry tier-1 (the
+test_serve.py conventions); the subprocess SIGKILL→respawn soak and
+the spawn-mode rolling restart are slow-marked and cranked by the
+fuzz-crank RESPAWN arm.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import serve
+from dr_tpu.serve import journal as journal_mod
+from dr_tpu.serve.router import _ProbeSchedule
+from dr_tpu.utils import faults, resilience
+from dr_tpu.utils.env import env_int, env_override
+
+X = np.arange(48, dtype=np.float32)
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = serve.Server(str(tmp_path / "cp.sock"),
+                       state_dir=str(tmp_path / "state"))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _client(srv, **kw):
+    kw.setdefault("timeout", 60.0)
+    return serve.Client(srv.path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker + probe schedule units (no daemon)
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    with env_override(DR_TPU_SERVE_PROBE_S="1.0",
+                      DR_TPU_SERVE_PROBES="3"):
+        br = serve.CircuitBreaker("/tmp/x.sock", seed=0)
+        assert br.state == "closed" and not br.due()
+        br.trip()
+        assert br.state == "open" and br.trips == 1
+        now = time.monotonic()
+        # first probe lands one backoff-base out, not immediately
+        assert not br.due(now)
+        assert br.due(now + 2.0)
+        # a failed probe advances the schedule: the next due time
+        # doubles (seeded jitter, deterministic)
+        br.sched.advance(now + 2.0)
+        assert not br.due(now + 2.1)
+        assert br.due(now + 2.0 + 4.0)
+        # budget bounds the probing: after 3 probes, never due again
+        br.sched.advance(now + 6.0)
+        br.sched.advance(now + 6.0)
+        assert br.exhausted() and not br.due(now + 1e6)
+        # a healthy probe closes the breaker and drops the schedule
+        br.reset()
+        assert br.state == "closed" and br.sched is None
+
+
+def test_probe_schedule_deterministic_and_bounded():
+    with env_override(DR_TPU_SERVE_PROBE_S="0.25",
+                      DR_TPU_SERVE_PROBE_CAP_S="2.0",
+                      DR_TPU_SERVE_PROBES="5"):
+        a, b = _ProbeSchedule(seed=3), _ProbeSchedule(seed=3)
+        assert a._delays == b._delays  # seeded: reproducible
+        assert len(a._delays) == 5
+        assert max(a._delays) <= 2.0 * 1.25  # cap (+jitter)
+        for _ in range(5):
+            assert not a.exhausted()
+            a.advance()
+        assert a.exhausted() and not a.due()
+
+
+# ---------------------------------------------------------------------------
+# retry budget (SPEC §20.2)
+# ---------------------------------------------------------------------------
+
+def test_token_budget_spend_refill():
+    b = resilience.TokenBudget(2, ratio=0.5)
+    assert b.spend() and b.spend() and not b.spend()
+    b.note_success()
+    assert not b.spend()  # half a token is not a whole one
+    b.note_success()
+    assert b.spend()
+    snap = b.snapshot()
+    assert snap["spent"] == 3 and snap["denied"] == 2
+
+
+def test_retry_budget_exhausted_fails_fast():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise resilience.TransientBackendError("UNAVAILABLE: synthetic")
+
+    t0 = time.perf_counter()
+    with pytest.raises(resilience.TransientBackendError):
+        resilience.retry(boom, attempts=8, base=5.0,
+                         budget=resilience.TokenBudget(0))
+    # one attempt, NO backoff sleep: the 5 s base never ran
+    assert len(calls) == 1
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_client_retries_draw_shared_budget(server):
+    # the intake fault serializes a retryable transient on EVERY
+    # request; a budget of one token allows exactly one resubmission
+    # fleet-wide, then the failure surfaces fast
+    budget = resilience.TokenBudget(1, ratio=0.0)
+    with faults.injected("serve.request", "transient",
+                         times=None) as sp:
+        with _client(server, retries=5, budget=budget) as c:
+            with pytest.raises(resilience.TransientBackendError):
+                c.reduce(X)
+            first = sp.fired
+            assert first == 2  # initial attempt + the one budgeted retry
+            # the bucket is dry: the next request gets ONE attempt
+            with pytest.raises(resilience.TransientBackendError):
+                c.reduce(X)
+            assert sp.fired == first + 1
+    # successful requests refill the bucket at the configured ratio
+    with _client(server, retries=5,
+                 budget=resilience.TokenBudget(4, ratio=1.0)) as c:
+        assert abs(c.reduce(np.ones(8, np.float32)) - 8.0) < 1e-3
+
+
+def test_router_and_clients_share_one_budget(tmp_path):
+    # the satellite bugfix: RouterClient's per-replica Clients draw
+    # from ONE bucket, so fleet-level retries cannot multiply
+    fleet = serve.Router(str(tmp_path / "b"), replicas=2, cpu=True,
+                         batch_window=0.0).start()
+    try:
+        budget = resilience.TokenBudget(1, ratio=0.0)
+        with serve.RouterClient(fleet.paths(), timeout=60.0,
+                                retries=4, budget=budget) as rc:
+            with faults.injected("serve.request", "transient",
+                                 times=None) as sp:
+                with pytest.raises(resilience.TransientBackendError):
+                    rc.reduce(X)
+                total_after_first = sp.fired
+                assert total_after_first == 2  # 1 try + 1 budgeted
+                with pytest.raises(resilience.TransientBackendError):
+                    rc.reduce(X, tenant="other")
+                # the other tenant (possibly the other replica) got
+                # NO budgeted retry: the bucket is shared and dry
+                assert sp.fired == total_after_first + 1
+    finally:
+        fleet.stop()
+
+
+def test_dead_fleet_fails_fast_classified(tmp_path):
+    # acceptance: with the budget exhausted and every breaker open, a
+    # dead fleet costs < 1 RTT per request — no backoff storm
+    with env_override(DR_TPU_SERVE_PROBE_S="30.0"):
+        fleet = serve.Router(str(tmp_path / "dead"), replicas=2,
+                             cpu=True, batch_window=0.0).start()
+        try:
+            rc = serve.RouterClient(fleet.paths(), timeout=60.0,
+                                    budget=resilience.TokenBudget(0))
+            assert abs(rc.reduce(np.ones(8, np.float32)) - 8.0) < 1e-3
+            for s in list(fleet._servers):
+                s.stop()
+            t0 = time.perf_counter()
+            for i in range(10):
+                with pytest.raises(resilience.RelayDownError):
+                    rc.reduce(X, tenant=f"t{i}")
+            assert time.perf_counter() - t0 < 1.0
+            assert set(rc.breaker_states().values()) == {"open"}
+            rc.close()
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (SPEC §20.3)
+# ---------------------------------------------------------------------------
+
+def test_drain_completes_inflight_then_stops(tmp_path):
+    srv = serve.Server(str(tmp_path / "d.sock"), batch_window=0.0)
+    srv.start()
+    try:
+        srv.hold()  # park the dispatcher: the request stays in flight
+        res = {}
+
+        def worker():
+            with _client(srv) as c:
+                res["got"] = c.reduce(np.ones(32, np.float32))
+
+        t = threading.Thread(target=worker)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while len(srv._queue) == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(srv._queue) == 1
+        dt = threading.Thread(target=srv.drain)
+        dt.start()
+        deadline = time.monotonic() + 10.0
+        while not srv.draining() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # admission is closed: a new compute request gets the typed
+        # drain rejection (ping still answers, and says so)
+        with _client(srv) as c2:
+            assert c2.ping().get("draining") is True
+            with pytest.raises(resilience.ServerDraining):
+                c2.reduce(np.ones(8, np.float32))
+        assert not srv._stopped.is_set()  # waiting on the in-flight
+        srv.release()
+        dt.join(timeout=30.0)
+        t.join(timeout=30.0)
+        assert abs(res["got"] - 32.0) < 1e-3  # in-flight COMPLETED
+        assert srv._stopped.is_set()
+        assert srv._drains == 1 and srv._drain_rejects == 1
+        assert env_int("_DR_TPU_SERVE_DRAINS", 0, floor=0) >= 1
+    finally:
+        srv.release()
+        srv.stop()
+
+
+def test_drain_wire_op_stops_daemon(server):
+    with _client(server) as c:
+        ack = c.drain()
+        assert ack.get("draining") is True
+    deadline = time.monotonic() + 10.0
+    while not server._stopped.is_set() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert server._stopped.is_set()
+    with pytest.raises(resilience.RelayDownError):
+        serve.Client(server.path, timeout=5.0)
+
+
+def test_drain_fault_site_classified(server):
+    with faults.injected("serve.drain", "program") as sp:
+        with pytest.raises(resilience.ProgramError):
+            server.drain()
+        assert sp.fired == 1
+    # the faulted drain left the daemon serving normally
+    assert not server.draining()
+    with _client(server) as c:
+        assert abs(c.reduce(np.ones(8, np.float32)) - 8.0) < 1e-3
+
+
+def test_drain_wire_op_fault_classified(server):
+    # the WIRE drain fires the site BEFORE the ack: a faulted drain
+    # reaches the caller classified (§20.5) — never a positive ack
+    # followed by a helper thread dying with the error
+    with faults.injected("serve.drain", "program") as sp:
+        with _client(server) as c:
+            with pytest.raises(resilience.ProgramError):
+                c.drain()
+            assert sp.fired == 1
+    assert not server.draining()  # and the daemon serves on
+    with _client(server) as c:
+        assert abs(c.reduce(np.ones(8, np.float32)) - 8.0) < 1e-3
+
+
+def test_router_drain_rehash_no_client_error(tmp_path):
+    with env_override(DR_TPU_SERVE_PROBE_S="30.0"):
+        fleet = serve.Router(str(tmp_path / "dr"), replicas=2,
+                             cpu=True, batch_window=0.0).start()
+        try:
+            with serve.RouterClient(fleet.paths(),
+                                    timeout=60.0) as rc:
+                t2 = next(t for t in (f"t{i}" for i in range(64))
+                          if rc.route(t) == fleet.paths()[1])
+                assert abs(rc.reduce(np.ones(8, np.float32),
+                                     tenant=t2) - 8.0) < 1e-3
+                # park one in-flight request on the replica (held
+                # dispatcher) so the drain STAYS in its announcing
+                # phase — an idle drain completes instantly and the
+                # client would only see the connect-refused corpse
+                fleet._servers[1].hold()
+                res = {}
+
+                def inflight():
+                    with _client(fleet._servers[1]) as c:
+                        res["got"] = c.reduce(np.ones(32, np.float32))
+
+                it = threading.Thread(target=inflight)
+                it.start()
+                deadline = time.monotonic() + 10.0
+                while len(fleet._servers[1]._queue) == 0 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                dt = threading.Thread(
+                    target=fleet._servers[1].drain)
+                dt.start()
+                deadline = time.monotonic() + 10.0
+                while not fleet._servers[1].draining() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                # mid-drain: the tenant's next op succeeds with NO
+                # classified error (the drain announcement re-hashes)
+                assert abs(rc.reduce(np.ones(16, np.float32),
+                                     tenant=t2) - 16.0) < 1e-3
+                fleet._servers[1].release()
+                dt.join(timeout=30.0)
+                it.join(timeout=30.0)
+                assert abs(res["got"] - 32.0) < 1e-3  # drain finished it
+                assert rc.drain_rehashes == 1 and rc.rehashes == 0
+                assert rc.breaker_states()[fleet.paths()[1]] == "open"
+                assert env_int("_DR_TPU_SERVE_ROUTER_DRAINED", 0,
+                               floor=0) >= 1
+        finally:
+            fleet._servers[1].release()
+            fleet.stop()
+
+
+def test_rolling_restart_zero_classified_errors(tmp_path):
+    # acceptance: rolling_restart over 2 replicas, traffic running,
+    # ZERO classified client errors, resident state intact (journal).
+    # NOT probe base 0.0: zero delays make the 16-probe budget
+    # burnable within one restart's downtime by the tight traffic
+    # loop — paced probes are the production shape
+    with env_override(DR_TPU_SERVE_PROBE_S="0.01"):
+        fleet = serve.Router(str(tmp_path / "rr"), replicas=2,
+                             cpu=True, batch_window=0.0,
+                             state_dir=str(tmp_path / "state")).start()
+        try:
+            rc = serve.RouterClient(fleet.paths(), tenant="alice",
+                                    timeout=60.0)
+            rc.put("feat", X)
+            errs, done = [], threading.Event()
+
+            def traffic():
+                while not done.is_set():
+                    try:
+                        rc.reduce(X, tenant="alice")
+                        rc.reduce(X, tenant="bob")
+                    except resilience.ResilienceError as e:
+                        errs.append(repr(e))
+
+            th = threading.Thread(target=traffic)
+            th.start()
+            try:
+                time.sleep(0.1)
+                restarted = fleet.rolling_restart()
+                time.sleep(0.2)
+            finally:
+                done.set()
+                th.join(timeout=60.0)
+            assert len(restarted) == 2
+            assert not errs, errs[:3]
+            # breakers re-close as paced probes land: the fleet is
+            # whole again (and only THEN does the tenant's home
+            # replica answer for its journal-replayed residents)
+            deadline = time.monotonic() + 10.0
+            while len(rc.live_replicas()) < 2 \
+                    and time.monotonic() < deadline:
+                rc.reduce(np.ones(4, np.float32), tenant="carol")
+            assert len(rc.live_replicas()) == 2
+            # resident state survived the full roll via the journal
+            np.testing.assert_array_equal(rc.get("feat"), X)
+            rc.close()
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# breaker probes re-admit a returned replica (SPEC §20.1)
+# ---------------------------------------------------------------------------
+
+def test_breaker_probe_readmits_replica(tmp_path):
+    with env_override(DR_TPU_SERVE_PROBE_S="0.0"):
+        fleet = serve.Router(str(tmp_path / "pr"), replicas=2,
+                             cpu=True, batch_window=0.0).start()
+        try:
+            with serve.RouterClient(fleet.paths(),
+                                    timeout=60.0) as rc:
+                t2 = next(t for t in (f"t{i}" for i in range(64))
+                          if rc.route(t) == fleet.paths()[0])
+                fleet._servers[0].stop()  # abrupt death, no drain
+                # the dead replica's tenant re-hashes (classified
+                # story marker) and the op still succeeds
+                assert abs(rc.reduce(np.ones(8, np.float32),
+                                     tenant=t2) - 8.0) < 1e-3
+                assert rc.rehashes == 1
+                assert len(rc.live_replicas()) == 1
+                # a fresh daemon takes the socket back; the due probe
+                # (router.probe fires) re-admits it to the ring
+                fleet.restart_replica(0)
+                with faults.injected("router.probe", "transient") \
+                        as sp:
+                    # the FAULTED probe backs off — replica stays out
+                    rc.reduce(np.ones(4, np.float32), tenant=t2)
+                    assert sp.fired == 1
+                    assert len(rc.live_replicas()) == 1
+                rc.reduce(np.ones(4, np.float32), tenant=t2)
+                assert fleet.paths()[0] in rc.live_replicas()
+                assert rc.recoveries == 1
+                assert rc.breaker_states()[fleet.paths()[0]] \
+                    == "closed"
+                assert env_int("_DR_TPU_SERVE_ROUTER_RECOVERED", 0,
+                               floor=0) >= 1
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe resident journal (SPEC §20.4)
+# ---------------------------------------------------------------------------
+
+def test_journal_replay_restores_residents(tmp_path, server):
+    with _client(server) as c:
+        c.put("a", X)
+        c.put("b", X * 2)
+        c.put("gone", X * 3)
+        c.drop("gone")
+        # an identical re-put appends nothing (content-tag fast path)
+        appends = server._journal.appends
+        c.put("a", X)
+        assert server._journal.appends == appends
+    server.stop()
+    srv2 = serve.Server(server.path,
+                        state_dir=str(tmp_path / "state")).start()
+    try:
+        with _client(srv2) as c:
+            np.testing.assert_array_equal(c.get("a"), X)
+            np.testing.assert_array_equal(c.get("b"), X * 2)
+            with pytest.raises(resilience.ProgramError):
+                c.get("gone")  # the drop was journaled too
+            # refs resolve against the replayed containers
+            assert abs(c.reduce(serve.Ref("b")) - 2 * X.sum()) < 1e-2
+        st = srv2.stats()["journal"]
+        assert st["replayed"] == 2 and st["live"] == 2
+        assert env_int("_DR_TPU_SERVE_JOURNAL_RECOVERED", 0,
+                       floor=0) == 2
+        story = resilience.degradation_story()
+        # a clean replay after a clean stop is NOT a degradation story
+        assert story is None or "journal_recovered" in story["serve"]
+    finally:
+        srv2.stop()
+
+
+def test_journal_torn_tail_truncates_cleanly(tmp_path, server):
+    with _client(server) as c:
+        c.put("keep", X)
+    server.stop()
+    jr = journal_mod.Journal(str(tmp_path / "state"), server.path)
+    good = os.path.getsize(jr.path)
+    with open(jr.path, "ab") as fh:
+        fh.write(b"\x20\x00\x00\x00\x10")  # half a record prefix
+    # strict scan classifies the tear
+    with pytest.raises(resilience.CheckpointCorruptError):
+        jr.scan()
+    srv2 = serve.Server(server.path,
+                        state_dir=str(tmp_path / "state")).start()
+    try:
+        with _client(srv2) as c:
+            np.testing.assert_array_equal(c.get("keep"), X)
+        assert os.path.getsize(jr.path) >= good  # compacted, whole
+        assert env_int("_DR_TPU_SERVE_JOURNAL_TRUNCATED", 0,
+                       floor=0) == 5
+        story = resilience.degradation_story()
+        assert story is not None
+        assert story["serve"]["journal_truncated"] == 5
+    finally:
+        srv2.stop()
+
+
+def test_journal_corrupt_payload_classified(tmp_path):
+    jr = journal_mod.Journal(str(tmp_path / "jc"), "/tmp/x.sock")
+    jr.claim()
+    jr.append("put", "t", "n", "tag",
+              np.arange(8, dtype=np.float32).tobytes())
+    with open(jr.path, "r+b") as fh:
+        fh.seek(-2, os.SEEK_END)
+        fh.write(b"\xff\xff")  # flip payload bytes: crc must catch it
+    with pytest.raises(resilience.CheckpointCorruptError):
+        jr.scan()
+    # replay truncates the corrupt record away — clean, empty
+    assert jr.replay() == {}
+    assert jr.truncated_bytes > 0
+
+
+def test_journal_stale_generation_fenced(tmp_path, server):
+    with _client(server) as c:
+        c.put("a", X)
+        # a NEWER daemon claims the state behind this one's back —
+        # the socket-takeover race's loser must never serve again
+        journal_mod.Journal(str(tmp_path / "state"),
+                            server.path).claim()
+        with pytest.raises(resilience.ProgramError):
+            c.put("b", X * 2)
+    deadline = time.monotonic() + 10.0
+    while not server._stopped.is_set() \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert server._stopped.is_set()  # the stale daemon took itself out
+    assert server._journal.fenced
+    assert "fenced" in (server.degraded or "")
+
+
+def test_journal_append_fault_degrades_durability_only(server):
+    # a journal IO fault must not fail the put — durability degrades,
+    # warned and counted; the entry still serves from memory
+    with faults.injected("serve.journal", "transient") as sp:
+        with _client(server) as c:
+            c.put("soft", X)
+            assert sp.fired == 1
+            np.testing.assert_array_equal(c.get("soft"), X)
+    assert server._journal_errors == 1
+
+
+def test_journal_replay_fault_starts_empty(tmp_path, server):
+    with _client(server) as c:
+        c.put("a", X)
+    server.stop()
+    with faults.injected("serve.journal", "program") as sp:
+        srv2 = serve.Server(server.path,
+                            state_dir=str(tmp_path / "state")).start()
+        try:
+            assert sp.fired >= 1
+            with _client(srv2) as c:
+                with pytest.raises(resilience.ProgramError):
+                    c.get("a")  # empty cache — but the daemon SERVES
+                assert abs(c.reduce(np.ones(8, np.float32)) - 8.0) \
+                    < 1e-3
+        finally:
+            srv2.stop()
+
+
+def test_journal_compact_fault_keeps_replayed_residents(tmp_path,
+                                                        server):
+    # a classified compaction failure AFTER a whole replay must not
+    # wipe the correctly-replayed residents: compact is atomic
+    # temp+replace, the old journal is intact on disk
+    with _client(server) as c:
+        c.put("a", X)
+    server.stop()
+    # replay fires serve.journal first (op="replay"); after=1 lands
+    # the fault on the compaction that follows the whole replay
+    with faults.injected("serve.journal", "transient", after=1) as sp:
+        srv2 = serve.Server(server.path,
+                            state_dir=str(tmp_path / "state")).start()
+        try:
+            assert sp.fired == 1
+            with _client(srv2) as c:
+                np.testing.assert_array_equal(c.get("a"), X)
+        finally:
+            srv2.stop()
+
+
+def test_journal_append_oserror_degrades_durability_only(server):
+    # a raw filesystem error (ENOENT/ENOSPC-shaped) on append follows
+    # the same contract as a classified one: durability degrades,
+    # the put still serves from memory
+    with _client(server) as c:
+        server._journal.path = os.path.join(
+            os.path.dirname(server._journal.path), "missing-dir",
+            "j.journal")
+        c.put("soft", X)
+        np.testing.assert_array_equal(c.get("soft"), X)
+    assert server._journal_errors == 1
+
+
+def test_journal_replay_oserror_starts_empty(tmp_path, server):
+    # an unreadable journal (OSError, not a classified corruption)
+    # must not brick the daemon: it starts with an EMPTY cache
+    with _client(server) as c:
+        c.put("a", X)
+    server.stop()
+    jr = journal_mod.Journal(str(tmp_path / "state"), server.path)
+    os.unlink(jr.path)
+    os.makedirs(jr.path)  # open("rb") now raises IsADirectoryError
+    srv2 = serve.Server(server.path,
+                        state_dir=str(tmp_path / "state")).start()
+    try:
+        with _client(srv2) as c:
+            with pytest.raises(resilience.ProgramError):
+                c.get("a")  # empty cache — but the daemon serves
+            assert abs(c.reduce(np.ones(8, np.float32)) - 8.0) < 1e-3
+    finally:
+        srv2.stop()
+
+
+def test_journal_unavailable_state_dir_serves_without_durability(
+        tmp_path):
+    # a state dir that cannot be created degrades DURABILITY at
+    # start, never the daemon
+    bad = tmp_path / "statefile"
+    bad.write_text("not a dir")
+    srv = serve.Server(str(tmp_path / "cp2.sock"), state_dir=str(bad))
+    srv.start()
+    try:
+        assert srv._journal is None
+        with _client(srv) as c:
+            c.put("a", X)
+            np.testing.assert_array_equal(c.get("a"), X)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# story + trace_view satellites
+# ---------------------------------------------------------------------------
+
+def test_degradation_story_controlplane_counters(monkeypatch):
+    for m, v in (("_DR_TPU_SERVE_RESPAWNS", "2"),
+                 ("_DR_TPU_SERVE_DRAINS", "3"),
+                 ("_DR_TPU_SERVE_ROUTER_RECOVERED", "1"),
+                 ("_DR_TPU_SERVE_JOURNAL_RECOVERED", "4")):
+        monkeypatch.setenv(m, v)
+    story = resilience.degradation_story()
+    assert story is not None  # respawns alone make it a story
+    assert story["serve"]["respawns"] == 2
+    assert story["serve"]["drains"] == 3
+    assert story["serve"]["router_recovered"] == 1
+    assert story["serve"]["journal_recovered"] == 4
+    assert "respawned" in story["reason"]
+
+
+def test_trace_view_controlplane_rollup(capsys):
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(repo, "tools", "trace_view.py"))
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    events = [
+        {"ph": "i", "name": "serve.drain", "cat": "serve", "ts": 1},
+        {"ph": "i", "name": "router.probe", "cat": "serve", "ts": 2,
+         "args": {"ok": False}},
+        {"ph": "i", "name": "router.probe", "cat": "serve", "ts": 3,
+         "args": {"ok": True}},
+        {"ph": "i", "name": "router.respawn", "cat": "serve", "ts": 4},
+        {"ph": "i", "name": "serve.journal.replay", "cat": "serve",
+         "ts": 5},
+    ]
+    tv.summarize(events)
+    out = capsys.readouterr().out
+    assert "serve control plane" in out
+    probe = next(l for l in out.splitlines()
+                 if l.strip().startswith("router.probe"))
+    assert "ok=1" in probe and "failed=1" in probe
+    assert "router.respawn" in out and "serve.drain" in out
+    assert "serve.journal.replay" in out
+
+
+# ---------------------------------------------------------------------------
+# subprocess soaks (slow — the fuzz-crank RESPAWN arm cranks these)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # two daemon subprocesses = two jax imports; the
+# RESPAWN arm cranks this kill→respawn→verify loop
+def test_subprocess_sigkill_respawn_serves_journal(tmp_path):
+    with env_override(DR_TPU_SERVE_PROBE_S="0.1"):
+        fleet = serve.Router(str(tmp_path / "sk"), replicas=2,
+                             cpu=True, spawn=True,
+                             state_dir=str(tmp_path / "state")).start()
+        try:
+            rc = serve.RouterClient(fleet.paths(), tenant="kv",
+                                    timeout=120.0, router=fleet)
+            x = np.arange(1 << 12, dtype=np.float32)
+            rc.put("feat", x)
+            victim = rc.route("kv")
+            vi = fleet.paths().index(victim)
+            fleet._procs[vi].send_signal(signal.SIGKILL)
+            fleet._procs[vi].wait(timeout=30)
+            # the supervisor poll rides rc calls (router=fleet): the
+            # traffic notices the death (re-hash), the poll respawns,
+            # the breaker probe re-admits — then the journal serves
+            # the tenant's resident BIT-EQUAL from the fresh process.
+            # The ring can NOT be the wait signal alone: it still
+            # lists the corpse until a request actually hits it.
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                try:
+                    rc.reduce(np.ones(8, np.float32), tenant="kv")
+                    if fleet.stats()["respawns"] >= 1 \
+                            and victim in rc.live_replicas():
+                        break
+                except resilience.ResilienceError:
+                    pass  # mid-churn classified: acceptable
+                time.sleep(0.05)
+            assert fleet.stats()["respawns"] >= 1, "never respawned"
+            assert victim in rc.live_replicas(), "never re-admitted"
+            np.testing.assert_array_equal(rc.get("feat", tenant="kv"),
+                                          x)
+            assert fleet.stats()["respawns"] >= 1
+            assert env_int("_DR_TPU_SERVE_RESPAWNS", 0, floor=0) >= 1
+            story = resilience.degradation_story()
+            assert story is not None and \
+                story["serve"]["respawns"] >= 1
+            rc.close()
+        finally:
+            fleet.stop()
+
+
+@pytest.mark.slow  # two daemon subprocesses; SIGTERM is the __main__
+# drain path — the spawn-mode half of the rolling-restart acceptance
+def test_subprocess_sigterm_drains_and_rolling_restart(tmp_path):
+    with env_override(DR_TPU_SERVE_PROBE_S="0.1"):
+        fleet = serve.Router(str(tmp_path / "rrs"), replicas=2,
+                             cpu=True, spawn=True,
+                             state_dir=str(tmp_path / "state")).start()
+        try:
+            rc = serve.RouterClient(fleet.paths(), tenant="alice",
+                                    timeout=120.0, router=fleet)
+            x = np.arange(256, dtype=np.float32)
+            rc.put("feat", x)
+            # SIGTERM = graceful drain (__main__): clean exit 0
+            proc = fleet._procs[1]
+            proc.terminate()
+            assert proc.wait(timeout=60) == 0
+            fleet._procs[1] = fleet._spawn(fleet.paths()[1],
+                                           cpu=True)
+            # full wire-drain rolling restart over both replicas
+            restarted = fleet.rolling_restart()
+            assert len(restarted) == 2
+            deadline = time.monotonic() + 60.0
+            while len(rc.live_replicas()) < 2 \
+                    and time.monotonic() < deadline:
+                try:
+                    rc.reduce(np.ones(8, np.float32), tenant="bob")
+                except resilience.ResilienceError:
+                    pass
+                time.sleep(0.05)
+            np.testing.assert_array_equal(rc.get("feat"), x)
+            rc.close()
+        finally:
+            fleet.stop()

@@ -82,6 +82,15 @@
 # oracles) plus the shrink->grow->shrink soak cranked below; the chaos
 # battery grew a grow-back leg sweeping the device.recover / mesh.grow
 # site rows (docs/SPEC.md SS16.6).
+#
+# RESPAWN arm (ISSUE 14, docs/SPEC.md SS20): the serving control
+# plane under churn — crank-budgeted rounds of the full control-plane
+# suite (circuit-breaker units, retry-budget exhaustion, journal
+# torn-tail/fence recovery, drain-with-inflight) plus the slow-marked
+# subprocess legs: replica SIGKILL -> supervisor respawn -> journal
+# recovery verified bit-equal each round, and the rolling-restart
+# soak (zero classified client errors).  The chaos battery above
+# sweeps the router.probe / serve.drain / serve.journal site rows.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
@@ -229,6 +238,25 @@ if [ -z "$FILTER" ]; then
     st=${PIPESTATUS[0]}
     if [ "$st" -ne 0 ]; then
       echo "FAILED ($st): tests/test_serve_dataplane.py arena arm (round $r)"
+      rc=1
+      break
+    fi
+    r=$((r + 1))
+  done
+fi
+# RESPAWN arm (ISSUE 14, docs/SPEC.md SS20): replica kill-and-respawn
+# churn — each round runs the whole control-plane suite, slow
+# subprocess legs included (SIGKILL -> respawn with journal recovery
+# verified bit-equal, rolling restart with zero classified errors).
+# Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  echo "=== tests/test_serve_controlplane.py (respawn arm, rounds=$CHAOS_ROUNDS) ==="
+  r=0
+  while [ "$r" -lt "$CHAOS_ROUNDS" ]; do
+    python -m pytest tests/test_serve_controlplane.py -q 2>&1 | tail -2
+    st=${PIPESTATUS[0]}
+    if [ "$st" -ne 0 ]; then
+      echo "FAILED ($st): tests/test_serve_controlplane.py respawn arm (round $r)"
       rc=1
       break
     fi

@@ -18,7 +18,12 @@ or CI log needs the story without a browser; this tool prints:
 * **per-tenant serve rollup** — p50/p95 queue-wait and service per
   tenant, so weighted-fair isolation (docs/SPEC.md §19.4) is visible
   straight from a trace: a heavy tenant's queue-wait dilates while a
-  light tenant's stays flat.
+  light tenant's stays flat;
+* **serve control-plane rollup** — drain, breaker-probe (with the
+  ok/failed split), replica-respawn, drain-rehash, and
+  journal-replay event counts (docs/SPEC.md §20), so a traced
+  rolling restart or kill-and-respawn session tells its story
+  without a browser.
 
 Usage::
 
@@ -134,6 +139,32 @@ def summarize(events: List[dict], top: int = 15,
         for (cat, name), n in sorted(groups.items(),
                                      key=lambda kv: (kv[0][0], -kv[1])):
             print(f"  {cat or '-':<10} {name:<28} {n:>8}", file=out)
+
+    # ---- serve control-plane rollup (docs/SPEC.md §20): drains,
+    # breaker probes, respawns, drain-rehashes, journal replays
+    cp: dict = defaultdict(int)
+    probe_ok = 0
+    for e in instants:
+        name = e.get("name", "")
+        # cat gates out the fault-site echo instants (cat="site"),
+        # which share these names and would double every count
+        if e.get("cat") == "serve" and \
+                name in ("serve.drain", "router.probe",
+                         "router.respawn", "router.drain_rehash",
+                         "serve.journal.replay"):
+            cp[name] += 1
+            if name == "router.probe" and (e.get("args") or {}).get("ok"):
+                probe_ok += 1
+    if cp:
+        print("\nserve control plane:", file=out)
+        for name in ("serve.drain", "router.drain_rehash",
+                     "router.probe", "router.respawn",
+                     "serve.journal.replay"):
+            if not cp.get(name):
+                continue
+            extra = (f" (ok={probe_ok}, failed={cp[name] - probe_ok})"
+                     if name == "router.probe" else "")
+            print(f"  {name:<22} {cp[name]:>6}{extra}", file=out)
 
     # ---- per-request serve latency breakdown
     reqs = [s for s in spans if s.get("name") == "serve.request"]
