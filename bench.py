@@ -1325,6 +1325,148 @@ def _serve_metrics(on_cpu: bool) -> dict:
     return out
 
 
+def _plan_opt_chain(n_fact: int, ncard: int, mode: str):
+    """One deferred relational pipeline flush under
+    ``DR_TPU_PLAN_OPT=mode`` on fresh containers: fusible elementwise
+    work interleaved with the opaque relational ops (join_auto ->
+    groupby_auto) and the fusible histogram/top_k tail — exactly the
+    shape whose recording-order run splits the §21 merge pass erases.
+    Returns ``(dispatches_in_flush, wall_seconds, opt_note,
+    results)``."""
+    import dr_tpu
+    from dr_tpu.utils.env import env_override
+    from dr_tpu.utils.spmd_guard import dispatch_count
+
+    rng = np.random.default_rng(19)
+    fk = rng.integers(0, ncard, n_fact).astype(np.float32)
+    fv = rng.standard_normal(n_fact).astype(np.float32)
+    dk = rng.permutation(ncard).astype(np.float32)
+    dv = rng.standard_normal(ncard).astype(np.float32)
+    aux = rng.standard_normal(n_fact).astype(np.float32)
+    fkv = dr_tpu.distributed_vector.from_array(fk)
+    fvv = dr_tpu.distributed_vector.from_array(fv)
+    dkv = dr_tpu.distributed_vector.from_array(dk)
+    dvv = dr_tpu.distributed_vector.from_array(dv)
+    a1 = dr_tpu.distributed_vector.from_array(aux)
+    a2 = dr_tpu.distributed_vector.from_array(aux)
+    hb = dr_tpu.distributed_vector(16, np.int32)
+    tv = dr_tpu.distributed_vector(8, np.float32)
+    ti = dr_tpu.distributed_vector(8, np.int32)
+    with env_override(DR_TPU_PLAN_OPT=mode):
+        d0 = dispatch_count()
+        t0 = time.perf_counter()
+        with dr_tpu.deferred() as p:
+            dr_tpu.for_each(a1, _pl_scale, 2.0)       # fusible run 1
+            j = dr_tpu.join_auto(fkv, fvv, dkv, dvv)  # opaque
+            dr_tpu.for_each(a2, _pl_shift, 1.0)       # fusible run 2
+            g = dr_tpu.groupby_auto(fkv, fvv, agg="sum")  # opaque
+            dr_tpu.histogram(a1, hb, -6.0, 6.0)       # fusible run 3
+            dr_tpu.top_k(a1, tv, ti)
+        wall = time.perf_counter() - t0
+        used = dispatch_count() - d0
+        results = (j.count, g.count, dr_tpu.to_numpy(hb).tolist())
+    return used, wall, (p.log[-1].get("opt") or {}), results
+
+
+def _plan_metrics(on_cpu: bool) -> dict:
+    """--plan / DR_TPU_BENCH_PLAN=1 (round 19, docs/SPEC.md §21): the
+    plan-optimizer A/B — the deferred relational pipeline (join_auto
+    -> groupby_auto -> histogram/top_k with interleaved elementwise
+    runs) and the serve batched flush, each measured with
+    ``DR_TPU_PLAN_OPT=0`` vs ``all``: dispatch counts per flush
+    (STRICTLY fewer with the optimizer on is the acceptance bar on
+    the relational pipeline) and wall time, plus the per-flush pass
+    note (runs merged / ops eliminated / pushdowns)."""
+    import tempfile
+    import threading
+
+    from dr_tpu import serve
+    from dr_tpu.utils.env import env_override
+    from dr_tpu.utils.spmd_guard import dispatch_count
+    out = {}
+    n_fact = 2 ** 12 if on_cpu else 2 ** 16
+    ncard = max(n_fact // 16, 4)
+    try:
+        leg = {}
+        for mode in ("0", "all"):
+            _plan_opt_chain(n_fact, ncard, mode)   # warm the compiles
+            used, wall, note, res = _plan_opt_chain(n_fact, ncard,
+                                                    mode)
+            leg[mode] = {"dispatches": used,
+                         "wall_ms": round(wall * 1e3, 2)}
+            if mode == "all":
+                leg["opt_note"] = {k: note.get(k) for k in
+                                   ("passes", "merged_runs",
+                                    "dce_ops", "pushdowns")}
+                leg["results"] = {"joined": res[0], "groups": res[1]}
+        leg["fewer_dispatches"] = \
+            leg["all"]["dispatches"] < leg["0"]["dispatches"]
+        out["plan_opt_relational"] = leg
+    except Exception as e:  # pragma: no cover - defensive
+        out["plan_opt_relational_error"] = repr(e)[:160]
+
+    # ---- serve leg: concurrent clients batched into ONE deferred
+    # flush ride the optimizer — scale runs split by opaque scans
+    # coalesce when the §21 merge pass is armed
+    try:
+        rng = np.random.default_rng(20)
+        x = rng.standard_normal(2 ** 12 if on_cpu
+                                else 2 ** 16).astype(np.float32)
+        sleg = {}
+        for mode in ("0", "all"):
+            tmpdir = tempfile.mkdtemp(prefix="dr_tpu_bench_plan_")
+            with env_override(DR_TPU_PLAN_OPT=mode):
+                srv = serve.Server(os.path.join(tmpdir, "p.sock"),
+                                   batch_window=0.01).start()
+                try:
+                    def burst():
+                        errs = []
+
+                        # TWO tenants, one op class each: the DRR
+                        # admission queue round-robins across tenants
+                        # (FIFO within), so the batch's recorded
+                        # queue DETERMINISTICALLY interleaves scale
+                        # runs with opaque scans — the recording-
+                        # order split the merge pass erases
+                        def worker(i):
+                            try:
+                                tenant = "scans" if i % 2 else "scales"
+                                with serve.Client(srv.path,
+                                                  timeout=120.0,
+                                                  tenant=tenant) as c:
+                                    if i % 2:
+                                        c.scan(x)
+                                    else:
+                                        c.scale(x, a=1.0 + i)
+                            except Exception as e:
+                                errs.append(repr(e)[:120])
+                        ths = [threading.Thread(target=worker,
+                                                args=(i,))
+                               for i in range(4)]
+                        t0 = time.perf_counter()
+                        for t in ths:
+                            t.start()
+                        for t in ths:
+                            t.join()
+                        return errs, time.perf_counter() - t0
+                    burst()  # warm the per-shape compiles
+                    d0 = dispatch_count()
+                    errs, wall = burst()
+                    sleg[mode] = {
+                        "dispatches": dispatch_count() - d0,
+                        "wall_ms": round(wall * 1e3, 2)}
+                    if errs:
+                        sleg[mode]["errors"] = errs[:2]
+                finally:
+                    srv.stop()
+                    import shutil
+                    shutil.rmtree(tmpdir, ignore_errors=True)
+        out["plan_opt_serve_batch"] = sleg
+    except Exception as e:  # pragma: no cover - defensive
+        out["plan_opt_serve_error"] = repr(e)[:160]
+    return out
+
+
 def _relay_listening() -> bool:
     """Claim-free reachability check of the loopback tunnel relay (ONE
     copy for the whole repo: utils/resilience.relay_listening — shared
@@ -1552,6 +1694,13 @@ def main():
         if "--redistribute" in sys.argv[1:] \
                 or env_flag("DR_TPU_BENCH_REDISTRIBUTE"):
             secondary.update(_redistribute_metrics(on_cpu))
+        # plan-optimizer config (round 19, docs/SPEC.md §21): the
+        # DR_TPU_PLAN_OPT=0-vs-all A/B over the deferred relational
+        # pipeline and the serve batched flush, opt-in (--plan /
+        # DR_TPU_BENCH_PLAN=1 — argv and env both survive the
+        # CPU-fallback re-execs) and honoring DR_TPU_BENCH_SECONDARY=0
+        if "--plan" in sys.argv[1:] or env_flag("DR_TPU_BENCH_PLAN"):
+            secondary.update(_plan_metrics(on_cpu))
 
     # tagged CPU fallback: the full degradation story (reason, original
     # probe error, retry count, probe wall time — and, AFTER the serve

@@ -81,12 +81,15 @@ from .algorithms.scan import (inclusive_scan, exclusive_scan,
                               inclusive_scan_n)
 from .algorithms.sort import sort, sort_by_key, argsort, is_sorted
 from .algorithms.relational import (join, groupby_aggregate, unique,
-                                    histogram, top_k, DeferredCount)
+                                    histogram, top_k, DeferredCount,
+                                    join_auto, groupby_auto, unique_auto,
+                                    AutoResult)
 from .algorithms.stencil import stencil_transform, stencil_iterate
 from .algorithms.stencil2d import (stencil2d_transform, stencil2d_iterate,
                                    stencil2d_n, heat_step_weights)
 from .algorithms.gemv import gemv, gemv_n, flat_gemv, gemm, spmm, spmm_n
 from . import plan
+from . import tuning
 from .plan import Plan, PlanScalar, deferred
 
 __version__ = "0.1.0"
@@ -106,7 +109,8 @@ __all__ = [
     "reduce_async", "transform_reduce_async", "dot_async",
     "inclusive_scan", "exclusive_scan",
     "join", "groupby_aggregate", "unique", "histogram", "top_k",
-    "DeferredCount",
+    "DeferredCount", "join_auto", "groupby_auto", "unique_auto",
+    "AutoResult", "tuning",
     "stencil_transform", "stencil_iterate",
     "stencil2d_transform", "stencil2d_iterate", "heat_step_weights",
     "gemv", "flat_gemv", "gemm", "spmm",

@@ -68,10 +68,20 @@ def _pick_format(a) -> str:
     (csr / ell / bcsr / ring) overrides the container's build-time
     autoselect (``sparse_matrix.format``).  Read per call so in-process
     sweeps work; every program the choice routes to has its own cache
-    key, so switching formats never reuses a stale program."""
+    key, so switching formats never reuses a stale program.  Between
+    the env pin and the autoselect sits the persisted tuning DB
+    (docs/SPEC.md §21.6): a measured ``spmv.format`` winner for this
+    mesh's backend/shape context (written by ``tune_tpu.py spmv``)
+    replaces the heuristic — an ineligible recorded format still
+    falls down the dispatch chain like a forced one (§12.2)."""
     env = env_str("DR_TPU_SPMV_FORMAT").lower()
     if env in ("csr", "ell", "bcsr", "ring"):
         return env
+    from .. import tuning as _tuning
+    v = _tuning.lookup("spmv", "format")
+    if isinstance(v, str) and v.lower() in ("csr", "ell", "bcsr",
+                                            "ring"):
+        return v.lower()
     return a._format
 
 
@@ -887,7 +897,20 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     from ..plan import active as _plan_active
     p = _plan_active()
     if p is not None:
-        p.record_opaque("gemv", lambda: gemv(c, a, b))
+        # footprint (SPEC §21.2): gemv ACCUMULATES into c (c += A·b),
+        # so c is read and written, never a coverage killer.  A plain
+        # host array b is never written by queued ops; any OTHER
+        # operand shape (a view/span over some container this
+        # footprint cannot name) stays a FULL BARRIER so no pass may
+        # eliminate or reorder its producers
+        if isinstance(b, distributed_vector):
+            reads, writes = (c, b), ((c, False),)
+        elif isinstance(b, (np.ndarray, jnp.ndarray)) or np.isscalar(b):
+            reads, writes = (c,), ((c, False),)
+        else:
+            reads = writes = None
+        p.record_opaque("gemv", lambda: gemv(c, a, b),
+                        reads=reads, writes=writes)
         return c
     assert isinstance(a, sparse_matrix)
     m, n = a.shape

@@ -118,10 +118,11 @@ from .sort import _decode, _encode
 from .. import obs as _obs
 from ..parallel.pipeline import fire_ppermute, ring_pipeline
 from ..utils import resilience as _resilience
-from ..utils.env import env_int
+from ..utils.env import env_int, env_raw
 from ..views import views as _v
 
 __all__ = ["join", "groupby_aggregate", "unique", "histogram", "top_k",
+           "join_auto", "groupby_auto", "unique_auto", "AutoResult",
            "DeferredCount", "AGGS", "JOIN_HOWS", "last_join_route"]
 
 #: supported groupby aggregations (docs/SPEC.md §17.1)
@@ -299,6 +300,32 @@ def _raise_capacity(what: str, need: int, cap: int) -> None:
         f"{what}: result has {need} rows but the output containers "
         f"hold only {cap} — the first {cap} rows are valid; size the "
         "outputs for the worst case or pre-aggregate")
+
+
+def _opaque_meta(kind: str, inputs: dict, outs) -> dict:
+    """The structured record a deferred relational op leaves on its
+    opaque queue item (docs/SPEC.md §21.2): ``inputs`` maps channel
+    name -> the view argument (the THUNK re-reads this dict at flush,
+    so the pushdown pass may rewrite entries in place), ``chains``
+    summarizes each channel as ``(container, off, n, plain)`` for the
+    pass's eligibility checks, and ``outs`` are the containers the
+    eager body rebuilds wholesale (full-coverage writes)."""
+    chains = {}
+    for name, view in inputs.items():
+        ch = _single_chain(view, kind)
+        chains[name] = (ch.cont, ch.off, ch.n, not ch.ops)
+    return {"kind": kind, "inputs": dict(inputs), "chains": chains,
+            "outs": tuple(outs)}
+
+
+def _meta_footprint(meta):
+    """(reads, writes) the plan optimizer keys on: every input chain's
+    container is read; every out container is rebuilt wholesale."""
+    reads = []
+    for _name, ch in meta["chains"].items():
+        if ch[0] not in reads:
+            reads.append(ch[0])
+    return tuple(reads), tuple((c, True) for c in meta["outs"])
 
 
 # ---------------------------------------------------------------------------
@@ -479,6 +506,32 @@ def _check_groupby(keys, values, out_keys, out_values):
     return kc, vc, okc, ovc
 
 
+def _groupby_sorted(rt, sid, sk, sv, n, ok_cont, ov_cont, agg) -> int:
+    """The aggregate half of a groupby, over the ALREADY-SORTED key
+    (and value) scratch — shared by the caller-capacity and the §21.4
+    auto-capacity paths (sort once, probe, allocate, aggregate).
+    Capacity enforcement stays with the caller."""
+    t0 = _obs.now()
+    prog = _groupby_program(
+        rt.mesh, rt.axis, sk.layout, sk.dtype,
+        sv.layout if sv is not None else None,
+        sv.dtype if sv is not None else None,
+        ok_cont.layout, ok_cont.dtype,
+        ov_cont.layout if ov_cont is not None else None,
+        ov_cont.dtype if ov_cont is not None else None,
+        agg, n)
+    args = [sk._data] + ([sv._data] if sv is not None else [])
+    outs = prog(*args)
+    if ov_cont is not None:
+        ok_cont._data, ov_cont._data, ngd = outs
+    else:
+        ok_cont._data, ngd = outs
+    ng = int(ngd)
+    _obs.complete("relational.phase", t0, cat="relational",
+                  parent=sid, phase="aggregate", groups=ng)
+    return ng
+
+
 def _groupby_eager(keys, values, out_keys, out_values, agg) -> int:
     kc, vc, okc, ovc = _check_groupby(keys, values, out_keys,
                                       out_values)
@@ -489,24 +542,9 @@ def _groupby_eager(keys, values, out_keys, out_values, agg) -> int:
     ng = -1
     try:
         sk, sv, n = _sorted_scratch(kc, vc, sid=sid)
-        t0 = _obs.now()
-        prog = _groupby_program(
-            rt.mesh, rt.axis, sk.layout, sk.dtype,
-            sv.layout if sv is not None else None,
-            sv.dtype if sv is not None else None,
-            okc.cont.layout, okc.cont.dtype,
-            ovc.cont.layout if ovc is not None else None,
-            ovc.cont.dtype if ovc is not None else None,
-            agg, n)
-        args = [sk._data] + ([sv._data] if sv is not None else [])
-        outs = prog(*args)
-        if ovc is not None:
-            okc.cont._data, ovc.cont._data, ngd = outs
-        else:
-            okc.cont._data, ngd = outs
-        ng = int(ngd)
-        _obs.complete("relational.phase", t0, cat="relational",
-                      parent=sid, phase="aggregate", groups=ng)
+        ng = _groupby_sorted(rt, sid, sk, sv, n, okc.cont,
+                             ovc.cont if ovc is not None else None,
+                             agg)
         if ng > okc.n:
             _raise_capacity(what, ng, okc.n)
         return ng
@@ -538,14 +576,25 @@ def groupby_aggregate(keys, values, out_keys, out_values,
     # validate NOW — API misuse must raise at the call site whether or
     # not a plan is recording — then defer the dispatch when one is
     # (out_values=None is only the internal unique form)
-    _check_groupby(keys, values, out_keys, out_values)
+    kc, vc, okc, ovc = _check_groupby(keys, values, out_keys,
+                                      out_values)
     p = _plan_active()
     if p is not None:
         box: list = []
+        inputs = {"keys": keys}
+        if values is not None:
+            inputs["values"] = values
+        meta = _opaque_meta(
+            "groupby", inputs,
+            (okc.cont,) + ((ovc.cont,) if ovc is not None else ()))
+        reads, writes = _meta_footprint(meta)
         p.record_opaque(
             "groupby_aggregate",
-            lambda k=keys, v=values, ok=out_keys, ov=out_values, a=agg:
-            box.append(_groupby_eager(k, v, ok, ov, a)))
+            lambda m=meta, ok=out_keys, ov=out_values, a=agg:
+            box.append(_groupby_eager(m["inputs"]["keys"],
+                                      m["inputs"].get("values"),
+                                      ok, ov, a)),
+            reads=reads, writes=writes, meta=meta)
         return DeferredCount(p, box)
     return _groupby_eager(keys, values, out_keys, out_values, agg)
 
@@ -557,14 +606,18 @@ def unique(r, out):
     Keys-only ``groupby_aggregate`` machinery — same sort backbone,
     same capacity contract."""
     _in_chain(r, "unique")
-    _whole_out(out, "unique")
+    okc = _whole_out(out, "unique")
     p = _plan_active()
     if p is not None:
         box: list = []
+        meta = _opaque_meta("unique", {"r": r}, (okc.cont,))
+        reads, writes = _meta_footprint(meta)
         p.record_opaque(
             "unique",
-            lambda k=r, ok=out:
-            box.append(_groupby_eager(k, None, ok, None, "count")))
+            lambda m=meta, ok=out:
+            box.append(_groupby_eager(m["inputs"]["r"], None, ok,
+                                      None, "count")),
+            reads=reads, writes=writes, meta=meta)
         return DeferredCount(p, box)
     return _groupby_eager(r, None, out, None, "count")
 
@@ -744,7 +797,26 @@ def _broadcast_max() -> int:
     small-side fast path).  Above it — with more than one shard and
     both sides non-empty — the merge re-homes on the bounded-memory
     repartition exchange.  ``0`` forces the repartition path (the
-    fuzz/regression arms' switch)."""
+    fuzz/regression arms' switch).
+
+    Route selection from measured data (§21.4, the ``joinroute``
+    pass): when the env var is UNSET, a ``join.broadcast_max`` entry
+    in the persisted tuning DB (``dr_tpu/tuning.py`` — written by the
+    ``tune_tpu.py`` crossover sweep, matched on this mesh's
+    backend/shape context) replaces the code default — sweep winners
+    become data, not code edits.  An explicit env pin always wins
+    (the operator's override), and a disabled pass or missing/corrupt
+    DB falls back to the code default."""
+    if env_raw("DR_TPU_JOIN_BROADCAST_MAX") is None:
+        from ..plan import opt as _opt
+        if _opt.enabled("joinroute"):
+            from .. import tuning as _tuning
+            v = _tuning.lookup("join", "broadcast_max")
+            if v is not None:
+                try:
+                    return max(0, int(v))
+                except (TypeError, ValueError):
+                    pass
     return env_int("DR_TPU_JOIN_BROADCAST_MAX", 1 << 18, floor=0)
 
 
@@ -1164,11 +1236,9 @@ def _join_partition_program(mesh, axis, llayout, lkdtype, lvdtype,
     return prog
 
 
-def _check_join(lk, lv, rk, rv, out_keys, out_lv, out_rv):
-    """The FULL join argument validation — run at the call site
-    (deferred regions included, §17.5) AND again by the eager body at
-    flush.  Symmetric in the sides, so the right-join swap passes the
-    same checks."""
+def _check_join_sides(lk, lv, rk, rv):
+    """Side-only join validation (shared by :func:`join` and the §21.4
+    auto-capacity form, which has no caller outputs to check)."""
     lkc = _in_chain(lk, "join")
     lvc = _in_chain(lv, "join")
     rkc = _in_chain(rk, "join")
@@ -1181,6 +1251,18 @@ def _check_join(lk, lv, rk, rv, out_keys, out_lv, out_rv):
         raise TypeError(
             f"join: key dtypes must match ({lkc.cont.dtype} != "
             f"{rkc.cont.dtype})")
+    if rkc.cont.runtime.mesh != lkc.cont.runtime.mesh:
+        raise TypeError("join: right keys must live on the left keys' "
+                        "mesh")
+    return lkc, lvc, rkc, rvc
+
+
+def _check_join(lk, lv, rk, rv, out_keys, out_lv, out_rv):
+    """The FULL join argument validation — run at the call site
+    (deferred regions included, §17.5) AND again by the eager body at
+    flush.  Symmetric in the sides, so the right-join swap passes the
+    same checks."""
+    lkc, lvc, rkc, rvc = _check_join_sides(lk, lv, rk, rv)
     okc = _whole_out(out_keys, "join")
     olc = _whole_out(out_lv, "join")
     orc = _whole_out(out_rv, "join")
@@ -1230,68 +1312,82 @@ def _join_eager(lk, lv, rk, rv, out_keys, out_lv, out_rv, how,
                                        phase="sort_left")
         srk, srv, nr = _sorted_scratch(rkc, rvc, sid=sid,
                                        phase="sort_right")
-        p_sh, Sl, *_ = working_geometry(slk.layout)
-        _, Sr, *_ = working_geometry(srk.layout)
-        # routing (docs/SPEC.md §18.4): small combined sides keep the
-        # broadcast sorted-merge (one program, O(nl+nr) per device);
-        # above the threshold the merge re-homes on the bounded-memory
-        # repartition exchange — each device merges only its own left
-        # block against the probed, rcap-bounded right partition
-        left_outer = how in ("left", "outer")
-        right_outer = how == "outer"
-        use_partition = (p_sh > 1 and nl > 0 and nr > 0
-                         and nl + nr > _broadcast_max())
-        if use_partition:
-            t0 = _obs.now()
-            fire_ppermute(what="join.partition")
-            probe = _join_partition_probe_program(
-                rt.mesh, rt.axis, slk.layout, slk.dtype,
-                srk.layout, srk.dtype, nl, nr, outer=right_outer)
-            starts, ends = probe(slk._data, srk._data)
-            part = np.asarray(ends) - np.asarray(starts)
-            mx = max(int(part.max(initial=0)), 1)
-            # pow2-quantized partition capacity: bounded recompiles
-            # across key distributions, never beyond the full side
-            rcap = min(1 << (mx - 1).bit_length(), p_sh * Sr)
-            _obs.complete("relational.phase", t0, cat="relational",
-                          parent=sid, phase="partition_plan",
-                          rcap=rcap)
-            t0 = _obs.now()
-            prog = _join_partition_program(
-                rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
-                srk.layout, srk.dtype, srv.dtype,
-                okc.cont.layout, okc.cont.dtype,
-                olc.cont.layout, olc.cont.dtype,
-                orc.cont.layout, orc.cont.dtype,
-                nl, nr, left_outer, rcap, right_outer=right_outer)
-            _set_join_route(impl="partition", nl=nl, nr=nr,
-                            nshards=p_sh, rcap=rcap,
-                            gathered_rows_per_device=Sl + rcap)
-        else:
-            t0 = _obs.now()
-            prog = _join_program(
-                rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
-                srk.layout, srk.dtype, srv.dtype,
-                okc.cont.layout, okc.cont.dtype,
-                olc.cont.layout, olc.cont.dtype,
-                orc.cont.layout, orc.cont.dtype,
-                nl, nr, left_outer, right_outer=right_outer)
-            _set_join_route(impl="broadcast", nl=nl, nr=nr,
-                            nshards=p_sh,
-                            gathered_rows_per_device=p_sh * (Sl + Sr))
-        okc.cont._data, olc.cont._data, orc.cont._data, md = prog(
-            slk._data, slv._data, srk._data, srv._data,
-            jnp.asarray(fill, orc.cont.dtype))
-        m = int(md)
-        _obs.complete("relational.phase", t0, cat="relational",
-                      parent=sid, phase="merge", rows=m,
-                      route="partition" if use_partition
-                      else "broadcast")
+        m = _merge_sorted(rt, sid, slk, slv, nl, srk, srv, nr,
+                          okc.cont, olc.cont, orc.cont, how, fill)
         if m > cap:
             _raise_capacity(f"join[{how}]", m, cap)
         return m
     finally:
         _obs.end(sid, rows=m)
+
+
+def _merge_sorted(rt, sid, slk, slv, nl, srk, srv, nr, ok_cont,
+                  ol_cont, or_cont, how, fill) -> int:
+    """The merge half of a join, over the ALREADY-SORTED scratch sides
+    (the §21.4 capinfer refactor: the auto-capacity path sorts once,
+    probes the count, allocates, and merges — no double sort).
+    Routes broadcast vs repartition (docs/SPEC.md §18.4), runs the
+    program, rebinds the out containers, and returns the row count —
+    capacity enforcement stays with the caller (it knows the
+    contract's wording)."""
+    p_sh, Sl, *_ = working_geometry(slk.layout)
+    _, Sr, *_ = working_geometry(srk.layout)
+    # routing (docs/SPEC.md §18.4): small combined sides keep the
+    # broadcast sorted-merge (one program, O(nl+nr) per device);
+    # above the threshold the merge re-homes on the bounded-memory
+    # repartition exchange — each device merges only its own left
+    # block against the probed, rcap-bounded right partition
+    left_outer = how in ("left", "outer")
+    right_outer = how == "outer"
+    use_partition = (p_sh > 1 and nl > 0 and nr > 0
+                     and nl + nr > _broadcast_max())
+    if use_partition:
+        t0 = _obs.now()
+        fire_ppermute(what="join.partition")
+        probe = _join_partition_probe_program(
+            rt.mesh, rt.axis, slk.layout, slk.dtype,
+            srk.layout, srk.dtype, nl, nr, outer=right_outer)
+        starts, ends = probe(slk._data, srk._data)
+        part = np.asarray(ends) - np.asarray(starts)
+        mx = max(int(part.max(initial=0)), 1)
+        # pow2-quantized partition capacity: bounded recompiles
+        # across key distributions, never beyond the full side
+        rcap = min(1 << (mx - 1).bit_length(), p_sh * Sr)
+        _obs.complete("relational.phase", t0, cat="relational",
+                      parent=sid, phase="partition_plan",
+                      rcap=rcap)
+        t0 = _obs.now()
+        prog = _join_partition_program(
+            rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
+            srk.layout, srk.dtype, srv.dtype,
+            ok_cont.layout, ok_cont.dtype,
+            ol_cont.layout, ol_cont.dtype,
+            or_cont.layout, or_cont.dtype,
+            nl, nr, left_outer, rcap, right_outer=right_outer)
+        _set_join_route(impl="partition", nl=nl, nr=nr,
+                        nshards=p_sh, rcap=rcap,
+                        gathered_rows_per_device=Sl + rcap)
+    else:
+        t0 = _obs.now()
+        prog = _join_program(
+            rt.mesh, rt.axis, slk.layout, slk.dtype, slv.dtype,
+            srk.layout, srk.dtype, srv.dtype,
+            ok_cont.layout, ok_cont.dtype,
+            ol_cont.layout, ol_cont.dtype,
+            or_cont.layout, or_cont.dtype,
+            nl, nr, left_outer, right_outer=right_outer)
+        _set_join_route(impl="broadcast", nl=nl, nr=nr,
+                        nshards=p_sh,
+                        gathered_rows_per_device=p_sh * (Sl + Sr))
+    ok_cont._data, ol_cont._data, or_cont._data, md = prog(
+        slk._data, slv._data, srk._data, srv._data,
+        jnp.asarray(fill, or_cont.dtype))
+    m = int(md)
+    _obs.complete("relational.phase", t0, cat="relational",
+                  parent=sid, phase="merge", rows=m,
+                  route="partition" if use_partition
+                  else "broadcast")
+    return m
 
 
 def join(left_keys, left_values, right_keys, right_values, out_keys,
@@ -1321,21 +1417,428 @@ def join(left_keys, left_values, right_keys, right_values, out_keys,
                          f"(known: {', '.join(JOIN_HOWS)})")
     # validate NOW — API misuse must raise at the call site whether or
     # not a plan is recording (§17.5)
-    _check_join(left_keys, left_values, right_keys, right_values,
-                out_keys, out_left, out_right)
+    _lkc, _lvc, _rkc, _rvc, okc, olc, orc = _check_join(
+        left_keys, left_values, right_keys, right_values,
+        out_keys, out_left, out_right)
     p = _plan_active()
     if p is not None:
         box: list = []
+        meta = _opaque_meta(
+            "join",
+            {"lk": left_keys, "lv": left_values,
+             "rk": right_keys, "rv": right_values},
+            (okc.cont, olc.cont, orc.cont))
+        reads, writes = _meta_footprint(meta)
         p.record_opaque(
             "join",
-            lambda a=left_keys, b=left_values, c=right_keys,
-            d=right_values, ok=out_keys, ol=out_left, orr=out_right,
+            lambda m=meta, ok=out_keys, ol=out_left, orr=out_right,
             h=how, f=fill:
-            box.append(_join_eager(a, b, c, d, ok, ol, orr, h, f)))
+            box.append(_join_eager(m["inputs"]["lk"],
+                                   m["inputs"]["lv"],
+                                   m["inputs"]["rk"],
+                                   m["inputs"]["rv"],
+                                   ok, ol, orr, h, f)),
+            reads=reads, writes=writes, meta=meta)
         return DeferredCount(p, box)
     return _join_eager(left_keys, left_values, right_keys,
                        right_values, out_keys, out_left, out_right,
                        how, fill)
+
+
+# ---------------------------------------------------------------------------
+# capacity inference (docs/SPEC.md §21.4 — the capinfer pass)
+# ---------------------------------------------------------------------------
+
+def _join_count_program(mesh, axis, llayout, lkdtype, rlayout,
+                        rkdtype, nl, nr, left_outer, right_outer):
+    """Count-only join probe over the SORTED key channels: the
+    broadcast merge's row arithmetic with no value gathers and no
+    output assembly — one small program whose scalar is the exact
+    result row count.  The auto-capacity path runs it on the scratch
+    it already sorted, so inference costs one probe dispatch, not a
+    second sort."""
+    key = ("reljoincnt", pinned_id(mesh), axis, llayout, str(lkdtype),
+           rlayout, str(rkdtype), int(nl), int(nr), bool(left_outer),
+           bool(right_outer), bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    p, Sl, *_ = working_geometry(llayout)
+    _, Sr, *_ = working_geometry(rlayout)
+    NL, NR = p * Sl, p * Sr
+
+    def body(lkb, rkb):
+        LK = lax.all_gather(lkb[0], axis).reshape(-1)    # (NL,)
+        RK = lax.all_gather(rkb[0], axis).reshape(-1)    # (NR,)
+        kl, bigl = _encode(LK)
+        kr, bigr = _encode(RK)
+        lvalid = jnp.arange(NL) < nl
+        rvalid = jnp.arange(NR) < nr
+        kl = jnp.where(lvalid, kl, bigl)
+        kr = jnp.where(rvalid, kr, bigr)
+        # the broadcast body's count shape, nr-clamped (§18.4's
+        # integer-pad-sentinel rule)
+        lo = jnp.minimum(jnp.searchsorted(kr, kl, side="left"), nr)
+        hi = jnp.minimum(jnp.searchsorted(kr, kl, side="right"), nr)
+        cnt = jnp.where(lvalid, (hi - lo).astype(jnp.int32), 0)
+        if left_outer:
+            rows = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)
+        else:
+            rows = cnt
+        M = jnp.sum(rows)
+        if right_outer:
+            lo_l = jnp.minimum(jnp.searchsorted(kl, kr, side="left"),
+                               nl)
+            hi_l = jnp.minimum(jnp.searchsorted(kl, kr, side="right"),
+                               nl)
+            M = M + jnp.sum(jnp.where(rvalid & (hi_l == lo_l), 1, 0)
+                            .astype(jnp.int32))
+        return M
+
+    # check_vma=False: M folds the same gathered channels identically
+    # on every shard (the _join_program precedent)
+    shm = jax.shard_map(body, mesh=mesh,
+                        in_specs=(P(axis, None),) * 2,
+                        out_specs=P(), check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _group_count_program(mesh, axis, klayout, kdtype, nreal):
+    """Count-only groupby probe over ONE sorted key scratch: the
+    boundary-flag count of :func:`_groupby_program` with no segmented
+    reduce and no output assembly — the exact distinct-group count."""
+    key = ("relgbcnt", pinned_id(mesh), axis, klayout, str(kdtype),
+           int(nreal), bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    _p, S, cap, prev, nxt, *_rest = working_geometry(klayout)
+    assert prev == 0 and nxt == 0 and cap == S, \
+        "group-count probe runs on the fresh uniform scratch"
+
+    def body(kblk):
+        r = lax.axis_index(axis)
+        kenc, big = _encode(kblk[0])
+        nvalid = jnp.clip(nreal - r * S, 0, S)
+        valid = jnp.arange(S) < nvalid
+        kenc = jnp.where(valid, kenc, big)
+        lasts = lax.all_gather(kenc[S - 1], axis)
+        prevk = lasts[jnp.maximum(r - 1, 0)]
+        first = jnp.where(r == 0, valid[0],
+                          valid[0] & (kenc[0] != prevk))
+        flags = jnp.concatenate(
+            [first[None].astype(jnp.int32),
+             (valid[1:] & (kenc[1:] != kenc[:-1])).astype(jnp.int32)])
+        return lax.psum(jnp.sum(flags), axis)
+
+    shm = jax.shard_map(body, mesh=mesh, in_specs=(P(axis, None),),
+                        out_specs=P(), check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
+def _pow2_cap(n: int) -> int:
+    """Pow2-quantized output capacity (the rcap discipline): bounded
+    program recompiles across nearby result sizes."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def _capinfer_enabled() -> bool:
+    from ..plan import opt as _opt
+    return _opt.enabled("capinfer")
+
+
+def _cap_hint(kind: str, base: int):
+    """Capacity hint for an auto-sized relational output: the
+    measured rows/input ratio from the tuning DB (plus the in-process
+    session overlay the last auto run noted), widened by a 1.25
+    safety margin.  None = no hint — the caller probes exact."""
+    if base <= 0:
+        return None
+    from .. import tuning as _tuning
+    r = _tuning.lookup("relational", "cap_ratio_" + kind)
+    try:
+        r = float(r) if r is not None else None
+    except (TypeError, ValueError):
+        r = None
+    if r is None:
+        return None
+    return max(1, int(np.ceil(r * base * 1.25)))
+
+
+def _note_ratio(kind: str, base: int, m: int) -> None:
+    """Session-note the observed rows/input ratio so the NEXT auto op
+    of this shape skips the probe; ``tune_tpu.py relational`` persists
+    the same ratios into the DB for future processes."""
+    if base > 0:
+        from .. import tuning as _tuning
+        _tuning.note("relational", "cap_ratio_" + kind,
+                     max(m, 1) / base)
+
+
+class AutoResult:
+    """Lazily-resolved result of an auto-capacity relational op
+    (§21.4): the output containers are allocated at execution from the
+    inferred capacity, so inside ``dr_tpu.deferred()`` they exist only
+    after the flush.  Resolution (``count`` / ``containers`` /
+    ``arrays()`` / ``int()``) flushes the owning plan if still
+    pending; a result whose flush was discarded raises instead of
+    lying (the DeferredCount contract)."""
+
+    __slots__ = ("_plan", "_box")
+
+    def __init__(self, plan, box):
+        self._plan = plan
+        self._box = box
+
+    def _resolve(self):
+        if not self._box and self._plan is not None:
+            self._plan.flush("relational auto result read")
+        if not self._box:
+            raise RuntimeError(
+                "auto relational result was discarded before it "
+                "resolved (faulted flush or abandoned region)")
+        return self._box[-1]
+
+    @property
+    def count(self) -> int:
+        return int(self._resolve()[1])
+
+    @property
+    def containers(self) -> tuple:
+        """The allocated output containers (capacity-padded)."""
+        return self._resolve()[0]
+
+    def arrays(self):
+        """Materialized outputs TRIMMED to the real row count."""
+        conts, m = self._resolve()
+        from .elementwise import to_numpy as _tonp
+        return [_tonp(c)[:m] for c in conts]
+
+    def __int__(self):
+        return self.count
+
+    def __repr__(self):
+        state = (f"count={self._box[-1][1]}" if self._box
+                 else "pending")
+        return f"AutoResult({state})"
+
+
+def _fresh_outs(rt, dtypes, cap):
+    from ..containers.distributed_vector import distributed_vector
+    return tuple(distributed_vector(cap, dtype=dt, runtime=rt)
+                 for dt in dtypes)
+
+
+def _join_auto_eager(lk, lv, rk, rv, how, fill):
+    if how == "right":
+        conts, m = _join_auto_eager(rk, rv, lk, lv, "left", fill)
+        ok, orr, ol = conts  # swap the value channels back
+        return (ok, ol, orr), m
+    lkc, lvc, rkc, rvc = _check_join_sides(lk, lv, rk, rv)
+    rt = lkc.cont.runtime
+    dtypes = (lkc.cont.dtype, lvc.cont.dtype, rvc.cont.dtype)
+    sid = _obs.begin("relational.join", cat="relational", how=how,
+                     auto=True, n_left=lkc.n, n_right=rkc.n)
+    m = -1
+    try:
+        if (lkc.n == 0 and not (how == "outer" and rkc.n > 0)) \
+                or (how == "inner" and rkc.n == 0):
+            from .elementwise import fill as _fill
+            conts = _fresh_outs(rt, dtypes, 1)
+            for c in conts:
+                _fill(c, 0)
+            m = 0
+            return conts, 0
+        slk, slv, nl = _sorted_scratch(lkc, lvc, sid=sid,
+                                       phase="sort_left")
+        srk, srv, nr = _sorted_scratch(rkc, rvc, sid=sid,
+                                       phase="sort_right")
+        base = nl + nr
+        exact = None
+        if _capinfer_enabled():
+            cap = _cap_hint("join_" + how, base)
+            if cap is None:
+                t0 = _obs.now()
+                prog = _join_count_program(
+                    rt.mesh, rt.axis, slk.layout, slk.dtype,
+                    srk.layout, srk.dtype, nl, nr,
+                    how in ("left", "outer"), how == "outer")
+                exact = int(prog(slk._data, srk._data))
+                cap = exact
+                _obs.complete("relational.phase", t0,
+                              cat="relational", parent=sid,
+                              phase="cap_probe", rows=exact)
+        else:
+            # the pass is off: the pre-§21 caller-guess shape
+            cap = 4 * base
+        cap = _pow2_cap(cap)
+        conts = _fresh_outs(rt, dtypes, cap)
+        m = _merge_sorted(rt, sid, slk, slv, nl, srk, srv, nr,
+                          *conts, how, fill)
+        if m > cap:
+            # a hinted (or guessed) capacity undershot: re-home on the
+            # exact count and re-merge — never a classified overflow
+            # on the auto path (the §21.4 contract)
+            cap = _pow2_cap(m)
+            conts = _fresh_outs(rt, dtypes, cap)
+            m2 = _merge_sorted(rt, sid, slk, slv, nl, srk, srv, nr,
+                               *conts, how, fill)
+            assert m2 == m, "join count drifted between merges"
+        _note_ratio("join_" + how, base, m)
+        return conts, m
+    finally:
+        _obs.end(sid, rows=m)
+
+
+def _groupby_auto_eager(keys, values, agg, keys_only=False):
+    kc = _in_chain(keys, "groupby_aggregate")
+    vc = _in_chain(values, "groupby_aggregate") \
+        if values is not None else None
+    if vc is not None and vc.n != kc.n:
+        raise ValueError(
+            f"groupby_aggregate: keys and values must have equal "
+            f"length ({kc.n} != {vc.n})")
+    rt = kc.cont.runtime
+    if vc is None:
+        vdt = jnp.int32                       # count channel
+    elif agg == "mean":
+        vdt = _acc_dtype(vc.cont.dtype)       # keeps the fold exact
+    else:
+        vdt = vc.cont.dtype
+    sid = _obs.begin("relational.groupby", cat="relational", agg=agg,
+                     auto=True, n=kc.n)
+    ng = -1
+    try:
+        sk, sv, n = _sorted_scratch(kc, vc, sid=sid)
+        if _capinfer_enabled():
+            cap = _cap_hint("groupby", n)
+            if cap is None:
+                t0 = _obs.now()
+                prog = _group_count_program(rt.mesh, rt.axis,
+                                            sk.layout, sk.dtype, n)
+                cap = int(prog(sk._data))
+                _obs.complete("relational.phase", t0,
+                              cat="relational", parent=sid,
+                              phase="cap_probe", groups=cap)
+        else:
+            cap = n                           # the worst-case guess
+        cap = _pow2_cap(min(cap, max(n, 1)))
+        while True:
+            ok = _fresh_outs(rt, (kc.cont.dtype,), cap)[0]
+            ov = None if keys_only \
+                else _fresh_outs(rt, (vdt,), cap)[0]
+            ng = _groupby_sorted(rt, sid, sk, sv, n, ok, ov, agg)
+            if ng <= cap:
+                break
+            cap = _pow2_cap(ng)  # hint undershot: exact retry
+        _note_ratio("groupby", n, ng)
+        outs = (ok,) if ov is None else (ok, ov)
+        return outs, ng
+    finally:
+        _obs.end(sid, groups=ng)
+
+
+def join_auto(left_keys, left_values, right_keys, right_values, *,
+              how: str = "inner", fill=0):
+    """:func:`join` with INFERRED output capacity (docs/SPEC.md
+    §21.4, the ``capinfer`` pass): the outputs are allocated from a
+    key-cardinality probe on the already-sorted scratch (or a
+    tuning-DB ratio hint that skips the probe; an undershot hint
+    re-merges at the exact count — never a classified overflow).
+    Returns an :class:`AutoResult`; with the pass disabled the
+    capacity falls back to the pre-§21 ``4 * (nl + nr)`` guess."""
+    if how not in JOIN_HOWS:
+        raise ValueError(f"join: unknown how {how!r} "
+                         f"(known: {', '.join(JOIN_HOWS)})")
+    _check_join_sides(left_keys, left_values, right_keys,
+                      right_values)
+    p = _plan_active()
+    if p is not None:
+        box: list = []
+        meta = _opaque_meta(
+            "join",
+            {"lk": left_keys, "lv": left_values,
+             "rk": right_keys, "rv": right_values}, ())
+        reads, _w = _meta_footprint(meta)
+        p.record_opaque(
+            "join(auto)",
+            lambda m=meta, h=how, f=fill:
+            box.append(_join_auto_eager(m["inputs"]["lk"],
+                                        m["inputs"]["lv"],
+                                        m["inputs"]["rk"],
+                                        m["inputs"]["rv"], h, f)),
+            reads=reads, writes=(), meta=meta)
+        return AutoResult(p, box)
+    box = [_join_auto_eager(left_keys, left_values, right_keys,
+                            right_values, how, fill)]
+    return AutoResult(None, box)
+
+
+def groupby_auto(keys, values, agg: str = "sum"):
+    """:func:`groupby_aggregate` with INFERRED output capacity
+    (§21.4): out containers sized from the distinct-key count probe
+    (or the tuning-DB ratio hint).  Returns an :class:`AutoResult`
+    over ``(out_keys, out_values)``."""
+    if agg not in AGGS:
+        raise ValueError(f"groupby_aggregate: unknown agg {agg!r} "
+                         f"(known: {', '.join(AGGS)})")
+    if values is None and agg != "count":
+        raise ValueError(
+            f"groupby_aggregate: agg {agg!r} needs values "
+            "(only 'count' accepts values=None)")
+    kc = _in_chain(keys, "groupby_aggregate")
+    if values is not None:
+        # §17.5 discipline: API misuse raises at the CALL SITE, not
+        # inside the deferred flush (where it would classify away the
+        # whole batch and point the traceback at the wrong place)
+        vc = _in_chain(values, "groupby_aggregate")
+        if vc.n != kc.n:
+            raise ValueError(
+                f"groupby_aggregate: keys and values must have equal "
+                f"length ({kc.n} != {vc.n})")
+    p = _plan_active()
+    if p is not None:
+        box: list = []
+        inputs = {"keys": keys}
+        if values is not None:
+            inputs["values"] = values
+        meta = _opaque_meta("groupby", inputs, ())
+        reads, _w = _meta_footprint(meta)
+        p.record_opaque(
+            "groupby(auto)",
+            lambda m=meta, a=agg:
+            box.append(_groupby_auto_eager(m["inputs"]["keys"],
+                                           m["inputs"].get("values"),
+                                           a)),
+            reads=reads, writes=(), meta=meta)
+        return AutoResult(p, box)
+    box = [_groupby_auto_eager(keys, values, agg)]
+    return AutoResult(None, box)
+
+
+def unique_auto(r):
+    """:func:`unique` with INFERRED output capacity (§21.4).  Returns
+    an :class:`AutoResult` over ``(out,)``."""
+    _in_chain(r, "unique")
+    p = _plan_active()
+    if p is not None:
+        box: list = []
+        meta = _opaque_meta("unique", {"r": r}, ())
+        reads, _w = _meta_footprint(meta)
+        p.record_opaque(
+            "unique(auto)",
+            lambda m=meta:
+            box.append(_groupby_auto_eager(m["inputs"]["r"], None,
+                                           "count", keys_only=True)),
+            reads=reads, writes=(), meta=meta)
+        return AutoResult(p, box)
+    box = [_groupby_auto_eager(r, None, "count", keys_only=True)]
+    return AutoResult(None, box)
 
 
 # ---------------------------------------------------------------------------

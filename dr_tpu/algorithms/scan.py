@@ -399,6 +399,24 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
     return prog
 
 
+def _scan_footprint(in_r, out):
+    """Optimizer footprint of a recorded-opaque scan (SPEC §21.2):
+    input chain containers are read; the out container is read AND
+    window-written (never a coverage killer — ``_write_window``
+    preserves cells outside the window).  Unresolvable shapes stay a
+    full barrier (None, None)."""
+    try:
+        ins = _resolve(in_r)
+        oc = _out_chain(out)
+    except Exception:
+        return None, None
+    if ins is None or oc is None:
+        return None, None
+    reads = {id(c.cont): c.cont for c in ins}
+    reads[id(oc.cont)] = oc.cont
+    return tuple(reads.values()), ((oc.cont, False),)
+
+
 def _scan(in_r, out, op, init, exclusive):
     if op is None:
         op = operator.add
@@ -521,9 +539,11 @@ def inclusive_scan(in_r, out, op: Callable = None, init=None):
     program rather than fused into the neighboring run."""
     p = _plan_active()
     if p is not None:
+        reads, writes = _scan_footprint(in_r, out)
         p.record_opaque(
             "inclusive_scan",
-            lambda: _scan(in_r, out, op, init, exclusive=False))
+            lambda: _scan(in_r, out, op, init, exclusive=False),
+            reads=reads, writes=writes)
         return out
     return _scan(in_r, out, op, init, exclusive=False)
 
@@ -576,9 +596,11 @@ def exclusive_scan(in_r, out, init=0, op: Callable = None):
     opaque, like :func:`inclusive_scan`."""
     p = _plan_active()
     if p is not None:
+        reads, writes = _scan_footprint(in_r, out)
         p.record_opaque(
             "exclusive_scan",
-            lambda: _exclusive_scan_eager(in_r, out, init, op))
+            lambda: _exclusive_scan_eager(in_r, out, init, op),
+            reads=reads, writes=writes)
         return out
     return _exclusive_scan_eager(in_r, out, init, op)
 

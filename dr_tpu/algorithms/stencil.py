@@ -169,7 +169,9 @@ def stencil_iterate(a_dv, b_dv, op: Union[Callable, Sequence[float]],
         # already one dispatch for S steps: record OPAQUE (deferred in
         # order, dispatched through its own program at flush)
         p.record_opaque("stencil_iterate",
-                        lambda: stencil_iterate(a_dv, b_dv, op, steps))
+                        lambda: stencil_iterate(a_dv, b_dv, op, steps),
+                        reads=(a_dv, b_dv),
+                        writes=((a_dv, False), (b_dv, False)))
         return a_dv
     cont = a_dv
     assert b_dv.layout == cont.layout
@@ -228,7 +230,8 @@ def stencil_iterate_blocked(dv, weights, steps: int, *, time_block: int = 8,
             lambda: stencil_iterate_blocked(dv, weights, steps,
                                             time_block=time_block,
                                             chunk=chunk,
-                                            interpret=interpret))
+                                            interpret=interpret),
+            reads=(dv,), writes=((dv, False),))
         return dv
     cont = dv
     hb = cont.halo_bounds
@@ -289,7 +292,8 @@ def stencil_iterate_matmul(dv, weights, steps: int, *, k_block: int = 32):
         p.record_opaque(
             "stencil_iterate_matmul",
             lambda: stencil_iterate_matmul(dv, weights, steps,
-                                           k_block=k_block))
+                                           k_block=k_block),
+            reads=(dv,), writes=((dv, False),))
         return dv
     from ..ops import stencil_matmul
     cont = dv

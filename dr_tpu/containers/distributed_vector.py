@@ -41,13 +41,15 @@ from ..utils.spmd_guard import TappedCache
 __all__ = ["distributed_vector", "halo"]
 
 
-def _plan_flush(reason: str) -> None:
+def _plan_flush(reason: str, cont=None) -> None:
     """Host-visible reads/writes of container state are deferred-plan
     flush points (dr_tpu/plan.py): pending recorded ops must land
     before ``_data`` is observed or externally rebound.  Lazy import —
-    the plan module builds on the algorithm layer above this one."""
+    the plan module builds on the algorithm layer above this one.
+    With ``cont``, the flush is footprint-gated (SPEC §21.2): a queue
+    that provably never touches the container skips the cliff."""
     from ..plan import flush_reads
-    flush_reads(reason)
+    flush_reads(reason, cont)
 
 
 def _normalize_dtype(dtype):
@@ -222,8 +224,11 @@ class distributed_vector:
                         self._dtype)(self._data)
 
     def assign_array(self, values) -> None:
-        """Rebind the whole logical value (ghost cells reset to zero)."""
-        _plan_flush("assign_array")
+        """Rebind the whole logical value (ghost cells reset to zero).
+        Footprint-gated flush: a container the active plan's queue
+        never touches (the from_array build of a FRESH operand inside
+        a serve batch) assigns without the flush cliff."""
+        _plan_flush("assign_array", self)
         values = jnp.asarray(values, self._dtype)
         assert values.shape == (self._n,)
         if self._dist_entry is not None:

@@ -67,8 +67,20 @@ def chunk_cap() -> int:
     amortize the sequential grid's per-step overhead; the (R, R)
     matmul-variant offset operator and the 4*R KiB VMEM buffers push
     back.  Read per call — scan program caches key on it
-    (algorithms/scan.py ``_kernel_variant``)."""
-    from ..utils.env import env_pow2
+    (algorithms/scan.py ``_kernel_variant``).  When the env var is
+    unset, a measured ``scan.chunk`` winner in the persisted tuning
+    DB (docs/SPEC.md §21.6, written by ``tune_tpu.py scan``) replaces
+    the code default for this mesh's backend/shape context."""
+    from ..utils.env import env_pow2, env_raw
+    if env_raw("DR_TPU_SCAN_CHUNK") is None:
+        from .. import tuning as _tuning
+        v = _tuning.lookup("scan", "chunk")
+        if v is not None:
+            try:
+                v = max(int(v), LANES)
+                return max(LANES, 1 << (v.bit_length() - 1))
+            except (TypeError, ValueError):
+                pass
     return env_pow2("DR_TPU_SCAN_CHUNK", _MAX_ROWS, floor=LANES)
 
 

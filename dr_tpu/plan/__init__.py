@@ -82,6 +82,17 @@ raises the classified error — never a hang, never silent corruption.
 Unresolved :class:`PlanScalar` handles from a discarded queue raise on
 resolution instead of returning stale numbers.
 
+Optimizer (round 19, docs/SPEC.md §21): the recorded queue is a
+LOGICAL plan.  At flush, ``plan/opt.py`` runs a pass pipeline over it
+— merge independent fusible runs split only by recording order (fewer
+dispatches per flush), eliminate dead ops whose writes are fully
+overwritten before any read, push single-input projections into the
+relational scratch-sort copy, infer relational output capacities from
+key-cardinality probes, and pick the join merge route from measured
+thresholds in the persisted tuning DB (``dr_tpu/tuning.py``).  Every
+pass is bit-identical-by-construction; ``DR_TPU_PLAN_OPT=0|auto|all``
+and per-pass ``DR_TPU_PLAN_OPT_DISABLE`` bisect them.
+
 Observability: :meth:`Plan.explain` / :meth:`Plan.stats` report fused
 runs, flush reasons, program-cache hits, and per-flush dispatch counts
 from the spmd_guard tap (``utils.spmd_guard.dispatch_count``).  Under
@@ -93,8 +104,8 @@ metrics registry (docs/SPEC.md §15).
 
 from __future__ import annotations
 
-from .utils.env import env_str
-from .utils import sanitize as _sanitize
+from ..utils.env import env_str
+from ..utils import sanitize as _sanitize
 import threading as _threading
 from contextlib import contextmanager
 from typing import List, Optional
@@ -103,17 +114,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .algorithms._common import owned_window_mask
-from .algorithms.elementwise import (_apply_chain_ops, _chain_scalars,
+from ..algorithms._common import owned_window_mask
+from ..algorithms.elementwise import (_apply_chain_ops, _chain_scalars,
                                      _op_key, _traced_op_key)
-from .algorithms.reduce import _MONOIDS, _identity_for
-from .core.pinning import pinned_id
-from . import obs as _obs
-from .utils import faults as _faults
-from .utils import resilience as _resilience
-from .utils import spmd_guard as _guard
-from .utils.spmd_guard import TappedCache
-from .views import views as _v
+from ..algorithms.reduce import _MONOIDS, _identity_for
+from ..core.pinning import pinned_id
+from .. import obs as _obs
+from ..utils import faults as _faults
+from ..utils import resilience as _resilience
+from ..utils import spmd_guard as _guard
+from ..utils.spmd_guard import TappedCache
+from ..views import views as _v
 
 __all__ = ["Plan", "PlanScalar", "deferred", "active", "flush_reads",
            "barrier"]
@@ -152,12 +163,22 @@ def active() -> Optional["Plan"]:
     return p
 
 
-def flush_reads(reason: str = "host materialization") -> None:
+def flush_reads(reason: str = "host materialization",
+                cont=None) -> None:
     """Flush the active plan (if any) before host-visible state is
-    read or externally mutated — the container/runtime hooks call this."""
+    read or externally mutated — the container/runtime hooks call
+    this.  With ``cont`` given, the flush is SKIPPED when the queue
+    provably never touches that container (docs/SPEC.md §21.2 — the
+    same footprints the optimizer keys on): a host write into a fresh
+    container (the serve daemon building each batched request's
+    operands) must not force the flush cliff on its batchmates'
+    recorded ops.  Unknown footprints keep the conservative flush."""
     p = _get_active()
-    if p is not None and not p._flushing and p._queue:
-        p.flush(reason)
+    if p is None or p._flushing or not p._queue:
+        return
+    if cont is not None and not p.queue_touches(cont):
+        return
+    p.flush(reason)
 
 
 def barrier(what: str) -> None:
@@ -263,17 +284,38 @@ class _FusedOp:
     traced ``vals`` (parallel to the "t" entries), and an optional
     ``pre`` dispatch-time hook (fired by ``_exec_run`` before the
     program-cache lookup — the fused analog of the eager dispatchers'
-    fault-site fires, e.g. ``redistribute.exchange``)."""
+    fault-site fires, e.g. ``redistribute.exchange``).
 
-    __slots__ = ("name", "key", "emit", "spec", "vals", "pre")
+    Optimizer footprint (docs/SPEC.md §21.2): ``reads`` is the tuple
+    of run-local container SLOTS whose VALUES the op consumes;
+    ``writes`` is a tuple of ``(slot, off, n, full)`` windows written
+    (``full`` = the whole padded row is rebuilt, ghosts included —
+    the op is a coverage KILLER for everything under it); ``pure``
+    marks ops the dead-op pass may eliminate outright (no reduction
+    handles, no ``pre`` side effects, no metadata flips).  The
+    mask-preserve self-read of a windowed write (cells outside the
+    mask pass through) is deliberately NOT in ``reads`` — the
+    coverage analysis only credits a kept op's write window when the
+    op does not read that container, which makes the passthrough
+    cells either covered-later or untouched (§21.2's argument).
+    ``push`` (transforms only) carries what the projection-pushdown
+    pass needs to re-home the op onto a relational scratch copy."""
 
-    def __init__(self, name, key, emit, spec=(), vals=(), pre=None):
+    __slots__ = ("name", "key", "emit", "spec", "vals", "pre",
+                 "reads", "writes", "pure", "push")
+
+    def __init__(self, name, key, emit, spec=(), vals=(), pre=None,
+                 reads=(), writes=(), pure=False, push=None):
         self.name = name
         self.key = key
         self.emit = emit
         self.spec = spec
         self.vals = list(vals)
         self.pre = pre
+        self.reads = tuple(reads)
+        self.writes = tuple(writes)
+        self.pure = pure
+        self.push = push
 
 
 class _Run:
@@ -300,13 +342,27 @@ class _Run:
 class _Opaque:
     """A recorded-but-not-fused op (inclusive_scan, stencil_iterate):
     deferred until flush, executed through its eager path there — it
-    splits the fusible runs around it but keeps record order."""
+    splits the fusible runs around it but keeps record order.
 
-    __slots__ = ("name", "thunk")
+    Optimizer footprint (docs/SPEC.md §21.2): ``reads`` is the tuple
+    of CONTAINERS whose values the thunk consumes, ``writes`` a tuple
+    of ``(container, full)`` pairs (``full`` = the eager path rebuilds
+    the whole container — a coverage killer, the relational outputs'
+    shape).  ``None`` for either means UNKNOWN: the op is a barrier no
+    pass may reorder across or eliminate through.  ``meta`` (dict or
+    None) is the structured record the relational tier leaves for the
+    pushdown/capinfer passes — the thunk re-reads ``meta`` at flush,
+    so a pass may rewrite its entries in place."""
 
-    def __init__(self, name, thunk):
+    __slots__ = ("name", "thunk", "reads", "writes", "meta")
+
+    def __init__(self, name, thunk, reads=None, writes=None,
+                 meta=None):
         self.name = name
         self.thunk = thunk
+        self.reads = None if reads is None else tuple(reads)
+        self.writes = None if writes is None else tuple(writes)
+        self.meta = meta
 
 
 class Plan:
@@ -365,7 +421,7 @@ class Plan:
             try:
                 thunk()
             except Exception as e:  # pragma: no cover - defensive
-                from .utils.fallback import warn_fallback
+                from ..utils.fallback import warn_fallback
                 warn_fallback("plan", f"redistribute undo failed "
                                       f"({e!r})")
 
@@ -376,6 +432,25 @@ class Plan:
             return list(values)
         return [self._subst.get(id(v), v) if isinstance(v, PlanScalar)
                 else v for v in values]
+
+    def queue_touches(self, cont) -> bool:
+        """Could any queued item read or write ``cont``?  The §21.2
+        footprint check :func:`flush_reads` keys its skip on.  A run
+        answers by slot membership; an opaque item with UNKNOWN
+        footprints (None reads/writes) answers True — the
+        conservative barrier."""
+        cid = id(cont)
+        for item in self._queue:
+            if isinstance(item, _Run):
+                if cid in item._cont_ids:
+                    return True
+            else:
+                if item.reads is None or item.writes is None:
+                    return True
+                if any(id(c) == cid for c in item.reads) or \
+                        any(id(c) == cid for c, _f in item.writes):
+                    return True
+        return False
 
     # ------------------------------------------------------------ region
     @contextmanager
@@ -459,7 +534,9 @@ class Plan:
             state[slot] = jnp.where(mask, v.astype(out_data.dtype),
                                     out_data)
 
-        run.ops.append(_FusedOp(gkind, key, emit, spec, vals))
+        run.ops.append(_FusedOp(gkind, key, emit, spec, vals,
+                                writes=((slot, off, n, False),),
+                                pure=True))
         self._note_replay(
             lambda oc=out_chain, g=gkind, v=value:
             self.record_generator(oc, g, v))
@@ -500,7 +577,18 @@ class Plan:
             v = jnp.broadcast_to(v, out_data.shape).astype(out_data.dtype)
             state[out_slot] = jnp.where(mask, v, out_data)
 
-        run.ops.append(_FusedOp(name, key, emit, spec, vals))
+        # pushdown eligibility (docs/SPEC.md §21.4): a single-input
+        # same-dtype windowed map with no view-chain ops and no
+        # index/PlanScalar dependence can be re-homed into a relational
+        # scratch-sort copy bit-identically (op → one cast, both paths)
+        push = None
+        if (len(ins) == 1 and not ins[0].ops and not with_index
+                and jnp.dtype(ins[0].cont.dtype) == jnp.dtype(cont.dtype)
+                and not any(isinstance(s, PlanScalar) for s in all_sc)):
+            push = (ins[0].cont, off, n, op, tuple(scalars))
+        run.ops.append(_FusedOp(
+            name, key, emit, spec, vals, reads=in_slots,
+            writes=((out_slot, off, n, False),), pure=True, push=push))
         self._note_replay(
             lambda i=ins, oc=out_chain, o=op, sc=tuple(scalars),
             wi=with_index, nm=name:
@@ -530,7 +618,10 @@ class Plan:
                 state[s] = jnp.where(mask, nv.astype(state[s].dtype),
                                      state[s])
 
-        run.ops.append(_FusedOp("for_each(zip)", key, emit, spec, vals))
+        run.ops.append(_FusedOp(
+            "for_each(zip)", key, emit, spec, vals, reads=in_slots,
+            writes=tuple((s, off, n, False) for s in out_slots),
+            pure=True))
         self._note_replay(
             lambda i=ins, o=outs, f=fn, sc=tuple(scalars):
             self.record_zip_foreach(i, o, f, sc))
@@ -576,7 +667,8 @@ class Plan:
 
         handle = PlanScalar(self, run, len(run.handles))
         run.handles.append(handle)
-        run.ops.append(_FusedOp("reduce", key, emit, spec, vals))
+        run.ops.append(_FusedOp("reduce", key, emit, spec, vals,
+                                reads=slots))
         self._note_replay(
             lambda ch=chains, k=kind, z=zip_op:
             self.record_reduce(ch, k, z), handle)
@@ -615,7 +707,16 @@ class Plan:
             owned, _ = owned_window_mask(layout, 0, total)
             state[slot] = jnp.where(owned, new, jnp.zeros((), dtype))
 
-        run.ops.append(_FusedOp("copy(host)", key, emit, spec, vals))
+        # whole-container splice rebuilds every cell (ghosts zeroed):
+        # a coverage KILLER; the windowed form preserves owned cells
+        # outside the window (a self-read) and zeroes ghosts — kept
+        # out of the dead-op pass entirely (pure=False)
+        whole = (off == 0 and n == total)
+        run.ops.append(_FusedOp(
+            "copy(host)", key, emit, spec, vals,
+            reads=() if whole else (slot,),
+            writes=((slot, 0, total, True) if whole
+                    else (slot, off, n, False),)))
         self._note_replay(
             lambda oc=out_chain, v=values: self.record_splice(oc, v))
         return True
@@ -635,7 +736,7 @@ class Plan:
         axis, mesh = dv.runtime.axis, dv.runtime.mesh
 
         def emit(state, svals, souts):
-            from .parallel import halo as _halo
+            from ..parallel import halo as _halo
             if kind == "exchange":
                 body = _halo._exchange_body(axis, nshards, seg, prev,
                                             nxt, periodic, n)
@@ -649,7 +750,9 @@ class Plan:
                                 out_specs=P(axis, None))
             state[slot] = shm(state[slot])
 
-        run.ops.append(_FusedOp(f"halo.{kind}", key, emit))
+        run.ops.append(_FusedOp(f"halo.{kind}", key, emit,
+                                reads=(slot,),
+                                writes=((slot, 0, n, False),)))
         self._note_replay(
             lambda d=dv, k=kind, o=op, it=iters:
             self.record_halo(d, k, o, it))
@@ -665,7 +768,7 @@ class Plan:
                str(out_cont.dtype))
 
         def emit(state, svals, souts):
-            from .algorithms.stencil import build_stencil_step
+            from ..algorithms.stencil import build_stencil_step
             step = build_stencil_step(layout, periodic, body_op, prev,
                                       nxt, axis)
             shm = jax.shard_map(
@@ -674,7 +777,9 @@ class Plan:
                 out_specs=P(axis, None))
             state[so] = shm(state[si], state[so])
 
-        run.ops.append(_FusedOp("stencil", key, emit))
+        run.ops.append(_FusedOp(
+            "stencil", key, emit, reads=(si, so),
+            writes=((so, 0, len(out_cont), False),)))
         # the replay thunk re-derives layout/axis/mesh from the LIVE
         # container (the recorded values would resurrect the dead mesh)
         self._note_replay(
@@ -694,7 +799,7 @@ class Plan:
         before the move ran; the elastic replay thunk re-records
         against the CURRENT global runtime (re-reading the rescued
         container's layout at call time, the stencil discipline)."""
-        from .parallel import runtime as _rtmod
+        from ..parallel import runtime as _rtmod
         target = rt or _rtmod.runtime()
         src_rt = cont.runtime
         src_dist = cont.distribution
@@ -708,7 +813,7 @@ class Plan:
         key = ("rdx", slot, src_layout, dst_layout, str(dtype))
 
         def emit(state, svals, souts):
-            from .parallel import redistribute as _rdx
+            from ..parallel import redistribute as _rdx
             body = _rdx._exchange_body(axis, src_layout, dst_layout,
                                        jnp.dtype(dtype))
             shm = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None),
@@ -716,14 +821,16 @@ class Plan:
             state[slot] = shm(state[slot])
 
         def pre():
-            from .parallel import redistribute as _rdx
+            from ..parallel import redistribute as _rdx
             _rdx.fire_exchange(src=str(src_layout), dst=str(dst_layout))
             _rdx.fire_ppermute(what="redistribute")
             _, moved = _rdx.plan_moves(src_layout, dst_layout)
             _obs.count("redistribute.bytes_moved",
                        moved * jnp.dtype(dtype).itemsize)
 
-        run.ops.append(_FusedOp("redistribute", key, emit, pre=pre))
+        run.ops.append(_FusedOp(
+            "redistribute", key, emit, pre=pre, reads=(slot,),
+            writes=((slot, 0, len(cont), False),)))
         self._note_undo(
             lambda c=cont, r=src_rt, d=src_dist:
             c._rebind(r, d, _data=c._data))
@@ -755,7 +862,7 @@ class Plan:
                out_layout, str(out_dtype), bins, spec)
 
         def emit(state, svals, souts):
-            from .algorithms import relational as _rel
+            from ..algorithms import relational as _rel
             body = _rel._histogram_body(axis, in_layout, off, n, ops,
                                         nsc, out_layout, bins,
                                         jnp.dtype(out_dtype))
@@ -765,7 +872,9 @@ class Plan:
                 out_specs=P(axis, None))
             state[so] = shm(state[si], *svals)
 
-        run.ops.append(_FusedOp("histogram", key, emit, spec, vals))
+        run.ops.append(_FusedOp(
+            "histogram", key, emit, spec, vals, reads=(si,),
+            writes=((so, 0, bins, True),), pure=True))
         self._note_replay(
             lambda ic=in_chain, oc=out_chain, l=lo, h=hi:
             self.record_histogram(ic, oc, l, h))
@@ -799,7 +908,7 @@ class Plan:
                k, bool(largest), bool(merge), spec)
 
         def emit(state, svals, souts):
-            from .algorithms import relational as _rel
+            from ..algorithms import relational as _rel
             body = _rel._top_k_body(axis, in_layout, off, n, ops, nsc,
                                     ov_layout, jnp.dtype(ov_dtype),
                                     oi_layout, k, largest, merge)
@@ -821,18 +930,32 @@ class Plan:
             else:
                 state[sov] = outs
 
-        run.ops.append(_FusedOp("top_k", key, emit, spec, vals))
+        tk_writes = ((sov, 0, k, True),)
+        if soi is not None:
+            tk_writes += ((soi, 0, k, True),)
+        tk_reads = (si,)
+        if merge:
+            tk_reads += (sov,) + ((soi,) if soi is not None else ())
+        run.ops.append(_FusedOp("top_k", key, emit, spec, vals,
+                                reads=tk_reads, writes=tk_writes,
+                                pure=True))
         self._note_replay(
             lambda ic=in_chain, vc=ov_chain, xc=oi_chain, lg=largest,
             mg=merge: self.record_top_k(ic, vc, xc, lg, mg))
         return True
 
-    def record_opaque(self, name: str, thunk) -> bool:
+    def record_opaque(self, name: str, thunk, reads=None, writes=None,
+                      meta=None) -> bool:
         """Record a deferred-but-not-fused op (its eager path runs at
-        flush, in record order); it closes the current fusible run."""
-        self._queue.append(_Opaque(name, thunk))
+        flush, in record order); it closes the current fusible run.
+        ``reads``/``writes``/``meta`` are the optimizer footprint
+        (see :class:`_Opaque`); omitting them keeps the op a full
+        barrier — correct, just opaque to the §21 passes."""
+        self._queue.append(_Opaque(name, thunk, reads=reads,
+                                   writes=writes, meta=meta))
         self._note_replay(
-            lambda n=name, t=thunk: self.record_opaque(n, t))
+            lambda n=name, t=thunk, r=reads, w=writes, m=meta:
+            self.record_opaque(n, t, r, w, m))
         return True
 
     def nonfusible(self, what: str) -> None:
@@ -842,7 +965,7 @@ class Plan:
         the dispatch cost the region was opened to avoid."""
         if not self._queue:
             return
-        from .utils.fallback import warn_fallback
+        from ..utils.fallback import warn_fallback
         warn_fallback("plan", f"non-fusible {what} forced a flush")
         self.flush(f"non-fusible: {what}")
 
@@ -866,13 +989,20 @@ class Plan:
                          items=len(queue))
         entry = {"reason": reason, "items": []}
         self.log.append(entry)
+        # optimizer pass pipeline (docs/SPEC.md §21): the recorded
+        # queue is the LOGICAL plan; the passes rewrite it into the
+        # executed queue (merged runs carry ``_sources`` back to the
+        # recorded items so the undo/replay/faulted-flush contracts
+        # keep holding against record identities)
+        from . import opt as _opt
+        exec_queue = _opt.optimize(self, queue, entry, parent=sid)
         d0 = _guard.dispatch_count()
         idx = 0
         try:
             # the injection site fires BEFORE any dispatch: a faulted
             # flush executes nothing and containers stay consistent
             _faults.fire("plan.flush")
-            for idx, item in enumerate(queue):
+            for idx, item in enumerate(exec_queue):
                 di = _guard.dispatch_count()
                 t0 = _obs.now()
                 if isinstance(item, _Opaque):
@@ -930,11 +1060,14 @@ class Plan:
             # in the suffix UNDO first (metadata back over the
             # still-src-shaped data) so the rescue's host gathers read
             # a consistent container; the replay thunks re-record them
-            # against the shrunken mesh.
-            self._undo_items(undos, queue[idx:])
+            # against the shrunken mesh.  The unexecuted suffix is
+            # expanded back to RECORDED items (merged runs carry their
+            # sources) so undo/replay match the record-time identities.
+            suffix = _opt.expand_items(exec_queue[idx:])
+            self._undo_items(undos, suffix)
             self._flushing = False
             try:
-                recovered = self._elastic_recover(queue[idx:], replay,
+                recovered = self._elastic_recover(suffix, replay,
                                                   de, entry)
             except BaseException:
                 # the replay itself died (a lost container under a
@@ -949,7 +1082,7 @@ class Plan:
                 entry["error"] = True
                 raise
         except BaseException:
-            self._undo_items(undos, queue[idx:])
+            self._undo_items(undos, _opt.expand_items(exec_queue[idx:]))
             self._break_handles(queue)
             entry["error"] = True
             raise
@@ -988,7 +1121,7 @@ class Plan:
         rescue is possible (elastic off, shrink floor, nested loss):
         the caller then drops the queue classified — exactly the
         pre-elastic faulted-flush contract."""
-        from .utils import elastic as _elastic
+        from ..utils import elastic as _elastic
         if not (_elastic.enabled() and _elastic.try_rescue(err)):
             return False
         suffix_ids = {id(it) for it in suffix}
@@ -1121,6 +1254,7 @@ class Plan:
     def stats(self) -> dict:
         items = [i for e in self.log for i in e.get("items", [])]
         fused = [i for i in items if i["kind"] == "fused"]
+        opts = [e.get("opt") for e in self.log if e.get("opt")]
         return {
             "flushes": len(self.log),
             "fused_runs": len(fused),
@@ -1128,6 +1262,12 @@ class Plan:
             "opaque_ops": sum(1 for i in items if i["kind"] == "opaque"),
             "cache_hits": sum(1 for i in fused if i["cache_hit"]),
             "dispatches": self.dispatches,
+            "opt": {
+                "merged_runs": sum(o.get("merged_runs", 0)
+                                   for o in opts),
+                "dce_ops": sum(o.get("dce_ops", 0) for o in opts),
+                "pushdowns": sum(o.get("pushdowns", 0) for o in opts),
+            },
         }
 
     def explain(self) -> str:
@@ -1143,6 +1283,13 @@ class Plan:
             tag = " [ERROR]" if e.get("error") else ""
             lines.append(f"  flush ({e['reason']}){tag}: "
                          f"{e.get('dispatches', 0)} dispatch(es)")
+            o = e.get("opt")
+            if o:
+                lines.append(
+                    f"    opt [{'+'.join(o.get('passes', ()))}]: "
+                    f"{o.get('merged_runs', 0)} run(s) merged, "
+                    f"{o.get('dce_ops', 0)} dead op(s) eliminated, "
+                    f"{o.get('pushdowns', 0)} pushdown(s)")
             for it in e.get("items", []):
                 if it["kind"] == "fused":
                     lines.append(
@@ -1183,5 +1330,5 @@ def deferred():
     # raises (a failed probe/grow leaves the session on the small
     # mesh).  Skipped when the region body raised: the discard path
     # must surface the user's error, not a recovery side quest.
-    from .utils import elastic as _elastic
+    from ..utils import elastic as _elastic
     _elastic.maybe_grow()

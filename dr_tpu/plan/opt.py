@@ -1,0 +1,545 @@
+"""Plan optimizer: the pass pipeline run over a recorded queue at
+flush (docs/SPEC.md §21).
+
+The recorded queue is a LOGICAL plan — ops land in recording order,
+runs split wherever an opaque op or a mesh change interrupts them, and
+every capacity/config decision is whatever the caller or the code
+default guessed.  This module rewrites the queue just before execution:
+
+* **merge** — independent fusible runs over one mesh that were split
+  only by recording order (an opaque op or another mesh's run between
+  them) coalesce into ONE dispatch.  A run moves earlier only past
+  items whose declared footprints are disjoint from every container it
+  touches, and never past the producer of a scalar operand it
+  consumes — so the merged program threads exactly the state the
+  recorded order would have.
+* **dce** — a pure op whose written window is fully overwritten before
+  any read (backward interval-coverage walk, ghost-aware: a full-row
+  killer is needed to retire a full-row victim) is eliminated; a run
+  left empty disappears entirely.
+* **pushdown** — a single-input same-dtype projection whose output
+  container feeds ONLY a relational op and dies afterwards is re-homed
+  into that op's scratch-sort copy (the op becomes a view-chain
+  BoundOp the copy fuses), turning the intermediate materialization
+  into a dead op the dce pass then removes.
+* **capinfer** / **joinroute** — config-level passes consulted at op
+  execution time: relational auto-capacity inference (probe + tuning
+  DB hints, ``algorithms/relational.py``) and measured join-route
+  thresholds (``dr_tpu/tuning.py``).  They register here so one knob
+  family covers the whole pipeline.
+
+Bit-identity contract (§21.3): every rewrite preserves the exact value
+of every observable — container contents (owned cells AND the ghost
+contract), resolved scalars, relational counts — against the
+unoptimized flush.  Merge keeps the per-op seal+barrier discipline
+(cross-op contraction stays pinned inside the merged program exactly
+as across the split programs); dce removes only writes that are
+provably overwritten before any read; pushdown routes the same op
+through the same single cast.  ``DR_TPU_PLAN_OPT=0`` turns the whole
+pipeline off, ``auto`` (the default) runs the rewrite passes that
+never add work, ``all`` adds the probe/rewrite passes; any pass name
+in ``DR_TPU_PLAN_OPT_DISABLE`` (csv) is skipped — the bisection knob
+the fuzz battery sweeps.
+
+Failure posture: an optimizer bug must never take a flush down — any
+pass exception is caught, announced through ``warn_fallback``, and the
+recorded queue executes unoptimized.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import PlanScalar, _FusedOp, _Opaque, _Run
+from .. import obs as _obs
+from ..utils.env import env_str
+
+__all__ = ["optimize", "expand_items", "enabled", "mode", "PASSES",
+           "PASS_NAMES"]
+
+#: passes the default ``auto`` mode leaves OFF: they spend extra work
+#: (probe dispatches, view rewrites) that only pays on relational
+#: pipelines — ``all`` arms them
+_AUTO_OFF = frozenset(("pushdown", "capinfer"))
+
+
+def mode() -> str:
+    """``DR_TPU_PLAN_OPT``: ``0``/``off`` disables every pass, ``all``
+    arms every pass, anything else (default) is ``auto``."""
+    raw = env_str("DR_TPU_PLAN_OPT", "auto").lower()
+    if raw in ("0", "off", "none"):
+        return "0"
+    if raw == "all":
+        return "all"
+    return "auto"
+
+
+def _disabled() -> set:
+    return {s.strip().lower()
+            for s in env_str("DR_TPU_PLAN_OPT_DISABLE").split(",")
+            if s.strip()}
+
+
+def enabled(name: str) -> bool:
+    """Is pass ``name`` armed under the current mode + per-pass
+    opt-outs?  The config-level passes (capinfer, joinroute) call this
+    at op-execution time, so a sweep can flip them per call."""
+    m = mode()
+    if m == "0" or name in _disabled():
+        return False
+    if m == "auto" and name in _AUTO_OFF:
+        return False
+    return True
+
+
+def expand_items(items) -> list:
+    """Optimized queue items back to the RECORDED items they execute
+    (merged/cloned runs carry ``_sources``) — the identity set the
+    undo/replay/faulted-flush contracts are keyed on."""
+    out = []
+    for it in items:
+        src = getattr(it, "_sources", None)
+        if src is None:
+            out.append(it)
+        else:
+            out.extend(expand_items(src))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# footprints
+# ---------------------------------------------------------------------------
+
+def _item_touch(item) -> Optional[set]:
+    """Every container id the item may read OR write; None = unknown
+    (a barrier nothing reorders across)."""
+    if isinstance(item, _Run):
+        return {id(c) for c in item.conts}
+    if item.reads is None or item.writes is None:
+        return None
+    ids = {id(c) for c in item.reads}
+    ids.update(id(c) for c, _full in item.writes)
+    return ids
+
+
+class _Group:
+    """A merge group under construction: runs in record order, merged
+    into one program at materialization."""
+
+    __slots__ = ("runs", "touch")
+
+    def __init__(self, run):
+        self.runs = [run]
+        self.touch = {id(c) for c in run.conts}
+
+    def add(self, run):
+        self.runs.append(run)
+        self.touch.update(id(c) for c in run.conts)
+
+
+class _SubState:
+    """List proxy translating a source run's slot numbering into the
+    merged run's combined state list."""
+
+    __slots__ = ("_s", "_m")
+
+    def __init__(self, state, smap):
+        self._s = state
+        self._m = smap
+
+    def __getitem__(self, i):
+        return self._s[self._m[i]]
+
+    def __setitem__(self, i, v):
+        self._s[self._m[i]] = v
+
+
+def _wrap(o: _FusedOp, smap, soff, wrapped) -> _FusedOp:
+    """Re-slot one source op into the merged run: slots map through
+    ``smap``, same-run scalar refs shift by ``soff`` (the merged souts
+    list concatenates the sources' in order)."""
+    spec2 = tuple(("r", s[1] + soff) if isinstance(s, tuple) else s
+                  for s in o.spec)
+
+    def emit(state, svals, souts, _o=o, _m=smap):
+        _o.emit(_SubState(state, _m), svals, souts)
+
+    w = _FusedOp(o.name, ("mrg", o.key, smap, soff), emit, spec2,
+                 o.vals, pre=o.pre,
+                 reads=tuple(smap[s] for s in o.reads),
+                 writes=tuple((smap[s], off, n, full)
+                              for (s, off, n, full) in o.writes),
+                 pure=o.pure)
+    # the wrapper copied the operand values; the SOURCE op's copy is
+    # dropped once the whole pass has succeeded (deferred — clearing
+    # here would gut the recorded queue the never-take-a-flush-down
+    # fallback re-executes after a later pass failure), so the cached
+    # merged program (whose closure pins the wrapper, which pins the
+    # source op) cannot pin a container-sized splice array
+    wrapped.append(o)
+    return w
+
+
+def _materialize(group: _Group) -> _Run:
+    if len(group.runs) == 1:
+        return group.runs[0]
+    first = group.runs[0]
+    m = _Run(first.mesh, first.axis)
+    m._sources = list(group.runs)
+    m._wrapped = wrapped = []
+    for r in group.runs:
+        smap = tuple(m.slot(c) for c in r.conts)
+        soff = len(m.handles)
+        m.handles.extend(r.handles)
+        identity = soff == 0 and smap == tuple(range(len(r.conts)))
+        for o in r.ops:
+            m.ops.append(o if identity
+                         else _wrap(o, smap, soff, wrapped))
+    return m
+
+
+def _pass_merge(q):
+    """Coalesce independent same-mesh fusible runs (§21.2)."""
+    out: List = []
+    merged = 0
+    for item in q:
+        if not (isinstance(item, _Run) and item.ops):
+            out.append(item)
+            continue
+        touch = {id(c) for c in item.conts}
+        # producers of scalar operands this run fetches at dispatch:
+        # it must execute AFTER them, so it cannot move past one
+        pending = {id(v._run) for o in item.ops for v in o.vals
+                   if isinstance(v, PlanScalar) and v._val is None
+                   and v._run is not None}
+        target = None
+        for j in range(len(out) - 1, -1, -1):
+            prev = out[j]
+            if isinstance(prev, _Group):
+                runs, ptouch = prev.runs, prev.touch
+            elif isinstance(prev, _Run):
+                runs, ptouch = [prev], _item_touch(prev)
+            else:
+                runs, ptouch = None, _item_touch(prev)
+            if runs is not None and runs[0].mesh is item.mesh \
+                    and runs[0].axis == item.axis:
+                if any(id(r) in pending for r in runs):
+                    break  # scalar-dependent on the candidate itself
+                target = j
+                break
+            # a middle item: this run may only move past it when their
+            # footprints are disjoint and no scalar dependency exists
+            if ptouch is None or (touch & ptouch):
+                break
+            if runs is not None and any(id(r) in pending for r in runs):
+                break
+        if target is None:
+            out.append(item)
+            continue
+        prev = out[target]
+        if isinstance(prev, _Group):
+            prev.add(item)
+        else:
+            out[target] = g = _Group(prev)
+            g.add(item)
+        merged += 1
+    final = [(_materialize(x) if isinstance(x, _Group) else x)
+             for x in out]
+    return final, merged
+
+
+# ---------------------------------------------------------------------------
+# dead-op elimination
+# ---------------------------------------------------------------------------
+
+def _cover(cov, c, lo, hi, ghost):
+    ent = cov.get(id(c))
+    if ent is None:
+        ent = cov[id(c)] = [[], False]
+    if ghost:
+        ent[1] = True
+    if hi <= lo:
+        return
+    ivs = ent[0]
+    ivs.append((lo, hi))
+    ivs.sort()
+    out = [ivs[0]]
+    for a, b in ivs[1:]:
+        la, lb = out[-1]
+        if a <= lb:
+            out[-1] = (la, max(lb, b))
+        else:
+            out.append((a, b))
+    ent[0] = out
+
+
+def _is_covered(cov, c, off, n, needs_ghost):
+    if n <= 0:
+        return True  # an empty window writes nothing
+    ent = cov.get(id(c))
+    if ent is None:
+        return False
+    if needs_ghost and not ent[1]:
+        return False
+    for a, b in ent[0]:
+        if a <= off and off + n <= b:
+            return True
+    return False
+
+
+def _clone_run(run: _Run, ops) -> _Run:
+    nr = _Run(run.mesh, run.axis)
+    nr.conts = run.conts          # slot numbering stays valid
+    nr._cont_ids = run._cont_ids
+    nr.handles = run.handles
+    nr.ops = ops
+    nr._sources = [run]
+    return nr
+
+
+def _pass_dce(q):
+    """Backward coverage walk: a pure op whose written windows are all
+    overwritten before any read dies; reads reset coverage; a kept
+    op's write window extends coverage only when the op does not read
+    that container (§21.2 — the mask-preserve argument).  A full-row
+    victim (ghost-zeroing relational outputs) retires only under a
+    full-row killer."""
+    out_rev: List = []
+    removed = 0
+    cov: dict = {}
+    for item in reversed(q):
+        if isinstance(item, _Opaque):
+            if item.reads is None or item.writes is None:
+                cov.clear()
+            else:
+                for c in item.reads:
+                    cov.pop(id(c), None)
+                rid = {id(c) for c in item.reads}
+                for c, full in item.writes:
+                    if full and id(c) not in rid:
+                        _cover(cov, c, 0, len(c), True)
+            out_rev.append(item)
+            continue
+        kept = []
+        changed = False
+        for o in reversed(item.ops):
+            if o.pure and o.writes and not o.pre and all(
+                    _is_covered(cov, item.conts[s], off, n, full)
+                    for (s, off, n, full) in o.writes):
+                removed += 1
+                changed = True
+                continue
+            rid = {id(item.conts[s]) for s in o.reads}
+            for s in o.reads:
+                cov.pop(id(item.conts[s]), None)
+            for (s, off, n, full) in o.writes:
+                c = item.conts[s]
+                if id(c) in rid:
+                    continue
+                if full:
+                    _cover(cov, c, 0, len(c), True)
+                else:
+                    _cover(cov, c, off, off + n, False)
+            kept.append(o)
+        if not changed:
+            out_rev.append(item)
+        elif kept or item.handles:
+            out_rev.append(_clone_run(item, list(reversed(kept))))
+        # else: every op died and no handles — the run disappears
+    return list(reversed(out_rev)), removed
+
+
+# ---------------------------------------------------------------------------
+# projection pushdown into the relational scratch-sort copy
+# ---------------------------------------------------------------------------
+
+def _events(q):
+    """Linearized touch events, execution order: ``(kind, cont_id,
+    item_index, op_or_None, full)`` with ``kind`` in {"r", "w",
+    "barrier"} (barriers carry cont_id None)."""
+    ev = []
+    for qi, item in enumerate(q):
+        if isinstance(item, _Opaque):
+            if item.reads is None or item.writes is None:
+                ev.append(("barrier", None, qi, None, False))
+                continue
+            for c in item.reads:
+                ev.append(("r", id(c), qi, None, False))
+            for c, full in item.writes:
+                ev.append(("w", id(c), qi, None, full))
+            continue
+        for o in item.ops:
+            for s in o.reads:
+                ev.append(("r", id(item.conts[s]), qi, o, False))
+            for (s, off, n, full) in o.writes:
+                ev.append(("w", id(item.conts[s]), qi, o, full))
+    return ev
+
+
+def _pushdown_one(q, item, name, chain):
+    """Try to push the producer of input channel ``name`` (a plain
+    whole/sub-range over ``cont``) into the relational scratch copy.
+    Returns True when the rewrite landed."""
+    from ..views import views as _v
+    cont, off, n, plain = chain
+    if not plain or n <= 0:
+        return False
+    ev = _events(q)
+    qi = q.index(item)
+    own = [i for i, e in enumerate(ev) if e[2] == qi]
+    if not own:
+        return False
+    e0, e1 = min(own), max(own) + 1
+    # --- backward: the LAST touch of cont before the opaque must be a
+    # pushable transform covering the read window
+    T = None
+    t_pos = None
+    for i in range(e0 - 1, -1, -1):
+        kind, cid, _qj, o, _full = ev[i]
+        if kind == "barrier":
+            return False
+        if cid != id(cont):
+            continue
+        if kind == "w" and o is not None and o.push is not None:
+            a, t_off, t_n, _op, _sc = o.push
+            if t_off <= off and t_off + t_n >= off + n \
+                    and a is not cont:
+                T, t_pos = o, i
+        break
+    if T is None:
+        return False
+    a, t_off, t_n, op, scalars = T.push
+    # --- the transform's input must be write-free between T and the
+    # opaque (its value at the opaque's flush position must equal its
+    # value where T would have run), and nothing else may touch the
+    # intermediate in between
+    for i in range(t_pos + 1, e0):
+        kind, cid, _qj, _o, _full = ev[i]
+        if kind == "barrier":
+            return False
+        if cid == id(a) and kind == "w":
+            return False
+        if cid == id(cont):
+            return False
+    # --- forward deadness: cont must be fully overwritten (no read
+    # first) after the opaque, else eliminating T would be observable
+    dead = False
+    for i in range(e1, len(ev)):
+        kind, cid, _qj, _o, full = ev[i]
+        if kind == "barrier":
+            return False
+        if cid != id(cont):
+            continue
+        if kind == "w" and full:
+            dead = True
+            break
+        return False
+    if not dead:
+        return False  # never overwritten: observable at flush end
+    # --- rewrite: the relational input becomes a view chain over the
+    # transform's input; the scratch copy fuses the op (one cast on
+    # both paths — bit-identical, §21.4)
+    base = a if (off == 0 and n == len(a)) \
+        else _v.subrange(a, off, off + n)
+    item.meta["inputs"][name] = _v.transform(base, op, *scalars)
+    item.meta["chains"][name] = (a, off, n, False)
+    reads = []
+    for _cname, ch in item.meta["chains"].items():
+        if ch[0] not in reads:
+            reads.append(ch[0])
+    item.reads = tuple(reads)
+    return True
+
+
+def _pass_pushdown(q):
+    pushes = 0
+    for item in q:
+        if not (isinstance(item, _Opaque) and isinstance(item.meta,
+                                                        dict)):
+            continue
+        chains = item.meta.get("chains")
+        if not chains:
+            continue
+        for name in list(chains):
+            if _pushdown_one(q, item, name, chains[name]):
+                pushes += 1
+    return q, pushes
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+#: the §21 pass registry (drlint rule R7 checks it against the SPEC
+#: table and the bit-identity fuzz arm): queue-rewrite passes carry
+#: their implementation; config-level passes (consulted at op
+#: execution through :func:`enabled`) register with None
+PASSES = (
+    ("pushdown", _pass_pushdown),
+    ("dce", _pass_dce),
+    ("merge", _pass_merge),
+    ("capinfer", None),
+    ("joinroute", None),
+)
+
+PASS_NAMES = tuple(n for n, _fn in PASSES)
+
+
+def optimize(plan, queue, entry, parent=0):
+    """Run the armed passes over ``queue``; returns the queue to
+    execute.  Records the per-flush optimizer note in ``entry`` and an
+    obs span under the flush (§21.5).  Never raises — a failed pass
+    falls back to the recorded queue, announced."""
+    if not queue or mode() == "0":
+        return queue
+    note = {"passes": [], "merged_runs": 0, "dce_ops": 0,
+            "pushdowns": 0}
+    q = list(queue)
+    t0 = _obs.now()
+    try:
+        for pname, fn in PASSES:
+            if fn is None or not enabled(pname):
+                continue
+            tp = _obs.now()
+            q, nhits = fn(q)
+            # per-pass span under the flush (§21.5): a traced run
+            # shows where optimization time went, pass by pass
+            _obs.complete(f"plan.opt.{pname}", tp, cat="plan",
+                          parent=parent, hits=nhits)
+            note["passes"].append(pname)
+            if pname == "merge":
+                note["merged_runs"] = nhits
+            elif pname == "dce":
+                note["dce_ops"] = nhits
+            elif pname == "pushdown":
+                note["pushdowns"] = nhits
+        # the WHOLE pipeline succeeded: the wrapped source ops'
+        # operand copies can drop now (deferred to here so a failed
+        # pass — even one after merge — falls back to a recorded
+        # queue whose ops still carry their operands), and the
+        # cached merged programs (whose closures pin the wrappers,
+        # which pin the sources) cannot pin container-sized arrays
+        for item in q:
+            for o in getattr(item, "_wrapped", ()):
+                o.vals = []
+    except Exception as e:  # pragma: no cover - defensive
+        from ..utils.fallback import warn_fallback
+        warn_fallback("plan", f"optimizer pass failed ({e!r}); "
+                              "flushing the recorded queue unoptimized")
+        note["error"] = repr(e)[:120]
+        q = list(queue)
+    for pname in ("capinfer", "joinroute"):
+        if enabled(pname):
+            note["passes"].append(pname)
+    if note["passes"] or note.get("error"):
+        entry["opt"] = note
+    _obs.complete("plan.opt", t0, cat="plan", parent=parent,
+                  passes="+".join(note["passes"]),
+                  merged_runs=note["merged_runs"],
+                  dce_ops=note["dce_ops"],
+                  pushdowns=note["pushdowns"])
+    if _obs.armed():
+        _obs.count("plan.opt.merged_runs", note["merged_runs"])
+        _obs.count("plan.opt.dce_ops", note["dce_ops"])
+        _obs.count("plan.opt.pushdowns", note["pushdowns"])
+    return q

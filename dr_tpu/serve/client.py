@@ -140,6 +140,22 @@ class Client:
         self._arena: Optional[_arena.ClientArena] = None
         self._arena_state = "unknown"  # unknown | on | off
         self._pending_release: list = []
+        # slot-lease cache (docs/SPEC.md §19.1): granted request
+        # leases are KEPT across requests (the ``keep`` wire marker)
+        # and reused for same-shape payloads — the per-request
+        # ``arena_alloc`` round trip disappears on steady traffic.
+        # Keyed by the lease's aligned byte capacity; bounded by
+        # DR_TPU_SERVE_LEASE_CACHE slots (0 disables), excess leases
+        # release by piggyback.  The cache drops whenever the
+        # connection does (the daemon's disconnect teardown frees the
+        # owner's slots, so a held handle's generation may bump) and
+        # on any ``arena.map``-classified reply (a stale-generation
+        # handle must never be offered twice).
+        self._lease_cap = env_int("DR_TPU_SERVE_LEASE_CACHE", 8,
+                                  floor=0)
+        self._lease_cache: dict = {}
+        self.lease_hits = 0
+        self.lease_misses = 0
         self._connect()
         if arena:  # explicit opt-in attaches eagerly (big REPLIES
             # can ride the arena even when no request payload does)
@@ -154,6 +170,10 @@ class Client:
         # daemon's disconnect teardown — releasing them on a fresh
         # connection would double-free a recycled slot
         self._pending_release = []
+        # held request leases died with the old connection too (owner
+        # teardown freed them; the slot ids may already be re-leased
+        # at a bumped generation)
+        self._lease_cache = {}
         # re-arm arena discovery: a reconnect after an invalidation
         # (whose close() detached the segment) must not leave a
         # long-lived retrying client on the inline wire forever
@@ -184,6 +204,8 @@ class Client:
         self.close()
 
     def close(self) -> None:
+        # held leases die with the connection (owner teardown)
+        self._lease_cache = {}
         if self._arena is not None:
             self._arena.close()
             self._arena = None
@@ -256,40 +278,94 @@ class Client:
             _arena.note_fallback(f"client attach failed ({e!r}); "
                                  "inline wire")
 
+    def _lease_size(self, nbytes: int) -> int:
+        """The aligned capacity a lease of ``nbytes`` rounds up to —
+        the cache key (same-shape payloads land on the same size)."""
+        return max(_arena.ALIGN,
+                   (int(nbytes) + _arena.ALIGN - 1)
+                   // _arena.ALIGN * _arena.ALIGN)
+
+    def _cache_lease(self, handle: dict) -> None:
+        """Return a still-held lease to the cache, or queue its
+        release by piggyback when the cache is full."""
+        if self._lease_cap > 0 and sum(
+                len(v) for v in self._lease_cache.values()) \
+                < self._lease_cap:
+            self._lease_cache.setdefault(int(handle["nbytes"]),
+                                         []).append(handle)
+        else:
+            self._pending_release.append(
+                {"slot": handle["slot"],
+                 "generation": handle["generation"]})
+
+    def _drop_lease_cache(self) -> None:
+        """Invalidate every held lease COLD — no releases queued (a
+        stale release would poison the next request's piggyback);
+        the daemon's disconnect teardown reaps the slots.  Queued
+        reply releases drop too: a generation bump that invalidated a
+        held lease may equally have invalidated an owed reply slot,
+        and one stale handle in the piggyback fails the whole next
+        request."""
+        self._lease_cache = {}
+        self._pending_release = []
+
     def _stage_arena(self, op, arrays):
         """Split a request's payloads between the arena and the inline
         wire: big payloads lease slots (one small ``arena_alloc``
         round trip), write their npy bytes ONCE into shared memory,
         and ride the header as handles; everything else stays inline.
-        Any arena failure (exhaustion transient, overload) falls back
-        to fully-inline for THIS request."""
+        A cached lease of the right capacity skips the alloc round
+        trip entirely (the ``keep`` discipline above).  Any arena
+        failure (exhaustion transient, overload) falls back to
+        fully-inline for THIS request.  Returns ``(inline_arrays,
+        entries, held)`` — ``held`` are the leases to re-cache once
+        the exchange settles."""
         if (op in _CONTROL_OPS or not self._arena_want
                 or not arrays):
-            return arrays, None
+            return arrays, None, []
         sizes = [np.asarray(a).nbytes for a in arrays]
         big = [i for i, nb in enumerate(sizes)
                if nb >= self._arena_min]
         if not big:
-            return arrays, None
+            return arrays, None, []
         self._ensure_arena()
         if self._arena is None:
-            return arrays, None
+            return arrays, None, []
         payloads = {i: _arena.npy_bytes(arrays[i]) for i in big}
-        try:
-            slots = self._request_once(
-                "arena_alloc",
-                params={"nbytes": [len(payloads[i]) for i in big]}
-            )["slots"]
-        except (resilience.TransientBackendError,
-                resilience.ServerOverloaded) as e:
-            _arena.note_fallback(f"lease failed ({type(e).__name__}); "
-                                 "inline wire for this request")
-            return arrays, None
+        handles = {}
+        for i in big:
+            pool = self._lease_cache.get(
+                self._lease_size(len(payloads[i])))
+            if pool:
+                handles[i] = pool.pop()
+                self.lease_hits += 1
+        missing = [i for i in big if i not in handles]
+        if missing:
+            self.lease_misses += len(missing)
+            try:
+                slots = self._request_once(
+                    "arena_alloc",
+                    params={"nbytes": [len(payloads[i])
+                                       for i in missing]})["slots"]
+            except (resilience.TransientBackendError,
+                    resilience.ServerOverloaded) as e:
+                _arena.note_fallback(
+                    f"lease failed ({type(e).__name__}); "
+                    "inline wire for this request")
+                for h in handles.values():  # reused leases survive
+                    self._cache_lease(h)
+                return arrays, None, []
+            handles.update(zip(missing, slots))
         entries = [None] * len(arrays)
-        for i, handle in zip(big, slots):
-            entries[i] = self._arena.write(handle, payloads[i])
+        keep = self._lease_cap > 0
+        for i in big:
+            entries[i] = self._arena.write(handles[i], payloads[i])
+            if keep:
+                entries[i]["keep"] = True
         inline = [a for i, a in enumerate(arrays) if i not in set(big)]
-        return inline, entries
+        # cache disabled: the daemon releases at intake (no keep), so
+        # nothing is held past this request
+        return inline, entries, list(handles.values()) if keep else []
 
     def _read_reply_arena(self, reply, rarrays):
         """Merge a reply's inline payloads with its arena results; the
@@ -314,19 +390,23 @@ class Client:
         return merged
 
     def _request_once(self, op, arrays=(), params=None, *,
-                      deadline_s=None, tenant=None):
+                      deadline_s=None, tenant=None, _stage=True):
         if self._broken:
             raise resilience.TransientBackendError(
                 f"serve: connection invalidated ({self._broken}); "
                 "reconnect to resubmit", site="serve.request")
         header = {"op": op, "params": params or {},
                   "tenant": tenant or self.tenant}
-        arrays = list(arrays)
+        orig = list(arrays)
+        arrays = list(orig)
         if any(isinstance(a, Ref) for a in arrays):
             header["refs"] = [a.name if isinstance(a, Ref) else None
                               for a in arrays]
             arrays = [a for a in arrays if not isinstance(a, Ref)]
-        arrays, entries = self._stage_arena(op, arrays)
+        if _stage:
+            arrays, entries, held = self._stage_arena(op, arrays)
+        else:
+            entries, held = None, []
         if entries is not None:
             header["arena"] = entries
         if self._arena is not None and op not in _CONTROL_OPS:
@@ -339,6 +419,34 @@ class Client:
         header["id"] = rid
         if deadline_s is not None:
             header["deadline_s"] = deadline_s
+        try:
+            return self._exchange(op, header, arrays, rid, held)
+        except resilience.TransientBackendError as e:
+            # a daemon-side transient AT MAP INTAKE (a cached lease
+            # skipped the alloc round trip, so the fault lands there
+            # now): the §19.1 contract — the arena is never a
+            # correctness dependency — resends THIS request fully
+            # inline; the held leases stay valid (keep discipline,
+            # nothing released) and re-cache in the finally below
+            if (entries is not None and _stage and not self._broken
+                    and getattr(e, "site", "") == "arena.map"):
+                _arena.note_fallback(
+                    "daemon-side map transient; inline wire for "
+                    "this request")
+                return self._request_once(op, orig, params,
+                                          deadline_s=deadline_s,
+                                          tenant=tenant, _stage=False)
+            raise
+        finally:
+            # the exchange settled (reply, error, or invalidation):
+            # still-held leases go back to the cache while the
+            # connection stands; a broken connection's leases died
+            # with it (owner teardown) and drop cold
+            if held and not self._broken and self._sock is not None:
+                for h in held:
+                    self._cache_lease(h)
+
+    def _exchange(self, op, header, arrays, rid, held):
         try:
             protocol.send_frame(self._sock, header, arrays)
             reply, rarrays = protocol.recv_frame(self._sock)
@@ -376,7 +484,18 @@ class Client:
                 "serve: reply stream desynchronized (stale reply id) — "
                 "open a fresh Client", site="serve.request")
         if not reply.get("ok", False):
-            protocol.raise_error(reply)
+            try:
+                protocol.raise_error(reply)
+            except resilience.ProgramError as e:
+                if held and getattr(e, "site", "") == "arena.map":
+                    # generation-bump defense: a stale-handle map is
+                    # the ONE way a held lease can be wrong — drop
+                    # every cached lease cold (no releases: a stale
+                    # release would poison the next request) and let
+                    # the disconnect teardown reap the slots
+                    self._drop_lease_cache()
+                    held.clear()
+                raise
         rarrays = self._read_reply_arena(reply, rarrays)
         if "scalar" in reply:
             return float(reply["scalar"])

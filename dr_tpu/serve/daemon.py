@@ -1021,7 +1021,11 @@ class Server:
         arena handles (``header["arena"]`` — mapped, then released:
         the bytes are copied out at intake), and resident references
         (``header["refs"]`` — resolved to stubs carrying the cached
-        container, so the handler skips the rebuild)."""
+        container, so the handler skips the rebuild).  An entry tagged
+        ``keep`` is mapped but NOT released — the client holds the
+        lease across requests (the §19.1 slot-lease cache; safe
+        because ``map`` copies the bytes out before the reply, and
+        the disconnect teardown still frees the slot wholesale)."""
         entries = header.get("arena")
         if entries is not None:
             ar = self._arena_required()
@@ -1032,7 +1036,8 @@ class Server:
                     wire.append(next(it, None))
                 else:
                     wire.append(ar.map(e))
-                    ar.release(e)
+                    if not e.get("keep"):
+                        ar.release(e)
             if any(w is None for w in wire):
                 raise resilience.ProgramError(
                     "serve: frame carries fewer inline payloads than "

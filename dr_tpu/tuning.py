@@ -1,0 +1,224 @@
+"""Persisted tuning database: measured config winners as DATA
+(docs/SPEC.md §21.6).
+
+Every per-op config in the package — stencil chunk caps, scan chunk
+rows, SpMV formats, the join merge-route threshold, relational
+capacity ratios — has a code default that was tuned on SOME machine
+at SOME point.  Mapple (arXiv:2507.17087) and Mesh-TensorFlow
+(arXiv:1811.02084) both argue those mapping decisions should be
+declarative data, not code: this module is that store.
+``tools/tune_tpu.py`` writes measured winners in (:func:`record`),
+dispatch-time pickers read them out (:func:`lookup`) with the code
+default as fallback — so the queued silicon ladders (ROADMAP item 7)
+become DB entries the moment the relay returns, with zero code edits.
+
+Keying is canon-portable like the compile cache: an entry is
+``domain.param@backend=<cpu|tpu|...>,nshards=<p>,x64=<0|1>`` — the
+mesh shape/backend CONTEXT is part of the key, so a CPU-mesh sweep
+can never poison the TPU entry for the same knob (and vice versa).
+Lookups match the CURRENT context exactly; no context = no entry =
+code default.
+
+Storage is ONE json file beside the compile cache:
+``DR_TPU_TUNING_DB`` names it directly, else it lives at
+``$DR_TPU_COMPILE_CACHE_DIR/tuning_db.json``; with neither set the
+persisted layer is off (lookups fall through to the in-process
+session overlay, then the default).  Writes are atomic
+read-modify-write (tmp + rename) with last-writer-wins per key; a
+missing or corrupt file degrades to code defaults with ONE
+``warn_fallback`` — a broken DB must never take a dispatch down.
+
+Two layers answer a lookup, freshest first:
+
+1. **session overlay** (:func:`note`) — in-process observations
+   (e.g. the §21.4 capinfer pass noting a measured rows/input ratio
+   so the next auto op skips its probe); never persisted.
+2. **persisted entries** — what ``tune_tpu.py`` recorded.
+
+Precedence at the integration sites is uniform: an explicit env pin
+(``DR_TPU_*``) beats the DB, the DB beats the code default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .utils.env import env_str
+from .utils.fallback import warn_fallback
+
+__all__ = ["lookup", "record", "note", "context", "context_key",
+           "db_path", "enabled", "reload", "clear_session"]
+
+_lock = threading.Lock()
+_cache: Optional[dict] = None
+_cache_path: Optional[str] = None
+_cache_mtime: float = -1.0
+_warned_paths: set = set()
+_session: dict = {}
+
+
+def db_path() -> str:
+    """The persisted DB file, or "" when no store is armed."""
+    p = env_str("DR_TPU_TUNING_DB")
+    if p:
+        return p
+    cache = env_str("DR_TPU_COMPILE_CACHE_DIR")
+    if cache:
+        return os.path.join(cache, "tuning_db.json")
+    return ""
+
+
+def enabled() -> bool:
+    """True when a persisted store is armed (lookups/records hit
+    disk); the session overlay works either way."""
+    return bool(db_path())
+
+
+def context() -> dict:
+    """Canon-portable tag of the current mesh/backend: ``backend``
+    (device platform), ``nshards`` (mesh width), ``x64``.  NEVER
+    initializes the runtime (a lookup must not claim devices): before
+    ``dr_tpu.init()`` the context is the unmatched ``backend="none"``
+    — entries only land/apply on a live mesh."""
+    try:
+        from .parallel import runtime as _rt
+        if not _rt.is_initialized():
+            return {"backend": "none", "nshards": 0, "x64": False}
+        import jax
+        r = _rt.runtime()
+        devs = list(r.mesh.devices.reshape(-1))
+        return {"backend": str(devs[0].platform),
+                "nshards": len(devs),
+                "x64": bool(jax.config.jax_enable_x64)}
+    except Exception:  # pragma: no cover - defensive
+        return {"backend": "none", "nshards": 0, "x64": False}
+
+
+def context_key(domain: str, param: str, ctx: Optional[dict] = None) \
+        -> str:
+    c = context() if ctx is None else ctx
+    return (f"{domain}.{param}@backend={c.get('backend', 'none')},"
+            f"nshards={int(c.get('nshards', 0))},"
+            f"x64={int(bool(c.get('x64', False)))}")
+
+
+def _load() -> dict:
+    """The persisted entries (mtime-checked reload so a sweep's write
+    in another process is visible without a restart).  Tolerant: any
+    read failure warns ONCE per path and applies code defaults."""
+    global _cache, _cache_path, _cache_mtime
+    path = db_path()
+    with _lock:
+        try:
+            mtime = os.path.getmtime(path) if path else -1.0
+        except OSError:
+            mtime = -1.0
+        if _cache is not None and _cache_path == path \
+                and _cache_mtime == mtime:
+            return _cache
+        _cache_path, _cache_mtime = path, mtime
+        _cache = {}
+        if not path or mtime < 0:
+            return _cache
+        try:
+            with open(path, encoding="utf-8") as fh:
+                raw = json.load(fh)
+            ent = raw.get("entries") if isinstance(raw, dict) else None
+            if not isinstance(ent, dict):
+                raise ValueError("no 'entries' table")
+            _cache = ent
+        except Exception as e:
+            if path not in _warned_paths:
+                _warned_paths.add(path)
+                warn_fallback(
+                    "tuning", f"tuning DB at {path!r} is unreadable "
+                              f"({e!r}); code defaults apply")
+            _cache = {}
+        return _cache
+
+
+def reload() -> None:
+    """Drop the read cache (tests; long-lived daemons after a sweep)."""
+    global _cache
+    with _lock:
+        _cache = None
+
+
+def clear_session() -> None:
+    """Drop the in-process overlay (between-test hygiene)."""
+    _session.clear()
+
+
+def lookup(domain: str, param: str, default=None,
+           ctx: Optional[dict] = None):
+    """The measured value for ``domain.param`` under the current (or
+    given) context, or ``default``.  Session overlay first (fresher),
+    then the persisted store; context mismatch = default."""
+    key = context_key(domain, param, ctx)
+    if key in _session:
+        return _session[key]
+    ent = _load().get(key)
+    if isinstance(ent, dict):
+        return ent.get("value", default)
+    return default if ent is None else ent
+
+
+def note(domain: str, param: str, value,
+         ctx: Optional[dict] = None) -> str:
+    """Record an in-process observation (session overlay only — the
+    capinfer ratio path).  Returns the key."""
+    key = context_key(domain, param, ctx)
+    _session[key] = value
+    return key
+
+
+def record(domain: str, param: str, value,
+           ctx: Optional[dict] = None, source: str = "") -> Optional[str]:
+    """Persist a measured winner (the ``tune_tpu.py`` write path):
+    atomic read-modify-write, last-writer-wins per key, the context
+    tag baked into the key (a CPU sweep cannot poison a TPU row).
+    With no store armed the value still lands in the session overlay.
+    Returns the key written (None = overlay only)."""
+    c = context() if ctx is None else ctx
+    key = context_key(domain, param, c)
+    _session[key] = value
+    path = db_path()
+    if not path:
+        return None
+    with _lock:
+        try:
+            raw = {}
+            if os.path.exists(path):
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        raw = json.load(fh)
+                except Exception:
+                    raw = {}  # corrupt store: rebuilt from here on
+            ent = raw.get("entries") if isinstance(raw, dict) else None
+            if not isinstance(ent, dict):
+                ent = {}
+            ent[key] = {"value": value, "domain": domain,
+                        "param": param, "context": dict(c),
+                        "source": source,
+                        "recorded_at": round(time.time(), 3)}
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"version": 1, "entries": ent}, fh,
+                          indent=1, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, path)
+            global _cache
+            _cache = None
+        except OSError as e:
+            warn_fallback(
+                "tuning", f"tuning DB write to {path!r} failed "
+                          f"({e!r}); winner kept in-process only")
+            return None
+    return key

@@ -115,8 +115,17 @@ def _clear_tuning_knobs(monkeypatch):
                 "DR_TPU_FLASH_BQ", "DR_TPU_FLASH_BK",
                 "DR_TPU_FLASH_STREAM", "DR_TPU_MM_PRECISION",
                 "DR_TPU_GATHER_W", "DR_TPU_DOT_IMPL",
-                "DR_TPU_SORT_STABLE"):
+                "DR_TPU_SORT_STABLE",
+                "DR_TPU_PLAN_OPT", "DR_TPU_PLAN_OPT_DISABLE",
+                "DR_TPU_TUNING_DB"):
         monkeypatch.delenv(var, raising=False)
+    yield
+    # the persisted tuning DB's in-process overlay (a noted capacity
+    # ratio, a recorded sweep winner) must not shift the NEXT test's
+    # picked configs — same hygiene as the env knobs above
+    from dr_tpu import tuning
+    tuning.clear_session()
+    tuning.reload()
 
 
 @pytest.fixture(params=[1, 2, 3, 4, 8])

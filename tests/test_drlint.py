@@ -376,3 +376,53 @@ print("SANITIZED-OK")
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "SANITIZED-OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# R7: plan-optimizer pass registry drift (ISSUE 15, docs/SPEC.md §21.2)
+# ---------------------------------------------------------------------------
+
+def test_r7_plan_opt_registry_drift(tmp_path, monkeypatch):
+    """Both drift directions fire: a registered pass without a §21.2
+    table row, and a table row naming no registered pass; a fuzz file
+    that neither sweeps PASS_NAMES nor names every pass fires too."""
+    opt = tmp_path / "opt.py"
+    opt.write_text('PASSES = (("merge", None), ("mystery", None))\n',
+                   encoding="utf-8")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "SPEC.md").write_text(
+        "### 21.2 The pass registry\n"
+        "| pass | kind | semantics |\n"
+        "| `merge` | rewrite | coalesce |\n"
+        "| `stale` | rewrite | gone |\n"
+        "## 22. next\n", encoding="utf-8")
+    fuzz = tmp_path / "fuzz.py"
+    fuzz.write_text("def test_fuzz_plan_opt():\n    pass  # merge\n",
+                    encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    files = [drlint.FileInfo(str(opt), "dr_tpu/plan/opt.py"),
+             drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
+    lin = drlint.Linter(files, {"R7", "R0"}, full_scan=True)
+    msgs = [f.msg for f in lin.run() if f.rule == "R7"]
+    text = " ".join(msgs)
+    assert "'mystery'" in text          # registered, undocumented
+    assert "'stale'" in text            # documented, unregistered
+    assert "PASS_NAMES" in text         # fuzz arm misses 'mystery'
+
+
+def test_r7_silent_when_registry_and_docs_agree(tmp_path, monkeypatch):
+    opt = tmp_path / "opt.py"
+    opt.write_text('PASSES = (("merge", None),)\n', encoding="utf-8")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "SPEC.md").write_text(
+        "### 21.2 The pass registry\n| `merge` | rewrite | x |\n",
+        encoding="utf-8")
+    fuzz = tmp_path / "fuzz.py"
+    fuzz.write_text(
+        "from dr_tpu.plan.opt import PASS_NAMES\n"
+        "def test_fuzz_plan_opt():\n    pass\n", encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    files = [drlint.FileInfo(str(opt), "dr_tpu/plan/opt.py"),
+             drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
+    lin = drlint.Linter(files, {"R7", "R0"}, full_scan=True)
+    assert [f for f in lin.run() if f.rule == "R7"] == []

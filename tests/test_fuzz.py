@@ -17,11 +17,12 @@ import dr_tpu
 from dr_tpu import views
 from dr_tpu.utils.env import env_int, env_override, env_raw
 
-# CI default trimmed 40 -> 28 in round 8: the tier-1 suite had grown
-# to the edge of its 870 s budget on the throttled container, and the
-# fuzz arms are the compile-heaviest block.  Depth soaks stay with the
-# crank (tools/fuzz_crank.sh runs every arm at 300 in its own process).
-ITERS = env_int("DR_TPU_FUZZ_ITERS", 28, floor=0)  # 0 = skip the arms
+# CI default trimmed 40 -> 28 in round 8, 28 -> 24 in round 19: the
+# tier-1 suite keeps growing to the edge of its 870 s budget on the
+# throttled container, and the fuzz arms are the compile-heaviest
+# block.  Depth soaks stay with the crank (tools/fuzz_crank.sh runs
+# every arm at 300 in its own process).
+ITERS = env_int("DR_TPU_FUZZ_ITERS", 24, floor=0)  # 0 = skip the arms
 
 
 def _mk(rng, n):
@@ -1741,3 +1742,197 @@ def test_fuzz_elastic_kill_and_revive(seed, tmp_path):
         w = dr_tpu.distributed_vector.from_array(
             np.ones(2 * dr_tpu.nprocs(), np.float32))
         assert abs(float(dr_tpu.reduce(w)) - len(w)) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# plan-optimizer bit-identity fuzz (round 19 — ISSUE 15, docs/SPEC.md §21)
+# ---------------------------------------------------------------------------
+
+def _po_scale(x, c):
+    return x * c
+
+
+def _po_shift(x, c):
+    return x + c
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_plan_opt(seed, tmp_path):
+    """Round-19 plan-optimizer arm (tools/fuzz_crank.sh): seeded
+    random recorded chains — fusible transforms / fills / reduce /
+    dot / histogram / top_k / redistribute / the opaque scan / the
+    relational auto ops (join_auto, groupby_auto, unique_auto) — each
+    flushed TWICE on fresh containers, ``DR_TPU_PLAN_OPT=all`` vs
+    ``=0``, and compared BIT-for-bit: every container, every resolved
+    scalar, every relational count and trimmed row set.  The §21
+    contract under test is bit-identity-by-construction for EVERY
+    pass (merge / dce / pushdown / capinfer / joinroute), so any
+    difference is an optimizer bug, not tolerance noise.  A slice of
+    iterations additionally injects a mid-flush device loss under
+    ``DR_TPU_ELASTIC=1`` on the optimized arm: the shrink-and-rescue
+    replay must land the values the unoptimized no-fault arm produced
+    — exactly for integer channels, at the elastic suite's tolerance
+    for float ones (a shrink changes the MESH WIDTH, so psum trees
+    and scan carries regroup; cross-width FP identity is impossible
+    and §21.3 scopes bit-identity to a fixed mesh).  The crank
+    re-runs this arm under ``DR_TPU_SANITIZE=1``
+    and with per-pass ``DR_TPU_PLAN_OPT_DISABLE`` bisection (the
+    PLAN-OPT arm; drlint R7 keys the pass registry on it)."""
+    import jax
+
+    from dr_tpu import faults, tuning
+    from dr_tpu.plan import opt as plan_opt
+
+    rng = np.random.default_rng(1900 + seed)
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else ITERS // 2
+    # per-pass bisection: most passes armed, one randomly disabled per
+    # iteration sometimes — every registered pass name cycles through
+    pass_names = plan_opt.PASS_NAMES
+    for it in range(max(4, iters // 6)):
+        P = min(int(rng.integers(1, 9)), len(jax.devices()))
+        dr_tpu.init(jax.devices()[:P])
+        n = int(rng.integers(8, 65))
+        nk = int(rng.integers(4, 49))
+        srcs = {
+            "a": rng.standard_normal(n).astype(np.float32),
+            "b": rng.standard_normal(n).astype(np.float32),
+            "k": rng.integers(0, max(2, nk // 3),
+                              nk).astype(np.float32),
+            "v": rng.standard_normal(nk).astype(np.float32),
+        }
+        kinds = ["fill", "subfill", "xform", "foreach", "reduce",
+                 "dot", "scan", "hist", "topk", "join", "groupby",
+                 "uniq"]
+        if P > 1:
+            kinds.append("rdx")
+        ops = []
+        for _ in range(int(rng.integers(3, 8))):
+            ops.append((str(rng.choice(kinds)),
+                        float(np.round(rng.standard_normal(), 3)),
+                        int(rng.integers(0, n + 1)),
+                        int(rng.integers(0, n + 1))))
+        disable = str(rng.choice(pass_names)) \
+            if rng.integers(0, 3) == 0 else None
+        shrink = bool(P > 1 and rng.integers(0, 5) == 0)
+        tag = f"seed={seed} it={it} P={P} n={n} nk={nk} " \
+              f"disable={disable} shrink={shrink} ops={ops}"
+
+        def rand_dist():
+            cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+            bounds = np.concatenate(([0], cuts, [n]))
+            return tuple(int(y - x)
+                         for x, y in zip(bounds[:-1], bounds[1:]))
+
+        dists = [rand_dist() if P > 1 else None for _ in range(4)]
+
+        def run(mode, inject):
+            """One full chain under DR_TPU_PLAN_OPT=mode on fresh
+            containers; returns (container arrays, scalar floats,
+            relational results)."""
+            tuning.clear_session()
+            conts = {nm: dr_tpu.distributed_vector.from_array(s)
+                     for nm, s in srcs.items()}
+            hb = dr_tpu.distributed_vector(8, np.int32)
+            kk = min(5, nk)
+            tv = dr_tpu.distributed_vector(kk, np.float32)
+            ti = dr_tpu.distributed_vector(kk, np.int32)
+            scal, autos, di = [], [], 0
+            with env_override(DR_TPU_PLAN_OPT=mode,
+                              DR_TPU_PLAN_OPT_DISABLE=disable,
+                              DR_TPU_ELASTIC="1" if inject else None):
+                if inject:
+                    # the §16 fate matrix: data on the lost rank only
+                    # RESTORES from a checkpoint — the arm audits the
+                    # optimizer's replay, not the rescue matrix
+                    every = dict(conts, hb=hb, tv=tv, ti=ti)
+                    for nm, v in every.items():
+                        dr_tpu.checkpoint.save(
+                            str(tmp_path / f"po_{it}_{nm}.npz"), v)
+                with dr_tpu.deferred():
+                    if inject:
+                        faults.inject("device.lost", "device_lost",
+                                      times=1)
+                    for kind, c, i0, i1 in ops:
+                        a, b = conts["a"], conts["b"]
+                        if kind == "fill":
+                            dr_tpu.fill(a, c)
+                        elif kind == "subfill":
+                            lo, hi = min(i0, i1), max(i0, i1)
+                            dr_tpu.fill(b[lo:hi], c)
+                        elif kind == "xform":
+                            dr_tpu.transform(a, b, _po_shift, c)
+                        elif kind == "foreach":
+                            dr_tpu.for_each(a, _po_scale, c)
+                        elif kind == "reduce":
+                            scal.append(dr_tpu.reduce(b))
+                        elif kind == "dot":
+                            scal.append(dr_tpu.dot(a, b))
+                        elif kind == "scan":
+                            dr_tpu.inclusive_scan(a, b)
+                        elif kind == "hist":
+                            dr_tpu.histogram(a, hb, -4.0, 4.0)
+                        elif kind == "topk":
+                            dr_tpu.top_k(a, tv, ti)
+                        elif kind == "rdx":
+                            # an explicit-sizes dist cannot replay
+                            # onto a shrunken mesh (SPEC §18.3): the
+                            # shrink arm re-targets the default layout
+                            dr_tpu.redistribute(
+                                conts["a"], None if inject
+                                else dists[di % len(dists)])
+                            di += 1
+                        elif kind == "join":
+                            autos.append(dr_tpu.join_auto(
+                                conts["k"], conts["v"], conts["k"],
+                                conts["v"]))
+                        elif kind == "groupby":
+                            autos.append(dr_tpu.groupby_auto(
+                                conts["k"], conts["v"], agg="sum"))
+                        else:  # uniq
+                            autos.append(
+                                dr_tpu.unique_auto(conts["k"]))
+                out_c = {nm: dr_tpu.to_numpy(v)
+                         for nm, v in conts.items()}
+                out_c["hb"] = dr_tpu.to_numpy(hb)
+                out_c["tv"] = dr_tpu.to_numpy(tv)
+                out_c["ti"] = dr_tpu.to_numpy(ti)
+                out_s = [float(s) for s in scal]
+                out_r = [(r.count, [np.asarray(x)
+                                    for x in r.arrays()])
+                         for r in autos]
+            return out_c, out_s, out_r
+
+        try:
+            base_c, base_s, base_r = run("0", inject=False)
+            got_c, got_s, got_r = run("all", inject=shrink)
+        finally:
+            faults.clear()
+        if shrink:
+            # the rescue shrank the session: restore the full mesh
+            # for the next iteration (conftest restores post-test)
+            from dr_tpu.utils import elastic
+            elastic.reset()
+
+        def cmp(b, g, msg):
+            # the one carve-out: a shrink changes the MESH WIDTH, so
+            # float collectives (psum trees, scan carries) regroup —
+            # cross-width FP identity is impossible; the elastic
+            # suite's tolerance applies.  Unshrunk chains stay EXACT.
+            b, g = np.asarray(b), np.asarray(g)
+            if shrink and b.dtype.kind == "f":
+                np.testing.assert_allclose(b, g, rtol=1e-5,
+                                           atol=1e-6, err_msg=msg)
+            else:
+                np.testing.assert_array_equal(b, g, err_msg=msg)
+
+        for nm in base_c:
+            cmp(base_c[nm], got_c[nm], f"{tag}: {nm}")
+        assert len(base_s) == len(got_s), tag
+        for bs, gs in zip(base_s, got_s):
+            cmp(np.float64(bs), np.float64(gs), f"{tag}: scalar")
+        assert len(base_r) == len(got_r), tag
+        for (bm, barrs), (gm, garrs) in zip(base_r, got_r):
+            assert bm == gm, f"{tag}: relational count {bm} != {gm}"
+            for ba, ga in zip(barrs, garrs):
+                cmp(ba, ga, tag)

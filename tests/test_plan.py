@@ -463,7 +463,9 @@ def test_explain_reports_runs_and_reasons():
     st = p.stats()
     assert st == {"flushes": 1, "fused_runs": 1, "fused_ops": 2,
                   "opaque_ops": 0, "cache_hits": st["cache_hits"],
-                  "dispatches": 1}
+                  "dispatches": 1,
+                  "opt": {"merged_runs": 0, "dce_ops": 0,
+                          "pushdowns": 0}}
 
 
 def test_nested_deferred_reenters():

@@ -1,0 +1,713 @@
+"""Plan optimizer pass pipeline (docs/SPEC.md §21): targeted units.
+
+The bit-identity battery lives in tests/test_fuzz.py::test_fuzz_plan_opt
+(random chains, ``DR_TPU_PLAN_OPT=all`` vs ``=0``); this file pins the
+individual pass semantics — merge coalesces independent runs split by
+recording order, dce eliminates overwritten-before-read writes,
+pushdown re-homes a projection into the relational scratch copy,
+capinfer sizes auto outputs from probes/hints, joinroute reads the
+tuning DB — plus the knob surface (mode / per-pass disable) and the
+never-take-a-flush-down failure posture.
+"""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import tuning
+from dr_tpu.plan import opt as plan_opt
+from dr_tpu.utils.env import env_override
+from dr_tpu.utils.spmd_guard import dispatch_count
+
+
+def _scale(x, c):
+    return x * c
+
+
+def _shift(x, c):
+    return x + c
+
+
+def _mkvec(src):
+    return dr_tpu.distributed_vector.from_array(np.asarray(src))
+
+
+# ---------------------------------------------------------------------------
+# mode / knob surface
+# ---------------------------------------------------------------------------
+
+def test_mode_parsing_and_per_pass_disable():
+    with env_override(DR_TPU_PLAN_OPT=None, DR_TPU_PLAN_OPT_DISABLE=None):
+        assert plan_opt.mode() == "auto"
+        assert plan_opt.enabled("merge")
+        assert plan_opt.enabled("dce")
+        # auto leaves the probe/rewrite passes off; all arms them
+        assert not plan_opt.enabled("capinfer")
+        assert not plan_opt.enabled("pushdown")
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        for name in plan_opt.PASS_NAMES:
+            assert plan_opt.enabled(name), name
+    with env_override(DR_TPU_PLAN_OPT="0"):
+        for name in plan_opt.PASS_NAMES:
+            assert not plan_opt.enabled(name), name
+    with env_override(DR_TPU_PLAN_OPT="all",
+                      DR_TPU_PLAN_OPT_DISABLE="merge, capinfer"):
+        assert not plan_opt.enabled("merge")
+        assert not plan_opt.enabled("capinfer")
+        assert plan_opt.enabled("dce")
+
+
+def test_every_pass_is_registered_with_an_impl_or_config_contract():
+    """The §21 registry shape drlint R7 keys on: queue-rewrite passes
+    carry a callable, config-level passes register with None — and
+    every name answers :func:`plan_opt.enabled`."""
+    names = set()
+    for name, fn in plan_opt.PASSES:
+        assert fn is None or callable(fn)
+        names.add(name)
+        with env_override(DR_TPU_PLAN_OPT="all",
+                          DR_TPU_PLAN_OPT_DISABLE=name):
+            assert not plan_opt.enabled(name)
+    assert names == set(plan_opt.PASS_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def test_merge_coalesces_runs_split_by_independent_opaque():
+    """fill(a) | scan(c->d) | for_each(b): the two fusible runs touch
+    containers disjoint from the scan's footprint, so they merge into
+    ONE dispatch — and the flush result is bit-equal to opt=0."""
+    n = 32
+    src_c = np.arange(n, dtype=np.float32)
+
+    def run():
+        a = dr_tpu.distributed_vector(n, np.float32)
+        b = _mkvec(np.full(n, 3.0, np.float32))
+        c = _mkvec(src_c)
+        d = dr_tpu.distributed_vector(n, np.float32)
+        d0 = dispatch_count()
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)
+            dr_tpu.inclusive_scan(c, d)
+            dr_tpu.for_each(b, _scale, 2.0)
+        used = dispatch_count() - d0
+        return ([dr_tpu.to_numpy(x) for x in (a, b, c, d)],
+                used, p.stats())
+
+    with env_override(DR_TPU_PLAN_OPT="0"):
+        base, base_used, base_stats = run()
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        got, used, stats = run()
+    for w, g in zip(base, got):
+        np.testing.assert_array_equal(w, g)
+    assert base_stats["opt"] == {"merged_runs": 0, "dce_ops": 0,
+                                 "pushdowns": 0}
+    assert stats["opt"]["merged_runs"] == 1
+    assert used < base_used
+    assert stats["fused_runs"] == base_stats["fused_runs"] - 1
+
+
+def test_merge_blocked_by_footprint_overlap():
+    """fill(a,1) | scan(a->d) | fill(a,2): the second run writes a
+    container the opaque reads, so recording order must hold."""
+    n = 16
+    a = _mkvec(np.zeros(n, np.float32))
+    d = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)
+            dr_tpu.inclusive_scan(a, d)
+            dr_tpu.fill(a, 2.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 2.0, np.float32))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(d),
+                                  np.cumsum(np.ones(n, np.float32)))
+    assert p.stats()["opt"]["merged_runs"] == 0
+
+
+def test_merge_blocked_by_scalar_dependency():
+    """A run consuming an earlier run's reduce handle cannot merge
+    backward past it — the handle resolves only after the producer
+    dispatches."""
+    n = 16
+    a = _mkvec(np.full(n, 2.0, np.float32))
+    c = _mkvec(np.arange(n, dtype=np.float32))
+    d = dr_tpu.distributed_vector(n, np.float32)
+    x = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            s = dr_tpu.reduce(a)          # run 1: handle producer
+            dr_tpu.inclusive_scan(c, d)   # opaque splitter
+            dr_tpu.fill(x, s)             # run 2: consumes the handle
+    assert float(s) == 2.0 * n
+    np.testing.assert_array_equal(dr_tpu.to_numpy(x),
+                                  np.full(n, 2.0 * n, np.float32))
+    assert p.stats()["opt"]["merged_runs"] == 0
+
+
+def test_merge_preserves_within_run_interleaving():
+    """Cross-container read-after-write THROUGH the merge: run 2's
+    transform reads what run 1 wrote — footprints intersect, so run 2
+    stays put and the merged threading still sees run 1's value."""
+    n = 24
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    c = _mkvec(np.arange(n, dtype=np.float32))
+    d = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred():
+            dr_tpu.fill(a, 5.0)
+            dr_tpu.inclusive_scan(c, d)
+            dr_tpu.transform(a, b, _shift, 1.0)  # reads a: no reorder
+    np.testing.assert_array_equal(dr_tpu.to_numpy(b),
+                                  np.full(n, 6.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# dce
+# ---------------------------------------------------------------------------
+
+def test_dce_eliminates_overwritten_fill():
+    n = 32
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)   # dead: same window overwritten
+            dr_tpu.fill(a, 2.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 2.0, np.float32))
+    assert p.stats()["opt"]["dce_ops"] == 1
+
+
+def test_dce_keeps_partially_overwritten_and_read_first_writes():
+    n = 32
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)             # read by the transform
+            dr_tpu.transform(a, b, _scale, 3.0)
+            dr_tpu.fill(a[0:n // 2], 7.0)   # partial: tail survives
+    want = np.full(n, 1.0, np.float32)
+    want[:n // 2] = 7.0
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a), want)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(b),
+                                  np.full(n, 3.0, np.float32))
+    assert p.stats()["opt"]["dce_ops"] == 0
+
+
+def test_dce_window_coverage_composes():
+    """Two half-window fills do NOT retire a whole-row victim (the
+    interval walk needs full coverage BY KEPT ops), but a whole-row
+    fill retires both earlier halves."""
+    n = 32
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a[0:n // 2], 1.0)   # dead under the full fill
+            dr_tpu.fill(a[n // 2:n], 2.0)   # dead under the full fill
+            dr_tpu.fill(a, 9.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 9.0, np.float32))
+    assert p.stats()["opt"]["dce_ops"] == 2
+
+
+def test_dce_empty_run_disappears():
+    """A run whose every op died (and that owes no scalar handles)
+    drops out of the executed queue entirely."""
+    n = 16
+    a = dr_tpu.distributed_vector(n, np.float32)
+    c = _mkvec(np.arange(n, dtype=np.float32))
+    d = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto",
+                      DR_TPU_PLAN_OPT_DISABLE="merge"):
+        d0 = dispatch_count()
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)           # the whole run dies
+            dr_tpu.inclusive_scan(c, d)
+            dr_tpu.fill(a, 2.0)
+        used = dispatch_count() - d0
+    assert p.stats()["opt"]["dce_ops"] == 1
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 2.0, np.float32))
+    # the dead first run never dispatched: scan + one fused run only
+    items = p.log[-1]["items"]
+    assert sum(1 for i in items if i["kind"] == "fused") == 1
+    assert used >= 1
+
+
+def test_per_pass_disable_bisects():
+    n = 32
+    with env_override(DR_TPU_PLAN_OPT="auto",
+                      DR_TPU_PLAN_OPT_DISABLE="dce"):
+        a = dr_tpu.distributed_vector(n, np.float32)
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)
+            dr_tpu.fill(a, 2.0)
+        assert p.stats()["opt"]["dce_ops"] == 0
+        assert "dce" not in p.log[-1]["opt"]["passes"]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 2.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# pushdown
+# ---------------------------------------------------------------------------
+
+def test_pushdown_rehomes_projection_into_relational_scratch():
+    """transform(a -> tmp) feeding ONLY a relational op, with tmp
+    overwritten afterwards: the projection re-homes into the scratch
+    sort copy and the materializing transform dies — same rows out."""
+    rng = np.random.default_rng(21)
+    n = 48
+    src = rng.integers(0, 8, n).astype(np.float32)
+    want = np.unique(src * 2.0)
+
+    a = _mkvec(src)
+    tmp = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.transform(a, tmp, _scale, 2.0)
+            r = dr_tpu.unique_auto(tmp)
+            dr_tpu.copy(np.zeros(n, np.float32), tmp)  # tmp dies
+        o = p.stats()["opt"]
+    np.testing.assert_array_equal(r.arrays()[0], want)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(tmp),
+                                  np.zeros(n, np.float32))
+    assert o["pushdowns"] == 1
+    assert o["dce_ops"] >= 1   # the re-homed transform died
+
+
+def test_pushdown_declines_when_intermediate_is_live():
+    """tmp read after the relational op: eliminating the transform
+    would be observable, so the pushdown must not fire."""
+    rng = np.random.default_rng(22)
+    n = 32
+    src = rng.integers(0, 6, n).astype(np.float32)
+    a = _mkvec(src)
+    tmp = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.transform(a, tmp, _scale, 2.0)
+            r = dr_tpu.unique_auto(tmp)
+        o = p.stats()["opt"]
+    assert o["pushdowns"] == 0
+    np.testing.assert_array_equal(r.arrays()[0], np.unique(src * 2.0))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(tmp), src * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# capinfer / auto-capacity API
+# ---------------------------------------------------------------------------
+
+def _join_oracle(lk, lv, rk, rv, how="inner", fill=0.0):
+    import pandas as pd
+    left = pd.DataFrame({"k": lk, "l": lv})
+    right = pd.DataFrame({"k": rk, "r": rv})
+    m = left.merge(right, on="k", how=how if how != "outer" else "outer")
+    m = m.fillna(fill)
+    return m.sort_values(["k", "l", "r"], kind="stable")
+
+
+def test_join_auto_matches_explicit_join():
+    rng = np.random.default_rng(23)
+    nl, nr = 40, 24
+    lk = rng.integers(0, 10, nl).astype(np.float32)
+    lv = rng.standard_normal(nl).astype(np.float32)
+    rk = rng.integers(0, 10, nr).astype(np.float32)
+    rv = rng.standard_normal(nr).astype(np.float32)
+
+    lkc, lvc = _mkvec(lk), _mkvec(lv)
+    rkc, rvc = _mkvec(rk), _mkvec(rv)
+    cap = 4 * (nl + nr)
+    ok = dr_tpu.distributed_vector(cap, np.float32)
+    ol = dr_tpu.distributed_vector(cap, np.float32)
+    orr = dr_tpu.distributed_vector(cap, np.float32)
+    m_exp = int(dr_tpu.join(lkc, lvc, rkc, rvc, ok, ol, orr))
+
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        r = dr_tpu.join_auto(lkc, lvc, rkc, rvc)
+        assert r.count == m_exp
+        got_k, got_l, got_r = r.arrays()
+    np.testing.assert_array_equal(got_k, dr_tpu.to_numpy(ok)[:m_exp])
+    np.testing.assert_array_equal(got_l, dr_tpu.to_numpy(ol)[:m_exp])
+    np.testing.assert_array_equal(got_r, dr_tpu.to_numpy(orr)[:m_exp])
+
+
+def test_capinfer_hint_skips_probe_and_survives_undershoot():
+    """A session-noted ratio replaces the count probe; a deliberately
+    TINY hint undershoots, and the auto path re-merges at the exact
+    count instead of raising the capacity error."""
+    rng = np.random.default_rng(24)
+    n = 40
+    keys = rng.integers(0, 4, n).astype(np.float32)   # heavy dup keys
+    vals = np.ones(n, np.float32)
+    kc, vc = _mkvec(keys), _mkvec(vals)
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        tuning.clear_session()
+        tuning.note("relational", "cap_ratio_join_inner", 1e-3)
+        r = dr_tpu.join_auto(kc, vc, kc, vc)
+        # many-to-many expansion: sum over keys of cnt^2
+        _u, cnt = np.unique(keys, return_counts=True)
+        assert r.count == int((cnt.astype(np.int64) ** 2).sum())
+        # the observed ratio overwrote the bogus note
+        key = tuning.context_key("relational", "cap_ratio_join_inner")
+        assert tuning._session[key] > 1e-3
+
+
+def test_groupby_auto_and_unique_auto_deferred():
+    rng = np.random.default_rng(25)
+    n = 36
+    keys = rng.integers(0, 7, n).astype(np.float32)
+    vals = rng.standard_normal(n).astype(np.float32)
+    kc, vc = _mkvec(keys), _mkvec(vals)
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        with dr_tpu.deferred():
+            g = dr_tpu.groupby_auto(kc, vc, agg="sum")
+            u = dr_tpu.unique_auto(kc)
+        gk, gv = g.arrays()
+        (uk,) = u.arrays()
+    want_k = np.unique(keys)
+    np.testing.assert_array_equal(gk, want_k)
+    np.testing.assert_array_equal(uk, want_k)
+    want_v = np.array([vals[keys == k].sum() for k in want_k],
+                      np.float64)
+    np.testing.assert_allclose(gv, want_v, rtol=1e-5, atol=1e-6)
+    assert g.count == len(want_k) and u.count == len(want_k)
+
+
+def test_auto_result_discarded_raises():
+    n = 16
+    kc = _mkvec(np.arange(n, dtype=np.float32))
+    with env_override(DR_TPU_PLAN_OPT="all"):
+        p = dr_tpu.plan.Plan()
+        with pytest.raises(RuntimeError, match="boom"):
+            with p.record():
+                u = dr_tpu.unique_auto(kc)
+                raise RuntimeError("boom")
+        with pytest.raises(RuntimeError, match="discarded"):
+            u.count
+
+
+def test_capinfer_disabled_uses_caller_guess_shape():
+    """With the pass off the auto API still works — capacity falls
+    back to the pre-§21 worst-case guess (no probe dispatch)."""
+    rng = np.random.default_rng(26)
+    n = 30
+    keys = rng.integers(0, 5, n).astype(np.float32)
+    kc = _mkvec(keys)
+    with env_override(DR_TPU_PLAN_OPT="0"):
+        u = dr_tpu.unique_auto(kc)
+        np.testing.assert_array_equal(u.arrays()[0], np.unique(keys))
+
+
+# ---------------------------------------------------------------------------
+# joinroute (tuning-DB route selection)
+# ---------------------------------------------------------------------------
+
+def test_joinroute_reads_tuning_db_and_env_pin_wins():
+    from dr_tpu.algorithms import relational as rel
+    rng = np.random.default_rng(27)
+    n = 32
+    k = rng.integers(0, 9, n).astype(np.float32)
+    v = rng.standard_normal(n).astype(np.float32)
+    kc, vc = _mkvec(k), _mkvec(v)
+    cap = 8 * n
+    outs = [dr_tpu.distributed_vector(cap, np.float32)
+            for _ in range(3)]
+
+    def route_of():
+        dr_tpu.join(kc, vc, kc, vc, *outs)
+        return rel.last_join_route()["impl"]
+
+    with env_override(DR_TPU_PLAN_OPT="all",
+                      DR_TPU_JOIN_BROADCAST_MAX=None):
+        assert route_of() == "broadcast"        # code default: 2^18
+        tuning.note("join", "broadcast_max", 0)  # measured: repartition
+        if dr_tpu.nprocs() > 1:
+            assert route_of() == "partition"
+        # the operator's env pin beats the DB
+        with env_override(DR_TPU_JOIN_BROADCAST_MAX=str(1 << 18)):
+            assert route_of() == "broadcast"
+        # the pass disabled: the DB entry is ignored
+        with env_override(DR_TPU_PLAN_OPT_DISABLE="joinroute"):
+            assert route_of() == "broadcast"
+
+
+# ---------------------------------------------------------------------------
+# failure posture / introspection
+# ---------------------------------------------------------------------------
+
+def test_optimizer_pass_failure_never_takes_the_flush_down(monkeypatch):
+    """A crashing pass is announced and the RECORDED queue executes —
+    correct results, `error` note in the flush entry."""
+    def boom(_q):
+        raise RuntimeError("synthetic pass bug")
+
+    monkeypatch.setattr(plan_opt, "PASSES",
+                        (("dce", boom),) + tuple(
+                            p for p in plan_opt.PASSES if p[0] != "dce"))
+    n = 16
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)
+            dr_tpu.fill(a, 4.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 4.0, np.float32))
+    assert "synthetic pass bug" in p.log[-1]["opt"]["error"]
+
+
+def test_explain_carries_the_opt_note():
+    n = 16
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)
+            dr_tpu.fill(a, 2.0)
+    text = p.explain()
+    assert "opt [" in text and "dead op(s) eliminated" in text
+
+
+# ---------------------------------------------------------------------------
+# tuning database (dr_tpu/tuning.py)
+# ---------------------------------------------------------------------------
+
+def test_tuning_record_lookup_roundtrip(tmp_path):
+    db = str(tmp_path / "tuning_db.json")
+    with env_override(DR_TPU_TUNING_DB=db):
+        assert tuning.enabled()
+        assert tuning.lookup("spmv", "format", "csr") == "csr"
+        key = tuning.record("spmv", "format", "ell", source="unit")
+        assert key is not None and "spmv.format@backend=" in key
+        tuning.clear_session()
+        tuning.reload()
+        assert tuning.lookup("spmv", "format") == "ell"
+    # a different store path: the entry does not leak
+    with env_override(DR_TPU_TUNING_DB=str(tmp_path / "other.json")):
+        tuning.clear_session()
+        tuning.reload()
+        assert tuning.lookup("spmv", "format") is None
+
+
+def test_tuning_context_isolation(tmp_path):
+    """A CPU-mesh record can never poison a TPU-context lookup (and
+    vice versa): the backend/nshards tag is part of the key."""
+    db = str(tmp_path / "tuning_db.json")
+    tpu_ctx = {"backend": "tpu", "nshards": 4, "x64": False}
+    with env_override(DR_TPU_TUNING_DB=db):
+        tuning.record("scan", "chunk", 256)          # live (cpu) ctx
+        tuning.record("scan", "chunk", 4096, ctx=tpu_ctx)
+        tuning.clear_session()
+        tuning.reload()
+        assert tuning.lookup("scan", "chunk") == 256
+        assert tuning.lookup("scan", "chunk", ctx=tpu_ctx) == 4096
+        # a third context matches nothing: code default
+        assert tuning.lookup(
+            "scan", "chunk", 8192,
+            ctx={"backend": "cpu", "nshards": 2, "x64": False}) == 8192
+
+
+def test_tuning_corrupt_db_single_warn_and_defaults(tmp_path,
+                                                    monkeypatch):
+    import warnings
+    from dr_tpu.utils import fallback
+    db = tmp_path / "tuning_db.json"
+    db.write_text("{ not json !!", encoding="utf-8")
+    monkeypatch.setenv("DR_TPU_SILENCE_FALLBACKS", "0")
+    fallback.reset()
+    with env_override(DR_TPU_TUNING_DB=str(db)):
+        tuning.reload()
+        monkeypatch.setattr(tuning, "_warned_paths", set())
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert tuning.lookup("scan", "chunk", 8192) == 8192
+            assert tuning.lookup("spmv", "format", "csr") == "csr"
+        warns = [w for w in rec if "tuning DB" in str(w.message)]
+        assert len(warns) == 1
+        # a later RECORD rebuilds the store from scratch
+        tuning.record("scan", "chunk", 512)
+        tuning.clear_session()
+        tuning.reload()
+        assert tuning.lookup("scan", "chunk") == 512
+
+
+def test_tuning_pickers_consult_db_between_env_and_default(tmp_path):
+    """The dispatch-time integration sites: scan chunk + spmv format
+    flip with a DB entry, and an explicit env pin still wins."""
+    import importlib
+    _gemv = importlib.import_module("dr_tpu.algorithms.gemv")
+    from dr_tpu.ops import scan_pallas
+    db = str(tmp_path / "tuning_db.json")
+    with env_override(DR_TPU_TUNING_DB=db, DR_TPU_SCAN_CHUNK=None,
+                      DR_TPU_SPMV_FORMAT=None):
+        default_chunk = scan_pallas.chunk_cap()
+        tuning.record("scan", "chunk", 256)
+        assert scan_pallas.chunk_cap() == 256
+        assert default_chunk != 256
+        with env_override(DR_TPU_SCAN_CHUNK="1024"):
+            assert scan_pallas.chunk_cap() == 1024
+
+        m = 64
+        rows = np.arange(m)
+        A = dr_tpu.sparse_matrix.from_coo(
+            (m, m), rows, rows, np.ones(m, np.float32))
+        base_fmt = _gemv._pick_format(A)
+        other = "csr" if base_fmt != "csr" else "ell"
+        tuning.record("spmv", "format", other)
+        assert _gemv._pick_format(A) == other
+        with env_override(DR_TPU_SPMV_FORMAT=base_fmt):
+            assert _gemv._pick_format(A) == base_fmt
+        # a junk recorded value is ignored, not crashed on
+        tuning.record("spmv", "format", 123)
+        assert _gemv._pick_format(A) == base_fmt
+
+
+def test_tuning_db_fresh_process_roundtrip(tmp_path):
+    """The acceptance round trip: a recorded winner changes the picked
+    config in a FRESH process with zero code edits, and without the
+    DB the fresh process keeps the code default."""
+    import subprocess
+    import sys
+    db = str(tmp_path / "tuning_db.json")
+    with env_override(DR_TPU_TUNING_DB=db):
+        tuning.record("scan", "chunk", 256, source="unit-roundtrip")
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import dr_tpu\n"
+        "dr_tpu.init()\n"
+        "from dr_tpu.ops import scan_pallas\n"
+        "print('chunk=%d' % scan_pallas.chunk_cap())\n"
+    )
+
+    def run(extra_env):
+        import os
+        env = dict(os.environ)
+        env.pop("DR_TPU_SCAN_CHUNK", None)
+        env.pop("DR_TPU_TUNING_DB", None)
+        env.update(extra_env)
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=300)
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    assert "chunk=256" in run({"DR_TPU_TUNING_DB": db})
+    assert "chunk=8192" in run({})
+
+
+# ---------------------------------------------------------------------------
+# footprint-gated host writes (§21.2 flush gating)
+# ---------------------------------------------------------------------------
+
+def test_assign_array_footprint_gated_flush():
+    """A host write into a container the queue never touches (the
+    serve daemon building a batched request's fresh operand) records
+    WITHOUT the flush cliff; a write into a touched container still
+    flushes the pending ops first."""
+    n = 16
+    a = dr_tpu.distributed_vector(n, np.float32)
+    src = np.arange(n, dtype=np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 1.0)
+        fresh = dr_tpu.distributed_vector.from_array(src)
+        assert p.stats()["flushes"] == 0     # untouched: no cliff
+        a.assign_array(np.full(n, 5.0, np.float32))
+        assert p.stats()["flushes"] == 1     # touched: ordered flush
+    np.testing.assert_array_equal(dr_tpu.to_numpy(fresh), src)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.full(n, 5.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# the bench --plan acceptance bar
+# ---------------------------------------------------------------------------
+
+def test_bench_plan_relational_ab_strictly_fewer_dispatches():
+    """The ISSUE 15 acceptance criterion, pinned in CI: the deferred
+    relational pipeline (join_auto -> groupby_auto -> histogram/top_k
+    with interleaved elementwise runs) flushes with STRICTLY fewer
+    dispatches under DR_TPU_PLAN_OPT=all than =0 — and the same
+    relational row counts."""
+    import bench
+    n_fact, ncard = 2 ** 10, 64
+    used = {}
+    res = {}
+    for mode in ("0", "all"):
+        bench._plan_opt_chain(n_fact, ncard, mode)   # warm compiles
+        used[mode], _wall, note, res[mode] = \
+            bench._plan_opt_chain(n_fact, ncard, mode)
+    assert used["all"] < used["0"], (used, note)
+    assert note.get("merged_runs", 0) >= 1
+    assert res["0"] == res["all"]
+
+
+# ---------------------------------------------------------------------------
+# review-fix regressions
+# ---------------------------------------------------------------------------
+
+def test_gemv_view_operand_is_a_full_barrier():
+    """A deferred gemv whose b operand is a VIEW (subrange/transform —
+    a container the footprint cannot name) must record as a full
+    barrier: dce may not retire a producer of the view's base across
+    it, and merge may not reorder one past it."""
+    n = 16
+    rows = np.arange(n)
+    A = dr_tpu.sparse_matrix.from_coo(
+        (n, n), rows, rows, np.ones(n, np.float32))
+    b = dr_tpu.distributed_vector(n, np.float32)
+    c = dr_tpu.distributed_vector(n, np.float32)
+    dr_tpu.fill(c, 0.0)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred():
+            dr_tpu.fill(b, 1.0)          # must NOT be dce'd
+            dr_tpu.gemv(c, A, b[0:n])    # barrier: reads b via a view
+            dr_tpu.fill(b, 2.0)
+    np.testing.assert_array_equal(dr_tpu.to_numpy(c),
+                                  np.ones(n, np.float32))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(b),
+                                  np.full(n, 2.0, np.float32))
+
+
+def test_pass_failure_after_merge_keeps_recorded_queue_executable(
+        monkeypatch):
+    """The never-take-a-flush-down fallback must re-execute a WHOLE
+    recorded queue even when a pass fails AFTER merge wrapped ops —
+    the source ops' operand values may only drop once the full
+    pipeline succeeded."""
+    def boom(_q):
+        raise RuntimeError("post-merge pass bug")
+
+    monkeypatch.setattr(plan_opt, "PASSES",
+                        tuple(plan_opt.PASSES) + (("post", boom),))
+    n = 16
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = _mkvec(np.full(n, 3.0, np.float32))
+    c = _mkvec(np.arange(n, dtype=np.float32))
+    d = dr_tpu.distributed_vector(n, np.float32)
+    with env_override(DR_TPU_PLAN_OPT="auto"):
+        with dr_tpu.deferred() as p:
+            dr_tpu.fill(a, 1.0)           # run 1
+            dr_tpu.inclusive_scan(c, d)   # splitter
+            dr_tpu.for_each(b, _scale, 2.0)  # run 2: wrapped by merge
+    assert "post-merge pass bug" in p.log[-1]["opt"]["error"]
+    np.testing.assert_array_equal(dr_tpu.to_numpy(a),
+                                  np.ones(n, np.float32))
+    np.testing.assert_array_equal(dr_tpu.to_numpy(b),
+                                  np.full(n, 6.0, np.float32))
+    np.testing.assert_array_equal(
+        dr_tpu.to_numpy(d),
+        np.cumsum(np.arange(n, dtype=np.float32)))
+
+
+def test_groupby_auto_validates_at_the_call_site():
+    """API misuse raises NOW, not inside the deferred flush where it
+    would classify away the whole batch (§17.5 discipline)."""
+    kc = _mkvec(np.arange(8, dtype=np.float32))
+    vc = _mkvec(np.arange(6, dtype=np.float32))
+    with dr_tpu.deferred():
+        with pytest.raises(ValueError, match="equal"):
+            dr_tpu.groupby_auto(kc, vc)

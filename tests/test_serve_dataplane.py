@@ -146,6 +146,83 @@ def test_arena_wire_roundtrip_and_reply_path(server):
     assert server._arena.stats()["in_use"] == 0
 
 
+def test_arena_lease_cache_reuses_slots_across_requests(server):
+    """ISSUE 15 satellite: same-shape payloads reuse a granted slot
+    lease (the ``keep`` wire marker) — the per-request ``arena_alloc``
+    round trip disappears after the first, the daemon's alloc count
+    stays flat, and every reply is still correct."""
+    with _client(server) as c:
+        for r in range(6):
+            got = c.scale(BIG, a=1.0 + r)
+            np.testing.assert_allclose(got, BIG * (1.0 + r), rtol=1e-6)
+        assert c.arena_active()
+        assert c.lease_hits >= 5, (c.lease_hits, c.lease_misses)
+        assert c.lease_misses == 1
+        # the held lease is ONE live slot beyond the reply traffic —
+        # request-side allocs stopped after the first request
+        allocs_now = server._arena.stats()["allocs"]
+        c.scale(BIG, a=9.0)
+        # one more round trip costs exactly the REPLY slot, never a
+        # fresh request lease
+        assert server._arena.stats()["allocs"] == allocs_now + 1
+    # disconnect teardown reaps the held lease wholesale
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if server._arena.stats()["in_use"] == 0:
+            break
+        time.sleep(0.02)
+    assert server._arena.stats()["in_use"] == 0
+
+
+def test_arena_lease_cache_differs_by_shape_and_caps(server):
+    """Different payload sizes take different leases; the cache stays
+    bounded (excess leases release by piggyback, never leak)."""
+    small_big = np.arange(1 << 14, dtype=np.float32)  # still >= floor
+    with _client(server) as c:
+        c.scale(BIG, a=1.0)
+        c.scale(small_big, a=1.0)
+        hits0 = c.lease_hits
+        c.scale(BIG, a=2.0)
+        c.scale(small_big, a=2.0)
+        assert c.lease_hits == hits0 + 2
+        assert c.lease_misses == 2  # one per distinct capacity
+
+
+def test_arena_lease_cache_disabled_by_env(tmp_path):
+    with env_override(DR_TPU_SERVE_LEASE_CACHE="0"):
+        srv = serve.Server(str(tmp_path / "nolease.sock")).start()
+        try:
+            with _client(srv) as c:
+                for r in range(3):
+                    np.testing.assert_allclose(
+                        c.scale(BIG, a=1.0 + r), BIG * (1.0 + r),
+                        rtol=1e-6)
+                assert c.lease_hits == 0
+                assert c.lease_misses == 3
+        finally:
+            srv.stop()
+
+
+def test_arena_lease_cache_drops_on_reconnect(tmp_path):
+    """A reconnect invalidates every held lease (the daemon teardown
+    freed them; a recycled slot's generation bumped) — the fresh
+    connection re-leases instead of offering a stale handle."""
+    srv = serve.Server(str(tmp_path / "relse.sock")).start()
+    try:
+        with _client(srv, retries=3) as c:
+            c.scale(BIG, a=1.0)
+            c.scale(BIG, a=2.0)
+            assert c.lease_hits == 1
+            # force a desync the retry path heals with a reconnect
+            c._invalidate("test: simulated desync")
+            assert c._lease_cache == {}
+            np.testing.assert_allclose(c.scale(BIG, a=3.0), BIG * 3.0,
+                                       rtol=1e-6)
+            assert c.lease_misses >= 2  # re-leased after the drop
+    finally:
+        srv.stop()
+
+
 def test_arena_disabled_daemon_serves_inline(tmp_path):
     with env_override(DR_TPU_SERVE_ARENA="0"):
         srv = serve.Server(str(tmp_path / "noar.sock")).start()

@@ -38,6 +38,13 @@ R6    tapped-cache: ``jax.jit`` in ``dr_tpu/`` must live in a module on
       the TappedCache discipline (so dispatches ride the spmd_guard
       tap); immediately-invoked ``jax.jit(f)(…)`` (compile-per-call) and
       plain-dict program caches are findings anywhere.
+R7    plan-opt registry: every optimizer pass registered in
+      ``dr_tpu/plan/opt.py``'s ``PASSES`` must have a docs/SPEC.md
+      §21.2 pass-table row (semantics + bit-identity argument) and
+      bit-identity fuzz coverage (``test_fuzz_plan_opt`` sweeps
+      ``PASS_NAMES``, or names each pass) — both drift directions;
+      registration itself is the per-pass disable flag
+      (``DR_TPU_PLAN_OPT_DISABLE`` keys on the registered name).
 ====  =====================================================================
 
 Suppressions: ``# drlint: ok[R2] <reason>`` on the finding's line, or on
@@ -90,6 +97,7 @@ RULES = {
     "R4": "collective under a data-dependent branch",
     "R5": "degradation path outside the fallback registry",
     "R6": "program compilation outside the TappedCache discipline",
+    "R7": "plan-optimizer pass registry drift",
 }
 
 DEFAULT_ROOTS = ("dr_tpu", "tools", "tests", "bench.py",
@@ -300,6 +308,7 @@ class Linter:
             self.check_file(fi)
         self.check_env_table()
         self.check_fault_registry()
+        self.check_plan_opt_registry()
         # suppressions apply last (and R0 findings ride along)
         for fi in self.files:
             sup = Suppressions(fi.lines, fi.relpath, self.findings)
@@ -516,6 +525,83 @@ class Linter:
                     self.emit("R3", chaos_fi, 1,
                               "test_chaos does not sweep faults.SITES "
                               f"and never names: {', '.join(missing)}")
+
+    # --------------------------------------------------------------- R7
+    def check_plan_opt_registry(self) -> None:
+        """Whole-repo R7 closure: every ``PASSES`` entry in
+        dr_tpu/plan/opt.py has a docs/SPEC.md §21.2 pass-table row and
+        bit-identity fuzz coverage, and every §21.2 row names a
+        registered pass — the R3 fault-registry discipline applied to
+        the optimizer's pass pipeline."""
+        if not self.full_scan or "R7" not in self.rules:
+            return
+        opt_fi = next((f for f in self.files
+                       if f.relpath == "dr_tpu/plan/opt.py"), None)
+        if opt_fi is None:
+            return
+        passes: Dict[str, int] = {}
+        for node in opt_fi.tree.body:
+            tgt = node.targets[0] if isinstance(node, ast.Assign) \
+                and node.targets else None
+            if isinstance(tgt, ast.Name) and tgt.id == "PASSES" and \
+                    isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Tuple) and elt.elts and \
+                            isinstance(elt.elts[0], ast.Constant):
+                        passes[elt.elts[0].value] = elt.lineno
+        if not passes:
+            self.emit("R7", opt_fi, 1,
+                      "no PASSES registry found — the §21 pass "
+                      "pipeline must register every pass")
+            return
+        # SPEC §21.2 pass-table rows (first backticked cell of each
+        # table row inside the subsection)
+        spec_rows: Dict[str, int] = {}
+        spec_path = os.path.join(REPO, "docs", "SPEC.md")
+        if os.path.exists(spec_path):
+            in_sect = False
+            with open(spec_path, encoding="utf-8") as fh:
+                for i, text in enumerate(fh.read().splitlines(), 1):
+                    if re.match(r"###\s*21\.2\b", text):
+                        in_sect = True
+                        continue
+                    if in_sect and re.match(r"##", text):
+                        break
+                    if in_sect:
+                        m = re.match(r"\|\s*`([a-z][a-z_]*)`", text)
+                        if m:
+                            spec_rows[m.group(1)] = i
+        for name, line in sorted(passes.items()):
+            if name not in spec_rows:
+                self.emit("R7", opt_fi, line,
+                          f"optimizer pass {name!r} has no docs/"
+                          "SPEC.md §21.2 pass-table row — document "
+                          "its semantics and bit-identity argument")
+        for name, line in sorted(spec_rows.items()):
+            if name not in passes:
+                self.findings.append(Finding(
+                    "docs/SPEC.md", line, "R7",
+                    f"§21.2 pass-table row {name!r} matches no "
+                    "registered pass in plan/opt.py — stale "
+                    "documentation"))
+        # bit-identity fuzz coverage: the arm sweeps the registry
+        # (PASS_NAMES) or names every pass explicitly
+        fuzz = next((f for f in self.files
+                     if f.relpath == "tests/test_fuzz.py"), None)
+        if fuzz is not None:
+            if "def test_fuzz_plan_opt" not in fuzz.src:
+                self.emit("R7", fuzz, 1,
+                          "tests/test_fuzz.py has no "
+                          "test_fuzz_plan_opt — every optimizer pass "
+                          "needs the bit-identity fuzz arm")
+            elif not re.search(r"\bPASS_NAMES\b", fuzz.src):
+                missing = [p for p in sorted(passes)
+                           if p not in fuzz.src]
+                if missing:
+                    self.emit("R7", fuzz, 1,
+                              "test_fuzz_plan_opt does not sweep "
+                              "plan_opt.PASS_NAMES and never names: "
+                              f"{', '.join(missing)}")
 
     # --------------------------------------------------------------- R4
     def check_collective(self, fi: FileInfo, node: ast.Call,

@@ -91,6 +91,14 @@
 # recovery verified bit-equal each round, and the rolling-restart
 # soak (zero classified client errors).  The chaos battery above
 # sweeps the router.probe / serve.drain / serve.journal site rows.
+# PLAN-OPT arm (ISSUE 15, docs/SPEC.md SS21): test_fuzz_plan_opt
+# cranks random recorded chains (fusible/opaque/relational/
+# redistribute mix, random per-pass DR_TPU_PLAN_OPT_DISABLE
+# bisection, a mid-flush elastic-shrink slice) and bit-compares
+# DR_TPU_PLAN_OPT=all vs =0 — collected automatically with the fuzz
+# arms above, plus a dedicated DR_TPU_SANITIZE=1 crank below (the
+# recompile budget and finite-flush sweep over every optimized
+# chain).  drlint R7 keys the pass registry on this arm.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
@@ -176,6 +184,22 @@ if [ -z "$FILTER" ]; then
     rc=1
   fi
   rm -rf "$TDIR"
+fi
+# PLAN-OPT arm (ISSUE 15): the bit-identity battery with the runtime
+# sanitizer armed — recompile budget, finite flush sweep, and
+# canon-portable dispatch keys over every OPTIMIZED chain (merged
+# runs re-key their programs; a sanitize finding here is an optimizer
+# bug).  Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  nd="tests/test_fuzz.py::test_fuzz_plan_opt"
+  echo "=== $nd (DR_TPU_SANITIZE=1 DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_SANITIZE=1 DR_TPU_FUZZ_ITERS=$ITERS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd under DR_TPU_SANITIZE=1"
+    rc=1
+  fi
 fi
 # ELASTIC arm (round 13): random kill-a-rank sweeps over random
 # container populations, crank-budgeted (each pass inits a fresh mesh,

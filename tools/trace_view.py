@@ -140,6 +140,32 @@ def summarize(events: List[dict], top: int = 15,
                                      key=lambda kv: (kv[0][0], -kv[1])):
             print(f"  {cat or '-':<10} {name:<28} {n:>8}", file=out)
 
+    # ---- plan optimizer rollup (docs/SPEC.md §21.5): per-flush
+    # optimizer spans plus the per-pass breakdown — what the pass
+    # pipeline did (runs merged, dead ops eliminated, pushdowns) and
+    # what it cost, straight from a traced run
+    opt_spans = [s for s in spans if s.get("name") == "plan.opt"]
+    if opt_spans:
+        tot = {"merged_runs": 0, "dce_ops": 0, "pushdowns": 0}
+        for s in opt_spans:
+            a = s.get("args") or {}
+            for k in tot:
+                try:
+                    tot[k] += int(a.get(k, 0) or 0)
+                except (TypeError, ValueError):
+                    pass
+        cost = sum(s.get("dur", 0) for s in opt_spans)
+        print(f"\nplan optimizer: {len(opt_spans)} optimized "
+              f"flush(es), {fmt_us(cost)} total — "
+              f"{tot['merged_runs']} run(s) merged, "
+              f"{tot['dce_ops']} dead op(s) eliminated, "
+              f"{tot['pushdowns']} pushdown(s)", file=out)
+        per = [(name, a) for name, a in sorted(agg.items())
+               if name.startswith("plan.opt.")]
+        for name, a in per:
+            print(f"  {name:<22} {a['count']:>6} runs  "
+                  f"{fmt_us(a['total']):>12} total", file=out)
+
     # ---- serve control-plane rollup (docs/SPEC.md §20): drains,
     # breaker probes, respawns, drain-rehashes, journal replays
     cp: dict = defaultdict(int)

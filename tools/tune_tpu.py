@@ -26,6 +26,33 @@ def _errline(e):
     return (str(e).splitlines() or [repr(e)])[0][:90]
 
 
+def _record_winner(domain, param, value, source):
+    """Persist a measured winner into the tuning DB (docs/SPEC.md
+    §21.6) under the LIVE mesh's backend/shape context — dispatch and
+    the plan-optimizer config passes read it back with code defaults
+    as fallback, so the sweep's result applies in every later process
+    with zero code edits.  The context tag (backend, nshards, x64) is
+    baked into the key, so a CPU-mesh sweep can never poison the TPU
+    entry (and vice versa); a DEGRADED sweep therefore records a CPU
+    row, which a TPU dispatch will never match.  With no store armed
+    (DR_TPU_TUNING_DB / DR_TPU_COMPILE_CACHE_DIR both unset) the
+    winner lands in the in-process overlay only and the line says
+    so."""
+    import dr_tpu
+    from dr_tpu import tuning
+    from dr_tpu.parallel import runtime as _rt
+    if not _rt.is_initialized():
+        dr_tpu.init()  # the context tag needs the live mesh
+    key = tuning.record(domain, param, value,
+                        source=f"tune_tpu:{source}")
+    if key is None:
+        print(f"tuning: {domain}.{param} = {value!r} noted in-process "
+              "only (no DR_TPU_TUNING_DB / compile-cache dir armed)",
+              flush=True)
+    else:
+        print(f"tuning: recorded {key} = {value!r}", flush=True)
+
+
 def _marginal(run_sync, r1=2, r2=10, samples=5):
     """bench._marginal: the jitter-proof variant.  The plain median
     difference this tool used through round 3 had NO minimum-spread
@@ -139,6 +166,7 @@ def tune_scan():
              ("mxu0", 16384, "manual"), ("mxu3", 16384, "manual"),
              ("mxu0", 4096, "manual"), ("vpu", 8192, "manual"),
              ("mxu0", 8192, "grid"), ("mxu3", 8192, "grid")]
+    results = []
     for variant, cap, pipe in sweep:
         if variant == "vpu":
             os.environ["DR_TPU_SCAN_KERNEL"] = "vpu"
@@ -170,6 +198,12 @@ def tune_scan():
             return float(run(x, r, s[0]))
         try:
             dt = _marginal(sync)
+            # only rungs measured under the DEFAULT kernel family and
+            # pipe feed the recorded winner: chunk_cap() applies the
+            # DB entry with no env pins, so a vpu- or grid-tuned
+            # chunk would be a cross-config confound
+            if variant != "vpu" and pipe == "manual":
+                results.append((dt, cap))
             print(f"scan kernel [{variant} {pipe} R={cap}]: "
                   f"{dt * 1e3:.3f} ms "
                   f"-> {2 * n * 4 / dt / 1e9:.1f} GB/s", flush=True)
@@ -180,6 +214,10 @@ def tune_scan():
     os.environ.pop("DR_TPU_SCAN_CHUNK", None)
     os.environ.pop("DR_TPU_SCAN_PASSES", None)
     os.environ.pop("DR_TPU_SCAN_PIPE", None)
+    if results:
+        # the chunk of the fastest rung becomes the DB winner the
+        # chunk_cap() picker reads back (env pin still beats it)
+        _record_winner("scan", "chunk", min(results)[1], "scan")
 
 
 def tune_container(name):
@@ -390,6 +428,7 @@ def tune_spmv_ladder():
     # restore any operator-pinned values on exit (the sweep forces its
     # own per-rung settings; a session-level pin must survive it)
     from dr_tpu.utils.env import env_override, env_raw
+    fmt_wins: dict = {}
     with env_override(
             DR_TPU_SPMV_FORMAT=env_raw("DR_TPU_SPMV_FORMAT"),
             DR_TPU_RING_SCHEDULE=env_raw("DR_TPU_RING_SCHEDULE")):
@@ -418,6 +457,7 @@ def tune_spmv_ladder():
                 # schedule A/B below — a [ring] rung here would repeat
                 # the [ring/pipelined] measurement verbatim.
                 viable = viable_formats(A)
+                rung_best = None
                 for fmt in ("csr", "ell", "bcsr"):
                     if not viable[fmt]:
                         print(f"spmv {tag} [{fmt}]: ineligible "
@@ -426,12 +466,17 @@ def tune_spmv_ladder():
                     os.environ["DR_TPU_SPMV_FORMAT"] = fmt
                     try:
                         dt = _marginal(run, 2, 18)
+                        if rung_best is None or dt < rung_best[0]:
+                            rung_best = (dt, fmt)
                         print(f"spmv {tag} [{fmt}]: "
                               f"{flops / dt / 1e9:.2f} GFLOP/s",
                               flush=True)
                     except Exception as e:
                         print(f"spmv {tag} [{fmt}]: FAIL {_errline(e)}",
                               flush=True)
+                if rung_best is not None:
+                    fmt_wins[rung_best[1]] = \
+                        fmt_wins.get(rung_best[1], 0) + 1
                 os.environ["DR_TPU_SPMV_FORMAT"] = "ring"
                 try:
                     if P > 1 and viable["ring"]:
@@ -469,6 +514,14 @@ def tune_spmv_ladder():
                     os.environ.pop("DR_TPU_SPMV_FORMAT", None)
                     os.environ.pop("DR_TPU_RING_SCHEDULE", None)
                 A = c = bv = None
+    if fmt_wins:
+        # majority winner across the ladder's rungs: the _pick_format
+        # tier between the env pin and the build-time autoselect.
+        # The ring arm is deliberately absent — its eligibility is
+        # per-matrix (bucket-skew gate), so a ring row would force
+        # the fallback chain on ineligible matrices for nothing.
+        best = max(sorted(fmt_wins), key=lambda f: fmt_wins[f])
+        _record_winner("spmv", "format", best, "spmv")
 
 
 def tune_sort():
@@ -624,20 +677,36 @@ def tune_relational():
     # the SAME runner as bench's relational config: the on-chip
     # ladder must time the identical workload the PERF.md rows record
     from bench import _relational_runner
+    from dr_tpu.utils.env import env_override
 
     dr_tpu.init()
     on_cpu = dr_tpu.devices()[0].platform == "cpu"
+    ratios = None
+    crossover = []  # (combined_rows, t_broadcast, t_partition)
     for logn in ((12, 14) if on_cpu else (16, 18, 20)):
         n = 2 ** logn
         for card in (max(n // 64, 4), max(n // 8, 4)):
             stage = conts = None
             try:
                 stage, conts = _relational_runner(n, card)
-                stage()  # warm/compile
-                _m, _ng, ts = stage()
+                # broadcast-vs-repartition A/B at every rung: the
+                # crossover row count is the §21.4 joinroute winner
+                with env_override(
+                        DR_TPU_JOIN_BROADCAST_MAX=str(1 << 62)):
+                    stage()  # warm/compile the broadcast programs
+                    m, ng, ts = stage()
+                with env_override(DR_TPU_JOIN_BROADCAST_MAX="0"):
+                    stage()  # warm the partition programs
+                    _m2, _ng2, ts_p = stage()
+                crossover.append((n + card, ts["join"], ts_p["join"]))
+                # observed output/input ratios: the capinfer pass's
+                # probe-skipping hints (join base = both sorted sides,
+                # groupby base = its input rows)
+                ratios = (m / max(n + card, 1), ng / max(m, 1))
                 total = sum(ts.values())
                 print(f"relational n=2^{logn} card={card:<7d}: "
-                      f"join {ts['join'] * 1e3:8.2f} ms  "
+                      f"join {ts['join'] * 1e3:8.2f} ms "
+                      f"(part {ts_p['join'] * 1e3:8.2f} ms)  "
                       f"groupby {ts['groupby'] * 1e3:8.2f} ms  "
                       f"topk {ts['topk'] * 1e3:8.2f} ms  "
                       f"({n / total / 1e3:8.1f} krows/s)",
@@ -647,6 +716,22 @@ def tune_relational():
                       f"{_errline(e)}", flush=True)
             finally:
                 stage = conts = None
+    if ratios is not None:
+        _record_winner("relational", "cap_ratio_join_inner",
+                       round(ratios[0], 6), "relational")
+        _record_winner("relational", "cap_ratio_groupby",
+                       round(ratios[1], 6), "relational")
+    wins = [c for c, tb, tp in crossover if tp < tb]
+    if wins and dr_tpu.nprocs() > 1:
+        # repartition first wins at `min(wins)` combined rows: route
+        # broadcast strictly below it (join keeps broadcast while
+        # combined <= broadcast_max)
+        _record_winner("join", "broadcast_max", min(wins) - 1,
+                       "relational")
+    elif crossover:
+        print("tuning: no repartition crossover to record (single "
+              "shard, or broadcast wins every measured rung) — "
+              "join.broadcast_max keeps the code default", flush=True)
 
 
 def tune_redistribute():
