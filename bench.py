@@ -325,6 +325,37 @@ def _marginal_with_fallback(run_sync, kernel_possible, env_var, err_key,
             os.environ.pop(env_var, None)
 
 
+def _kernel_ab(arm, run_sync, on_tpu, **kw):
+    """Pallas-vs-XLA A/B of ONE registered kernel arm (docs/SPEC.md
+    §22.5): the same fused run timed under each env pin of the arm's
+    override var (ops/kernels.ARMS), per-leg guarded so a kernel
+    failure records an error string instead of eating the column.
+    Off-TPU the pallas pin means interpret mode — hours at bench
+    sizes — so the column carries the honest skip tag instead of a
+    meaningless number.  Callers pick operands INSIDE the arm's
+    eligibility cap; at the headline sizes the pin silently no-ops
+    and the A/B would time XLA against itself."""
+    from dr_tpu.ops import kernels
+    from dr_tpu.utils.env import env_override
+    if not on_tpu:
+        return {"note": "cpu mesh: pallas arm = interpret; A/B skipped"}
+    env_var = dict((a, e) for a, e, _, _, _ in kernels.ARMS)[arm]
+    res = {}
+    for mode in ("xla", "pallas"):
+        with env_override(**{env_var: mode}):
+            try:
+                dt = _marginal(run_sync, **kw)
+                res[f"{mode}_ms"] = round(dt * 1e3, 3)
+            except _JitterError as e:
+                res[f"{mode}_error"] = f"JitterError: {e}"[:120]
+            except Exception as e:  # pragma: no cover - defensive
+                res[f"{mode}_error"] = repr(e)[:120]
+    if "xla_ms" in res and "pallas_ms" in res:
+        res["winner"] = ("pallas" if res["pallas_ms"] < res["xla_ms"]
+                         else "xla")
+    return res
+
+
 def _time_amortized(dispatch, sync, calls=16, batches=3):
     """Median per-call time of ``calls`` async dispatches + ONE sync.
 
@@ -675,6 +706,29 @@ def _secondary_metrics(on_cpu: bool, on_tpu: bool,
                     out["sortkv_phase_dominant"] = bdk.dominant
             except Exception as e:  # pragma: no cover - defensive
                 out["sortkv_phases_error"] = repr(e)[:160]
+
+        # --phases also grows the sort_local kernel-arm A/B
+        # (docs/SPEC.md §22.5) — at a per-shard size INSIDE the
+        # bitonic eligibility cap (the headline n is far above it,
+        # where the pallas pin silently no-ops)
+        if phases:
+            va = None
+            try:
+                n_ab = 16384 * P
+                va = dr_tpu.distributed_vector(n_ab, np.float32)
+                va.assign_array(
+                    rng.standard_normal(n_ab).astype(np.float32))
+
+                def run_ab(r):
+                    sort_n(va, r)
+                    _sync(va)
+                out.setdefault("kernels", {})["sort_local"] = \
+                    _kernel_ab("sort_local", run_ab, on_tpu,
+                               r1=2, r2=6, samples=3)
+            except Exception as e:  # pragma: no cover - defensive
+                out["sort_kernel_ab_error"] = repr(e)[:120]
+            finally:
+                va = None
     except Exception as e:  # pragma: no cover - defensive
         out["sort_error"] = repr(e)[:160]
     finally:
@@ -966,6 +1020,44 @@ def _relational_metrics(on_cpu: bool) -> dict:
         out["relational_deferred_dispatches"] = dispatch_count() - d0
     except Exception as e:  # pragma: no cover - defensive
         out["relational_error"] = repr(e)[:160]
+
+    # kernel-arm A/Bs (docs/SPEC.md §22.5): the segred monoid core and
+    # the histogram scatter-add, each under both env pins at a
+    # kernel-eligible per-shard size (the pipeline's joined product is
+    # far above the §22 caps, where the pin silently no-ops).
+    # Independently guarded like every config here.
+    try:
+        P = dr_tpu.nprocs()
+        rng = np.random.default_rng(8)
+        nk = 8192 * P
+        gk = dr_tpu.distributed_vector.from_array(
+            rng.integers(0, 512, nk).astype(np.int32))
+        gv = dr_tpu.distributed_vector.from_array(
+            rng.integers(0, 99, nk).astype(np.int32))
+        ok = dr_tpu.distributed_vector(1024, np.int32)
+        ov = dr_tpu.distributed_vector(1024, np.int32)
+
+        def run_segred(r):
+            for _ in range(r):
+                dr_tpu.groupby_aggregate(gk, gv, ok, ov, agg="sum")
+            _sync(ov)
+        hv = dr_tpu.distributed_vector.from_array(
+            rng.standard_normal(nk).astype(np.float32))
+        hb = dr_tpu.distributed_vector(256, np.int32)
+
+        def run_hist(r):
+            for _ in range(r):
+                dr_tpu.histogram(hv, hb, -4.0, 4.0)
+            _sync(hb)
+        kerns = out.setdefault("kernels", {})
+        kerns["segred"] = _kernel_ab("segred", run_segred, not on_cpu,
+                                     r1=2, r2=6, samples=3)
+        kerns["hist"] = _kernel_ab("hist", run_hist, not on_cpu,
+                                   r1=2, r2=6, samples=3)
+    except Exception as e:  # pragma: no cover - defensive
+        out["kernels_error"] = repr(e)[:160]
+    finally:
+        gk = gv = ok = ov = hv = hb = None
     return out
 
 
@@ -1686,7 +1778,12 @@ def main():
         # CPU-fallback re-execs) and honors DR_TPU_BENCH_SECONDARY=0
         if "--relational" in sys.argv[1:] \
                 or env_flag("DR_TPU_BENCH_RELATIONAL"):
-            secondary.update(_relational_metrics(on_cpu))
+            rel = _relational_metrics(on_cpu)
+            # detail.kernels is shared with the --phases sort_local
+            # A/B — merge the sub-dict instead of clobbering it
+            if "kernels" in rel and "kernels" in secondary:
+                secondary["kernels"].update(rel.pop("kernels"))
+            secondary.update(rel)
         # redistribute config (round 16): host vs collective re-layout
         # ladder, opt-in (--redistribute / DR_TPU_BENCH_REDISTRIBUTE=1
         # — argv and env both survive the CPU-fallback re-execs) and

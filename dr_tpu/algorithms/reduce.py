@@ -111,8 +111,96 @@ def _fused_reduce_program(chains, kind, zip_op=None):
     return prog
 
 
+_KIND_TO_SEGRED = {"add": "sum", "mul": "prod", "min": "min",
+                   "max": "max"}
+
+
+def _storage_dtype(dtype):
+    """The dtype a declared container actually STORES: 64-bit declares
+    narrow to their 32-bit counterparts when x64 is off."""
+    dt = jnp.dtype(dtype)
+    if not jax.config.jax_enable_x64 and dt.itemsize == 8 \
+            and dt.kind in "iuf":
+        return jnp.dtype(dt.name.replace("64", "32"))
+    return dt
+
+
+def _reduce_kernel_decision(chains, kind, zip_op):
+    """The ``segred`` kernel-arm decision (docs/SPEC.md §22) for the
+    fused monoid reduce: the masked-compare Pallas kernel (one segment)
+    replaces the XLA vector reduce for PLAIN single-container chains
+    whose monoid is combine-order-free at the bit level — min/max over
+    any dtype, add/mul over exact (integer/bool) dtypes; float
+    accumulation is order-sensitive and stays on XLA.  View-chain ops
+    and zip combines can change the traced dtype, so they keep the XLA
+    route too."""
+    from ..ops import kernels, segred_pallas
+    from ._common import uniform_layout
+    if zip_op is not None or len(chains) != 1 or chains[0].ops:
+        return kernels.NO_KERNEL
+    c0 = chains[0]
+    if not uniform_layout(c0.cont.layout):
+        return kernels.NO_KERNEL  # uneven layouts carry size tuples
+    nshards, seg, prev, nxt, total_n = c0.cont.layout
+    width = prev + seg + nxt
+    dt = _storage_dtype(c0.cont.dtype)
+    kern = kernels.use_kernel(
+        "segred", runtime=c0.cont.runtime,
+        eligible=segred_pallas.eligible(
+            width, 1, ((dt, _KIND_TO_SEGRED[kind]),)))
+    if kern.use and not kern.interpret and dt.itemsize == 8:
+        return kernels.NO_KERNEL  # wide columns are interpret-only
+    return kern
+
+
+def _kernel_reduce_program(chain, kind, kern):
+    """The segred-arm twin of :func:`_fused_reduce_program`: one
+    shard_map program — per-shard masked kernel reduce (one segment) +
+    one all_gather and the same monoid fold over the p partials.  Exact
+    for every eligible monoid (see :func:`_reduce_kernel_decision`), so
+    bit-identical to the XLA route."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from ..ops import segred_pallas
+    c0 = chain
+    key = ("redk", c0.key, kind, tuple(kern))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
+    rt = c0.cont.runtime
+    layout, off, n = c0.cont.layout, c0.off, c0.n
+    vec_reduce, _ = _MONOIDS[kind]
+    op = _KIND_TO_SEGRED[kind]
+
+    def body(blk):
+        r = lax.axis_index(rt.axis)
+        mask, _gid = owned_window_mask(layout, off, n)
+        v = blk[0]
+        ident = _identity_for(kind, v.dtype)
+        masked = jnp.where(mask[r], v, ident)
+        seg0 = jnp.zeros((v.shape[0],), jnp.int32)
+        local = segred_pallas.segmented(
+            seg0, 1, ((masked, op),), interpret=kern.interpret)[0][0]
+        totals = lax.all_gather(local, rt.axis)      # (p,)
+        return vec_reduce(totals)
+
+    # check_vma=False: every shard folds the same gathered totals (the
+    # _custom_reduce_program precedent), and shard_map has no
+    # replication rule for pallas_call anyway
+    shm = jax.shard_map(body, mesh=rt.mesh,
+                        in_specs=(P(rt.axis, None),),
+                        out_specs=P(), check_vma=False)
+    prog = jax.jit(shm)
+    _prog_cache[key] = prog
+    return prog
+
+
 def _call_fused_reduce(chains, kind, zip_op=None):
     """Build + invoke the fused reduce with the BoundOp scalar tail."""
+    kern = _reduce_kernel_decision(chains, kind, zip_op)
+    if kern.use:
+        return _kernel_reduce_program(chains[0], kind, kern)(
+            chains[0].cont._data)
     scal = _chain_scalars(chains)
     if isinstance(zip_op, _v.BoundOp):
         scal = scal + list(zip_op.scalars)

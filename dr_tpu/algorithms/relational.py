@@ -114,7 +114,8 @@ from .elementwise import (_apply_chain_ops, _chain_scalars, _out_chain,
                           _plan_active, _prog_cache, _resolve,
                           _traced_op_key, copy as _copy)
 from .reduce import _identity_for
-from .sort import _decode, _encode
+from .sort import _decode, _encode, _kernel_key_dtype
+from ..ops import hist_pallas, kernels, segred_pallas
 from .. import obs as _obs
 from ..parallel.pipeline import fire_ppermute, ring_pipeline
 from ..utils import resilience as _resilience
@@ -349,15 +350,6 @@ def _groupby_program(mesh, axis, klayout, kdtype, vlayout, vdtype,
     ``vlayout`` is None for ``values=None`` (count), ``ov_layout``
     None for the keys-only form (``unique``).  ``nreal`` is the REAL
     element count (the scratch capacity is max(n, 1))."""
-    key = ("relgb", pinned_id(mesh), axis, klayout, str(kdtype),
-           vlayout, str(vdtype) if vlayout is not None else None,
-           ok_layout, str(ok_dtype),
-           ov_layout, str(ov_dtype) if ov_layout is not None else None,
-           agg, int(nreal), bool(jax.config.jax_enable_x64))
-    prog = _prog_cache.get(key)
-    if prog is not None:
-        return prog
-
     p, S, cap, prev, nxt, ncap, starts, sizes = \
         working_geometry(klayout)
     assert prev == 0 and nxt == 0 and cap == S, \
@@ -366,6 +358,36 @@ def _groupby_program(mesh, axis, klayout, kdtype, vlayout, vdtype,
     has_ov = ov_layout is not None
     acc = _acc_dtype(vdtype) if has_vals else jnp.int32
     nseg = S + 1
+
+    # segred kernel-arm decision (docs/SPEC.md §22): the masked-compare
+    # Pallas reduce replaces the jax.ops.segment_* scatter when picked.
+    # The monoid columns are EXACT both routes by construction — the
+    # key channel is a min, the count an int32 sum, and a float-
+    # accumulated sum/mean column makes the call ineligible (float
+    # addition is combine-order-sensitive).  64-bit columns (x64 key
+    # encodings, f64 accumulators) are interpret-only.
+    kdt = _kernel_key_dtype(kdtype)
+    cols_dt = [(kdt, "min"), (np.int32, "sum")]
+    if has_vals and agg in ("sum", "mean"):
+        cols_dt.append((acc, "sum"))
+    elif has_vals and agg in ("min", "max"):
+        cols_dt.append((acc, agg))
+    kern = kernels.use_kernel(
+        "segred", kernels.mesh_platform(mesh),
+        eligible=segred_pallas.eligible(S, nseg, cols_dt))
+    if kern.use and not kern.interpret and any(
+            jnp.dtype(dt).itemsize == 8 for dt, _ in cols_dt):
+        kern = kernels.NO_KERNEL  # wide columns are interpret-only
+
+    key = ("relgb", pinned_id(mesh), axis, klayout, str(kdtype),
+           vlayout, str(vdtype) if vlayout is not None else None,
+           ok_layout, str(ok_dtype),
+           ov_layout, str(ov_dtype) if ov_layout is not None else None,
+           agg, int(nreal), tuple(kern),
+           bool(jax.config.jax_enable_x64))
+    prog = _prog_cache.get(key)
+    if prog is not None:
+        return prog
 
     def body(kblk, *rest):
         r = lax.axis_index(axis)
@@ -395,21 +417,49 @@ def _groupby_program(mesh, axis, klayout, kdtype, vlayout, vdtype,
         # the bucketed scatter-add of the reduce path.  My segment j
         # holds global group id gid_off - 1 + j (segment 0 continues
         # the previous shard's open group).
-        pkey = jax.ops.segment_min(jnp.where(valid, kenc, big), segid,
-                                   num_segments=nseg)
-        pcnt = jax.ops.segment_sum(valid.astype(jnp.int32), segid,
-                                   num_segments=nseg)
-        if has_vals:
-            vacc = rest[0][0].astype(acc)
-            psum_ = jax.ops.segment_sum(
-                jnp.where(valid, vacc, jnp.zeros((), acc)), segid,
-                num_segments=nseg)
-            pmin = jax.ops.segment_min(
-                jnp.where(valid, vacc, _identity_for("min", acc)),
-                segid, num_segments=nseg)
-            pmax = jax.ops.segment_max(
-                jnp.where(valid, vacc, _identity_for("max", acc)),
-                segid, num_segments=nseg)
+        if kern.use:
+            # ONE masked-compare kernel call computes exactly the
+            # columns this agg reads (the XLA route computes all and
+            # lets dead-code elimination drop the rest)
+            cols = [(jnp.where(valid, kenc, big), "min"),
+                    (valid.astype(jnp.int32), "sum")]
+            if has_vals:
+                vacc = rest[0][0].astype(acc)
+                if agg in ("sum", "mean"):
+                    cols.append((jnp.where(valid, vacc,
+                                           jnp.zeros((), acc)), "sum"))
+                elif agg == "min":
+                    cols.append((jnp.where(
+                        valid, vacc, _identity_for("min", acc)), "min"))
+                elif agg == "max":
+                    cols.append((jnp.where(
+                        valid, vacc, _identity_for("max", acc)), "max"))
+            res = segred_pallas.segmented(
+                segid.astype(jnp.int32), nseg, tuple(cols),
+                interpret=kern.interpret)
+            pkey, pcnt = res[0], res[1]
+            if has_vals and agg in ("sum", "mean"):
+                psum_ = res[2]
+            elif has_vals and agg == "min":
+                pmin = res[2]
+            elif has_vals and agg == "max":
+                pmax = res[2]
+        else:
+            pkey = jax.ops.segment_min(jnp.where(valid, kenc, big),
+                                       segid, num_segments=nseg)
+            pcnt = jax.ops.segment_sum(valid.astype(jnp.int32), segid,
+                                       num_segments=nseg)
+            if has_vals:
+                vacc = rest[0][0].astype(acc)
+                psum_ = jax.ops.segment_sum(
+                    jnp.where(valid, vacc, jnp.zeros((), acc)), segid,
+                    num_segments=nseg)
+                pmin = jax.ops.segment_min(
+                    jnp.where(valid, vacc, _identity_for("min", acc)),
+                    segid, num_segments=nseg)
+                pmax = jax.ops.segment_max(
+                    jnp.where(valid, vacc, _identity_for("max", acc)),
+                    segid, num_segments=nseg)
 
         def assemble(layout, partial, ident, combine):
             """Re-home per-run partials into ``layout``'s windows: one
@@ -1845,13 +1895,24 @@ def unique_auto(r):
 # histogram
 # ---------------------------------------------------------------------------
 
+def _hist_kernel_decision(mesh, in_layout, bins):
+    """The ``hist`` kernel-arm decision (docs/SPEC.md §22) for one
+    histogram program shape — shared by the eager program and the
+    deferred-plan record so both key their caches on it."""
+    _p, _S, cap, prev, nxt, _n, _st, _sz = working_geometry(in_layout)
+    return kernels.use_kernel(
+        "hist", kernels.mesh_platform(mesh),
+        eligible=hist_pallas.eligible(prev + cap + nxt, int(bins)))
+
+
 def _histogram_body(axis, in_layout, off, n, ops, nsc, out_layout,
-                    bins, out_dtype):
+                    bins, out_dtype, kern=kernels.NO_KERNEL):
     """The histogram shard body — shared verbatim between the eager
     program below and the deferred-plan fusible emit
     (``plan.record_histogram``).  ``scalars`` = the view chain's
     BoundOp values then (lo, hi), all TRACED (a streamed range reuses
-    one program)."""
+    one program).  ``kern`` routes the bucketed scatter-add through
+    the ``hist`` Pallas arm (exact: integer sums)."""
     So, starts_c, _sizes = _dest_geometry(out_layout)
 
     def body(blk, *scalars):
@@ -1871,9 +1932,13 @@ def _histogram_body(axis, in_layout, off, n, ops, nsc, out_layout,
             .astype(jnp.int32)
         inr = mask[r] & (xv >= lov) & (xv <= hiv)
         bc = jnp.clip(jnp.where(inr, b, 0), 0, bins - 1)
-        local = jax.ops.segment_sum(
-            jnp.where(inr, 1, 0).astype(jnp.int32), bc,
-            num_segments=bins)
+        cnt = jnp.where(inr, 1, 0).astype(jnp.int32)
+        if kern.use:
+            local = hist_pallas.bincount(bc.astype(jnp.int32), cnt,
+                                         bins,
+                                         interpret=kern.interpret)
+        else:
+            local = jax.ops.segment_sum(cnt, bc, num_segments=bins)
         total = lax.psum(local, axis)                  # (bins,)
         t = starts_c[r] + jnp.arange(So)
         live = t < bins
@@ -1889,17 +1954,21 @@ def _histogram_body(axis, in_layout, off, n, ops, nsc, out_layout,
 def _histogram_program(mesh, axis, in_layout, off, n, in_dtype, ops,
                        out_layout, out_dtype, bins):
     nsc = sum(len(o.scalars) for o in ops if isinstance(o, _v.BoundOp))
+    kern = _hist_kernel_decision(mesh, in_layout, bins)
     key = ("relhist", pinned_id(mesh), axis, in_layout, off, n,
            str(in_dtype), tuple(_traced_op_key(o) for o in ops),
-           out_layout, str(out_dtype), int(bins))
+           out_layout, str(out_dtype), int(bins), tuple(kern))
     prog = _prog_cache.get(key)
     if prog is not None:
         return prog
     body = _histogram_body(axis, in_layout, off, n, ops, nsc,
-                           out_layout, bins, out_dtype)
+                           out_layout, bins, out_dtype, kern=kern)
+    # check_vma=False under the kernel arm: shard_map has no
+    # replication rule for pallas_call
     shm = jax.shard_map(body, mesh=mesh,
                         in_specs=(P(axis, None),) + (P(),) * (nsc + 2),
-                        out_specs=P(axis, None))
+                        out_specs=P(axis, None),
+                        check_vma=not kern.use)
     prog = jax.jit(shm)
     _prog_cache[key] = prog
     return prog

@@ -96,25 +96,26 @@ def _blocked_scan(combine, x, ident, kind=None):
     return combine(carry[:, None], rs).reshape(-1)[:n]
 
 
-def _use_scan_kernel(layout, kind, in_dtype, runtime) -> bool:
-    """The single-pass Pallas chunked cumsum serves the hot case: add-
-    scan over f32-accumulable INPUT data (f32/bf16/f16 — the kernel
-    accumulates in f32, so integer exactness and f64 precision must
-    take the XLA path), TPU backend, lane-chunkable segment.
-    ``DR_TPU_SCAN_IMPL=xla`` forces the XLA matmul-cumsum."""
-    if env_str("DR_TPU_SCAN_IMPL").lower() == "xla":
-        return False
-    from ..ops import scan_pallas
-    from ._common import f32_accumulable, on_tpu
-    if not uniform_layout(layout):  # the kernel tiles uniform rows only
-        return False
-    nshards, seg, prev, nxt, n = layout
-    if not f32_accumulable(in_dtype):
-        return False
-    return (kind == "add"
-            and scan_pallas.supported()
-            and on_tpu(runtime)
-            and scan_pallas.pick_chunk(seg) is not None)
+def _use_scan_kernel(layout, kind, in_dtype, runtime):
+    """The ``scan`` kernel-arm decision (docs/SPEC.md §22) — ONE
+    decision point through the arm registry (``ops/kernels.use_kernel``:
+    ``DR_TPU_SCAN_IMPL`` pin > tuning-DB winner > auto-by-platform)
+    instead of the old per-call flag checks.  Eligibility is the
+    single-pass Pallas chunked cumsum's hot case: add-scan over
+    f32-accumulable INPUT data (f32/bf16/f16 — the kernel accumulates
+    in f32, so integer exactness and f64 precision must take the XLA
+    path), uniform lane-chunkable layout.  Returns a
+    :class:`..ops.kernels.Decision`; ``DR_TPU_SCAN_IMPL=pallas`` on a
+    CPU mesh runs the kernel in interpret mode (the parity battery's
+    route)."""
+    from ..ops import kernels, scan_pallas
+    from ._common import f32_accumulable
+    eligible = (uniform_layout(layout)  # the kernel tiles uniform rows
+                and f32_accumulable(in_dtype)
+                and kind == "add"
+                and scan_pallas.pick_chunk(layout[1]) is not None)
+    return kernels.use_kernel("scan", runtime=runtime,
+                              eligible=eligible)
 
 
 def _kernel_variant():
@@ -129,7 +130,7 @@ def _kernel_variant():
 
 
 def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
-                  use_kernel=False, window=None, aliased=False,
+                  use_kernel=None, window=None, aliased=False,
                   ops=(), out_layout=None, out_window=None):
     """``window=(off, wn)`` scans ONLY the logical subrange (round 4):
     with an identity op, the window scan IS the whole-container scan of
@@ -156,10 +157,12 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
       realign from the in-window's per-shard geometry to the
       out-window's by one static masked all_to_all (the sort family's
       rebalance pattern) and blend through the OUT container's mask."""
+    from ..ops import kernels
+    kern = use_kernel if use_kernel is not None else kernels.NO_KERNEL
     mismatched = out_window is not None
     key = ("scan", pinned_id(mesh), axis, layout, kind, _op_key(op) if kind is None
-           else None, exclusive, str(dtype), use_kernel,
-           _kernel_variant() if use_kernel else None, window, aliased,
+           else None, exclusive, str(dtype), tuple(kern),
+           _kernel_variant() if kern.use else None, window, aliased,
            tuple(_traced_op_key(f) for f in ops), out_layout, out_window)
     prog = _prog_cache.get(key)
     if prog is not None:
@@ -235,7 +238,7 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             nvalid = jnp.minimum(sizes_c[r],
                                  jnp.clip(n - starts_c[r], 0, S))
             x = jnp.where(jnp.arange(S) < nvalid, x, ident)
-        if use_kernel:
+        if kern.use:
             # carry-seeded kernel: compute each shard's TOTAL first (a
             # cheap reduction read), fold the preceding totals, and
             # hand the carry to the kernel — the scan itself is then
@@ -243,7 +246,8 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
             # third whole-array pass for the carry fixup
             from ..ops import scan_pallas
             if nshards == 1:
-                scanned = scan_pallas.chunked_cumsum(x)
+                scanned = scan_pallas.chunked_cumsum(
+                    x, interpret=kern.interpret)
             else:
                 # f32 totals regardless of input dtype: the kernel's
                 # carry seed is f32, and a bf16-rounded cross-shard
@@ -253,7 +257,8 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                 masked = jnp.where(jnp.arange(nshards) < r, totals,
                                    jnp.zeros((), totals.dtype))
                 carry = jnp.sum(masked)
-                scanned = scan_pallas.chunked_cumsum(x, carry=carry)
+                scanned = scan_pallas.chunked_cumsum(
+                    x, carry=carry, interpret=kern.interpret)
         else:
             local = _blocked_scan(combine, x,
                                   ident if kind is not None else None,
@@ -312,7 +317,7 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                         upto=r)
                     scanned = jnp.where(r > first_nz,
                                         combine(ue_carry, local), local)
-        if exclusive and (use_kernel or kind is None):
+        if exclusive and (kern.use or kind is None):
             if kind is None and (wgeom or not
                                  (exact or uniform_layout(layout))):
                 # uneven identityless: my first exclusive value is the
@@ -390,7 +395,7 @@ def _scan_program(mesh, axis, layout, kind, op, exclusive, dtype,
                              in_specs=(P(axis, None),) * nin
                              + (P(),) * nsc,
                              out_specs=P(axis, None),
-                             check_vma=not use_kernel)
+                             check_vma=not kern.use)
     # donate the OUT buffer the window blend rebinds (the aliased form
     # donates its single in/out row)
     donate = () if window is None else ((0,) if aliased else (1,))
@@ -479,8 +484,10 @@ def _scan(in_r, out, op, init, exclusive):
         # length comes from window_geometry and is generally not
         # lane-aligned — chunked_cumsum's pick_chunk assertion would
         # crash at trace time on TPU.
-        use_kernel = (not c.ops) and not mis_ok and _use_scan_kernel(
-            c.cont.layout, kind, c.cont.dtype, c.cont.runtime)
+        from ..ops import kernels
+        use_kernel = _use_scan_kernel(
+            c.cont.layout, kind, c.cont.dtype, c.cont.runtime) \
+            if (not c.ops) and not mis_ok else kernels.NO_KERNEL
         prog = _scan_program(
             mesh, c.cont.runtime.axis, c.cont.layout, kind, op,
             exclusive, dt, use_kernel=use_kernel,
@@ -573,8 +580,8 @@ def inclusive_scan_n(in_v, out, iters: int):
     use_kernel = _use_scan_kernel(c.cont.layout, "add", c.cont.dtype,
                                   c.cont.runtime)
     key = ("scan_n", pinned_id(mesh), c.cont.layout, str(dtype),
-           int(iters), use_kernel,
-           _kernel_variant() if use_kernel else None)
+           int(iters), tuple(use_kernel),
+           _kernel_variant() if use_kernel.use else None)
     prog = _prog_cache.get(key)
     if prog is None:
         one = _scan_program(

@@ -94,6 +94,7 @@ from ._common import (owned_window_mask, window_geometry,
 from .elementwise import (_apply_chain_ops, _chain_scalars, _out_chain,
                           _prog_cache, _resolve, _traced_op_key)
 from ..core.pinning import pinned_id
+from ..ops import kernels, sort_pallas
 from ..views import views as _v
 
 __all__ = ["sort", "sort_by_key", "argsort", "is_sorted",
@@ -170,6 +171,23 @@ def _encode(x, distinct_zeros=False):
     return x, jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
 
 
+def _kernel_key_dtype(dtype):
+    """Static mirror of :func:`_encode`'s output dtype for the ACTUAL
+    array storage (declared 64-bit containers store 32-bit when x64 is
+    off) — the sort_local kernel arm's eligibility is decided before
+    any array exists."""
+    dt = jnp.dtype(dtype)
+    x64 = bool(jax.config.jax_enable_x64)
+    if jnp.issubdtype(dt, jnp.floating):
+        return np.dtype(np.uint64) \
+            if (dt == jnp.dtype(np.float64) and x64) \
+            else np.dtype(np.uint32)
+    ndt = np.dtype(dt.name) if dt.kind in "iub" else np.dtype(dt)
+    if ndt.kind in "iu" and ndt.itemsize == 8 and not x64:
+        ndt = np.dtype(ndt.name.replace("64", "32"))
+    return ndt
+
+
 def _decode(k, dtype):
     """Inverse of :func:`_encode` (NaN payload/sign canonicalized);
     the key WIDTH picks the float branch — a declared-f64 container on
@@ -235,10 +253,23 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         if stop_after == phases[-1]:
             stop_after = None  # the full program IS the last phase
     stable = _stable_override()
+    # kernel-arm decision (docs/SPEC.md §22): the sort_local Pallas
+    # bitonic replaces phase 1's lax.sort when picked.  Resolved HERE,
+    # before the cache lookup, so the pick is part of the program's
+    # identity and the kernel.build fault site fires per dispatch.
+    kdt = _kernel_key_dtype(dtype)
+    S_el = (working_geometry(layout)[1] if window is None
+            else window_geometry(layout, *window)[1])
+    kern = kernels.use_kernel(
+        "sort_local", kernels.mesh_platform(mesh),
+        eligible=sort_pallas.eligible(S_el, kdt, interpret=True))
+    if kern.use and not sort_pallas.eligible(S_el, kdt,
+                                             interpret=kern.interpret):
+        kern = kernels.NO_KERNEL  # wide keys are interpret-only
     key = ("sort", pinned_id(mesh), axis, layout, str(dtype),
            bool(descending), pay_layout,
            str(pay_dtype) if pay_layout else None, window, pay_window,
-           aliased, stop_after, stable,
+           aliased, stop_after, stable, tuple(kern),
            # x64 state changes the traced key width for declared-f64
            # containers (uint32 under x64-off, uint64 under x64-on)
            bool(jax.config.jax_enable_x64))
@@ -404,15 +435,29 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         # to the dtype-max pad sentinel would otherwise let a pad
         # displace the real element in the merge; (b) key ties keep
         # original global order exactly (numpy-stable).
-        if pay:
-            vals = (kv, jnp.where(local_ok, gid, GMAX).astype(
-                jnp.int32))
+        if kern.use:
+            # the on-chip bitonic (ops/sort_pallas) — keys-only output
+            # equals lax.sort on the encoding (equal keys are bit-
+            # identical), KV output equals it under EITHER stability
+            # flag (the (key, gid) pair order is total)
+            if pay:
+                xs, gs = sort_pallas.sort_kv(
+                    kv, jnp.where(local_ok, gid, GMAX).astype(
+                        jnp.int32), interpret=kern.interpret)
+            else:
+                xs = sort_pallas.sort_keys(kv,
+                                           interpret=kern.interpret)
+                gs = None
         else:
-            vals = (kv,)
-        srt = lax.sort(vals, dimension=0, num_keys=len(vals),
-                       is_stable=stable)
-        xs = srt[0]
-        gs = srt[1] if pay else None
+            if pay:
+                vals = (kv, jnp.where(local_ok, gid, GMAX).astype(
+                    jnp.int32))
+            else:
+                vals = (kv,)
+            srt = lax.sort(vals, dimension=0, num_keys=len(vals),
+                           is_stable=stable)
+            xs = srt[0]
+            gs = srt[1] if pay else None
         if stop_after == "local_sort":
             # value-mix the secondary channel in so XLA cannot narrow
             # the variadic sort to a single-operand one
@@ -542,10 +587,13 @@ def _sort_program(mesh, axis, layout, dtype, descending,
         return finish(kreb, pay_gather(gperm))
 
     nin = 1 if pay_layout is None or aliased else 2
+    # check_vma=False under the kernel arm: shard_map has no
+    # replication rule for pallas_call (the scan kernel's precedent)
     shmapped = jax.shard_map(
         body, mesh=mesh, in_specs=(P(axis, None),) * nin,
         out_specs=P(axis, None) if pay_layout is None or aliased
-        else (P(axis, None),) * 2)
+        else (P(axis, None),) * 2,
+        check_vma=not kern.use)
     # in-place rebind: donate the input buffers like the other in-place
     # cached programs (elementwise/gemv/stencil)
     prog = jax.jit(shmapped, donate_argnums=tuple(range(nin)))

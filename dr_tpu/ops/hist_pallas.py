@@ -1,0 +1,36 @@
+"""Pallas TPU kernel arm: histogram bucketed scatter-add.
+
+The per-shard half of ``histogram`` (docs/SPEC.md §17) is a bincount —
+``segment_sum`` of int32 0/1 counts over clipped bucket ids — i.e. ONE
+integer-sum column of the masked-compare segmented reduce.  This
+module is the thin arm wrapper over ``segred_pallas`` so the histogram
+seam registers and tunes independently (``DR_TPU_HIST_IMPL`` — bucket
+counts have their own size/shape regime) while sharing one kernel
+body.  Integer sums are exact under any combine order, so the arm is
+bit-identical to the scatter route for every input.
+
+Arm registration: ``ops/kernels.py`` (``hist``, ``DR_TPU_HIST_IMPL``);
+the XLA fallback is ``jax.ops.segment_sum``.
+"""
+
+from __future__ import annotations
+
+from . import segred_pallas
+
+__all__ = ["supported", "eligible", "bincount"]
+
+
+def supported() -> bool:
+    return segred_pallas.supported()
+
+
+def eligible(n: int, bins: int) -> bool:
+    import jax.numpy as jnp
+    return segred_pallas.eligible(n, bins, ((jnp.int32, "sum"),))
+
+
+def bincount(bucket, counts, bins: int, *, interpret: bool = False):
+    """Sum int32 ``counts`` into ``bins`` buckets keyed by int32
+    ``bucket`` ids; out-of-range ids contribute nothing."""
+    return segred_pallas.segmented(
+        bucket, bins, ((counts, "sum"),), interpret=interpret)[0]

@@ -1,0 +1,138 @@
+"""On-chip kernel-arm registry (docs/SPEC.md §22).
+
+The per-shard hot loops of the sort/scan/segmented-reduce backbone each
+have TWO lowerings: the portable XLA route (always present, always
+correct — the fallback by construction) and a hand-written Pallas
+kernel (``sort_pallas`` / ``segred_pallas`` / ``hist_pallas`` /
+``scan_pallas``).  This module is the ONE decision point between them:
+every dispatch seam calls :func:`use_kernel` and bakes the returned
+:class:`Decision` into its program-cache key, so a changed arm pick is
+a different cached program, never a silent retrace.
+
+Selection precedence is the §21 rule — explicit env pin
+(``auto|pallas|xla``) > persisted tuning-DB winner (``kernels.<arm>``,
+written by ``tune_tpu.py kernels``) > code default ``auto``.  ``auto``
+resolves by platform: Pallas on TPU when the call is eligible, XLA
+everywhere else.  A ``pallas`` pin is FORCED — on a CPU mesh it runs
+the kernel in Pallas interpret mode, which is how tier-1 and the fuzz
+crank execute the real kernel bodies without silicon
+(``test_fuzz_kernel_parity``).
+
+Every decision fires the ``kernel.build`` fault site first; an armed
+classified fault degrades the call to the XLA route (warn_fallback,
+never a crash) — kernels are an OPTIMIZATION tier, the portable
+lowering is the contract.
+
+The ``ARMS`` table is a pure literal on purpose: ``tools/drlint.py``
+R8 AST-parses it (the R7 plan-pass-registry pattern) and checks each
+arm's env override, fallback declaration, fault-site guard, SPEC §22.1
+row, and fuzz-parity coverage without importing jax.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+from ..utils.env import env_str
+from ..utils.fallback import warn_fallback
+
+__all__ = ["ARMS", "ARM_NAMES", "Decision", "NO_KERNEL", "use_kernel",
+           "mesh_platform"]
+
+# (arm, env override, kernel module, xla fallback, fault site) — one row
+# per registered kernel arm; R8 closes this table against the kernel
+# modules, the env registry, faults.SITES, and SPEC §22.1.
+ARMS = (
+    ("sort_local", "DR_TPU_SORT_LOCAL", "sort_pallas",
+     "lax.sort", "kernel.build"),
+    ("segred", "DR_TPU_SEGRED_IMPL", "segred_pallas",
+     "jax.ops.segment_*", "kernel.build"),
+    ("hist", "DR_TPU_HIST_IMPL", "hist_pallas",
+     "jax.ops.segment_sum", "kernel.build"),
+    ("scan", "DR_TPU_SCAN_IMPL", "scan_pallas",
+     "matmul-cumsum", "kernel.build"),
+)
+
+ARM_NAMES = tuple(a[0] for a in ARMS)
+
+_MODES = ("auto", "pallas", "xla")
+
+# Literal env reads per arm: drlint R2's env inventory only sees
+# constant first arguments, so each registered override is spelled out.
+_ENV_READERS = {
+    "sort_local": lambda: env_str("DR_TPU_SORT_LOCAL"),
+    "segred": lambda: env_str("DR_TPU_SEGRED_IMPL"),
+    "hist": lambda: env_str("DR_TPU_HIST_IMPL"),
+    "scan": lambda: env_str("DR_TPU_SCAN_IMPL"),
+}
+
+
+class Decision(NamedTuple):
+    """One resolved arm pick.  NOTE: a NamedTuple is always truthy —
+    branch on ``.use``, and key program caches on ``tuple(decision)``."""
+    use: bool
+    interpret: bool
+
+
+NO_KERNEL = Decision(False, False)
+
+
+def mesh_platform(mesh) -> str:
+    """The mesh's device platform ("cpu"/"tpu") — program builders hold
+    a mesh, not the runtime."""
+    return mesh.devices.flat[0].platform
+
+
+def _supported(arm: str) -> bool:
+    from . import hist_pallas, scan_pallas, segred_pallas, sort_pallas
+    mod = {"sort_local": sort_pallas, "segred": segred_pallas,
+           "hist": hist_pallas, "scan": scan_pallas}[arm]
+    return mod.supported()
+
+
+def _mode(arm: str) -> str:
+    """env pin > tuning-DB winner > ``auto`` (tolerant: junk values in
+    either source mean ``auto``, the §21 picker discipline)."""
+    raw = _ENV_READERS[arm]().strip().lower()
+    if raw in _MODES:
+        return raw
+    if raw:
+        return "auto"
+    from .. import tuning as _tuning
+    v = _tuning.lookup("kernels", arm)
+    if isinstance(v, str) and v.strip().lower() in _MODES:
+        return v.strip().lower()
+    return "auto"
+
+
+def use_kernel(arm: str, platform: Optional[str] = None, *,
+               runtime=None, eligible: bool = True) -> Decision:
+    """Resolve one kernel-arm decision.
+
+    ``platform`` is the mesh's device platform string; pass ``runtime``
+    instead where one is handy.  ``eligible`` carries the arm-specific
+    static eligibility (size caps, dtype support, layout shape) the
+    caller computed — an ineligible call is XLA under every mode, a
+    ``pallas`` pin included (the pin forces the kernel where it CAN
+    run, it does not extend where it can).
+
+    Fires the ``kernel.build`` fault site on EVERY decision (the chaos
+    battery reaches it through any sort/groupby/histogram call); an
+    armed classified fault degrades to the XLA route."""
+    assert arm in _ENV_READERS, f"unregistered kernel arm {arm!r}"
+    from ..utils import faults, resilience
+    try:
+        faults.fire("kernel.build", arm=arm)
+    except resilience.ResilienceError as e:
+        warn_fallback("kernels", f"{arm} kernel build faulted "
+                                 f"({type(e).__name__}); xla route")
+        return NO_KERNEL
+    mode = _mode(arm)
+    if mode == "xla" or not eligible or not _supported(arm):
+        return NO_KERNEL
+    if platform is None:
+        platform = runtime.devices[0].platform
+    on_tpu = platform == "tpu"
+    if mode == "pallas":
+        return Decision(True, not on_tpu)
+    return Decision(on_tpu, False)

@@ -1,0 +1,158 @@
+"""Pallas TPU kernel: vectorized masked segmented reduce.
+
+``jax.ops.segment_*`` lowers to a scatter — serialized combines through
+HBM.  On-chip the same reduction is a masked COMPARE: the input block
+(values + int32 segment ids) sits in VMEM once, the grid walks 128-wide
+output-segment tiles, and each step builds the ``(128, n)`` membership
+mask ``segid == tile_base + lane`` and reduces every requested monoid
+column along the element axis — pure VPU work, no scatter, no HBM
+round trip per segment.  Segment ids need NOT be sorted (histogram's
+bucket ids reuse this kernel as-is); ids outside ``[0, nseg)``
+(including the pad fill ``-1``) match no tile and contribute nothing.
+
+Bit-identity to the XLA route: per segment both routes combine the SAME
+multiset of elements with the same monoid — exact whenever the monoid
+is combine-order-free at the bit level.  min/max are (any dtype —
+identities and NaN/±0 select behavior verified equal to the
+``segment_min``/``segment_max`` scatter); integer/bool sum and prod are
+(modular); FLOAT sum/prod are NOT (association changes rounding), so
+callers must not route float additive columns here — the dispatch
+seams encode that in their eligibility, and :func:`eligible` enforces
+it.  Empty segments produce the same identities the scatter route
+fills with (+inf/max for min, -inf/lowest for max, 0 for sum, 1 for
+prod).
+
+Arm registration: ``ops/kernels.py`` (``segred``,
+``DR_TPU_SEGRED_IMPL``); the XLA fallback is ``jax.ops.segment_*``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from .stencil_pallas import _HAS_PLTPU, pltpu
+
+__all__ = ["supported", "eligible", "segmented", "OPS"]
+
+LANES = 128
+#: input/segment-count cap: the (128, n) membership mask is the VMEM
+#: footprint (n * 512 B at the cap) and the mask rebuild is O(nseg/128)
+#: passes over the block — past this the scatter's O(n) wins.
+_MAX_N = 1 << 15
+
+OPS = ("sum", "prod", "min", "max")
+
+#: monoids whose combine is bit-order-free only over exact dtypes:
+#: float columns are ineligible for these (association changes
+#: rounding); min/max are order-free for every dtype.
+_EXACT_ONLY = ("sum", "prod")
+
+
+def supported() -> bool:
+    return _HAS_PLTPU
+
+
+def eligible(n: int, nseg: int, cols) -> bool:
+    """``cols`` is a sequence of ``(dtype, op)`` monoid columns."""
+    if n < 1 or n > _MAX_N or nseg < 1 or nseg > _MAX_N:
+        return False
+    for dt, op in cols:
+        if op not in OPS:
+            return False
+        kind = np.dtype(jnp.dtype(dt).name).kind
+        if op in _EXACT_ONLY and kind not in "iub":
+            return False
+    return True
+
+
+def _identity(op: str, dtype):
+    dt = jnp.dtype(dtype)
+    if op == "sum":
+        return jnp.zeros((), dt)
+    if op == "prod":
+        return jnp.ones((), dt)
+    if jnp.issubdtype(dt, jnp.inexact):
+        v = jnp.inf if op == "min" else -jnp.inf
+        return jnp.asarray(v, dt)
+    info = np.iinfo(np.dtype(dt.name))
+    return jnp.asarray(info.max if op == "min" else info.min, dt)
+
+
+@functools.lru_cache(maxsize=32)
+def _build(n_pad: int, ntiles: int, specs, interpret: bool):
+    """``specs``: tuple of (dtype name, op) output columns.  Inputs are
+    (1, n_pad) rows re-streamed whole per tile; outputs are
+    (ntiles, 128) with one row per grid step."""
+
+    def kernel(sid_ref, *refs):
+        ncols = len(specs)
+        t = pl.program_id(0)
+        sid = sid_ref[...]                              # (1, n_pad)
+        seg = t * LANES + lax.broadcasted_iota(
+            jnp.int32, (LANES, 1), 0)
+        m = sid == seg                                  # (128, n_pad)
+        for i, (dtn, op) in enumerate(specs):
+            v = refs[i][...]                            # (1, n_pad)
+            ident = _identity(op, jnp.dtype(dtn))
+            masked = jnp.where(m, v, ident)
+            if op == "sum":
+                r = jnp.sum(masked, axis=1)
+            elif op == "prod":
+                r = jnp.prod(masked, axis=1)
+            elif op == "min":
+                r = jnp.min(masked, axis=1)
+            else:
+                r = jnp.max(masked, axis=1)
+            refs[ncols + i][...] = r.reshape(1, LANES)
+
+    full = pl.BlockSpec((1, n_pad), lambda t: (0, 0))
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20,
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        kernel,
+        grid=(ntiles,),
+        in_specs=[full] * (1 + len(specs)),
+        out_specs=[pl.BlockSpec((1, LANES), lambda t: (t, 0))
+                   for _ in specs],
+        out_shape=[jax.ShapeDtypeStruct((ntiles, LANES), jnp.dtype(dtn))
+                   for dtn, _ in specs],
+        interpret=interpret,
+        **params,
+    )
+
+
+def segmented(segid, nseg: int, cols, *, interpret: bool = False):
+    """Segmented reduce of every ``(values, op)`` column in ``cols``
+    over int32 ``segid`` into ``nseg`` segments; returns a tuple of
+    ``(nseg,)`` arrays.  Ids outside ``[0, nseg)`` contribute nothing.
+    Caller checks :func:`eligible` first."""
+    n = segid.shape[0]
+    n_pad = -(-n // LANES) * LANES
+    if n_pad > n:
+        # pad ids with -1: matches no output tile
+        segid = jnp.concatenate(
+            [segid, jnp.full((n_pad - n,), np.int32(-1), jnp.int32)])
+    ntiles = -(-nseg // LANES)
+    specs = tuple((str(v.dtype), op) for v, op in cols)
+    vals = []
+    for v, op in cols:
+        if n_pad > n:
+            v = jnp.concatenate(
+                [v, jnp.full((n_pad - n,), _identity(op, v.dtype),
+                             v.dtype)])
+        vals.append(v.reshape(1, n_pad))
+    outs = _build(n_pad, ntiles, specs, interpret)(
+        segid.reshape(1, n_pad), *vals)
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    return tuple(o.reshape(ntiles * LANES)[:nseg] for o in outs)

@@ -1,0 +1,204 @@
+"""Pallas TPU kernel: on-chip bitonic sort of one shard's key block.
+
+The sample sort's LOCAL phase (``algorithms/sort.py`` phase 1) is the
+profiled hot loop — ``lax.sort`` round-trips HBM per merge level, while
+a shard's key block fits VMEM outright.  This kernel runs the whole
+bitonic network on-chip: the block is viewed ``(M/128, 128)``
+lane-blocked, every compare-exchange stage is one vectorized
+min/max/select over the full tile, and the two partner mechanisms map
+to the two on-chip data paths — stride ``j >= 128`` partners are a
+leading-axis regroup ``(B, 2, j/128, 128)`` + half-swap (sublane
+shuffle), stride ``j < 128`` partners are a lane roll (``pltpu.roll``)
+masked by the butterfly direction.  The roll has no wraparound hazard:
+a lane with bit ``j`` clear rolls down to ``lane + j < 128`` (no
+carry), a lane with bit ``j`` set rolls up within the same 128 block.
+
+Variants: keys-only, and key+index (the payload plan's ``gid``
+channel).  The KV compare uses the FULL pair order ``(key, gid)`` —
+valid gids are distinct, pad pairs are bitwise-identical — a total
+order, so the network's output is THE unique sorted sequence and
+matches ``lax.sort(num_keys=2)`` under either stability flag
+bit-for-bit.  Keys-only sorts the monotone total-order ENCODING
+(equal keys are bit-identical), so any comparison sort agrees.
+
+Padding: blocks pad to a power of two with the dtype's maximum (the
+encoding's ``big`` / the caller's pad key), which sorts to the tail
+and slices off — the multiset is preserved, so bit-identity to the
+XLA route survives the pad/slice round trip.
+
+Arm registration: ``ops/kernels.py`` (``sort_local``,
+``DR_TPU_SORT_LOCAL``); the XLA fallback is ``lax.sort``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from .stencil_pallas import _HAS_PLTPU, pltpu
+
+__all__ = ["supported", "eligible", "sort_keys", "sort_kv"]
+
+LANES = 128
+#: eligibility cap on the PADDED block: the network is O(M log^2 M)
+#: compare-exchanges, statically unrolled — past this the XLA sort's
+#: better asymptotics (and Mosaic's program size) win.  The queued
+#: silicon ladder (tune_tpu.py kernels) is the empirical arbiter.
+_MAX_ELEMS = 1 << 15
+
+
+def supported() -> bool:
+    return _HAS_PLTPU
+
+
+def _padded(n: int) -> int:
+    m = 2 * LANES
+    while m < n:
+        m *= 2
+    return m
+
+
+def eligible(n: int, key_dtype, *, interpret: bool = False) -> bool:
+    """Static per-call eligibility: size within the VMEM/unroll cap and
+    a key dtype the compare network handles on the target — 4-byte keys
+    (the uint32 encoding, int32/uint32/f32-backed) on real TPUs; the
+    interpret route additionally takes the x64 encodings (uint64/int64),
+    which is how the CPU parity battery covers the wide-key path."""
+    if n < 1 or _padded(n) > _MAX_ELEMS:
+        return False
+    dt = np.dtype(jnp.dtype(key_dtype).name)
+    if dt.kind not in "iu":
+        return False
+    return dt.itemsize == 4 or (interpret and dt.itemsize == 8)
+
+
+def _pad_max(dtype):
+    return np.array(np.iinfo(np.dtype(jnp.dtype(dtype).name)).max,
+                    np.dtype(jnp.dtype(dtype).name))
+
+
+@functools.lru_cache(maxsize=32)
+def _build(M: int, kv: bool, kdtype_name: str, interpret: bool):
+    """One compiled bitonic network over an (M/128, 128) VMEM tile."""
+    R = M // LANES
+    dtype = jnp.dtype(kdtype_name)
+
+    def _lane_roll(y, j):
+        # jnp.roll lowers poorly on Mosaic; pltpu.roll(y, s, 1) shifts
+        # lane c -> value from lane c - s (mod 128), so down-by-j is
+        # shift 128 - j
+        if interpret:
+            return jnp.roll(y, -j, axis=1), jnp.roll(y, j, axis=1)
+        return (pltpu.roll(y, LANES - j, 1), pltpu.roll(y, j, 1))
+
+    def kernel(*refs):
+        if kv:
+            x_ref, g_ref, ox_ref, og_ref = refs
+            g = g_ref[...]
+        else:
+            x_ref, ox_ref = refs
+            g = None
+        x = x_ref[...]
+        row = lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+        idx = row * LANES + lane
+        k = 2
+        while k <= M:
+            j = k // 2
+            while j >= 1:
+                up = (idx & k) == 0
+                keep_min = ((idx & j) == 0) == up
+                if j >= LANES:
+                    jr = j // LANES
+                    B = R // (2 * jr)
+
+                    def _swap(y, jr=jr, B=B):
+                        # partner rows differ in idx bit j: regroup the
+                        # leading axis and swap the two halves
+                        y4 = y.reshape(B, 2, jr, LANES)
+                        return jnp.concatenate(
+                            [y4[:, 1:2], y4[:, 0:1]],
+                            axis=1).reshape(R, LANES)
+
+                    p = _swap(x)
+                    pg = _swap(g) if kv else None
+                else:
+                    down = (lane & j) == 0
+                    xd, xu = _lane_roll(x, j)
+                    p = jnp.where(down, xd, xu)
+                    if kv:
+                        gd, gu = _lane_roll(g, j)
+                        pg = jnp.where(down, gd, gu)
+                if kv:
+                    # full (key, gid) pair order: a TOTAL order (valid
+                    # gids distinct, pad pairs identical), so the
+                    # network output is the unique sorted sequence
+                    a_le = (x < p) | ((x == p) & (g <= pg))
+                    take_a = keep_min == a_le
+                    x = jnp.where(take_a, x, p)
+                    g = jnp.where(take_a, g, pg)
+                else:
+                    lo = jnp.minimum(x, p)
+                    hi = jnp.maximum(x, p)
+                    x = jnp.where(keep_min, lo, hi)
+                j //= 2
+            k *= 2
+        ox_ref[...] = x
+        if kv:
+            og_ref[...] = g
+
+    n_io = 2 if kv else 1
+    dtypes = (dtype, jnp.int32) if kv else (dtype,)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 2 ** 20)
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((R, LANES), lambda i: (0, 0))
+                  for _ in range(n_io)],
+        out_specs=[pl.BlockSpec((R, LANES), lambda i: (0, 0))
+                   for _ in range(n_io)],
+        out_shape=[jax.ShapeDtypeStruct((R, LANES), dt)
+                   for dt in dtypes],
+        interpret=interpret,
+        **params,
+    )
+
+
+def sort_keys(keys, *, interpret: bool = False):
+    """Ascending on-chip sort of a 1-D integer key block (the monotone
+    encoding).  Caller checks :func:`eligible` first."""
+    n = keys.shape[0]
+    M = _padded(n)
+    if M > n:
+        keys = jnp.concatenate(
+            [keys, jnp.full((M - n,), _pad_max(keys.dtype), keys.dtype)])
+    out, = _build(M, False, str(keys.dtype), interpret)(
+        keys.reshape(M // LANES, LANES))
+    return out.reshape(M)[:n]
+
+
+def sort_kv(keys, gid, *, interpret: bool = False):
+    """Ascending on-chip sort of (key, gid) pairs by the full pair
+    order; ``gid`` is the payload plan's int32 index channel.  Pads
+    with (dtype max, INT32_MAX) — the sort family's (pad key, GMAX)
+    convention — so the tail slices off exactly."""
+    n = keys.shape[0]
+    M = _padded(n)
+    if M > n:
+        keys = jnp.concatenate(
+            [keys, jnp.full((M - n,), _pad_max(keys.dtype), keys.dtype)])
+        gid = jnp.concatenate(
+            [gid, jnp.full((M - n,), np.int32(np.iinfo(np.int32).max),
+                           jnp.int32)])
+    ox, og = _build(M, True, str(keys.dtype), interpret)(
+        keys.reshape(M // LANES, LANES), gid.reshape(M // LANES, LANES))
+    return ox.reshape(M)[:n], og.reshape(M)[:n]

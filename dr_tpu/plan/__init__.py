@@ -857,19 +857,26 @@ class Plan:
         ops = tuple(in_chain.ops)
         nsc = len(all_sc) - 2
         axis, mesh = out_cont.runtime.axis, out_cont.runtime.mesh
+        # hist kernel-arm decision (docs/SPEC.md §22): resolved at
+        # RECORD time through the same shared helper as the eager
+        # program, and part of the fused-op key — a changed arm pick
+        # is a different fused program
+        from ..algorithms import relational as _rel
+        kern = _rel._hist_kernel_decision(mesh, in_layout, bins)
         key = ("relhist", si, so, in_layout, off, n,
                tuple(_traced_op_key(o) for o in ops), str(in_cont.dtype),
-               out_layout, str(out_dtype), bins, spec)
+               out_layout, str(out_dtype), bins, spec, tuple(kern))
 
         def emit(state, svals, souts):
             from ..algorithms import relational as _rel
             body = _rel._histogram_body(axis, in_layout, off, n, ops,
                                         nsc, out_layout, bins,
-                                        jnp.dtype(out_dtype))
+                                        jnp.dtype(out_dtype), kern=kern)
             shm = jax.shard_map(
                 body, mesh=mesh,
                 in_specs=(P(axis, None),) + (P(),) * (nsc + 2),
-                out_specs=P(axis, None))
+                out_specs=P(axis, None),
+                check_vma=not kern.use)
             state[so] = shm(state[si], *svals)
 
         run.ops.append(_FusedOp(

@@ -226,6 +226,12 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "router.probe": ("transient", "program"),
     "serve.drain": ("transient", "program"),
     "serve.journal": ("transient", "program"),
+    # on-chip kernel tier (docs/SPEC.md §22): fires at EVERY kernel-arm
+    # decision (ops/kernels.use_kernel — sort_local/segred/hist/scan),
+    # before the arm's program is built or fetched; a fault there
+    # degrades that dispatch to the portable XLA route (warned,
+    # counted), never a crash — the kernels are an optimization tier.
+    "kernel.build": ("transient", "program"),
     "fallback.warn": (),
 }
 

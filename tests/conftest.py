@@ -116,6 +116,8 @@ def _clear_tuning_knobs(monkeypatch):
                 "DR_TPU_FLASH_STREAM", "DR_TPU_MM_PRECISION",
                 "DR_TPU_GATHER_W", "DR_TPU_DOT_IMPL",
                 "DR_TPU_SORT_STABLE",
+                "DR_TPU_SORT_LOCAL", "DR_TPU_SEGRED_IMPL",
+                "DR_TPU_HIST_IMPL", "DR_TPU_SCAN_IMPL",
                 "DR_TPU_PLAN_OPT", "DR_TPU_PLAN_OPT_DISABLE",
                 "DR_TPU_TUNING_DB"):
         monkeypatch.delenv(var, raising=False)
@@ -126,6 +128,17 @@ def _clear_tuning_knobs(monkeypatch):
     from dr_tpu import tuning
     tuning.clear_session()
     tuning.reload()
+
+
+def pytest_collection_modifyitems(config, items):
+    """``kernel_interpret``-marked tests run Pallas kernels in interpret
+    mode at crank depth (the unrolled bitonic network traces slowly on
+    CPU): promote them to ``slow`` so tier-1's ``-m 'not slow'`` keeps
+    its budget while ``tools/fuzz_crank.sh`` (unfiltered) still runs
+    them."""
+    for item in items:
+        if item.get_closest_marker("kernel_interpret") is not None:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(params=[1, 2, 3, 4, 8])

@@ -426,3 +426,93 @@ def test_r7_silent_when_registry_and_docs_agree(tmp_path, monkeypatch):
              drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
     lin = drlint.Linter(files, {"R7", "R0"}, full_scan=True)
     assert [f for f in lin.run() if f.rule == "R7"] == []
+
+
+# ---------------------------------------------------------------------------
+# R8: kernel-arm registry drift (docs/SPEC.md §22.1)
+# ---------------------------------------------------------------------------
+
+def _write_r8_faults(tmp_path, sites):
+    d = tmp_path / "dr_tpu" / "utils"
+    d.mkdir(parents=True)
+    body = ", ".join(f'"{s}": ("transient",)' for s in sites)
+    (d / "faults.py").write_text("SITES = {%s}\n" % body,
+                                 encoding="utf-8")
+
+
+def test_r8_kernel_registry_drift(tmp_path, monkeypatch):
+    """Every closure direction fires: an unregistered env override, a
+    missing kernel module, a module without supported(), an empty
+    fallback declaration, an unregistered fault site, both SPEC §22.1
+    drift directions, and a fuzz file that neither sweeps ARM_NAMES
+    nor names every arm."""
+    kern = tmp_path / "kernels.py"
+    kern.write_text(
+        'from dr_tpu.utils.env import env_str\n'
+        'ARMS = (\n'
+        '    ("bitonic", "DR_TPU_BITONIC_IMPL", "bitonic_pallas",\n'
+        '     "lax.sort", "kernel.build"),\n'
+        '    ("mystery", "DR_TPU_MYSTERY_IMPL", "missing_pallas",\n'
+        '     "", "no.such.site"),\n'
+        ')\n'
+        'env_str("DR_TPU_BITONIC_IMPL")\n', encoding="utf-8")
+    probe = tmp_path / "bitonic_pallas.py"
+    probe.write_text("def helper():\n    pass\n", encoding="utf-8")
+    _write_r8_faults(tmp_path, ["kernel.build"])
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "SPEC.md").write_text(
+        "### 22.1 The arm registry\n"
+        "| arm | env | kernel | fallback | seams |\n"
+        "| `bitonic` | x | x | x | x |\n"
+        "| `stale` | x | x | x | x |\n"
+        "## 23. next\n", encoding="utf-8")
+    fuzz = tmp_path / "fuzz.py"
+    fuzz.write_text(
+        "def test_fuzz_kernel_parity():\n    pass  # bitonic\n",
+        encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    files = [drlint.FileInfo(str(kern), "dr_tpu/ops/kernels.py"),
+             drlint.FileInfo(str(probe),
+                             "dr_tpu/ops/bitonic_pallas.py"),
+             drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
+    lin = drlint.Linter(files, {"R8", "R0"}, full_scan=True)
+    msgs = [f.msg for f in lin.run() if f.rule == "R8"]
+    text = " ".join(msgs)
+    assert "'DR_TPU_MYSTERY_IMPL'" in text   # override never read
+    assert "does not exist" in text          # missing kernel module
+    assert "supported()" in text             # probe-less module
+    assert "no portable" in text             # empty fallback cell
+    assert "'no.such.site'" in text          # unregistered fault site
+    assert "'mystery'" in text               # registered, undocumented
+    assert "'stale'" in text                 # documented, unregistered
+    assert "ARM_NAMES" in text               # fuzz arm misses 'mystery'
+
+
+def test_r8_silent_when_registry_and_docs_agree(tmp_path, monkeypatch):
+    kern = tmp_path / "kernels.py"
+    kern.write_text(
+        'from dr_tpu.utils.env import env_str\n'
+        'ARMS = (\n'
+        '    ("bitonic", "DR_TPU_BITONIC_IMPL", "bitonic_pallas",\n'
+        '     "lax.sort", "kernel.build"),\n'
+        ')\n'
+        'env_str("DR_TPU_BITONIC_IMPL")\n', encoding="utf-8")
+    probe = tmp_path / "bitonic_pallas.py"
+    probe.write_text("def supported():\n    return True\n",
+                     encoding="utf-8")
+    _write_r8_faults(tmp_path, ["kernel.build"])
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "SPEC.md").write_text(
+        "### 22.1 The arm registry\n| `bitonic` | x | x | x | x |\n",
+        encoding="utf-8")
+    fuzz = tmp_path / "fuzz.py"
+    fuzz.write_text(
+        "from dr_tpu.ops.kernels import ARM_NAMES\n"
+        "def test_fuzz_kernel_parity():\n    pass\n", encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    files = [drlint.FileInfo(str(kern), "dr_tpu/ops/kernels.py"),
+             drlint.FileInfo(str(probe),
+                             "dr_tpu/ops/bitonic_pallas.py"),
+             drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
+    lin = drlint.Linter(files, {"R8", "R0"}, full_scan=True)
+    assert [f for f in lin.run() if f.rule == "R8"] == []

@@ -1,0 +1,98 @@
+"""Unit tests for the §22 kernel-arm registry (dr_tpu/ops/kernels.py):
+selection precedence (env pin > tuning-DB winner > ``auto``), the
+forced-pin interpret semantics off-TPU, the eligibility veto, the
+``kernel.build`` fault-site degrade, and the Decision truthiness
+contract every dispatch seam keys on.  The end-to-end halves (parity,
+recording) live in test_fuzz.py and the tune ladder; this file pins
+the decision function itself."""
+
+import pytest
+
+from dr_tpu import tuning
+from dr_tpu.ops import kernels
+from dr_tpu.utils import faults
+from dr_tpu.utils.env import env_override
+
+
+def test_decision_truthiness_contract():
+    # a NamedTuple is ALWAYS truthy — seams must branch on .use and
+    # key program caches on tuple(decision), never `if kern:`
+    assert kernels.NO_KERNEL
+    assert not kernels.NO_KERNEL.use
+    assert tuple(kernels.Decision(True, True)) != tuple(kernels.NO_KERNEL)
+
+
+def test_registry_shape():
+    assert set(kernels.ARM_NAMES) == {"sort_local", "segred", "hist",
+                                      "scan"}
+    for arm, env, mod, fallback, site in kernels.ARMS:
+        assert env.startswith("DR_TPU_")
+        assert fallback
+        assert site == "kernel.build"
+
+
+def test_auto_resolves_by_platform():
+    assert kernels.use_kernel("hist", "cpu") == kernels.NO_KERNEL
+    assert kernels.use_kernel("hist", "tpu") == kernels.Decision(True,
+                                                                 False)
+
+
+def test_pallas_pin_forced_interpret_off_tpu():
+    with env_override(DR_TPU_HIST_IMPL="pallas"):
+        assert kernels.use_kernel("hist", "cpu") \
+            == kernels.Decision(True, True)
+        assert kernels.use_kernel("hist", "tpu") \
+            == kernels.Decision(True, False)
+
+
+def test_xla_pin_wins_even_on_tpu():
+    with env_override(DR_TPU_SEGRED_IMPL="xla"):
+        assert kernels.use_kernel("segred", "tpu") == kernels.NO_KERNEL
+
+
+def test_tuning_db_between_pin_and_default():
+    tuning.note("kernels", "hist", "pallas")
+    try:
+        # a recorded winner applies with no pin (interpret here: cpu)...
+        assert kernels.use_kernel("hist", "cpu") \
+            == kernels.Decision(True, True)
+        # ...and an explicit env pin still beats it
+        with env_override(DR_TPU_HIST_IMPL="xla"):
+            assert kernels.use_kernel("hist", "cpu") == kernels.NO_KERNEL
+    finally:
+        tuning.clear_session()
+
+
+def test_junk_pin_and_junk_db_mean_auto():
+    tuning.note("kernels", "hist", "warp9")
+    try:
+        assert kernels.use_kernel("hist", "cpu") == kernels.NO_KERNEL
+        with env_override(DR_TPU_HIST_IMPL="mystery"):
+            assert kernels.use_kernel("hist", "tpu") \
+                == kernels.Decision(True, False)  # junk pin = auto
+    finally:
+        tuning.clear_session()
+
+
+def test_ineligible_beats_every_mode():
+    with env_override(DR_TPU_SORT_LOCAL="pallas"):
+        assert kernels.use_kernel("sort_local", "tpu", eligible=False) \
+            == kernels.NO_KERNEL
+
+
+def test_kernel_build_fault_degrades_to_xla(recwarn):
+    with env_override(DR_TPU_HIST_IMPL="pallas"):
+        try:
+            with faults.injected("kernel.build", "transient", times=1):
+                assert kernels.use_kernel("hist", "cpu") \
+                    == kernels.NO_KERNEL
+            # the fault was one-shot: the next decision is the pin again
+            assert kernels.use_kernel("hist", "cpu") \
+                == kernels.Decision(True, True)
+        finally:
+            faults.clear()
+
+
+def test_unregistered_arm_asserts():
+    with pytest.raises(AssertionError):
+        kernels.use_kernel("warp", "cpu")

@@ -178,15 +178,13 @@ def test_distributed_scan_with_kernel_interpret(monkeypatch):
     local scan (interpret mode) on the multi-device mesh — validates
     the kernel's interaction with masking, the all_gather carry
     exchange, and the exclusive shift."""
-    import functools
     from dr_tpu.algorithms import scan as scan_mod
-    from dr_tpu.ops import scan_pallas
+    from dr_tpu.ops import kernels
 
+    # a forced interpret-mode Decision (§22): the program threads
+    # interpret=True into chunked_cumsum itself now
     monkeypatch.setattr(scan_mod, "_use_scan_kernel",
-                        lambda *a, **k: True)
-    monkeypatch.setattr(
-        scan_pallas, "chunked_cumsum",
-        functools.partial(scan_pallas.chunked_cumsum, interpret=True))
+                        lambda *a, **k: kernels.Decision(True, True))
     P = dr_tpu.nprocs()
     # seg stays 128*128 (lane-chunkable) but n is NOT P*seg: the last
     # shard's tail is pad, exercising the gid<n mask ahead of the
@@ -558,13 +556,13 @@ def test_scan_mismatched_window_never_takes_kernel(monkeypatch):
     on TPU for an add-monoid f32 uniform container), the mis_ok route
     must build the XLA program."""
     import dr_tpu.algorithms.scan as scan_mod
-    from dr_tpu.ops import scan_pallas
+    from dr_tpu.ops import kernels, scan_pallas
 
     def boom(*a, **k):
         raise AssertionError("Pallas kernel taken on the "
                              "mismatched-window scan route")
     monkeypatch.setattr(scan_mod, "_use_scan_kernel",
-                        lambda *a, **k: True)
+                        lambda *a, **k: kernels.Decision(True, True))
     monkeypatch.setattr(scan_pallas, "chunked_cumsum", boom)
     n = 61
     src = np.random.default_rng(61).standard_normal(n).astype(np.float32)

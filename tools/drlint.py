@@ -98,6 +98,7 @@ RULES = {
     "R5": "degradation path outside the fallback registry",
     "R6": "program compilation outside the TappedCache discipline",
     "R7": "plan-optimizer pass registry drift",
+    "R8": "kernel-arm registry drift",
 }
 
 DEFAULT_ROOTS = ("dr_tpu", "tools", "tests", "bench.py",
@@ -309,6 +310,7 @@ class Linter:
         self.check_env_table()
         self.check_fault_registry()
         self.check_plan_opt_registry()
+        self.check_kernel_registry()
         # suppressions apply last (and R0 findings ride along)
         for fi in self.files:
             sup = Suppressions(fi.lines, fi.relpath, self.findings)
@@ -601,6 +603,124 @@ class Linter:
                     self.emit("R7", fuzz, 1,
                               "test_fuzz_plan_opt does not sweep "
                               "plan_opt.PASS_NAMES and never names: "
+                              f"{', '.join(missing)}")
+
+    # --------------------------------------------------------------- R8
+    def check_kernel_registry(self) -> None:
+        """Whole-repo R8 closure: every ``ARMS`` row in
+        dr_tpu/ops/kernels.py declares an env override the inventory
+        actually reads, a kernel module that exists and exports
+        ``supported()``, a portable-fallback declaration, a fault site
+        registered in faults.SITES, and a docs/SPEC.md §22.1 arm-table
+        row (both drift directions) — plus pallas-vs-xla parity fuzz
+        coverage.  The R3/R7 registry discipline applied to the
+        on-chip kernel tier."""
+        if not self.full_scan or "R8" not in self.rules:
+            return
+        k_fi = next((f for f in self.files
+                     if f.relpath == "dr_tpu/ops/kernels.py"), None)
+        if k_fi is None:
+            return
+        arms: Dict[str, Tuple[int, str, str, str, str]] = {}
+        for node in k_fi.tree.body:
+            tgt = node.targets[0] if isinstance(node, ast.Assign) \
+                and node.targets else None
+            if isinstance(tgt, ast.Name) and tgt.id == "ARMS" and \
+                    isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Tuple) and \
+                            len(elt.elts) == 5 and all(
+                                isinstance(e, ast.Constant)
+                                for e in elt.elts):
+                        arms[elt.elts[0].value] = (
+                            elt.lineno, elt.elts[1].value,
+                            elt.elts[2].value, elt.elts[3].value,
+                            elt.elts[4].value)
+        if not arms:
+            self.emit("R8", k_fi, 1,
+                      "no ARMS registry found — the §22 kernel tier "
+                      "must register every arm as a literal 5-tuple "
+                      "(arm, env, module, xla fallback, fault site)")
+            return
+        sites = self.fault_sites() or {}
+        for name, (line, env, module, fallback, site) in \
+                sorted(arms.items()):
+            if not ENV_VAR_RE.match(env) or env not in self.env_refs:
+                self.emit("R8", k_fi, line,
+                          f"kernel arm {name!r} override {env!r} is "
+                          "never read through the env registry — "
+                          "register a literal env_str read")
+            mod_fi = next((f for f in self.files
+                           if f.relpath == f"dr_tpu/ops/{module}.py"),
+                          None)
+            if mod_fi is None:
+                self.emit("R8", k_fi, line,
+                          f"kernel arm {name!r} names module "
+                          f"{module!r} but dr_tpu/ops/{module}.py "
+                          "does not exist")
+            elif not re.search(r"^def supported\(", mod_fi.src,
+                               re.MULTILINE):
+                self.emit("R8", mod_fi, 1,
+                          f"kernel module {module!r} exports no "
+                          "supported() availability probe — the arm "
+                          "cannot degrade gracefully without one")
+            if not fallback:
+                self.emit("R8", k_fi, line,
+                          f"kernel arm {name!r} declares no portable "
+                          "XLA fallback — kernels are an optimization "
+                          "tier, the portable lowering is the "
+                          "contract")
+            if sites and site not in sites:
+                self.emit("R8", k_fi, line,
+                          f"kernel arm {name!r} fault site {site!r} "
+                          "is not registered in faults.SITES")
+        # SPEC §22.1 arm-table rows (first backticked cell), both
+        # drift directions — the R7 pass-table pattern
+        spec_rows: Dict[str, int] = {}
+        spec_path = os.path.join(REPO, "docs", "SPEC.md")
+        if os.path.exists(spec_path):
+            in_sect = False
+            with open(spec_path, encoding="utf-8") as fh:
+                for i, text in enumerate(fh.read().splitlines(), 1):
+                    if re.match(r"###\s*22\.1\b", text):
+                        in_sect = True
+                        continue
+                    if in_sect and re.match(r"##", text):
+                        break
+                    if in_sect:
+                        m = re.match(r"\|\s*`([a-z][a-z_]*)`", text)
+                        if m:
+                            spec_rows[m.group(1)] = i
+        for name, (line, *_rest) in sorted(arms.items()):
+            if name not in spec_rows:
+                self.emit("R8", k_fi, line,
+                          f"kernel arm {name!r} has no docs/SPEC.md "
+                          "§22.1 arm-table row — document its scope, "
+                          "eligibility, and bit-identity contract")
+        for name, line in sorted(spec_rows.items()):
+            if name not in arms:
+                self.findings.append(Finding(
+                    "docs/SPEC.md", line, "R8",
+                    f"§22.1 arm-table row {name!r} matches no "
+                    "registered arm in ops/kernels.py — stale "
+                    "documentation"))
+        # parity fuzz coverage: the arm battery sweeps the registry
+        # (ARM_NAMES) or names every arm explicitly
+        fuzz = next((f for f in self.files
+                     if f.relpath == "tests/test_fuzz.py"), None)
+        if fuzz is not None:
+            if "def test_fuzz_kernel_parity" not in fuzz.src:
+                self.emit("R8", fuzz, 1,
+                          "tests/test_fuzz.py has no "
+                          "test_fuzz_kernel_parity — every kernel arm "
+                          "needs the pallas-vs-xla parity fuzz arm")
+            elif not re.search(r"\bARM_NAMES\b", fuzz.src):
+                missing = [a for a in sorted(arms)
+                           if a not in fuzz.src]
+                if missing:
+                    self.emit("R8", fuzz, 1,
+                              "test_fuzz_kernel_parity does not sweep "
+                              "kernels.ARM_NAMES and never names: "
                               f"{', '.join(missing)}")
 
     # --------------------------------------------------------------- R4

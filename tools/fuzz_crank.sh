@@ -99,6 +99,17 @@
 # arms above, plus a dedicated DR_TPU_SANITIZE=1 crank below (the
 # recompile budget and finite-flush sweep over every optimized
 # chain).  drlint R7 keys the pass registry on this arm.
+#
+# KERNEL arm (docs/SPEC.md SS22): test_fuzz_kernel_parity cranks every
+# registered kernel arm (ops/kernels.ARM_NAMES) pallas-PINNED (Pallas
+# interpret mode on the CPU mesh — the real kernel bodies, no silicon)
+# vs xla-PINNED on identical inputs, bit-equal everywhere but the scan
+# arm's tolerance carve-out, with a mid-sort elastic-shrink slice
+# (filter `kernel_parity`); the slow-marked kernel_interpret variant
+# (test_fuzz_kernel_parity_deep) collects here too — geometries past
+# one bitonic stage boundary and a >2-tile segred groupby.  The chaos
+# battery sweeps the kernel.build site rows.  drlint R8 keys the arm
+# registry on this battery.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}

@@ -6,7 +6,7 @@ Measures everything by the marginal method with a hard scalar-read sync
 on tunneled backends, so each timed call returns one device scalar.
 
 Usage:  python tools/tune_tpu.py
-        [stencil|scan|dot|spmv|heat|attn|halo|sort|pipeline|
+        [stencil|scan|dot|spmv|heat|attn|halo|sort|kernels|pipeline|
          relational|redistribute|serve|all]
 
 Prints one line per configuration; safe to re-run (all programs cached
@@ -620,6 +620,144 @@ def tune_sort():
             v = kd = pd = None
 
 
+def tune_kernels():
+    """On-chip kernel-arm ladder (docs/SPEC.md §22): every registered
+    arm (ops/kernels.ARM_NAMES) A/B'd pallas vs xla over dtype x size
+    rungs, the winner per arm recorded into the tuning DB as
+    ``kernels.<arm>`` — the §22.2 pickers read it back with env pins
+    still beating it.  On this host's CPU mesh the pallas rung runs in
+    interpret mode (uselessly slow — the recorded CPU-context row can
+    never poison the TPU entry, §21.6), so the ladder only MEANS
+    something on silicon; it still runs everywhere as a correctness
+    smoke."""
+    import dr_tpu
+    from dr_tpu.ops import kernels
+    from dr_tpu.utils.env import env_override
+
+    dr_tpu.init()
+    P = dr_tpu.nprocs()
+    rng = np.random.default_rng(22)
+    wins = {}
+
+    def ab(arm, label, run_sync):
+        """One rung: time run_sync under each pin; returns the winner
+        mode or None when either leg failed."""
+        env = dict((e, None) for _, e, _, _, _ in kernels.ARMS)
+        out = {}
+        for mode in ("xla", "pallas"):
+            env[dict((a, e) for a, e, _, _, _ in kernels.ARMS)[arm]] \
+                = mode
+            with env_override(**env):
+                try:
+                    out[mode] = _marginal(run_sync, 2, 10)
+                    print(f"kernels {arm} [{label} {mode}]: "
+                          f"{out[mode] * 1e3:.3f} ms", flush=True)
+                except Exception as e:
+                    print(f"kernels {arm} [{label} {mode}]: FAIL "
+                          f"{_errline(e)}", flush=True)
+        if len(out) == 2:
+            return min(out, key=out.get)
+        return None
+
+    # --- sort_local: the fused sort_n loop at kernel-eligible shard
+    # sizes (padded bitonic cap is 2^15 elements per shard)
+    from dr_tpu.algorithms.sort import sort_by_key_n, sort_n
+    for dt_name, dt in (("f32", np.float32), ("i32", np.int32)):
+        for spp in (4096, 16384):
+            n = spp * P
+            v = dr_tpu.distributed_vector(n, dt)
+            src = (rng.standard_normal(n).astype(dt) if dt == np.float32
+                   else rng.integers(-9999, 9999, n).astype(dt))
+            v.assign_array(src)
+
+            def run(r, v=v):
+                sort_n(v, r)
+                float(v[0])
+            w = ab("sort_local", f"{dt_name} n={n}", run)
+            if w:
+                wins.setdefault("sort_local", []).append(w)
+    kd = dr_tpu.distributed_vector(8192 * P, np.float32)
+    kd.assign_array(rng.standard_normal(8192 * P).astype(np.float32))
+    pd = dr_tpu.distributed_vector(8192 * P, np.int32)
+    dr_tpu.iota(pd, 0)
+
+    def run_kv(r):
+        sort_by_key_n(kd, pd, r)
+        float(kd[0])
+    w = ab("sort_local", f"kv n={8192 * P}", run_kv)
+    if w:
+        wins.setdefault("sort_local", []).append(w)
+
+    # --- segred: groupby (the monoid core) + the plain reduce route
+    for agg, vdt in (("sum", np.int32), ("min", np.float32)):
+        nk = 4096 * P
+        gk = dr_tpu.distributed_vector.from_array(
+            rng.integers(0, 500, nk).astype(np.int32))
+        gv = dr_tpu.distributed_vector.from_array(
+            rng.integers(0, 99, nk).astype(vdt) if vdt == np.int32
+            else rng.standard_normal(nk).astype(vdt))
+        ok = dr_tpu.distributed_vector(512, np.int32)
+        ov = dr_tpu.distributed_vector(512, vdt)
+
+        def run(r, gk=gk, gv=gv, ok=ok, ov=ov, agg=agg):
+            for _ in range(r):
+                dr_tpu.groupby_aggregate(gk, gv, ok, ov, agg=agg)
+            float(ov[0])
+        w = ab("segred", f"groupby-{agg}-{np.dtype(vdt).name}", run)
+        if w:
+            wins.setdefault("segred", []).append(w)
+    ri = dr_tpu.distributed_vector.from_array(
+        rng.integers(-99, 99, 8192 * P).astype(np.int32))
+
+    def run_red(r):
+        acc = 0
+        for _ in range(r):
+            acc = dr_tpu.reduce(ri)
+        float(acc)
+    w = ab("segred", "reduce-add-int32", run_red)
+    if w:
+        wins.setdefault("segred", []).append(w)
+
+    # --- hist: the bincount scatter-add over bin-count rungs
+    hv = dr_tpu.distributed_vector.from_array(
+        rng.standard_normal(8192 * P).astype(np.float32))
+    for bins in (64, 1024):
+        hb = dr_tpu.distributed_vector(bins, np.int32)
+
+        def run(r, hb=hb):
+            for _ in range(r):
+                dr_tpu.histogram(hv, hb, -4.0, 4.0)
+            float(hb[0])
+        w = ab("hist", f"bins={bins}", run)
+        if w:
+            wins.setdefault("hist", []).append(w)
+
+    # --- scan: the fused inclusive_scan_n loop at a chunkable size
+    ns = 128 * 128 * max(1, 2 ** 27 // (128 * 128 * P)) * P
+    sv = dr_tpu.distributed_vector(ns, np.float32)
+    dr_tpu.fill(sv, 1.0)
+    so = dr_tpu.distributed_vector(ns, np.float32)
+
+    def run_scan(r):
+        dr_tpu.inclusive_scan_n(sv, so, r)
+        float(so[0])
+    w = ab("scan", f"f32 n={ns}", run_scan)
+    if w:
+        wins.setdefault("scan", []).append(w)
+
+    for arm in kernels.ARM_NAMES:
+        got = wins.get(arm)
+        if not got:
+            print(f"kernels {arm}: no complete A/B rung — nothing "
+                  "recorded", flush=True)
+            continue
+        # majority across rungs (the spmv-format discipline): the
+        # picker applies ONE mode per arm, so the rung vote is the
+        # honest aggregate
+        best = max(set(got), key=got.count)
+        _record_winner("kernels", arm, best, "kernels")
+
+
 def tune_pipeline():
     """Chain-length ladder for the deferred execution plan (round 8,
     dr_tpu/plan.py): per-chain time of the 5-op pipeline chain
@@ -919,6 +1057,8 @@ if __name__ == "__main__":
             tune_scan()
         if what in ("sort", "all"):
             tune_sort()
+        if what in ("kernels", "all"):
+            tune_kernels()
         if what in ("pipeline", "all"):
             tune_pipeline()
         if what in ("relational", "all"):
