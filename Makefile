@@ -1,7 +1,7 @@
 # Repo-level entry points.  The native library keeps its own Makefile
 # (make -C native test / bridge-test).
 
-.PHONY: lint test sanitize-test native-test
+.PHONY: lint test sanitize sanitize-test native-test
 
 # static invariant gate (docs/SPEC.md §13): exits non-zero on any
 # non-baselined drlint finding
@@ -12,9 +12,15 @@ test:
 	python -m pytest tests/ -x -q
 
 # the tier-1 suite with the runtime sanitizer armed (recompile budget,
-# finite flush sweep, canon-portability of every dispatch key)
+# finite flush sweep, canon-portability of every dispatch key, and the
+# §23 plansan layer: shadow verifier + serializability oracle)
 sanitize-test:
-	DR_TPU_SANITIZE=1 python -m pytest tests/ -x -q
+	DR_TPU_SANITIZE=1 python -m pytest tests/ -x -q -m 'not slow'
+
+# the full soundness gate (docs/SPEC.md §23.5): tier-1 under the armed
+# runtime sanitizer PLUS the static half (drlint R0-R10) — the
+# fuzz_crank SANITIZE arm and the PR checklist both run this
+sanitize: sanitize-test lint
 
 native-test:
 	$(MAKE) -C native test

@@ -899,16 +899,21 @@ def gemv(c: distributed_vector, a: sparse_matrix, b) -> distributed_vector:
     if p is not None:
         # footprint (SPEC §21.2): gemv ACCUMULATES into c (c += A·b),
         # so c is read and written, never a coverage killer.  A plain
-        # host array b is never written by queued ops; any OTHER
-        # operand shape (a view/span over some container this
-        # footprint cannot name) stays a FULL BARRIER so no pass may
-        # eliminate or reorder its producers
+        # host array b is never written by queued ops; a view operand
+        # resolves its base-container chain through the ONE
+        # interference helper; anything unresolvable stays a FULL
+        # BARRIER so no pass may eliminate or reorder its producers
         if isinstance(b, distributed_vector):
             reads, writes = (c, b), ((c, False),)
         elif isinstance(b, (np.ndarray, jnp.ndarray)) or np.isscalar(b):
             reads, writes = (c,), ((c, False),)
         else:
-            reads = writes = None
+            from ..plan import interference as _interf
+            conts = _interf.view_containers(b)
+            if conts is not None:
+                reads, writes = (c,) + conts, ((c, False),)
+            else:
+                reads = writes = None
         p.record_opaque("gemv", lambda: gemv(c, a, b),
                         reads=reads, writes=writes)
         return c

@@ -37,6 +37,7 @@ from ..parallel import runtime as _rt
 from ..parallel.halo import halo_bounds, span_halo
 from .distribution import block_distribution
 from ..utils.spmd_guard import TappedCache
+from ..utils import sanitize as _sanitize
 
 __all__ = ["distributed_vector", "halo"]
 
@@ -119,7 +120,12 @@ class distributed_vector:
             starts = None
         prior = {k: self.__dict__.get(k)
                  for k in ("_rt", "_nshards", "_dist_entry", "_seg",
-                           "_sizes", "_starts", "_data", "_halo")}
+                           "_sizes", "_starts", "_data_arr", "_halo")}
+        if prior["_rt"] is None and _sanitize._born_hook is not None:
+            # container CREATION (not a live elastic rebind): tell the
+            # plansan opaque watcher before the first state write, so
+            # scratch containers born inside a watched thunk are exempt
+            _sanitize._born_hook(self)
         try:
             self._rt = runtime
             self._nshards = P
@@ -138,6 +144,26 @@ class distributed_vector:
                 self.__dict__.update(prior)
             raise
         self._rt.register(self)
+
+    # ---------------------------------------------------------------- state
+    @property
+    def _data(self):
+        """The current sharded device state.  A property so the
+        plansan opaque-footprint watcher
+        (``utils/sanitize.watch_containers``, SPEC §23.3) observes
+        every host-side read and rebind; unarmed, the cost is one
+        module-global ``None`` check."""
+        h = _sanitize._access_hook
+        if h is not None:
+            h("r", self)
+        return self._data_arr
+
+    @_data.setter
+    def _data(self, value):
+        h = _sanitize._access_hook
+        if h is not None:
+            h("w", self)
+        self._data_arr = value
 
     # ------------------------------------------------------------------ meta
     @property

@@ -302,7 +302,7 @@ class _FusedOp:
     pass needs to re-home the op onto a relational scratch copy."""
 
     __slots__ = ("name", "key", "emit", "spec", "vals", "pre",
-                 "reads", "writes", "pure", "push")
+                 "reads", "writes", "pure", "push", "src")
 
     def __init__(self, name, key, emit, spec=(), vals=(), pre=None,
                  reads=(), writes=(), pure=False, push=None):
@@ -316,6 +316,10 @@ class _FusedOp:
         self.writes = tuple(writes)
         self.pure = pure
         self.push = push
+        #: the RECORDED op this one executes, when a pass re-slotted it
+        #: into a merged run (opt._wrap) — the plansan oracle resolves
+        #: executed identities back to record identities through it
+        self.src = None
 
 
 class _Run:
@@ -435,22 +439,11 @@ class Plan:
 
     def queue_touches(self, cont) -> bool:
         """Could any queued item read or write ``cont``?  The §21.2
-        footprint check :func:`flush_reads` keys its skip on.  A run
-        answers by slot membership; an opaque item with UNKNOWN
-        footprints (None reads/writes) answers True — the
-        conservative barrier."""
-        cid = id(cont)
-        for item in self._queue:
-            if isinstance(item, _Run):
-                if cid in item._cont_ids:
-                    return True
-            else:
-                if item.reads is None or item.writes is None:
-                    return True
-                if any(id(c) == cid for c in item.reads) or \
-                        any(id(c) == cid for c, _f in item.writes):
-                    return True
-        return False
+        footprint check :func:`flush_reads` keys its skip on; the
+        aliasing answer comes from the one interference helper
+        (``plan/interference.py``, drlint rule R10)."""
+        from . import interference as _interf
+        return _interf.queue_touches(self._queue, cont)
 
     # ------------------------------------------------------------ region
     @contextmanager
@@ -1002,18 +995,35 @@ class Plan:
         # recorded items so the undo/replay/faulted-flush contracts
         # keep holding against record identities)
         from . import opt as _opt
+        # plansan (SPEC §23): snapshot the recorded queue's dependency
+        # structure BEFORE the passes run — pushdown rewrites opaque
+        # footprints in place, so the oracle pins the originals now
+        _plansan = None
+        snap = None
+        if _sanitize.installed():
+            from . import plansan as _plansan
+            snap = _plansan.snapshot(queue)
         exec_queue = _opt.optimize(self, queue, entry, parent=sid)
         d0 = _guard.dispatch_count()
         idx = 0
         try:
-            # the injection site fires BEFORE any dispatch: a faulted
+            # the injection sites fire BEFORE any dispatch: a faulted
             # flush executes nothing and containers stay consistent
+            # (sanitize.verify fires on every flush, armed or not —
+            # the chaos battery reaches it without DR_TPU_SANITIZE)
             _faults.fire("plan.flush")
+            _faults.fire("sanitize.verify")
+            if _plansan is not None:
+                _plansan.check_serializable(snap, exec_queue)
             for idx, item in enumerate(exec_queue):
                 di = _guard.dispatch_count()
                 t0 = _obs.now()
                 if isinstance(item, _Opaque):
-                    item.thunk()
+                    if _plansan is not None:
+                        with _plansan.watch(item):
+                            item.thunk()
+                    else:
+                        item.thunk()
                     _obs.complete("plan.opaque", t0, cat="plan",
                                   parent=sid, op=item.name)
                     entry["items"].append(
@@ -1028,6 +1038,10 @@ class Plan:
                         # queue) must not be blamed on its program
                         pre_ok = all(_sanitize.is_finite(c._data)
                                      for c in item.conts)
+                    if _plansan is not None:
+                        # shadow-verify the run's ops against their
+                        # declared footprints before it dispatches
+                        _plansan.verify_run(item)
                     hit = self._exec_run(item)
                     _obs.complete("plan.run", t0, cat="plan",
                                   parent=sid, ops=len(item.ops),

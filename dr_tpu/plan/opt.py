@@ -48,9 +48,10 @@ recorded queue executes unoptimized.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
-from . import PlanScalar, _FusedOp, _Opaque, _Run
+from . import _FusedOp, _Opaque, _Run
+from . import interference as _interf
 from .. import obs as _obs
 from ..utils.env import env_str
 
@@ -107,20 +108,10 @@ def expand_items(items) -> list:
 
 
 # ---------------------------------------------------------------------------
-# footprints
+# footprints — every aliasing/ordering query routes through
+# plan/interference.py (drlint rule R10): a pass must not hand-roll
+# its own footprint interpretation
 # ---------------------------------------------------------------------------
-
-def _item_touch(item) -> Optional[set]:
-    """Every container id the item may read OR write; None = unknown
-    (a barrier nothing reorders across)."""
-    if isinstance(item, _Run):
-        return {id(c) for c in item.conts}
-    if item.reads is None or item.writes is None:
-        return None
-    ids = {id(c) for c in item.reads}
-    ids.update(id(c) for c, _full in item.writes)
-    return ids
-
 
 class _Group:
     """A merge group under construction: runs in record order, merged
@@ -164,12 +155,13 @@ def _wrap(o: _FusedOp, smap, soff, wrapped) -> _FusedOp:
     def emit(state, svals, souts, _o=o, _m=smap):
         _o.emit(_SubState(state, _m), svals, souts)
 
+    reads2, writes2 = _interf.remap(o, smap)
     w = _FusedOp(o.name, ("mrg", o.key, smap, soff), emit, spec2,
-                 o.vals, pre=o.pre,
-                 reads=tuple(smap[s] for s in o.reads),
-                 writes=tuple((smap[s], off, n, full)
-                              for (s, off, n, full) in o.writes),
+                 o.vals, pre=o.pre, reads=reads2, writes=writes2,
                  pure=o.pure)
+    # the wrapper executes the SOURCE op's emit — the plansan oracle
+    # resolves executed identities back to recorded ops through src
+    w.src = o
     # the wrapper copied the operand values; the SOURCE op's copy is
     # dropped once the whole pass has succeeded (deferred — clearing
     # here would gut the recorded queue the never-take-a-flush-down
@@ -209,18 +201,16 @@ def _pass_merge(q):
         touch = {id(c) for c in item.conts}
         # producers of scalar operands this run fetches at dispatch:
         # it must execute AFTER them, so it cannot move past one
-        pending = {id(v._run) for o in item.ops for v in o.vals
-                   if isinstance(v, PlanScalar) and v._val is None
-                   and v._run is not None}
+        pending = _interf.scalar_producers(item)
         target = None
         for j in range(len(out) - 1, -1, -1):
             prev = out[j]
             if isinstance(prev, _Group):
                 runs, ptouch = prev.runs, prev.touch
             elif isinstance(prev, _Run):
-                runs, ptouch = [prev], _item_touch(prev)
+                runs, ptouch = [prev], _interf.item_touch(prev)
             else:
-                runs, ptouch = None, _item_touch(prev)
+                runs, ptouch = None, _interf.item_touch(prev)
             if runs is not None and runs[0].mesh is item.mesh \
                     and runs[0].axis == item.axis:
                 if any(id(r) in pending for r in runs):
@@ -252,41 +242,6 @@ def _pass_merge(q):
 # dead-op elimination
 # ---------------------------------------------------------------------------
 
-def _cover(cov, c, lo, hi, ghost):
-    ent = cov.get(id(c))
-    if ent is None:
-        ent = cov[id(c)] = [[], False]
-    if ghost:
-        ent[1] = True
-    if hi <= lo:
-        return
-    ivs = ent[0]
-    ivs.append((lo, hi))
-    ivs.sort()
-    out = [ivs[0]]
-    for a, b in ivs[1:]:
-        la, lb = out[-1]
-        if a <= lb:
-            out[-1] = (la, max(lb, b))
-        else:
-            out.append((a, b))
-    ent[0] = out
-
-
-def _is_covered(cov, c, off, n, needs_ghost):
-    if n <= 0:
-        return True  # an empty window writes nothing
-    ent = cov.get(id(c))
-    if ent is None:
-        return False
-    if needs_ghost and not ent[1]:
-        return False
-    for a, b in ent[0]:
-        if a <= off and off + n <= b:
-            return True
-    return False
-
-
 def _clone_run(run: _Run, ops) -> _Run:
     nr = _Run(run.mesh, run.axis)
     nr.conts = run.conts          # slot numbering stays valid
@@ -306,40 +261,20 @@ def _pass_dce(q):
     full-row killer."""
     out_rev: List = []
     removed = 0
-    cov: dict = {}
+    cov = _interf.Coverage()
     for item in reversed(q):
         if isinstance(item, _Opaque):
-            if item.reads is None or item.writes is None:
-                cov.clear()
-            else:
-                for c in item.reads:
-                    cov.pop(id(c), None)
-                rid = {id(c) for c in item.reads}
-                for c, full in item.writes:
-                    if full and id(c) not in rid:
-                        _cover(cov, c, 0, len(c), True)
+            cov.visit_opaque(item)
             out_rev.append(item)
             continue
         kept = []
         changed = False
         for o in reversed(item.ops):
-            if o.pure and o.writes and not o.pre and all(
-                    _is_covered(cov, item.conts[s], off, n, full)
-                    for (s, off, n, full) in o.writes):
+            if cov.op_dead(item, o):
                 removed += 1
                 changed = True
                 continue
-            rid = {id(item.conts[s]) for s in o.reads}
-            for s in o.reads:
-                cov.pop(id(item.conts[s]), None)
-            for (s, off, n, full) in o.writes:
-                c = item.conts[s]
-                if id(c) in rid:
-                    continue
-                if full:
-                    _cover(cov, c, 0, len(c), True)
-                else:
-                    _cover(cov, c, off, off + n, False)
+            cov.visit_op(item, o)
             kept.append(o)
         if not changed:
             out_rev.append(item)
@@ -353,29 +288,6 @@ def _pass_dce(q):
 # projection pushdown into the relational scratch-sort copy
 # ---------------------------------------------------------------------------
 
-def _events(q):
-    """Linearized touch events, execution order: ``(kind, cont_id,
-    item_index, op_or_None, full)`` with ``kind`` in {"r", "w",
-    "barrier"} (barriers carry cont_id None)."""
-    ev = []
-    for qi, item in enumerate(q):
-        if isinstance(item, _Opaque):
-            if item.reads is None or item.writes is None:
-                ev.append(("barrier", None, qi, None, False))
-                continue
-            for c in item.reads:
-                ev.append(("r", id(c), qi, None, False))
-            for c, full in item.writes:
-                ev.append(("w", id(c), qi, None, full))
-            continue
-        for o in item.ops:
-            for s in o.reads:
-                ev.append(("r", id(item.conts[s]), qi, o, False))
-            for (s, off, n, full) in o.writes:
-                ev.append(("w", id(item.conts[s]), qi, o, full))
-    return ev
-
-
 def _pushdown_one(q, item, name, chain):
     """Try to push the producer of input channel ``name`` (a plain
     whole/sub-range over ``cont``) into the relational scratch copy.
@@ -384,7 +296,7 @@ def _pushdown_one(q, item, name, chain):
     cont, off, n, plain = chain
     if not plain or n <= 0:
         return False
-    ev = _events(q)
+    ev = _interf.events(q)
     qi = q.index(item)
     own = [i for i, e in enumerate(ev) if e[2] == qi]
     if not own:

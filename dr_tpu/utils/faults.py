@@ -114,6 +114,13 @@ serve.journal        resident-state journal ops     transient, program
                      durability warned, never the
                      request; a replay fault starts
                      the daemon on an empty cache)
+sanitize.verify      plansan verification per       transient, program
+                     flush (plan/__init__.flush —
+                     after plan.flush, before the
+                     oracle and any dispatch; a
+                     fault fails the flush
+                     classified with nothing
+                     executed)
 fallback.warn        utils/fallback.warn_fallback   (counting only)
 ===================  ============================  =======================
 
@@ -232,6 +239,15 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # degrades that dispatch to the portable XLA route (warned,
     # counted), never a crash — the kernels are an optimization tier.
     "kernel.build": ("transient", "program"),
+    # plansan footprint verifier (docs/SPEC.md §23): fires on EVERY
+    # plan flush right after plan.flush, before the serializability
+    # oracle runs and before any dispatch — a faulted verification
+    # surfaces classified with nothing executed and containers exactly
+    # as recorded (the same "faulted flush executes nothing" contract
+    # as plan.flush); the verifier itself only checks under
+    # DR_TPU_SANITIZE=1 but the site fires unconditionally so the
+    # chaos battery reaches it unarmed.
+    "sanitize.verify": ("transient", "program"),
     "fallback.warn": (),
 }
 

@@ -44,6 +44,7 @@ gives every test its own epoch (``reset_epoch`` / ``check_recompiles``).
 from __future__ import annotations
 
 import re
+import threading
 from collections import Counter
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -52,7 +53,8 @@ from .env import env_flag, env_int
 
 __all__ = ["SanitizeError", "enabled", "install", "installed",
            "reset_epoch", "check_recompiles", "zero_recompile",
-           "check_finite", "is_finite", "recompile_counts"]
+           "check_finite", "is_finite", "recompile_counts",
+           "watch_containers"]
 
 
 class SanitizeError(AssertionError):
@@ -153,6 +155,62 @@ def zero_recompile(what: str = "region"):
             f"{what}: {grew} program compile(s) inside a "
             "zero-recompile region — the re-record path misses its "
             "cache (value-keyed key or drifting key structure)")
+
+
+# ---------------------------------------------------------------------------
+# container-access watching (the plansan opaque-footprint verifier,
+# docs/SPEC.md §23.3)
+# ---------------------------------------------------------------------------
+
+#: module-global fast gates for the instrumented containers: a
+#: ``_data`` property pays ONE None check while no watcher is armed
+#: anywhere; armed, the dispatchers below route to the PER-THREAD
+#: watcher (the serve daemon's dispatch thread must not observe the
+#: host thread's opaque thunk, and vice versa).
+_access_hook = None
+_born_hook = None
+_watch_tls = threading.local()
+_watch_lock = threading.Lock()
+_watchers = 0
+
+
+def _dispatch_access(kind: str, cont) -> None:
+    h = getattr(_watch_tls, "access", None)
+    if h is not None:
+        h(kind, cont)
+
+
+def _dispatch_born(cont) -> None:
+    h = getattr(_watch_tls, "born", None)
+    if h is not None:
+        h(cont)
+
+
+@contextmanager
+def watch_containers(access, born=None):
+    """Arm a container-access watcher ON THIS THREAD for the enclosed
+    block: instrumented containers report every ``_data`` read
+    (``access("r", cont)``), every rebind (``access("w", cont)``), and
+    every container CREATION (``born(cont)``) — the plansan opaque
+    verifier's observation channel.  Nests (the previous watcher is
+    restored); other threads stay unobserved."""
+    global _access_hook, _born_hook, _watchers
+    prev = (getattr(_watch_tls, "access", None),
+            getattr(_watch_tls, "born", None))
+    _watch_tls.access, _watch_tls.born = access, born
+    with _watch_lock:
+        _watchers += 1
+        _access_hook = _dispatch_access
+        _born_hook = _dispatch_born
+    try:
+        yield
+    finally:
+        _watch_tls.access, _watch_tls.born = prev
+        with _watch_lock:
+            _watchers -= 1
+            if not _watchers:
+                _access_hook = None
+                _born_hook = None
 
 
 def is_finite(arr) -> bool:

@@ -1,0 +1,11 @@
+"""R10 bad twin: a would-be optimizer pass hand-rolls its own aliasing
+logic by reading item footprints directly."""
+# drlint: scope=dr_tpu/plan/r10_fixture.py — judge this fixture under
+# the dr_tpu/plan/ serialization-dependency discipline
+
+
+def pass_swap(q):
+    a, b = q
+    if not (set(a.writes) & set(b.reads)):
+        return [b, a]
+    return q

@@ -1,0 +1,14 @@
+"""R10 clean twin: the same reorder decision routed through the one
+interference-graph helper."""
+# drlint: scope=dr_tpu/plan/r10_fixture.py — same effective relpath as
+# the bad twin, so cleanliness is proven under the same discipline
+
+from . import interference as _interf
+
+
+def pass_swap(q):
+    a, b = q
+    ta, tb = _interf.item_touch(a), _interf.item_touch(b)
+    if ta is not None and tb is not None and not (ta & tb):
+        return [b, a]
+    return q

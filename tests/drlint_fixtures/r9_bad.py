@@ -1,0 +1,20 @@
+"""R9 bad twin: plan-item record sites without derived footprints —
+a _FusedOp with no declaration at all, one whose slots are NOT derived
+from the run's operands, and a record_opaque missing its writes."""
+# drlint: scope=package — R9 only applies inside dr_tpu/; judge this
+# fixture as package code under a direct CLI scan too
+
+
+def record_fill(run, cont, value):
+    slot = run.slot(cont)
+    run.ops.append(_FusedOp("fill", ("fill",), None, ("t",), (value,)))
+
+
+def record_axpy(run, cont, alpha):
+    idx = alpha + 1    # an operand value, not a slot
+    run.ops.append(_FusedOp("axpy", ("axpy",), None, reads=(idx,),
+                            writes=((idx, 0, 4, False),)))
+
+
+def record_scan(plan, cont):
+    plan.record_opaque("scan", lambda: None, reads=(cont,))

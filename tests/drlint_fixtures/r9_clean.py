@@ -1,0 +1,36 @@
+"""R9 clean twin: every footprint slot chases to a run.slot(…) result
+(through tuple-unpacking and conditionals), opaque footprints name
+their containers, and the barrier opt-in is EXPLICIT."""
+# drlint: scope=package — same scope as the bad twin, so cleanliness
+# is proven under the package-scoped rules
+
+
+def record_fill(run, cont, value, n):
+    slot = run.slot(cont)
+    run.ops.append(_FusedOp("fill", ("fill",), None, ("t",), (value,),
+                            writes=((slot, 0, n, False),), pure=True))
+
+
+def record_dot(run, a, b, maybe):
+    sa, sb = run.slot(a), run.slot(b)
+    sm = run.slot(maybe) if maybe is not None else None
+    run.ops.append(_FusedOp("dot", ("dot",), None,
+                            reads=(sa, sb) + ((sm,) if sm is not None
+                                              else ())))
+
+
+def record_foreach(run, outs):
+    out_slots = tuple(run.slot(c) for c in outs)
+    run.ops.append(_FusedOp(
+        "foreach", ("foreach",), None, reads=out_slots,
+        writes=tuple((s, 0, 4, False) for s in out_slots)))
+
+
+def record_scan(plan, in_cont, out):
+    plan.record_opaque("scan", lambda: None, reads=(in_cont, out),
+                       writes=((out, False),))
+
+
+def record_mystery(plan, thunk):
+    # the documented barrier opt-in: UNKNOWN footprints, declared so
+    plan.record_opaque("mystery", thunk, reads=None, writes=None)

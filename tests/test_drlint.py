@@ -73,6 +73,31 @@ def test_r6_catches_both_shapes():
     assert "plain dict" in msgs and "immediately-invoked" in msgs
 
 
+def test_r9_fires_on_bad_silent_on_clean():
+    """All three per-site shapes fire on the bad twin (footprint-less
+    _FusedOp, underived reads/writes, record_opaque missing writes);
+    the derivation chaser accepts the clean twin's tuple-unpack,
+    IfExp, concatenation, genexp, and explicit-barrier forms."""
+    bad = [f for f in _scan("r9_bad.py") if f.rule == "R9"]
+    msgs = " | ".join(f.msg for f in bad)
+    assert "no reads=/writes=" in msgs, bad
+    assert "reads= is not derived" in msgs, bad
+    assert "writes= is not derived" in msgs, bad
+    assert "record_opaque without writes" in msgs, bad
+    assert _scan("r9_clean.py") == []
+
+
+def test_r10_path_scope_fires_on_bad_silent_on_clean():
+    """R10 applies under the EFFECTIVE dr_tpu/plan/ relpath — the
+    twins opt in via the path-valued scope pragma; direct
+    .reads/.writes loads fire, the interference-helper route is
+    silent."""
+    bad = [f for f in _scan("r10_bad.py") if f.rule == "R10"]
+    assert len(bad) == 2, bad
+    assert all("plan/interference.py" in f.msg for f in bad)
+    assert _scan("r10_clean.py") == []
+
+
 def test_outside_package_r5_r6_module_rules_do_not_apply(tmp_path):
     """The same snippets under a tests/ relpath — with the fixture's
     scope=package pragma stripped — are NOT findings (the
@@ -516,3 +541,104 @@ def test_r8_silent_when_registry_and_docs_agree(tmp_path, monkeypatch):
              drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
     lin = drlint.Linter(files, {"R8", "R0"}, full_scan=True)
     assert [f for f in lin.run() if f.rule == "R8"] == []
+
+
+# ---------------------------------------------------------------------------
+# R9: plansan footprint-family registry drift (docs/SPEC.md §23.2)
+# ---------------------------------------------------------------------------
+
+def test_r9_family_registry_drift(tmp_path, monkeypatch):
+    """Every closure direction fires: a family naming a nonexistent
+    record method, a record method missing from FAMILIES, an
+    undocumented family, a stale §23.2 row, a missing mutation
+    battery, a fuzz file without the oracle arm, and an unregistered
+    sanitize.verify fault site."""
+    ps = tmp_path / "plansan.py"
+    ps.write_text(
+        'FAMILIES = (\n'
+        '    ("generator", "record_fill"),\n'
+        '    ("mystery", "record_mystery"),\n'
+        ')\n', encoding="utf-8")
+    plan = tmp_path / "plan_init.py"
+    plan.write_text(
+        "class Plan:\n"
+        "    def record_fill(self):\n        pass\n"
+        "    def record_extra(self):\n        pass\n", encoding="utf-8")
+    _write_r8_faults(tmp_path, ["plan.flush"])
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "SPEC.md").write_text(
+        "### 23.2 The family table\n"
+        "| family | declares | verifier checks |\n"
+        "| `generator` | x | x |\n"
+        "| `stale` | x | x |\n"
+        "## 24. next\n", encoding="utf-8")
+    fuzz = tmp_path / "fuzz.py"
+    fuzz.write_text("def test_fuzz_plan_opt():\n    pass\n",
+                    encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    files = [drlint.FileInfo(str(ps), "dr_tpu/plan/plansan.py"),
+             drlint.FileInfo(str(plan), "dr_tpu/plan/__init__.py"),
+             drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
+    lin = drlint.Linter(files, {"R9", "R0"}, full_scan=True)
+    msgs = [f.msg for f in lin.run() if f.rule == "R9"]
+    text = " ".join(msgs)
+    assert "'record_mystery'" in text        # family -> missing method
+    assert "'record_extra'" in text          # method -> missing family
+    assert "'mystery'" in text and "§23.2" in text   # undocumented
+    assert "'stale'" in text                 # documented, unregistered
+    assert "test_plansan.py does not exist" in text
+    assert "test_fuzz_plansan" in text
+    assert "'sanitize.verify'" in text
+
+
+def test_r9_silent_when_registry_and_docs_agree(tmp_path, monkeypatch):
+    ps = tmp_path / "plansan.py"
+    ps.write_text('FAMILIES = (("generator", "record_fill"),)\n'
+                  'FAMILY_NAMES = tuple(f for f, _m in FAMILIES)\n',
+                  encoding="utf-8")
+    plan = tmp_path / "plan_init.py"
+    plan.write_text("class Plan:\n    def record_fill(self):\n"
+                    "        pass\n", encoding="utf-8")
+    _write_r8_faults(tmp_path, ["sanitize.verify"])
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "SPEC.md").write_text(
+        "### 23.2 The family table\n| `generator` | x | x |\n",
+        encoding="utf-8")
+    bat = tmp_path / "bat.py"
+    bat.write_text("from dr_tpu.plan.plansan import FAMILY_NAMES\n"
+                   "def test_families():\n    pass\n", encoding="utf-8")
+    fuzz = tmp_path / "fuzz.py"
+    fuzz.write_text("def test_fuzz_plansan():\n    pass\n",
+                    encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    files = [drlint.FileInfo(str(ps), "dr_tpu/plan/plansan.py"),
+             drlint.FileInfo(str(plan), "dr_tpu/plan/__init__.py"),
+             drlint.FileInfo(str(bat), "tests/test_plansan.py"),
+             drlint.FileInfo(str(fuzz), "tests/test_fuzz.py")]
+    lin = drlint.Linter(files, {"R9", "R0"}, full_scan=True)
+    assert [f for f in lin.run() if f.rule == "R9"] == []
+
+
+# ---------------------------------------------------------------------------
+# baseline staleness: a dead suppression fails a FULL scan; --prune
+# burns it down (partial scans only note — see test_baseline_burn_down)
+# ---------------------------------------------------------------------------
+
+def test_stale_baseline_fails_full_scan_and_prunes(tmp_path, monkeypatch):
+    pkg = tmp_path / "dr_tpu"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text('import warnings\nwarnings.warn("boo")\n',
+                   encoding="utf-8")
+    (tmp_path / "bench.py").write_text("", encoding="utf-8")
+    (tmp_path / "__graft_entry__.py").write_text("", encoding="utf-8")
+    monkeypatch.setattr(drlint, "REPO", str(tmp_path))
+    base = tmp_path / "base.json"
+    args = ["--baseline", str(base), "--rules", "R5"]
+    assert drlint.main(args + ["--write-baseline"]) == 0
+    assert drlint.main(args + ["--check"]) == 0    # fires, baselined
+    mod.write_text("x = 1\n", encoding="utf-8")    # "fix" the finding
+    assert drlint.main(args + ["--check"]) == 1    # stale FAILS full scan
+    assert drlint.main(args + ["--check", "--prune"]) == 0
+    assert json.loads(base.read_text())["findings"] == {}
+    assert drlint.main(args + ["--check"]) == 0    # burned down

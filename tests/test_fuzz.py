@@ -1939,6 +1939,152 @@ def test_fuzz_plan_opt(seed, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# plansan: armed shadow-verifier + serializability oracle (SPEC §23)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, pytest.param(1, marks=pytest.mark.slow)])
+def test_fuzz_plansan(seed):
+    """§23 PLANSAN arm (tools/fuzz_crank.sh): seeded random recorded
+    chains — fusible fills / transforms / reduce / dot / histogram /
+    top_k / redistribute, the opaque scan, and the relational auto op
+    (born-container exemption coverage) — flushed ARMED (the
+    within-process equivalent of ``DR_TPU_SANITIZE=1``): the shadow
+    verifier abstractly replays every fused run against its declared
+    footprint, the container watcher wraps every opaque thunk, and the
+    conflict-serializability oracle proves each optimized queue
+    conflict-equivalent to its recorded order — under a RANDOM pass
+    subset via ``DR_TPU_PLAN_OPT_DISABLE`` so every §21 pass
+    combination faces the oracle, not just all-on/all-off.  Green
+    means honest record sites never classify; the other direction
+    (each family's seeded under-declaration CAUGHT) is the
+    tests/test_plansan.py mutation battery.  An unarmed control run on
+    identical inputs pins bit-identity: plansan is observation-only."""
+    import jax
+
+    from dr_tpu import tuning
+    from dr_tpu.plan import opt as plan_opt
+    from dr_tpu.utils import sanitize, spmd_guard
+
+    rng = np.random.default_rng(2300 + seed)
+    iters = ITERS if env_raw("DR_TPU_FUZZ_ITERS") is not None \
+        else ITERS // 2
+    pass_names = plan_opt.PASS_NAMES
+    for it in range(max(3, iters // 8)):
+        P = min(int(rng.integers(1, 9)), len(jax.devices()))
+        dr_tpu.init(jax.devices()[:P])
+        n = int(rng.integers(8, 65))
+        nk = int(rng.integers(4, 33))
+        srcs = {
+            "a": rng.standard_normal(n).astype(np.float32),
+            "b": rng.standard_normal(n).astype(np.float32),
+            "k": rng.integers(0, max(2, nk // 3),
+                              nk).astype(np.float32),
+        }
+        kinds = ["fill", "subfill", "xform", "foreach", "reduce",
+                 "dot", "scan", "hist", "topk", "uniq"]
+        if P > 1:
+            kinds.append("rdx")
+        ops = []
+        for _ in range(int(rng.integers(3, 8))):
+            ops.append((str(rng.choice(kinds)),
+                        float(np.round(rng.standard_normal(), 3)),
+                        int(rng.integers(0, n + 1)),
+                        int(rng.integers(0, n + 1))))
+        # a random SUBSET of passes disabled — the oracle must hold
+        # for every pass combination, not just the bisection pairs
+        sub = [p for p in pass_names if rng.integers(0, 2) == 0]
+        disable = ",".join(sub) if sub else None
+        tag = f"seed={seed} it={it} P={P} n={n} nk={nk} " \
+              f"disable={disable} ops={ops}"
+
+        def rand_dist():
+            cuts = np.sort(rng.integers(0, n + 1, size=P - 1))
+            bounds = np.concatenate(([0], cuts, [n]))
+            return tuple(int(y - x)
+                         for x, y in zip(bounds[:-1], bounds[1:]))
+
+        dists = [rand_dist() if P > 1 else None for _ in range(4)]
+
+        def run(armed):
+            """One full chain on fresh containers, the plansan layer
+            armed or not; returns (container arrays, scalars,
+            relational results)."""
+            tuning.clear_session()
+            conts = {nm: dr_tpu.distributed_vector.from_array(s)
+                     for nm, s in srcs.items()}
+            hb = dr_tpu.distributed_vector(8, np.int32)
+            kk = min(5, n)
+            tv = dr_tpu.distributed_vector(kk, np.float32)
+            ti = dr_tpu.distributed_vector(kk, np.int32)
+            scal, autos, di = [], [], 0
+            prev = (sanitize._installed, spmd_guard._compile_hook,
+                    spmd_guard._canon_check_hook)
+            if armed:
+                spmd_guard._compile_hook = sanitize._on_compile
+                spmd_guard._canon_check_hook = sanitize._on_record
+                sanitize._installed = True
+                sanitize.reset_epoch()
+            try:
+                with env_override(DR_TPU_PLAN_OPT="all",
+                                  DR_TPU_PLAN_OPT_DISABLE=disable):
+                    with dr_tpu.deferred():
+                        for kind, c, i0, i1 in ops:
+                            a, b = conts["a"], conts["b"]
+                            if kind == "fill":
+                                dr_tpu.fill(a, c)
+                            elif kind == "subfill":
+                                lo, hi = min(i0, i1), max(i0, i1)
+                                dr_tpu.fill(b[lo:hi], c)
+                            elif kind == "xform":
+                                dr_tpu.transform(a, b, _po_shift, c)
+                            elif kind == "foreach":
+                                dr_tpu.for_each(a, _po_scale, c)
+                            elif kind == "reduce":
+                                scal.append(dr_tpu.reduce(b))
+                            elif kind == "dot":
+                                scal.append(dr_tpu.dot(a, b))
+                            elif kind == "scan":
+                                dr_tpu.inclusive_scan(a, b)
+                            elif kind == "hist":
+                                dr_tpu.histogram(a, hb, -4.0, 4.0)
+                            elif kind == "topk":
+                                dr_tpu.top_k(a, tv, ti)
+                            elif kind == "rdx":
+                                dr_tpu.redistribute(
+                                    conts["a"],
+                                    dists[di % len(dists)])
+                                di += 1
+                            else:  # uniq
+                                autos.append(
+                                    dr_tpu.unique_auto(conts["k"]))
+                    out_c = {nm: dr_tpu.to_numpy(v)
+                             for nm, v in conts.items()}
+                    out_c["hb"] = dr_tpu.to_numpy(hb)
+                    out_c["tv"] = dr_tpu.to_numpy(tv)
+                    out_c["ti"] = dr_tpu.to_numpy(ti)
+                    out_s = [float(s) for s in scal]
+                    out_r = [(r.count, [np.asarray(x)
+                                        for x in r.arrays()])
+                             for r in autos]
+            finally:
+                (sanitize._installed, spmd_guard._compile_hook,
+                 spmd_guard._canon_check_hook) = prev
+            return out_c, out_s, out_r
+
+        base_c, base_s, base_r = run(armed=False)
+        got_c, got_s, got_r = run(armed=True)
+        for nm in base_c:
+            np.testing.assert_array_equal(
+                base_c[nm], got_c[nm], err_msg=f"{tag}: {nm}")
+        assert base_s == got_s, f"{tag}: scalars"
+        assert len(base_r) == len(got_r), tag
+        for (bm, barrs), (gm, garrs) in zip(base_r, got_r):
+            assert bm == gm, f"{tag}: relational count {bm} != {gm}"
+            for ba, ga in zip(barrs, garrs):
+                np.testing.assert_array_equal(ba, ga, err_msg=tag)
+
+
+# ---------------------------------------------------------------------------
 # On-chip kernel tier (docs/SPEC.md §22): pallas-vs-xla arm parity
 # ---------------------------------------------------------------------------
 

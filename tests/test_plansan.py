@@ -1,0 +1,357 @@
+"""plansan — footprint-soundness mutation battery + oracle acceptance
+(docs/SPEC.md §23).
+
+Every footprint family in ``plansan.FAMILY_NAMES`` gets ONE seeded
+under-declaration the shadow verifier must classify as a
+:class:`FootprintViolation` (drlint rule R9 closes the sweep against
+the registry both ways); the conflict-serializability oracle catches
+seeded reorders of conflicting work; and the gemv view-operand
+footprint keeps the §21.2 ``flush_reads`` skip from worst-case
+flushing.  The verifier and watcher are exercised DIRECTLY (they do
+not require ``DR_TPU_SANITIZE=1`` arming) — the armed end-to-end
+route rides ``make sanitize`` and the ``test_fuzz_plansan`` arm.
+"""
+
+import numpy as np
+import pytest
+
+import dr_tpu
+from dr_tpu import plan as dr_plan
+from dr_tpu import views
+from dr_tpu.plan import interference, plansan
+from dr_tpu.utils import sanitize
+
+
+# module-level ops: program-cache keys pin callable identity
+def _scale(x, c):
+    return x * c
+
+
+def _swap_sum(x, y):
+    return (x + y, x - y)
+
+
+def _double(x):
+    return x * 2
+
+
+def _runs(p):
+    return [it for it in p._queue if isinstance(it, dr_plan._Run)]
+
+
+def _assert_catches(run, op):
+    """The declared footprint verifies clean; the seeded
+    under-declaration is a classified FootprintViolation carrying the
+    trace-tail postmortem; the restore verifies clean again."""
+    plansan.verify_run(run)
+    orig_r, orig_w = op.reads, op.writes
+    if op.writes:
+        op.writes = ()
+    else:
+        op.reads = ()
+    try:
+        with pytest.raises(plansan.FootprintViolation) as ei:
+            plansan.verify_run(run)
+    finally:
+        op.reads, op.writes = orig_r, orig_w
+    assert isinstance(ei.value, sanitize.SanitizeError)
+    assert hasattr(ei.value, "trace_tail")
+    assert "R9" in str(ei.value)
+    plansan.verify_run(run)
+
+
+def _fused_driver(record, opname=None):
+    """Record inside a deferred region, under-declare one fused op,
+    assert the verifier catches it, restore, and let the exit flush
+    run the UNmutated plan to completion."""
+    with dr_tpu.deferred() as p:
+        record()
+        run = _runs(p)[-1]
+        op = run.ops[-1] if opname is None else \
+            next(o for o in run.ops if o.name == opname)
+        _assert_catches(run, op)
+
+
+# ---------------------------------------------------------------------------
+# one seeded under-declaration per footprint family
+# ---------------------------------------------------------------------------
+
+def _drive_generator():
+    n = 8 * dr_tpu.nprocs()
+    v = dr_tpu.distributed_vector(n, np.float32)
+    _fused_driver(lambda: dr_tpu.fill(v, 2.0))
+
+
+def _drive_transform():
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector(n, np.float32)
+    _fused_driver(lambda: dr_tpu.transform(a, b, _scale, 1.5))
+
+
+def _drive_zip_foreach():
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32) + 1)
+    _fused_driver(lambda: dr_tpu.for_each(views.zip(a, b), _swap_sum))
+
+
+def _drive_reduce():
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    _fused_driver(lambda: dr_tpu.reduce(a))
+
+
+def _drive_splice():
+    n = 8 * dr_tpu.nprocs()
+    v = dr_tpu.distributed_vector(n, np.float32)
+    src = np.arange(n, dtype=np.float32)
+    _fused_driver(lambda: dr_tpu.copy(src, v))
+
+
+def _drive_halo():
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    n = 8 * dr_tpu.nprocs()
+    v = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32), halo=hb)
+    _fused_driver(lambda: dr_tpu.halo(v).exchange())
+
+
+def _drive_stencil():
+    hb = dr_tpu.halo_bounds(1, 1, periodic=True)
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32), halo=hb)
+    b = dr_tpu.distributed_vector.from_array(
+        np.zeros(n, dtype=np.float32), halo=hb)
+    _fused_driver(
+        lambda: dr_tpu.stencil_transform(a, b, [0.25, 0.5, 0.25]))
+
+
+def _drive_redistribute():
+    P = dr_tpu.nprocs()
+    n = 4 * P
+    v = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    team = [n] + [0] * (P - 1)
+    _fused_driver(lambda: dr_tpu.redistribute(v, team))
+
+
+def _drive_histogram():
+    n = 8 * dr_tpu.nprocs()
+    vv = dr_tpu.distributed_vector.from_array(
+        np.linspace(-2.0, 2.0, n, dtype=np.float32))
+    out = dr_tpu.distributed_vector(9, np.int32)
+    _fused_driver(lambda: dr_tpu.histogram(vv, out, -2.5, 2.5))
+
+
+def _drive_top_k():
+    n = 8 * dr_tpu.nprocs()
+    vv = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    tv = dr_tpu.distributed_vector(3, np.float32)
+    ti = dr_tpu.distributed_vector(3, np.int32)
+    _fused_driver(lambda: dr_tpu.top_k(vv, tv, ti))
+
+
+def _drive_opaque():
+    """The opaque half rides the container-access watcher instead of
+    the abstract replay: under-declare the scan's write of ``out`` and
+    run its thunk under ``plansan.watch``."""
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.inclusive_scan(a, b)
+        [item] = [it for it in p._queue
+                  if isinstance(it, dr_plan._Opaque)]
+        orig_r, orig_w = item.reads, item.writes
+        item.writes = ()   # under-declare (NOT None — that is the
+        try:               # documented barrier opt-out)
+            with pytest.raises(plansan.FootprintViolation) as ei:
+                with plansan.watch(item):
+                    item.thunk()
+        finally:
+            item.reads, item.writes = orig_r, orig_w
+        assert hasattr(ei.value, "trace_tail")
+        assert "R9" in str(ei.value)
+        # the declared footprint passes the same watcher
+        with plansan.watch(item):
+            item.thunk()
+    np.testing.assert_allclose(
+        dr_tpu.to_numpy(b),
+        np.cumsum(np.arange(n, dtype=np.float32)))
+
+
+_DRIVERS = {
+    "generator": _drive_generator,
+    "transform": _drive_transform,
+    "zip_foreach": _drive_zip_foreach,
+    "reduce": _drive_reduce,
+    "splice": _drive_splice,
+    "halo": _drive_halo,
+    "stencil": _drive_stencil,
+    "redistribute": _drive_redistribute,
+    "histogram": _drive_histogram,
+    "top_k": _drive_top_k,
+    "opaque": _drive_opaque,
+}
+
+
+def test_battery_covers_every_family():
+    """The R9 closure contract: the battery sweeps the registry."""
+    assert set(_DRIVERS) == set(plansan.FAMILY_NAMES)
+
+
+@pytest.mark.parametrize("family", sorted(_DRIVERS))
+def test_mutation_battery_catches_underdeclaration(family):
+    _DRIVERS[family]()
+
+
+def test_barrier_opaque_is_exempt_from_the_watcher():
+    """A declared barrier (None footprint) already pays the worst case
+    in every pass — the watcher must not second-guess it."""
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.inclusive_scan(a, b)
+        [item] = list(p._queue)
+        orig_r, orig_w = item.reads, item.writes
+        item.reads = item.writes = None
+        try:
+            with plansan.watch(item):   # no violation
+                item.thunk()
+        finally:
+            item.reads, item.writes = orig_r, orig_w
+
+
+# ---------------------------------------------------------------------------
+# conflict-serializability oracle
+# ---------------------------------------------------------------------------
+
+def test_oracle_catches_intra_run_reorder():
+    """fill -> transform fuse into ONE run; reversing the op order
+    inside it breaks the W->R dependency on the filled container."""
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 2.0)
+        dr_tpu.transform(a, b, _scale, 3.0)
+        [run] = _runs(p)
+        snap = plansan.snapshot(p._queue)
+        plansan.check_serializable(snap, list(p._queue))  # as recorded
+        run.ops.reverse()
+        try:
+            with pytest.raises(plansan.SerializationViolation,
+                               match="data") as ei:
+                plansan.check_serializable(snap, list(p._queue))
+        finally:
+            run.ops.reverse()
+        assert hasattr(ei.value, "trace_tail")
+    np.testing.assert_allclose(dr_tpu.to_numpy(b), np.full(n, 6.0))
+
+
+def test_oracle_catches_opaque_queue_reorder():
+    """Two chained scans (W b -> R b) are opaque queue items; swapping
+    them breaks the dependency."""
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector(n, np.float32)
+    c = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.inclusive_scan(a, b)
+        dr_tpu.inclusive_scan(b, c)
+        snap = plansan.snapshot(p._queue)
+        plansan.check_serializable(snap, list(p._queue))
+        with pytest.raises(plansan.SerializationViolation, match="data"):
+            plansan.check_serializable(snap, list(p._queue)[::-1])
+
+
+def test_oracle_barrier_orders_against_everything():
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 1.0)
+        p.record_opaque("mystery", lambda: None)   # None = barrier
+        snap = plansan.snapshot(p._queue)
+        plansan.check_serializable(snap, list(p._queue))
+        with pytest.raises(plansan.SerializationViolation,
+                           match="barrier"):
+            plansan.check_serializable(snap, list(p._queue)[::-1])
+
+
+def test_oracle_dropped_ops_are_unconstrained():
+    """Dead-eliminated ops simply vanish from the executed queue — the
+    oracle constrains ordering, not liveness (bit-identity owns that)."""
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector(n, np.float32)
+    b = dr_tpu.distributed_vector(n, np.float32)
+    with dr_tpu.deferred() as p:
+        dr_tpu.fill(a, 2.0)
+        dr_tpu.transform(a, b, _scale, 3.0)
+        snap = plansan.snapshot(p._queue)
+        plansan.check_serializable(snap, [])       # everything dropped
+
+
+# ---------------------------------------------------------------------------
+# view-operand footprints (satellite: flush_reads stops worst-case
+# flushing on opaque barriers it can now resolve)
+# ---------------------------------------------------------------------------
+
+def test_view_containers_resolves_chains_and_keeps_barriers():
+    n = 8 * dr_tpu.nprocs()
+    a = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32))
+    b = dr_tpu.distributed_vector.from_array(
+        np.arange(n, dtype=np.float32) + 1)
+    got = interference.view_containers(views.take(a, 4))
+    assert [id(x) for x in got] == [id(a)]
+    got = interference.view_containers(
+        views.transform(views.zip(a, b), _swap_sum))
+    assert [id(x) for x in got] == [id(a), id(b)]
+    assert interference.view_containers(object()) is None
+
+
+def test_gemv_view_footprint_skips_unrelated_flush():
+    """A gemv over a transform VIEW used to record a full barrier —
+    every host touch paid the flush cliff.  The resolved base-chain
+    footprint lets ``flush_reads`` skip unrelated containers and
+    still flush for the view's base."""
+    P = dr_tpu.nprocs()
+    m = ncols = 4 * P
+    rng = np.random.default_rng(5)
+    nnz = 3 * m
+    rows = rng.integers(0, m, size=nnz)
+    cols = rng.integers(0, ncols, size=nnz)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    A = dr_tpu.sparse_matrix.from_coo((m, ncols), rows, cols, vals)
+    csrc = rng.standard_normal(m).astype(np.float32)
+    bsrc = rng.standard_normal(ncols).astype(np.float32)
+    c = dr_tpu.distributed_vector.from_array(csrc)
+    b = dr_tpu.distributed_vector.from_array(bsrc)
+    unrelated = dr_tpu.distributed_vector(4 * P, np.float32)
+    tview = views.transform(b, _double)
+    with dr_tpu.deferred() as p:
+        dr_tpu.gemv(c, A, tview)
+        [item] = list(p._queue)
+        reads = interference.opaque_reads(item)
+        assert reads is not None, "view operand must not be a barrier"
+        assert id(b) in {id(x) for x in reads}
+        dr_plan.flush_reads(cont=unrelated)
+        assert len(p._queue) == 1      # provably untouched: skipped
+        dr_plan.flush_reads(cont=b)
+        assert len(p._queue) == 0      # the view's base flushes
+    ref = csrc.astype(np.float64)
+    np.add.at(ref, rows,
+              vals.astype(np.float64) * (2.0 * bsrc.astype(np.float64))[cols])
+    np.testing.assert_allclose(dr_tpu.to_numpy(c), ref,
+                               rtol=1e-3, atol=1e-4)

@@ -45,6 +45,23 @@ R7    plan-opt registry: every optimizer pass registered in
       ``PASS_NAMES``, or names each pass) — both drift directions;
       registration itself is the per-pass disable flag
       (``DR_TPU_PLAN_OPT_DISABLE`` keys on the registered name).
+R9    footprint-closure: every plan-item record site in ``dr_tpu/``
+      declares its footprint — a ``_FusedOp(…)`` construction passes
+      ``reads=``/``writes=`` whose slot positions are DERIVED from the
+      op's actual traced operands (``run.slot(…)`` results, chased
+      through local assignments — the R1 machinery pointed at
+      footprints), and a ``record_opaque(…)`` call provides BOTH
+      ``reads`` and ``writes`` (an explicit ``None`` is the documented
+      barrier opt-in).  Whole-repo closure à la R3/R7/R8: the
+      ``plansan.FAMILIES`` registry ↔ the ``Plan.record_*`` methods ↔
+      the SPEC §23.2 family table ↔ the mutation battery
+      (``tests/test_plansan.py``) ↔ the ``test_fuzz_plansan`` arm,
+      both drift directions, plus the ``sanitize.verify`` fault site.
+R10   serialization-dependency: code under ``dr_tpu/plan/`` must not
+      interpret ``.reads``/``.writes`` footprints itself — every
+      aliasing/ordering decision routes through
+      ``plan/interference.py`` (the one interference-graph helper),
+      so no future pass can hand-roll its own aliasing logic.
 ====  =====================================================================
 
 Suppressions: ``# drlint: ok[R2] <reason>`` on the finding's line, or on
@@ -56,12 +73,20 @@ R0).
 Scope pragma: ``# drlint: scope=package`` in a file's first lines makes
 the package-scoped rules (R5, the R6 module checks) apply to it even
 outside ``dr_tpu/`` — fixture twins declare it so a direct CLI scan
-judges them exactly as the faked-relpath test scan does.
+judges them exactly as the faked-relpath test scan does.  A path value
+(``# drlint: scope=dr_tpu/plan/x.py``) additionally gives the file that
+EFFECTIVE relpath for the path-scoped rules (R10) — how the R10
+fixture twins opt into the ``dr_tpu/plan/`` discipline from
+``tests/drlint_fixtures/``.
 
 Baseline: ``tools/drlint_baseline.json`` holds accepted pre-existing
 findings (keyed file::rule::message, line-number free so they survive
 drift).  ``--check`` exits non-zero on any non-baselined finding;
-``--write-baseline`` records the current findings for burn-down.
+``--write-baseline`` records the current findings for burn-down.  A
+baseline entry that no longer matches any finding is STALE and fails
+the run (a dead suppression could mask a reintroduced bug);
+``--prune`` rewrites the baseline down to the entries that still
+fire.
 
 Usage::
 
@@ -99,6 +124,8 @@ RULES = {
     "R6": "program compilation outside the TappedCache discipline",
     "R7": "plan-optimizer pass registry drift",
     "R8": "kernel-arm registry drift",
+    "R9": "plan-item record site without a derived footprint",
+    "R10": "footprint interpreted outside plan/interference.py",
 }
 
 DEFAULT_ROOTS = ("dr_tpu", "tools", "tests", "bench.py",
@@ -117,10 +144,13 @@ DATA_REDUCERS = {"item", "any", "all", "sum", "min", "max", "mean",
 CACHE_NAME_RE = re.compile(r"^_\w*cache\w*$|^\w*_cache$")
 
 SUPPRESS_RE = re.compile(
-    r"#\s*drlint:\s*ok\[(R[0-9](?:\s*,\s*R[0-9])*)\]\s*(.*)")
+    r"#\s*drlint:\s*ok\[(R[0-9]+(?:\s*,\s*R[0-9]+)*)\]\s*(.*)")
 #: opts a file outside dr_tpu/ into the package-scoped rules (R5/R6
-#: module checks); must appear in the first few lines
-SCOPE_PACKAGE_RE = re.compile(r"#\s*drlint:\s*scope=package\b")
+#: module checks); must appear in the first few lines.  A path value
+#: (``scope=dr_tpu/plan/x.py``) also gives the file that EFFECTIVE
+#: relpath for the path-scoped rules (R10).
+SCOPE_PACKAGE_RE = re.compile(
+    r"#\s*drlint:\s*scope=(package\b|[\w./-]+)")
 
 
 @dataclass
@@ -237,8 +267,18 @@ class FileInfo:
             self.src = fh.read()
         self.lines = self.src.splitlines()
         self.tree = ast.parse(self.src, filename=relpath)
-        self.in_pkg = relpath.startswith("dr_tpu/") or any(
-            SCOPE_PACKAGE_RE.search(ln) for ln in self.lines[:5])
+        scope = None
+        for ln in self.lines[:5]:
+            m = SCOPE_PACKAGE_RE.search(ln)
+            if m:
+                scope = m.group(1)
+                break
+        #: the relpath the PATH-scoped rules judge the file by: its
+        #: real location, unless a scope pragma fakes one (fixtures)
+        self.effective = relpath if scope in (None, "package") else scope
+        self.in_pkg = (relpath.startswith("dr_tpu/") or
+                       self.effective.startswith("dr_tpu/") or
+                       scope == "package")
         # parent links for ancestor walks
         self.parent: Dict[ast.AST, ast.AST] = {}
         for node in ast.walk(self.tree):
@@ -311,6 +351,7 @@ class Linter:
         self.check_fault_registry()
         self.check_plan_opt_registry()
         self.check_kernel_registry()
+        self.check_plansan_registry()
         # suppressions apply last (and R0 findings ride along)
         for fi in self.files:
             sup = Suppressions(fi.lines, fi.relpath, self.findings)
@@ -331,9 +372,14 @@ class Linter:
                       f"program cache {cname!r} is a plain dict — use "
                       "spmd_guard.TappedCache so dispatches ride the "
                       "guard tap")
+        check_r10 = ("R10" in self.rules and
+                     fi.effective.startswith("dr_tpu/plan/") and
+                     os.path.basename(fi.effective) != "interference.py")
         for node in ast.walk(fi.tree):
             if isinstance(node, ast.Call):
                 self.visit_call(fi, node, is_env_py)
+                if fi.in_pkg:
+                    self.check_record_site(fi, node)
             elif isinstance(node, ast.Subscript):
                 self.visit_subscript(fi, node, is_env_py)
             elif isinstance(node, ast.Compare):
@@ -343,6 +389,15 @@ class Linter:
             elif isinstance(node, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
                 self.check_builder(fi, node)
+            if check_r10 and isinstance(node, ast.Attribute) and \
+                    node.attr in ("reads", "writes") and \
+                    isinstance(node.ctx, ast.Load):
+                self.emit("R10", fi, node,
+                          f"footprint attribute .{node.attr} read "
+                          "inside dr_tpu/plan/ — every aliasing/"
+                          "ordering decision routes through "
+                          "plan/interference.py (the one "
+                          "interference-graph helper)")
 
     def note_env(self, var: str, fi: FileInfo, line: int) -> None:
         self.env_refs.setdefault(var, (fi.relpath, line))
@@ -723,6 +778,304 @@ class Linter:
                               "kernels.ARM_NAMES and never names: "
                               f"{', '.join(missing)}")
 
+    # --------------------------------------------------------------- R9
+    #: interference helpers whose results ARE footprints — a Name
+    #: chased onto one of these calls is derived by construction
+    _R9_HELPERS = {"remap"}
+
+    def check_record_site(self, fi: FileInfo, node: ast.Call) -> None:
+        """R9 per-site half: a ``record_opaque(…)`` call must provide
+        BOTH ``reads`` and ``writes`` (an explicit ``None`` is the
+        documented barrier opt-in); a ``_FusedOp(…)`` construction
+        must declare at least one of ``reads=``/``writes=`` and every
+        slot position in them must be DERIVED from the run's actual
+        operands (``run.slot(…)`` results chased through local
+        assignments — the R1 taint machinery pointed at footprints)."""
+        if "R9" not in self.rules:
+            return
+        name = _dotted(node.func)
+        short = name.rsplit(".", 1)[-1]
+        if short == "record_opaque":
+            provided = {kw.arg for kw in node.keywords
+                        if kw.arg in ("reads", "writes")}
+            if len(node.args) >= 3:
+                provided.add("reads")
+            if len(node.args) >= 4:
+                provided.add("writes")
+            missing = sorted({"reads", "writes"} - provided)
+            if missing:
+                self.emit("R9", fi, node,
+                          "record_opaque without "
+                          f"{' / '.join(missing)} — declare the "
+                          "containers the thunk touches, or opt into "
+                          "the barrier explicitly (reads=None/"
+                          "writes=None)")
+            return
+        if short != "_FusedOp":
+            return
+        kws = {kw.arg: kw.value for kw in node.keywords}
+        if "reads" not in kws and "writes" not in kws:
+            self.emit("R9", fi, node,
+                      "_FusedOp constructed with no reads=/writes= "
+                      "footprint — every §21 pass and the flush-cliff "
+                      "skip would treat the op as touching nothing")
+            return
+        names = self._r9_names(fi, node)
+        rd = kws.get("reads")
+        if rd is not None and not self._r9_tuple(
+                rd, names, set(), self._r9_slot, 0):
+            self.emit("R9", fi, rd,
+                      "_FusedOp reads= is not derived from the run's "
+                      "operands — every slot position must chase to a "
+                      ".slot(…) result (or an interference helper)")
+        wr = kws.get("writes")
+        if wr is not None and not self._r9_tuple(
+                wr, names, set(), self._r9_write_elem, 0):
+            self.emit("R9", fi, wr,
+                      "_FusedOp writes= is not derived from the run's "
+                      "operands — every window's slot must chase to a "
+                      ".slot(…) result (or an interference helper)")
+
+    def _r9_names(self, fi: FileInfo,
+                  node: ast.AST) -> Dict[str, List[ast.AST]]:
+        """Name -> RHS expressions bound in the call's enclosing
+        function (tuple-unpacking spreads a tuple RHS elementwise; a
+        non-tuple RHS maps onto every target — ``a, b = helper()``
+        chases both names to the call)."""
+        fn = None
+        for anc in fi.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                fn = anc
+                break
+        if fn is None:
+            fn = fi.tree
+        out: Dict[str, List[ast.AST]] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, []).append(n.value)
+                    elif isinstance(t, ast.Tuple):
+                        vals = (n.value.elts if isinstance(
+                            n.value, ast.Tuple) and
+                            len(n.value.elts) == len(t.elts)
+                            else [n.value] * len(t.elts))
+                        for te, ve in zip(t.elts, vals):
+                            if isinstance(te, ast.Name):
+                                out.setdefault(te.id, []).append(ve)
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name):
+                out.setdefault(n.target.id, []).append(n.value)
+        return out
+
+    def _r9_slot(self, e: ast.AST, names, assumed,
+                 depth: int) -> bool:
+        """One slot POSITION: a ``.slot(…)`` result, a literal, or a
+        name that chases to one through the local assignment map."""
+        if depth > 8:
+            return False
+        if isinstance(e, ast.Constant):
+            return True              # literal slot / None (absent pair)
+        if isinstance(e, ast.Call):
+            d = _dotted(e.func)
+            return d == "slot" or d.endswith(".slot")
+        if isinstance(e, ast.IfExp):
+            return (self._r9_slot(e.body, names, assumed, depth + 1) and
+                    self._r9_slot(e.orelse, names, assumed, depth + 1))
+        if isinstance(e, ast.Name):
+            if e.id in assumed:
+                return True
+            rhss = names.get(e.id)
+            return bool(rhss) and all(
+                self._r9_slot(r, names, assumed, depth + 1)
+                for r in rhss)
+        return False
+
+    def _r9_write_elem(self, e: ast.AST, names, assumed,
+                       depth: int) -> bool:
+        """One writes= element: a ``(slot, …)`` window tuple whose
+        FIRST position is slot-derived (extents are the fuzz
+        battery's problem, not the lint's)."""
+        if depth > 8:
+            return False
+        if isinstance(e, ast.Tuple):
+            return bool(e.elts) and self._r9_slot(
+                e.elts[0], names, assumed, depth + 1)
+        if isinstance(e, ast.IfExp):
+            return (self._r9_write_elem(e.body, names, assumed,
+                                        depth + 1) and
+                    self._r9_write_elem(e.orelse, names, assumed,
+                                        depth + 1))
+        if isinstance(e, ast.Name):
+            if e.id in assumed:
+                return True
+            rhss = names.get(e.id)
+            return bool(rhss) and all(
+                self._r9_write_elem(r, names, assumed, depth + 1)
+                for r in rhss)
+        return False
+
+    def _r9_tuple(self, e: ast.AST, names, assumed, elem,
+                  depth: int) -> bool:
+        """A whole footprint expression: a tuple of ``elem``-valid
+        entries, chased through names, concatenation, conditional
+        branches, ``tuple(genexp)`` comprehension (targets assumed
+        derived), or an interference-helper call."""
+        if depth > 8:
+            return False
+        if isinstance(e, ast.Constant):
+            return e.value is None   # explicit barrier / empty default
+        if isinstance(e, ast.Tuple):
+            return all(elem(x, names, assumed, depth + 1)
+                       for x in e.elts)
+        if isinstance(e, ast.IfExp):
+            return (self._r9_tuple(e.body, names, assumed, elem,
+                                   depth + 1) and
+                    self._r9_tuple(e.orelse, names, assumed, elem,
+                                   depth + 1))
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+            return (self._r9_tuple(e.left, names, assumed, elem,
+                                   depth + 1) and
+                    self._r9_tuple(e.right, names, assumed, elem,
+                                   depth + 1))
+        if isinstance(e, ast.Name):
+            if e.id in assumed:
+                return True
+            rhss = names.get(e.id)
+            return bool(rhss) and all(
+                self._r9_tuple(r, names, assumed, elem, depth + 1)
+                for r in rhss)
+        if isinstance(e, ast.Call):
+            d = _dotted(e.func)
+            short = d.rsplit(".", 1)[-1]
+            if short in self._R9_HELPERS:
+                return True
+            if short == "tuple" and e.args and isinstance(
+                    e.args[0], (ast.GeneratorExp, ast.ListComp)):
+                g = e.args[0]
+                assumed2 = set(assumed)
+                for gen in g.generators:
+                    for t in ast.walk(gen.target):
+                        if isinstance(t, ast.Name):
+                            assumed2.add(t.id)
+                return elem(g.elt, names, assumed2, depth + 1)
+        return False
+
+    def check_plansan_registry(self) -> None:
+        """Whole-repo R9 closure: the ``plansan.FAMILIES`` registry ↔
+        the ``Plan.record_*`` methods ↔ the SPEC §23.2 family table ↔
+        the mutation battery ↔ the fuzz arm, both drift directions,
+        plus the ``sanitize.verify`` fault site — the R3/R7/R8
+        registry discipline applied to footprint kinds."""
+        if not self.full_scan or "R9" not in self.rules:
+            return
+        ps_fi = next((f for f in self.files
+                      if f.relpath == "dr_tpu/plan/plansan.py"), None)
+        if ps_fi is None:
+            return
+        fams: Dict[str, Tuple[int, str]] = {}
+        for node in ps_fi.tree.body:
+            tgt = node.targets[0] if isinstance(node, ast.Assign) \
+                and node.targets else None
+            if isinstance(tgt, ast.Name) and tgt.id == "FAMILIES" and \
+                    isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Tuple) and \
+                            len(elt.elts) == 2 and all(
+                                isinstance(e, ast.Constant)
+                                for e in elt.elts):
+                        fams[elt.elts[0].value] = (
+                            elt.lineno, elt.elts[1].value)
+        if not fams:
+            self.emit("R9", ps_fi, 1,
+                      "no FAMILIES registry found — plansan must "
+                      "register every footprint kind as a literal "
+                      "(family, record_method) pair")
+            return
+        # families ↔ Plan.record_* methods, both directions
+        plan_fi = next((f for f in self.files
+                        if f.relpath == "dr_tpu/plan/__init__.py"),
+                       None)
+        if plan_fi is not None:
+            methods: Dict[str, int] = {}
+            for m in re.finditer(r"^\s+def (record_[a-z_]+)\(",
+                                 plan_fi.src, re.MULTILINE):
+                methods[m.group(1)] = \
+                    plan_fi.src[:m.start()].count("\n") + 1
+            for fam, (line, meth) in sorted(fams.items()):
+                if meth not in methods:
+                    self.emit("R9", ps_fi, line,
+                              f"family {fam!r} names {meth!r} but "
+                              "plan/__init__.py defines no such "
+                              "record method")
+            for meth, line in sorted(methods.items()):
+                if meth not in {m for _l, m in fams.values()}:
+                    self.emit("R9", plan_fi, line,
+                              f"record method {meth!r} is missing "
+                              "from plansan.FAMILIES — unregistered "
+                              "footprint kinds escape the mutation "
+                              "battery and the fuzz arm")
+        # SPEC §23.2 family-table rows, both directions
+        spec_rows: Dict[str, int] = {}
+        spec_path = os.path.join(REPO, "docs", "SPEC.md")
+        if os.path.exists(spec_path):
+            in_sect = False
+            with open(spec_path, encoding="utf-8") as fh:
+                for i, text in enumerate(fh.read().splitlines(), 1):
+                    if re.match(r"###\s*23\.2\b", text):
+                        in_sect = True
+                        continue
+                    if in_sect and re.match(r"##", text):
+                        break
+                    if in_sect:
+                        m = re.match(r"\|\s*`([a-z][a-z_]*)`", text)
+                        if m:
+                            spec_rows[m.group(1)] = i
+        for fam, (line, _meth) in sorted(fams.items()):
+            if fam not in spec_rows:
+                self.emit("R9", ps_fi, line,
+                          f"footprint family {fam!r} has no docs/"
+                          "SPEC.md §23.2 family-table row — document "
+                          "its declared footprint shape")
+        for fam, line in sorted(spec_rows.items()):
+            if fam not in fams:
+                self.findings.append(Finding(
+                    "docs/SPEC.md", line, "R9",
+                    f"§23.2 family-table row {fam!r} matches no "
+                    "plansan.FAMILIES entry — stale documentation"))
+        # mutation battery sweeps the registry
+        bat = next((f for f in self.files
+                    if f.relpath == "tests/test_plansan.py"), None)
+        if bat is None:
+            self.emit("R9", ps_fi, 1,
+                      "tests/test_plansan.py does not exist — every "
+                      "footprint family needs a seeded "
+                      "under-declaration the shadow verifier catches")
+        elif not re.search(r"\bFAMILY_NAMES\b", bat.src):
+            missing = [f for f in sorted(fams) if f not in bat.src]
+            if missing:
+                self.emit("R9", bat, 1,
+                          "test_plansan does not sweep "
+                          "plansan.FAMILY_NAMES and never names: "
+                          f"{', '.join(missing)}")
+        # oracle fuzz arm exists
+        fuzz = next((f for f in self.files
+                     if f.relpath == "tests/test_fuzz.py"), None)
+        if fuzz is not None and \
+                "def test_fuzz_plansan" not in fuzz.src:
+            self.emit("R9", fuzz, 1,
+                      "tests/test_fuzz.py has no test_fuzz_plansan — "
+                      "the serializability oracle needs the random-"
+                      "plan random-pass-subset fuzz arm")
+        # the runtime verifier's fault site is registered
+        sites = self.fault_sites() or {}
+        if sites and "sanitize.verify" not in sites:
+            self.emit("R9", ps_fi, 1,
+                      "fault site 'sanitize.verify' is not registered "
+                      "in faults.SITES — the verifier's failure path "
+                      "is outside the chaos sweep")
+
     # --------------------------------------------------------------- R4
     def check_collective(self, fi: FileInfo, node: ast.Call,
                          short: str) -> None:
@@ -963,6 +1316,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="accept current findings into the baseline")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline file (report everything)")
+    ap.add_argument("--prune", action="store_true",
+                    help="rewrite the baseline down to the entries "
+                    "that still fire (stale suppressions otherwise "
+                    "FAIL the run)")
     args = ap.parse_args(argv)
 
     full_scan = not args.paths
@@ -1013,10 +1370,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                f"({n_base} baselined, {n_sup} suppressed) over "
                f"{len(files)} file(s)")
     print(summary, file=out)
-    if stale:
-        print(f"drlint: note — {sum(stale.values())} stale baseline "
-              "entr(ies) no longer fire; re-run --write-baseline",
-              file=out)
+    stale_fail = False
+    if stale and args.prune:
+        # rewrite the baseline down to what still fires
+        kept = {k: v - stale.get(k, 0) for k, v in baseline.items()}
+        kept = {k: v for k, v in kept.items() if v > 0}
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump({"findings": kept}, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"drlint: pruned {sum(stale.values())} stale baseline "
+              f"entr(ies); {sum(kept.values())} remain in "
+              f"{args.baseline}", file=out)
+    elif stale and full_scan:
+        # a suppression matching no finding could silently re-admit
+        # the bug it once excused — stale entries fail the gate
+        stale_fail = True
+        for k in sorted(stale):
+            print(f"drlint: STALE baseline entry ({stale[k]}x): {k}",
+                  file=out)
+        print(f"drlint: {sum(stale.values())} stale baseline "
+              "entr(ies) no longer match any finding — run --prune "
+              "(or --write-baseline) to burn them down", file=out)
+    elif stale:
+        # a partial scan can't tell dead from out-of-scope — note only
+        print(f"drlint: note — {sum(stale.values())} baseline "
+              "entr(ies) did not fire in this partial scan", file=out)
 
     if args.json:
         report = {
@@ -1032,7 +1410,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             with open(args.json, "w", encoding="utf-8") as fh:
                 fh.write(text)
-    return 1 if active else 0
+    return 1 if (active or stale_fail) else 0
 
 
 if __name__ == "__main__":

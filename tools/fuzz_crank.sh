@@ -110,6 +110,18 @@
 # one bitonic stage boundary and a >2-tile segred groupby.  The chaos
 # battery sweeps the kernel.build site rows.  drlint R8 keys the arm
 # registry on this battery.
+#
+# PLANSAN arm (docs/SPEC.md SS23): test_fuzz_plansan cranks random
+# recorded chains with the plansan layer armed in-process — shadow
+# verifier over every fused run, container watcher over every opaque
+# thunk, serializability oracle over every optimized queue under
+# RANDOM DR_TPU_PLAN_OPT_DISABLE pass subsets — bit-compared against
+# an unarmed control (filter `plansan`; collected automatically with
+# the fuzz arms).  A dedicated DR_TPU_SANITIZE=1 crank below re-runs
+# it through the env-armed install() route, and the MAKE-SANITIZE
+# gate runs the whole tier-1 suite armed plus drlint (= `make
+# sanitize`, the SS23.5 soundness gate).  drlint R9 keys the
+# footprint family registry on the test_plansan.py mutation battery.
 set -u
 cd "$(dirname "$0")/.."
 ITERS=${1:-300}
@@ -209,6 +221,35 @@ if [ -z "$FILTER" ]; then
   st=${PIPESTATUS[0]}
   if [ "$st" -ne 0 ]; then
     echo "FAILED ($st): $nd under DR_TPU_SANITIZE=1"
+    rc=1
+  fi
+fi
+# PLANSAN arm (docs/SPEC.md SS23): the plansan battery through the
+# ENV-armed route — DR_TPU_SANITIZE=1 makes runtime init call
+# sanitize.install(), so the verifier/watcher/oracle ride every flush
+# the way a production sanitize run arms them (the in-process arming
+# inside the test covered the hook mechanics; this covers install()).
+# Skipped when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  nd="tests/test_fuzz.py::test_fuzz_plansan"
+  echo "=== $nd (DR_TPU_SANITIZE=1 DR_TPU_FUZZ_ITERS=$ITERS) ==="
+  DR_TPU_SANITIZE=1 DR_TPU_FUZZ_ITERS=$ITERS \
+    python -m pytest "$nd" -q 2>&1 | tail -2
+  st=${PIPESTATUS[0]}
+  if [ "$st" -ne 0 ]; then
+    echo "FAILED ($st): $nd under DR_TPU_SANITIZE=1"
+    rc=1
+  fi
+fi
+# MAKE-SANITIZE gate (docs/SPEC.md SS23.5): the full soundness gate —
+# tier-1 under the armed runtime sanitizer (recompile budget, finite
+# sweep, canon keys, plansan verifier + oracle on every deferred
+# flush in the suite) plus the static half (drlint R0-R10).  Skipped
+# when a filter already narrowed the crank.
+if [ -z "$FILTER" ]; then
+  echo "=== make sanitize (armed tier-1 + drlint) ==="
+  if ! make sanitize; then
+    echo "FAILED: make sanitize"
     rc=1
   fi
 fi
